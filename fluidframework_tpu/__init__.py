@@ -1,0 +1,26 @@
+"""fluidframework_tpu — a TPU-native real-time collaboration framework.
+
+A ground-up re-design of the capabilities of Fluid Framework
+(reference: 16CentAstrology-Inc/FluidFramework) for TPU hardware:
+
+- Distributed Data Structures (DDSes) with optimistic local replicas that
+  converge by deterministic replay of a totally ordered op stream
+  (reference: packages/dds/*).
+- The merge hot path — merge-tree op application and sequence
+  reconciliation (reference: packages/dds/merge-tree/src/mergeTree.ts) —
+  is re-expressed as vectorized JAX/XLA kernels over a
+  structure-of-arrays segment table (`fluidframework_tpu.ops`).
+- A total-order sequencing service with MSN tracking (reference:
+  server/routerlicious/packages/lambdas/src/deli/lambda.ts) with both a
+  scalar in-proc implementation (`fluidframework_tpu.server`) and a
+  batched JAX kernel that sequences thousands of documents at once.
+- Runtime, summarization/checkpointing, reconnect-with-rebase, and the
+  full test story (mock runtimes, seeded fuzz farms, in-proc orderer
+  integration tests, replay harnesses).
+
+This is not a port: data layouts, kernels and parallelism are designed
+for XLA/TPU (SPMD over `jax.sharding.Mesh`, associative scans,
+min-reductions), not translated from the reference's TypeScript.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,18 @@
+"""Scalar reference implementations (the differential-testing oracles).
+
+`mergetree.py` is a straight, correct, pointer-free implementation of the
+reference's merge-tree conflict-resolution semantics
+(packages/dds/merge-tree/src/mergeTree.ts). Every TPU kernel in
+`fluidframework_tpu.ops` is validated bit-identically against it on
+seeded multi-client farms (mirroring the role of the reference's
+mergeTreeOperationRunner.ts harness).
+"""
+
+from .mergetree import (
+    Segment,
+    MergeTreeEngine,
+    CollabClient,
+    VisCategory,
+)
+
+__all__ = ["Segment", "MergeTreeEngine", "CollabClient", "VisCategory"]

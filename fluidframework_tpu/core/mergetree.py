@@ -1,0 +1,612 @@
+"""Scalar merge-tree engine: the semantics oracle.
+
+A pointer-free re-implementation of the reference merge-tree's
+conflict-resolution semantics over a flat, document-ordered segment list.
+It is deliberately simple and slow (O(n) per op) — its job is to be
+*obviously correct* so the vectorized JAX kernels
+(fluidframework_tpu/ops/mergetree_kernel.py) can be differentially
+tested against it, mirroring how the reference fuzz farms
+(packages/dds/merge-tree/src/test/client.conflictFarm.spec.ts) assert
+replica convergence.
+
+Semantics sources (reference file:line):
+- Visibility of a segment at a perspective (refSeq, clientId):
+  mergeTree.ts:916 `nodeLength` (remote path) and mergeTree.ts:613
+  `localNetLength` (local path). Three outcomes: SKIP (`undefined` —
+  tombstone excluded even from tie-breaks), ZERO (invisible but
+  participates in tie-breaks), VISIBLE.
+- Insert placement + concurrency tie-break: mergeTree.ts:1740
+  `insertingWalk` with mergeTree.ts:1719 `breakTie` — the new segment is
+  placed before an existing zero-position segment iff
+  effective(newSeq) > effective(segSeq), where a new local pending op
+  has effective seq +inf and an existing local pending segment +inf-1.
+- Range walks (remove/annotate) visit only segments with visible
+  length > 0 at the op's perspective: mergeTree.ts `nodeMap` (skips
+  len undefined or 0), after splitting at the range boundaries
+  (`ensureIntervalBoundary`).
+- Overlapping removes keep the earliest sequenced removedSeq and
+  accumulate removing client ids: mergeTree.ts:1960 `markRangeRemoved`.
+- Acking local ops: mergeTree.ts:1283 `ackPendingSegment` (FIFO pending
+  segment groups).
+- Annotate conflict resolution: segmentPropertiesManager.ts
+  `addProperties` — pending local key updates shadow remote writes until
+  acked; `null` deletes a key.
+- Zamboni (tombstone collection below the MSN): zamboni.ts:19.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..protocol.constants import (
+    EFF_SEQ_EXISTING_LOCAL,
+    EFF_SEQ_NEW_LOCAL,
+    NON_COLLAB_CLIENT,
+    UNASSIGNED_SEQ,
+    UNIVERSAL_SEQ,
+)
+from ..protocol.mergetree_ops import (
+    AnnotateOp,
+    GroupOp,
+    InsertOp,
+    MergeTreeDeltaType,
+    MergeTreeOp,
+    RemoveOp,
+)
+from ..protocol.messages import DocumentMessage, MessageType, SequencedMessage
+
+
+
+
+class VisCategory(enum.IntEnum):
+    SKIP = 0  # excluded from walks entirely (tombstone at/before perspective)
+    ZERO = 1  # zero visible length; participates in insert tie-breaks
+    VISIBLE = 2
+
+
+@dataclass
+class Segment:
+    """One run of content with its merge metadata (reference ISegment,
+    mergeTreeNodes.ts:126)."""
+
+    content: Any  # str for text; tuple/list for item sequences
+    seq: int  # UNASSIGNED_SEQ while a local insert is pending
+    client_id: int
+    local_seq: Optional[int] = None
+    removed_seq: Optional[int] = None  # None=not removed; UNASSIGNED_SEQ=pending
+    local_removed_seq: Optional[int] = None
+    removed_clients: List[int] = field(default_factory=list)
+    props: Optional[Dict[str, Any]] = None
+    # pending local annotate counts per key (segmentPropertiesManager.ts)
+    pending_props: Optional[Dict[str, int]] = None
+    # pending local op groups this segment belongs to (reference:
+    # ISegment.segmentGroups; splitAt copies membership so an ack reaches
+    # both halves of a split pending segment).
+    groups: List[Any] = field(default_factory=list)
+
+    def __len__(self) -> int:
+        return len(self.content)
+
+    def split(self, offset: int) -> "Segment":
+        """Split self at offset; self keeps [:offset], returns the tail
+        (inherits all merge metadata — reference BaseSegment.splitAt)."""
+        assert 0 < offset < len(self.content)
+        tail = Segment(
+            content=self.content[offset:],
+            seq=self.seq,
+            client_id=self.client_id,
+            local_seq=self.local_seq,
+            removed_seq=self.removed_seq,
+            local_removed_seq=self.local_removed_seq,
+            removed_clients=list(self.removed_clients),
+            props=dict(self.props) if self.props is not None else None,
+            pending_props=dict(self.pending_props) if self.pending_props else None,
+            groups=list(self.groups),
+        )
+        self.content = self.content[:offset]
+        for grp in tail.groups:
+            grp.segments.append(tail)
+        return tail
+
+
+def _eff_seq(seq: int) -> int:
+    """An existing segment's effective seq for tie-break comparisons
+    (reference mergeTree.ts:1719 breakTie): a local pending segment
+    compares just below a new local op."""
+    if seq == UNASSIGNED_SEQ:
+        return EFF_SEQ_EXISTING_LOCAL
+    return seq
+
+
+@dataclass
+class _PendingGroup:
+    """One local op's segment group awaiting ack (reference SegmentGroup)."""
+
+    kind: MergeTreeDeltaType
+    segments: List[Segment] = field(default_factory=list)
+    props: Optional[Dict[str, Any]] = None  # for annotate acks
+    local_seq: Optional[int] = None
+
+
+class MergeTreeEngine:
+    """A single replica's merge state: a document-ordered segment list.
+
+    `local_client_id` is the id this replica submits ops as
+    (NON_COLLAB_CLIENT for a passive/replay replica, e.g. the
+    server-side summarizer view).
+    """
+
+    def __init__(self, local_client_id: int = NON_COLLAB_CLIENT):
+        self.segments: List[Segment] = []
+        self.local_client_id = local_client_id
+        self.collaborating = local_client_id != NON_COLLAB_CLIENT
+        self.current_seq = 0
+        self.min_seq = 0
+        self.local_seq = 0
+        self.pending: deque[_PendingGroup] = deque()
+        self.zamboni_enabled = True
+
+    # ---------------------------------------------------------------- load
+
+    def load(self, content: Any, props: Optional[dict] = None) -> None:
+        """Initialize from summary content (seq = UniversalSequenceNumber,
+        reference mergeTree.ts reloadFromSegments)."""
+        if len(content) > 0:
+            self.segments.append(
+                Segment(
+                    content=content,
+                    seq=UNIVERSAL_SEQ,
+                    client_id=NON_COLLAB_CLIENT,
+                    props=dict(props) if props else None,
+                )
+            )
+
+    # ---------------------------------------------------------- visibility
+
+    def _vis(self, seg: Segment, ref_seq: int, client_id: int) -> Tuple[VisCategory, int]:
+        """Visibility of `seg` at perspective (ref_seq, client_id).
+
+        Mirrors mergeTree.ts:916 nodeLength. Returns (category, visible
+        length)."""
+        removed = seg.removed_seq is not None
+        if client_id == self.local_client_id and self.collaborating:
+            # Local perspective (localNetLength, mergeTree.ts:613): the
+            # local replica has applied every sequenced op plus its own
+            # pending ones, so any removal (acked or pending) hides the
+            # segment; tombstones at/below the MSN are zamboni-eligible
+            # and must be skipped entirely.
+            if removed:
+                norm = (
+                    float("inf")
+                    if seg.removed_seq == UNASSIGNED_SEQ
+                    else seg.removed_seq
+                )
+                if norm > self.min_seq:
+                    return (VisCategory.ZERO, 0)
+                return (VisCategory.SKIP, 0)
+            return (VisCategory.VISIBLE, len(seg))
+
+        # Remote perspective.
+        if removed and seg.removed_seq != UNASSIGNED_SEQ and seg.removed_seq <= ref_seq:
+            # Tombstone at this perspective: may not exist on other
+            # replicas — excluded from all decisions.
+            return (VisCategory.SKIP, 0)
+        if seg.client_id == client_id or (
+            seg.seq != UNASSIGNED_SEQ and seg.seq <= ref_seq
+        ):
+            # Insert visible at this perspective.
+            if removed and client_id in seg.removed_clients:
+                return (VisCategory.ZERO, 0)
+            return (VisCategory.VISIBLE, len(seg))
+        # Insert not visible.
+        if removed and seg.removed_seq != UNASSIGNED_SEQ:
+            # Inserted and (remotely) removed, both unseen by this
+            # client: will never exist for it.
+            return (VisCategory.SKIP, 0)
+        return (VisCategory.ZERO, 0)
+
+    def visible_length(self, ref_seq: int, client_id: int) -> int:
+        return sum(self._vis(s, ref_seq, client_id)[1] for s in self.segments)
+
+    # ------------------------------------------------------------- insert
+
+    def insert(
+        self,
+        pos: int,
+        content: Any,
+        ref_seq: int,
+        client_id: int,
+        seq: int,
+        props: Optional[dict] = None,
+    ) -> Segment:
+        """Insert `content` at visible position `pos` of perspective
+        (ref_seq, client_id), with op sequence number `seq`
+        (UNASSIGNED_SEQ for a pending local op).
+
+        Placement mirrors insertingWalk + breakTie (mergeTree.ts:1740,
+        :1719): walk document order accumulating visible lengths; land
+        strictly inside a VISIBLE segment -> split it; at a boundary,
+        place the new segment before the first non-SKIP segment whose
+        effective seq is lower than the op's.
+        """
+        eff_new = EFF_SEQ_NEW_LOCAL if seq == UNASSIGNED_SEQ else seq
+        local_seq = None
+        if seq == UNASSIGNED_SEQ:
+            self.local_seq += 1
+            local_seq = self.local_seq
+        new_seg = Segment(
+            content=content,
+            seq=seq,
+            client_id=client_id,
+            local_seq=local_seq,
+            props=dict(props) if props else None,
+        )
+
+        remaining = pos
+        insert_at = len(self.segments)  # default: append at end
+        for i, seg in enumerate(self.segments):
+            cat, length = self._vis(seg, ref_seq, client_id)
+            if cat == VisCategory.SKIP:
+                continue
+            if remaining < length:
+                # Lands inside or immediately before a VISIBLE segment.
+                # At its position 0 the tie-break always favors the new
+                # op (a visible segment's seq is <= refSeq < newSeq; an
+                # existing local pending segment yields to a new local).
+                if remaining == 0:
+                    insert_at = i
+                else:
+                    tail = seg.split(remaining)
+                    self.segments.insert(i + 1, tail)
+                    insert_at = i + 1
+                break
+            if remaining == 0 and length == 0:
+                # breakTie (mergeTree.ts:1719): place before iff the new
+                # op's effective seq is strictly greater than the
+                # segment's (new local = INT32_MAX beats existing local
+                # = INT32_MAX - 1 beats any sequenced seq).
+                if eff_new > _eff_seq(seg.seq):
+                    insert_at = i
+                    break
+                continue
+            remaining -= length
+        else:
+            if remaining > 0:
+                raise ValueError(
+                    f"insert pos {pos} beyond visible length at perspective "
+                    f"({ref_seq},{client_id})"
+                )
+            insert_at = len(self.segments)
+
+        self.segments.insert(insert_at, new_seg)
+
+        if seq == UNASSIGNED_SEQ:
+            grp = _PendingGroup(kind=MergeTreeDeltaType.INSERT, local_seq=local_seq)
+            grp.segments.append(new_seg)
+            new_seg.groups.append(grp)
+            self.pending.append(grp)
+        return new_seg
+
+    # ------------------------------------------------------------- remove
+
+    def _ensure_boundary(self, pos: int, ref_seq: int, client_id: int) -> None:
+        """Split a VISIBLE segment so visible position `pos` is a segment
+        boundary (reference ensureIntervalBoundary, mergeTree.ts:1706)."""
+        remaining = pos
+        for i, seg in enumerate(self.segments):
+            cat, length = self._vis(seg, ref_seq, client_id)
+            if cat == VisCategory.SKIP:
+                continue
+            if remaining < length:
+                if remaining > 0:
+                    tail = seg.split(remaining)
+                    self.segments.insert(i + 1, tail)
+                return
+            remaining -= length
+
+    def remove_range(
+        self, start: int, end: int, ref_seq: int, client_id: int, seq: int
+    ) -> List[Segment]:
+        """Mark [start, end) removed at perspective (ref_seq, client_id).
+
+        Mirrors markRangeRemoved (mergeTree.ts:1960): only segments with
+        visible length > 0 at the perspective are marked; overlapping
+        removes keep the earliest sequenced removedSeq; a local pending
+        remove overtaken by a remote one puts the remote client at the
+        head of the removing-client list.
+        """
+        assert end > start >= 0
+        self._ensure_boundary(start, ref_seq, client_id)
+        self._ensure_boundary(end, ref_seq, client_id)
+
+        local_seq = None
+        if seq == UNASSIGNED_SEQ:
+            self.local_seq += 1
+            local_seq = self.local_seq
+
+        marked: List[Segment] = []
+        pos = 0
+        for seg in self.segments:
+            if pos >= end:
+                break
+            cat, length = self._vis(seg, ref_seq, client_id)
+            if cat == VisCategory.SKIP or length == 0:
+                continue
+            if pos >= start:  # boundary splits guarantee full containment
+                if seg.removed_seq is not None:
+                    if seg.removed_seq == UNASSIGNED_SEQ:
+                        # Our pending local remove lost the race: the
+                        # remote remover goes to the head of the list and
+                        # its seq becomes the removal seq.
+                        seg.removed_clients.insert(0, client_id)
+                        seg.removed_seq = seq
+                    else:
+                        # Overlapping sequenced removes: keep earliest.
+                        seg.removed_clients.append(client_id)
+                else:
+                    seg.removed_seq = seq
+                    seg.removed_clients = [client_id]
+                    seg.local_removed_seq = local_seq
+                marked.append(seg)
+            pos += length
+
+        if seq == UNASSIGNED_SEQ:
+            grp = _PendingGroup(kind=MergeTreeDeltaType.REMOVE, local_seq=local_seq)
+            # Only segments newly removed by us are pending-acked.
+            for s in marked:
+                if s.removed_seq == UNASSIGNED_SEQ:
+                    grp.segments.append(s)
+                    s.groups.append(grp)
+            self.pending.append(grp)
+        return marked
+
+    # ----------------------------------------------------------- annotate
+
+    def annotate_range(
+        self,
+        start: int,
+        end: int,
+        props: Dict[str, Any],
+        ref_seq: int,
+        client_id: int,
+        seq: int,
+    ) -> None:
+        """Set properties on [start, end) at the op's perspective.
+
+        Conflict rule (segmentPropertiesManager.ts addProperties): a
+        remote write to a key with pending local updates is ignored
+        (the local value will win when sequenced); `None` deletes.
+        """
+        assert end > start >= 0
+        self._ensure_boundary(start, ref_seq, client_id)
+        self._ensure_boundary(end, ref_seq, client_id)
+        is_local = seq == UNASSIGNED_SEQ
+        if is_local:
+            self.local_seq += 1
+
+        pending_segs: List[Segment] = []
+        pos = 0
+        for seg in self.segments:
+            if pos >= end:
+                break
+            cat, length = self._vis(seg, ref_seq, client_id)
+            if cat == VisCategory.SKIP or length == 0:
+                continue
+            if pos >= start:
+                if seg.props is None:
+                    seg.props = {}
+                for key, value in props.items():
+                    if is_local:
+                        if seg.pending_props is None:
+                            seg.pending_props = {}
+                        seg.pending_props[key] = seg.pending_props.get(key, 0) + 1
+                        _set_prop(seg.props, key, value)
+                    else:
+                        if seg.pending_props and seg.pending_props.get(key):
+                            continue  # shadowed by pending local write
+                        _set_prop(seg.props, key, value)
+                pending_segs.append(seg)
+            pos += length
+
+        if is_local:
+            grp = _PendingGroup(
+                kind=MergeTreeDeltaType.ANNOTATE,
+                props=dict(props),
+                local_seq=self.local_seq,
+            )
+            for s in pending_segs:
+                grp.segments.append(s)
+                s.groups.append(grp)
+            self.pending.append(grp)
+
+    # ----------------------------------------------------------------- ack
+
+    def ack(self, seq: int) -> None:
+        """Ack the oldest pending local op with its assigned sequence
+        number (reference ackPendingSegment, mergeTree.ts:1283)."""
+        grp = self.pending.popleft()
+        for seg in grp.segments:
+            try:
+                seg.groups.remove(grp)
+            except ValueError:
+                pass
+        if grp.kind == MergeTreeDeltaType.INSERT:
+            for seg in grp.segments:
+                seg.seq = seq
+                seg.local_seq = None
+        elif grp.kind == MergeTreeDeltaType.REMOVE:
+            for seg in grp.segments:
+                if seg.removed_seq == UNASSIGNED_SEQ:
+                    seg.removed_seq = seq
+                # else: an overlapping remote remove was sequenced first
+                # and already owns removed_seq (keep earliest).
+                seg.local_removed_seq = None
+        elif grp.kind == MergeTreeDeltaType.ANNOTATE:
+            for seg in grp.segments:
+                if seg.pending_props:
+                    for key in grp.props or {}:
+                        cnt = seg.pending_props.get(key)
+                        if cnt:
+                            if cnt == 1:
+                                del seg.pending_props[key]
+                            else:
+                                seg.pending_props[key] = cnt - 1
+
+    # ------------------------------------------------------------ windows
+
+    def update_min_seq(self, min_seq: int) -> None:
+        """Advance the MSN and run zamboni: physically drop tombstones
+        whose removal is at/below the MSN (zamboni.ts:19)."""
+        assert min_seq >= self.min_seq
+        self.min_seq = min_seq
+        if self.zamboni_enabled:
+            self.segments = [
+                s
+                for s in self.segments
+                if not (
+                    s.removed_seq is not None
+                    and s.removed_seq != UNASSIGNED_SEQ
+                    and s.removed_seq <= min_seq
+                )
+            ]
+
+    # ------------------------------------------------------------- output
+
+    def get_text(self) -> str:
+        """Concatenated visible text from the local perspective.
+        Item-content engines (e.g. permutation vectors) use get_items()."""
+        parts = []
+        for seg in self.segments:
+            if seg.removed_seq is None:
+                if not isinstance(seg.content, str):
+                    raise TypeError("non-text engine: use get_items()")
+                parts.append(seg.content)
+        return "".join(parts)
+
+    def get_items(self) -> List[Any]:
+        out: List[Any] = []
+        for seg in self.segments:
+            if seg.removed_seq is None:
+                out.extend(seg.content)
+        return out
+
+    def annotated_spans(self) -> List[Tuple[Any, Optional[dict]]]:
+        """(content, props) for each visible segment — for convergence
+        assertions that include annotations."""
+        return [
+            (s.content, dict(s.props) if s.props else None)
+            for s in self.segments
+            if s.removed_seq is None
+        ]
+
+
+def _set_prop(props: Dict[str, Any], key: str, value: Any) -> None:
+    if value is None:
+        props.pop(key, None)
+    else:
+        props[key] = value
+
+
+class CollabClient:
+    """A collaborating replica: local edits + sequenced-stream application.
+
+    Mirrors the role of merge-tree `Client` (reference
+    packages/dds/merge-tree/src/client.ts:98): local ops are applied
+    optimistically and queued; `apply_msg` (client.ts:858) routes a
+    sequenced message either to the ack path (own op) or the remote
+    apply path, then advances the collaboration window.
+    """
+
+    def __init__(self, client_id: int, initial: str = ""):
+        self.client_id = client_id
+        self.engine = MergeTreeEngine(local_client_id=client_id)
+        if initial:
+            self.engine.load(initial)
+        self.client_seq = 0
+
+    # ------------------------------------------------------- local edits
+
+    def _make_msg(self, op: MergeTreeOp) -> DocumentMessage:
+        self.client_seq += 1
+        return DocumentMessage(
+            client_seq=self.client_seq,
+            ref_seq=self.engine.current_seq,
+            type=MessageType.OP,
+            contents=op,
+        )
+
+    def insert_local(self, pos: int, content: Any, props: Optional[dict] = None) -> DocumentMessage:
+        self.engine.insert(
+            pos,
+            content,
+            self.engine.current_seq,
+            self.client_id,
+            UNASSIGNED_SEQ,
+            props=props,
+        )
+        if isinstance(content, str):
+            return self._make_msg(InsertOp(pos=pos, text=content, props=props))
+        return self._make_msg(InsertOp(pos=pos, seg=list(content), props=props))
+
+    def remove_local(self, start: int, end: int) -> DocumentMessage:
+        self.engine.remove_range(
+            start, end, self.engine.current_seq, self.client_id, UNASSIGNED_SEQ
+        )
+        return self._make_msg(RemoveOp(start=start, end=end))
+
+    def annotate_local(self, start: int, end: int, props: dict) -> DocumentMessage:
+        self.engine.annotate_range(
+            start, end, props, self.engine.current_seq, self.client_id, UNASSIGNED_SEQ
+        )
+        return self._make_msg(AnnotateOp(start=start, end=end, props=dict(props)))
+
+    # --------------------------------------------------- sequenced input
+
+    def apply_msg(self, msg: SequencedMessage) -> None:
+        # Non-op messages (join/leave/noop/summarize...) only advance the
+        # collaboration window (reference client.ts:858 applyMsg switch).
+        if msg.type == MessageType.OP:
+            op = msg.contents
+            if msg.client_id == self.client_id:
+                self._ack_op(op, msg.sequence_number)
+            else:
+                self._apply_remote(op, msg)
+        self.engine.current_seq = msg.sequence_number
+        self.engine.update_min_seq(
+            max(self.engine.min_seq, msg.minimum_sequence_number)
+        )
+
+    def _ack_op(self, op: MergeTreeOp, seq: int) -> None:
+        if isinstance(op, GroupOp):
+            for sub in op.ops:
+                self.engine.ack(seq)
+            return
+        self.engine.ack(seq)
+
+    def _apply_remote(self, op: MergeTreeOp, msg: SequencedMessage) -> None:
+        if isinstance(op, GroupOp):
+            for sub in op.ops:
+                self._apply_remote(sub, msg)
+            return
+        ref_seq, cid, seq = msg.ref_seq, msg.client_id, msg.sequence_number
+        if isinstance(op, InsertOp):
+            content = op.text if op.seg is None else op.seg
+            self.engine.insert(op.pos, content, ref_seq, cid, seq, props=op.props)
+        elif isinstance(op, RemoveOp):
+            self.engine.remove_range(op.start, op.end, ref_seq, cid, seq)
+        elif isinstance(op, AnnotateOp):
+            self.engine.annotate_range(op.start, op.end, op.props, ref_seq, cid, seq)
+        else:
+            raise TypeError(f"unknown op {op!r}")
+
+    # ----------------------------------------------------------- queries
+
+    def get_text(self) -> str:
+        return self.engine.get_text()
+
+    @property
+    def current_seq(self) -> int:
+        return self.engine.current_seq

@@ -1,0 +1,54 @@
+"""Protocol layer (L0): wire types shared by client and server.
+
+Mirrors the roles of the reference's `common/lib/protocol-definitions`
+(`src/protocol.ts`, `src/summary.ts`, `src/clients.ts`) without copying
+its shape byte-for-byte: Python dataclasses for host-side plumbing plus
+integer encodings chosen so op batches lower directly into int32 arrays
+for the TPU kernels.
+"""
+
+from .constants import (
+    UNASSIGNED_SEQ,
+    UNIVERSAL_SEQ,
+    TREE_MAINT_SEQ,
+    NON_COLLAB_CLIENT,
+    NO_CLIENT,
+)
+from .messages import (
+    MessageType,
+    DocumentMessage,
+    SequencedMessage,
+    NackMessage,
+    SignalMessage,
+)
+from .mergetree_ops import (
+    MergeTreeDeltaType,
+    InsertOp,
+    RemoveOp,
+    AnnotateOp,
+    GroupOp,
+    MergeTreeOp,
+    op_to_json,
+    op_from_json,
+)
+
+__all__ = [
+    "UNASSIGNED_SEQ",
+    "UNIVERSAL_SEQ",
+    "TREE_MAINT_SEQ",
+    "NON_COLLAB_CLIENT",
+    "NO_CLIENT",
+    "MessageType",
+    "DocumentMessage",
+    "SequencedMessage",
+    "NackMessage",
+    "SignalMessage",
+    "MergeTreeDeltaType",
+    "InsertOp",
+    "RemoveOp",
+    "AnnotateOp",
+    "GroupOp",
+    "MergeTreeOp",
+    "op_to_json",
+    "op_from_json",
+]

@@ -1,0 +1,33 @@
+"""Sentinel sequence numbers and client ids.
+
+Reference: packages/dds/merge-tree/src/constants.ts:11-15. The values are
+kept identical so recorded op streams and snapshots from the reference
+replay bit-identically.
+"""
+
+# An op/segment that has been applied locally but not yet sequenced by the
+# ordering service.
+UNASSIGNED_SEQ = -1
+
+# Applies to every perspective: content present "from the beginning"
+# (e.g. segments loaded from a summary, or edits made outside
+# collaboration).
+UNIVERSAL_SEQ = 0
+
+# Internal structural maintenance (segment splits for interval
+# boundaries); never wins a tie-break.
+TREE_MAINT_SEQ = -2
+
+# Client id used when not collaborating.
+NON_COLLAB_CLIENT = -2
+
+# "No client" marker for int32 tables (removing client slots, etc.).
+NO_CLIENT = -3
+
+# Effective-sequence-number encoding used by tie-breaks
+# (reference: mergeTree.ts:1719 breakTie). A *new* local pending op
+# compares as +inf; an *existing* local pending segment as +inf - 1.
+# For the int32 kernels we use INT32_MAX / INT32_MAX - 1.
+INT32_MAX = 2**31 - 1
+EFF_SEQ_NEW_LOCAL = INT32_MAX
+EFF_SEQ_EXISTING_LOCAL = INT32_MAX - 1

@@ -1,0 +1,18 @@
+"""Ordering service (the "routerlicious" role, re-designed in-proc).
+
+- `sequencer.py`: per-document total-order sequencer with MSN tracking —
+  the role of the deli lambda (reference:
+  server/routerlicious/packages/lambdas/src/deli/lambda.ts).
+- `local_service.py`: in-process ordering service wiring sequencer ->
+  connected clients, the role of LocalOrderer/LocalDeltaConnectionServer
+  (reference: server/routerlicious/packages/memory-orderer/src/
+  localOrderer.ts:95, local-server/src/localDeltaConnectionServer.ts:63).
+
+The batched TPU counterpart (thousands of documents sequenced in one
+kernel call) lives in fluidframework_tpu/ops/sequencer_kernel.py.
+"""
+
+from .sequencer import DocumentSequencer, NACK_STALE_REFSEQ
+from .local_service import LocalOrderingService
+
+__all__ = ["DocumentSequencer", "LocalOrderingService", "NACK_STALE_REFSEQ"]

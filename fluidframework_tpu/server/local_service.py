@@ -1,0 +1,146 @@
+"""In-process ordering service: sequencer + fan-out.
+
+Plays the role the reference's LocalOrderer + LocalDeltaConnectionServer
+play for tests and local dev (memory-orderer/src/localOrderer.ts:95,
+local-server/src/localDeltaConnectionServer.ts:63): clients connect,
+submit DocumentMessages, and every connected client receives the totally
+ordered SequencedMessage stream. A pluggable op store keeps the durable
+log (the scriptorium role) so late joiners can catch up.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Dict, List, Optional, Union
+
+from ..protocol.messages import DocumentMessage, NackMessage, SequencedMessage
+from .sequencer import DocumentSequencer
+
+Listener = Callable[[SequencedMessage], None]
+NackListener = Callable[[NackMessage], None]
+
+
+class _Connection:
+    def __init__(self, service: "LocalOrderingService", doc_id: str, client_id: int):
+        self.service = service
+        self.doc_id = doc_id
+        self.client_id = client_id
+        self.listener: Optional[Listener] = None
+        self.nack_listener: Optional[NackListener] = None
+        self.connected = True
+
+    def submit(self, msg: DocumentMessage) -> None:
+        if not self.connected:
+            raise RuntimeError("connection closed")
+        self.service._submit(self.doc_id, self.client_id, msg)
+
+    def disconnect(self) -> None:
+        if self.connected:
+            self.connected = False
+            self.service._leave(self.doc_id, self.client_id)
+
+
+class LocalOrderingService:
+    """All documents' sequencers + their connected clients, in-proc.
+
+    Delivery is synchronous and depth-first by default (submit ->
+    everyone's listener runs before submit returns), matching the
+    determinism the in-proc reference harness relies on. Set
+    `deferred=True` to queue deliveries and drain them explicitly
+    (`process_all`), which is how tests interleave op races — the role of
+    MockContainerRuntimeFactory.processAllMessages (reference:
+    packages/runtime/test-runtime-utils/src/mocks.ts:107).
+    """
+
+    def __init__(self, deferred: bool = False):
+        self.sequencers: Dict[str, DocumentSequencer] = {}
+        self.connections: Dict[str, List[_Connection]] = {}
+        self.op_log: Dict[str, List[SequencedMessage]] = {}
+        self.deferred = deferred
+        self._queue: deque[SequencedMessage] = deque()
+        self._doc_queue: Dict[str, deque] = {}
+        self._next_client_id: Dict[str, int] = {}
+
+    # ------------------------------------------------------ connections
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None) -> _Connection:
+        seqr = self.sequencers.setdefault(doc_id, DocumentSequencer(doc_id))
+        if client_id is None:
+            client_id = self._next_client_id.get(doc_id, 1)
+        self._next_client_id[doc_id] = max(
+            self._next_client_id.get(doc_id, 1), client_id + 1
+        )
+        if any(
+            c.client_id == client_id for c in self.connections.get(doc_id, [])
+        ):
+            raise ValueError(
+                f"client {client_id} already connected to {doc_id}"
+            )
+        conn = _Connection(self, doc_id, client_id)
+        self.connections.setdefault(doc_id, []).append(conn)
+        join = seqr.join(client_id)
+        self._deliver(doc_id, join)
+        return conn
+
+    def _leave(self, doc_id: str, client_id: int) -> None:
+        conns = self.connections.get(doc_id, [])
+        self.connections[doc_id] = [c for c in conns if c.client_id != client_id]
+        seqr = self.sequencers[doc_id]
+        leave = seqr.leave(client_id)
+        if leave is not None:
+            self._deliver(doc_id, leave)
+
+    # ------------------------------------------------------- sequencing
+
+    def _submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
+        seqr = self.sequencers[doc_id]
+        result = seqr.sequence(client_id, msg)
+        if isinstance(result, NackMessage):
+            for conn in self.connections.get(doc_id, []):
+                if conn.client_id == client_id and conn.nack_listener:
+                    conn.nack_listener(result)
+            return
+        self._deliver(doc_id, result)
+
+    def _deliver(self, doc_id: str, msg: SequencedMessage) -> None:
+        self.op_log.setdefault(doc_id, []).append(msg)
+        if self.deferred:
+            self._doc_queue.setdefault(doc_id, deque()).append(msg)
+        else:
+            self._fan_out(doc_id, msg)
+
+    def _fan_out(self, doc_id: str, msg: SequencedMessage) -> None:
+        for conn in list(self.connections.get(doc_id, [])):
+            if conn.connected and conn.listener is not None:
+                conn.listener(msg)
+
+    # --------------------------------------------------- deferred drain
+
+    def pending_count(self, doc_id: str) -> int:
+        return len(self._doc_queue.get(doc_id, ()))
+
+    def process_one(self, doc_id: str) -> bool:
+        q = self._doc_queue.get(doc_id)
+        if not q:
+            return False
+        self._fan_out(doc_id, q.popleft())
+        return True
+
+    def process_all(self, doc_id: Optional[str] = None) -> int:
+        """Drain queued deliveries; returns number delivered."""
+        n = 0
+        doc_ids = [doc_id] if doc_id else list(self._doc_queue)
+        progress = True
+        while progress:
+            progress = False
+            for d in doc_ids if doc_id else list(self._doc_queue):
+                while self.process_one(d):
+                    n += 1
+                    progress = True
+        return n
+
+    # ----------------------------------------------------------- catchup
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        """Durable op log read (the scriptorium/deltaStorage role)."""
+        return [m for m in self.op_log.get(doc_id, []) if m.sequence_number > from_seq]

@@ -1,0 +1,196 @@
+"""Per-document total-order sequencer (the deli role).
+
+Scalar, host-side implementation of the sequencing semantics in the
+reference's deli lambda (server/routerlicious/packages/lambdas/src/deli/
+lambda.ts): stamp monotonically increasing sequence numbers, track each
+connected client's reference sequence number
+(ClientSequenceNumberManager, clientSeqManager.ts:22), maintain the
+minimum sequence number (MSN) as the min over connected clients' refSeqs,
+nack ops whose refSeq is below the MSN (lambda.ts:967), and evict idle
+clients so the MSN can advance.
+
+The batched TPU kernel version (10k documents sequenced per call) is in
+fluidframework_tpu/ops/sequencer_kernel.py; this class is its oracle.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    SequencedMessage,
+)
+
+NACK_STALE_REFSEQ = 400
+NACK_UNKNOWN_CLIENT = 403
+NACK_OUT_OF_ORDER = 422
+
+
+@dataclass
+class _ClientState:
+    ref_seq: int
+    client_seq: int
+    last_update: float
+    can_evict: bool = True
+
+
+class DocumentSequencer:
+    """Sequences one document's op stream and tracks its MSN."""
+
+    def __init__(self, doc_id: str = "doc"):
+        self.doc_id = doc_id
+        self.seq = 0
+        self.min_seq = 0
+        self.clients: Dict[int, _ClientState] = {}
+
+    # ------------------------------------------------------- membership
+
+    def join(self, client_id: int, now: Optional[float] = None) -> SequencedMessage:
+        """Admit a client (reference: deli handles ClientJoin by adding
+        to the MSN heap)."""
+        self.clients[client_id] = _ClientState(
+            ref_seq=self.seq, client_seq=0, last_update=now or time.time()
+        )
+        return self._stamp(
+            client_id=client_id,
+            client_seq=0,
+            ref_seq=self.seq,
+            type_=MessageType.CLIENT_JOIN,
+            contents=client_id,
+        )
+
+    def leave(self, client_id: int) -> Optional[SequencedMessage]:
+        if client_id not in self.clients:
+            return None
+        self.clients.pop(client_id)
+        return self._stamp(
+            client_id=client_id,
+            client_seq=0,
+            ref_seq=self.seq,
+            type_=MessageType.CLIENT_LEAVE,
+            contents=client_id,
+        )
+
+    # ------------------------------------------------------- sequencing
+
+    def sequence(
+        self, client_id: int, msg: DocumentMessage, now: Optional[float] = None
+    ) -> Union[SequencedMessage, NackMessage]:
+        """Stamp one client message with the next sequence number, or
+        nack it (stale refSeq / unknown client / out-of-order
+        clientSeq), mirroring deli's ticket() (lambda.ts:818)."""
+        state = self.clients.get(client_id)
+        if state is None:
+            return NackMessage(
+                client_id, msg.client_seq, NACK_UNKNOWN_CLIENT, "unknown client"
+            )
+        if msg.ref_seq < self.min_seq:
+            return NackMessage(
+                client_id,
+                msg.client_seq,
+                NACK_STALE_REFSEQ,
+                f"refSeq {msg.ref_seq} below MSN {self.min_seq}",
+            )
+        if msg.client_seq != state.client_seq + 1:
+            return NackMessage(
+                client_id,
+                msg.client_seq,
+                NACK_OUT_OF_ORDER,
+                f"clientSeq {msg.client_seq}, expected {state.client_seq + 1}",
+            )
+        state.client_seq = msg.client_seq
+        state.ref_seq = msg.ref_seq
+        state.last_update = now or time.time()
+        return self._stamp(
+            client_id=client_id,
+            client_seq=msg.client_seq,
+            ref_seq=msg.ref_seq,
+            type_=msg.type,
+            contents=msg.contents,
+            metadata=msg.metadata,
+            address=msg.address,
+        )
+
+    def _stamp(
+        self,
+        client_id: int,
+        client_seq: int,
+        ref_seq: int,
+        type_: MessageType,
+        contents=None,
+        metadata=None,
+        address=None,
+    ) -> SequencedMessage:
+        self.seq += 1
+        self._update_msn()
+        return SequencedMessage(
+            sequence_number=self.seq,
+            minimum_sequence_number=self.min_seq,
+            client_id=client_id,
+            client_seq=client_seq,
+            ref_seq=ref_seq,
+            type=type_,
+            contents=contents,
+            metadata=metadata,
+            address=address,
+            timestamp=time.time(),
+        )
+
+    def _update_msn(self) -> None:
+        # MSN = min over connected clients' refSeqs; with no clients the
+        # MSN trails the head (deli: msn == seq when no clients so
+        # summaries can collect everything).
+        if self.clients:
+            msn = min(s.ref_seq for s in self.clients.values())
+        else:
+            msn = self.seq
+        # MSN is monotone even across eviction races.
+        self.min_seq = max(self.min_seq, msn)
+
+    def evict_idle(self, older_than: float) -> List[SequencedMessage]:
+        """Evict clients idle since before `older_than` (deli's idle
+        eviction keeps the MSN advancing)."""
+        out = []
+        for cid, st in list(self.clients.items()):
+            if st.can_evict and st.last_update < older_than:
+                msg = self.leave(cid)
+                if msg is not None:
+                    out.append(msg)
+        return out
+
+    # ------------------------------------------------------- checkpoint
+
+    def checkpoint(self) -> dict:
+        """Serializable sequencer state (reference: deli
+        checkpointContext.ts writes the equivalent to Mongo)."""
+        return {
+            "doc_id": self.doc_id,
+            "seq": self.seq,
+            "min_seq": self.min_seq,
+            "clients": {
+                str(cid): {
+                    "ref_seq": st.ref_seq,
+                    "client_seq": st.client_seq,
+                    "last_update": st.last_update,
+                }
+                for cid, st in self.clients.items()
+            },
+        }
+
+    @classmethod
+    def restore(cls, state: dict) -> "DocumentSequencer":
+        seq = cls(state["doc_id"])
+        seq.seq = state["seq"]
+        seq.min_seq = state["min_seq"]
+        for cid, st in state["clients"].items():
+            seq.clients[int(cid)] = _ClientState(
+                ref_seq=st["ref_seq"],
+                client_seq=st["client_seq"],
+                last_update=st["last_update"],
+            )
+        return seq

@@ -1,0 +1,120 @@
+"""Seeded multi-client convergence farms.
+
+The workhorse consistency test, after the reference's conflict farm
+(packages/dds/merge-tree/src/test/client.conflictFarm.spec.ts +
+mergeTreeOperationRunner.ts): a round consists of each client applying
+random local ops *before* seeing each other's (maximal concurrency),
+then the sequencer's totally ordered stream is drained to everyone and
+all replicas must agree exactly.
+"""
+
+from __future__ import annotations
+
+import random
+import string
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..core.mergetree import CollabClient
+from ..protocol.messages import DocumentMessage, SequencedMessage
+from ..server.sequencer import DocumentSequencer
+
+
+@dataclass
+class FarmConfig:
+    num_clients: int = 4
+    rounds: int = 20
+    ops_per_client_per_round: int = 4
+    seed: int = 0
+    insert_weight: float = 0.5
+    remove_weight: float = 0.3
+    annotate_weight: float = 0.2
+    max_insert_len: int = 6
+    annotate_keys: Tuple[str, ...] = ("bold", "color", "size")
+    initial_text: str = "hello world"
+    check_annotations: bool = True
+
+
+def random_op_for(
+    client: CollabClient, rng: random.Random, cfg: FarmConfig
+) -> Optional[DocumentMessage]:
+    """One random local op on `client` (insert/remove/annotate mix)."""
+    length = len(client.get_text())
+    r = rng.random()
+    total = cfg.insert_weight + cfg.remove_weight + cfg.annotate_weight
+    r *= total
+    if r < cfg.insert_weight or length == 0:
+        pos = rng.randint(0, length)
+        n = rng.randint(1, cfg.max_insert_len)
+        text = "".join(rng.choice(string.ascii_lowercase) for _ in range(n))
+        return client.insert_local(pos, text)
+    r -= cfg.insert_weight
+    start = rng.randint(0, length - 1)
+    end = rng.randint(start + 1, min(length, start + 8))
+    if r < cfg.remove_weight:
+        return client.remove_local(start, end)
+    key = rng.choice(cfg.annotate_keys)
+    value = rng.choice([rng.randint(0, 9), "x", None])
+    return client.annotate_local(start, end, {key: value})
+
+
+def run_sharedstring_farm(cfg: FarmConfig) -> str:
+    """Run the farm; assert convergence each round; return final text."""
+    rng = random.Random(cfg.seed)
+    seqr = DocumentSequencer("farm")
+    clients: List[CollabClient] = []
+    for i in range(cfg.num_clients):
+        cid = i + 1
+        seqr.join(cid)
+        clients.append(CollabClient(cid, initial=cfg.initial_text))
+    # Join messages consumed sequence numbers; align every window.
+    for cl in clients:
+        cl.engine.current_seq = seqr.seq
+
+    for rnd in range(cfg.rounds):
+        # Phase 1: everyone edits locally without seeing each other.
+        submissions: List[Tuple[int, DocumentMessage]] = []
+        for c in clients:
+            for _ in range(cfg.ops_per_client_per_round):
+                msg = random_op_for(c, rng, cfg)
+                if msg is not None:
+                    submissions.append((c.client_id, msg))
+        # Phase 2: sequence in a shuffled interleaving.
+        # (Per-client order must be preserved — deli enforces clientSeq
+        # contiguity — so shuffle by merging per-client queues.)
+        per_client = {c.client_id: [] for c in clients}
+        for cid, m in submissions:
+            per_client[cid].append(m)
+        sequenced: List[SequencedMessage] = []
+        while any(per_client.values()):
+            cid = rng.choice([c for c, q in per_client.items() if q])
+            out = seqr.sequence(cid, per_client[cid].pop(0))
+            assert isinstance(out, SequencedMessage), f"unexpected nack {out}"
+            sequenced.append(out)
+        # Phase 3: drain to all clients in total order.
+        for m in sequenced:
+            for c in clients:
+                c.apply_msg(m)
+        # Phase 4: convergence.
+        texts = [c.get_text() for c in clients]
+        assert all(t == texts[0] for t in texts), (
+            f"round {rnd}: divergent texts (seed {cfg.seed}):\n"
+            + "\n".join(f"  client {c.client_id}: {t!r}" for c, t in zip(clients, texts))
+        )
+        if cfg.check_annotations:
+            spans = [_normalized_spans(c) for c in clients]
+            assert all(s == spans[0] for s in spans), (
+                f"round {rnd}: divergent annotations (seed {cfg.seed})"
+            )
+    return clients[0].get_text()
+
+
+def _normalized_spans(client: CollabClient):
+    """Character-wise (char, props) stream — segment boundaries may
+    legitimately differ across replicas; per-character state may not."""
+    out = []
+    for content, props in client.engine.annotated_spans():
+        norm = tuple(sorted(props.items())) if props else ()
+        for ch in content:
+            out.append((ch, norm))
+    return out

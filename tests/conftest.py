@@ -1,0 +1,18 @@
+"""Test configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices so
+multi-chip sharding tests (jax.sharding.Mesh over documents/sequence
+axes) compile and run without TPU hardware, per the project's multi-chip
+validation strategy. Must run before the first `import jax` anywhere in
+the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,58 @@
+"""Seeded multi-client fuzz farms: replica convergence.
+
+The oracle-level equivalent of the reference's conflict farm
+(packages/dds/merge-tree/src/test/client.conflictFarm.spec.ts).
+"""
+
+import pytest
+
+from fluidframework_tpu.testing.farm import FarmConfig, run_sharedstring_farm
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_conflict_farm_small(seed):
+    run_sharedstring_farm(
+        FarmConfig(num_clients=3, rounds=10, ops_per_client_per_round=3, seed=seed)
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_conflict_farm_more_clients(seed):
+    run_sharedstring_farm(
+        FarmConfig(
+            num_clients=8,
+            rounds=8,
+            ops_per_client_per_round=4,
+            seed=1000 + seed,
+        )
+    )
+
+
+def test_conflict_farm_insert_heavy():
+    run_sharedstring_farm(
+        FarmConfig(
+            num_clients=5,
+            rounds=12,
+            ops_per_client_per_round=5,
+            seed=42,
+            insert_weight=0.8,
+            remove_weight=0.1,
+            annotate_weight=0.1,
+            initial_text="",
+        )
+    )
+
+
+def test_conflict_farm_remove_heavy():
+    run_sharedstring_farm(
+        FarmConfig(
+            num_clients=4,
+            rounds=12,
+            ops_per_client_per_round=4,
+            seed=7,
+            insert_weight=0.35,
+            remove_weight=0.55,
+            annotate_weight=0.10,
+            initial_text="the quick brown fox jumps over the lazy dog",
+        )
+    )

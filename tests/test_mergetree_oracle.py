@@ -1,0 +1,250 @@
+"""Unit tests for the scalar merge-tree oracle.
+
+Scenario sources: reference merge-tree unit tests
+(packages/dds/merge-tree/src/test/*.spec.ts) — basic editing, concurrent
+insert tie-breaks, overlapping removes, annotate conflicts, ack flow.
+"""
+
+import pytest
+
+from fluidframework_tpu.core.mergetree import CollabClient, MergeTreeEngine
+from fluidframework_tpu.protocol.constants import NON_COLLAB_CLIENT, UNASSIGNED_SEQ
+from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
+from fluidframework_tpu.server.sequencer import DocumentSequencer
+
+
+def make_msg(seq, msn, cid, cseq, ref, op):
+    return SequencedMessage(
+        sequence_number=seq,
+        minimum_sequence_number=msn,
+        client_id=cid,
+        client_seq=cseq,
+        ref_seq=ref,
+        type=MessageType.OP,
+        contents=op,
+    )
+
+
+class TestBasicEditing:
+    def test_insert_into_empty(self):
+        e = MergeTreeEngine()
+        e.insert(0, "hello", 0, 1, 1)
+        assert e.get_text() == "hello"
+
+    def test_insert_middle_splits(self):
+        e = MergeTreeEngine()
+        e.insert(0, "held", 0, 1, 1)
+        e.insert(3, "lo wor", 1, 1, 2)
+        assert e.get_text() == "hello word"[:9] + "d"  # "hello word"? no:
+        # "held" with "lo wor" at 3 -> "hel" + "lo wor" + "d" == "hello word"
+        assert e.get_text() == "hello word"
+        assert len(e.segments) == 3
+
+    def test_remove_range(self):
+        e = MergeTreeEngine()
+        e.insert(0, "hello world", 0, 1, 1)
+        e.remove_range(5, 11, 1, 1, 2)
+        assert e.get_text() == "hello"
+
+    def test_remove_middle(self):
+        e = MergeTreeEngine()
+        e.insert(0, "hello cruel world", 0, 1, 1)
+        e.remove_range(5, 11, 1, 1, 2)
+        assert e.get_text() == "hello world"
+
+    def test_insert_at_end(self):
+        e = MergeTreeEngine()
+        e.insert(0, "ab", 0, 1, 1)
+        e.insert(2, "cd", 1, 1, 2)
+        assert e.get_text() == "abcd"
+
+    def test_annotate(self):
+        e = MergeTreeEngine()
+        e.insert(0, "abcd", 0, 1, 1)
+        e.annotate_range(1, 3, {"bold": True}, 1, 1, 2)
+        spans = e.annotated_spans()
+        assert spans == [("a", None), ("bc", {"bold": True}), ("d", None)]
+
+    def test_annotate_null_deletes(self):
+        e = MergeTreeEngine()
+        e.insert(0, "ab", 0, 1, 1, props={"k": 1})
+        e.annotate_range(0, 2, {"k": None}, 1, 1, 2)
+        assert e.annotated_spans() == [("ab", None)]  # empty props normalize to None
+
+
+class TestConcurrency:
+    def test_concurrent_inserts_same_pos_later_seq_first(self):
+        """Two clients insert at pos 0 concurrently (both refSeq 0): the
+        op sequenced LATER lands closer to the position (breakTie:
+        newSeq > segSeq => insert before)."""
+        e = MergeTreeEngine()
+        e.insert(0, "X", 0, 1, 1)  # client 1, seq 1, ref 0
+        e.insert(0, "Y", 0, 2, 2)  # client 2, seq 2, ref 0 — concurrent
+        assert e.get_text() == "YX"
+
+    def test_concurrent_insert_not_in_removed_range(self):
+        """A concurrent insert inside a concurrently-removed range
+        survives the remove."""
+        e = MergeTreeEngine()
+        e.insert(0, "abcdef", 0, 1, 1)
+        # client 2 inserts at 3 having seen seq 1
+        e.insert(3, "XX", 1, 2, 2)
+        # client 3 removes [1,5) also having seen only seq 1 (concurrent
+        # with the insert)
+        e.remove_range(1, 5, 1, 3, 3)
+        assert e.get_text() == "aXXf"
+
+    def test_overlapping_removes(self):
+        e = MergeTreeEngine()
+        e.insert(0, "abcdef", 0, 1, 1)
+        e.remove_range(1, 4, 1, 2, 2)  # client 2 removes bcd
+        e.remove_range(2, 5, 1, 3, 3)  # client 3 concurrently removes cde
+        assert e.get_text() == "af"
+        # the overlap keeps the earliest removedSeq
+        removed = [s for s in e.segments if s.removed_seq is not None]
+        assert all(s.removed_seq in (2, 3) for s in removed)
+
+    def test_insert_at_boundary_of_removed(self):
+        """Insert at a position whose neighbors were concurrently
+        removed: tombstones (acked <= refSeq) are excluded from
+        tie-breaks, invisible-but-live segments participate."""
+        e = MergeTreeEngine()
+        e.insert(0, "ab", 0, 1, 1)
+        e.remove_range(0, 1, 1, 1, 2)  # remove 'a' (acked)
+        # client 2 saw both ops (ref 2) and inserts at 0
+        e.insert(0, "Z", 2, 2, 3)
+        assert e.get_text() == "Zb"
+
+
+class TestCollabClients:
+    def _wire(self, n, initial=""):
+        seqr = DocumentSequencer()
+        clients = [CollabClient(i + 1, initial=initial) for i in range(n)]
+        for c in clients:
+            seqr.join(c.client_id)
+        for c in clients:
+            c.engine.current_seq = seqr.seq
+        return seqr, clients
+
+    def _deliver(self, seqr, clients, msgs_by_client):
+        out = []
+        for cid, msg in msgs_by_client:
+            s = seqr.sequence(cid, msg)
+            assert isinstance(s, SequencedMessage)
+            out.append(s)
+        for m in out:
+            for c in clients:
+                c.apply_msg(m)
+
+    def test_two_client_convergence(self):
+        seqr, (a, b) = self._wire(2, initial="base")
+        m1 = a.insert_local(0, "A")
+        m2 = b.insert_local(4, "B")  # b hasn't seen m1
+        self._deliver(seqr, [a, b], [(1, m1), (2, m2)])
+        assert a.get_text() == b.get_text() == "AbaseB"
+
+    def test_local_pending_then_remote(self):
+        seqr, (a, b) = self._wire(2, initial="xy")
+        ma = a.insert_local(1, "AA")  # a: xAAy pending
+        mb = b.insert_local(1, "B")  # b: xBy pending
+        # sequence b first, then a
+        self._deliver(seqr, [a, b], [(2, mb), (1, ma)])
+        assert a.get_text() == b.get_text()
+        # a's op sequenced later -> lands before b's at the tie position
+        assert a.get_text() == "xAABy"
+
+    def test_remove_vs_insert_race(self):
+        seqr, (a, b) = self._wire(2, initial="hello world")
+        ma = a.remove_local(0, 5)
+        mb = b.insert_local(5, "!!")
+        self._deliver(seqr, [a, b], [(1, ma), (2, mb)])
+        assert a.get_text() == b.get_text() == "!! world"
+
+    def test_overlapping_remove_ack(self):
+        seqr, (a, b) = self._wire(2, initial="abcd")
+        ma = a.remove_local(1, 3)
+        mb = b.remove_local(0, 2)
+        self._deliver(seqr, [a, b], [(2, mb), (1, ma)])
+        assert a.get_text() == b.get_text() == "d"
+
+    def test_annotate_pending_shadows_remote(self):
+        seqr, (a, b) = self._wire(2, initial="ab")
+        ma = a.annotate_local(0, 2, {"c": "red"})
+        mb = b.annotate_local(0, 2, {"c": "blue"})
+        # b's annotate sequenced first; a's pending write shadows it,
+        # and a's (sequenced later) wins everywhere.
+        self._deliver(seqr, [a, b], [(2, mb), (1, ma)])
+        sa = a.engine.annotated_spans()
+        sb = b.engine.annotated_spans()
+        assert sa == sb
+        assert all(p == {"c": "red"} for _, p in sa)
+
+    def test_annotate_remote_after_local_wins(self):
+        seqr, (a, b) = self._wire(2, initial="ab")
+        ma = a.annotate_local(0, 2, {"c": "red"})
+        # a's op sequenced FIRST, then b annotates having seen it
+        self._deliver(seqr, [a, b], [(1, ma)])
+        mb = b.annotate_local(0, 2, {"c": "blue"})
+        self._deliver(seqr, [a, b], [(2, mb)])
+        sa = a.engine.annotated_spans()
+        sb = b.engine.annotated_spans()
+        assert sa == sb
+        assert all(p == {"c": "blue"} for _, p in sa)
+
+    def test_split_pending_insert_ack(self):
+        """A pending local insert split by another local insert must ack
+        both halves."""
+        seqr, (a, b) = self._wire(2)
+        m1 = a.insert_local(0, "abcd")
+        m2 = a.insert_local(2, "XY")  # splits pending 'abcd'
+        self._deliver(seqr, [a, b], [(1, m1), (1, m2)])
+        assert a.get_text() == b.get_text() == "abXYcd"
+        assert all(s.seq != UNASSIGNED_SEQ for s in a.engine.segments)
+        assert not a.engine.pending
+
+    def test_zamboni_drops_tombstones(self):
+        seqr, (a, b) = self._wire(2, initial="abcdef")
+        m = a.remove_local(0, 3)
+        self._deliver(seqr, [a, b], [(1, m)])
+        # push MSN forward with noop-ish traffic
+        m2 = a.insert_local(3, "x")
+        m3 = b.insert_local(0, "y")
+        self._deliver(seqr, [a, b], [(1, m2), (2, m3)])
+        assert a.get_text() == b.get_text()
+        # after MSN passes the remove, tombstones are physically gone
+        if a.engine.min_seq >= 2:
+            assert all(s.removed_seq is None for s in a.engine.segments)
+
+
+class TestSequencer:
+    def test_msn_tracking(self):
+        s = DocumentSequencer()
+        s.join(1)
+        s.join(2)
+        from fluidframework_tpu.protocol.messages import DocumentMessage
+
+        m = s.sequence(1, DocumentMessage(client_seq=1, ref_seq=2))
+        assert m.sequence_number == 3
+        # c2 joined when head seq was 1 => its refSeq is 1; MSN = min(2, 1)
+        assert m.minimum_sequence_number == 1
+
+    def test_nack_stale_refseq(self):
+        from fluidframework_tpu.protocol.messages import DocumentMessage, NackMessage
+
+        s = DocumentSequencer()
+        s.join(1)
+        s.min_seq = 10
+        out = s.sequence(1, DocumentMessage(client_seq=1, ref_seq=3))
+        assert isinstance(out, NackMessage)
+        assert out.code == 400
+
+    def test_checkpoint_roundtrip(self):
+        from fluidframework_tpu.protocol.messages import DocumentMessage
+
+        s = DocumentSequencer("d1")
+        s.join(1)
+        s.sequence(1, DocumentMessage(client_seq=1, ref_seq=1))
+        s2 = DocumentSequencer.restore(s.checkpoint())
+        assert s2.seq == s.seq and s2.min_seq == s.min_seq
+        m = s2.sequence(1, DocumentMessage(client_seq=2, ref_seq=2))
+        assert m.sequence_number == s.seq + 1
