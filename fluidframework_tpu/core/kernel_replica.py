@@ -1,0 +1,453 @@
+"""Host driver for the vectorized merge-tree kernel.
+
+`KernelReplica` is the TPU-backed counterpart of a passive
+`MergeTreeEngine` replica: it consumes the totally ordered
+SequencedMessage stream (the convergence contract — every replica
+replaying the same stream reaches the same state, SURVEY.md §3.3) and
+maintains document state on-device as a `SegmentTable`.
+
+Host responsibilities (deliberately outside the kernel):
+
+- Text arena: inserted content is appended to a host-side arena; the
+  kernel only moves `(buf_start, length)` spans. `get_text()` gathers
+  the final visible spans (reference: merge-tree text is materialized
+  the same lazy way via `getText` walks, mergeTree.ts).
+- Dictionary encoding: property keys → static columns, values → int
+  ids (TPU-idiomatic columnar encoding of the reference's arbitrary
+  PropertySet JSON, packages/dds/merge-tree/src/properties.ts).
+- Chunking: ops are applied in fixed-size batches (one `lax.scan` jit
+  call per chunk) with noop padding; chunk boundaries are
+  semantics-free.
+- Window compaction (the zamboni role, zamboni.ts:19): tombstones
+  whose removal seq is at/below the MSN are physically dropped, and
+  maximal runs of "settled" segments (insert seq ≤ MSN, not removed,
+  identical props) are coalesced into single rows over a freshly
+  rewritten arena. This bounds the live table size by the collab
+  window + annotation structure rather than total edit history —
+  which is exactly what makes the O(capacity)-per-op kernel fast.
+- Capacity: tables are grown (padded) ahead of need so the kernel's
+  ERR_CAPACITY can never fire; each op adds at most 2 rows.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocol.constants import NO_CLIENT, UNIVERSAL_SEQ
+from ..protocol.mergetree_ops import (
+    AnnotateOp,
+    GroupOp,
+    InsertOp,
+    MergeTreeOp,
+    RemoveOp,
+)
+from ..protocol.messages import MessageType, SequencedMessage
+from .mergetree import MergeTreeEngine  # noqa: F401  (oracle counterpart)
+from ..ops.mergetree_kernel import (
+    ERR_BAD_POS,
+    ERR_CAPACITY,
+    ERR_REMOVERS,
+    NO_KEY,
+    NOT_REMOVED,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_NOOP,
+    OP_REMOVE,
+    PROP_ABSENT,
+    PROP_DELETE,
+    OpBatch,
+    SegmentTable,
+    apply_op_batch_jit,
+    make_table,
+)
+
+
+class TextArena:
+    """Append-only host text arena addressed by code-point offset."""
+
+    def __init__(self, initial: str = ""):
+        self._parts: List[str] = [initial] if initial else []
+        self._len = len(initial)
+
+    def append(self, text: str) -> int:
+        off = self._len
+        self._parts.append(text)
+        self._len += len(text)
+        return off
+
+    def __len__(self) -> int:
+        return self._len
+
+    def snapshot(self) -> str:
+        if len(self._parts) != 1:
+            self._parts = ["".join(self._parts)]
+        return self._parts[0] if self._parts else ""
+
+
+class PropInterner:
+    """key → props column id; value → int id (None/delete is a sentinel)."""
+
+    def __init__(self, max_keys: int):
+        self.max_keys = max_keys
+        self.key_ids: Dict[str, int] = {}
+        self.values: List[Any] = []
+        self._value_ids: Dict[str, int] = {}
+
+    def key_id(self, key: str) -> int:
+        kid = self.key_ids.get(key)
+        if kid is None:
+            kid = len(self.key_ids)
+            if kid >= self.max_keys:
+                raise ValueError(
+                    f"more than {self.max_keys} distinct property keys; "
+                    "raise n_prop_keys"
+                )
+            self.key_ids[key] = kid
+        return kid
+
+    def value_id(self, value: Any) -> int:
+        if value is None:
+            return PROP_DELETE
+        token = json.dumps(value, sort_keys=True, default=repr)
+        vid = self._value_ids.get(token)
+        if vid is None:
+            vid = len(self.values)
+            self.values.append(value)
+            self._value_ids[token] = vid
+        return vid
+
+    def decode_row(self, row: np.ndarray) -> Optional[dict]:
+        out = {}
+        for key, kid in self.key_ids.items():
+            vid = int(row[kid])
+            if vid != PROP_ABSENT:
+                out[key] = self.values[vid]
+        return out or None
+
+
+class KernelReplica:
+    """TPU-backed passive replica over the totally ordered op stream."""
+
+    def __init__(
+        self,
+        initial: str = "",
+        chunk_size: int = 512,
+        capacity: int = 4096,
+        n_removers: int = 4,
+        n_prop_keys: int = 8,
+        max_prop_pairs: int = 4,
+        compact_watermark: float = 0.65,
+    ):
+        self.chunk_size = chunk_size
+        self.capacity = capacity
+        self.n_removers = n_removers
+        self.n_prop_keys = n_prop_keys
+        self.max_prop_pairs = max_prop_pairs
+        self.compact_watermark = compact_watermark
+
+        self.arena = TextArena(initial)
+        self.props = PropInterner(n_prop_keys)
+        self.table = make_table(capacity, n_removers, n_prop_keys)
+        if initial:
+            self.table = self.table._replace(
+                n_rows=jnp.int32(1),
+                buf_start=self.table.buf_start.at[0].set(0),
+                length=self.table.length.at[0].set(len(initial)),
+                ins_seq=self.table.ins_seq.at[0].set(UNIVERSAL_SEQ),
+                ins_client=self.table.ins_client.at[0].set(NO_CLIENT),
+            )
+        self.min_seq = 0
+        self.current_seq = 0
+        # MSN as of the last op actually applied on-device. Compaction
+        # must use this (not self.min_seq): encoded-but-unapplied ops
+        # have refSeq ≥ the MSN at their sequencing time ≥ this value,
+        # so tombstones at/below it are SKIP for every pending op too.
+        self._applied_min_seq = 0
+        self._pending_rows_bound = int(self.table.n_rows)  # host row-count bound
+        self._encoded: List[tuple] = []
+
+    # ------------------------------------------------------------ encode
+
+    def _encode_op(self, op: MergeTreeOp, msg: SequencedMessage) -> None:
+        if isinstance(op, GroupOp):
+            for sub in op.ops:
+                self._encode_op(sub, msg)
+            return
+        seq, ref, cid = msg.sequence_number, msg.ref_seq, msg.client_id
+        msn = msg.minimum_sequence_number
+        keys: List[int] = []
+        vals: List[int] = []
+        if isinstance(op, InsertOp):
+            if op.seg is not None and not isinstance(op.seg, str):
+                raise TypeError(
+                    "KernelReplica is a text engine; item sequences use "
+                    "ItemKernelReplica semantics (not yet vectorized)"
+                )
+            text = op.text if op.seg is None else op.seg
+            off = self.arena.append(text)
+            if op.props:
+                for k, v in op.props.items():
+                    keys.append(self.props.key_id(k))
+                    vals.append(self.props.value_id(v))
+            row = (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text), keys, vals, msn)
+        elif isinstance(op, RemoveOp):
+            row = (OP_REMOVE, op.start, op.end, seq, ref, cid, 0, 0, keys, vals, msn)
+        elif isinstance(op, AnnotateOp):
+            for k, v in op.props.items():
+                keys.append(self.props.key_id(k))
+                vals.append(self.props.value_id(v))
+            if len(keys) > self.max_prop_pairs:
+                # Split into several annotate ops at the same perspective
+                # (equivalent: same range, same seq stamps).
+                for i in range(0, len(keys), self.max_prop_pairs):
+                    self._encoded.append(
+                        (OP_ANNOTATE, op.start, op.end, seq, ref, cid, 0, 0,
+                         keys[i : i + self.max_prop_pairs],
+                         vals[i : i + self.max_prop_pairs], msn)
+                    )
+                    self._pending_rows_bound += 2
+                return
+            row = (OP_ANNOTATE, op.start, op.end, seq, ref, cid, 0, 0, keys, vals, msn)
+        else:
+            raise TypeError(f"unknown op {op!r}")
+        self._encoded.append(row)
+        self._pending_rows_bound += 2
+
+    # ------------------------------------------------------------- apply
+
+    def apply_messages(self, msgs: Iterable[SequencedMessage]) -> None:
+        for msg in msgs:
+            if msg.type == MessageType.OP and msg.contents is not None:
+                self._encode_op(msg.contents, msg)
+            self.current_seq = msg.sequence_number
+            self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+            if len(self._encoded) >= self.chunk_size:
+                self._flush_chunks(final=False)
+        self._flush_chunks(final=True)
+
+    def _flush_chunks(self, final: bool) -> None:
+        while len(self._encoded) >= self.chunk_size or (final and self._encoded):
+            chunk = self._encoded[: self.chunk_size]
+            del self._encoded[: self.chunk_size]
+            self._ensure_capacity()
+            batch = self._build_batch(chunk)
+            self.table = apply_op_batch_jit(self.table, batch)
+            self._applied_min_seq = chunk[-1][10]
+        if self._pending_rows_bound > self.capacity * self.compact_watermark:
+            self.compact()
+
+    def _build_batch(self, chunk: list) -> OpBatch:
+        B, PK = self.chunk_size, self.max_prop_pairs
+        op_type = np.full(B, OP_NOOP, np.int32)
+        pos1 = np.zeros(B, np.int32)
+        pos2 = np.zeros(B, np.int32)
+        seq = np.zeros(B, np.int32)
+        ref = np.zeros(B, np.int32)
+        client = np.full(B, NO_CLIENT, np.int32)
+        buf = np.zeros(B, np.int32)
+        ilen = np.zeros(B, np.int32)
+        pkeys = np.full((B, PK), NO_KEY, np.int32)
+        pvals = np.full((B, PK), PROP_ABSENT, np.int32)
+        for i, (t, p1, p2, s, r, c, b, ln, ks, vs, _msn) in enumerate(chunk):
+            op_type[i], pos1[i], pos2[i] = t, p1, p2
+            seq[i], ref[i], client[i], buf[i], ilen[i] = s, r, c, b, ln
+            for j, (k, v) in enumerate(zip(ks, vs)):
+                pkeys[i, j], pvals[i, j] = k, v
+        return OpBatch(
+            op_type=jnp.asarray(op_type),
+            pos1=jnp.asarray(pos1),
+            pos2=jnp.asarray(pos2),
+            seq=jnp.asarray(seq),
+            ref_seq=jnp.asarray(ref),
+            client=jnp.asarray(client),
+            buf_start=jnp.asarray(buf),
+            ins_len=jnp.asarray(ilen),
+            prop_keys=jnp.asarray(pkeys),
+            prop_vals=jnp.asarray(pvals),
+        )
+
+    # --------------------------------------------------------- capacity
+
+    def _ensure_capacity(self) -> None:
+        needed = self._host_rows_upper_bound() + 2 * self.chunk_size + 8
+        if needed <= self.capacity:
+            return
+        new_cap = self.capacity
+        while new_cap < needed:
+            new_cap *= 2
+        self._grow(new_cap)
+
+    def _host_rows_upper_bound(self) -> int:
+        return self._pending_rows_bound
+
+    def _grow(self, new_cap: int) -> None:
+        pad = new_cap - self.capacity
+        t = self.table
+
+        def pad1(a, fill):
+            return jnp.concatenate([a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
+
+        self.table = SegmentTable(
+            n_rows=t.n_rows,
+            buf_start=pad1(t.buf_start, 0),
+            length=pad1(t.length, 0),
+            ins_seq=pad1(t.ins_seq, 0),
+            ins_client=pad1(t.ins_client, NO_CLIENT),
+            rem_seq=pad1(t.rem_seq, NOT_REMOVED),
+            rem_clients=pad1(t.rem_clients, NO_CLIENT),
+            props=pad1(t.props, PROP_ABSENT),
+            error=t.error,
+        )
+        self.capacity = new_cap
+
+    # ------------------------------------------------------- compaction
+
+    def compact(self) -> None:
+        """Zamboni + settled-run coalescing over a rewritten arena.
+
+        Safe because any future op's refSeq ≥ MSN (deli nacks stale
+        refSeqs, deli/lambda.ts:967): a tombstone with removal ≤ MSN is
+        SKIP for every future perspective, and a settled row
+        (ins_seq ≤ MSN, not removed) is fully VISIBLE for every future
+        perspective — so runs of settled rows with identical props are
+        indistinguishable from a single loaded row.
+        """
+        t = jax.tree_util.tree_map(np.asarray, self.table)
+        n = int(t.n_rows)
+        text = self.arena.snapshot()
+
+        new_rows: List[tuple] = []  # (text, ins_seq, ins_client, rem_seq, rem_clients, props)
+        run_parts: List[str] = []
+        run_props: Optional[np.ndarray] = None
+
+        def flush_run():
+            nonlocal run_parts, run_props
+            if run_parts:
+                new_rows.append(
+                    ("".join(run_parts), UNIVERSAL_SEQ, NO_CLIENT, None, None, run_props)
+                )
+                run_parts = []
+                run_props = None
+
+        for i in range(n):
+            rem = int(t.rem_seq[i])
+            removed = rem != NOT_REMOVED
+            if removed and rem <= self._applied_min_seq:
+                continue  # zamboni: tombstone below the window
+            seg_text = text[int(t.buf_start[i]) : int(t.buf_start[i]) + int(t.length[i])]
+            settled = (not removed) and int(t.ins_seq[i]) <= self._applied_min_seq
+            if settled:
+                if run_props is not None and not np.array_equal(run_props, t.props[i]):
+                    flush_run()
+                run_props = t.props[i].copy()
+                run_parts.append(seg_text)
+            else:
+                flush_run()
+                new_rows.append(
+                    (
+                        seg_text,
+                        int(t.ins_seq[i]),
+                        int(t.ins_client[i]),
+                        rem if removed else None,
+                        t.rem_clients[i].copy(),
+                        t.props[i].copy(),
+                    )
+                )
+        flush_run()
+
+        # Rebuild arena + table.
+        m = len(new_rows)
+        cap = self.capacity
+        while cap // 2 >= max(m + 2 * self.chunk_size + 8, 64) and cap > 64:
+            cap //= 2
+        buf_start = np.zeros(cap, np.int32)
+        length = np.zeros(cap, np.int32)
+        ins_seq = np.zeros(cap, np.int32)
+        ins_client = np.full(cap, NO_CLIENT, np.int32)
+        rem_seq = np.full(cap, NOT_REMOVED, np.int32)
+        rem_clients = np.full((cap, self.n_removers), NO_CLIENT, np.int32)
+        props = np.full((cap, self.n_prop_keys), PROP_ABSENT, np.int32)
+        parts: List[str] = []
+        off = 0
+        for i, (seg_text, iseq, iclient, rseq, rclients, prow) in enumerate(new_rows):
+            buf_start[i] = off
+            length[i] = len(seg_text)
+            ins_seq[i] = iseq
+            ins_client[i] = iclient
+            if rseq is not None:
+                rem_seq[i] = rseq
+                rem_clients[i] = rclients
+            if prow is not None:
+                props[i] = prow
+            parts.append(seg_text)
+            off += len(seg_text)
+        self.arena = TextArena("".join(parts))
+        self.capacity = cap
+        # Encoded-but-unapplied ops still hold offsets into the old
+        # arena; re-append their text to the new arena and remap.
+        if self._encoded:
+            remapped = []
+            for row in self._encoded:
+                if row[0] == OP_INSERT and row[7] > 0:
+                    new_off = self.arena.append(text[row[6] : row[6] + row[7]])
+                    row = row[:6] + (new_off,) + row[7:]
+                remapped.append(row)
+            self._encoded = remapped
+        err = int(t.error)
+        self.table = SegmentTable(
+            n_rows=jnp.int32(m),
+            buf_start=jnp.asarray(buf_start),
+            length=jnp.asarray(length),
+            ins_seq=jnp.asarray(ins_seq),
+            ins_client=jnp.asarray(ins_client),
+            rem_seq=jnp.asarray(rem_seq),
+            rem_clients=jnp.asarray(rem_clients),
+            props=jnp.asarray(props),
+            error=jnp.int32(err),
+        )
+        self._pending_rows_bound = m + 2 * len(self._encoded)
+
+    # ------------------------------------------------------------ output
+
+    def check_errors(self) -> None:
+        err = int(self.table.error)
+        problems = []
+        if err & ERR_CAPACITY:
+            problems.append("segment table capacity overflow")
+        if err & ERR_BAD_POS:
+            problems.append("op position beyond visible length")
+        if err & ERR_REMOVERS:
+            problems.append("removing-client slots exhausted")
+        if problems:
+            raise RuntimeError("kernel error: " + "; ".join(problems))
+
+    def _host_table(self):
+        return jax.tree_util.tree_map(np.asarray, self.table)
+
+    def get_text(self) -> str:
+        self._flush_chunks(final=True)
+        t = self._host_table()
+        text = self.arena.snapshot()
+        n = int(t.n_rows)
+        parts = [
+            text[int(t.buf_start[i]) : int(t.buf_start[i]) + int(t.length[i])]
+            for i in range(n)
+            if int(t.rem_seq[i]) == NOT_REMOVED
+        ]
+        return "".join(parts)
+
+    def annotated_spans(self) -> List[Tuple[str, Optional[dict]]]:
+        self._flush_chunks(final=True)
+        t = self._host_table()
+        text = self.arena.snapshot()
+        out = []
+        for i in range(int(t.n_rows)):
+            if int(t.rem_seq[i]) == NOT_REMOVED:
+                seg = text[int(t.buf_start[i]) : int(t.buf_start[i]) + int(t.length[i])]
+                out.append((seg, self.props.decode_row(np.asarray(t.props[i]))))
+        return out

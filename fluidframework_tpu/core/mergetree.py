@@ -237,12 +237,18 @@ class MergeTreeEngine:
         if seq == UNASSIGNED_SEQ:
             self.local_seq += 1
             local_seq = self.local_seq
+        # None-valued insert props are absent (the null-deletes
+        # convention applies uniformly; keeps parity with the kernel's
+        # dictionary encoding where PROP_DELETE never materializes).
+        clean_props = (
+            {k: v for k, v in props.items() if v is not None} if props else None
+        )
         new_seg = Segment(
             content=content,
             seq=seq,
             client_id=client_id,
             local_seq=local_seq,
-            props=dict(props) if props else None,
+            props=clean_props or None,
         )
 
         remaining = pos
@@ -510,6 +516,48 @@ def _set_prop(props: Dict[str, Any], key: str, value: Any) -> None:
         props[key] = value
 
 
+def apply_remote_op(
+    engine: MergeTreeEngine,
+    op: MergeTreeOp,
+    ref_seq: int,
+    client_id: int,
+    seq: int,
+) -> None:
+    """Apply a sequenced remote op at its perspective (the routing of
+    reference Client.applyRemoteOp, client.ts:802)."""
+    if isinstance(op, GroupOp):
+        for sub in op.ops:
+            apply_remote_op(engine, sub, ref_seq, client_id, seq)
+        return
+    if isinstance(op, InsertOp):
+        content = op.text if op.seg is None else op.seg
+        engine.insert(op.pos, content, ref_seq, client_id, seq, props=op.props)
+    elif isinstance(op, RemoveOp):
+        engine.remove_range(op.start, op.end, ref_seq, client_id, seq)
+    elif isinstance(op, AnnotateOp):
+        engine.annotate_range(op.start, op.end, op.props, ref_seq, client_id, seq)
+    else:
+        raise TypeError(f"unknown op {op!r}")
+
+
+def replay_passive(stream, initial: Any = "") -> MergeTreeEngine:
+    """Replay a totally ordered SequencedMessage stream into a fresh
+    passive replica (the server-side summarizer view; also the scalar
+    oracle for the vectorized kernel's replay path)."""
+    engine = MergeTreeEngine()
+    if len(initial) > 0:
+        engine.load(initial)
+    for msg in stream:
+        if msg.type == MessageType.OP and msg.contents is not None:
+            apply_remote_op(
+                engine, msg.contents, msg.ref_seq, msg.client_id,
+                msg.sequence_number,
+            )
+        engine.current_seq = msg.sequence_number
+        engine.update_min_seq(max(engine.min_seq, msg.minimum_sequence_number))
+    return engine
+
+
 class CollabClient:
     """A collaborating replica: local edits + sequenced-stream application.
 
@@ -587,20 +635,9 @@ class CollabClient:
         self.engine.ack(seq)
 
     def _apply_remote(self, op: MergeTreeOp, msg: SequencedMessage) -> None:
-        if isinstance(op, GroupOp):
-            for sub in op.ops:
-                self._apply_remote(sub, msg)
-            return
-        ref_seq, cid, seq = msg.ref_seq, msg.client_id, msg.sequence_number
-        if isinstance(op, InsertOp):
-            content = op.text if op.seg is None else op.seg
-            self.engine.insert(op.pos, content, ref_seq, cid, seq, props=op.props)
-        elif isinstance(op, RemoveOp):
-            self.engine.remove_range(op.start, op.end, ref_seq, cid, seq)
-        elif isinstance(op, AnnotateOp):
-            self.engine.annotate_range(op.start, op.end, op.props, ref_seq, cid, seq)
-        else:
-            raise TypeError(f"unknown op {op!r}")
+        apply_remote_op(
+            self.engine, op, msg.ref_seq, msg.client_id, msg.sequence_number
+        )
 
     # ----------------------------------------------------------- queries
 

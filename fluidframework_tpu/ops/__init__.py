@@ -1,0 +1,10 @@
+"""Vectorized (JAX/XLA) kernels: the TPU execution backend.
+
+Each kernel has a scalar oracle elsewhere in the package and is
+differentially tested against it:
+
+- mergetree_kernel: batched merge-tree op application
+  (oracle: fluidframework_tpu.core.mergetree.MergeTreeEngine)
+- sequencer_kernel: batched document sequencing / MSN
+  (oracle: fluidframework_tpu.server.sequencer.DocumentSequencer)
+"""

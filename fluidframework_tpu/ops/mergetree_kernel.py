@@ -1,0 +1,429 @@
+"""Vectorized merge-tree kernel: batched sequenced-op application.
+
+The TPU-native re-expression of the reference merge-tree hot path
+(packages/dds/merge-tree/src/mergeTree.ts:1397 insertSegments, :1960
+markRangeRemoved, :1895 annotateRange, position resolution via
+partialLengths.ts) as a structure-of-arrays segment table plus
+`lax.scan` over a totally ordered op batch.
+
+Design (SURVEY.md §7):
+
+- The segment list is held *physically in document order* in fixed-
+  capacity int32 arrays (`SegmentTable`); rows `[0, n_rows)` are live.
+  There are no pointers: the reference's B-tree exists only to make
+  per-op position resolution O(log n) on a scalar CPU. On TPU we
+  resolve positions with an O(n) vector prefix-sum per op — the whole
+  table is touched with full lanes, which is the shape XLA/VPU wants.
+- Visibility of a segment at a perspective (refSeq, clientId) is the
+  closed-form predicate of reference mergeTree.ts:916 `nodeLength`,
+  computed as a mask over all rows at once.
+- Inserts/splits shift the suffix of the table by 1-2 rows via a
+  single gather (`rows[src]`), i.e. a vectorized memmove.
+- Characters are never seen by the kernel: segments carry
+  `(buf_start, length)` spans into a host-side text arena, so the
+  kernel is pure int32 table manipulation. Property annotations are
+  dictionary-encoded host-side (key→column, value→int id).
+
+Semantics notes / scope:
+
+- This kernel implements the *sequenced replay* path: every op it sees
+  has an assigned sequence number and ops arrive in ascending seq
+  order (the totally ordered stream every replica converges on —
+  SURVEY.md §3.3). Local pending ops (UNASSIGNED_SEQ) and the
+  ack/rebase paths stay host-side in core/mergetree.py, mirroring the
+  reference's split between the hot remote-apply loop and the rare
+  reconnect machinery (client.ts:917).
+- Insert tie-breaks (mergeTree.ts:1719 breakTie) reduce to
+  `op_seq > row_ins_seq` because all rows are sequenced; equal seqs
+  occur for flattened group ops and break toward "walk past", exactly
+  as the reference's strict `>`.
+
+Differential gate: tests/test_kernel_vs_oracle.py replays seeded farm
+streams through this kernel and the scalar oracle and asserts
+bit-identical text + annotations.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..protocol.constants import INT32_MAX, NO_CLIENT
+
+# Sentinels (int32 table encoding).
+NOT_REMOVED = INT32_MAX  # rem_seq value for live segments
+PROP_ABSENT = -1  # props cell: key not set on this segment
+PROP_DELETE = -2  # op value: delete the key (reference: null prop value)
+NO_KEY = -1  # op key slot unused
+
+# Op type codes (match protocol.mergetree_ops.MergeTreeDeltaType).
+OP_INSERT = 0
+OP_REMOVE = 1
+OP_ANNOTATE = 2
+OP_NOOP = 3
+
+# Error bit flags accumulated in SegmentTable.error.
+ERR_CAPACITY = 1  # segment table overflow
+ERR_BAD_POS = 2  # op position beyond visible length
+ERR_REMOVERS = 4  # more concurrent removers than KR slots
+
+
+class SegmentTable(NamedTuple):
+    """SoA segment table for one document replica (rows in doc order)."""
+
+    n_rows: jnp.ndarray  # int32 scalar
+    buf_start: jnp.ndarray  # int32[S] offset into the host text arena
+    length: jnp.ndarray  # int32[S]
+    ins_seq: jnp.ndarray  # int32[S] (UNIVERSAL_SEQ=0 for loaded content)
+    ins_client: jnp.ndarray  # int32[S]
+    rem_seq: jnp.ndarray  # int32[S] (NOT_REMOVED if live)
+    rem_clients: jnp.ndarray  # int32[S, KR] (NO_CLIENT padding)
+    props: jnp.ndarray  # int32[S, KK] (PROP_ABSENT default)
+    error: jnp.ndarray  # int32 scalar, ERR_* bit flags
+
+
+class OpBatch(NamedTuple):
+    """A chunk of sequenced ops in ascending sequence-number order."""
+
+    op_type: jnp.ndarray  # int32[B]
+    pos1: jnp.ndarray  # int32[B] insert pos / range start
+    pos2: jnp.ndarray  # int32[B] range end (exclusive)
+    seq: jnp.ndarray  # int32[B]
+    ref_seq: jnp.ndarray  # int32[B]
+    client: jnp.ndarray  # int32[B]
+    buf_start: jnp.ndarray  # int32[B] arena offset of inserted text
+    ins_len: jnp.ndarray  # int32[B]
+    prop_keys: jnp.ndarray  # int32[B, PK] (NO_KEY padding)
+    prop_vals: jnp.ndarray  # int32[B, PK]
+
+
+def make_table(capacity: int, n_removers: int, n_prop_keys: int) -> SegmentTable:
+    """An empty table with static shapes (S, KR, KK)."""
+    return SegmentTable(
+        n_rows=jnp.int32(0),
+        buf_start=jnp.zeros(capacity, jnp.int32),
+        length=jnp.zeros(capacity, jnp.int32),
+        ins_seq=jnp.zeros(capacity, jnp.int32),
+        ins_client=jnp.full(capacity, NO_CLIENT, jnp.int32),
+        rem_seq=jnp.full(capacity, NOT_REMOVED, jnp.int32),
+        rem_clients=jnp.full((capacity, n_removers), NO_CLIENT, jnp.int32),
+        props=jnp.full((capacity, n_prop_keys), PROP_ABSENT, jnp.int32),
+        error=jnp.int32(0),
+    )
+
+
+# --------------------------------------------------------------------------
+# Visibility (reference mergeTree.ts:916 nodeLength, remote perspective)
+# --------------------------------------------------------------------------
+
+
+def _visibility(table: SegmentTable, ref_seq, client):
+    """Per-row (skip, vis_len) at perspective (ref_seq, client).
+
+    skip: excluded from walks and tie-breaks entirely (tombstone at the
+    perspective, or insert+remove both unseen → the segment will never
+    exist for this client).
+    vis_len: visible length (0 for zero-visibility rows that still
+    participate in insert tie-breaks).
+    """
+    capacity = table.length.shape[0]
+    live = jnp.arange(capacity, dtype=jnp.int32) < table.n_rows
+    removed = table.rem_seq != NOT_REMOVED
+    tomb = removed & (table.rem_seq <= ref_seq)
+    ins_vis = (table.ins_client == client) | (table.ins_seq <= ref_seq)
+    among_removers = jnp.any(table.rem_clients == client, axis=1)
+    skip = (~live) | tomb | (removed & ~ins_vis)
+    visible = (~skip) & ins_vis & ~(removed & among_removers)
+    vis_len = jnp.where(visible, table.length, 0)
+    return skip, vis_len
+
+
+def _prefix(vis_len):
+    """Exclusive prefix sum of visible lengths (the role of the
+    reference's PartialSequenceLengths cache, partialLengths.ts:256 —
+    recomputed as a scan instead of maintained incrementally)."""
+    return jnp.cumsum(vis_len) - vis_len
+
+
+# --------------------------------------------------------------------------
+# Table edits (gather-based row shifts)
+# --------------------------------------------------------------------------
+
+
+def _shift_rows(table: SegmentTable, at: jnp.ndarray, shift: jnp.ndarray) -> SegmentTable:
+    """Open `shift` empty rows at index `at` by gathering the suffix
+    rightward (vectorized memmove). Rows [at, at+shift) keep stale
+    values — the caller overwrites them."""
+    capacity = table.length.shape[0]
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    src = jnp.where(j < at, j, jnp.maximum(j - shift, 0))
+
+    def g(a):
+        return a[src]
+
+    return table._replace(
+        buf_start=g(table.buf_start),
+        length=g(table.length),
+        ins_seq=g(table.ins_seq),
+        ins_client=g(table.ins_client),
+        rem_seq=g(table.rem_seq),
+        rem_clients=g(table.rem_clients),
+        props=g(table.props),
+        n_rows=table.n_rows + shift,
+        error=table.error
+        | jnp.where(table.n_rows + shift > capacity, ERR_CAPACITY, 0).astype(jnp.int32),
+    )
+
+
+def _write_row(table: SegmentTable, at, buf_start, length, ins_seq, ins_client,
+               rem_seq, rem_clients_row, props_row) -> SegmentTable:
+    """Overwrite row `at` with the given field values."""
+    capacity = table.length.shape[0]
+    here = jnp.arange(capacity, dtype=jnp.int32) == at
+
+    def w(a, v):
+        if a.ndim == 1:
+            return jnp.where(here, v, a)
+        return jnp.where(here[:, None], v[None, :], a)
+
+    return table._replace(
+        buf_start=w(table.buf_start, buf_start),
+        length=w(table.length, length),
+        ins_seq=w(table.ins_seq, ins_seq),
+        ins_client=w(table.ins_client, ins_client),
+        rem_seq=w(table.rem_seq, rem_seq),
+        rem_clients=w(table.rem_clients, rem_clients_row),
+        props=w(table.props, props_row),
+    )
+
+
+def _op_props_row(op: OpBatch, n_prop_keys: int):
+    """Dictionary-encoded props carried by an op, as a props row
+    (PROP_DELETE values become 'absent' for newly inserted segments)."""
+    row = jnp.full(n_prop_keys, PROP_ABSENT, jnp.int32)
+    vals = jnp.where(op.prop_vals == PROP_DELETE, PROP_ABSENT, op.prop_vals)
+    keys = jnp.where(op.prop_keys == NO_KEY, n_prop_keys, op.prop_keys)  # drop
+    return row.at[keys].set(vals, mode="drop")
+
+
+def _ensure_boundary(table: SegmentTable, pos, ref_seq, client) -> SegmentTable:
+    """Split the visible row spanning `pos` so `pos` falls on a row
+    boundary (reference ensureIntervalBoundary, mergeTree.ts:1706)."""
+    skip, vis_len = _visibility(table, ref_seq, client)
+    prefix = _prefix(vis_len)
+    inside = (~skip) & (prefix < pos) & (prefix + vis_len > pos)
+    found = jnp.any(inside)
+    idx = jnp.argmax(inside).astype(jnp.int32)
+    off = pos - prefix[idx]
+
+    def do_split(t: SegmentTable) -> SegmentTable:
+        t2 = _shift_rows(t, idx + 1, jnp.int32(1))
+        # Tail inherits all merge metadata (reference BaseSegment.splitAt).
+        t2 = _write_row(
+            t2,
+            idx + 1,
+            t.buf_start[idx] + off,
+            t.length[idx] - off,
+            t.ins_seq[idx],
+            t.ins_client[idx],
+            t.rem_seq[idx],
+            t.rem_clients[idx],
+            t.props[idx],
+        )
+        return t2._replace(length=t2.length.at[idx].set(off))
+
+    return lax.cond(found, do_split, lambda t: t, table)
+
+
+# --------------------------------------------------------------------------
+# Op application
+# --------------------------------------------------------------------------
+
+
+def _apply_insert(table: SegmentTable, op: OpBatch) -> SegmentTable:
+    """Insert at visible position pos1 of the op's perspective
+    (reference insertingWalk + breakTie, mergeTree.ts:1740,:1719)."""
+    n_prop_keys = table.props.shape[1]
+    skip, vis_len = _visibility(table, op.ref_seq, op.client)
+    prefix = _prefix(vis_len)
+    pos = op.pos1
+    # Landing row: first non-skip row that either spans pos (split) or
+    # starts exactly at pos. Zero-visibility rows at the boundary take
+    # the new segment *before* them iff the op's seq wins the tie-break
+    # (strictly greater than the row's insert seq).
+    spans = (~skip) & (prefix < pos) & (prefix + vis_len > pos)
+    at_boundary = (~skip) & (prefix >= pos) & (
+        (vis_len > 0) | (op.seq > table.ins_seq)
+    )
+    cond = spans | at_boundary
+    found = jnp.any(cond)
+    idx = jnp.argmax(cond).astype(jnp.int32)
+    total = jnp.sum(vis_len)
+    bad = (~found) & (pos > total)
+
+    do_split = found & (prefix[idx] < pos)
+    insert_at = jnp.where(found, jnp.where(do_split, idx + 1, idx), table.n_rows)
+    shift = jnp.where(do_split, 2, 1).astype(jnp.int32)
+    off = pos - prefix[idx]
+
+    # Snapshot split-source fields before shifting.
+    head_bs = table.buf_start[idx]
+    head_len = table.length[idx]
+    head_ins_seq = table.ins_seq[idx]
+    head_ins_client = table.ins_client[idx]
+    head_rem_seq = table.rem_seq[idx]
+    head_rem_clients = table.rem_clients[idx]
+    head_props = table.props[idx]
+
+    t = _shift_rows(table, insert_at, shift)
+    # New segment row.
+    t = _write_row(
+        t,
+        insert_at,
+        op.buf_start,
+        op.ins_len,
+        op.seq,
+        op.client,
+        jnp.int32(NOT_REMOVED),
+        jnp.full(t.rem_clients.shape[1], NO_CLIENT, jnp.int32),
+        _op_props_row(op, n_prop_keys),
+    )
+
+    def with_split(t2: SegmentTable) -> SegmentTable:
+        # Layout after a split: head(idx, truncated) NEW(idx+1) tail(idx+2).
+        t3 = t2._replace(length=t2.length.at[idx].set(off))
+        return _write_row(
+            t3,
+            idx + 2,
+            head_bs + off,
+            head_len - off,
+            head_ins_seq,
+            head_ins_client,
+            head_rem_seq,
+            head_rem_clients,
+            head_props,
+        )
+
+    t = lax.cond(do_split, with_split, lambda x: x, t)
+    return t._replace(error=t.error | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32))
+
+
+def _range_mask(table: SegmentTable, start, end, ref_seq, client):
+    """Rows fully covering [start, end) visible content after boundary
+    splits (the reference's nodeMap range walk over len>0 rows)."""
+    skip, vis_len = _visibility(table, ref_seq, client)
+    prefix = _prefix(vis_len)
+    covered = (
+        (~skip) & (vis_len > 0) & (prefix >= start) & (prefix + vis_len <= end)
+    )
+    bad = end > jnp.sum(vis_len)
+    return covered, bad
+
+
+def _apply_remove(table: SegmentTable, op: OpBatch) -> SegmentTable:
+    """Mark [pos1, pos2) removed (reference markRangeRemoved,
+    mergeTree.ts:1960): overlapping removes keep the earliest sequenced
+    removedSeq and accumulate the removing client ids."""
+    t = _ensure_boundary(table, op.pos1, op.ref_seq, op.client)
+    t = _ensure_boundary(t, op.pos2, op.ref_seq, op.client)
+    covered, bad = _range_mask(t, op.pos1, op.pos2, op.ref_seq, op.client)
+
+    already = t.rem_seq != NOT_REMOVED
+    new_rem_seq = jnp.where(covered & ~already, op.seq, t.rem_seq)
+
+    # Removing-client slot: first write goes to slot 0; an overlapping
+    # remove appends at the first free slot.
+    n_removers = t.rem_clients.shape[1]
+    free = t.rem_clients == NO_CLIENT
+    first_free = jnp.argmax(free, axis=1).astype(jnp.int32)
+    no_free = ~jnp.any(free, axis=1)
+    slot = jnp.where(already, first_free, 0)
+    write = covered & ~(already & no_free)
+    slot_onehot = (
+        jnp.arange(n_removers, dtype=jnp.int32)[None, :] == slot[:, None]
+    )
+    new_rem_clients = jnp.where(
+        write[:, None] & slot_onehot, op.client, t.rem_clients
+    )
+    overflow = jnp.any(covered & already & no_free)
+
+    return t._replace(
+        rem_seq=new_rem_seq,
+        rem_clients=new_rem_clients,
+        error=t.error
+        | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32)
+        | jnp.where(overflow, ERR_REMOVERS, 0).astype(jnp.int32),
+    )
+
+
+def _apply_annotate(table: SegmentTable, op: OpBatch) -> SegmentTable:
+    """Set dictionary-encoded properties on [pos1, pos2) (reference
+    annotateRange mergeTree.ts:1895 + segmentPropertiesManager
+    addProperties; sequenced-path semantics: last writer wins, null
+    deletes)."""
+    t = _ensure_boundary(table, op.pos1, op.ref_seq, op.client)
+    t = _ensure_boundary(t, op.pos2, op.ref_seq, op.client)
+    covered, bad = _range_mask(t, op.pos1, op.pos2, op.ref_seq, op.client)
+
+    n_prop_keys = t.props.shape[1]
+    props = t.props
+    n_pairs = op.prop_keys.shape[0]
+    for p in range(n_pairs):  # PK is a small static width
+        key = op.prop_keys[p]
+        val = op.prop_vals[p]
+        valid = key != NO_KEY
+        col = jnp.arange(n_prop_keys, dtype=jnp.int32) == key
+        newv = jnp.where(val == PROP_DELETE, PROP_ABSENT, val)
+        props = jnp.where(valid & covered[:, None] & col[None, :], newv, props)
+
+    return t._replace(
+        props=props,
+        error=t.error | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32),
+    )
+
+
+def _apply_one(table: SegmentTable, op: OpBatch) -> SegmentTable:
+    return lax.switch(
+        jnp.clip(op.op_type, 0, 3),
+        [
+            _apply_insert,
+            _apply_remove,
+            _apply_annotate,
+            lambda t, _o: t,  # noop / non-op message
+        ],
+        table,
+        op,
+    )
+
+
+def apply_op_batch(table: SegmentTable, ops: OpBatch) -> SegmentTable:
+    """Apply a chunk of sequenced ops in order (lax.scan over the batch).
+
+    This is the jit unit: the whole chunk runs as one XLA computation;
+    per-op work is a handful of O(capacity) vector passes."""
+
+    def step(t, op):
+        return _apply_one(t, op), None
+
+    table, _ = lax.scan(step, table, ops)
+    return table
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def apply_op_batch_jit(table: SegmentTable, ops: OpBatch) -> SegmentTable:
+    return apply_op_batch(table, ops)
+
+
+# vmap over a leading document axis: the data-parallel form used by the
+# multi-document benchmarks and the pjit/shard_map multi-chip path
+# (documents are embarrassingly parallel — SURVEY.md §2.6 row 1).
+apply_op_batch_docs = jax.vmap(apply_op_batch)
+
+
+@functools.partial(jax.jit, donate_argnums=0)
+def apply_op_batch_docs_jit(tables: SegmentTable, ops: OpBatch) -> SegmentTable:
+    return apply_op_batch_docs(tables, ops)

@@ -127,13 +127,15 @@ class LocalOrderingService:
         return True
 
     def process_all(self, doc_id: Optional[str] = None) -> int:
-        """Drain queued deliveries; returns number delivered."""
+        """Drain queued deliveries; returns number delivered.
+
+        Re-lists the queue dict each pass so documents created by
+        listeners mid-drain are picked up too."""
         n = 0
-        doc_ids = [doc_id] if doc_id else list(self._doc_queue)
         progress = True
         while progress:
             progress = False
-            for d in doc_ids if doc_id else list(self._doc_queue):
+            for d in [doc_id] if doc_id else list(self._doc_queue):
                 while self.process_one(d):
                     n += 1
                     progress = True

@@ -29,6 +29,7 @@ from ..protocol.messages import (
 NACK_STALE_REFSEQ = 400
 NACK_UNKNOWN_CLIENT = 403
 NACK_OUT_OF_ORDER = 422
+NACK_FUTURE_REFSEQ = 416
 
 
 @dataclass
@@ -95,6 +96,17 @@ class DocumentSequencer:
                 msg.client_seq,
                 NACK_STALE_REFSEQ,
                 f"refSeq {msg.ref_seq} below MSN {self.min_seq}",
+            )
+        if msg.ref_seq > self.seq:
+            # A refSeq ahead of the head would drive the MSN above the
+            # sequence number and permanently nack every honest client
+            # (the MSN invariant: minSeq <= seq, reference deli ticket()
+            # rejects invalid refSeqs the same way).
+            return NackMessage(
+                client_id,
+                msg.client_seq,
+                NACK_FUTURE_REFSEQ,
+                f"refSeq {msg.ref_seq} ahead of head {self.seq}",
             )
         if msg.client_seq != state.client_seq + 1:
             return NackMessage(

@@ -58,14 +58,23 @@ def random_op_for(
     return client.annotate_local(start, end, {key: value})
 
 
-def run_sharedstring_farm(cfg: FarmConfig) -> str:
-    """Run the farm; assert convergence each round; return final text."""
+@dataclass
+class FarmResult:
+    final_text: str
+    stream: List[SequencedMessage]
+    clients: List[CollabClient]
+
+
+def run_sharedstring_farm(cfg: FarmConfig) -> FarmResult:
+    """Run the farm; assert convergence each round. Returns the final
+    text plus the full sequenced stream (for passive/kernel replays)."""
     rng = random.Random(cfg.seed)
     seqr = DocumentSequencer("farm")
     clients: List[CollabClient] = []
+    stream: List[SequencedMessage] = []
     for i in range(cfg.num_clients):
         cid = i + 1
-        seqr.join(cid)
+        stream.append(seqr.join(cid))
         clients.append(CollabClient(cid, initial=cfg.initial_text))
     # Join messages consumed sequence numbers; align every window.
     for cl in clients:
@@ -92,6 +101,7 @@ def run_sharedstring_farm(cfg: FarmConfig) -> str:
             assert isinstance(out, SequencedMessage), f"unexpected nack {out}"
             sequenced.append(out)
         # Phase 3: drain to all clients in total order.
+        stream.extend(sequenced)
         for m in sequenced:
             for c in clients:
                 c.apply_msg(m)
@@ -102,18 +112,21 @@ def run_sharedstring_farm(cfg: FarmConfig) -> str:
             + "\n".join(f"  client {c.client_id}: {t!r}" for c, t in zip(clients, texts))
         )
         if cfg.check_annotations:
-            spans = [_normalized_spans(c) for c in clients]
+            spans = [char_spans(c.engine.annotated_spans()) for c in clients]
             assert all(s == spans[0] for s in spans), (
                 f"round {rnd}: divergent annotations (seed {cfg.seed})"
             )
-    return clients[0].get_text()
+    return FarmResult(
+        final_text=clients[0].get_text(), stream=stream, clients=clients
+    )
 
 
-def _normalized_spans(client: CollabClient):
-    """Character-wise (char, props) stream — segment boundaries may
-    legitimately differ across replicas; per-character state may not."""
+def char_spans(annotated_spans):
+    """Character-wise (char, props) stream from (content, props) spans —
+    segment boundaries may legitimately differ across replicas;
+    per-character state may not."""
     out = []
-    for content, props in client.engine.annotated_spans():
+    for content, props in annotated_spans:
         norm = tuple(sorted(props.items())) if props else ()
         for ch in content:
             out.append((ch, norm))

@@ -1,0 +1,162 @@
+"""Differential tests: JAX merge-tree kernel vs the scalar oracle.
+
+The convergence contract (SURVEY.md §3.3): every replica replaying the
+same totally ordered op stream reaches identical state. The farm
+produces concurrent multi-client streams through the real sequencer; a
+passive scalar replica (`replay_passive`) and the TPU `KernelReplica`
+both replay them; final text and per-character annotations must match
+exactly (the kernel-vs-reference differential strategy of SURVEY.md §4).
+"""
+
+import random
+import string
+
+import pytest
+
+from fluidframework_tpu.core.kernel_replica import KernelReplica
+from fluidframework_tpu.core.mergetree import CollabClient, replay_passive
+from fluidframework_tpu.server.sequencer import DocumentSequencer
+from fluidframework_tpu.testing.farm import FarmConfig, char_spans, run_sharedstring_farm
+
+
+def replay_and_compare(cfg: FarmConfig, **replica_kw):
+    farm = run_sharedstring_farm(cfg)
+    oracle = replay_passive(farm.stream, cfg.initial_text)
+    assert oracle.get_text() == farm.final_text
+
+    replica = KernelReplica(initial=cfg.initial_text, **replica_kw)
+    replica.apply_messages(farm.stream)
+    replica.check_errors()
+    assert replica.get_text() == farm.final_text
+    assert char_spans(replica.annotated_spans()) == char_spans(
+        oracle.annotated_spans()
+    )
+    return replica
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_kernel_matches_oracle_small(seed):
+    replay_and_compare(
+        FarmConfig(num_clients=3, rounds=8, ops_per_client_per_round=3, seed=seed),
+        chunk_size=16,
+        capacity=256,
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_kernel_matches_oracle_more_clients(seed):
+    replay_and_compare(
+        FarmConfig(
+            num_clients=8, rounds=6, ops_per_client_per_round=4, seed=500 + seed
+        ),
+        chunk_size=64,
+        capacity=512,
+    )
+
+
+def test_kernel_insert_heavy_from_empty():
+    replay_and_compare(
+        FarmConfig(
+            num_clients=4,
+            rounds=10,
+            ops_per_client_per_round=5,
+            seed=11,
+            insert_weight=0.85,
+            remove_weight=0.1,
+            annotate_weight=0.05,
+            initial_text="",
+        ),
+        chunk_size=32,
+        capacity=512,
+    )
+
+
+def test_kernel_remove_heavy():
+    replay_and_compare(
+        FarmConfig(
+            num_clients=4,
+            rounds=10,
+            ops_per_client_per_round=4,
+            seed=12,
+            insert_weight=0.35,
+            remove_weight=0.55,
+            annotate_weight=0.1,
+            initial_text="the quick brown fox jumps over the lazy dog",
+        ),
+        chunk_size=32,
+        capacity=512,
+    )
+
+
+def test_kernel_tiny_chunks_exercise_boundaries():
+    # chunk_size=1: every op is its own jit call; padding/flush logic
+    # must be semantics-free.
+    replay_and_compare(
+        FarmConfig(num_clients=3, rounds=4, ops_per_client_per_round=2, seed=3),
+        chunk_size=1,
+        capacity=256,
+    )
+
+
+def test_kernel_compaction_mid_stream():
+    # Tiny capacity + low watermark forces repeated compactions; the
+    # final state must be unaffected.
+    replica = replay_and_compare(
+        FarmConfig(num_clients=4, rounds=12, ops_per_client_per_round=4, seed=77),
+        chunk_size=16,
+        capacity=128,
+        compact_watermark=0.3,
+    )
+    assert int(replica.table.n_rows) <= replica.capacity
+
+
+def test_kernel_insert_with_none_prop_matches_oracle():
+    # None-valued insert props are absent on both engines (the
+    # null-deletes convention; kernel dictionary encoding can't
+    # materialize PROP_DELETE on a new segment).
+    from fluidframework_tpu.protocol.mergetree_ops import InsertOp
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+
+    stream = [
+        SequencedMessage(
+            sequence_number=1,
+            minimum_sequence_number=0,
+            client_id=1,
+            client_seq=1,
+            ref_seq=0,
+            type=MessageType.OP,
+            contents=InsertOp(pos=0, text="abc", props={"k": None, "b": 1}),
+        )
+    ]
+    oracle = replay_passive(stream)
+    replica = KernelReplica(chunk_size=4, capacity=64)
+    replica.apply_messages(stream)
+    replica.check_errors()
+    assert replica.get_text() == oracle.get_text() == "abc"
+    assert char_spans(replica.annotated_spans()) == char_spans(
+        oracle.annotated_spans()
+    ) == [("a", (("b", 1),)), ("b", (("b", 1),)), ("c", (("b", 1),))]
+
+
+def test_kernel_sequential_inserts_deterministic():
+    # Single writer, pure append/typing pattern.
+    seqr = DocumentSequencer("d")
+    client = CollabClient(1)
+    seqr.join(1)
+    client.engine.current_seq = seqr.seq
+    stream = []
+    rng = random.Random(5)
+    for _ in range(200):
+        text = "".join(rng.choice(string.ascii_lowercase) for _ in range(3))
+        pos = rng.randint(0, len(client.get_text()))
+        msg = client.insert_local(pos, text)
+        out = seqr.sequence(1, msg)
+        client.apply_msg(out)
+        stream.append(out)
+    replica = KernelReplica(chunk_size=64, capacity=2048)
+    replica.apply_messages(stream)
+    replica.check_errors()
+    assert replica.get_text() == client.get_text()

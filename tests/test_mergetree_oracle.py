@@ -35,8 +35,7 @@ class TestBasicEditing:
         e = MergeTreeEngine()
         e.insert(0, "held", 0, 1, 1)
         e.insert(3, "lo wor", 1, 1, 2)
-        assert e.get_text() == "hello word"[:9] + "d"  # "hello word"? no:
-        # "held" with "lo wor" at 3 -> "hel" + "lo wor" + "d" == "hello word"
+        # "held" with "lo wor" at 3 -> "hel" + "lo wor" + "d"
         assert e.get_text() == "hello word"
         assert len(e.segments) == 3
 
@@ -237,6 +236,22 @@ class TestSequencer:
         out = s.sequence(1, DocumentMessage(client_seq=1, ref_seq=3))
         assert isinstance(out, NackMessage)
         assert out.code == 400
+
+    def test_nack_future_refseq(self):
+        # A refSeq ahead of the head would wedge the MSN above seq and
+        # permanently nack every honest client.
+        from fluidframework_tpu.protocol.messages import DocumentMessage, NackMessage
+
+        s = DocumentSequencer()
+        s.join(1)
+        s.join(2)
+        out = s.sequence(1, DocumentMessage(client_seq=1, ref_seq=999))
+        assert isinstance(out, NackMessage)
+        assert out.code == 416
+        # Honest traffic still flows afterwards.
+        ok = s.sequence(1, DocumentMessage(client_seq=1, ref_seq=2))
+        assert not isinstance(ok, NackMessage)
+        assert s.min_seq <= s.seq
 
     def test_checkpoint_roundtrip(self):
         from fluidframework_tpu.protocol.messages import DocumentMessage
