@@ -1,0 +1,109 @@
+"""Headline benchmark: merge-tree sequenced-op replay throughput.
+
+Replays a synthetic mixed SharedString op stream (insert/remove/
+annotate from 1024 round-robin clients — BASELINE.md config 2 shape)
+through the vectorized TPU kernel via the columnar replay engine, and
+through the scalar Python oracle as the baseline, then prints ONE JSON
+line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+`vs_baseline` is kernel throughput / scalar-oracle throughput on the
+same workload. A correctness gate first replays a prefix through both
+paths and asserts identical final text (the project's bit-identity
+contract, BASELINE.json north_star).
+
+Env knobs: BENCH_OPS (default 1_000_000), BENCH_GATE_OPS (default
+20_000), BENCH_ORACLE_OPS (default 20_000), BENCH_CLIENTS (1024).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    n_ops = int(os.environ.get("BENCH_OPS", 1_000_000))
+    n_gate = min(int(os.environ.get("BENCH_GATE_OPS", 20_000)), n_ops)
+    n_oracle = min(int(os.environ.get("BENCH_ORACLE_OPS", 20_000)), n_ops)
+    n_clients = int(os.environ.get("BENCH_CLIENTS", 1024))
+    initial_len = 64
+
+    from fluidframework_tpu.core.columnar_replay import ColumnarReplica
+    from fluidframework_tpu.core.mergetree import replay_passive
+    from fluidframework_tpu.testing.synthetic import generate_stream
+
+    print(f"generating {n_ops} ops from {n_clients} clients...", file=sys.stderr)
+    stream = generate_stream(
+        n_ops, n_clients=n_clients, seed=7, initial_len=initial_len
+    )
+
+    # ---- correctness gate: kernel vs scalar oracle on a prefix --------
+    gate_stream = generate_stream(
+        n_gate, n_clients=n_clients, seed=7, initial_len=initial_len
+    )
+    gate = ColumnarReplica(gate_stream, initial_len=initial_len)
+    gate.replay()
+    gate.check_errors()
+    oracle = replay_passive(
+        gate_stream.as_messages(), initial="".join(map(chr, gate_stream.text[:initial_len]))
+    )
+    if gate.get_text() != oracle.get_text():
+        print("FATAL: kernel/oracle divergence on gate prefix", file=sys.stderr)
+        sys.exit(1)
+    print(f"gate ok ({n_gate} ops bit-identical)", file=sys.stderr)
+
+    # ---- scalar oracle baseline --------------------------------------
+    t0 = time.perf_counter()
+    oracle_msgs = list(gate_stream.as_messages(n_oracle))
+    t_decode = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    replay_passive(
+        oracle_msgs, initial="".join(map(chr, gate_stream.text[:initial_len]))
+    )
+    t_oracle = time.perf_counter() - t0
+    oracle_ops_s = n_oracle / t_oracle
+    print(
+        f"scalar oracle: {oracle_ops_s:,.0f} ops/s "
+        f"({n_oracle} ops in {t_oracle:.2f}s; decode {t_decode:.2f}s)",
+        file=sys.stderr,
+    )
+
+    # ---- kernel replay (warm once, then timed) -----------------------
+    warm = ColumnarReplica(
+        generate_stream(2048, n_clients=n_clients, seed=3, initial_len=initial_len),
+        initial_len=initial_len,
+    )
+    warm.replay()  # compile cache warm-up
+
+    replica = ColumnarReplica(stream, initial_len=initial_len)
+    t0 = time.perf_counter()
+    replica.replay()
+    replica.table.n_rows.block_until_ready()
+    t_kernel = time.perf_counter() - t0
+    replica.check_errors()
+    kernel_ops_s = n_ops / t_kernel
+    print(
+        f"kernel: {kernel_ops_s:,.0f} ops/s ({n_ops} ops in {t_kernel:.2f}s, "
+        f"{replica.compactions} compactions, final len "
+        f"{int(sum(replica.table.length[: int(replica.table.n_rows)]))})",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "mergetree_replay_ops_per_sec_1024clients",
+                "value": round(kernel_ops_s, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(kernel_ops_s / oracle_ops_s, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

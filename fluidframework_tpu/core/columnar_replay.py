@@ -1,0 +1,343 @@
+"""Columnar replay engine: the high-throughput sequenced-replay path.
+
+`ColumnarReplica` plays the same convergence role as
+`core.kernel_replica.KernelReplica` (consume the totally ordered
+stream, maintain a `SegmentTable` on device) but takes its input as
+pre-decoded columnar arrays (`testing.synthetic.ColumnarStream`) so the
+host never touches per-op Python objects — the analog of the reference
+replay tool pre-parsing recorded op files before the timed loop
+(packages/tools/replay-tool/src/replayMessages.ts).
+
+Compaction (the zamboni role, reference
+packages/dds/merge-tree/src/zamboni.ts:19) is fully vectorized numpy:
+
+- tombstones with removal seq ≤ the applied MSN are dropped;
+- maximal runs of *settled* rows (insert seq ≤ MSN, not removed,
+  identical props) are coalesced into single rows — this is what
+  keeps the live table O(collab window), which in turn keeps the
+  kernel's O(capacity)-per-op cost flat over arbitrarily long streams;
+- all surviving text is gathered into a fresh contiguous codepoint
+  arena with one fancy-index gather (no per-row Python).
+
+Two text address spaces share the int32 offset coordinate: compacted
+document text lives at [0, STREAM_BASE) and immutable stream-insert
+text at [STREAM_BASE, ...). Splits only ever do offset arithmetic
+within one region, so the kernel stays oblivious.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.mergetree_kernel import (
+    ERR_BAD_POS,
+    ERR_CAPACITY,
+    ERR_REMOVERS,
+    NO_CLIENT,
+    NO_KEY,
+    NOT_REMOVED,
+    OP_NOOP,
+    PROP_ABSENT,
+    OpBatch,
+    SegmentTable,
+    apply_op_batch_jit,
+    make_table,
+)
+from ..protocol.constants import UNIVERSAL_SEQ
+from ..testing.synthetic import ColumnarStream
+
+STREAM_BASE = 1 << 28  # stream-arena offsets start here
+
+
+@jax.jit
+def _pack_table(t: SegmentTable) -> jnp.ndarray:
+    """Flatten the whole table into one int32 vector so a device→host
+    pull is a single transfer (each transfer pays a full RTT on a
+    tunneled device, so one big beats many small)."""
+    return jnp.concatenate(
+        [
+            t.buf_start, t.length, t.ins_seq, t.ins_client, t.rem_seq,
+            t.rem_clients.ravel(), t.props.ravel(),
+            jnp.stack([t.n_rows, t.error]),
+        ]
+    )
+
+
+def _unpack_table(flat: np.ndarray, capacity: int, kr: int, kk: int):
+    """Host-side view of a packed table (numpy, no copies)."""
+    c = capacity
+    out = {}
+    off = 0
+    for name in ("buf_start", "length", "ins_seq", "ins_client", "rem_seq"):
+        out[name] = flat[off : off + c]
+        off += c
+    out["rem_clients"] = flat[off : off + c * kr].reshape(c, kr)
+    off += c * kr
+    out["props"] = flat[off : off + c * kk].reshape(c, kk)
+    off += c * kk
+    out["n_rows"] = int(flat[off])
+    out["error"] = int(flat[off + 1])
+    return out
+
+
+def _device_table(host: dict, capacity: int) -> SegmentTable:
+    """Push a host table back as ONE transfer + on-device slicing."""
+    flat = np.concatenate(
+        [
+            host["buf_start"], host["length"], host["ins_seq"],
+            host["ins_client"], host["rem_seq"],
+            host["rem_clients"].ravel(), host["props"].ravel(),
+            np.asarray([host["n_rows"], host["error"]], np.int32),
+        ]
+    ).astype(np.int32)
+    kr = host["rem_clients"].shape[1]
+    kk = host["props"].shape[1]
+    return _slice_table(jnp.asarray(flat), capacity, kr, kk)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _slice_table(flat: jnp.ndarray, c: int, kr: int, kk: int) -> SegmentTable:
+    off = 0
+
+    def take(n):
+        nonlocal off
+        part = lax.dynamic_slice_in_dim(flat, off, n)
+        off += n
+        return part
+
+    buf_start = take(c)
+    length = take(c)
+    ins_seq = take(c)
+    ins_client = take(c)
+    rem_seq = take(c)
+    rem_clients = take(c * kr).reshape(c, kr)
+    props = take(c * kk).reshape(c, kk)
+    tail = take(2)
+    return SegmentTable(
+        n_rows=tail[0], buf_start=buf_start, length=length, ins_seq=ins_seq,
+        ins_client=ins_client, rem_seq=rem_seq, rem_clients=rem_clients,
+        props=props, error=tail[1],
+    )
+
+
+class ColumnarReplica:
+    """Device-resident replica driven by columnar op arrays."""
+
+    def __init__(
+        self,
+        stream: ColumnarStream,
+        initial_len: int = 0,
+        chunk_size: int = 1024,
+        capacity: int = 16384,
+        n_removers: int = 4,
+        n_prop_keys: int = 8,
+        compact_watermark: float = 0.7,
+    ):
+        self.stream = stream
+        self.chunk_size = chunk_size
+        self.capacity = capacity
+        self.n_removers = n_removers
+        self.n_prop_keys = n_prop_keys
+        self.compact_watermark = compact_watermark
+
+        # Document arena: compacted text (region [0, STREAM_BASE)).
+        self.doc_text = np.asarray(stream.text[:initial_len], np.int32)
+        self.table = make_table(capacity, n_removers, n_prop_keys)
+        if initial_len:
+            self.table = self.table._replace(
+                n_rows=jnp.int32(1),
+                length=self.table.length.at[0].set(initial_len),
+                ins_seq=self.table.ins_seq.at[0].set(UNIVERSAL_SEQ),
+                ins_client=self.table.ins_client.at[0].set(NO_CLIENT),
+            )
+        self._rows_bound = int(self.table.n_rows)
+        self._applied_min_seq = 0
+        self.compactions = 0
+
+    # -------------------------------------------------------------- replay
+
+    def replay(self) -> None:
+        s = self.stream
+        n = len(s)
+        B = self.chunk_size
+        # Stream insert offsets are rebased into the stream region.
+        buf = s.buf_start + STREAM_BASE
+        for lo in range(0, n, B):
+            hi = min(lo + B, n)
+            self._apply_chunk(s, buf, lo, hi)
+
+    def _apply_chunk(self, s: ColumnarStream, buf: np.ndarray, lo: int, hi: int) -> None:
+        B = self.chunk_size
+        m = hi - lo
+
+        def pad(a: np.ndarray, fill: int = 0) -> jnp.ndarray:
+            if m == B:
+                return jnp.asarray(a[lo:hi])
+            out = np.full(B, fill, np.int32)
+            out[:m] = a[lo:hi]
+            return jnp.asarray(out)
+
+        self._rows_bound += 2 * m
+        if self._rows_bound + 2 > self.capacity:
+            self.compact()  # emergency compact before overflow
+            if self._rows_bound + 2 * m + 2 > self.capacity:
+                self._grow(max(self.capacity * 2, self._rows_bound * 2))
+            self._rows_bound += 2 * m
+
+        pk = pad(s.prop_key, NO_KEY)[:, None]
+        pv = pad(s.prop_val, PROP_ABSENT)[:, None]
+        batch = OpBatch(
+            op_type=pad(s.op_type, OP_NOOP),
+            pos1=pad(s.pos1),
+            pos2=pad(s.pos2),
+            seq=pad(s.seq),
+            ref_seq=pad(s.ref_seq),
+            client=pad(s.client, NO_CLIENT),
+            buf_start=pad(buf),
+            ins_len=pad(s.ins_len),
+            prop_keys=pk,
+            prop_vals=pv,
+        )
+        self.table = apply_op_batch_jit(self.table, batch)
+        self._applied_min_seq = int(s.min_seq[hi - 1])
+        if self._rows_bound > self.capacity * self.compact_watermark:
+            self.compact()
+
+    # ----------------------------------------------------------- capacity
+
+    def _grow(self, new_cap: int) -> None:
+        pad = new_cap - self.capacity
+        t = self.table
+
+        def pad1(a, fill):
+            return jnp.concatenate(
+                [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)]
+            )
+
+        self.table = SegmentTable(
+            n_rows=t.n_rows,
+            buf_start=pad1(t.buf_start, 0),
+            length=pad1(t.length, 0),
+            ins_seq=pad1(t.ins_seq, 0),
+            ins_client=pad1(t.ins_client, NO_CLIENT),
+            rem_seq=pad1(t.rem_seq, NOT_REMOVED),
+            rem_clients=pad1(t.rem_clients, NO_CLIENT),
+            props=pad1(t.props, PROP_ABSENT),
+            error=t.error,
+        )
+        self.capacity = new_cap
+
+    # --------------------------------------------------------- compaction
+
+    def _gather_text(self, buf: np.ndarray, lens: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Concatenate the spans (buf[i], lens[i]) from both arenas into
+        one contiguous array; returns (text, new_offsets)."""
+        total = int(lens.sum())
+        new_off = np.cumsum(lens) - lens
+        if total == 0:
+            return np.empty(0, np.int32), new_off.astype(np.int32)
+        D = len(self.doc_text)
+        src_base = np.where(buf < STREAM_BASE, buf, D + (buf - STREAM_BASE))
+        big = np.concatenate([self.doc_text, self.stream.text])
+        flat_src = np.repeat(src_base, lens) + (
+            np.arange(total) - np.repeat(new_off, lens)
+        )
+        return big[flat_src], new_off.astype(np.int32)
+
+    def compact(self) -> None:
+        flat = np.asarray(_pack_table(self.table))  # ONE device→host pull
+        t = _unpack_table(flat, self.capacity, self.n_removers, self.n_prop_keys)
+        n = t["n_rows"]
+        msn = self._applied_min_seq
+        live = np.arange(len(t["length"])) < n
+        removed = t["rem_seq"] != NOT_REMOVED
+        keep = live & ~(removed & (t["rem_seq"] <= msn))
+        idx = np.nonzero(keep)[0]
+        k = len(idx)
+
+        buf = t["buf_start"][idx]
+        lens = t["length"][idx].astype(np.int64)
+        props = t["props"][idx]
+        settled = (~removed[idx]) & (t["ins_seq"][idx] <= msn)
+
+        # Run grouping: consecutive settled rows with identical props
+        # coalesce; every unsettled row is its own run.
+        if k:
+            prev_settled = np.concatenate([[False], settled[:-1]])
+            same_props = np.concatenate(
+                [[False], (props[1:] == props[:-1]).all(axis=1)]
+            )
+            start_run = ~(settled & prev_settled & same_props)
+            start_run[0] = True
+            run_id = np.cumsum(start_run) - 1
+            m = int(run_id[-1]) + 1
+        else:
+            start_run = np.zeros(0, bool)
+            run_id = np.zeros(0, np.int64)
+            m = 0
+
+        new_text, new_off = self._gather_text(buf, lens)
+        first = np.nonzero(start_run)[0]  # first kept-row index of each run
+        run_len = np.bincount(run_id, weights=lens, minlength=m).astype(np.int32)
+
+        cap = self.capacity
+        nb = np.zeros(cap, np.int32)
+        nl = np.zeros(cap, np.int32)
+        nis = np.zeros(cap, np.int32)
+        nic = np.full(cap, NO_CLIENT, np.int32)
+        nrs = np.full(cap, NOT_REMOVED, np.int32)
+        nrc = np.full((cap, self.n_removers), NO_CLIENT, np.int32)
+        npr = np.full((cap, self.n_prop_keys), PROP_ABSENT, np.int32)
+        if m:
+            nb[:m] = new_off[first]
+            nl[:m] = run_len[:m]
+            nis[:m] = t["ins_seq"][idx][first]
+            nic[:m] = t["ins_client"][idx][first]
+            nrs[:m] = t["rem_seq"][idx][first]
+            nrc[:m] = t["rem_clients"][idx][first]
+            npr[:m] = props[first]
+
+        self.doc_text = new_text
+        # ONE host→device push.
+        self.table = _device_table(
+            {
+                "buf_start": nb, "length": nl, "ins_seq": nis,
+                "ins_client": nic, "rem_seq": nrs, "rem_clients": nrc,
+                "props": npr, "n_rows": m, "error": t["error"],
+            },
+            cap,
+        )
+        self._rows_bound = m
+        self.compactions += 1
+
+    # ------------------------------------------------------------- output
+
+    def check_errors(self) -> None:
+        err = int(self.table.error)
+        problems = []
+        if err & ERR_CAPACITY:
+            problems.append("segment table capacity overflow")
+        if err & ERR_BAD_POS:
+            problems.append("op position beyond visible length")
+        if err & ERR_REMOVERS:
+            problems.append("removing-client slots exhausted")
+        if problems:
+            raise RuntimeError("kernel error: " + "; ".join(problems))
+
+    def get_text(self) -> str:
+        flat = np.asarray(_pack_table(self.table))
+        t = _unpack_table(flat, self.capacity, self.n_removers, self.n_prop_keys)
+        live = (np.arange(len(t["length"])) < t["n_rows"]) & (
+            t["rem_seq"] == NOT_REMOVED
+        )
+        idx = np.nonzero(live)[0]
+        text, _ = self._gather_text(
+            t["buf_start"][idx], t["length"][idx].astype(np.int64)
+        )
+        return "".join(map(chr, text))

@@ -170,6 +170,7 @@ class KernelReplica:
         self._applied_min_seq = 0
         self._pending_rows_bound = int(self.table.n_rows)  # host row-count bound
         self._encoded: List[tuple] = []
+        self._applied_since_compact = False
 
     # ------------------------------------------------------------ encode
 
@@ -194,6 +195,24 @@ class KernelReplica:
                 for k, v in op.props.items():
                     keys.append(self.props.key_id(k))
                     vals.append(self.props.value_id(v))
+            if len(keys) > self.max_prop_pairs:
+                # Insert with the first PK props, then annotate the
+                # inserted range with the rest at the same perspective
+                # (at (ref, cid) after the insert, [pos, pos+len) covers
+                # exactly the new segment).
+                self._encoded.append(
+                    (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text),
+                     keys[: self.max_prop_pairs], vals[: self.max_prop_pairs], msn)
+                )
+                self._pending_rows_bound += 2
+                for i in range(self.max_prop_pairs, len(keys), self.max_prop_pairs):
+                    self._encoded.append(
+                        (OP_ANNOTATE, op.pos, op.pos + len(text), seq, ref, cid,
+                         0, 0, keys[i : i + self.max_prop_pairs],
+                         vals[i : i + self.max_prop_pairs], msn)
+                    )
+                    self._pending_rows_bound += 2
+                return
             row = (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text), keys, vals, msn)
         elif isinstance(op, RemoveOp):
             row = (OP_REMOVE, op.start, op.end, seq, ref, cid, 0, 0, keys, vals, msn)
@@ -238,7 +257,16 @@ class KernelReplica:
             batch = self._build_batch(chunk)
             self.table = apply_op_batch_jit(self.table, batch)
             self._applied_min_seq = chunk[-1][10]
-        if self._pending_rows_bound > self.capacity * self.compact_watermark:
+            self._applied_since_compact = True
+        if (
+            self._applied_since_compact
+            and self._pending_rows_bound > self.capacity * self.compact_watermark
+        ):
+            # Guard on ops actually applied since the last compact:
+            # when many rows stay unsettled (live collab window), a
+            # fresh compact can leave the bound above the watermark,
+            # and re-compacting on every no-op flush (e.g. get_text)
+            # would rebuild an identical table each call.
             self.compact()
 
     def _build_batch(self, chunk: list) -> OpBatch:
@@ -411,6 +439,7 @@ class KernelReplica:
             error=jnp.int32(err),
         )
         self._pending_rows_bound = m + 2 * len(self._encoded)
+        self._applied_since_compact = False
 
     # ------------------------------------------------------------ output
 

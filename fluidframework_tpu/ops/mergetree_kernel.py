@@ -155,15 +155,26 @@ def _prefix(vis_len):
 
 
 def _shift_rows(table: SegmentTable, at: jnp.ndarray, shift: jnp.ndarray) -> SegmentTable:
-    """Open `shift` empty rows at index `at` by gathering the suffix
-    rightward (vectorized memmove). Rows [at, at+shift) keep stale
-    values — the caller overwrites them."""
+    """Open `shift` ∈ {0, 1} empty rows at index `at` by shifting the
+    suffix rightward (vectorized memmove); `at >= capacity` or
+    `shift == 0` is an identity. Row `at` keeps a stale value — the
+    caller overwrites it.
+
+    Implemented as a static roll + elementwise select rather than a
+    dynamic gather: general gathers lower to scalar-core loops on TPU,
+    while roll is a concat of static slices and the select is pure VPU
+    work. There is deliberately NO control flow here (or anywhere in
+    the op-apply path): a masked no-op pass is far cheaper on TPU than
+    per-op `lax.cond` dispatch inside the scan."""
     capacity = table.length.shape[0]
     j = jnp.arange(capacity, dtype=jnp.int32)
-    src = jnp.where(j < at, j, jnp.maximum(j - shift, 0))
+    keep = (j < at) | (shift == 0)
 
     def g(a):
-        return a[src]
+        moved = jnp.roll(a, 1, axis=0)
+        if a.ndim == 1:
+            return jnp.where(keep, a, moved)
+        return jnp.where(keep[:, None], a, moved)
 
     return table._replace(
         buf_start=g(table.buf_start),
@@ -210,193 +221,131 @@ def _op_props_row(op: OpBatch, n_prop_keys: int):
     return row.at[keys].set(vals, mode="drop")
 
 
-def _ensure_boundary(table: SegmentTable, pos, ref_seq, client) -> SegmentTable:
-    """Split the visible row spanning `pos` so `pos` falls on a row
-    boundary (reference ensureIntervalBoundary, mergeTree.ts:1706)."""
+def _split_at(table: SegmentTable, pos, ref_seq, client, enable) -> SegmentTable:
+    """Masked ensure-boundary (reference ensureIntervalBoundary,
+    mergeTree.ts:1706): if `enable` and visible position `pos` falls
+    strictly inside a row, split that row. Straight-line masked code —
+    when no split is needed every write is a no-op pass."""
+    capacity = table.length.shape[0]
     skip, vis_len = _visibility(table, ref_seq, client)
     prefix = _prefix(vis_len)
     inside = (~skip) & (prefix < pos) & (prefix + vis_len > pos)
-    found = jnp.any(inside)
-    idx = jnp.argmax(inside).astype(jnp.int32)
+    found = jnp.any(inside) & enable
+    idx = jnp.argmax(inside).astype(jnp.int32)  # garbage unless found
     off = pos - prefix[idx]
+    at = jnp.where(found, idx + 1, jnp.int32(capacity))
 
-    def do_split(t: SegmentTable) -> SegmentTable:
-        t2 = _shift_rows(t, idx + 1, jnp.int32(1))
-        # Tail inherits all merge metadata (reference BaseSegment.splitAt).
-        t2 = _write_row(
-            t2,
-            idx + 1,
-            t.buf_start[idx] + off,
-            t.length[idx] - off,
-            t.ins_seq[idx],
-            t.ins_client[idx],
-            t.rem_seq[idx],
-            t.rem_clients[idx],
-            t.props[idx],
-        )
-        return t2._replace(length=t2.length.at[idx].set(off))
+    # Snapshot the split row's fields before shifting.
+    head = (table.buf_start[idx], table.length[idx], table.ins_seq[idx],
+            table.ins_client[idx], table.rem_seq[idx], table.rem_clients[idx],
+            table.props[idx])
 
-    return lax.cond(found, do_split, lambda t: t, table)
+    t = _shift_rows(table, at, jnp.where(found, 1, 0).astype(jnp.int32))
+    # Tail inherits all merge metadata (reference BaseSegment.splitAt);
+    # `at >= capacity` makes this a no-op.
+    t = _write_row(t, at, head[0] + off, head[1] - off, head[2], head[3],
+                   head[4], head[5], head[6])
+    # Truncate the head row (drop-mode scatter is a no-op when masked).
+    head_at = jnp.where(found, idx, jnp.int32(capacity))
+    return t._replace(length=t.length.at[head_at].set(off, mode="drop"))
 
 
 # --------------------------------------------------------------------------
-# Op application
+# Op application — one fully unconditional (masked) step
 # --------------------------------------------------------------------------
 
 
-def _apply_insert(table: SegmentTable, op: OpBatch) -> SegmentTable:
-    """Insert at visible position pos1 of the op's perspective
-    (reference insertingWalk + breakTie, mergeTree.ts:1740,:1719)."""
+def _apply_one(table: SegmentTable, op: OpBatch) -> SegmentTable:
+    """Apply one sequenced op of any type as straight-line masked code.
+
+    The reference dispatches per op type (client.ts:802 applyRemoteOp →
+    insert/remove/annotate walks). On TPU, per-op control flow
+    (`lax.cond`/`lax.switch` inside the scan) costs more than the work
+    it saves, so every step runs the same fixed passes with masks:
+
+      1. boundary split at pos1 (insert, remove, annotate)
+      2. boundary split at pos2 (remove, annotate)
+      3. shift+write of the new segment row (insert; reference
+         insertingWalk + breakTie, mergeTree.ts:1740,:1719 — after the
+         pos1 split the landing site is always a row boundary)
+      4. masked field updates over the covered range (remove: rem_seq /
+         rem_clients per markRangeRemoved mergeTree.ts:1960; annotate:
+         dictionary-encoded props per annotateRange mergeTree.ts:1895)
+    """
+    capacity = table.length.shape[0]
     n_prop_keys = table.props.shape[1]
-    skip, vis_len = _visibility(table, op.ref_seq, op.client)
+    is_ins = op.op_type == OP_INSERT
+    is_rem = op.op_type == OP_REMOVE
+    is_ann = op.op_type == OP_ANNOTATE
+    is_range = is_rem | is_ann
+
+    # 1-2. Boundary splits.
+    t = _split_at(table, op.pos1, op.ref_seq, op.client, is_ins | is_range)
+    t = _split_at(t, op.pos2, op.ref_seq, op.client, is_range)
+
+    # 3. Insert landing + shift + write.
+    skip, vis_len = _visibility(t, op.ref_seq, op.client)
     prefix = _prefix(vis_len)
-    pos = op.pos1
-    # Landing row: first non-skip row that either spans pos (split) or
-    # starts exactly at pos. Zero-visibility rows at the boundary take
-    # the new segment *before* them iff the op's seq wins the tie-break
-    # (strictly greater than the row's insert seq).
-    spans = (~skip) & (prefix < pos) & (prefix + vis_len > pos)
-    at_boundary = (~skip) & (prefix >= pos) & (
-        (vis_len > 0) | (op.seq > table.ins_seq)
-    )
-    cond = spans | at_boundary
-    found = jnp.any(cond)
-    idx = jnp.argmax(cond).astype(jnp.int32)
     total = jnp.sum(vis_len)
-    bad = (~found) & (pos > total)
-
-    do_split = found & (prefix[idx] < pos)
-    insert_at = jnp.where(found, jnp.where(do_split, idx + 1, idx), table.n_rows)
-    shift = jnp.where(do_split, 2, 1).astype(jnp.int32)
-    off = pos - prefix[idx]
-
-    # Snapshot split-source fields before shifting.
-    head_bs = table.buf_start[idx]
-    head_len = table.length[idx]
-    head_ins_seq = table.ins_seq[idx]
-    head_ins_client = table.ins_client[idx]
-    head_rem_seq = table.rem_seq[idx]
-    head_rem_clients = table.rem_clients[idx]
-    head_props = table.props[idx]
-
-    t = _shift_rows(table, insert_at, shift)
-    # New segment row.
+    # First non-skip row at/after pos1 that is either visible content or
+    # a zero-visibility row losing the tie-break to this op (strict >,
+    # reference breakTie mergeTree.ts:1719).
+    land = (~skip) & (prefix >= op.pos1) & ((vis_len > 0) | (op.seq > t.ins_seq))
+    land_found = jnp.any(land)
+    insert_at = jnp.where(land_found, jnp.argmax(land).astype(jnp.int32), t.n_rows)
+    at = jnp.where(is_ins, insert_at, jnp.int32(capacity))
+    t = _shift_rows(t, at, jnp.where(is_ins, 1, 0).astype(jnp.int32))
     t = _write_row(
-        t,
-        insert_at,
-        op.buf_start,
-        op.ins_len,
-        op.seq,
-        op.client,
+        t, at, op.buf_start, op.ins_len, op.seq, op.client,
         jnp.int32(NOT_REMOVED),
         jnp.full(t.rem_clients.shape[1], NO_CLIENT, jnp.int32),
         _op_props_row(op, n_prop_keys),
     )
+    bad = is_ins & (~land_found) & (op.pos1 > total)
 
-    def with_split(t2: SegmentTable) -> SegmentTable:
-        # Layout after a split: head(idx, truncated) NEW(idx+1) tail(idx+2).
-        t3 = t2._replace(length=t2.length.at[idx].set(off))
-        return _write_row(
-            t3,
-            idx + 2,
-            head_bs + off,
-            head_len - off,
-            head_ins_seq,
-            head_ins_client,
-            head_rem_seq,
-            head_rem_clients,
-            head_props,
-        )
-
-    t = lax.cond(do_split, with_split, lambda x: x, t)
-    return t._replace(error=t.error | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32))
-
-
-def _range_mask(table: SegmentTable, start, end, ref_seq, client):
-    """Rows fully covering [start, end) visible content after boundary
-    splits (the reference's nodeMap range walk over len>0 rows)."""
-    skip, vis_len = _visibility(table, ref_seq, client)
+    # 4. Covered-range updates (visibility recomputed after the shift).
+    skip, vis_len = _visibility(t, op.ref_seq, op.client)
     prefix = _prefix(vis_len)
     covered = (
-        (~skip) & (vis_len > 0) & (prefix >= start) & (prefix + vis_len <= end)
+        (~skip) & (vis_len > 0) & (prefix >= op.pos1)
+        & (prefix + vis_len <= op.pos2)
     )
-    bad = end > jnp.sum(vis_len)
-    return covered, bad
+    bad = bad | (is_range & (op.pos2 > jnp.sum(vis_len)))
 
-
-def _apply_remove(table: SegmentTable, op: OpBatch) -> SegmentTable:
-    """Mark [pos1, pos2) removed (reference markRangeRemoved,
-    mergeTree.ts:1960): overlapping removes keep the earliest sequenced
-    removedSeq and accumulate the removing client ids."""
-    t = _ensure_boundary(table, op.pos1, op.ref_seq, op.client)
-    t = _ensure_boundary(t, op.pos2, op.ref_seq, op.client)
-    covered, bad = _range_mask(t, op.pos1, op.pos2, op.ref_seq, op.client)
-
+    # Remove: overlapping removes keep the earliest sequenced rem_seq
+    # and append the removing client at the first free slot.
+    upd_rem = covered & is_rem
     already = t.rem_seq != NOT_REMOVED
-    new_rem_seq = jnp.where(covered & ~already, op.seq, t.rem_seq)
-
-    # Removing-client slot: first write goes to slot 0; an overlapping
-    # remove appends at the first free slot.
+    new_rem_seq = jnp.where(upd_rem & ~already, op.seq, t.rem_seq)
     n_removers = t.rem_clients.shape[1]
     free = t.rem_clients == NO_CLIENT
     first_free = jnp.argmax(free, axis=1).astype(jnp.int32)
     no_free = ~jnp.any(free, axis=1)
     slot = jnp.where(already, first_free, 0)
-    write = covered & ~(already & no_free)
-    slot_onehot = (
-        jnp.arange(n_removers, dtype=jnp.int32)[None, :] == slot[:, None]
-    )
-    new_rem_clients = jnp.where(
-        write[:, None] & slot_onehot, op.client, t.rem_clients
-    )
-    overflow = jnp.any(covered & already & no_free)
+    write = upd_rem & ~(already & no_free)
+    slot_onehot = jnp.arange(n_removers, dtype=jnp.int32)[None, :] == slot[:, None]
+    new_rem_clients = jnp.where(write[:, None] & slot_onehot, op.client, t.rem_clients)
+    overflow = jnp.any(upd_rem & already & no_free)
 
-    return t._replace(
-        rem_seq=new_rem_seq,
-        rem_clients=new_rem_clients,
-        error=t.error
-        | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32)
-        | jnp.where(overflow, ERR_REMOVERS, 0).astype(jnp.int32),
-    )
-
-
-def _apply_annotate(table: SegmentTable, op: OpBatch) -> SegmentTable:
-    """Set dictionary-encoded properties on [pos1, pos2) (reference
-    annotateRange mergeTree.ts:1895 + segmentPropertiesManager
-    addProperties; sequenced-path semantics: last writer wins, null
-    deletes)."""
-    t = _ensure_boundary(table, op.pos1, op.ref_seq, op.client)
-    t = _ensure_boundary(t, op.pos2, op.ref_seq, op.client)
-    covered, bad = _range_mask(t, op.pos1, op.pos2, op.ref_seq, op.client)
-
-    n_prop_keys = t.props.shape[1]
+    # Annotate: last writer wins, PROP_DELETE clears (sequenced-path
+    # semantics of segmentPropertiesManager addProperties).
+    upd_ann = covered & is_ann
     props = t.props
-    n_pairs = op.prop_keys.shape[0]
-    for p in range(n_pairs):  # PK is a small static width
+    for p in range(op.prop_keys.shape[0]):  # PK is a small static width
         key = op.prop_keys[p]
         val = op.prop_vals[p]
         valid = key != NO_KEY
         col = jnp.arange(n_prop_keys, dtype=jnp.int32) == key
         newv = jnp.where(val == PROP_DELETE, PROP_ABSENT, val)
-        props = jnp.where(valid & covered[:, None] & col[None, :], newv, props)
+        props = jnp.where(valid & upd_ann[:, None] & col[None, :], newv, props)
 
     return t._replace(
+        rem_seq=new_rem_seq,
+        rem_clients=new_rem_clients,
         props=props,
-        error=t.error | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32),
-    )
-
-
-def _apply_one(table: SegmentTable, op: OpBatch) -> SegmentTable:
-    return lax.switch(
-        jnp.clip(op.op_type, 0, 3),
-        [
-            _apply_insert,
-            _apply_remove,
-            _apply_annotate,
-            lambda t, _o: t,  # noop / non-op message
-        ],
-        table,
-        op,
+        error=t.error
+        | jnp.where(bad, ERR_BAD_POS, 0).astype(jnp.int32)
+        | jnp.where(overflow, ERR_REMOVERS, 0).astype(jnp.int32),
     )
 
 

@@ -1,0 +1,26 @@
+"""Multi-chip parallelism: device meshes and sharded op pipelines.
+
+The reference scales horizontally by partitioning *documents* across
+Kafka partitions and deli instances (SURVEY.md §2.6: document = shard
+unit, server/routerlicious/packages/lambdas-driver/src/document-router).
+The TPU-native equivalent is an SPMD mesh: document state (segment
+tables) and op batches carry a leading `docs` axis sharded across
+devices; cross-document reductions (fleet MSN, error flags) ride ICI
+collectives inserted by XLA.
+"""
+
+from .mesh import (
+    docs_sharding,
+    make_docs_mesh,
+    replicate_sharding,
+    sharded_pipeline_step,
+    shard_tables,
+)
+
+__all__ = [
+    "make_docs_mesh",
+    "docs_sharding",
+    "replicate_sharding",
+    "shard_tables",
+    "sharded_pipeline_step",
+]

@@ -1,0 +1,95 @@
+"""Document-sharded execution over a `jax.sharding.Mesh`.
+
+Documents are embarrassingly parallel (the reference partitions Kafka
+topics by document id and runs one deli sequencer per partition —
+SURVEY.md §2.6 row 1). Here that becomes: every per-document state
+array gets a leading `docs` axis laid out across the mesh, the merge
+kernel runs as one SPMD computation, and the only cross-device traffic
+is tiny reductions (global MSN = min, error flags = bitwise-or) that
+XLA lowers to ICI collectives.
+
+On a CPU host this runs over virtual devices
+(``--xla_force_host_platform_device_count``); the code is identical on
+a real multi-chip TPU slice.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.mergetree_kernel import OpBatch, SegmentTable, apply_op_batch
+
+
+def make_docs_mesh(n_devices: Optional[int] = None, axis: str = "docs") -> Mesh:
+    """A 1-D mesh over the first `n_devices` devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devs)} present"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def docs_sharding(mesh: Mesh, axis: str = "docs") -> NamedSharding:
+    """Shard the leading (document) axis across the mesh."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_tables(tables: SegmentTable, mesh: Mesh, axis: str = "docs") -> SegmentTable:
+    """Place a batched (leading docs axis) SegmentTable onto the mesh."""
+    sh = docs_sharding(mesh, axis)
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tables)
+
+
+def sharded_pipeline_step(mesh: Mesh, axis: str = "docs"):
+    """Compile the full multi-document op-application step for `mesh`.
+
+    The step is the SPMD form of one ordering-service tick (SURVEY.md
+    §3.2-3.3): each document applies its chunk of the totally ordered
+    stream (vmapped merge kernel), then the fleet reduces a global
+    minimum sequence number (the deli MSN min-reduce,
+    server/.../deli/clientSeqManager.ts:22 — here an ICI collective
+    inserted by XLA) and or-combines error flags.
+
+    Returns a jitted ``step(tables, ops, doc_min_seqs) ->
+    (tables, global_min_seq, error)`` with document-sharded in/out
+    shardings.
+    """
+    docs = docs_sharding(mesh, axis)
+    repl = replicate_sharding(mesh)
+
+    def step(tables: SegmentTable, ops: OpBatch, doc_min_seqs: jnp.ndarray):
+        new_tables = jax.vmap(apply_op_batch)(tables, ops)
+        # Cross-document reductions: XLA lowers these to all-reduce
+        # over the docs mesh axis (ICI), the TPU-native form of the
+        # reference's cross-partition MSN bookkeeping.
+        global_min_seq = jnp.min(doc_min_seqs)
+        error = jnp.bitwise_or.reduce(new_tables.error)
+        return new_tables, global_min_seq, error
+
+    table_shardings = SegmentTable(
+        n_rows=docs, buf_start=docs, length=docs, ins_seq=docs,
+        ins_client=docs, rem_seq=docs, rem_clients=docs, props=docs,
+        error=docs,
+    )
+    op_shardings = OpBatch(
+        op_type=docs, pos1=docs, pos2=docs, seq=docs, ref_seq=docs,
+        client=docs, buf_start=docs, ins_len=docs, prop_keys=docs,
+        prop_vals=docs,
+    )
+    return jax.jit(
+        step,
+        in_shardings=(table_shardings, op_shardings, docs),
+        out_shardings=(table_shardings, repl, repl),
+    )

@@ -1,0 +1,171 @@
+"""Synthetic totally-ordered op streams in columnar form.
+
+The replay benchmarks (BASELINE.md configs 1-2: mixed SharedString
+insert/remove/annotate from many clients) need op streams far larger
+than the Python-object message path can cheaply materialize. This
+module generates streams directly in the columnar layout the kernel
+consumes (see `fluidframework_tpu.ops.mergetree_kernel.OpBatch`),
+mirroring how the reference's replay tool pre-parses recorded op files
+before the timed replay (packages/tools/replay-tool/src/replayMessages.ts).
+
+Every generated op is *valid*: positions are within the visible length
+at the op's perspective. Ops use ``ref_seq = seq - 1`` (each client has
+seen the whole prefix when it submits), so the visible length is
+exactly the document length tracked by the generator. Concurrency
+semantics (tie-breaks at lagging refSeqs) are exercised by the farm
+streams in `fluidframework_tpu.testing.farm`, which remain the
+correctness gate; this generator is the throughput workload.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List
+
+import numpy as np
+
+from ..ops.mergetree_kernel import NO_KEY, OP_ANNOTATE, OP_INSERT, OP_REMOVE
+from ..protocol.messages import MessageType, SequencedMessage
+from ..protocol.mergetree_ops import AnnotateOp, InsertOp, RemoveOp
+
+
+@dataclass
+class ColumnarStream:
+    """A sequenced op stream as parallel numpy arrays (one row per op)."""
+
+    op_type: np.ndarray  # int32[N]
+    pos1: np.ndarray  # int32[N]
+    pos2: np.ndarray  # int32[N]
+    seq: np.ndarray  # int32[N]
+    ref_seq: np.ndarray  # int32[N]
+    client: np.ndarray  # int32[N]
+    buf_start: np.ndarray  # int32[N] (offset into `text`)
+    ins_len: np.ndarray  # int32[N]
+    prop_key: np.ndarray  # int32[N] (NO_KEY when no annotation)
+    prop_val: np.ndarray  # int32[N]
+    min_seq: np.ndarray  # int32[N] MSN as of this op
+    text: np.ndarray  # int32[S] codepoint arena for all inserted text
+
+    def __len__(self) -> int:
+        return len(self.op_type)
+
+    # ---------------------------------------------------------- messages
+
+    def as_messages(self, limit: int | None = None) -> Iterator[SequencedMessage]:
+        """Object-form view (for the scalar oracle / object-path replay)."""
+        n = len(self) if limit is None else min(limit, len(self))
+        for i in range(n):
+            t = int(self.op_type[i])
+            if t == OP_INSERT:
+                lo = int(self.buf_start[i])
+                text = "".join(
+                    map(chr, self.text[lo : lo + int(self.ins_len[i])])
+                )
+                op = InsertOp(pos=int(self.pos1[i]), text=text)
+            elif t == OP_REMOVE:
+                op = RemoveOp(start=int(self.pos1[i]), end=int(self.pos2[i]))
+            else:
+                op = AnnotateOp(
+                    start=int(self.pos1[i]),
+                    end=int(self.pos2[i]),
+                    props={f"k{int(self.prop_key[i])}": int(self.prop_val[i])},
+                )
+            yield SequencedMessage(
+                sequence_number=int(self.seq[i]),
+                minimum_sequence_number=int(self.min_seq[i]),
+                client_id=int(self.client[i]),
+                client_seq=0,
+                ref_seq=int(self.ref_seq[i]),
+                type=MessageType.OP,
+                contents=op,
+            )
+
+
+def generate_stream(
+    n_ops: int,
+    n_clients: int = 1024,
+    seed: int = 0,
+    window: int = 1024,
+    insert_weight: float = 0.55,
+    remove_weight: float = 0.25,
+    annotate_weight: float = 0.20,
+    max_insert_len: int = 8,
+    max_range_len: int = 16,
+    n_prop_keys: int = 8,
+    n_prop_vals: int = 16,
+    initial_len: int = 64,
+) -> ColumnarStream:
+    """Generate `n_ops` mixed ops from `n_clients` round-robin clients.
+
+    The MSN trails the head by `window` (the collaboration-window size
+    deli would maintain for caught-up clients), so replay engines can
+    compact tombstones exactly as they would in a live session.
+    """
+    rng = np.random.default_rng(seed)
+    # Pre-draw all randomness (keeps the Python loop light).
+    type_u = rng.random(n_ops)
+    pos_u = rng.random(n_ops)
+    len_draw = rng.integers(1, max_insert_len + 1, n_ops).astype(np.int64)
+    range_draw = rng.integers(1, max_range_len + 1, n_ops).astype(np.int64)
+    keys = rng.integers(0, n_prop_keys, n_ops).astype(np.int32)
+    vals = rng.integers(0, n_prop_vals, n_ops).astype(np.int32)
+    codepoints = rng.integers(ord("a"), ord("z") + 1, int(np.sum(len_draw))).astype(
+        np.int32
+    )
+
+    w_total = insert_weight + remove_weight + annotate_weight
+    t_ins = insert_weight / w_total
+    t_rem = t_ins + remove_weight / w_total
+
+    op_type = np.empty(n_ops, np.int32)
+    pos1 = np.empty(n_ops, np.int32)
+    pos2 = np.zeros(n_ops, np.int32)
+    buf_start = np.zeros(n_ops, np.int32)
+    ins_len = np.zeros(n_ops, np.int32)
+    prop_key = np.full(n_ops, NO_KEY, np.int32)
+    prop_val = np.zeros(n_ops, np.int32)
+
+    length = initial_len  # visible length before op i (ref_seq = seq-1 view)
+    arena_off = initial_len
+    for i in range(n_ops):
+        u = type_u[i]
+        if u < t_ins or length == 0:
+            n = int(len_draw[i])
+            op_type[i] = OP_INSERT
+            pos1[i] = int(pos_u[i] * (length + 1))
+            buf_start[i] = arena_off
+            ins_len[i] = n
+            arena_off += n
+            length += n
+        else:
+            start = int(pos_u[i] * length)
+            end = min(length, start + int(range_draw[i]))
+            if end == start:
+                start -= 1
+            if u < t_rem:
+                op_type[i] = OP_REMOVE
+                length -= end - start
+            else:
+                op_type[i] = OP_ANNOTATE
+                prop_key[i] = keys[i]
+                prop_val[i] = vals[i]
+            pos1[i] = start
+            pos2[i] = end
+
+    seq = np.arange(1, n_ops + 1, dtype=np.int32)
+    initial_text = rng.integers(ord("a"), ord("z") + 1, initial_len).astype(np.int32)
+    text = np.concatenate([initial_text, codepoints[: arena_off - initial_len]])
+    return ColumnarStream(
+        op_type=op_type,
+        pos1=pos1,
+        pos2=pos2,
+        seq=seq,
+        ref_seq=seq - 1,
+        client=(np.arange(n_ops, dtype=np.int32) % n_clients) + 1,
+        buf_start=buf_start,
+        ins_len=ins_len,
+        prop_key=prop_key,
+        prop_val=prop_val,
+        min_seq=np.maximum(0, seq - window).astype(np.int32),
+        text=text,
+    )

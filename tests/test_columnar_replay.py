@@ -1,0 +1,66 @@
+"""Columnar replay engine vs the scalar oracle, including compaction.
+
+Same differential contract as tests/test_kernel_vs_oracle.py (the
+project's bit-identity gate, BASELINE.json north_star), driven through
+the high-throughput columnar path of core/columnar_replay.py.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.core.columnar_replay import ColumnarReplica
+from fluidframework_tpu.core.mergetree import replay_passive
+from fluidframework_tpu.testing.synthetic import generate_stream
+
+INITIAL = 16
+
+
+def _oracle_text(stream):
+    initial = "".join(map(chr, stream.text[:INITIAL]))
+    return replay_passive(stream.as_messages(), initial=initial).get_text()
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_columnar_matches_oracle(seed):
+    stream = generate_stream(
+        1500, n_clients=16, seed=seed, window=64, initial_len=INITIAL
+    )
+    rep = ColumnarReplica(
+        stream, initial_len=INITIAL, chunk_size=128, capacity=1024,
+        compact_watermark=0.5,
+    )
+    rep.replay()
+    rep.check_errors()
+    assert rep.compactions > 0, "test must exercise compaction"
+    assert rep.get_text() == _oracle_text(stream)
+
+
+def test_columnar_emergency_growth():
+    # A tiny capacity forces the emergency compact+grow path.
+    stream = generate_stream(
+        600, n_clients=8, seed=9, window=32, initial_len=INITIAL,
+        insert_weight=0.9, remove_weight=0.05, annotate_weight=0.05,
+    )
+    rep = ColumnarReplica(
+        stream, initial_len=INITIAL, chunk_size=64, capacity=128,
+        compact_watermark=0.9,
+    )
+    rep.replay()
+    rep.check_errors()
+    assert rep.capacity > 128
+    assert rep.get_text() == _oracle_text(stream)
+
+
+def test_columnar_mid_stream_state_is_consistent():
+    # Interleave replay with compaction at every chunk and verify the
+    # final annotated state length matches the oracle's.
+    stream = generate_stream(
+        800, n_clients=4, seed=5, window=16, initial_len=INITIAL
+    )
+    rep = ColumnarReplica(
+        stream, initial_len=INITIAL, chunk_size=32, capacity=512,
+        compact_watermark=0.1,  # compact constantly
+    )
+    rep.replay()
+    rep.check_errors()
+    assert rep.get_text() == _oracle_text(stream)
