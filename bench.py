@@ -65,6 +65,7 @@ def main() -> None:
         oracle_msgs, initial="".join(map(chr, gate_stream.text[:initial_len]))
     )
     t_oracle = time.perf_counter() - t0
+    n_oracle = len(oracle_msgs)  # as_messages caps at the gate stream length
     oracle_ops_s = n_oracle / t_oracle
     print(
         f"scalar oracle: {oracle_ops_s:,.0f} ops/s "

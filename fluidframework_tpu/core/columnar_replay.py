@@ -36,9 +36,6 @@ import numpy as np
 from jax import lax
 
 from ..ops.mergetree_kernel import (
-    ERR_BAD_POS,
-    ERR_CAPACITY,
-    ERR_REMOVERS,
     NO_CLIENT,
     NO_KEY,
     NOT_REMOVED,
@@ -47,7 +44,9 @@ from ..ops.mergetree_kernel import (
     OpBatch,
     SegmentTable,
     apply_op_batch_jit,
+    grow_table,
     make_table,
+    raise_kernel_errors,
 )
 from ..protocol.constants import UNIVERSAL_SEQ
 from ..testing.synthetic import ColumnarStream
@@ -186,8 +185,9 @@ class ColumnarReplica:
         self._rows_bound += 2 * m
         if self._rows_bound + 2 > self.capacity:
             self.compact()  # emergency compact before overflow
-            if self._rows_bound + 2 * m + 2 > self.capacity:
-                self._grow(max(self.capacity * 2, self._rows_bound * 2))
+            need = self._rows_bound + 2 * m + 2
+            if need > self.capacity:
+                self._grow(max(self.capacity * 2, 2 * need))
             self._rows_bound += 2 * m
 
         pk = pad(s.prop_key, NO_KEY)[:, None]
@@ -212,25 +212,7 @@ class ColumnarReplica:
     # ----------------------------------------------------------- capacity
 
     def _grow(self, new_cap: int) -> None:
-        pad = new_cap - self.capacity
-        t = self.table
-
-        def pad1(a, fill):
-            return jnp.concatenate(
-                [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)]
-            )
-
-        self.table = SegmentTable(
-            n_rows=t.n_rows,
-            buf_start=pad1(t.buf_start, 0),
-            length=pad1(t.length, 0),
-            ins_seq=pad1(t.ins_seq, 0),
-            ins_client=pad1(t.ins_client, NO_CLIENT),
-            rem_seq=pad1(t.rem_seq, NOT_REMOVED),
-            rem_clients=pad1(t.rem_clients, NO_CLIENT),
-            props=pad1(t.props, PROP_ABSENT),
-            error=t.error,
-        )
+        self.table = grow_table(self.table, self.capacity, new_cap)
         self.capacity = new_cap
 
     # --------------------------------------------------------- compaction
@@ -242,13 +224,16 @@ class ColumnarReplica:
         new_off = np.cumsum(lens) - lens
         if total == 0:
             return np.empty(0, np.int32), new_off.astype(np.int32)
-        D = len(self.doc_text)
-        src_base = np.where(buf < STREAM_BASE, buf, D + (buf - STREAM_BASE))
-        big = np.concatenate([self.doc_text, self.stream.text])
-        flat_src = np.repeat(src_base, lens) + (
+        flat_src = np.repeat(buf, lens) + (
             np.arange(total) - np.repeat(new_off, lens)
         )
-        return big[flat_src], new_off.astype(np.int32)
+        # Gather per region (the immutable stream arena is large; never
+        # copy it wholesale just to index a few live spans).
+        out = np.empty(total, np.int32)
+        in_stream = flat_src >= STREAM_BASE
+        out[~in_stream] = self.doc_text[flat_src[~in_stream]]
+        out[in_stream] = self.stream.text[flat_src[in_stream] - STREAM_BASE]
+        return out, new_off.astype(np.int32)
 
     def compact(self) -> None:
         flat = np.asarray(_pack_table(self.table))  # ONE device→host pull
@@ -319,16 +304,7 @@ class ColumnarReplica:
     # ------------------------------------------------------------- output
 
     def check_errors(self) -> None:
-        err = int(self.table.error)
-        problems = []
-        if err & ERR_CAPACITY:
-            problems.append("segment table capacity overflow")
-        if err & ERR_BAD_POS:
-            problems.append("op position beyond visible length")
-        if err & ERR_REMOVERS:
-            problems.append("removing-client slots exhausted")
-        if problems:
-            raise RuntimeError("kernel error: " + "; ".join(problems))
+        raise_kernel_errors(int(self.table.error))
 
     def get_text(self) -> str:
         flat = np.asarray(_pack_table(self.table))

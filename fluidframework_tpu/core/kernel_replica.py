@@ -49,9 +49,6 @@ from ..protocol.mergetree_ops import (
 from ..protocol.messages import MessageType, SequencedMessage
 from .mergetree import MergeTreeEngine  # noqa: F401  (oracle counterpart)
 from ..ops.mergetree_kernel import (
-    ERR_BAD_POS,
-    ERR_CAPACITY,
-    ERR_REMOVERS,
     NO_KEY,
     NOT_REMOVED,
     OP_ANNOTATE,
@@ -63,7 +60,9 @@ from ..ops.mergetree_kernel import (
     OpBatch,
     SegmentTable,
     apply_op_batch_jit,
+    grow_table,
     make_table,
+    raise_kernel_errors,
 )
 
 
@@ -314,23 +313,7 @@ class KernelReplica:
         return self._pending_rows_bound
 
     def _grow(self, new_cap: int) -> None:
-        pad = new_cap - self.capacity
-        t = self.table
-
-        def pad1(a, fill):
-            return jnp.concatenate([a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
-
-        self.table = SegmentTable(
-            n_rows=t.n_rows,
-            buf_start=pad1(t.buf_start, 0),
-            length=pad1(t.length, 0),
-            ins_seq=pad1(t.ins_seq, 0),
-            ins_client=pad1(t.ins_client, NO_CLIENT),
-            rem_seq=pad1(t.rem_seq, NOT_REMOVED),
-            rem_clients=pad1(t.rem_clients, NO_CLIENT),
-            props=pad1(t.props, PROP_ABSENT),
-            error=t.error,
-        )
+        self.table = grow_table(self.table, self.capacity, new_cap)
         self.capacity = new_cap
 
     # ------------------------------------------------------- compaction
@@ -444,16 +427,7 @@ class KernelReplica:
     # ------------------------------------------------------------ output
 
     def check_errors(self) -> None:
-        err = int(self.table.error)
-        problems = []
-        if err & ERR_CAPACITY:
-            problems.append("segment table capacity overflow")
-        if err & ERR_BAD_POS:
-            problems.append("op position beyond visible length")
-        if err & ERR_REMOVERS:
-            problems.append("removing-client slots exhausted")
-        if problems:
-            raise RuntimeError("kernel error: " + "; ".join(problems))
+        raise_kernel_errors(int(self.table.error))
 
     def _host_table(self):
         return jax.tree_util.tree_map(np.asarray, self.table)

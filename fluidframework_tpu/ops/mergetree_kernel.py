@@ -101,6 +101,37 @@ class OpBatch(NamedTuple):
     prop_vals: jnp.ndarray  # int32[B, PK]
 
 
+def grow_table(table: SegmentTable, old_cap: int, new_cap: int) -> SegmentTable:
+    """Pad a table to a larger static capacity (realloc outside jit)."""
+    pad = new_cap - old_cap
+
+    def pad1(a, fill):
+        return jnp.concatenate([a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
+
+    return table._replace(
+        buf_start=pad1(table.buf_start, 0),
+        length=pad1(table.length, 0),
+        ins_seq=pad1(table.ins_seq, 0),
+        ins_client=pad1(table.ins_client, NO_CLIENT),
+        rem_seq=pad1(table.rem_seq, NOT_REMOVED),
+        rem_clients=pad1(table.rem_clients, NO_CLIENT),
+        props=pad1(table.props, PROP_ABSENT),
+    )
+
+
+def raise_kernel_errors(error: int) -> None:
+    """Raise if any ERR_* bit is set in an error-flag word."""
+    problems = []
+    if error & ERR_CAPACITY:
+        problems.append("segment table capacity overflow")
+    if error & ERR_BAD_POS:
+        problems.append("op position beyond visible length")
+    if error & ERR_REMOVERS:
+        problems.append("removing-client slots exhausted")
+    if problems:
+        raise RuntimeError("kernel error: " + "; ".join(problems))
+
+
 def make_table(capacity: int, n_removers: int, n_prop_keys: int) -> SegmentTable:
     """An empty table with static shapes (S, KR, KK)."""
     return SegmentTable(

@@ -26,13 +26,26 @@ from ..ops.mergetree_kernel import OpBatch, SegmentTable, apply_op_batch
 
 
 def make_docs_mesh(n_devices: Optional[int] = None, axis: str = "docs") -> Mesh:
-    """A 1-D mesh over the first `n_devices` devices (default: all)."""
+    """A 1-D mesh over the first `n_devices` devices (default: all).
+
+    If the default backend has fewer than `n_devices` (e.g. one real
+    TPU chip while validating an 8-way sharding), falls back to the
+    host CPU backend, which provides
+    ``--xla_force_host_platform_device_count`` virtual devices."""
     devs = jax.devices()
     if n_devices is not None:
         if n_devices > len(devs):
-            raise ValueError(
-                f"requested {n_devices} devices, only {len(devs)} present"
-            )
+            try:
+                cpu = jax.devices("cpu")
+            except RuntimeError:
+                cpu = []
+            if n_devices <= len(cpu):
+                devs = cpu
+            else:
+                raise ValueError(
+                    f"requested {n_devices} devices, only {len(devs)} "
+                    f"{devs[0].platform if devs else ''} and {len(cpu)} cpu present"
+                )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
 
@@ -75,7 +88,11 @@ def sharded_pipeline_step(mesh: Mesh, axis: str = "docs"):
         # over the docs mesh axis (ICI), the TPU-native form of the
         # reference's cross-partition MSN bookkeeping.
         global_min_seq = jnp.min(doc_min_seqs)
-        error = jnp.bitwise_or.reduce(new_tables.error)
+        # Bitwise-or of the per-doc error flags, expressed as a per-bit
+        # max-reduce (some collective backends lack an integer or-reduce).
+        bits = jnp.arange(31, dtype=jnp.int32)
+        per_bit = (new_tables.error[:, None] >> bits[None, :]) & 1
+        error = jnp.sum(jnp.max(per_bit, axis=0) << bits)
         return new_tables, global_min_seq, error
 
     table_shardings = SegmentTable(

@@ -1,0 +1,36 @@
+"""Runtime layer: the container/datastore orchestration around DDSes.
+
+TPU-native analog of the reference client runtime stack (SURVEY.md §1
+L4): `ContainerRuntime` (packages/runtime/container-runtime) routes the
+totally ordered op stream to datastores, batches outbound ops, and
+replays pending state on reconnect; `DataStoreRuntime`
+(packages/runtime/datastore) hosts channels (DDS instances); the
+channel seam (packages/runtime/datastore-definitions/src/channel.ts:243)
+is the plugin boundary DDSes register behind.
+"""
+
+from .channel import (
+    ChannelAttributes,
+    ChannelFactory,
+    ChannelRegistry,
+    ChannelServices,
+    ChannelStorage,
+    DeltaConnection,
+)
+from .shared_object import SharedObject
+from .datastore import DataStoreRuntime
+from .container_runtime import ContainerRuntime, Envelope, FlushMode
+
+__all__ = [
+    "ChannelAttributes",
+    "ChannelFactory",
+    "ChannelRegistry",
+    "ChannelServices",
+    "ChannelStorage",
+    "ContainerRuntime",
+    "DataStoreRuntime",
+    "DeltaConnection",
+    "Envelope",
+    "FlushMode",
+    "SharedObject",
+]

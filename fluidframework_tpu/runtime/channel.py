@@ -1,0 +1,172 @@
+"""The channel seam: the plugin boundary DDSes register behind.
+
+Mirrors the roles of the reference's interface-only package
+`@fluidframework/datastore-definitions`:
+
+- `ChannelFactory` — `IChannelFactory.create/load`
+  (packages/runtime/datastore-definitions/src/channel.ts:243,269,287):
+  how a runtime instantiates a DDS of a given type, fresh or from a
+  summary.
+- `DeltaConnection` — `IDeltaConnection` (channel.ts:166): the channel's
+  window onto the op stream (submit outbound; the runtime drives
+  process/resubmit/rollback/applyStashedOp inbound via the handler the
+  channel attaches, `IDeltaHandler` channel.ts:119).
+- `ChannelStorage` — `IChannelStorageService` (channel.ts:201): read
+  access to the channel's subtree of a summary.
+
+The TPU backend plugs in *here*: a DDS whose hot path runs as JAX
+kernels registers an ordinary `ChannelFactory`; everything above the
+seam is storage/ordering plumbing that never sees device arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Protocol, TYPE_CHECKING
+
+from ..protocol.messages import SequencedMessage
+
+if TYPE_CHECKING:  # pragma: no cover
+    from .datastore import DataStoreRuntime
+    from .shared_object import SharedObject
+
+
+@dataclass(frozen=True)
+class ChannelAttributes:
+    """Identifies the DDS type + format of a stored channel
+    (reference IChannelAttributes, channel.ts:217)."""
+
+    type: str
+    snapshot_format_version: str = "1"
+    package_version: str = "tpu-0.1"
+
+
+class DeltaHandler(Protocol):
+    """What a channel exposes to the runtime for inbound traffic
+    (reference IDeltaHandler, channel.ts:119)."""
+
+    def process(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None: ...
+    def resubmit(self, content: Any, local_metadata: Any) -> None: ...
+    def rollback(self, content: Any, local_metadata: Any) -> None: ...
+    def apply_stashed_op(self, content: Any) -> Any: ...
+
+
+class DeltaConnection:
+    """Channel ↔ datastore-runtime op pipe (reference IDeltaConnection
+    channel.ts:166 / ChannelDeltaConnection,
+    packages/runtime/datastore/src/channelDeltaConnection.ts)."""
+
+    def __init__(
+        self,
+        submit_fn: Callable[[Any, Any], None],
+        dirty_fn: Optional[Callable[[], None]] = None,
+    ):
+        self._submit = submit_fn
+        self._dirty = dirty_fn
+        self.connected = True
+        self.handler: Optional[DeltaHandler] = None
+
+    def attach(self, handler: DeltaHandler) -> None:
+        self.handler = handler
+
+    def submit(self, content: Any, local_metadata: Any = None) -> None:
+        self._submit(content, local_metadata)
+
+    def dirty(self) -> None:
+        if self._dirty is not None:
+            self._dirty()
+
+    # Runtime-side dispatch (ChannelDeltaConnection.process guards that
+    # a handler is attached before ops flow).
+    def process(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        assert self.handler is not None, "channel not attached to delta stream"
+        self.handler.process(msg, local, local_metadata)
+
+    def resubmit(self, content: Any, local_metadata: Any) -> None:
+        assert self.handler is not None
+        self.handler.resubmit(content, local_metadata)
+
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        assert self.handler is not None
+        self.handler.rollback(content, local_metadata)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        assert self.handler is not None
+        return self.handler.apply_stashed_op(content)
+
+
+class ChannelStorage:
+    """Read view of one channel's summary subtree (reference
+    IChannelStorageService channel.ts:201). Blobs are a flat
+    path → bytes/str mapping; `SummaryTree.flatten()` produces it."""
+
+    def __init__(self, blobs: Optional[Dict[str, Any]] = None):
+        self._blobs = dict(blobs or {})
+
+    def contains(self, path: str) -> bool:
+        return path in self._blobs
+
+    def read(self, path: str) -> Any:
+        return self._blobs[path]
+
+    def list(self) -> list:
+        return sorted(self._blobs)
+
+
+@dataclass
+class ChannelServices:
+    """What a channel needs to go live (reference IChannelServices,
+    channel.ts:313): a delta connection and its storage."""
+
+    delta_connection: DeltaConnection
+    storage: ChannelStorage = field(default_factory=ChannelStorage)
+
+
+class ChannelFactory:
+    """Base channel factory (reference IChannelFactory, channel.ts:243).
+
+    Subclasses set `type_name` and `channel_class`; `create` makes a
+    fresh detached channel, `load` rehydrates one from storage then
+    connects it.
+    """
+
+    type_name: str = ""
+    channel_class: type = None  # type: ignore[assignment]
+
+    @property
+    def attributes(self) -> ChannelAttributes:
+        return ChannelAttributes(type=self.type_name)
+
+    def create(self, runtime: "DataStoreRuntime", channel_id: str) -> "SharedObject":
+        ch = self.channel_class(channel_id, runtime, self.attributes)
+        ch.initialize_local()
+        return ch
+
+    def load(
+        self,
+        runtime: "DataStoreRuntime",
+        channel_id: str,
+        services: ChannelServices,
+        attributes: ChannelAttributes,
+    ) -> "SharedObject":
+        ch = self.channel_class(channel_id, runtime, self.attributes)
+        ch.load(services)
+        return ch
+
+
+class ChannelRegistry:
+    """type name → factory (reference ISharedObjectRegistry,
+    packages/runtime/datastore/src/dataStoreRuntime.ts:104 ctor arg)."""
+
+    def __init__(self, factories: Optional[list] = None):
+        self._by_type: Dict[str, ChannelFactory] = {}
+        for f in factories or []:
+            self.register(f)
+
+    def register(self, factory: ChannelFactory) -> None:
+        self._by_type[factory.type_name] = factory
+
+    def get(self, type_name: str) -> ChannelFactory:
+        if type_name not in self._by_type:
+            raise KeyError(f"no channel factory registered for {type_name!r}")
+        return self._by_type[type_name]

@@ -140,8 +140,7 @@ def generate_stream(
         else:
             start = int(pos_u[i] * length)
             end = min(length, start + int(range_draw[i]))
-            if end == start:
-                start -= 1
+            assert end > start  # pos_u < 1.0 and range_draw >= 1
             if u < t_rem:
                 op_type[i] = OP_REMOVE
                 length -= end - start
