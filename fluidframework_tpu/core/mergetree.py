@@ -86,6 +86,9 @@ class Segment:
     # ISegment.segmentGroups; splitAt copies membership so an ack reaches
     # both halves of a split pending segment).
     groups: List[Any] = field(default_factory=list)
+    # local reference positions anchored on this segment (reference
+    # ISegment.localRefs, localReference.ts LocalReferenceCollection).
+    refs: List["LocalReference"] = field(default_factory=list)
 
     def __len__(self) -> int:
         return len(self.content)
@@ -109,7 +112,34 @@ class Segment:
         self.content = self.content[:offset]
         for grp in tail.groups:
             grp.segments.append(tail)
+        # References at/after the split point move to the tail
+        # (localReference.ts LocalReferenceCollection.split).
+        moved = [r for r in self.refs if r.offset >= offset]
+        self.refs = [r for r in self.refs if r.offset < offset]
+        for r in moved:
+            r.segment = tail
+            r.offset -= offset
+        tail.refs = moved
         return tail
+
+
+@dataclass
+class LocalReference:
+    """A position anchored to a segment + offset that tracks edits
+    (reference LocalReferencePosition,
+    packages/dds/merge-tree/src/localReference.ts). `segment is None`
+    means the reference points at the end of the document. When the
+    anchor segment is removed, resolution *slides* the position to the
+    nearest surviving position (SlideOnRemove semantics)."""
+
+    segment: Optional[Segment]
+    offset: int = 0
+
+    def detach(self) -> None:
+        if self.segment is not None and self in self.segment.refs:
+            self.segment.refs.remove(self)
+        self.segment = None
+        self.offset = 0
 
 
 def _eff_seq(seq: int) -> int:
@@ -461,23 +491,82 @@ class MergeTreeEngine:
                             else:
                                 seg.pending_props[key] = cnt - 1
 
+    # --------------------------------------------------- local references
+
+    def anchor_at(
+        self, pos: int, ref_seq: int, client_id: int
+    ) -> LocalReference:
+        """Anchor a reference at visible position `pos` of perspective
+        (ref_seq, client_id) (reference createLocalReferencePosition,
+        client.ts / mergeTree.ts). pos == visible length anchors the
+        document end (segment None)."""
+        remaining = pos
+        for seg in self.segments:
+            cat, length = self._vis(seg, ref_seq, client_id)
+            if cat == VisCategory.SKIP or length == 0:
+                continue
+            if remaining < length:
+                ref = LocalReference(segment=seg, offset=remaining)
+                seg.refs.append(ref)
+                return ref
+            remaining -= length
+        if remaining > 0:
+            raise ValueError(f"anchor pos {pos} beyond visible length")
+        return LocalReference(segment=None)
+
+    def local_position(self, ref: LocalReference) -> int:
+        """Resolve a reference to a visible position at the local
+        perspective, sliding forward off removed segments
+        (SlideOnRemove, localReference.ts)."""
+        if ref.segment is None:
+            return self.visible_length(self.current_seq, self.local_client_id)
+        pos = 0
+        for seg in self.segments:
+            cat, length = self._vis(seg, self.current_seq, self.local_client_id)
+            if seg is ref.segment:
+                if cat == VisCategory.VISIBLE:
+                    return pos + min(ref.offset, length)
+                return pos  # removed anchor: slide to nearest survivor
+            if cat != VisCategory.SKIP:
+                pos += length
+        # Anchor segment no longer tracked (shouldn't happen: zamboni
+        # re-anchors); treat as end.
+        return pos
+
     # ------------------------------------------------------------ windows
 
     def update_min_seq(self, min_seq: int) -> None:
         """Advance the MSN and run zamboni: physically drop tombstones
-        whose removal is at/below the MSN (zamboni.ts:19)."""
+        whose removal is at/below the MSN (zamboni.ts:19). References on
+        collected segments slide to the next surviving segment."""
         assert min_seq >= self.min_seq
         self.min_seq = min_seq
-        if self.zamboni_enabled:
-            self.segments = [
-                s
-                for s in self.segments
-                if not (
-                    s.removed_seq is not None
-                    and s.removed_seq != UNASSIGNED_SEQ
-                    and s.removed_seq <= min_seq
-                )
-            ]
+        if not self.zamboni_enabled:
+            return
+        kept: List[Segment] = []
+        orphaned: List[LocalReference] = []
+        for s in self.segments:
+            dead = (
+                s.removed_seq is not None
+                and s.removed_seq != UNASSIGNED_SEQ
+                and s.removed_seq <= min_seq
+            )
+            if dead:
+                orphaned.extend(s.refs)
+                s.refs = []
+            else:
+                if orphaned:
+                    # Slide orphans to the front of the next survivor.
+                    for r in orphaned:
+                        r.segment = s
+                        r.offset = 0
+                        s.refs.append(r)
+                    orphaned = []
+                kept.append(s)
+        for r in orphaned:  # removed tail: anchor to document end
+            r.segment = None
+            r.offset = 0
+        self.segments = kept
 
     # ------------------------------------------------------------- output
 

@@ -1,0 +1,44 @@
+"""Distributed data structures (the reference's packages/dds/*).
+
+Every DDS subclasses `runtime.SharedObject` and registers a
+`ChannelFactory` behind the channel seam. Conflict policy per family:
+
+- map/directory/cell: last-writer-wins with pending-local shadowing
+  (packages/dds/map/src/mapKernel.ts:130)
+- counter: commutative increments (packages/dds/counter)
+- sequence (SharedString): merge-tree CRDT (packages/dds/merge-tree →
+  core.mergetree + ops.mergetree_kernel)
+- matrix: two permutation merge-trees + sparse cell store
+  (packages/dds/matrix)
+- consensus family: server-ack gated (ordered-collection,
+  register-collection, task-manager, pact-map)
+"""
+
+from .map import MapFactory, SharedMap, DirectoryFactory, SharedDirectory
+from .cell import CellFactory, SharedCell
+from .counter import CounterFactory, SharedCounter
+from .sequence import (
+    IntervalCollection,
+    Marker,
+    SequenceInterval,
+    SharedSegmentSequence,
+    SharedString,
+    StringFactory,
+)
+
+__all__ = [
+    "CellFactory",
+    "CounterFactory",
+    "DirectoryFactory",
+    "IntervalCollection",
+    "MapFactory",
+    "Marker",
+    "SequenceInterval",
+    "SharedCell",
+    "SharedCounter",
+    "SharedDirectory",
+    "SharedMap",
+    "SharedSegmentSequence",
+    "SharedString",
+    "StringFactory",
+]

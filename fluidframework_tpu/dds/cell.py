@@ -1,0 +1,91 @@
+"""SharedCell: a single shared LWW value.
+
+Reference packages/dds/cell/src/cell.ts:58. Same pending-local
+shadowing as the map kernel, over exactly one slot.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+class SharedCell(SharedObject):
+    def initialize_local_core(self) -> None:
+        self._value: Any = None
+        self._empty = True
+        self._pending = 0
+
+    def get(self) -> Any:
+        return self._value
+
+    @property
+    def is_empty(self) -> bool:
+        return self._empty
+
+    def set(self, value: Any) -> None:
+        md = {"prev": self._value, "empty": self._empty}
+        self._value = value
+        self._empty = False
+        self._pending += 1
+        self.submit_local_message({"type": "setCell", "value": value}, md)
+        self.emit("valueChanged", value, True)
+
+    def delete(self) -> None:
+        md = {"prev": self._value, "empty": self._empty}
+        self._value = None
+        self._empty = True
+        self._pending += 1
+        self.submit_local_message({"type": "deleteCell"}, md)
+        self.emit("delete", True)
+
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        self._value = local_metadata["prev"]
+        self._empty = local_metadata["empty"]
+        self._pending -= 1
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        if local:
+            self._pending -= 1
+            return
+        if self._pending > 0:
+            return  # pending local write wins (cell.ts processCore)
+        if op["type"] == "setCell":
+            self._value = op["value"]
+            self._empty = False
+            self.emit("valueChanged", self._value, False)
+        else:
+            self._value = None
+            self._empty = True
+            self.emit("delete", False)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        if content["type"] == "setCell":
+            self.set(content["value"])
+        else:
+            self.delete()
+        return None
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob("header", {"value": self._value, "empty": self._empty})
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        data = json.loads(storage.read("header"))
+        self._value = data["value"]
+        self._empty = data["empty"]
+
+
+class CellFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/cell"
+    channel_class = SharedCell

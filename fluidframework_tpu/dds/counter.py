@@ -1,0 +1,58 @@
+"""SharedCounter: commutative shared increments.
+
+Reference packages/dds/counter/src/counter.ts:84. Increments commute,
+so there is no conflict policy: every replica sums every increment;
+a local increment is applied optimistically and skipped on its
+sequenced echo.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+class SharedCounter(SharedObject):
+    def initialize_local_core(self) -> None:
+        self._value = 0
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def increment(self, amount: int = 1) -> None:
+        if not isinstance(amount, int):
+            raise TypeError("SharedCounter increments must be integers")
+        self._value += amount
+        self.submit_local_message({"type": "increment", "incrementAmount": amount})
+        self.emit("incremented", amount, self._value)
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        if local:
+            return  # already applied optimistically
+        amount = msg.contents["incrementAmount"]
+        self._value += amount
+        self.emit("incremented", amount, self._value)
+
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        self._value -= content["incrementAmount"]
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        self.increment(content["incrementAmount"])
+        return None
+
+    def summarize_core(self):
+        return SummaryTreeBuilder().add_json_blob("header", {"value": self._value}).summary
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self._value = json.loads(storage.read("header"))["value"]
+
+
+class CounterFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/counter"
+    channel_class = SharedCounter

@@ -1,0 +1,434 @@
+"""SharedMap and SharedDirectory: last-writer-wins key-value DDSes.
+
+Mirrors packages/dds/map: `MapKernel` (src/mapKernel.ts:130) owns the
+op apply / pending-ack bookkeeping shared by `SharedMap` (src/map.ts:92)
+and each subdirectory of `SharedDirectory` (src/directory.ts:324).
+
+Conflict policy (mapKernel.ts processMessageForKey/Clear):
+- a remote write to a key with pending local writes is ignored — the
+  local value rides a later sequence number and wins;
+- a remote clear wipes the data but re-applies pending local values;
+- acking a local op just decrements its pending count (the value was
+  applied optimistically at submit time).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Iterator, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+_DELETE = object()  # pending-value sentinel: local delete in flight
+
+
+class MapKernel:
+    """Op apply + pending bookkeeping for one key-space
+    (reference MapKernel, mapKernel.ts:130)."""
+
+    def __init__(self, submit_fn):
+        self._submit = submit_fn
+        self.data: Dict[str, Any] = {}
+        self._pending_keys: Dict[str, int] = {}
+        self._pending_values: Dict[str, Any] = {}
+        self._pending_clears = 0
+
+    # ----------------------------------------------------------- local API
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.data.get(key, default)
+
+    def has(self, key: str) -> bool:
+        return key in self.data
+
+    def set(self, key: str, value: Any) -> None:
+        md = self._undo_record(key)
+        self.data[key] = value
+        self._track_pending(key, value)
+        self._submit({"type": "set", "key": key, "value": value}, md)
+
+    def delete(self, key: str) -> bool:
+        md = self._undo_record(key)
+        existed = key in self.data
+        self.data.pop(key, None)
+        self._track_pending(key, _DELETE)
+        self._submit({"type": "delete", "key": key}, md)
+        return existed
+
+    def clear(self) -> None:
+        # Pending bookkeeping survives a local clear: earlier local ops
+        # are still in flight and their echoes must find their counts
+        # (mapKernel.ts keeps pendingKeys across clear).
+        md = {"data": dict(self.data)}
+        self.data.clear()
+        self._pending_clears += 1
+        self._submit({"type": "clear"}, md)
+
+    def _undo_record(self, key: str) -> dict:
+        return {
+            "exists": key in self.data,
+            "prev": self.data.get(key),
+            "had_pending": key in self._pending_values,
+            "prev_pending": self._pending_values.get(key),
+        }
+
+    def keys(self) -> Iterator[str]:
+        return iter(self.data)
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def _track_pending(self, key: str, value: Any) -> None:
+        self._pending_keys[key] = self._pending_keys.get(key, 0) + 1
+        self._pending_values[key] = value
+
+    # -------------------------------------------------------------- apply
+
+    def process(self, op: dict, local: bool) -> None:
+        kind = op["type"]
+        if local:
+            # Ack: the optimistic apply already happened at submit.
+            if kind == "clear":
+                self._pending_clears -= 1
+            else:
+                key = op["key"]
+                n = self._pending_keys.get(key, 0) - 1
+                if n <= 0:
+                    self._pending_keys.pop(key, None)
+                    self._pending_values.pop(key, None)
+                else:
+                    self._pending_keys[key] = n
+            return
+        if kind == "clear":
+            # Remote clear wipes, then pending local values re-apply
+            # (they ride later sequence numbers — mapKernel
+            # processClearMessage).
+            self.data.clear()
+            for key, val in self._pending_values.items():
+                if val is not _DELETE:
+                    self.data[key] = val
+            return
+        key = op["key"]
+        if self._pending_clears > 0 or self._pending_keys.get(key, 0) > 0:
+            return  # shadowed by pending local state
+        if kind == "set":
+            self.data[key] = op["value"]
+        elif kind == "delete":
+            self.data.pop(key, None)
+
+    def rollback(self, op: dict, md: Any) -> None:
+        """Undo a just-submitted local op (orderSequentially abort,
+        containerRuntime.ts:1996 → mapKernel rollback)."""
+        kind = op["type"]
+        if kind == "clear":
+            self.data = dict(md["data"])
+            self._pending_clears -= 1
+            return
+        key = op["key"]
+        if md["exists"]:
+            self.data[key] = md["prev"]
+        else:
+            self.data.pop(key, None)
+        n = self._pending_keys.get(key, 0) - 1
+        if n <= 0:
+            self._pending_keys.pop(key, None)
+            self._pending_values.pop(key, None)
+        else:
+            self._pending_keys[key] = n
+            if md["had_pending"]:
+                self._pending_values[key] = md["prev_pending"]
+
+    # ---------------------------------------------------------- summaries
+
+    def to_serializable(self) -> Dict[str, Any]:
+        return dict(self.data)
+
+    def load(self, data: Dict[str, Any]) -> None:
+        self.data = dict(data)
+
+
+class SharedMap(SharedObject):
+    """LWW key-value DDS (reference SharedMap, map.ts:92)."""
+
+    def initialize_local_core(self) -> None:
+        self.kernel = MapKernel(self.submit_local_message)
+
+    # Public API mirrors ISharedMap.
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.kernel.get(key, default)
+
+    def set(self, key: str, value: Any) -> "SharedMap":
+        self.kernel.set(key, value)
+        self.emit("valueChanged", key, True)
+        return self
+
+    def has(self, key: str) -> bool:
+        return self.kernel.has(key)
+
+    def delete(self, key: str) -> bool:
+        out = self.kernel.delete(key)
+        self.emit("valueChanged", key, True)
+        return out
+
+    def clear(self) -> None:
+        self.kernel.clear()
+        self.emit("clear", True)
+
+    def keys(self):
+        return self.kernel.keys()
+
+    def items(self):
+        return self.kernel.data.items()
+
+    def __len__(self) -> int:
+        return len(self.kernel)
+
+    # Channel seam obligations.
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        self.kernel.process(msg.contents, local)
+        if not local:
+            key = msg.contents.get("key") if isinstance(msg.contents, dict) else None
+            self.emit("valueChanged", key, False)
+
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        self.kernel.rollback(content, local_metadata)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        op = content
+        if op["type"] == "set":
+            self.kernel.set(op["key"], op["value"])
+        elif op["type"] == "delete":
+            self.kernel.delete(op["key"])
+        elif op["type"] == "clear":
+            self.kernel.clear()
+        return None
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob("header", self.kernel.to_serializable())
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.kernel = MapKernel(self.submit_local_message)
+        self.kernel.load(json.loads(storage.read("header")))
+
+
+class MapFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/map"
+    channel_class = SharedMap
+
+
+# ---------------------------------------------------------------------------
+# SharedDirectory
+# ---------------------------------------------------------------------------
+
+
+class SubDirectory:
+    """One node of the directory tree (reference SubDirectory,
+    directory.ts:1244): a MapKernel for its keys + named children."""
+
+    def __init__(self, shared: "SharedDirectory", path: str):
+        self._shared = shared
+        self.path = path  # absolute, "/" for root
+        self.kernel = MapKernel(
+            lambda op, md=None: shared._submit_storage_op(path, op, md)
+        )
+        self.subdirs: Dict[str, "SubDirectory"] = {}
+
+    # key ops
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.kernel.get(key, default)
+
+    def set(self, key: str, value: Any) -> "SubDirectory":
+        self.kernel.set(key, value)
+        return self
+
+    def has(self, key: str) -> bool:
+        return self.kernel.has(key)
+
+    def delete(self, key: str) -> bool:
+        return self.kernel.delete(key)
+
+    def clear(self) -> None:
+        self.kernel.clear()
+
+    def keys(self):
+        return self.kernel.keys()
+
+    def __len__(self) -> int:
+        return len(self.kernel)
+
+    # subdirectory ops
+    def create_subdirectory(self, name: str) -> "SubDirectory":
+        sub = self.subdirs.get(name)
+        if sub is None:
+            sub = self._create_child(name)
+            self._shared._submit_subdir_op(
+                {"type": "createSubDirectory", "path": self.path, "subdirName": name}
+            )
+        return sub
+
+    def delete_subdirectory(self, name: str) -> bool:
+        existed = name in self.subdirs
+        self.subdirs.pop(name, None)
+        self._shared._submit_subdir_op(
+            {"type": "deleteSubDirectory", "path": self.path, "subdirName": name}
+        )
+        return existed
+
+    def get_subdirectory(self, name: str) -> Optional["SubDirectory"]:
+        return self.subdirs.get(name)
+
+    def _create_child(self, name: str) -> "SubDirectory":
+        child_path = self.path.rstrip("/") + "/" + name
+        sub = SubDirectory(self._shared, child_path)
+        self.subdirs[name] = sub
+        return sub
+
+    # summary form
+    def to_serializable(self) -> dict:
+        return {
+            "storage": self.kernel.to_serializable(),
+            "subdirectories": {
+                name: sub.to_serializable() for name, sub in self.subdirs.items()
+            },
+        }
+
+    def load(self, data: dict) -> None:
+        self.kernel.load(data.get("storage", {}))
+        for name, sub_data in data.get("subdirectories", {}).items():
+            self._create_child(name).load(sub_data)
+
+
+class SharedDirectory(SharedObject):
+    """Hierarchical LWW key-value DDS (reference SharedDirectory,
+    directory.ts:324). Ops carry the absolute subdirectory path."""
+
+    def initialize_local_core(self) -> None:
+        self.root = SubDirectory(self, "/")
+
+    # Root-level convenience API (ISharedDirectory extends IDirectory).
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.root.get(key, default)
+
+    def set(self, key: str, value: Any) -> "SharedDirectory":
+        self.root.set(key, value)
+        return self
+
+    def has(self, key: str) -> bool:
+        return self.root.has(key)
+
+    def delete(self, key: str) -> bool:
+        return self.root.delete(key)
+
+    def keys(self):
+        return self.root.keys()
+
+    def create_subdirectory(self, name: str) -> SubDirectory:
+        return self.root.create_subdirectory(name)
+
+    def get_subdirectory(self, name: str) -> Optional[SubDirectory]:
+        return self.root.get_subdirectory(name)
+
+    def get_working_directory(self, path: str) -> Optional[SubDirectory]:
+        node = self.root
+        for part in path.strip("/").split("/"):
+            if not part:
+                continue
+            node = node.get_subdirectory(part)
+            if node is None:
+                return None
+        return node
+
+    # op plumbing
+    def _submit_storage_op(self, path: str, op: dict, md: Any = None) -> None:
+        self.submit_local_message({**op, "path": path}, md)
+
+    def _submit_subdir_op(self, op: dict) -> None:
+        self.submit_local_message(op)
+
+    def _resolve(self, path: str, create: bool = False) -> Optional[SubDirectory]:
+        node = self.root
+        for part in path.strip("/").split("/"):
+            if not part:
+                continue
+            nxt = node.get_subdirectory(part)
+            if nxt is None:
+                if not create:
+                    return None
+                nxt = node._create_child(part)
+            node = nxt
+        return node
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        kind = op["type"]
+        if kind == "createSubDirectory":
+            if not local:
+                parent = self._resolve(op["path"], create=True)
+                if parent is not None and op["subdirName"] not in parent.subdirs:
+                    parent._create_child(op["subdirName"])
+            return
+        if kind == "deleteSubDirectory":
+            if not local:
+                parent = self._resolve(op["path"])
+                if parent is not None:
+                    parent.subdirs.pop(op["subdirName"], None)
+            return
+        node = self._resolve(op["path"], create=not local)
+        if node is not None:
+            node.kernel.process(op, local)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        op = dict(content)
+        kind = op["type"]
+        if kind == "createSubDirectory":
+            parent = self._resolve(op["path"], create=True)
+            parent.create_subdirectory(op["subdirName"])
+        elif kind == "deleteSubDirectory":
+            parent = self._resolve(op["path"], create=True)
+            parent.delete_subdirectory(op["subdirName"])
+        else:
+            node = self._resolve(op["path"], create=True)
+            if kind == "set":
+                node.set(op["key"], op["value"])
+            elif kind == "delete":
+                node.delete(op["key"])
+            elif kind == "clear":
+                node.clear()
+        return None
+
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        op = content
+        kind = op["type"]
+        if kind == "createSubDirectory":
+            parent = self._resolve(op["path"])
+            if parent is not None:
+                parent.subdirs.pop(op["subdirName"], None)
+        elif kind == "deleteSubDirectory":
+            raise NotImplementedError("deleteSubDirectory rollback")
+        else:
+            node = self._resolve(op["path"])
+            if node is not None:
+                node.kernel.rollback(op, local_metadata)
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob("header", self.root.to_serializable())
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.root = SubDirectory(self, "/")
+        self.root.load(json.loads(storage.read("header")))
+
+
+class DirectoryFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/directory"
+    channel_class = SharedDirectory

@@ -1,0 +1,465 @@
+"""SharedString and the sequence DDS family over the merge-tree engine.
+
+Mirrors packages/dds/sequence: `SharedSegmentSequence`
+(src/sequence.ts:112, processCore :620) binds a merge-tree replica
+(core.mergetree.MergeTreeEngine — the reference's Client, client.ts:98)
+behind the channel seam; `SharedString` (src/sharedString.ts:169) is
+its text specialization; `IntervalCollection`
+(src/intervalCollection.ts:1436) stores anchored ranges whose endpoints
+are merge-tree local references that slide on remove.
+
+Channel op encoding (`contents` of the channel-level message):
+- {"kind": "seq", "op": <MergeTreeOp>} — merge-tree delta
+- {"kind": "intervals", "collection": name, "op": {...}} — interval ops
+
+The high-throughput sequenced-replay path for this DDS is the TPU
+kernel (ops.mergetree_kernel via core.columnar_replay); this class is
+the interactive collaborating replica (local edits, acks, references),
+host-side by design like the reference's Client.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+from ..core.mergetree import LocalReference, MergeTreeEngine, apply_remote_op
+from ..protocol.constants import NON_COLLAB_CLIENT, UNASSIGNED_SEQ, UNIVERSAL_SEQ
+from ..protocol.mergetree_ops import (
+    AnnotateOp,
+    GroupOp,
+    InsertOp,
+    MergeTreeOp,
+    RemoveOp,
+    op_from_json,
+    op_to_json,
+)
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+@dataclass
+class Marker:
+    """An atomic length-1 non-text segment (reference Marker,
+    mergeTreeNodes.ts:557): an anchor/boundary with properties."""
+
+    ref_type: int = 0
+    props: Optional[dict] = None
+
+    def __len__(self) -> int:
+        return 1
+
+    def __getitem__(self, i):  # slicing never splits a length-1 segment
+        return self
+
+
+class SharedSegmentSequence(SharedObject):
+    """Base sequence DDS (reference SharedSegmentSequence,
+    sequence.ts:112)."""
+
+    def initialize_local_core(self) -> None:
+        self.engine = MergeTreeEngine(local_client_id=NON_COLLAB_CLIENT)
+        self._collections: Dict[str, IntervalCollection] = {}
+
+    def on_connected(self) -> None:
+        # Adopt the session identity: local ops now ride the pending/ack
+        # path (reference Client.startOrUpdateCollaboration).
+        cid = self.runtime.client_id
+        assert cid is not None
+        self.engine.local_client_id = cid
+        self.engine.collaborating = True
+        self.engine.current_seq = self.runtime.container.current_seq
+
+    # ------------------------------------------------------------ queries
+
+    def get_length(self) -> int:
+        return self.engine.visible_length(
+            self.engine.current_seq, self.engine.local_client_id
+        )
+
+    # -------------------------------------------------------- local edits
+
+    def _submit_seq_op(self, op: MergeTreeOp) -> None:
+        self.submit_local_message({"kind": "seq", "op": op})
+
+    def _local_perspective(self):
+        return self.engine.current_seq, self.engine.local_client_id
+
+    def _insert(self, pos: int, content: Any, props: Optional[dict]) -> None:
+        if self.engine.collaborating:
+            self.engine.insert(
+                pos, content, self.engine.current_seq,
+                self.engine.local_client_id, UNASSIGNED_SEQ, props=props,
+            )
+        else:  # detached: applies as pre-collaboration content
+            self.engine.insert(
+                pos, content, UNIVERSAL_SEQ, NON_COLLAB_CLIENT,
+                UNIVERSAL_SEQ, props=props,
+            )
+            return
+        if isinstance(content, str):
+            op = InsertOp(pos=pos, text=content, props=props)
+        else:
+            op = InsertOp(pos=pos, seg=content, props=props)
+        self._submit_seq_op(op)
+        self.emit("sequenceDelta", op, True)
+
+    def remove_range(self, start: int, end: int) -> None:
+        if self.engine.collaborating:
+            self.engine.remove_range(
+                start, end, self.engine.current_seq,
+                self.engine.local_client_id, UNASSIGNED_SEQ,
+            )
+            self._submit_seq_op(RemoveOp(start=start, end=end))
+            self.emit("sequenceDelta", RemoveOp(start=start, end=end), True)
+        else:
+            self.engine.remove_range(
+                start, end, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ
+            )
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        if self.engine.collaborating:
+            self.engine.annotate_range(
+                start, end, props, self.engine.current_seq,
+                self.engine.local_client_id, UNASSIGNED_SEQ,
+            )
+            self._submit_seq_op(AnnotateOp(start=start, end=end, props=dict(props)))
+            self.emit("sequenceDelta", AnnotateOp(start=start, end=end, props=props), True)
+        else:
+            self.engine.annotate_range(
+                start, end, props, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ
+            )
+
+    # ---------------------------------------------------- inbound routing
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        contents = msg.contents
+        kind = contents["kind"]
+        if kind == "seq":
+            op = contents["op"]
+            if isinstance(op, dict):  # wire-decoded form
+                op = op_from_json(op)
+            if local:
+                self._ack(op, msg.sequence_number)
+            else:
+                apply_remote_op(
+                    self.engine, op, msg.ref_seq, msg.client_id,
+                    msg.sequence_number,
+                )
+                self.emit("sequenceDelta", op, False)
+        elif kind == "intervals":
+            coll = self.get_interval_collection(contents["collection"])
+            coll._process(contents["op"], msg, local)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown sequence op kind {kind!r}")
+        # Advance the collaboration window (Client.applyMsg tail,
+        # client.ts:877).
+        self.engine.current_seq = msg.sequence_number
+        self.engine.update_min_seq(
+            max(self.engine.min_seq, msg.minimum_sequence_number)
+        )
+
+    def _ack(self, op: MergeTreeOp, seq: int) -> None:
+        if isinstance(op, GroupOp):
+            for _ in op.ops:
+                self.engine.ack(seq)
+        else:
+            self.engine.ack(seq)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        op = content["op"] if content["kind"] == "seq" else None
+        if op is None:
+            raise NotImplementedError("stashed interval ops")
+        if isinstance(op, dict):
+            op = op_from_json(op)
+        # Re-apply as a fresh pending local op (client.ts:831
+        # applyStashedOp): positions were recorded at the stashed
+        # session's perspective which the rehydrated state reproduces.
+        if isinstance(op, InsertOp):
+            self._insert(op.pos, op.text if op.seg is None else op.seg, op.props)
+        elif isinstance(op, RemoveOp):
+            self.remove_range(op.start, op.end)
+        elif isinstance(op, AnnotateOp):
+            self.annotate_range(op.start, op.end, op.props)
+        return None
+
+    # --------------------------------------------------------- intervals
+
+    def get_interval_collection(self, name: str) -> "IntervalCollection":
+        if name not in self._collections:
+            self._collections[name] = IntervalCollection(self, name)
+        return self._collections[name]
+
+    # --------------------------------------------------------- summaries
+
+    def summarize_core(self):
+        """Chunked segment snapshot (reference SnapshotV1 header +
+        body chunks, snapshotV1.ts:30; chunk size :37). Segments inside
+        the collab window persist their merge info
+        (IJSONSegmentWithMergeInfo, snapshotChunks.ts:48)."""
+        header = {
+            "currentSeq": self.engine.current_seq,
+            "minSeq": self.engine.min_seq,
+            "intervals": {
+                name: coll._to_serializable()
+                for name, coll in self._collections.items()
+            },
+        }
+        segs = []
+        for s in self.engine.segments:
+            row: Dict[str, Any] = {}
+            if isinstance(s.content, Marker):
+                row["marker"] = {"refType": s.content.ref_type, "props": s.content.props}
+            elif isinstance(s.content, str):
+                row["text"] = s.content
+            else:
+                row["items"] = list(s.content)
+            if s.props:
+                row["props"] = dict(s.props)
+            # Merge info for unsettled segments (in collab window).
+            if s.seq not in (UNIVERSAL_SEQ,) or s.removed_seq is not None:
+                row["seq"] = s.seq
+                row["client"] = s.client_id
+                if s.removed_seq is not None:
+                    row["removedSeq"] = s.removed_seq
+                    row["removedClients"] = list(s.removed_clients)
+            segs.append(row)
+        builder = SummaryTreeBuilder().add_json_blob("header", header)
+        chunk_size = 10_000  # snapshotV1.ts:37
+        chunk, chunks, size = [], [], 0
+        for row in segs:
+            chunk.append(row)
+            size += len(row.get("text", "x"))
+            if size >= chunk_size:
+                chunks.append(chunk)
+                chunk, size = [], 0
+        if chunk or not chunks:
+            chunks.append(chunk)
+        for i, c in enumerate(chunks):
+            builder.add_json_blob(f"body_{i}", c)
+        builder.add_json_blob("chunkCount", len(chunks))
+        return builder.summary
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        header = json.loads(storage.read("header"))
+        self.engine.current_seq = header["currentSeq"]
+        self.engine.min_seq = header["minSeq"]
+        from ..core.mergetree import Segment
+
+        n_chunks = json.loads(storage.read("chunkCount"))
+        for i in range(n_chunks):
+            for row in json.loads(storage.read(f"body_{i}")):
+                if "marker" in row:
+                    content: Any = Marker(
+                        ref_type=row["marker"]["refType"],
+                        props=row["marker"]["props"],
+                    )
+                elif "text" in row:
+                    content = row["text"]
+                else:
+                    content = list(row["items"])
+                seg = Segment(
+                    content=content,
+                    seq=row.get("seq", UNIVERSAL_SEQ),
+                    client_id=row.get("client", NON_COLLAB_CLIENT),
+                    props=row.get("props"),
+                )
+                if "removedSeq" in row:
+                    seg.removed_seq = row["removedSeq"]
+                    seg.removed_clients = list(row["removedClients"])
+                self.engine.segments.append(seg)
+        for name, data in header.get("intervals", {}).items():
+            coll = self.get_interval_collection(name)
+            coll._load(data)
+
+
+class SharedString(SharedSegmentSequence):
+    """Collaborative text (reference SharedString, sharedString.ts)."""
+
+    def insert_text(self, pos: int, text: str, props: Optional[dict] = None) -> None:
+        self._insert(pos, text, props)
+
+    def remove_text(self, start: int, end: int) -> None:
+        self.remove_range(start, end)
+
+    def insert_marker(self, pos: int, ref_type: int = 0,
+                      props: Optional[dict] = None) -> None:
+        self._insert(pos, Marker(ref_type=ref_type, props=props), None)
+
+    def get_text(self) -> str:
+        parts = []
+        for seg in self.engine.segments:
+            if seg.removed_seq is None and isinstance(seg.content, str):
+                parts.append(seg.content)
+        return "".join(parts)
+
+    def get_markers(self) -> List[Marker]:
+        return [
+            s.content
+            for s in self.engine.segments
+            if s.removed_seq is None and isinstance(s.content, Marker)
+        ]
+
+    def annotated_spans(self):
+        return self.engine.annotated_spans()
+
+
+class StringFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/mergeTree"
+    channel_class = SharedString
+
+
+class SequenceFactory(StringFactory):
+    """Alias factory matching the reference's SharedString factory id."""
+
+
+# ---------------------------------------------------------------------------
+# Interval collections
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SequenceInterval:
+    """An anchored range (reference SequenceInterval,
+    intervalCollection.ts:404): endpoints are merge-tree local
+    references that slide on remove."""
+
+    interval_id: str
+    start_ref: LocalReference
+    end_ref: LocalReference
+    props: Dict[str, Any] = field(default_factory=dict)
+
+    def bounds(self, engine: MergeTreeEngine):
+        return engine.local_position(self.start_ref), engine.local_position(self.end_ref)
+
+
+class IntervalCollection:
+    """A named set of intervals over one sequence (reference
+    IntervalCollection, intervalCollection.ts:1436).
+
+    Conflict policy: whole-interval last-writer-wins with
+    pending-local shadowing (the defaultMap kernel the reference
+    stores interval values in, dds/sequence/src/defaultMap.ts).
+    """
+
+    def __init__(self, sequence: SharedSegmentSequence, name: str):
+        self.sequence = sequence
+        self.name = name
+        self.intervals: Dict[str, SequenceInterval] = {}
+        self._pending: Dict[str, int] = {}
+        self._next_local_id = 0
+
+    # ----------------------------------------------------------- local API
+
+    def _submit(self, op: dict) -> None:
+        self.sequence.submit_local_message(
+            {"kind": "intervals", "collection": self.name, "op": op}
+        )
+
+    def _anchor_local(self, start: int, end: int):
+        eng = self.sequence.engine
+        ref_seq, cid = eng.current_seq, eng.local_client_id
+        return eng.anchor_at(start, ref_seq, cid), eng.anchor_at(end, ref_seq, cid)
+
+    def add(self, start: int, end: int, props: Optional[dict] = None) -> SequenceInterval:
+        self._next_local_id += 1
+        iid = f"{self.sequence.engine.local_client_id}-{self._next_local_id}"
+        s_ref, e_ref = self._anchor_local(start, end)
+        iv = SequenceInterval(iid, s_ref, e_ref, dict(props or {}))
+        self.intervals[iid] = iv
+        self._pending[iid] = self._pending.get(iid, 0) + 1
+        self._submit(
+            {"type": "add", "id": iid, "start": start, "end": end, "props": props or {}}
+        )
+        return iv
+
+    def change(self, iid: str, start: int, end: int) -> None:
+        iv = self.intervals[iid]
+        iv.start_ref.detach()
+        iv.end_ref.detach()
+        iv.start_ref, iv.end_ref = self._anchor_local(start, end)
+        self._pending[iid] = self._pending.get(iid, 0) + 1
+        self._submit({"type": "change", "id": iid, "start": start, "end": end})
+
+    def remove_interval_by_id(self, iid: str) -> None:
+        iv = self.intervals.pop(iid, None)
+        if iv is not None:
+            iv.start_ref.detach()
+            iv.end_ref.detach()
+        self._pending[iid] = self._pending.get(iid, 0) + 1
+        self._submit({"type": "delete", "id": iid})
+
+    def get_interval_by_id(self, iid: str) -> Optional[SequenceInterval]:
+        return self.intervals.get(iid)
+
+    def __iter__(self) -> Iterator[SequenceInterval]:
+        return iter(self.intervals.values())
+
+    def __len__(self) -> int:
+        return len(self.intervals)
+
+    # -------------------------------------------------------------- apply
+
+    def _process(self, op: dict, msg: SequencedMessage, local: bool) -> None:
+        iid = op["id"]
+        if local:
+            n = self._pending.get(iid, 0) - 1
+            if n <= 0:
+                self._pending.pop(iid, None)
+            else:
+                self._pending[iid] = n
+            return
+        if self._pending.get(iid, 0) > 0:
+            return  # pending local change shadows the remote one
+        eng = self.sequence.engine
+        kind = op["type"]
+        if kind == "delete":
+            iv = self.intervals.pop(iid, None)
+            if iv is not None:
+                iv.start_ref.detach()
+                iv.end_ref.detach()
+            return
+        # Anchor at the op's perspective — every replica resolves the
+        # same segments (merge-tree remote-perspective contract).
+        s_ref = eng.anchor_at(op["start"], msg.ref_seq, msg.client_id)
+        e_ref = eng.anchor_at(op["end"], msg.ref_seq, msg.client_id)
+        if kind == "add":
+            self.intervals[iid] = SequenceInterval(
+                iid, s_ref, e_ref, dict(op.get("props") or {})
+            )
+        elif kind == "change":
+            iv = self.intervals.get(iid)
+            if iv is None:
+                s_ref.detach()
+                e_ref.detach()
+                return
+            iv.start_ref.detach()
+            iv.end_ref.detach()
+            iv.start_ref, iv.end_ref = s_ref, e_ref
+
+    # ---------------------------------------------------------- summaries
+
+    def _to_serializable(self) -> list:
+        eng = self.sequence.engine
+        return [
+            {
+                "id": iv.interval_id,
+                "start": eng.local_position(iv.start_ref),
+                "end": eng.local_position(iv.end_ref),
+                "props": iv.props,
+            }
+            for iv in self.intervals.values()
+        ]
+
+    def _load(self, data: list) -> None:
+        eng = self.sequence.engine
+        for row in data:
+            s_ref = eng.anchor_at(row["start"], eng.current_seq, eng.local_client_id)
+            e_ref = eng.anchor_at(row["end"], eng.current_seq, eng.local_client_id)
+            self.intervals[row["id"]] = SequenceInterval(
+                row["id"], s_ref, e_ref, dict(row.get("props") or {})
+            )

@@ -1,0 +1,365 @@
+"""ContainerRuntime: orchestrates datastores over the op stream.
+
+Mirrors the reference `ContainerRuntime`
+(packages/runtime/container-runtime/src/containerRuntime.ts:543):
+
+- inbound: `process` (:1813) unwraps op envelopes and routes to the
+  addressed datastore/channel, with batch-atomicity buffering
+  (ScheduleManagerCore, scheduleManager.ts:99);
+- outbound: an `Outbox` (opLifecycle/outbox.ts:40) accumulates local
+  ops and flushes them as marked batches (`batch: true/false`
+  metadata), in Immediate or TurnBased flush mode;
+- `PendingStateManager` (pendingStateManager.ts:75) tracks
+  unacknowledged local ops, matches them against the sequenced echo,
+  and replays them on reconnect (resubmit through each DDS so
+  merge-trees can rebase, client.ts:917);
+- `order_sequentially` (:1996) rolls back locally applied ops when the
+  callback throws.
+"""
+
+from __future__ import annotations
+
+import enum
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ..protocol.messages import DocumentMessage, MessageType, NackMessage, SequencedMessage
+from ..utils.events import EventEmitter
+from .channel import ChannelRegistry
+from .datastore import DataStoreRuntime
+from .summary import SummaryTree, SummaryTreeBuilder
+
+
+
+@dataclass
+class Envelope:
+    """Op envelope addressing datastore → channel (the nested address
+    wrapping of reference submitDataStoreOp, containerRuntime.ts:2779)."""
+
+    datastore: str
+    channel: str
+    contents: Any
+
+
+class FlushMode(enum.Enum):
+    # reference FlushMode (runtime-definitions): Immediate sends each op
+    # in its own batch; TurnBased accumulates until flush().
+    IMMEDIATE = "immediate"
+    TURN_BASED = "turnBased"
+
+
+@dataclass
+class _PendingMessage:
+    """One unacked local op (reference IPendingMessage,
+    pendingStateManager.ts)."""
+
+    client_seq: int
+    envelope: Envelope
+    local_metadata: Any
+    batch_meta: Optional[dict] = None
+    # Perspective at op creation (the reference stamps refSeq when the
+    # message is created, not when the batch flushes).
+    ref_seq: int = 0
+
+
+class ContainerRuntime(EventEmitter):
+    """The per-container op orchestrator.
+
+    `connection` is anything with `.submit(DocumentMessage)`,
+    `.client_id`, and assignable `.listener` / `.nack_listener`
+    (server.local_service._Connection satisfies this; drivers provide
+    the same surface).
+    """
+
+    def __init__(
+        self,
+        registry: ChannelRegistry,
+        flush_mode: FlushMode = FlushMode.TURN_BASED,
+    ):
+        super().__init__()
+        self.registry = registry
+        self.flush_mode = flush_mode
+        self.datastores: Dict[str, DataStoreRuntime] = {}
+        self.connection = None
+        self.client_id: Optional[int] = None
+        self.current_seq = 0
+        self.min_seq = 0
+        self._client_seq = 0
+        self._outbox: List[_PendingMessage] = []
+        self._pending = deque()  # acked-awaited _PendingMessage FIFO
+        self._inbound_batch: List[SequencedMessage] = []
+        self._in_batch = False
+        self._rollback_log: Optional[List[_PendingMessage]] = None
+        self._ever_connected = False
+
+    _emit = EventEmitter.emit
+
+    @property
+    def is_dirty(self) -> bool:
+        """True while local changes are unacked (reference
+        ContainerRuntime.isDirty)."""
+        return bool(self._pending) or bool(self._outbox)
+
+    # --------------------------------------------------------- datastores
+
+    def create_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        if datastore_id in self.datastores:
+            raise KeyError(f"datastore {datastore_id!r} exists")
+        ds = DataStoreRuntime(
+            datastore_id,
+            self.registry,
+            submit_fn=lambda cid, content, md: self._submit_op(
+                Envelope(datastore_id, cid, content), md
+            ),
+        )
+        ds.container = self
+        self.datastores[datastore_id] = ds
+        return ds
+
+    def get_datastore(self, datastore_id: str) -> DataStoreRuntime:
+        return self.datastores[datastore_id]
+
+    # --------------------------------------------------------- connection
+
+    def connect(self, connection) -> None:
+        """Go live on an ordering-service connection: catch up on the
+        op gap since our last known seq, attach all datastores'
+        channels, and replay pending ops if reconnecting."""
+        had_pending = list(self._pending)
+        self._pending.clear()
+        self.connection = connection
+        self._ever_connected = True
+        self.client_id = connection.client_id
+        # Fresh connection = fresh server-side clientSeq expectation
+        # (the sequencer's join resets the per-client counter).
+        self._client_seq = 0
+        connection.listener = self.process
+        if hasattr(connection, "nack_listener"):
+            connection.nack_listener = self._on_nack
+        # Delta catch-up: fetch ops sequenced between our last applied
+        # seq and the join point (Container.load attachOpHandler +
+        # DeltaManager catch-up, SURVEY.md §3.4). Live delivery starts
+        # strictly after the join, so the two sources never overlap.
+        if hasattr(connection, "catch_up"):
+            for msg in connection.catch_up(self.current_seq):
+                self.process(msg)
+        for ds in self.datastores.values():
+            ds.attach_all()
+        # Reconnect: replay unacked ops through each channel's resubmit
+        # path (PendingStateManager.replayPendingStates →
+        # DDS reSubmitCore; merge-trees rebase, client.ts:917).
+        for pm in had_pending:
+            ds = self.datastores[pm.envelope.datastore]
+            ds.resubmit(pm.envelope.channel, pm.envelope.contents, pm.local_metadata)
+        self.flush()
+        self._emit("connected", self.client_id)
+
+    def _on_nack(self, nack: NackMessage) -> None:
+        """A nack is connection-fatal (the reference client's response
+        to a deli nack, lambda.ts:967, is reconnect + replay): drop off
+        the connection, keep every unacked op (including the nacked
+        one) in the pending FIFO, and let the host reconnect — at which
+        point connect() replays them through each DDS's resubmit path
+        with fresh perspectives."""
+        self.disconnect()
+        self._emit("nack", nack)
+
+    def disconnect(self) -> None:
+        """Leave the current connection; unacked ops stay pending for
+        replay on the next connect()."""
+        conn, self.connection = self.connection, None
+        if conn is not None and hasattr(conn, "disconnect"):
+            try:
+                conn.disconnect()
+            except Exception:
+                pass
+        self._emit("disconnected")
+
+    # ----------------------------------------------------------- outbound
+
+    def _submit_op(self, envelope: Envelope, local_metadata: Any) -> None:
+        if self.connection is None and not self._ever_connected:
+            # Detached container: ops were already applied locally;
+            # state is captured by the attach summary. (A *disconnected*
+            # container keeps queueing — the ops flush on reconnect.)
+            return
+        pm = _PendingMessage(0, envelope, local_metadata, ref_seq=self.current_seq)
+        if self._rollback_log is not None:
+            self._rollback_log.append(pm)
+        self._outbox.append(pm)
+        if self.flush_mode is FlushMode.IMMEDIATE:
+            self.flush()
+
+    def flush(self) -> None:
+        """Send the accumulated batch (Outbox.flush, outbox.ts:40):
+        first op carries {"batch": true}, last {"batch": false};
+        singletons carry no batch metadata."""
+        if self.connection is None:
+            return  # disconnected: outbox drains on reconnect
+        batch, self._outbox = self._outbox, []
+        n = len(batch)
+        if n == 0:
+            return
+        for i, pm in enumerate(batch):
+            meta = None
+            if n > 1:
+                if i == 0:
+                    meta = {"batch": True}
+                elif i == n - 1:
+                    meta = {"batch": False}
+            self._client_seq += 1
+            pm.client_seq = self._client_seq
+            pm.batch_meta = meta
+            self._pending.append(pm)
+            self.connection.submit(
+                DocumentMessage(
+                    client_seq=pm.client_seq,
+                    ref_seq=pm.ref_seq,
+                    type=MessageType.OP,
+                    contents={
+                        "address": pm.envelope.datastore,
+                        "contents": {
+                            "address": pm.envelope.channel,
+                            "contents": pm.envelope.contents,
+                        },
+                    },
+                    metadata=meta,
+                )
+            )
+
+    def order_sequentially(self, callback: Callable[[], Any]) -> Any:
+        """Run `callback`; if it throws, roll back the ops it produced
+        in reverse order (containerRuntime.ts:1996)."""
+        if self._rollback_log is not None:
+            return callback()  # nested: outermost owns the log
+        self._rollback_log = []
+        try:
+            return callback()
+        except BaseException as user_exc:
+            log, self._rollback_log = self._rollback_log, None
+            # Drop the ops from the outbox first — even if a DDS cannot
+            # roll back, a "rolled back" op must never reach the wire.
+            log_set = {id(pm) for pm in log}
+            self._outbox = [m for m in self._outbox if id(m) not in log_set]
+            for pm in reversed(log):
+                ds = self.datastores[pm.envelope.datastore]
+                try:
+                    ds.rollback(pm.envelope.channel, pm.envelope.contents,
+                                pm.local_metadata)
+                except BaseException as rb_exc:
+                    # Local state may now diverge from what peers will
+                    # compute: unrecoverable (the reference closes the
+                    # container, containerRuntime.ts:1996).
+                    self._emit("closed", rb_exc)
+                    raise RuntimeError(
+                        "rollback failed; container corrupt"
+                    ) from user_exc
+            raise
+        finally:
+            self._rollback_log = None
+
+    # ------------------------------------------------------------ inbound
+
+    def process(self, msg: SequencedMessage) -> None:
+        """Inbound sequenced message (containerRuntime.ts:1813 process),
+        with batch buffering: a batch-start message holds delivery until
+        its batch-end arrives, then the whole batch applies back-to-back
+        (ScheduleManagerCore batch atomicity, scheduleManager.ts:99)."""
+        meta = msg.metadata if isinstance(msg.metadata, dict) else None
+        if self._in_batch:
+            self._inbound_batch.append(msg)
+            if meta is not None and meta.get("batch") is False:
+                batch, self._inbound_batch = self._inbound_batch, []
+                self._in_batch = False
+                for m in batch:
+                    self._process_one(m)
+            return
+        if meta is not None and meta.get("batch") is True:
+            self._in_batch = True
+            self._inbound_batch = [msg]
+            return
+        self._process_one(msg)
+
+    def _process_one(self, msg: SequencedMessage) -> None:
+        self.current_seq = msg.sequence_number
+        self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+        if msg.type != MessageType.OP or not isinstance(msg.contents, dict):
+            self._emit("op", msg, False)
+            return
+        local = msg.client_id == self.client_id
+        local_metadata = None
+        if local:
+            # Match the sequenced echo against the pending FIFO
+            # (PendingStateManager.processPendingLocalMessage).
+            assert self._pending, "sequenced local op with empty pending queue"
+            pm = self._pending.popleft()
+            assert pm.client_seq == msg.client_seq, (
+                f"pending clientSeq {pm.client_seq} != echoed {msg.client_seq}"
+            )
+            local_metadata = pm.local_metadata
+        outer = msg.contents
+        inner = outer["contents"]
+        ds = self.datastores[outer["address"]]
+        ds.process(inner["address"], _reshape(msg, inner["contents"]), local, local_metadata)
+        self._emit("op", msg, local)
+        if not self.is_dirty:
+            self._emit("saved")
+
+    # ---------------------------------------------------------- summaries
+
+    def summarize(self) -> SummaryTree:
+        """Container summary: one subtree per datastore under
+        ".channels", plus runtime metadata (the shape of reference
+        ContainerRuntime.summarize / summaryFormat.md).
+
+        Refuses while local changes are unacked: pending state (e.g. a
+        merge-tree segment at UNASSIGNED_SEQ) is not summarizable — the
+        reference's summarizer likewise only runs on a clean replica."""
+        if self.connection is not None and self.is_dirty:
+            raise RuntimeError(
+                "cannot summarize with pending local changes; "
+                "process the op stream to quiescence first"
+            )
+        builder = SummaryTreeBuilder()
+        channels = SummaryTreeBuilder()
+        for did, ds in self.datastores.items():
+            channels.add_tree(did, ds.summarize())
+        builder.add_tree(".channels", channels.summary)
+        builder.add_json_blob(
+            ".metadata",
+            {"sequenceNumber": self.current_seq, "minimumSequenceNumber": self.min_seq},
+        )
+        return builder.summary
+
+    def load(self, summary: SummaryTree) -> None:
+        """Boot from a summary (Container.load → instantiateRuntime →
+        lazy datastore realization, SURVEY.md §3.4 — eager here)."""
+        import json as _json
+
+        meta = _json.loads(summary.get_blob(".metadata"))
+        self.current_seq = meta["sequenceNumber"]
+        self.min_seq = meta["minimumSequenceNumber"]
+        channels = summary.get_tree(".channels")
+        for did, node in channels.entries.items():
+            assert isinstance(node, SummaryTree)
+            ds = self.create_datastore(did)
+            ds.load(node)
+
+
+def _reshape(msg: SequencedMessage, inner_contents: Any) -> SequencedMessage:
+    """The channel-level view of a sequenced message: same stamps,
+    contents narrowed to the channel op (what ChannelDeltaConnection
+    hands to SharedObjectCore's delta handler)."""
+    return SequencedMessage(
+        sequence_number=msg.sequence_number,
+        minimum_sequence_number=msg.minimum_sequence_number,
+        client_id=msg.client_id,
+        client_seq=msg.client_seq,
+        ref_seq=msg.ref_seq,
+        type=msg.type,
+        contents=inner_contents,
+        metadata=msg.metadata,
+        address=None,
+        timestamp=msg.timestamp,
+    )

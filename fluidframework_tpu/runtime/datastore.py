@@ -1,0 +1,170 @@
+"""DataStoreRuntime: hosts a set of channels (DDS instances).
+
+Mirrors `FluidDataStoreRuntime` (reference
+packages/runtime/datastore/src/dataStoreRuntime.ts:104): creates
+channels through the registry, routes inbound channel ops
+(`process` :591 → `ChannelDeltaConnection.process`,
+remoteChannelContext.ts:131), forwards outbound channel ops up to the
+container runtime, and summarizes per-channel subtrees with channel
+`.attributes` metadata blobs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional
+
+from ..protocol.messages import SequencedMessage
+from .channel import (
+    ChannelAttributes,
+    ChannelRegistry,
+    ChannelServices,
+    ChannelStorage,
+    DeltaConnection,
+)
+from .shared_object import SharedObject
+from .summary import SummaryTree, SummaryTreeBuilder
+
+ATTRIBUTES_BLOB = ".attributes"
+
+
+class DataStoreRuntime:
+    """One datastore's channel host.
+
+    `submit_fn(channel_id, content, local_metadata)` sends an op up to
+    the container runtime (FluidDataStoreContext.submitMessage path);
+    standalone use (unit tests, single-datastore documents) may wire it
+    straight to an ordering-service connection.
+    """
+
+    def __init__(
+        self,
+        datastore_id: str,
+        registry: ChannelRegistry,
+        submit_fn: Optional[Callable[[str, Any, Any], None]] = None,
+    ):
+        self.id = datastore_id
+        self.registry = registry
+        self._submit_fn = submit_fn
+        self.channels: Dict[str, SharedObject] = {}
+        self._local_metadata: Dict[str, Any] = {}
+        self.connected = False
+        # Back-reference to the hosting container runtime (None when
+        # standalone); set by ContainerRuntime.create_datastore.
+        self.container = None
+
+    @property
+    def client_id(self) -> Optional[int]:
+        """The session client id once the container connects (reference
+        IFluidDataStoreRuntime.clientId)."""
+        return self.container.client_id if self.container is not None else None
+
+    # -------------------------------------------------------- channel mgmt
+
+    def create_channel(self, channel_id: str, type_name: str) -> SharedObject:
+        """Create a fresh detached channel (dataStoreRuntime.ts:253
+        createChannel)."""
+        if channel_id in self.channels:
+            raise KeyError(f"channel {channel_id!r} exists")
+        factory = self.registry.get(type_name)
+        ch = factory.create(self, channel_id)
+        self.channels[channel_id] = ch
+        return ch
+
+    def get_channel(self, channel_id: str) -> SharedObject:
+        return self.channels[channel_id]
+
+    def _connection_for(self, channel_id: str) -> DeltaConnection:
+        return DeltaConnection(
+            submit_fn=lambda content, md: self._submit_channel_op(
+                channel_id, content, md
+            )
+        )
+
+    def attach_channel(self, channel: SharedObject) -> None:
+        """Bind a detached channel to the op stream
+        (dataStoreRuntime.ts bindChannel)."""
+        channel.connect(ChannelServices(self._connection_for(channel.id)))
+
+    def attach_all(self) -> None:
+        self.connected = True
+        for ch in self.channels.values():
+            if not ch.is_attached:
+                self.attach_channel(ch)
+            if self.client_id is not None:
+                ch.on_connected()
+
+    # ----------------------------------------------------------- outbound
+
+    def _submit_channel_op(self, channel_id: str, content: Any, md: Any) -> None:
+        if self._submit_fn is None:
+            raise RuntimeError("datastore runtime has no submit path")
+        self._submit_fn(channel_id, content, md)
+
+    # ------------------------------------------------------------ inbound
+
+    def process(self, channel_id: str, msg: SequencedMessage, local: bool,
+                local_metadata: Any) -> None:
+        """Route one sequenced channel op (dataStoreRuntime.ts:591
+        process → channel delta handler)."""
+        ch = self.channels[channel_id]
+        assert ch.services is not None, f"channel {channel_id} not attached"
+        ch.services.delta_connection.process(msg, local, local_metadata)
+
+    def resubmit(self, channel_id: str, content: Any, local_metadata: Any) -> None:
+        ch = self.channels[channel_id]
+        assert ch.services is not None
+        ch.services.delta_connection.resubmit(content, local_metadata)
+
+    def rollback(self, channel_id: str, content: Any, local_metadata: Any) -> None:
+        ch = self.channels[channel_id]
+        assert ch.services is not None
+        ch.services.delta_connection.rollback(content, local_metadata)
+
+    def apply_stashed_op(self, channel_id: str, content: Any) -> Any:
+        ch = self.channels[channel_id]
+        assert ch.services is not None
+        return ch.services.delta_connection.apply_stashed_op(content)
+
+    # ---------------------------------------------------------- summaries
+
+    def summarize(self) -> SummaryTree:
+        """Per-channel subtrees + attributes blobs (the shape
+        FluidDataStoreRuntime.summarize produces from channel
+        summarizeCore outputs)."""
+        builder = SummaryTreeBuilder()
+        for cid, ch in self.channels.items():
+            sub = ch.get_attach_summary()
+            sub.add_blob(
+                ATTRIBUTES_BLOB,
+                json.dumps(
+                    {
+                        "type": ch.attributes.type,
+                        "snapshotFormatVersion": ch.attributes.snapshot_format_version,
+                    }
+                ),
+            )
+            builder.add_tree(cid, sub)
+        return builder.summary
+
+    def load(self, summary: SummaryTree) -> None:
+        """Rehydrate every channel from a datastore summary subtree
+        (the RemoteChannelContext lazy-load path, remoteChannelContext.ts:39 —
+        eager here; laziness is an optimization, not semantics)."""
+        for cid, node in summary.entries.items():
+            assert isinstance(node, SummaryTree), f"unexpected blob {cid}"
+            attrs = json.loads(node.get_blob(ATTRIBUTES_BLOB))
+            factory = self.registry.get(attrs["type"])
+            storage = ChannelStorage(
+                {
+                    k: v
+                    for k, v in node.flatten().items()
+                    if k != ATTRIBUTES_BLOB
+                }
+            )
+            services = ChannelServices(self._connection_for(cid), storage)
+            ch = factory.load(
+                self, cid, services, ChannelAttributes(type=attrs["type"])
+            )
+            self.channels[cid] = ch
+        self.connected = True

@@ -1,0 +1,124 @@
+"""SharedObject: the abstract DDS base class.
+
+Mirrors `SharedObjectCore`/`SharedObject` (reference
+packages/dds/shared-object-base/src/sharedObject.ts:42,583): attach/load
+lifecycle, local-op submission, inbound routing to `process_core`, and
+the summarize hooks. Concrete DDSes (map, sequence, matrix, ...)
+subclass this and plug in behind the channel seam.
+
+Lifecycle states (reference AttachState): a channel starts *detached*
+(`initialize_local`), may accumulate local state, then *connects* to a
+delta stream (`connect`) or is *loaded* from a summary (`load`). Ops
+submitted while detached are applied locally only; on connect the DDS
+keeps its state and starts submitting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..utils.events import EventEmitter
+from .channel import ChannelAttributes, ChannelServices, ChannelStorage
+
+
+class SharedObject(EventEmitter):
+    """Abstract DDS base (reference SharedObjectCore, sharedObject.ts:42)."""
+
+    def __init__(self, channel_id: str, runtime: Any, attributes: ChannelAttributes):
+        super().__init__()
+        self.id = channel_id
+        self.runtime = runtime
+        self.attributes = attributes
+        self.services: Optional[ChannelServices] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    @property
+    def is_attached(self) -> bool:
+        return self.services is not None
+
+    def initialize_local(self) -> None:
+        """Fresh detached channel (factory create path,
+        IChannelFactory.create channel.ts:269)."""
+        self.initialize_local_core()
+
+    def load(self, services: ChannelServices) -> None:
+        """Rehydrate from a summary then connect (factory load path,
+        IChannelFactory.load channel.ts:287 → SharedObjectCore.load
+        sharedObject.ts:308)."""
+        self.load_core(services.storage)
+        self._attach_delta_handler(services)
+
+    def connect(self, services: ChannelServices) -> None:
+        """Attach a live delta stream to this channel
+        (SharedObjectCore.connect → attachDeltaHandler,
+        sharedObject.ts:423,448)."""
+        self._attach_delta_handler(services)
+
+    def _attach_delta_handler(self, services: ChannelServices) -> None:
+        self.services = services
+        services.delta_connection.attach(self)  # self implements DeltaHandler
+        self.did_attach()
+
+    # ------------------------------------------------------ outbound path
+
+    def submit_local_message(self, content: Any, local_metadata: Any = None) -> None:
+        """Apply-locally-then-submit tail (sharedObject.ts:350
+        submitLocalMessage). Detached channels swallow the op — their
+        state is captured wholesale by the attach summary."""
+        if self.services is not None:
+            self.services.delta_connection.submit(content, local_metadata)
+
+    # ------------------------------------------------- inbound (DeltaHandler)
+
+    def process(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        self.process_core(msg, local, local_metadata)
+
+    def resubmit(self, content: Any, local_metadata: Any) -> None:
+        """Reconnect path: re-send a pending op against current state
+        (sharedObject.ts:385 reSubmitCore; merge-tree overrides to
+        rebase, client.ts:917). Default: submit unchanged."""
+        self.submit_local_message(content, local_metadata)
+
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        """Undo a just-applied local op (orderSequentially abort path,
+        containerRuntime.ts:1996). DDSes that support it override."""
+        raise NotImplementedError(f"{type(self).__name__} cannot roll back")
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        """Apply an op recovered from a closed session's pending state
+        (IDeltaHandler.applyStashedOp channel.ts:153); returns the
+        local metadata to track it as pending."""
+        raise NotImplementedError(f"{type(self).__name__} cannot apply stashed ops")
+
+    # ---------------------------------------------------------- summaries
+
+    def get_attach_summary(self):
+        """Summary of current state for attach/summarize (reference
+        SharedObject.getAttachSummary → summarizeCore,
+        sharedObject.ts:583,722). Returns a SummaryTree (runtime.summary)."""
+        return self.summarize_core()
+
+    # ------------------------------------------------ subclass obligations
+
+    def initialize_local_core(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def did_attach(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def on_connected(self) -> None:
+        """The hosting container went live on a connection: the session
+        client id is now known (reference setConnectionState plumbing).
+        DDSes that track a collaborating identity override."""
+        pass
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        raise NotImplementedError
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        raise NotImplementedError
+
+    def summarize_core(self):
+        raise NotImplementedError

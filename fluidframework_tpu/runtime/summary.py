@@ -1,0 +1,126 @@
+"""Summary tree: the checkpoint data model.
+
+Mirrors the reference's `ISummaryTree`/`ISummaryBlob` protocol types
+(common/lib/protocol-definitions/src/summary.ts) and the
+`SummaryTreeBuilder` helper (packages/runtime/runtime-utils/src/
+summaryUtils.ts). A summary is a git-like tree: internal nodes are
+trees, leaves are blobs (str/bytes/JSON-able). `flatten()` yields the
+path → blob mapping `ChannelStorage` reads; `to_json`/`from_json` give
+a storable wire form (the role the git tree encoding plays for
+gitrest, server/gitrest).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, Tuple, Union
+
+
+@dataclass
+class SummaryBlob:
+    content: Union[str, bytes]
+
+
+@dataclass
+class SummaryTree:
+    entries: Dict[str, Union["SummaryTree", SummaryBlob]] = field(default_factory=dict)
+
+    def add_blob(self, key: str, content: Union[str, bytes]) -> "SummaryTree":
+        self.entries[key] = SummaryBlob(content)
+        return self
+
+    def add_tree(self, key: str, tree: "SummaryTree") -> "SummaryTree":
+        self.entries[key] = tree
+        return self
+
+    def get_tree(self, key: str) -> "SummaryTree":
+        node = self.entries[key]
+        assert isinstance(node, SummaryTree), f"{key} is a blob"
+        return node
+
+    def get_blob(self, key: str) -> Union[str, bytes]:
+        node = self.entries[key]
+        assert isinstance(node, SummaryBlob), f"{key} is a tree"
+        return node.content
+
+    # ------------------------------------------------------------ walking
+
+    def flatten(self, prefix: str = "") -> Dict[str, Union[str, bytes]]:
+        """Path → blob content for every leaf (the IChannelStorageService
+        read view, channel.ts:201)."""
+        out: Dict[str, Union[str, bytes]] = {}
+        for key, node in self.entries.items():
+            path = f"{prefix}{key}"
+            if isinstance(node, SummaryBlob):
+                out[path] = node.content
+            else:
+                out.update(node.flatten(path + "/"))
+        return out
+
+    def walk(self) -> Iterator[Tuple[str, SummaryBlob]]:
+        yield from self.flatten().items()
+
+    def stats(self) -> Tuple[int, int]:
+        """(tree_nodes, blob_nodes) — reference ISummaryStats."""
+        trees, blobs = 1, 0
+        for node in self.entries.values():
+            if isinstance(node, SummaryBlob):
+                blobs += 1
+            else:
+                t, b = node.stats()
+                trees += t
+                blobs += b
+        return trees, blobs
+
+    # ---------------------------------------------------------- wire form
+
+    def to_json(self) -> str:
+        def enc(node):
+            if isinstance(node, SummaryBlob):
+                if isinstance(node.content, bytes):
+                    return {"type": "blob", "encoding": "latin1",
+                            "content": node.content.decode("latin1")}
+                return {"type": "blob", "content": node.content}
+            return {
+                "type": "tree",
+                "entries": {k: enc(v) for k, v in node.entries.items()},
+            }
+
+        return json.dumps(enc(self))
+
+    @classmethod
+    def from_json(cls, data: str) -> "SummaryTree":
+        def dec(obj):
+            if obj["type"] == "blob":
+                if obj.get("encoding") == "latin1":
+                    return SummaryBlob(obj["content"].encode("latin1"))
+                return SummaryBlob(obj["content"])
+            t = cls()
+            t.entries = {k: dec(v) for k, v in obj["entries"].items()}
+            return t
+
+        return dec(json.loads(data))
+
+
+class SummaryTreeBuilder:
+    """Fluent builder (reference SummaryTreeBuilder, summaryUtils.ts)."""
+
+    def __init__(self):
+        self._tree = SummaryTree()
+
+    def add_blob(self, key: str, content: Union[str, bytes]) -> "SummaryTreeBuilder":
+        self._tree.add_blob(key, content)
+        return self
+
+    def add_json_blob(self, key: str, value: Any) -> "SummaryTreeBuilder":
+        self._tree.add_blob(key, json.dumps(value))
+        return self
+
+    def add_tree(self, key: str, tree: SummaryTree) -> "SummaryTreeBuilder":
+        self._tree.add_tree(key, tree)
+        return self
+
+    @property
+    def summary(self) -> SummaryTree:
+        return self._tree

@@ -28,11 +28,27 @@ class _Connection:
         self.listener: Optional[Listener] = None
         self.nack_listener: Optional[NackListener] = None
         self.connected = True
+        # Sequence number of this connection's join message: live
+        # delivery covers strictly-later messages; everything at/before
+        # it is fetched via catch_up (so a joiner never double-receives
+        # messages queued before it connected).
+        self.join_seq = 0
 
     def submit(self, msg: DocumentMessage) -> None:
         if not self.connected:
             raise RuntimeError("connection closed")
         self.service._submit(self.doc_id, self.client_id, msg)
+
+    def catch_up(self, from_seq: int) -> List[SequencedMessage]:
+        """Ops in (from_seq, join_seq] — the gap between a loaded
+        summary/last session and this connection (the
+        IDocumentDeltaStorageService fetch of Container.load,
+        SURVEY.md §3.4)."""
+        return [
+            m
+            for m in self.service.ops_from(self.doc_id, from_seq)
+            if m.sequence_number <= self.join_seq
+        ]
 
     def disconnect(self) -> None:
         if self.connected:
@@ -77,8 +93,9 @@ class LocalOrderingService:
                 f"client {client_id} already connected to {doc_id}"
             )
         conn = _Connection(self, doc_id, client_id)
-        self.connections.setdefault(doc_id, []).append(conn)
         join = seqr.join(client_id)
+        conn.join_seq = join.sequence_number
+        self.connections.setdefault(doc_id, []).append(conn)
         self._deliver(doc_id, join)
         return conn
 
@@ -111,7 +128,11 @@ class LocalOrderingService:
 
     def _fan_out(self, doc_id: str, msg: SequencedMessage) -> None:
         for conn in list(self.connections.get(doc_id, [])):
-            if conn.connected and conn.listener is not None:
+            if (
+                conn.connected
+                and conn.listener is not None
+                and msg.sequence_number > conn.join_seq
+            ):
                 conn.listener(msg)
 
     # --------------------------------------------------- deferred drain

@@ -1,0 +1,78 @@
+"""Multi-client runtime harness for DDS unit tests.
+
+Plays the role of the reference's `MockContainerRuntimeFactory` +
+`MockFluidDataStoreRuntime`
+(packages/runtime/test-runtime-utils/src/mocks.ts:206,392): N real
+`ContainerRuntime`s share one in-proc `LocalOrderingService` in
+deferred mode; `process_all()` is the analog of
+`processAllMessages` — drain the totally ordered stream to every
+replica. Unlike the reference mocks these are the *production* runtime
+classes; only the ordering service is local (which mirrors how the
+reference integration tests run real lambdas in-proc, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..runtime.channel import ChannelRegistry
+from ..runtime.container_runtime import ContainerRuntime, FlushMode
+from ..server.local_service import LocalOrderingService
+
+DEFAULT_DATASTORE = "default"
+
+
+class MultiClientHarness:
+    """N container runtimes collaborating on one document in-proc."""
+
+    def __init__(
+        self,
+        n_clients: int,
+        registry: ChannelRegistry,
+        doc_id: str = "doc",
+        flush_mode: FlushMode = FlushMode.TURN_BASED,
+        channel_types: Optional[Sequence[tuple]] = None,
+    ):
+        """`channel_types`: [(channel_id, type_name), ...] created on
+        every client's default datastore before connecting (the mock
+        pattern: each replica constructs its own instance of the same
+        channel, reference mocks.ts usage throughout dds tests)."""
+        self.service = LocalOrderingService(deferred=True)
+        self.doc_id = doc_id
+        self.runtimes: List[ContainerRuntime] = []
+        for i in range(n_clients):
+            rt = ContainerRuntime(registry, flush_mode=flush_mode)
+            ds = rt.create_datastore(DEFAULT_DATASTORE)
+            for cid, tname in channel_types or []:
+                ds.create_channel(cid, tname)
+            self.runtimes.append(rt)
+        for i, rt in enumerate(self.runtimes):
+            conn = self.service.connect(doc_id, client_id=i + 1)
+            rt.connect(conn)
+        self.process_all()  # drain joins so every replica's seq aligns
+
+    def channel(self, client_index: int, channel_id: str):
+        return self.runtimes[client_index].get_datastore(
+            DEFAULT_DATASTORE
+        ).get_channel(channel_id)
+
+    def flush_all(self) -> None:
+        for rt in self.runtimes:
+            rt.flush()
+
+    def process_all(self) -> int:
+        """Flush every client's outbox, then drain the sequenced stream
+        to all replicas (processAllMessages, mocks.ts:107)."""
+        self.flush_all()
+        n = self.service.process_all(self.doc_id)
+        # flushing during processing can enqueue more (e.g. resubmits)
+        while True:
+            self.flush_all()
+            more = self.service.process_all(self.doc_id)
+            if not more:
+                return n
+            n += more
+
+    @property
+    def sequencer(self):
+        return self.service.sequencers[self.doc_id]

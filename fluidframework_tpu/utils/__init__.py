@@ -1,0 +1,5 @@
+"""Shared utilities (the reference's common/lib/common-utils role)."""
+
+from .events import EventEmitter
+
+__all__ = ["EventEmitter"]

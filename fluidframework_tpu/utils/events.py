@@ -1,0 +1,36 @@
+"""Minimal synchronous event emitter.
+
+The role of the reference's TypedEventEmitter
+(common/lib/common-utils/src/typedEventEmitter.ts): listener
+registration + synchronous dispatch, shared by DDSes, runtimes, and
+services.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+
+class EventEmitter:
+    def __init__(self):
+        self._listeners: Dict[str, List[Callable]] = {}
+
+    def on(self, event: str, fn: Callable) -> Callable:
+        self._listeners.setdefault(event, []).append(fn)
+        return fn
+
+    def off(self, event: str, fn: Callable) -> None:
+        handlers = self._listeners.get(event, [])
+        if fn in handlers:
+            handlers.remove(fn)
+
+    def once(self, event: str, fn: Callable) -> Callable:
+        def wrapper(*args):
+            self.off(event, wrapper)
+            fn(*args)
+
+        return self.on(event, wrapper)
+
+    def emit(self, event: str, *args) -> None:
+        for fn in list(self._listeners.get(event, [])):
+            fn(*args)

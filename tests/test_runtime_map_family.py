@@ -1,0 +1,270 @@
+"""Runtime stack + map-family DDS tests.
+
+Convergence and conflict-policy tests for SharedMap/SharedDirectory/
+SharedCell/SharedCounter running through the real ContainerRuntime →
+DataStoreRuntime → channel seam over the in-proc ordering service
+(the reference's mock-runtime DDS unit layer, SURVEY.md §4, plus
+map-specific cases after packages/dds/map/src/test/map.spec.ts).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds import (
+    CellFactory,
+    CounterFactory,
+    DirectoryFactory,
+    MapFactory,
+)
+from fluidframework_tpu.runtime import ChannelRegistry, FlushMode
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+REGISTRY = ChannelRegistry(
+    [MapFactory(), DirectoryFactory(), CellFactory(), CounterFactory()]
+)
+
+
+def make_harness(n=2, channels=(("m", MapFactory.type_name),), **kw):
+    return MultiClientHarness(n, REGISTRY, channel_types=list(channels), **kw)
+
+
+# ---------------------------------------------------------------- SharedMap
+
+
+def test_map_basic_set_get_converges():
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("k", 1)
+    b.set("other", "x")
+    h.process_all()
+    for m in (a, b):
+        assert m.get("k") == 1
+        assert m.get("other") == "x"
+
+
+def test_map_concurrent_set_last_sequenced_wins():
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("k", "from-a")
+    b.set("k", "from-b")
+    # a's op sequences first (flush order), so b's wins everywhere.
+    h.process_all()
+    assert a.get("k") == "from-b"
+    assert b.get("k") == "from-b"
+
+
+def test_map_pending_local_shadows_remote():
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("k", "a1")
+    h.process_all()
+    # b writes and its op is sequenced; a has a new pending write that
+    # must shadow b's sequenced value until a's own op lands.
+    b.set("k", "b1")
+    h.runtimes[1].flush()
+    a.set("k", "a2")  # pending at a
+    h.service.process_all()  # delivers b's op only
+    assert a.get("k") == "a2"  # shadowed (mapKernel pending rule)
+    h.process_all()  # now a's op sequences after b's: a2 wins
+    assert a.get("k") == "a2"
+    assert b.get("k") == "a2"
+
+
+def test_map_delete_and_clear():
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("x", 1)
+    a.set("y", 2)
+    h.process_all()
+    b.delete("x")
+    h.process_all()
+    assert not a.has("x") and a.get("y") == 2
+    a.clear()
+    h.process_all()
+    assert len(a) == 0 and len(b) == 0
+
+
+def test_map_remote_clear_reapplies_pending_local():
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    b.clear()
+    h.runtimes[1].flush()
+    a.set("k", "local")  # pending at a when the clear arrives
+    h.service.process_all()
+    assert a.get("k") == "local"  # survived the remote clear
+    h.process_all()
+    assert b.get("k") == "local"  # and wins globally once sequenced
+
+
+# ------------------------------------------------------------ SharedDirectory
+
+
+def test_directory_subdirs_and_values_converge():
+    h = make_harness(channels=(("d", DirectoryFactory.type_name),))
+    a, b = h.channel(0, "d"), h.channel(1, "d")
+    a.set("root-key", 1)
+    sub = a.create_subdirectory("sub")
+    sub.set("inner", "v")
+    nested = sub.create_subdirectory("nested")
+    nested.set("deep", [1, 2])
+    h.process_all()
+    for d in (a, b):
+        assert d.get("root-key") == 1
+        w = d.get_working_directory("/sub")
+        assert w.get("inner") == "v"
+        assert d.get_working_directory("/sub/nested").get("deep") == [1, 2]
+
+
+def test_directory_delete_subdirectory():
+    h = make_harness(channels=(("d", DirectoryFactory.type_name),))
+    a, b = h.channel(0, "d"), h.channel(1, "d")
+    a.create_subdirectory("gone").set("k", 1)
+    h.process_all()
+    b.root.delete_subdirectory("gone")
+    h.process_all()
+    assert a.get_subdirectory("gone") is None
+    assert b.get_subdirectory("gone") is None
+
+
+# ---------------------------------------------------------------- SharedCell
+
+
+def test_cell_lww_and_pending_shadow():
+    h = make_harness(channels=(("c", CellFactory.type_name),))
+    a, b = h.channel(0, "c"), h.channel(1, "c")
+    a.set("first")
+    h.process_all()
+    assert b.get() == "first"
+    b.set("second")
+    h.runtimes[1].flush()
+    a.set("third")
+    h.service.process_all()
+    assert a.get() == "third"  # pending local shadows b's sequenced op
+    h.process_all()
+    assert a.get() == "third" and b.get() == "third"
+    a.delete()
+    h.process_all()
+    assert a.is_empty and b.is_empty
+
+
+# -------------------------------------------------------------- SharedCounter
+
+
+def test_counter_concurrent_increments_sum():
+    h = make_harness(n=3, channels=(("n", CounterFactory.type_name),))
+    cs = [h.channel(i, "n") for i in range(3)]
+    cs[0].increment(5)
+    cs[1].increment(-2)
+    cs[2].increment(10)
+    cs[0].increment(1)
+    h.process_all()
+    assert [c.value for c in cs] == [14, 14, 14]
+
+
+def test_counter_rejects_non_int():
+    h = make_harness(channels=(("n", CounterFactory.type_name),))
+    with pytest.raises(TypeError):
+        h.channel(0, "n").increment(1.5)
+
+
+# ------------------------------------------------------------ runtime behavior
+
+
+def test_immediate_flush_mode():
+    h = make_harness(flush_mode=FlushMode.IMMEDIATE)
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("k", 1)
+    # No explicit flush: immediate mode already submitted.
+    h.service.process_all()
+    assert b.get("k") == 1
+
+
+def test_batch_atomicity_metadata():
+    """A turn's ops travel as one marked batch and apply back-to-back
+    (outbox.ts:40 batch markers; scheduleManager.ts:99 atomicity)."""
+    h = make_harness()
+    a = h.channel(0, "m")
+    a.set("x", 1)
+    a.set("y", 2)
+    a.set("z", 3)
+    h.runtimes[0].flush()
+    log = h.service.op_log[h.doc_id]
+    batch_msgs = [m for m in log if isinstance(m.contents, dict)]
+    metas = [m.metadata for m in batch_msgs[-3:]]
+    assert metas[0] == {"batch": True}
+    assert metas[1] is None
+    assert metas[2] == {"batch": False}
+    h.process_all()
+    assert h.channel(1, "m").get("z") == 3
+
+
+def test_runtime_is_dirty_tracking():
+    h = make_harness()
+    rt = h.runtimes[0]
+    a = h.channel(0, "m")
+    assert not rt.is_dirty
+    a.set("k", 1)
+    assert rt.is_dirty  # in outbox
+    rt.flush()
+    assert rt.is_dirty  # pending ack
+    h.process_all()
+    assert not rt.is_dirty
+
+
+def test_pending_echo_mismatch_asserts():
+    h = make_harness()
+    rt = h.runtimes[0]
+    a = h.channel(0, "m")
+    a.set("k", 1)
+    rt.flush()
+    # Corrupt the pending queue to simulate a lost op.
+    rt._pending.clear()
+    with pytest.raises(AssertionError):
+        h.service.process_all()
+
+
+# ------------------------------------------------------- summarize/load boot
+
+
+def test_container_summarize_and_load_roundtrip():
+    h = make_harness(
+        channels=(
+            ("m", MapFactory.type_name),
+            ("d", DirectoryFactory.type_name),
+            ("c", CellFactory.type_name),
+            ("n", CounterFactory.type_name),
+        )
+    )
+    a = h.channel(0, "m")
+    a.set("k", {"nested": True})
+    h.channel(0, "d").create_subdirectory("s").set("i", 7)
+    h.channel(0, "c").set("cv")
+    h.channel(0, "n").increment(3)
+    h.process_all()
+
+    summary = h.runtimes[0].summarize()
+    wire = summary.to_json()
+
+    from fluidframework_tpu.runtime import ContainerRuntime
+    from fluidframework_tpu.runtime.summary import SummaryTree
+
+    rt = ContainerRuntime(REGISTRY)
+    rt.load(SummaryTree.from_json(wire))
+    ds = rt.get_datastore("default")
+    assert ds.get_channel("m").get("k") == {"nested": True}
+    assert (
+        ds.get_channel("d").get_working_directory("/s").get("i") == 7
+    )
+    assert ds.get_channel("c").get() == "cv"
+    assert ds.get_channel("n").value == 3
+    assert rt.current_seq == h.runtimes[0].current_seq
+
+    # The loaded container can join the session and keep collaborating.
+    conn = h.service.connect(h.doc_id, client_id=99)
+    rt.connect(conn)
+    ds.get_channel("n").increment(10)
+    rt.flush()
+    h.process_all()
+    assert ds.get_channel("n").value == 13
+    assert h.channel(1, "n").value == 13

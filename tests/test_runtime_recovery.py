@@ -1,0 +1,173 @@
+"""Regression tests for runtime recovery paths (reconnect, nack,
+catch-up, rollback, dirty-summarize) — the failure-detection /
+elastic-recovery semantics of SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds import CounterFactory, MapFactory, StringFactory
+from fluidframework_tpu.protocol.messages import DocumentMessage, MessageType
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.summary import SummaryTree
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+REGISTRY = ChannelRegistry([MapFactory(), CounterFactory(), StringFactory()])
+
+
+def make_harness(n=2, channels=(("m", MapFactory.type_name),)):
+    return MultiClientHarness(n, REGISTRY, channel_types=list(channels))
+
+
+def test_map_clear_keeps_pending_bookkeeping():
+    """set/clear/set in one turn with an interleaved remote set must
+    converge with the local value winning (mapKernel keeps pending
+    counts across a local clear)."""
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("k", 1)
+    a.clear()
+    a.set("k", 3)
+    h.runtimes[0].flush()
+    b.set("k", 9)
+    h.runtimes[1].flush()
+    h.process_all()
+    # Sequence order: a:set(1), a:clear, a:set(3), b:set(9) → LWW = 9.
+    assert a.get("k") == 9
+    assert b.get("k") == 9
+
+
+def test_map_clear_pending_shadow_remote_between():
+    """Remote op sequenced between our clear and our later set: our set
+    wins (it sequences last) and replicas converge."""
+    h = make_harness()
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    b.set("k", 9)
+    h.runtimes[1].flush()  # b's op sequences first
+    a.set("k", 1)
+    a.clear()
+    a.set("k", 3)
+    h.runtimes[0].flush()
+    h.process_all()
+    assert a.get("k") == 3
+    assert b.get("k") == 3
+
+
+def test_reconnect_resets_client_seq_and_replays_pending():
+    """Disconnect with unacked ops; reconnect under a new client id must
+    restart clientSeq at 1 and replay the pending ops (no 422 nack)."""
+    h = make_harness()
+    rt = h.runtimes[0]
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("before", 1)
+    h.process_all()
+    # Submit and lose the connection before the op is sequenced.
+    a.set("lost", 2)
+    rt.flush()
+    conn = rt.connection
+    # Simulate connection loss: drop the pending op server-side too by
+    # disconnecting before drain (the queued message was already
+    # sequenced in this in-proc service, so instead simulate by
+    # clearing delivery: here we just reconnect — replay must be
+    # harmless/idempotent at the map level since its op will sequence
+    # again under the new identity).
+    conn.disconnect()
+    nacks = []
+    rt.on("nack", nacks.append)
+    rt2_conn = h.service.connect(h.doc_id, client_id=11)
+    rt.connect(rt2_conn)
+    rt.flush()
+    h.process_all()
+    assert not nacks, [n.reason for n in nacks]
+    assert b.get("lost") == 2
+    assert a.get("lost") == 2
+    assert not rt.is_dirty
+
+
+def test_late_joiner_catches_up_from_op_log():
+    """Ops sequenced between a summary and connect() must be fetched
+    (delta catch-up), not silently skipped."""
+    h = make_harness()
+    a = h.channel(0, "m")
+    a.set("k", "v1")
+    h.process_all()
+    wire = h.runtimes[0].summarize().to_json()
+
+    # More traffic after the summary.
+    a.set("k", "v2")
+    a.set("extra", True)
+    h.process_all()
+
+    cold = ContainerRuntime(REGISTRY)
+    cold.load(SummaryTree.from_json(wire))
+    cold.connect(h.service.connect(h.doc_id, client_id=42))
+    m = cold.get_datastore("default").get_channel("m")
+    assert m.get("k") == "v2"  # caught up
+    assert m.get("extra") is True
+    assert cold.current_seq == h.sequencer.seq  # fully caught up
+
+
+def test_summarize_refuses_dirty():
+    h = make_harness()
+    a = h.channel(0, "m")
+    a.set("k", 1)
+    with pytest.raises(RuntimeError, match="pending local changes"):
+        h.runtimes[0].summarize()
+    h.process_all()
+    h.runtimes[0].summarize()  # clean now
+
+
+def test_order_sequentially_rolls_back_and_drops_ops():
+    h = make_harness(channels=(("m", MapFactory.type_name), ("n", CounterFactory.type_name)))
+    rt = h.runtimes[0]
+    m, n = h.channel(0, "m"), h.channel(0, "n")
+    m.set("keep", 1)
+    h.process_all()
+
+    def cb():
+        m.set("keep", 2)
+        m.set("other", 3)
+        n.increment(10)
+        raise ValueError("abort")
+
+    with pytest.raises(ValueError, match="abort"):
+        rt.order_sequentially(cb)
+    # Local state restored...
+    assert m.get("keep") == 1
+    assert not m.has("other")
+    assert n.value == 0
+    # ...and nothing leaks to the wire.
+    h.process_all()
+    assert h.channel(1, "m").get("keep") == 1
+    assert not h.channel(1, "m").has("other")
+    assert h.channel(1, "n").value == 0
+    assert not rt.is_dirty
+
+
+def test_stale_refseq_nack_disconnects_then_reconnect_replays():
+    """A nack drops the connection with pending ops intact (the
+    reference client's response to a deli nack, lambda.ts:967);
+    reconnecting replays them with fresh perspectives and clientSeqs."""
+    h = make_harness()
+    rt = h.runtimes[0]
+    a, b = h.channel(0, "m"), h.channel(1, "m")
+    a.set("x", 1)
+    h.process_all()
+    a.set("y", 2)
+    pm = rt._outbox[0]
+    pm.ref_seq = -5  # simulate a stale perspective
+    nacks = []
+    rt.on("nack", nacks.append)
+    rt.flush()
+    h.process_all()
+    assert len(nacks) == 1 and nacks[0].code == 400
+    assert rt.connection is None  # nack is connection-fatal
+    # Edits while disconnected queue up.
+    a.set("offline", 3)
+    # Reconnect: pending + queued ops replay and converge.
+    rt.connect(h.service.connect(h.doc_id, client_id=21))
+    h.process_all()
+    assert b.get("y") == 2 and b.get("offline") == 3
+    assert a.get("y") == 2 and a.get("x") == 1
+    assert not rt.is_dirty

@@ -1,0 +1,213 @@
+"""SharedString through the full runtime stack + interval collections.
+
+The farm tests (tests/test_farm_convergence.py) already fuzz the
+merge-tree semantics against the sequencer directly; these tests drive
+the same engine through the production ContainerRuntime → DataStore →
+channel path (the reference's dds/sequence test layer, e.g.
+packages/dds/sequence/src/test/sharedString.spec.ts and
+intervalCollection.spec.ts).
+"""
+
+from __future__ import annotations
+
+import random
+import string as _string
+
+import pytest
+
+from fluidframework_tpu.dds import StringFactory
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.summary import SummaryTree
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+REGISTRY = ChannelRegistry([StringFactory()])
+
+
+def make_harness(n=2):
+    return MultiClientHarness(n, REGISTRY, channel_types=[("s", StringFactory.type_name)])
+
+
+def test_basic_insert_remove_converges():
+    h = make_harness()
+    a, b = h.channel(0, "s"), h.channel(1, "s")
+    a.insert_text(0, "hello world")
+    h.process_all()
+    b.insert_text(5, ",")
+    a.remove_text(0, 1)
+    h.process_all()
+    assert a.get_text() == b.get_text() == "ello, world"
+
+
+def test_concurrent_insert_same_position():
+    h = make_harness()
+    a, b = h.channel(0, "s"), h.channel(1, "s")
+    a.insert_text(0, "base")
+    h.process_all()
+    a.insert_text(0, "AA")
+    b.insert_text(0, "BB")
+    h.process_all()
+    # a's op sequences first; b's later op wins position 0 (breakTie:
+    # later seq beats earlier at the same spot).
+    assert a.get_text() == b.get_text() == "BBAAbase"
+
+
+def test_annotate_and_markers():
+    h = make_harness()
+    a, b = h.channel(0, "s"), h.channel(1, "s")
+    a.insert_text(0, "styled text")
+    h.process_all()
+    b.annotate_range(0, 6, {"bold": True})
+    a.insert_marker(0, ref_type=1, props={"tag": "pg"})
+    h.process_all()
+    assert a.get_text() == b.get_text() == "styled text"
+    assert len(a.get_markers()) == len(b.get_markers()) == 1
+    assert a.annotated_spans() == b.annotated_spans()
+
+
+def test_overlapping_concurrent_removes():
+    h = make_harness(3)
+    chans = [h.channel(i, "s") for i in range(3)]
+    chans[0].insert_text(0, "abcdefghij")
+    h.process_all()
+    chans[0].remove_text(2, 6)
+    chans[1].remove_text(4, 8)
+    chans[2].insert_text(5, "XY")
+    h.process_all()
+    texts = {c.get_text() for c in chans}
+    assert len(texts) == 1, texts
+
+
+def test_random_farm_through_runtime():
+    """Seeded random op mix over 3 clients through the real stack —
+    the conflictFarm shape (client.conflictFarm.spec.ts) with the
+    production runtime in the loop."""
+    h = make_harness(3)
+    chans = [h.channel(i, "s") for i in range(3)]
+    chans[0].insert_text(0, "initial text here")
+    h.process_all()
+    rng = random.Random(42)
+    for _ in range(30):
+        for c in chans:
+            n = len(c.get_text())
+            r = rng.random()
+            if r < 0.5 or n == 0:
+                pos = rng.randint(0, n)
+                txt = "".join(
+                    rng.choice(_string.ascii_lowercase) for _ in range(rng.randint(1, 5))
+                )
+                c.insert_text(pos, txt)
+            elif r < 0.8:
+                s = rng.randint(0, n - 1)
+                e = rng.randint(s + 1, min(n, s + 6))
+                c.remove_text(s, e)
+            else:
+                s = rng.randint(0, n - 1)
+                e = rng.randint(s + 1, min(n, s + 6))
+                c.annotate_range(s, e, {"k": rng.randint(0, 3)})
+        h.process_all()
+    final = {c.get_text() for c in chans}
+    assert len(final) == 1, final
+    spans = {tuple(map(repr, c.annotated_spans())) for c in chans}
+    assert len(spans) == 1
+
+
+# ------------------------------------------------------------- intervals
+
+
+def test_interval_add_and_slide_on_remove():
+    h = make_harness()
+    a, b = h.channel(0, "s"), h.channel(1, "s")
+    a.insert_text(0, "0123456789")
+    h.process_all()
+    coll = a.get_interval_collection("comments")
+    iv = coll.add(3, 7, {"author": "a"})
+    h.process_all()
+    b_coll = b.get_interval_collection("comments")
+    assert len(b_coll) == 1
+    b_iv = b_coll.get_interval_by_id(iv.interval_id)
+    assert b_iv.bounds(b.engine) == (3, 7)
+    assert b_iv.props == {"author": "a"}
+    # Remove a range containing the start anchor: it slides forward.
+    b.remove_text(2, 5)
+    h.process_all()
+    assert a.get_text() == "0156789"
+    assert iv.bounds(a.engine) == (2, 4)
+    assert b_iv.bounds(b.engine) == (2, 4)
+
+
+def test_interval_change_and_delete():
+    h = make_harness()
+    a, b = h.channel(0, "s"), h.channel(1, "s")
+    a.insert_text(0, "abcdefgh")
+    h.process_all()
+    coll = a.get_interval_collection("x")
+    iv = coll.add(1, 3)
+    h.process_all()
+    coll.change(iv.interval_id, 4, 6)
+    h.process_all()
+    b_iv = b.get_interval_collection("x").get_interval_by_id(iv.interval_id)
+    assert b_iv.bounds(b.engine) == (4, 6)
+    coll.remove_interval_by_id(iv.interval_id)
+    h.process_all()
+    assert len(b.get_interval_collection("x")) == 0
+
+
+def test_interval_endpoints_track_inserts():
+    h = make_harness()
+    a, b = h.channel(0, "s"), h.channel(1, "s")
+    a.insert_text(0, "hello world")
+    h.process_all()
+    iv = a.get_interval_collection("c").add(6, 11)  # "world"
+    h.process_all()
+    b.insert_text(0, ">>> ")
+    h.process_all()
+    assert a.get_text() == ">>> hello world"
+    assert iv.bounds(a.engine) == (10, 15)
+    b_iv = b.get_interval_collection("c").get_interval_by_id(iv.interval_id)
+    assert b_iv.bounds(b.engine) == (10, 15)
+
+
+# --------------------------------------------------------- summarize/load
+
+
+def test_string_summary_roundtrip_with_intervals():
+    h = make_harness()
+    a = h.channel(0, "s")
+    a.insert_text(0, "persistent content")
+    a.annotate_range(0, 10, {"bold": True})
+    a.get_interval_collection("marks").add(2, 8, {"note": 1})
+    h.process_all()
+
+    wire = h.runtimes[0].summarize().to_json()
+    rt = ContainerRuntime(REGISTRY)
+    rt.load(SummaryTree.from_json(wire))
+    s = rt.get_datastore("default").get_channel("s")
+    assert s.get_text() == "persistent content"
+    assert s.annotated_spans() == a.annotated_spans()
+    iv = list(s.get_interval_collection("marks"))[0]
+    assert iv.bounds(s.engine) == (2, 8)
+    assert iv.props == {"note": 1}
+
+    # Rejoin the session and keep editing.
+    rt.connect(h.service.connect(h.doc_id, client_id=50))
+    s.insert_text(0, "! ")
+    rt.flush()
+    h.process_all()
+    assert s.get_text() == "! persistent content"
+    assert h.channel(1, "s").get_text() == "! persistent content"
+
+
+def test_detached_edits_then_attach_summary():
+    """Detached-container workflow: edit before any connection, then
+    boot a second runtime from the attach summary (reference
+    Container.createDetached → attach, container.ts:376,1056)."""
+    rt = ContainerRuntime(REGISTRY)
+    ds = rt.create_datastore("default")
+    s = ds.create_channel("s", StringFactory.type_name)
+    s.insert_text(0, "offline draft")
+    s.remove_text(0, 3)
+    assert s.get_text() == "line draft"
+    wire = rt.summarize().to_json()
+    rt2 = ContainerRuntime(REGISTRY)
+    rt2.load(SummaryTree.from_json(wire))
+    assert rt2.get_datastore("default").get_channel("s").get_text() == "line draft"
