@@ -67,7 +67,7 @@ class VisCategory(enum.IntEnum):
     VISIBLE = 2
 
 
-@dataclass
+@dataclass(eq=False)
 class Segment:
     """One run of content with its merge metadata (reference ISegment,
     mergeTreeNodes.ts:126)."""
@@ -123,7 +123,7 @@ class Segment:
         return tail
 
 
-@dataclass
+@dataclass(eq=False)
 class LocalReference:
     """A position anchored to a segment + offset that tracks edits
     (reference LocalReferencePosition,
@@ -151,7 +151,7 @@ def _eff_seq(seq: int) -> int:
     return seq
 
 
-@dataclass
+@dataclass(eq=False)
 class _PendingGroup:
     """One local op's segment group awaiting ack (reference SegmentGroup)."""
 
@@ -490,6 +490,118 @@ class MergeTreeEngine:
                                 del seg.pending_props[key]
                             else:
                                 seg.pending_props[key] = cnt - 1
+
+    # ------------------------------------------------- reconnect / rebase
+
+    def _group_index(self, seg: Segment, kind: "MergeTreeDeltaType"):
+        for g in seg.groups:
+            if g.kind == kind:
+                try:
+                    return list(self.pending).index(g)
+                except ValueError:
+                    return None
+        return None
+
+    def _reg_vis_len(self, seg: Segment, idx: int) -> int:
+        """Visible length of `seg` at the perspective a regenerated op
+        (pending-FIFO position `idx`) will be applied at by remote
+        replicas: everything sequenced plus our earlier pending groups
+        (they sequence first), excluding our later pending state."""
+        if seg.seq == UNASSIGNED_SEQ:
+            gi = self._group_index(seg, MergeTreeDeltaType.INSERT)
+            if gi is None or gi >= idx:
+                return 0  # not yet sequenced when this op applies
+        if seg.removed_seq is not None:
+            if seg.removed_seq != UNASSIGNED_SEQ:
+                return 0  # sequenced removal: tombstone at any future refSeq
+            gi = self._group_index(seg, MergeTreeDeltaType.REMOVE)
+            if gi is not None and gi < idx:
+                return 0  # earlier pending remove sequences first
+        return len(seg)
+
+    def regenerate_pending_op(
+        self, grp: "_PendingGroup", original: "MergeTreeOp"
+    ) -> Optional["MergeTreeOp"]:
+        """Rebase a pending local op against current state for
+        resubmission after reconnect (reference
+        Client.regeneratePendingOp / normalizeSegmentsOnRebase,
+        client.ts:917): positions are recomputed from the pending
+        group's segments, because remote edits sequenced since the op
+        was created may have shifted them. Range ops whose segments
+        became non-contiguous regenerate as a GroupOp of per-segment
+        ops (and their pending group splits to match, so the single
+        sequenced ack of the GroupOp pops one group per sub-op).
+
+        Returns the op to resubmit, or None if nothing remains (the
+        pending group is dropped from the FIFO in that case).
+        """
+        order = list(self.pending)
+        idx = order.index(grp)
+        seg_pos = {id(s): i for i, s in enumerate(self.segments)}
+        segs = sorted(
+            [s for s in grp.segments if id(s) in seg_pos],
+            key=lambda s: seg_pos[id(s)],
+        )
+        # Segments may have been stamped under a previous connection's
+        # client id; the op resubmits under the current identity.
+        for s in segs:
+            s.client_id = self.local_client_id
+
+        def base_pos(target: Segment) -> int:
+            total = 0
+            for s in self.segments:
+                if s is target:
+                    return total
+                total += self._reg_vis_len(s, idx)
+            raise AssertionError("pending segment not in segment list")
+
+        if grp.kind == MergeTreeDeltaType.INSERT:
+            if not segs:
+                self.pending.remove(grp)
+                return None
+            text_parts = [s.content for s in segs]
+            content = (
+                "".join(text_parts)
+                if isinstance(text_parts[0], str)
+                else [x for part in text_parts for x in part]
+            )
+            props = original.props if isinstance(original, InsertOp) else None
+            pos = base_pos(segs[0])
+            if isinstance(content, str):
+                return InsertOp(pos=pos, text=content, props=props)
+            return InsertOp(pos=pos, seg=content, props=props)
+
+        if not segs:
+            self.pending.remove(grp)
+            return None
+
+        # Split the group: one per-segment group in place of the original.
+        at = idx
+        self.pending.remove(grp)
+        new_groups = []
+        for s in segs:
+            g = _PendingGroup(kind=grp.kind, props=grp.props, local_seq=grp.local_seq)
+            g.segments.append(s)
+            s.groups = [x for x in s.groups if x is not grp] + [g]
+            new_groups.append(g)
+        for offset, g in enumerate(new_groups):
+            self.pending.insert(at + offset, g)
+
+        ops: List[MergeTreeOp] = []
+        removed_before = 0
+        for s in segs:
+            start = base_pos(s) - removed_before
+            end = start + len(s)
+            if grp.kind == MergeTreeDeltaType.REMOVE:
+                ops.append(RemoveOp(start=start, end=end))
+                removed_before += len(s)
+            else:
+                ops.append(
+                    AnnotateOp(start=start, end=end, props=dict(grp.props or {}))
+                )
+        if len(ops) == 1:
+            return ops[0]
+        return GroupOp(ops=ops)
 
     # --------------------------------------------------- local references
 

@@ -17,6 +17,7 @@ Every DDS subclasses `runtime.SharedObject` and registers a
 from .map import MapFactory, SharedMap, DirectoryFactory, SharedDirectory
 from .cell import CellFactory, SharedCell
 from .counter import CounterFactory, SharedCounter
+from .matrix import MatrixFactory, SharedMatrix
 from .sequence import (
     IntervalCollection,
     Marker,
@@ -33,6 +34,8 @@ __all__ = [
     "IntervalCollection",
     "MapFactory",
     "Marker",
+    "MatrixFactory",
+    "SharedMatrix",
     "SequenceInterval",
     "SharedCell",
     "SharedCounter",

@@ -1,0 +1,264 @@
+"""SharedMatrix: a collaborative 2D grid.
+
+Mirrors packages/dds/matrix (src/matrix.ts:80): the row order and the
+column order are each a *merge-tree replica* over opaque handles
+(`PermutationVector extends Client`, src/permutationvector.ts:151) —
+inserting/removing rows or columns is a sequence insert/remove, reusing
+all of the merge-tree's conflict resolution; cells live in a sparse
+store keyed by (row_handle, col_handle) (src/sparsearray2d.ts:57) so
+cell values survive row/column moves without rewrites.
+
+Handles are replica-local storage names (each replica allocates its
+own); convergence is judged on the (position → value) mapping, exactly
+as the reference.
+
+setCell conflict policy: last sequenced writer wins with pending-local
+shadowing per cell (reference matrix conflict-resolution; the
+productSet/bspSet machinery for undo-aware set semantics is not yet
+ported — see framework undo-redo task).
+
+Wire ops (`contents`):
+- {"type": "insertRows"/"removeRows"/"insertCols"/"removeCols",
+   "pos": p, "count": n}
+- {"type": "setCell", "row": r, "col": c, "value": v}  (positions at
+   the sender's perspective)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.mergetree import MergeTreeEngine, Segment, VisCategory
+from ..protocol.constants import NON_COLLAB_CLIENT, UNASSIGNED_SEQ, UNIVERSAL_SEQ
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+class PermutationVector:
+    """One axis's order: a merge-tree over handle items
+    (reference PermutationVector, permutationvector.ts:151)."""
+
+    def __init__(self):
+        self.engine = MergeTreeEngine(local_client_id=NON_COLLAB_CLIENT)
+        self._next_handle = 0
+
+    def alloc(self, count: int) -> List[int]:
+        out = list(range(self._next_handle, self._next_handle + count))
+        self._next_handle += count
+        return out
+
+    # ---- perspective-resolved queries
+
+    def handle_at(self, pos: int, ref_seq: int, client_id: int) -> int:
+        """The handle at visible position `pos` of a perspective."""
+        remaining = pos
+        for seg in self.engine.segments:
+            cat, length = self.engine._vis(seg, ref_seq, client_id)
+            if cat == VisCategory.SKIP or length == 0:
+                continue
+            if remaining < length:
+                return seg.content[remaining]
+            remaining -= length
+        raise IndexError(f"position {pos} beyond visible length")
+
+    def local_handle_at(self, pos: int) -> int:
+        return self.handle_at(
+            pos, self.engine.current_seq, self.engine.local_client_id
+        )
+
+    def length(self) -> int:
+        return self.engine.visible_length(
+            self.engine.current_seq, self.engine.local_client_id
+        )
+
+    def handles(self) -> List[int]:
+        return self.engine.get_items()
+
+
+class SharedMatrix(SharedObject):
+    def initialize_local_core(self) -> None:
+        self.rows = PermutationVector()
+        self.cols = PermutationVector()
+        self._cells: Dict[Tuple[int, int], Any] = {}
+        self._pending_cells: Dict[Tuple[int, int], int] = {}
+
+    def on_connected(self) -> None:
+        cid = self.runtime.client_id
+        for pv in (self.rows, self.cols):
+            pv.engine.local_client_id = cid
+            pv.engine.collaborating = True
+            pv.engine.current_seq = self.runtime.container.current_seq
+
+    # --------------------------------------------------------------- shape
+
+    @property
+    def row_count(self) -> int:
+        return self.rows.length()
+
+    @property
+    def col_count(self) -> int:
+        return self.cols.length()
+
+    def _axis_insert(self, pv: PermutationVector, pos: int, count: int, op_type: str) -> None:
+        handles = pv.alloc(count)
+        eng = pv.engine
+        if eng.collaborating:
+            eng.insert(pos, handles, eng.current_seq, eng.local_client_id, UNASSIGNED_SEQ)
+            self.submit_local_message({"type": op_type, "pos": pos, "count": count})
+        else:
+            eng.insert(pos, handles, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ)
+
+    def _axis_remove(self, pv: PermutationVector, pos: int, count: int, op_type: str) -> None:
+        eng = pv.engine
+        if eng.collaborating:
+            eng.remove_range(pos, pos + count, eng.current_seq, eng.local_client_id, UNASSIGNED_SEQ)
+            self.submit_local_message({"type": op_type, "pos": pos, "count": count})
+        else:
+            eng.remove_range(pos, pos + count, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ)
+
+    def insert_rows(self, pos: int, count: int = 1) -> None:
+        self._axis_insert(self.rows, pos, count, "insertRows")
+
+    def remove_rows(self, pos: int, count: int = 1) -> None:
+        self._axis_remove(self.rows, pos, count, "removeRows")
+
+    def insert_cols(self, pos: int, count: int = 1) -> None:
+        self._axis_insert(self.cols, pos, count, "insertCols")
+
+    def remove_cols(self, pos: int, count: int = 1) -> None:
+        self._axis_remove(self.cols, pos, count, "removeCols")
+
+    # --------------------------------------------------------------- cells
+
+    def get_cell(self, row: int, col: int) -> Any:
+        key = (self.rows.local_handle_at(row), self.cols.local_handle_at(col))
+        return self._cells.get(key)
+
+    def set_cell(self, row: int, col: int, value: Any) -> None:
+        key = (self.rows.local_handle_at(row), self.cols.local_handle_at(col))
+        self._cells[key] = value
+        if self.rows.engine.collaborating:
+            self._pending_cells[key] = self._pending_cells.get(key, 0) + 1
+            self.submit_local_message(
+                {"type": "setCell", "row": row, "col": col, "value": value},
+                {"key": key},
+            )
+        self.emit("cellChanged", row, col, True)
+
+    def to_dense(self) -> List[List[Any]]:
+        """The visible grid (row-major), for assertions and export."""
+        rh = self.rows.handles()
+        ch = self.cols.handles()
+        return [[self._cells.get((r, c)) for c in ch] for r in rh]
+
+    # --------------------------------------------------------------- apply
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        kind = op["type"]
+        if kind == "setCell":
+            if local:
+                key = local_metadata["key"]
+                n = self._pending_cells.get(key, 0) - 1
+                if n <= 0:
+                    self._pending_cells.pop(key, None)
+                else:
+                    self._pending_cells[key] = n
+            else:
+                key = (
+                    self.rows.handle_at(op["row"], msg.ref_seq, msg.client_id),
+                    self.cols.handle_at(op["col"], msg.ref_seq, msg.client_id),
+                )
+                if self._pending_cells.get(key, 0) == 0:
+                    self._cells[key] = op["value"]
+                    self.emit("cellChanged", op["row"], op["col"], False)
+        else:
+            pv = self.rows if "Rows" in kind else self.cols
+            eng = pv.engine
+            if local:
+                eng.ack(msg.sequence_number)
+            elif kind.startswith("insert"):
+                eng.insert(
+                    op["pos"], pv.alloc(op["count"]), msg.ref_seq,
+                    msg.client_id, msg.sequence_number,
+                )
+            else:
+                eng.remove_range(
+                    op["pos"], op["pos"] + op["count"], msg.ref_seq,
+                    msg.client_id, msg.sequence_number,
+                )
+        # Advance both axes' collaboration windows.
+        for pv in (self.rows, self.cols):
+            pv.engine.current_seq = msg.sequence_number
+            pv.engine.update_min_seq(
+                max(pv.engine.min_seq, msg.minimum_sequence_number)
+            )
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        op = content
+        kind = op["type"]
+        if kind == "setCell":
+            self.set_cell(op["row"], op["col"], op["value"])
+        elif kind == "insertRows":
+            self.insert_rows(op["pos"], op["count"])
+        elif kind == "removeRows":
+            self.remove_rows(op["pos"], op["count"])
+        elif kind == "insertCols":
+            self.insert_cols(op["pos"], op["count"])
+        elif kind == "removeCols":
+            self.remove_cols(op["pos"], op["count"])
+        return None
+
+    # ----------------------------------------------------------- summaries
+
+    def summarize_core(self):
+        """Positional snapshot of the visible grid (reference matrix
+        snapshot: permutation vectors + cell payload). Unsettled merge
+        metadata inside the collab window is not persisted — summaries
+        are taken on quiescent replicas (ContainerRuntime refuses dirty
+        summarize)."""
+        dense = self.to_dense()
+        cells = [
+            [r, c, row_vals[c]]
+            for r, row_vals in enumerate(dense)
+            for c in range(len(row_vals))
+            if row_vals[c] is not None
+        ]
+        header = {
+            "rowCount": self.row_count,
+            "colCount": self.col_count,
+            "currentSeq": self.rows.engine.current_seq,
+            "minSeq": self.rows.engine.min_seq,
+        }
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob("header", header)
+            .add_json_blob("cells", cells)
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        header = json.loads(storage.read("header"))
+        for pv, n in ((self.rows, header["rowCount"]), (self.cols, header["colCount"])):
+            pv.engine.current_seq = header["currentSeq"]
+            pv.engine.min_seq = header["minSeq"]
+            if n:
+                pv.engine.segments.append(
+                    Segment(
+                        content=pv.alloc(n),
+                        seq=UNIVERSAL_SEQ,
+                        client_id=NON_COLLAB_CLIENT,
+                    )
+                )
+        rh, ch = self.rows.handles(), self.cols.handles()
+        for r, c, v in json.loads(storage.read("cells")):
+            self._cells[(rh[r], ch[c])] = v
+
+
+class MatrixFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/sharedmatrix"
+    channel_class = SharedMatrix

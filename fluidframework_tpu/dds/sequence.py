@@ -83,7 +83,27 @@ class SharedSegmentSequence(SharedObject):
     # -------------------------------------------------------- local edits
 
     def _submit_seq_op(self, op: MergeTreeOp) -> None:
-        self.submit_local_message({"kind": "seq", "op": op})
+        # Local metadata = the engine's pending group for this op, so
+        # the reconnect path can rebase (regeneratePendingOp).
+        grp = self.engine.pending[-1] if self.engine.pending else None
+        self.submit_local_message({"kind": "seq", "op": op}, grp)
+
+    def resubmit(self, content: Any, local_metadata: Any) -> None:
+        """Reconnect replay: rebase the pending op against current
+        state before resubmitting (reference reSubmitCore →
+        Client.regeneratePendingOp, client.ts:917)."""
+        if not (isinstance(content, dict) and content.get("kind") == "seq"):
+            self.submit_local_message(content, local_metadata)
+            return
+        grp = local_metadata
+        if grp is None or grp not in self.engine.pending:
+            return  # already sequenced during catch-up: nothing to send
+        op = content["op"]
+        if isinstance(op, dict):
+            op = op_from_json(op)
+        regenerated = self.engine.regenerate_pending_op(grp, op)
+        if regenerated is not None:
+            self.submit_local_message({"kind": "seq", "op": regenerated}, grp)
 
     def _local_perspective(self):
         return self.engine.current_seq, self.engine.local_client_id

@@ -1,0 +1,163 @@
+"""Quorum and protocol-op handling (shared by client and server).
+
+Mirrors the reference's `protocol-base` package (shared the same way:
+server/routerlicious/packages/protocol-base, used by both the loader's
+protocol state and scribe): `QuorumClients` (quorum.ts:60) tracks the
+connected-client set; `QuorumProposals` (quorum.ts:142) tracks
+proposals, which commit when the MSN passes the proposal's sequence
+number (every connected client has seen it); `ProtocolOpHandler`
+(protocol.ts:68, processMessage :109) folds the protocol message types
+(join/leave/propose) into that state.
+
+The canonical use is the "code" proposal (which runtime package a
+container runs), but any key/value can be proposed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.events import EventEmitter
+from .messages import MessageType, SequencedMessage
+
+
+@dataclass
+class QuorumClient:
+    client_id: int
+    joined_seq: int
+    detail: Any = None  # IClient payload (user identity, capabilities)
+
+
+@dataclass
+class _Proposal:
+    key: str
+    value: Any
+    seq: int  # sequence number of the propose message
+    proposer: int
+
+
+class QuorumClients(EventEmitter):
+    """Connected-client set keyed by client id (quorum.ts:60)."""
+
+    def __init__(self):
+        super().__init__()
+        self.members: Dict[int, QuorumClient] = {}
+
+    def add(self, client_id: int, joined_seq: int, detail: Any = None) -> None:
+        self.members[client_id] = QuorumClient(client_id, joined_seq, detail)
+        self.emit("addMember", client_id)
+
+    def remove(self, client_id: int) -> None:
+        if self.members.pop(client_id, None) is not None:
+            self.emit("removeMember", client_id)
+
+    def oldest(self) -> Optional[QuorumClient]:
+        """Lowest join seq — the basis of summarizer election
+        (OrderedClientElection)."""
+        if not self.members:
+            return None
+        return min(self.members.values(), key=lambda c: (c.joined_seq, c.client_id))
+
+    def __contains__(self, client_id: int) -> bool:
+        return client_id in self.members
+
+    def __len__(self) -> int:
+        return len(self.members)
+
+
+class QuorumProposals(EventEmitter):
+    """Pending + committed proposals (quorum.ts:142). A proposal
+    commits when the MSN reaches its sequence number."""
+
+    def __init__(self):
+        super().__init__()
+        self.pending: List[_Proposal] = []
+        self.values: Dict[str, Tuple[Any, int]] = {}  # key -> (value, commit seq)
+
+    def add(self, key: str, value: Any, seq: int, proposer: int) -> None:
+        self.pending.append(_Proposal(key, value, seq, proposer))
+
+    def update_msn(self, msn: int) -> None:
+        ready = [p for p in self.pending if p.seq <= msn]
+        if not ready:
+            return
+        self.pending = [p for p in self.pending if p.seq > msn]
+        for p in ready:
+            self.values[p.key] = (p.value, p.seq)
+            self.emit("approveProposal", p.key, p.value, p.seq)
+
+    def get(self, key: str) -> Any:
+        entry = self.values.get(key)
+        return entry[0] if entry else None
+
+
+class ProtocolOpHandler:
+    """Folds protocol messages into quorum state (protocol.ts:68)."""
+
+    def __init__(self, current_seq: int = 0, min_seq: int = 0):
+        self.quorum = QuorumClients()
+        self.proposals = QuorumProposals()
+        self.current_seq = current_seq
+        self.min_seq = min_seq
+
+    def process_message(self, msg: SequencedMessage) -> None:
+        """protocol.ts:109 processMessage."""
+        if msg.type == MessageType.CLIENT_JOIN:
+            detail = None
+            client_id = msg.contents
+            if isinstance(msg.contents, dict):
+                client_id = msg.contents.get("clientId", msg.client_id)
+                detail = msg.contents.get("detail")
+            self.quorum.add(client_id, msg.sequence_number, detail)
+        elif msg.type == MessageType.CLIENT_LEAVE:
+            client_id = msg.contents
+            if isinstance(msg.contents, dict):
+                client_id = msg.contents.get("clientId", msg.client_id)
+            self.quorum.remove(client_id)
+        elif msg.type == MessageType.PROPOSE:
+            # Malformed proposals are ignored rather than poisoning the
+            # op stream for every replica (a single bad message must
+            # not halt processing).
+            if (
+                isinstance(msg.contents, dict)
+                and "key" in msg.contents
+                and "value" in msg.contents
+            ):
+                self.proposals.add(
+                    msg.contents["key"], msg.contents["value"],
+                    msg.sequence_number, msg.client_id,
+                )
+        self.current_seq = msg.sequence_number
+        self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+        self.proposals.update_msn(self.min_seq)
+
+    # ------------------------------------------------------------ state
+
+    def snapshot(self) -> dict:
+        """Serializable protocol state (the .protocol summary subtree,
+        blobs.ts/scribeHelper.ts roles)."""
+        return {
+            "sequenceNumber": self.current_seq,
+            "minimumSequenceNumber": self.min_seq,
+            "members": [
+                [c.client_id, {"joined_seq": c.joined_seq, "detail": c.detail}]
+                for c in self.quorum.members.values()
+            ],
+            "values": [[k, [v, s]] for k, (v, s) in self.proposals.values.items()],
+            "proposals": [
+                [p.seq, {"key": p.key, "value": p.value, "proposer": p.proposer}]
+                for p in self.proposals.pending
+            ],
+        }
+
+    @classmethod
+    def from_snapshot(cls, data: dict) -> "ProtocolOpHandler":
+        h = cls(data["sequenceNumber"], data["minimumSequenceNumber"])
+        for cid, info in data["members"]:
+            h.quorum.add(cid, info["joined_seq"], info["detail"])
+        for k, (v, s) in data["values"]:
+            h.proposals.values[k] = (v, s)
+        for seq, p in data["proposals"]:
+            h.proposals.add(p["key"], p["value"], seq, p["proposer"])
+        return h

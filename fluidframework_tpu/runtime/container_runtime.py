@@ -25,6 +25,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
 from ..protocol.messages import DocumentMessage, MessageType, NackMessage, SequencedMessage
+from ..protocol.quorum import ProtocolOpHandler
 from ..utils.events import EventEmitter
 from .channel import ChannelRegistry
 from .datastore import DataStoreRuntime
@@ -61,6 +62,10 @@ class _PendingMessage:
     # Perspective at op creation (the reference stamps refSeq when the
     # message is created, not when the batch flushes).
     ref_seq: int = 0
+    # Identity the op was submitted under: after a reconnect the echo
+    # arrives carrying the OLD client id, and must still match
+    # (pendingStateManager matches on the recorded identity).
+    client_id: Optional[int] = None
 
 
 class ContainerRuntime(EventEmitter):
@@ -92,6 +97,22 @@ class ContainerRuntime(EventEmitter):
         self._in_batch = False
         self._rollback_log: Optional[List[_PendingMessage]] = None
         self._ever_connected = False
+        # Protocol state: quorum membership + MSN-committed proposals
+        # (the loader's initializeProtocolState role, container.ts:1697).
+        self.protocol = ProtocolOpHandler()
+        # GC driver (attach_gc); its state rides the summary.
+        self.gc = None
+
+    def attach_gc(self, sweep_grace: int = 0):
+        """Enable garbage collection for this container (the reference
+        enables GC via IContainerRuntimeOptions.gcOptions)."""
+        from .gc import GarbageCollector
+
+        if self.gc is None:
+            self.gc = GarbageCollector(self, sweep_grace=sweep_grace)
+        else:
+            self.gc.sweep_grace = sweep_grace
+        return self.gc
 
     _emit = EventEmitter.emit
 
@@ -103,7 +124,10 @@ class ContainerRuntime(EventEmitter):
 
     # --------------------------------------------------------- datastores
 
-    def create_datastore(self, datastore_id: str) -> DataStoreRuntime:
+    def create_datastore(self, datastore_id: str, root: bool = True) -> DataStoreRuntime:
+        """`root=False` datastores survive only while a handle to them
+        (or to one of their channels) is reachable from a root — the
+        reference's createDataStore vs createRootDataStore split."""
         if datastore_id in self.datastores:
             raise KeyError(f"datastore {datastore_id!r} exists")
         ds = DataStoreRuntime(
@@ -114,6 +138,7 @@ class ContainerRuntime(EventEmitter):
             ),
         )
         ds.container = self
+        ds.is_root = root
         self.datastores[datastore_id] = ds
         return ds
 
@@ -126,30 +151,37 @@ class ContainerRuntime(EventEmitter):
         """Go live on an ordering-service connection: catch up on the
         op gap since our last known seq, attach all datastores'
         channels, and replay pending ops if reconnecting."""
-        had_pending = list(self._pending)
-        self._pending.clear()
         self.connection = connection
         self._ever_connected = True
         self.client_id = connection.client_id
         # Fresh connection = fresh server-side clientSeq expectation
         # (the sequencer's join resets the per-client counter).
         self._client_seq = 0
-        connection.listener = self.process
         if hasattr(connection, "nack_listener"):
             connection.nack_listener = self._on_nack
-        # Delta catch-up: fetch ops sequenced between our last applied
-        # seq and the join point (Container.load attachOpHandler +
-        # DeltaManager catch-up, SURVEY.md §3.4). Live delivery starts
-        # strictly after the join, so the two sources never overlap.
+        for ds in self.datastores.values():
+            ds.attach_all()
+        # Delta catch-up BEFORE replaying pending: ops that *did*
+        # sequence under the previous connection arrive here carrying
+        # the old identity and ack their pending entries, so they are
+        # not resubmitted (double-apply). (Container.load
+        # attachOpHandler + DeltaManager catch-up, SURVEY.md §3.4.)
         if hasattr(connection, "catch_up"):
             for msg in connection.catch_up(self.current_seq):
                 self.process(msg)
-        for ds in self.datastores.values():
-            ds.attach_all()
-        # Reconnect: replay unacked ops through each channel's resubmit
+        # Attach the live listener only after catch-up: ops sequenced
+        # in between were buffered by the connection and drain, in
+        # order, on assignment.
+        connection.listener = self.process
+        # Replay what's still unacked — both flushed-but-unacked
+        # (_pending) and never-flushed (_outbox, whose recorded
+        # perspectives are stale) — through each channel's resubmit
         # path (PendingStateManager.replayPendingStates →
         # DDS reSubmitCore; merge-trees rebase, client.ts:917).
-        for pm in had_pending:
+        replay = list(self._pending) + list(self._outbox)
+        self._pending.clear()
+        self._outbox.clear()
+        for pm in replay:
             ds = self.datastores[pm.envelope.datastore]
             ds.resubmit(pm.envelope.channel, pm.envelope.contents, pm.local_metadata)
         self.flush()
@@ -210,6 +242,7 @@ class ContainerRuntime(EventEmitter):
                     meta = {"batch": False}
             self._client_seq += 1
             pm.client_seq = self._client_seq
+            pm.client_id = self.client_id
             pm.batch_meta = meta
             self._pending.append(pm)
             self.connection.submit(
@@ -284,27 +317,72 @@ class ContainerRuntime(EventEmitter):
     def _process_one(self, msg: SequencedMessage) -> None:
         self.current_seq = msg.sequence_number
         self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+        # Every message advances protocol state: join/leave/propose
+        # mutate the quorum, and any MSN advance can commit proposals
+        # (the reference routes all messages through ProtocolOpHandler).
+        self.protocol.process_message(msg)
         if msg.type != MessageType.OP or not isinstance(msg.contents, dict):
             self._emit("op", msg, False)
             return
-        local = msg.client_id == self.client_id
+        # Local iff it matches the head of the pending FIFO by the
+        # identity it was SUBMITTED under (not the current connection's:
+        # an op sequenced just before a disconnect echoes with the old
+        # client id during catch-up — PendingStateManager matches on
+        # the recorded identity, pendingStateManager.ts:75).
+        head = self._pending[0] if self._pending else None
+        local = (
+            head is not None
+            and head.client_id == msg.client_id
+            and head.client_seq == msg.client_seq
+        )
         local_metadata = None
         if local:
-            # Match the sequenced echo against the pending FIFO
-            # (PendingStateManager.processPendingLocalMessage).
-            assert self._pending, "sequenced local op with empty pending queue"
             pm = self._pending.popleft()
-            assert pm.client_seq == msg.client_seq, (
-                f"pending clientSeq {pm.client_seq} != echoed {msg.client_seq}"
-            )
             local_metadata = pm.local_metadata
+        elif msg.client_id == self.client_id:
+            raise AssertionError(
+                f"own op seq={msg.sequence_number} clientSeq={msg.client_seq} "
+                "does not match pending head"
+            )
         outer = msg.contents
         inner = outer["contents"]
-        ds = self.datastores[outer["address"]]
+        ds = self.datastores.get(outer["address"])
+        if ds is None or inner["address"] not in ds.channels:
+            node = f"/{outer['address']}" if ds is None else (
+                f"/{outer['address']}/{inner['address']}"
+            )
+            if self.gc is not None and node in self.gc.tombstoned:
+                # Straggler op to a swept node: absorbed (tombstone
+                # semantics, gc/garbageCollection.md).
+                self._emit("gcTombstoneOp", node, msg)
+                return
+            raise KeyError(f"op addressed to unknown node {node}")
         ds.process(inner["address"], _reshape(msg, inner["contents"]), local, local_metadata)
         self._emit("op", msg, local)
         if not self.is_dirty:
             self._emit("saved")
+
+    def submit_system_message(self, type_: MessageType, contents: Any) -> None:
+        """Submit a non-op protocol message (summarize, propose, noop)
+        on this client's sequence-number stream. These don't enter the
+        pending-op FIFO — their sequenced echo carries no datastore
+        routing."""
+        if self.connection is None:
+            raise RuntimeError("not connected")
+        self._client_seq += 1
+        self.connection.submit(
+            DocumentMessage(
+                client_seq=self._client_seq,
+                ref_seq=self.current_seq,
+                type=type_,
+                contents=contents,
+            )
+        )
+
+    def propose(self, key: str, value: Any) -> None:
+        """Propose a quorum value (Quorum.propose, quorum.ts:142); it
+        commits when the MSN passes the proposal (all clients saw it)."""
+        self.submit_system_message(MessageType.PROPOSE, {"key": key, "value": value})
 
     # ---------------------------------------------------------- summaries
 
@@ -328,8 +406,16 @@ class ContainerRuntime(EventEmitter):
         builder.add_tree(".channels", channels.summary)
         builder.add_json_blob(
             ".metadata",
-            {"sequenceNumber": self.current_seq, "minimumSequenceNumber": self.min_seq},
+            {
+                "sequenceNumber": self.current_seq,
+                "minimumSequenceNumber": self.min_seq,
+                "datastores": {
+                    did: {"root": ds.is_root} for did, ds in self.datastores.items()
+                },
+            },
         )
+        if self.gc is not None:
+            builder.add_json_blob(".gc", self.gc.state())
         return builder.summary
 
     def load(self, summary: SummaryTree) -> None:
@@ -340,11 +426,17 @@ class ContainerRuntime(EventEmitter):
         meta = _json.loads(summary.get_blob(".metadata"))
         self.current_seq = meta["sequenceNumber"]
         self.min_seq = meta["minimumSequenceNumber"]
+        roots = meta.get("datastores", {})
         channels = summary.get_tree(".channels")
         for did, node in channels.entries.items():
             assert isinstance(node, SummaryTree)
-            ds = self.create_datastore(did)
+            ds = self.create_datastore(
+                did, root=roots.get(did, {}).get("root", True)
+            )
             ds.load(node)
+        if ".gc" in summary.entries:
+            self.attach_gc()
+            self.gc.load_state(_json.loads(summary.get_blob(".gc")))
 
 
 def _reshape(msg: SequencedMessage, inner_contents: Any) -> SequencedMessage:

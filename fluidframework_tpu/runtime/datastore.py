@@ -52,6 +52,15 @@ class DataStoreRuntime:
         # Back-reference to the hosting container runtime (None when
         # standalone); set by ContainerRuntime.create_datastore.
         self.container = None
+        # GC root flag (reference: root/aliased datastores are GC roots).
+        self.is_root = True
+
+    @property
+    def handle(self) -> dict:
+        """Serialized reference to this datastore (GC edge)."""
+        from .gc import make_handle
+
+        return make_handle(f"/{self.id}")
 
     @property
     def client_id(self) -> Optional[int]:

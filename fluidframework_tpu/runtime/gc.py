@@ -1,0 +1,166 @@
+"""Garbage collection across the container's reference graph.
+
+Mirrors the reference GC subsystem
+(packages/runtime/container-runtime/src/gc/garbageCollection.ts:91 and
+the standalone packages/runtime/garbage-collector): DDS values may hold
+*handles* (serialized references) to datastores/channels; GC marks
+everything reachable from root datastores via handles, tracks when a
+node first became unreferenced (gcUnreferencedStateTracker.ts), and
+sweeps nodes that stay unreferenced past a grace window (the
+tombstone → sweep-ready progression).
+
+Handle encoding (the FluidSerializer role,
+shared-object-base/src/serializer.ts): a JSON-able marker dict
+`{"type": "__fluid_handle__", "url": "/<datastore>[/<channel>]"}`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Set, Tuple
+
+HANDLE_TYPE = "__fluid_handle__"
+
+
+def make_handle(url: str) -> dict:
+    return {"type": HANDLE_TYPE, "url": url}
+
+
+def is_handle(value: Any) -> bool:
+    return (
+        isinstance(value, dict)
+        and value.get("type") == HANDLE_TYPE
+        and isinstance(value.get("url"), str)
+    )
+
+
+def find_handles(value: Any) -> Iterator[str]:
+    """All handle urls embedded in a JSON-able value tree."""
+    if is_handle(value):
+        yield value["url"]
+    elif isinstance(value, dict):
+        for v in value.values():
+            yield from find_handles(v)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from find_handles(v)
+
+
+def run_garbage_collection(
+    graph: Dict[str, List[str]], roots: List[str]
+) -> Tuple[Set[str], Set[str]]:
+    """Mark phase over an adjacency map (the standalone
+    runGarbageCollection, packages/runtime/garbage-collector/src/
+    garbageCollector.ts). Returns (referenced, unreferenced)."""
+    referenced: Set[str] = set()
+    stack = [r for r in roots if r in graph]
+    while stack:
+        node = stack.pop()
+        if node in referenced:
+            continue
+        referenced.add(node)
+        for out in graph.get(node, []):
+            if out not in referenced and out in graph:
+                stack.append(out)
+    return referenced, set(graph) - referenced
+
+
+class GarbageCollector:
+    """Container-level GC driver (GarbageCollector,
+    gc/garbageCollection.ts:91).
+
+    Nodes are "/<ds>" and "/<ds>/<channel>". A datastore created with
+    root=True is a GC root. A channel is referenced iff its datastore
+    is referenced or a handle points at it. `sweep_grace` is measured
+    in sequence numbers (the reference uses wall-clock sessionExpiry;
+    seq-space is the deterministic analog).
+    """
+
+    def __init__(self, runtime, sweep_grace: int = 0):
+        self.runtime = runtime
+        self.sweep_grace = sweep_grace
+        # node -> seq at which it became unreferenced
+        self.unreferenced_since: Dict[str, int] = {}
+        # Swept node ids: late traffic addressed to these is dropped
+        # (the reference's tombstone stage; full sweep coordination is
+        # a GC-op protocol — here every replica makes the same
+        # seq-space decision, and tombstones absorb stragglers).
+        self.tombstoned: Set[str] = set()
+
+    # ------------------------------------------------------------- graph
+
+    def build_graph(self) -> Tuple[Dict[str, List[str]], List[str]]:
+        graph: Dict[str, List[str]] = {}
+        roots: List[str] = []
+        for did, ds in self.runtime.datastores.items():
+            ds_node = f"/{did}"
+            ch_nodes = [f"/{did}/{cid}" for cid in ds.channels]
+            graph[ds_node] = list(ch_nodes)  # a live datastore refs its channels
+            if getattr(ds, "is_root", True):
+                roots.append(ds_node)
+            for cid, ch in ds.channels.items():
+                refs: List[str] = []
+                for blob in ch.get_attach_summary().flatten().values():
+                    if isinstance(blob, str):
+                        import json as _json
+
+                        try:
+                            refs.extend(find_handles(_json.loads(blob)))
+                        except (ValueError, TypeError):
+                            pass
+                # A reachable channel keeps its datastore alive (a
+                # handle to a child implies the parent is loadable).
+                graph[f"/{did}/{cid}"] = refs + [ds_node]
+        return graph, roots
+
+    # --------------------------------------------------------------- run
+
+    def collect(self) -> Tuple[Set[str], Set[str]]:
+        """Mark + unreferenced-state tracking. Returns
+        (referenced, unreferenced) node sets."""
+        graph, roots = self.build_graph()
+        referenced, unreferenced = run_garbage_collection(graph, roots)
+        now = self.runtime.current_seq
+        for node in unreferenced:
+            self.unreferenced_since.setdefault(node, now)
+        for node in referenced:
+            self.unreferenced_since.pop(node, None)  # revived
+        return referenced, unreferenced
+
+    def sweep(self) -> List[str]:
+        """Delete nodes unreferenced for > sweep_grace sequence numbers
+        (the sweep-ready phase). Returns deleted node ids."""
+        self.collect()
+        now = self.runtime.current_seq
+        deleted = []
+        swept_ds = set()
+        for node, since in sorted(self.unreferenced_since.items()):
+            if now - since < self.sweep_grace:
+                continue
+            parts = node.strip("/").split("/")
+            if len(parts) == 1:
+                if self.runtime.datastores.pop(parts[0], None) is not None:
+                    swept_ds.add(parts[0])
+                    deleted.append(node)
+            else:
+                if parts[0] in swept_ds:
+                    deleted.append(node)  # went down with its datastore
+                    continue
+                ds = self.runtime.datastores.get(parts[0])
+                if ds is not None and ds.channels.pop(parts[1], None) is not None:
+                    deleted.append(node)
+        for node in deleted:
+            self.unreferenced_since.pop(node, None)
+        self.tombstoned.update(deleted)
+        return deleted
+
+    # ----------------------------------------------------------- summary
+
+    def state(self) -> dict:
+        return {
+            "unreferencedSince": dict(self.unreferenced_since),
+            "tombstoned": sorted(self.tombstoned),
+        }
+
+    def load_state(self, data: dict) -> None:
+        self.unreferenced_since = dict(data.get("unreferencedSince", {}))
+        self.tombstoned = set(data.get("tombstoned", []))

@@ -35,6 +35,14 @@ class SharedObject(EventEmitter):
     # ---------------------------------------------------------- lifecycle
 
     @property
+    def handle(self) -> dict:
+        """Serialized reference to this channel (the IFluidHandle role;
+        GC edges are discovered by scanning summaries for these)."""
+        from .gc import make_handle
+
+        return make_handle(f"/{self.runtime.id}/{self.id}")
+
+    @property
     def is_attached(self) -> bool:
         return self.services is not None
 

@@ -1,0 +1,134 @@
+"""Client-side summarization: election, heuristics, ack tracking.
+
+Mirrors the reference summarizer subsystem
+(packages/runtime/container-runtime/src/summary/):
+
+- `SummarizerElection` — the oldest eligible quorum client summarizes
+  (SummarizerClientElection + OrderedClientElection,
+  summarizerClientElection.ts); on its departure the next-oldest takes
+  over.
+- `SummaryCollection` — the op-stream view of summarize/ack/nack
+  traffic (summaryCollection.ts:222).
+- `SummaryManager` — runs the heuristics (op count since last ack,
+  runningSummarizer.ts/summarizerHeuristics.ts) and executes the
+  summary: serialize the container, upload to storage, submit the
+  summarize op, await the server's ack (scribe, SURVEY.md §3.5).
+
+The reference isolates the summarizer in a hidden second container;
+here the elected client summarizes in place — same protocol traffic,
+simpler topology (our ContainerRuntime.summarize already refuses
+dirty state, which is the property the hidden container guarantees).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..protocol.messages import MessageType, SequencedMessage
+from ..utils.events import EventEmitter
+from .container_runtime import ContainerRuntime
+
+
+class SummaryCollection(EventEmitter):
+    """Observes summarize/summaryAck/summaryNack in the op stream
+    (summaryCollection.ts:222)."""
+
+    def __init__(self, runtime: ContainerRuntime):
+        super().__init__()
+        self.runtime = runtime
+        self.last_ack: Optional[dict] = None
+        self.last_ack_seq = 0  # seq of the acked summarize op
+        runtime.on("op", self._on_op)
+
+    def _on_op(self, msg: SequencedMessage, local: bool) -> None:
+        if msg.type == MessageType.SUMMARY_ACK:
+            self.last_ack = msg.contents
+            self.last_ack_seq = msg.contents["summaryProposal"]["summarySequenceNumber"]
+            self.emit("ack", msg.contents)
+        elif msg.type == MessageType.SUMMARY_NACK:
+            self.emit("nack", msg.contents)
+
+
+class SummarizerElection:
+    """Oldest-client election over the runtime's quorum."""
+
+    def __init__(self, runtime: ContainerRuntime):
+        self.runtime = runtime
+
+    @property
+    def elected_client_id(self) -> Optional[int]:
+        oldest = self.runtime.protocol.quorum.oldest()
+        return oldest.client_id if oldest else None
+
+    @property
+    def is_elected(self) -> bool:
+        return (
+            self.runtime.client_id is not None
+            and self.elected_client_id == self.runtime.client_id
+        )
+
+
+class SummaryManager:
+    """Drives the summarize loop for one container.
+
+    `storage` needs `upload_summary(wire) -> handle`
+    (server.lambdas.LocalServer provides it; drivers adapt their
+    service's storage API to the same shape).
+    """
+
+    def __init__(
+        self,
+        runtime: ContainerRuntime,
+        storage: Any,
+        max_ops: int = 100,
+    ):
+        self.runtime = runtime
+        self.storage = storage
+        self.max_ops = max_ops
+        self.election = SummarizerElection(runtime)
+        self.collection = SummaryCollection(runtime)
+        self._ops_since_ack = 0
+        self._summary_in_flight = False
+        runtime.on("op", self._count)
+        self.collection.on("ack", self._on_ack)
+        self.collection.on("nack", self._on_nack)
+
+    def _count(self, msg: SequencedMessage, local: bool) -> None:
+        if msg.type == MessageType.OP:
+            self._ops_since_ack += 1
+
+    def _on_ack(self, contents: dict) -> None:
+        self._ops_since_ack = 0
+        self._summary_in_flight = False
+
+    def _on_nack(self, contents: dict) -> None:
+        self._summary_in_flight = False  # retry on next heuristic pass
+
+    @property
+    def should_summarize(self) -> bool:
+        return (
+            self.election.is_elected
+            and not self._summary_in_flight
+            and self._ops_since_ack >= self.max_ops
+            and not self.runtime.is_dirty
+        )
+
+    def maybe_summarize(self) -> bool:
+        """Run one heuristic pass; returns True if a summary was
+        submitted (RunningSummarizer.trySummarize)."""
+        if not self.should_summarize:
+            return False
+        self.summarize_now()
+        return True
+
+    def summarize_now(self) -> str:
+        """Serialize → upload → submit the summarize op. Returns the
+        storage handle (SURVEY.md §3.5 submitSummary)."""
+        wire = self.runtime.summarize().to_json()
+        handle = self.storage.upload_summary(wire)
+        self._summary_in_flight = True
+        self.runtime.submit_system_message(
+            MessageType.SUMMARIZE,
+            {"handle": handle, "head": self.runtime.current_seq},
+        )
+        return handle

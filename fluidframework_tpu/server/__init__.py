@@ -14,5 +14,27 @@ kernel call) lives in fluidframework_tpu/ops/sequencer_kernel.py.
 
 from .sequencer import DocumentSequencer, NACK_STALE_REFSEQ
 from .local_service import LocalOrderingService
+from .castore import ContentAddressedStore
+from .log import LogConsumer, LogTopic, MessageLog
+from .lambdas import (
+    BroadcasterLambda,
+    DeliLambda,
+    LocalServer,
+    ScribeLambda,
+    ScriptoriumLambda,
+)
 
-__all__ = ["DocumentSequencer", "LocalOrderingService", "NACK_STALE_REFSEQ"]
+__all__ = [
+    "BroadcasterLambda",
+    "ContentAddressedStore",
+    "DeliLambda",
+    "DocumentSequencer",
+    "LocalOrderingService",
+    "LocalServer",
+    "LogConsumer",
+    "LogTopic",
+    "MessageLog",
+    "NACK_STALE_REFSEQ",
+    "ScribeLambda",
+    "ScriptoriumLambda",
+]

@@ -1,0 +1,46 @@
+"""Content-addressed summary/blob store.
+
+Plays the role git storage plays in the reference (gitrest over
+libgit2, server/gitrest; fronted by historian's cache): summaries are
+immutable blobs addressed by content hash, with named refs for each
+document's latest summary. The C++ implementation
+(fluidframework_tpu/native) backs the high-throughput path; this is
+the reference/fallback.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional
+
+
+class ContentAddressedStore:
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._refs: Dict[str, str] = {}  # doc id -> blob key
+
+    def put(self, content: bytes) -> str:
+        if isinstance(content, str):
+            content = content.encode()
+        key = hashlib.sha256(content).hexdigest()
+        self._blobs[key] = content
+        return key
+
+    def get(self, key: str) -> bytes:
+        return self._blobs[key]
+
+    def contains(self, key: str) -> bool:
+        return key in self._blobs
+
+    # ------------------------------------------------------------- refs
+
+    def set_ref(self, name: str, key: str) -> None:
+        if key not in self._blobs:
+            raise KeyError(f"unknown blob {key}")
+        self._refs[name] = key
+
+    def get_ref(self, name: str) -> Optional[str]:
+        return self._refs.get(name)
+
+    def list_refs(self) -> List[str]:
+        return sorted(self._refs)

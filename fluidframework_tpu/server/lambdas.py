@@ -1,0 +1,481 @@
+"""The ordering-service lambda pipeline, in-proc.
+
+Re-creates the reference routerlicious topology (SURVEY.md §2.5) over
+the in-memory message log:
+
+    alfred → [rawdeltas] → deli → [deltas] → {scriptorium, broadcaster,
+                                              scribe}
+
+- `AlfredIngress` — WS front door (lambdas/src/alfred/index.ts:211):
+  admits connections, validates submissions (size cap), forwards to
+  the rawdeltas topic, routes nacks/ops back to sockets.
+- `DeliLambda` — the sequencer (lambdas/src/deli/lambda.ts:215,
+  ticket :818): stamps seq/MSN via DocumentSequencer, nacks invalid
+  submissions, checkpoints (offset + sequencer state) like
+  checkpointContext.ts.
+- `ScriptoriumLambda` — durable op log (scriptorium/lambda.ts:35),
+  serving the delta-storage catch-up reads.
+- `BroadcasterLambda` — per-doc fan-out to connected sockets
+  (broadcaster/lambda.ts:49).
+- `ScribeLambda` — protocol-op folding + summary ack/nack
+  (scribe/lambda.ts:56,252): maintains ProtocolOpHandler per doc,
+  validates client summaries against the content-addressed store, and
+  emits summaryAck/summaryNack control messages back through deli.
+
+The same production lambdas run under the in-proc pump exactly as the
+reference's LocalOrderer runs the real lambda classes over LocalKafka
+(memory-orderer/src/localOrderer.ts:95,245).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from ..protocol.messages import (
+    DocumentMessage,
+    MessageType,
+    NackMessage,
+    SequencedMessage,
+)
+from ..protocol.quorum import ProtocolOpHandler
+from .castore import ContentAddressedStore
+from .log import LogConsumer, MessageLog
+from .sequencer import DocumentSequencer
+
+SYSTEM_CLIENT = -1  # server-originated control messages (scribe acks)
+MAX_OP_BYTES = 768 * 1024  # alfred's op-size nack threshold
+
+
+# --------------------------------------------------------------------------
+# deli
+# --------------------------------------------------------------------------
+
+
+class DeliLambda:
+    """Sequences the rawdeltas stream into the deltas stream."""
+
+    def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None):
+        self.log = log
+        self.sequencers: Dict[str, DocumentSequencer] = {}
+        offset = 0
+        if checkpoint:
+            offset = checkpoint["offset"]
+            for doc_id, state in checkpoint["docs"].items():
+                self.sequencers[doc_id] = DocumentSequencer.restore(state)
+        self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
+        self.deltas = log.topic("deltas")
+
+    def _doc(self, doc_id: str) -> DocumentSequencer:
+        if doc_id not in self.sequencers:
+            self.sequencers[doc_id] = DocumentSequencer(doc_id)
+        return self.sequencers[doc_id]
+
+    def pump(self) -> int:
+        n = 0
+        for raw in self.consumer.poll():
+            self._handle(raw)
+            n += 1
+        return n
+
+    def _handle(self, raw: dict) -> None:
+        doc = self._doc(raw["doc"])
+        kind = raw["kind"]
+        if kind == "join":
+            msg = doc.join(raw["client"])
+            self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+        elif kind == "leave":
+            msg = doc.leave(raw["client"])
+            if msg is not None:
+                self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+        elif kind == "control":
+            # Server-side control (summary ack/nack from scribe): stamp
+            # bypassing client validation (deli's system-message path).
+            msg = doc._stamp(
+                client_id=SYSTEM_CLIENT,
+                client_seq=0,
+                ref_seq=doc.seq,
+                type_=raw["type"],
+                contents=raw["contents"],
+            )
+            self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+        else:  # client op
+            out = doc.sequence(raw["client"], raw["msg"])
+            if isinstance(out, NackMessage):
+                self.deltas.append(
+                    {"doc": raw["doc"], "kind": "nack", "client": raw["client"],
+                     "msg": out}
+                )
+            else:
+                self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": out})
+
+    def checkpoint(self) -> dict:
+        """Resumable state (deli checkpointContext.ts → Mongo)."""
+        return {
+            "offset": self.consumer.checkpoint(),
+            "docs": {d: s.checkpoint() for d, s in self.sequencers.items()},
+        }
+
+
+# --------------------------------------------------------------------------
+# scriptorium
+# --------------------------------------------------------------------------
+
+
+class ScriptoriumLambda:
+    """Writes sequenced ops to the durable per-doc op store."""
+
+    def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None):
+        self.store: Dict[str, List[SequencedMessage]] = {}
+        offset = 0
+        if checkpoint:
+            offset = checkpoint["offset"]
+        self.consumer = LogConsumer(log.topic("deltas"), offset)
+        if checkpoint is None:
+            self.store = {}
+        # On restore, replay the log from 0 to rebuild the store (the
+        # reference restores from Mongo; our "Mongo" is rebuilt from
+        # the log, which is equivalent because the log is durable).
+        if checkpoint:
+            for m in log.topic("deltas").read(0, offset):
+                self._apply(m)
+
+    def _apply(self, entry: dict) -> None:
+        if entry["kind"] == "op":
+            self.store.setdefault(entry["doc"], []).append(entry["msg"])
+
+    def pump(self) -> int:
+        n = 0
+        for entry in self.consumer.poll():
+            self._apply(entry)
+            n += 1
+        return n
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        return [
+            m for m in self.store.get(doc_id, [])
+            if m.sequence_number > from_seq
+        ]
+
+    def checkpoint(self) -> dict:
+        return {"offset": self.consumer.checkpoint()}
+
+
+# --------------------------------------------------------------------------
+# broadcaster
+# --------------------------------------------------------------------------
+
+
+class BroadcasterLambda:
+    """Fans sequenced ops out to connected sockets per doc."""
+
+    def __init__(self, log: MessageLog):
+        self.consumer = LogConsumer(log.topic("deltas"))
+        # doc -> list of (socket) where socket has deliver(msg)/nack(msg)
+        self.rooms: Dict[str, List[Any]] = {}
+
+    def join_room(self, doc_id: str, socket: Any) -> None:
+        self.rooms.setdefault(doc_id, []).append(socket)
+
+    def leave_room(self, doc_id: str, socket: Any) -> None:
+        if socket in self.rooms.get(doc_id, []):
+            self.rooms[doc_id].remove(socket)
+
+    def pump(self) -> int:
+        n = 0
+        for entry in self.consumer.poll():
+            doc = entry["doc"]
+            if entry["kind"] == "op":
+                for sock in list(self.rooms.get(doc, [])):
+                    sock.deliver(entry["msg"])
+            elif entry["kind"] == "nack":
+                for sock in list(self.rooms.get(doc, [])):
+                    if sock.client_id == entry["client"]:
+                        sock.nack(entry["msg"])
+            n += 1
+        return n
+
+
+# --------------------------------------------------------------------------
+# scribe
+# --------------------------------------------------------------------------
+
+
+class ScribeLambda:
+    """Protocol-state keeper + summary validator/acker."""
+
+    def __init__(
+        self,
+        log: MessageLog,
+        storage: ContentAddressedStore,
+        checkpoint: Optional[dict] = None,
+    ):
+        self.log = log
+        self.storage = storage
+        self.protocol: Dict[str, ProtocolOpHandler] = {}
+        offset = 0
+        if checkpoint:
+            offset = checkpoint["offset"]
+            for doc_id, snap in checkpoint["protocol"].items():
+                self.protocol[doc_id] = ProtocolOpHandler.from_snapshot(snap)
+        self.consumer = LogConsumer(log.topic("deltas"), offset)
+        self.rawdeltas = log.topic("rawdeltas")
+
+    def _doc(self, doc_id: str) -> ProtocolOpHandler:
+        if doc_id not in self.protocol:
+            self.protocol[doc_id] = ProtocolOpHandler()
+        return self.protocol[doc_id]
+
+    def pump(self) -> int:
+        n = 0
+        for entry in self.consumer.poll():
+            if entry["kind"] != "op":
+                n += 1
+                continue
+            doc_id = entry["doc"]
+            msg: SequencedMessage = entry["msg"]
+            handler = self._doc(doc_id)
+            handler.process_message(msg)
+            if msg.type == MessageType.SUMMARIZE:
+                self._handle_summarize(doc_id, msg)
+            n += 1
+        return n
+
+    def _handle_summarize(self, doc_id: str, msg: SequencedMessage) -> None:
+        """Validate the client summary and ack/nack it through deli
+        (scribe/lambda.ts:252-266)."""
+        handle = (msg.contents or {}).get("handle")
+        if handle and self.storage.contains(handle):
+            self.storage.set_ref(doc_id, handle)
+            self.rawdeltas.append(
+                {
+                    "doc": doc_id,
+                    "kind": "control",
+                    "type": MessageType.SUMMARY_ACK,
+                    "contents": {
+                        "handle": handle,
+                        "summaryProposal": {"summarySequenceNumber": msg.sequence_number},
+                    },
+                }
+            )
+        else:
+            self.rawdeltas.append(
+                {
+                    "doc": doc_id,
+                    "kind": "control",
+                    "type": MessageType.SUMMARY_NACK,
+                    "contents": {
+                        "message": f"unknown summary handle {handle!r}",
+                        "summaryProposal": {"summarySequenceNumber": msg.sequence_number},
+                    },
+                }
+            )
+
+    def latest_summary(self, doc_id: str) -> Optional[str]:
+        return self.storage.get_ref(doc_id)
+
+    def checkpoint(self) -> dict:
+        return {
+            "offset": self.consumer.checkpoint(),
+            "protocol": {d: h.snapshot() for d, h in self.protocol.items()},
+        }
+
+
+# --------------------------------------------------------------------------
+# alfred + the assembled service
+# --------------------------------------------------------------------------
+
+
+class _Socket:
+    """One client's connection through alfred (the shape ContainerRuntime
+    expects: submit/listener/nack_listener/client_id/catch_up/disconnect)."""
+
+    def __init__(self, server: "LocalServer", doc_id: str, client_id: int):
+        self.server = server
+        self.doc_id = doc_id
+        self.client_id = client_id
+        self._listener: Optional[Callable[[SequencedMessage], None]] = None
+        self.nack_listener: Optional[Callable[[NackMessage], None]] = None
+        self.connected = True
+        self.join_seq = 0
+        # Ops delivered before the client assigned a listener buffer
+        # here and drain on assignment (the reference driver's
+        # early-op queueing, driver-base/src/documentDeltaConnection.ts:42).
+        self._backlog: List[SequencedMessage] = []
+
+    @property
+    def listener(self):
+        return self._listener
+
+    @listener.setter
+    def listener(self, fn) -> None:
+        self._listener = fn
+        if fn is not None:
+            backlog, self._backlog = self._backlog, []
+            for msg in backlog:
+                fn(msg)
+
+    # broadcaster side
+    def deliver(self, msg: SequencedMessage) -> None:
+        if self.join_seq == 0 and msg.type == MessageType.CLIENT_JOIN:
+            cid = msg.contents if not isinstance(msg.contents, dict) else msg.contents.get("clientId")
+            if cid == self.client_id:
+                self.join_seq = msg.sequence_number
+                return  # own join: surfaced via catch_up, not live
+        if not self.connected or msg.sequence_number <= self.join_seq or self.join_seq == 0:
+            return
+        if self._listener is None:
+            self._backlog.append(msg)
+        else:
+            self._listener(msg)
+
+    def nack(self, msg: NackMessage) -> None:
+        if self.connected and self.nack_listener is not None:
+            self.nack_listener(msg)
+
+    # client side
+    def submit(self, msg: DocumentMessage) -> None:
+        if not self.connected:
+            raise RuntimeError("socket closed")
+        self.server.alfred_submit(self.doc_id, self.client_id, msg)
+
+    def catch_up(self, from_seq: int) -> List[SequencedMessage]:
+        return [
+            m
+            for m in self.server.scriptorium.ops_from(self.doc_id, from_seq)
+            if m.sequence_number <= self.join_seq
+        ]
+
+    def disconnect(self) -> None:
+        if self.connected:
+            self.connected = False
+            self.server.alfred_disconnect(self)
+
+
+class LocalServer:
+    """The full pipeline in one object (the tinylicious/LocalOrderer
+    role): production lambdas over in-proc topics, synchronous pump."""
+
+    def __init__(
+        self,
+        storage: Optional[ContentAddressedStore] = None,
+        deferred: bool = False,
+        checkpoints: Optional[dict] = None,
+        log: Optional[MessageLog] = None,
+    ):
+        """Restart contract: pass the previous instance's `log` (the
+        durable substrate, as Kafka retains topics across lambda
+        crashes), `storage`, and `checkpoints()`; every lambda resumes
+        from its checkpointed offset/state."""
+        self.log = log if log is not None else MessageLog()
+        self.storage = storage if storage is not None else ContentAddressedStore()
+        cp = checkpoints or {}
+        self.deli = DeliLambda(self.log, cp.get("deli"))
+        self.scriptorium = ScriptoriumLambda(self.log, cp.get("scriptorium"))
+        self.broadcaster = BroadcasterLambda(self.log)
+        if cp:
+            # Fresh broadcaster on restart: no sockets exist yet, so
+            # skip history (reconnecting sockets catch up via storage).
+            self.broadcaster.consumer.offset = self.log.topic("deltas").head
+        self.scribe = ScribeLambda(self.log, self.storage, cp.get("scribe"))
+        self.deferred = deferred
+        self._next_client: Dict[str, int] = {}
+        # Broadcaster must lag scriptorium so catch_up is complete by
+        # the time a live op arrives; pump order below guarantees it.
+
+    # ------------------------------------------------------------- pump
+
+    def process_all(self, doc_id: Optional[str] = None) -> int:
+        """Drain the whole pipeline to quiescence."""
+        n = 0
+        while True:
+            moved = self.deli.pump()
+            moved += self.scriptorium.pump()
+            moved += self.scribe.pump()
+            moved += self.broadcaster.pump()
+            if moved == 0:
+                return n
+            n += moved
+
+    def _auto_pump(self) -> None:
+        if not self.deferred:
+            self.process_all()
+
+    # ----------------------------------------------------------- alfred
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None) -> _Socket:
+        """The connect_document handshake (alfred/index.ts:595)."""
+        if client_id is None:
+            client_id = self._next_client.get(doc_id, 1)
+        self._next_client[doc_id] = max(self._next_client.get(doc_id, 1), client_id + 1)
+        if any(
+            s.client_id == client_id and s.connected
+            for s in self.broadcaster.rooms.get(doc_id, [])
+        ):
+            raise ValueError(f"client {client_id} already connected to {doc_id}")
+        sock = _Socket(self, doc_id, client_id)
+        self.broadcaster.join_room(doc_id, sock)
+        self.log.topic("rawdeltas").append(
+            {"doc": doc_id, "kind": "join", "client": client_id}
+        )
+        # The join must be sequenced before the socket is usable (the
+        # reference handshake awaits the join roundtrip).
+        self.process_all()
+        assert sock.join_seq > 0
+        return sock
+
+    def alfred_submit(self, doc_id: str, client_id: int, msg: DocumentMessage) -> None:
+        # Ingress validation (alfred/index.ts:228): size cap nack.
+        try:
+            size = len(json.dumps(msg.contents, default=str))
+        except Exception:
+            size = 0
+        if size > MAX_OP_BYTES:
+            self.log.topic("deltas").append(
+                {
+                    "doc": doc_id,
+                    "kind": "nack",
+                    "client": client_id,
+                    "msg": NackMessage(client_id, msg.client_seq, 413, "op too large"),
+                }
+            )
+        else:
+            self.log.topic("rawdeltas").append(
+                {"doc": doc_id, "kind": "op", "client": client_id, "msg": msg}
+            )
+        self._auto_pump()
+
+    def alfred_disconnect(self, sock: _Socket) -> None:
+        self.broadcaster.leave_room(sock.doc_id, sock)
+        self.log.topic("rawdeltas").append(
+            {"doc": sock.doc_id, "kind": "leave", "client": sock.client_id}
+        )
+        self._auto_pump()
+
+    # ------------------------------------------------------- storage API
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        return self.scriptorium.ops_from(doc_id, from_seq)
+
+    def upload_summary(self, wire: str) -> str:
+        """Client summary upload (the storage.uploadSummaryWithContext
+        role): returns the handle to cite in the summarize op."""
+        return self.storage.put(wire.encode())
+
+    def download_summary(self, doc_id: str) -> Optional[str]:
+        key = self.storage.get_ref(doc_id)
+        if key is None:
+            return None
+        return self.storage.get(key).decode()
+
+    # -------------------------------------------------------- lifecycle
+
+    def checkpoints(self) -> dict:
+        """All lambdas' resumable state (crash/restart contract,
+        SURVEY.md §5 failure detection)."""
+        return {
+            "deli": self.deli.checkpoint(),
+            "scriptorium": self.scriptorium.checkpoint(),
+            "scribe": self.scribe.checkpoint(),
+        }

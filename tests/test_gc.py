@@ -1,0 +1,100 @@
+"""GC tests: handle discovery, mark, unreferenced tracking, sweep
+(reference packages/runtime/container-runtime/src/gc + the standalone
+garbage-collector package).
+"""
+
+from __future__ import annotations
+
+from fluidframework_tpu.dds import MapFactory
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.runtime.gc import (
+    GarbageCollector,
+    find_handles,
+    make_handle,
+    run_garbage_collection,
+)
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+REGISTRY = ChannelRegistry([MapFactory()])
+
+
+def test_find_handles_nested():
+    v = {
+        "a": make_handle("/x"),
+        "b": [1, {"c": make_handle("/y/z")}],
+        "d": "not a handle",
+    }
+    assert sorted(find_handles(v)) == ["/x", "/y/z"]
+
+
+def test_run_garbage_collection_marks():
+    graph = {
+        "/root": ["/a"],
+        "/a": ["/b"],
+        "/b": [],
+        "/orphan": ["/a"],  # unreferenced, even though it refs /a
+    }
+    ref, unref = run_garbage_collection(graph, ["/root"])
+    assert ref == {"/root", "/a", "/b"}
+    assert unref == {"/orphan"}
+
+
+def make_rt():
+    h = MultiClientHarness(1, REGISTRY, channel_types=[("root-map", MapFactory.type_name)])
+    return h, h.runtimes[0]
+
+
+def test_gc_lifecycle_mark_revive_sweep():
+    h, rt = make_rt()
+    root_map = h.channel(0, "root-map")
+
+    # A non-root datastore is alive only via handles.
+    aux = rt.create_datastore("aux", root=False)
+    aux_map = aux.create_channel("data", MapFactory.type_name)
+    aux.attach_all()
+    root_map.set("ref", aux_map.handle)
+    h.process_all()
+
+    gc = GarbageCollector(rt, sweep_grace=2)
+    ref, unref = gc.collect()
+    assert "/aux" in ref and "/aux/data" in ref
+    assert not unref
+
+    # Drop the reference: aux becomes unreferenced (tracked, not yet swept).
+    root_map.delete("ref")
+    h.process_all()
+    ref, unref = gc.collect()
+    assert "/aux" in unref and "/aux/data" in unref
+    since = gc.unreferenced_since["/aux"]
+
+    # Revive before the grace expires.
+    root_map.set("ref", aux.handle)
+    h.process_all()
+    ref, unref = gc.collect()
+    assert "/aux" in ref
+    assert "/aux" not in gc.unreferenced_since
+
+    # Drop again and let the grace window pass.
+    root_map.delete("ref")
+    h.process_all()
+    gc.collect()
+    assert gc.sweep() == []  # grace not yet elapsed
+    for i in range(3):
+        root_map.set(f"tick{i}", i)
+    h.process_all()
+    deleted = gc.sweep()
+    assert "/aux" in deleted and "/aux/data" in deleted
+    assert "aux" not in rt.datastores
+
+
+def test_gc_state_roundtrip():
+    h, rt = make_rt()
+    aux = rt.create_datastore("aux", root=False)
+    aux.create_channel("data", MapFactory.type_name)
+    aux.attach_all()
+    gc = GarbageCollector(rt)
+    gc.collect()
+    assert "/aux" in gc.unreferenced_since
+    gc2 = GarbageCollector(rt)
+    gc2.load_state(gc.state())
+    assert gc2.unreferenced_since == gc.unreferenced_since

@@ -1,0 +1,146 @@
+"""Reconnect semantics for merge-tree DDSes: rebase-on-resubmit
+(reference Client.regeneratePendingOp, client.ts:917), catch-up ack of
+ops sequenced under the old identity, and no-loss delivery around the
+connect window.
+"""
+
+from __future__ import annotations
+
+from fluidframework_tpu.dds import MapFactory, StringFactory
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.server import LocalServer
+
+REGISTRY = ChannelRegistry([StringFactory(), MapFactory()])
+
+
+def mk(server, cid=None, doc="doc"):
+    rt = ContainerRuntime(REGISTRY)
+    ds = rt.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    ds.create_channel("m", MapFactory.type_name)
+    rt.connect(server.connect(doc, cid))
+    return rt
+
+
+def C(rt, c="s"):
+    return rt.get_datastore("default").get_channel(c)
+
+
+def test_pending_insert_rebases_on_reconnect():
+    """A pending insert whose position shifted due to remote edits must
+    resubmit at the rebased position."""
+    server = LocalServer(deferred=True)
+    a_rt, b_rt = mk(server, 1), mk(server, 2)
+    server.process_all()
+    a, b = C(a_rt), C(b_rt)
+    a.insert_text(0, "hello")
+    a_rt.flush()
+    server.process_all()
+
+    # a inserts '!' at the end (pos 5), but is disconnected before it
+    # sequences; meanwhile b prepends 'XXX'.
+    a.insert_text(5, "!")
+    a_rt.disconnect()
+    server.process_all()
+    b.insert_text(0, "XXX")
+    b_rt.flush()
+    server.process_all()
+
+    a_rt.connect(server.connect("doc"))
+    server.process_all()
+    a_rt.flush()
+    server.process_all()
+    assert a.get_text() == b.get_text() == "XXXhello!"
+
+
+def test_pending_remove_split_by_remote_insert_rebases():
+    """A pending remove whose target range was split by a remote insert
+    regenerates as per-segment ops and still converges."""
+    server = LocalServer(deferred=True)
+    a_rt, b_rt = mk(server, 1), mk(server, 2)
+    server.process_all()
+    a, b = C(a_rt), C(b_rt)
+    a.insert_text(0, "abcdef")
+    a_rt.flush()
+    server.process_all()
+
+    a.remove_text(1, 5)  # pending removal of 'bcde'
+    a_rt.disconnect()
+    server.process_all()
+    b.insert_text(3, "XY")  # lands inside the pending-removed range
+    b_rt.flush()
+    server.process_all()
+    assert b.get_text() == "abcXYdef"
+
+    a_rt.connect(server.connect("doc"))
+    server.process_all()
+    a_rt.flush()
+    server.process_all()
+    texts = {a.get_text(), b.get_text()}
+    assert texts == {"aXYf"}, texts
+
+
+def test_op_sequenced_before_disconnect_not_double_applied():
+    """An op that DID sequence under the old client id must be matched
+    by catch-up as our own (acked), not applied remotely + resubmitted."""
+    server = LocalServer()
+    a_rt, b_rt = mk(server, 1), mk(server, 2)
+    a, b = C(a_rt), C(b_rt)
+    a.insert_text(0, "hello")
+    a_rt.flush()
+
+    # Submit; server sequences it, but simulate the echo being lost by
+    # detaching the listener before flush.
+    sock = a_rt.connection
+    a.insert_text(5, "!")
+    sock._listener = None  # drop live delivery (connection dying)
+    a_rt.flush()  # server sequences the op; echo goes to the backlog
+    sock.connected = False  # now the connection is really gone
+    a_rt.connection = None
+
+    a_rt.connect(server.connect("doc"))
+    a_rt.flush()
+    assert a.get_text() == b.get_text() == "hello!"
+    assert not a_rt.is_dirty
+
+
+def test_no_ops_lost_between_connect_and_listener():
+    """Ops sequenced between server.connect() and runtime.connect()
+    must be buffered, not dropped."""
+    server = LocalServer()
+    a_rt = mk(server, 1)
+    sock_b = server.connect("doc", 2)  # socket exists, no runtime yet
+    C(a_rt, "m").set("k", "v")
+    a_rt.flush()  # sequenced while sock_b has no listener
+
+    b_rt = ContainerRuntime(REGISTRY)
+    ds = b_rt.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    ds.create_channel("m", MapFactory.type_name)
+    b_rt.connect(sock_b)
+    assert C(b_rt, "m").get("k") == "v"
+    assert b_rt.current_seq == a_rt.current_seq
+
+
+def test_duplicate_client_id_rejected():
+    server = LocalServer()
+    server.connect("doc", 7)
+    try:
+        server.connect("doc", 7)
+    except ValueError as e:
+        assert "already connected" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+def test_malformed_propose_ignored():
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    server = LocalServer()
+    a_rt, b_rt = mk(server, 1), mk(server, 2)
+    a_rt.submit_system_message(MessageType.PROPOSE, "junk")
+    a_rt.submit_system_message(MessageType.PROPOSE, {"k": 1})
+    # Stream keeps flowing for everyone.
+    C(a_rt, "m").set("after", True)
+    a_rt.flush()
+    assert C(b_rt, "m").get("after") is True

@@ -1,0 +1,176 @@
+"""Full lambda-pipeline integration tests.
+
+The key property (mirroring how the reference's LocalOrderer runs the
+*production* lambdas in-proc, localOrderer.ts:95): the same
+ContainerRuntime + DDS scenarios that run against LocalOrderingService
+run unchanged against the full alfred → deli → scriptorium/broadcaster/
+scribe pipeline — plus pipeline-only behavior: summary ack/nack through
+scribe, quorum proposals, lambda crash/checkpoint/restore.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds import MapFactory, StringFactory
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.summary import SummaryTree
+from fluidframework_tpu.runtime.summary_manager import SummaryManager
+from fluidframework_tpu.server import LocalServer
+
+REGISTRY = ChannelRegistry([MapFactory(), StringFactory()])
+
+
+def connect_runtime(server, doc="doc", client_id=None, channels=(("s", StringFactory.type_name),)):
+    rt = ContainerRuntime(REGISTRY)
+    ds = rt.create_datastore("default")
+    for cid, tname in channels:
+        ds.create_channel(cid, tname)
+    rt.connect(server.connect(doc, client_id))
+    return rt
+
+
+def chan(rt, cid="s"):
+    return rt.get_datastore("default").get_channel(cid)
+
+
+def test_collab_over_full_pipeline():
+    server = LocalServer()
+    a_rt = connect_runtime(server, client_id=1)
+    b_rt = connect_runtime(server, client_id=2)
+    a, b = chan(a_rt), chan(b_rt)
+    a.insert_text(0, "hello pipeline")
+    a_rt.flush()
+    b.insert_text(0, ">> ")
+    b_rt.flush()
+    assert a.get_text() == b.get_text()
+    assert ">> " in a.get_text() and "hello pipeline" in a.get_text()
+    # durable op log is serving
+    assert server.ops_from("doc", 0)[-1].sequence_number >= 2
+
+
+def test_summary_flow_with_scribe_ack():
+    server = LocalServer()
+    rt1 = connect_runtime(server, client_id=1)
+    rt2 = connect_runtime(server, client_id=2)
+    mgr = SummaryManager(rt1, server, max_ops=3)
+    assert mgr.election.is_elected  # client 1 joined first
+    assert not SummaryManager(rt2, server, max_ops=3).election.is_elected
+
+    s = chan(rt1)
+    for i in range(4):
+        s.insert_text(0, f"{i}")
+        rt1.flush()
+    acks = []
+    mgr.collection.on("ack", acks.append)
+    assert mgr.maybe_summarize()
+    assert len(acks) == 1  # scribe validated & acked synchronously
+    handle = acks[0]["handle"]
+    assert server.storage.get_ref("doc") == handle
+
+    # A cold client boots from the scribe-blessed summary + op tail.
+    wire = server.download_summary("doc")
+    cold = ContainerRuntime(REGISTRY)
+    cold.load(SummaryTree.from_json(wire))
+    cold.connect(server.connect("doc", client_id=9))
+    assert chan(cold).get_text() == s.get_text()
+
+
+def test_summary_nack_on_bogus_handle():
+    server = LocalServer()
+    rt = connect_runtime(server, client_id=1)
+    mgr = SummaryManager(rt, server)
+    nacks = []
+    mgr.collection.on("nack", nacks.append)
+    rt.submit_system_message(MessageType.SUMMARIZE, {"handle": "deadbeef"})
+    assert len(nacks) == 1
+    assert "unknown summary handle" in nacks[0]["message"]
+    assert not mgr._summary_in_flight
+
+
+def test_quorum_proposal_commits_on_msn():
+    server = LocalServer()
+    rt1 = connect_runtime(server, client_id=1)
+    rt2 = connect_runtime(server, client_id=2)
+    committed = []
+    rt2.protocol.proposals.on(
+        "approveProposal", lambda k, v, s: committed.append((k, v))
+    )
+    rt1.propose("code", {"package": "tpu-app@1"})
+    # The proposal commits once the MSN passes it: both clients must
+    # reference a seq >= proposal seq. Drive traffic from both.
+    chan(rt1).insert_text(0, "x")
+    rt1.flush()
+    chan(rt2).insert_text(0, "y")
+    rt2.flush()
+    chan(rt1).insert_text(0, "z")
+    rt1.flush()
+    chan(rt2).insert_text(0, "w")
+    rt2.flush()
+    assert ("code", {"package": "tpu-app@1"}) in committed
+    assert rt1.protocol.proposals.get("code") == {"package": "tpu-app@1"}
+    assert rt2.protocol.proposals.get("code") == {"package": "tpu-app@1"}
+
+
+def test_oversized_op_nacked():
+    server = LocalServer()
+    rt = connect_runtime(server, client_id=1, channels=(("m", MapFactory.type_name),))
+    nacks = []
+    rt.on("nack", nacks.append)
+    chan(rt, "m").set("big", "x" * (800 * 1024))
+    rt.flush()
+    assert len(nacks) == 1 and nacks[0].code == 413
+    assert rt.connection is None  # nack is connection-fatal
+
+
+def test_election_passes_to_next_oldest_on_leave():
+    server = LocalServer()
+    rt1 = connect_runtime(server, client_id=1)
+    rt2 = connect_runtime(server, client_id=2)
+    m2 = SummaryManager(rt2, server)
+    assert not m2.election.is_elected
+    rt1.connection.disconnect()
+    # rt2 sees the leave; election moves to it.
+    assert m2.election.elected_client_id == 2
+    assert m2.election.is_elected
+
+
+def test_lambda_crash_checkpoint_restore():
+    """Kill the server mid-session; restore every lambda from its
+    checkpoint over the durable log; clients reconnect and converge
+    (the deli/scribe checkpoint contract, checkpointContext.ts)."""
+    server = LocalServer()
+    rt1 = connect_runtime(server, client_id=1)
+    s = chan(rt1)
+    s.insert_text(0, "before crash")
+    rt1.flush()
+    cps = server.checkpoints()
+    log, storage = server.log, server.storage
+
+    # "Crash": build a fresh server from checkpoints + durable log.
+    server2 = LocalServer(storage=storage, checkpoints=cps, log=log)
+    # Sequencer state survived:
+    assert server2.deli.sequencers["doc"].seq == server.deli.sequencers["doc"].seq
+    # Old runtime reconnects (new client id) and continues.
+    rt1.disconnect()
+    rt1.connect(server2.connect("doc"))
+    s.insert_text(0, "after restore ")
+    rt1.flush()
+
+    rt2 = connect_runtime(server2, client_id=77)
+    assert chan(rt2).get_text() == s.get_text() == "after restore before crash"
+
+
+def test_checkpoint_restore_preserves_quorum_and_protocol():
+    server = LocalServer()
+    rt1 = connect_runtime(server, client_id=1)
+    rt1.propose("k", "v")
+    chan(rt1).insert_text(0, "ab")
+    rt1.flush()
+    cps = server.checkpoints()
+    server2 = LocalServer(storage=server.storage, checkpoints=cps, log=server.log)
+    proto = server2.scribe.protocol["doc"]
+    assert 1 in proto.quorum
+    # MSN == head with one client at head, so the proposal committed.
+    assert proto.proposals.get("k") == "v"

@@ -1,0 +1,130 @@
+"""SharedMatrix convergence tests (reference
+packages/dds/matrix/src/test/matrix.spec.ts shapes): concurrent
+row/col structure edits + cell writes over the runtime stack.
+"""
+
+from __future__ import annotations
+
+import random
+
+from fluidframework_tpu.dds import MatrixFactory
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.summary import SummaryTree
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+REGISTRY = ChannelRegistry([MatrixFactory()])
+
+
+def make_harness(n=2):
+    return MultiClientHarness(n, REGISTRY, channel_types=[("x", MatrixFactory.type_name)])
+
+
+def test_basic_grid_and_cells():
+    h = make_harness()
+    a, b = h.channel(0, "x"), h.channel(1, "x")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 3)
+    h.process_all()
+    assert (b.row_count, b.col_count) == (2, 3)
+    a.set_cell(0, 0, "tl")
+    b.set_cell(1, 2, "br")
+    h.process_all()
+    assert a.to_dense() == b.to_dense() == [["tl", None, None], [None, None, "br"]]
+
+
+def test_cells_track_row_col_inserts():
+    h = make_harness()
+    a, b = h.channel(0, "x"), h.channel(1, "x")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    h.process_all()
+    a.set_cell(1, 1, "v")
+    h.process_all()
+    # Concurrent structural edits shift positions but not cell identity.
+    a.insert_rows(0, 1)
+    b.insert_cols(1, 2)
+    h.process_all()
+    assert a.to_dense() == b.to_dense()
+    assert a.get_cell(2, 3) == "v"  # slid by 1 row and 2 cols
+
+
+def test_concurrent_set_cell_lww_with_pending_shadow():
+    h = make_harness()
+    a, b = h.channel(0, "x"), h.channel(1, "x")
+    a.insert_rows(0, 1)
+    a.insert_cols(0, 1)
+    h.process_all()
+    b.set_cell(0, 0, "from-b")
+    h.runtimes[1].flush()
+    a.set_cell(0, 0, "from-a")  # pending when b's arrives
+    h.service.process_all()
+    assert a.get_cell(0, 0) == "from-a"  # shadowed
+    h.process_all()
+    assert a.get_cell(0, 0) == "from-a"
+    assert b.get_cell(0, 0) == "from-a"  # a sequenced later: LWW
+
+
+def test_remove_rows_drops_cells_from_view():
+    h = make_harness()
+    a, b = h.channel(0, "x"), h.channel(1, "x")
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 2)
+    h.process_all()
+    a.set_cell(1, 0, "gone")
+    a.set_cell(2, 1, "stays")
+    h.process_all()
+    b.remove_rows(1, 1)
+    h.process_all()
+    assert a.row_count == 2
+    assert a.to_dense() == b.to_dense() == [[None, None], [None, "stays"]]
+
+
+def test_random_structure_fuzz_converges():
+    h = make_harness()
+    a, b = h.channel(0, "x"), h.channel(1, "x")
+    a.insert_rows(0, 4)
+    a.insert_cols(0, 4)
+    h.process_all()
+    rng = random.Random(7)
+    chans = [a, b]
+    for step in range(25):
+        for m in chans:
+            r = rng.random()
+            if r < 0.3 and m.row_count < 12:
+                m.insert_rows(rng.randint(0, m.row_count), rng.randint(1, 2))
+            elif r < 0.45 and m.row_count > 2:
+                m.remove_rows(rng.randint(0, m.row_count - 1), 1)
+            elif r < 0.6 and m.col_count < 12:
+                m.insert_cols(rng.randint(0, m.col_count), 1)
+            elif r < 0.7 and m.col_count > 2:
+                m.remove_cols(rng.randint(0, m.col_count - 1), 1)
+            elif m.row_count and m.col_count:
+                m.set_cell(
+                    rng.randint(0, m.row_count - 1),
+                    rng.randint(0, m.col_count - 1),
+                    step,
+                )
+        h.process_all()
+    assert a.to_dense() == b.to_dense()
+    assert (a.row_count, a.col_count) == (b.row_count, b.col_count)
+
+
+def test_matrix_summary_roundtrip():
+    h = make_harness()
+    a = h.channel(0, "x")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    h.process_all()
+    a.set_cell(0, 1, {"rich": [1, 2]})
+    h.process_all()
+    wire = h.runtimes[0].summarize().to_json()
+    rt = ContainerRuntime(REGISTRY)
+    rt.load(SummaryTree.from_json(wire))
+    m = rt.get_datastore("default").get_channel("x")
+    assert m.to_dense() == a.to_dense()
+    # Rejoin and collaborate.
+    rt.connect(h.service.connect(h.doc_id, client_id=33))
+    m.set_cell(1, 0, "post-load")
+    rt.flush()
+    h.process_all()
+    assert h.channel(1, "x").get_cell(1, 0) == "post-load"
