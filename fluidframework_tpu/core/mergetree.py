@@ -571,6 +571,14 @@ class MergeTreeEngine:
                 return InsertOp(pos=pos, text=content, props=props)
             return InsertOp(pos=pos, seg=content, props=props)
 
+        # A segment whose removal has already *sequenced* (a remote
+        # remove overtook our pending one) is a tombstone for every
+        # future perspective: the regenerated remove/annotate must not
+        # cite it, or receivers would hit unrelated visible content.
+        segs = [
+            s for s in segs
+            if not (s.removed_seq is not None and s.removed_seq != UNASSIGNED_SEQ)
+        ]
         if not segs:
             self.pending.remove(grp)
             return None

@@ -17,7 +17,21 @@ Every DDS subclasses `runtime.SharedObject` and registers a
 from .map import MapFactory, SharedMap, DirectoryFactory, SharedDirectory
 from .cell import CellFactory, SharedCell
 from .counter import CounterFactory, SharedCounter
+from .consensus import (
+    READ_ATOMIC,
+    READ_LWW,
+    ConsensusQueue,
+    ConsensusQueueFactory,
+    ConsensusRegisterCollection,
+    PactMap,
+    PactMapFactory,
+    RegisterCollectionFactory,
+    TaskManager,
+    TaskManagerFactory,
+)
+from .ink import InkFactory, SharedInk
 from .matrix import MatrixFactory, SharedMatrix
+from .summary_block import SharedSummaryBlock, SummaryBlockFactory
 from .sequence import (
     IntervalCollection,
     Marker,
@@ -28,8 +42,22 @@ from .sequence import (
 )
 
 __all__ = [
+    "READ_ATOMIC",
+    "READ_LWW",
     "CellFactory",
+    "ConsensusQueue",
+    "ConsensusQueueFactory",
+    "ConsensusRegisterCollection",
     "CounterFactory",
+    "InkFactory",
+    "PactMap",
+    "PactMapFactory",
+    "RegisterCollectionFactory",
+    "SharedInk",
+    "SharedSummaryBlock",
+    "SummaryBlockFactory",
+    "TaskManager",
+    "TaskManagerFactory",
     "DirectoryFactory",
     "IntervalCollection",
     "MapFactory",

@@ -1,0 +1,341 @@
+"""Consensus-family DDSes: server-ack-gated coordination structures.
+
+These DDSes derive their guarantees from the total order itself (a
+claim is yours iff *your* op sequences first) plus quorum membership
+(leases release when their holder leaves). Reference packages:
+
+- `ConsensusQueue` (dds/ordered-collection/src/consensusQueue.ts:37):
+  distributed work queue with acquire/complete/release leases.
+- `ConsensusRegisterCollection`
+  (dds/register-collection/src/consensusRegisterCollection.ts:95):
+  versioned registers with Atomic / LocalWriterWins read policies.
+- `TaskManager` (dds/task-manager/src/taskManager.ts:150): per-task
+  volunteer queues; the head holds the lock.
+- `PactMap` (dds/pact-map/src/pactMap.ts:159): write-once keys that
+  commit when every connected client has seen them (MSN passes the
+  set's sequence number — the quorum-proposal commit rule).
+
+Quorum-leave cleanup is deterministic: every replica folds protocol
+messages at the same stream position (ContainerRuntime._process_one),
+so lease releases happen identically everywhere.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+class _QuorumWatcher(SharedObject):
+    """Base for DDSes that react to quorum membership changes."""
+
+    def on_connected(self) -> None:
+        quorum = self.runtime.container.protocol.quorum
+        if getattr(self, "_watching", None) is not quorum:
+            self._watching = quorum
+            quorum.on("removeMember", self._on_member_left)
+
+    def _on_member_left(self, client_id: int) -> None:  # pragma: no cover
+        pass
+
+
+# ---------------------------------------------------------------------------
+# ConsensusQueue
+# ---------------------------------------------------------------------------
+
+
+class ConsensusQueue(_QuorumWatcher):
+    """FIFO queue with acquire leases (consensusQueue.ts:37).
+
+    acquire(): submits an acquire op; when it sequences and the queue
+    is non-empty, the head value is leased to the acquiring client.
+    complete(id) removes it permanently; release(id) returns it to the
+    head. A leaseholder's departure releases its leases.
+    """
+
+    def initialize_local_core(self) -> None:
+        self.queue: List[dict] = []  # {"id": n, "value": v}
+        self.in_flight: Dict[int, dict] = {}  # id -> {"value", "client"}
+        self._next_id = 0
+        self._acquire_callbacks: List[Callable[[Optional[dict]], None]] = []
+
+    def add(self, value: Any) -> None:
+        self.submit_local_message({"type": "add", "value": value})
+
+    def acquire(self, callback: Optional[Callable[[Optional[dict]], None]] = None) -> None:
+        """Request the queue head; `callback(item_or_None)` fires when
+        our acquire op sequences (the server-ack contract)."""
+        self._acquire_callbacks.append(callback or (lambda item: None))
+        self.submit_local_message({"type": "acquire"})
+
+    def complete(self, item_id: int) -> None:
+        self.submit_local_message({"type": "complete", "id": item_id})
+
+    def release(self, item_id: int) -> None:
+        self.submit_local_message({"type": "release", "id": item_id})
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        kind = op["type"]
+        if kind == "add":
+            self.queue.append({"id": self._next_id, "value": op["value"]})
+            self._next_id += 1
+        elif kind == "acquire":
+            item = self.queue.pop(0) if self.queue else None
+            if item is not None:
+                self.in_flight[item["id"]] = {
+                    "value": item["value"],
+                    "client": msg.client_id,
+                }
+            if local:
+                cb = self._acquire_callbacks.pop(0)
+                cb(dict(item) if item else None)
+            self.emit("acquired", item, msg.client_id)
+        elif kind == "complete":
+            self.in_flight.pop(op["id"], None)
+        elif kind == "release":
+            entry = self.in_flight.pop(op["id"], None)
+            if entry is not None:
+                self.queue.insert(0, {"id": op["id"], "value": entry["value"]})
+
+    def _on_member_left(self, client_id: int) -> None:
+        # Leases die with their holder (localOrderSequentially in the
+        # reference releases on quorum leave).
+        for item_id in sorted(
+            [i for i, e in self.in_flight.items() if e["client"] == client_id]
+        ):
+            entry = self.in_flight.pop(item_id)
+            self.queue.insert(0, {"id": item_id, "value": entry["value"]})
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        self.submit_local_message(content)
+        return None
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob(
+                "header",
+                {"queue": self.queue, "nextId": self._next_id,
+                 "inFlight": [[k, v] for k, v in self.in_flight.items()]},
+            )
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        data = json.loads(storage.read("header"))
+        self.queue = data["queue"]
+        self._next_id = data["nextId"]
+        self.in_flight = {int(k): v for k, v in data["inFlight"]}
+
+
+class ConsensusQueueFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/consensus-queue"
+    channel_class = ConsensusQueue
+
+
+# ---------------------------------------------------------------------------
+# ConsensusRegisterCollection
+# ---------------------------------------------------------------------------
+
+READ_ATOMIC = "Atomic"
+READ_LWW = "LocalWriterWins"
+
+
+class ConsensusRegisterCollection(_QuorumWatcher):
+    """Versioned registers (consensusRegisterCollection.ts:95): a write
+    supersedes exactly the versions its author had seen (version seq <=
+    write refSeq); concurrent writes coexist as versions. Atomic read =
+    earliest surviving version; LWW read = latest."""
+
+    def initialize_local_core(self) -> None:
+        # key -> [{"value", "seq", "client"}] in sequence order
+        self.registers: Dict[str, List[dict]] = {}
+
+    def write(self, key: str, value: Any) -> None:
+        self.submit_local_message({"type": "write", "key": key, "value": value})
+
+    def read(self, key: str, policy: str = READ_ATOMIC) -> Any:
+        versions = self.registers.get(key)
+        if not versions:
+            return None
+        return versions[0 if policy == READ_ATOMIC else -1]["value"]
+
+    def read_versions(self, key: str) -> List[Any]:
+        return [v["value"] for v in self.registers.get(key, [])]
+
+    def keys(self):
+        return self.registers.keys()
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        if op["type"] != "write":
+            return
+        key = op["key"]
+        versions = self.registers.setdefault(key, [])
+        # Supersede everything the writer had seen.
+        versions[:] = [v for v in versions if v["seq"] > msg.ref_seq]
+        versions.append(
+            {"value": op["value"], "seq": msg.sequence_number, "client": msg.client_id}
+        )
+        self.emit("atomicChanged" if len(versions) == 1 else "versionChanged", key)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        self.submit_local_message(content)
+        return None
+
+    def summarize_core(self):
+        return SummaryTreeBuilder().add_json_blob("header", self.registers).summary
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.registers = json.loads(storage.read("header"))
+
+
+class RegisterCollectionFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/consensus-register-collection"
+    channel_class = ConsensusRegisterCollection
+
+
+# ---------------------------------------------------------------------------
+# TaskManager
+# ---------------------------------------------------------------------------
+
+
+class TaskManager(_QuorumWatcher):
+    """Distributed task locks via volunteer queues (taskManager.ts:150).
+    The queue head holds the lock; abandoning or leaving passes it."""
+
+    def initialize_local_core(self) -> None:
+        self.queues: Dict[str, List[int]] = {}  # task id -> client queue
+
+    def volunteer_for_task(self, task_id: str) -> None:
+        self.submit_local_message({"type": "volunteer", "taskId": task_id})
+
+    def abandon(self, task_id: str) -> None:
+        self.submit_local_message({"type": "abandon", "taskId": task_id})
+
+    def assigned_client(self, task_id: str) -> Optional[int]:
+        q = self.queues.get(task_id)
+        return q[0] if q else None
+
+    def assigned(self, task_id: str) -> bool:
+        cid = self.runtime.client_id
+        return cid is not None and self.assigned_client(task_id) == cid
+
+    def queued(self, task_id: str) -> bool:
+        cid = self.runtime.client_id
+        return cid is not None and cid in self.queues.get(task_id, [])
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        q = self.queues.setdefault(op["taskId"], [])
+        if op["type"] == "volunteer":
+            if msg.client_id not in q:
+                q.append(msg.client_id)
+        elif op["type"] == "abandon":
+            if msg.client_id in q:
+                was_head = q[0] == msg.client_id
+                q.remove(msg.client_id)
+                if was_head and q:
+                    self.emit("assigned", op["taskId"], q[0])
+        self.emit("queueChanged", op["taskId"])
+
+    def _on_member_left(self, client_id: int) -> None:
+        for task_id, q in self.queues.items():
+            if client_id in q:
+                was_head = q[0] == client_id
+                q.remove(client_id)
+                if was_head and q:
+                    self.emit("assigned", task_id, q[0])
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        self.submit_local_message(content)
+        return None
+
+    def summarize_core(self):
+        # Volunteer queues are session state: clients re-volunteer on
+        # load (the reference persists nothing for connected clients).
+        return SummaryTreeBuilder().add_json_blob("header", {}).summary
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+
+
+class TaskManagerFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/task-manager"
+    channel_class = TaskManager
+
+
+# ---------------------------------------------------------------------------
+# PactMap
+# ---------------------------------------------------------------------------
+
+
+class PactMap(_QuorumWatcher):
+    """Write-once keys committed by unanimous observation
+    (pactMap.ts:159): a set becomes the key's pact once the MSN passes
+    its sequence number; competing concurrent sets lose to the first
+    sequenced."""
+
+    def initialize_local_core(self) -> None:
+        self.values: Dict[str, Any] = {}  # committed pacts
+        self.pending_pacts: Dict[str, dict] = {}  # key -> {"value","seq"}
+
+    def set(self, key: str, value: Any) -> None:
+        self.submit_local_message({"type": "set", "key": key, "value": value})
+
+    def get(self, key: str) -> Any:
+        return self.values.get(key)
+
+    def get_pending(self, key: str) -> Any:
+        p = self.pending_pacts.get(key)
+        return p["value"] if p else None
+
+    def has(self, key: str) -> bool:
+        return key in self.values
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        if op["type"] == "set":
+            key = op["key"]
+            if key not in self.values and key not in self.pending_pacts:
+                self.pending_pacts[key] = {
+                    "value": op["value"], "seq": msg.sequence_number,
+                }
+            # else: a pact exists or is forming — later sets lose.
+        self._commit_ready(msg.minimum_sequence_number)
+
+    def _commit_ready(self, msn: int) -> None:
+        ready = [k for k, p in self.pending_pacts.items() if p["seq"] <= msn]
+        for key in ready:
+            self.values[key] = self.pending_pacts.pop(key)["value"]
+            self.emit("pact", key, self.values[key])
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        self.submit_local_message(content)
+        return None
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob(
+                "header", {"values": self.values, "pending": self.pending_pacts}
+            )
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        data = json.loads(storage.read("header"))
+        self.values = data["values"]
+        self.pending_pacts = data["pending"]
+
+
+class PactMapFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/pact-map"
+    channel_class = PactMap

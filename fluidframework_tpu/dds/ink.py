@@ -1,0 +1,93 @@
+"""SharedInk: append-only ink strokes.
+
+Reference packages/dds/ink/src/ink.ts:103: strokes are created with a
+pen and extended point-by-point; all ops commute per-stroke (points
+append in sequence order), so there is no conflict policy beyond the
+total order.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+class SharedInk(SharedObject):
+    def initialize_local_core(self) -> None:
+        self.strokes: Dict[str, dict] = {}  # id -> {"pen", "points"}
+        self._order: List[str] = []
+        self._next_local = 0
+
+    def create_stroke(self, pen: Optional[dict] = None) -> str:
+        self._next_local += 1
+        stroke_id = f"{self.runtime.client_id or 'detached'}-{self._next_local}"
+        self._apply_create(stroke_id, pen or {})
+        self.submit_local_message(
+            {"type": "createStroke", "id": stroke_id, "pen": pen or {}}
+        )
+        return stroke_id
+
+    def append_point(self, stroke_id: str, x: float, y: float,
+                     pressure: float = 1.0) -> None:
+        point = {"x": x, "y": y, "pressure": pressure}
+        self.strokes[stroke_id]["points"].append(point)
+        self.submit_local_message(
+            {"type": "stylus", "id": stroke_id, "point": point}
+        )
+
+    def get_stroke(self, stroke_id: str) -> dict:
+        return self.strokes[stroke_id]
+
+    def get_strokes(self) -> List[dict]:
+        return [self.strokes[s] for s in self._order]
+
+    def _apply_create(self, stroke_id: str, pen: dict) -> None:
+        if stroke_id not in self.strokes:
+            self.strokes[stroke_id] = {"id": stroke_id, "pen": pen, "points": []}
+            self._order.append(stroke_id)
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        if local:
+            return  # applied optimistically (all ink ops commute)
+        op = msg.contents
+        if op["type"] == "createStroke":
+            self._apply_create(op["id"], op["pen"])
+        elif op["type"] == "stylus":
+            if op["id"] in self.strokes:
+                self.strokes[op["id"]]["points"].append(op["point"])
+        self.emit("ink", op)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        op = content
+        if op["type"] == "createStroke":
+            self._apply_create(op["id"], op["pen"])
+            self.submit_local_message(op)
+        else:
+            self.append_point(op["id"], **op["point"])
+        return None
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob(
+                "header",
+                {"order": self._order, "strokes": self.strokes},
+            )
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        data = json.loads(storage.read("header"))
+        self._order = data["order"]
+        self.strokes = data["strokes"]
+
+
+class InkFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/ink"
+    channel_class = SharedInk

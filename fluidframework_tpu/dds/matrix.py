@@ -77,6 +77,21 @@ class PermutationVector:
     def handles(self) -> List[int]:
         return self.engine.get_items()
 
+    def position_of_handle(self, handle: int) -> Optional[int]:
+        """Current local visible position of a handle, or None if its
+        row/col is no longer visible."""
+        pos = 0
+        for seg in self.engine.segments:
+            cat, length = self.engine._vis(
+                seg, self.engine.current_seq, self.engine.local_client_id
+            )
+            if cat == VisCategory.SKIP or length == 0:
+                continue
+            if handle in seg.content:
+                return pos + seg.content.index(handle)
+            pos += length
+        return None
+
 
 class SharedMatrix(SharedObject):
     def initialize_local_core(self) -> None:
@@ -107,7 +122,11 @@ class SharedMatrix(SharedObject):
         eng = pv.engine
         if eng.collaborating:
             eng.insert(pos, handles, eng.current_seq, eng.local_client_id, UNASSIGNED_SEQ)
-            self.submit_local_message({"type": op_type, "pos": pos, "count": count})
+            self.submit_local_message(
+                {"type": op_type, "pos": pos, "count": count},
+                {"axis": "rows" if pv is self.rows else "cols",
+                 "group": eng.pending[-1]},
+            )
         else:
             eng.insert(pos, handles, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ)
 
@@ -115,7 +134,11 @@ class SharedMatrix(SharedObject):
         eng = pv.engine
         if eng.collaborating:
             eng.remove_range(pos, pos + count, eng.current_seq, eng.local_client_id, UNASSIGNED_SEQ)
-            self.submit_local_message({"type": op_type, "pos": pos, "count": count})
+            self.submit_local_message(
+                {"type": op_type, "pos": pos, "count": count},
+                {"axis": "rows" if pv is self.rows else "cols",
+                 "group": eng.pending[-1]},
+            )
         else:
             eng.remove_range(pos, pos + count, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ)
 
@@ -174,7 +197,12 @@ class SharedMatrix(SharedObject):
                 )
                 if self._pending_cells.get(key, 0) == 0:
                     self._cells[key] = op["value"]
-                    self.emit("cellChanged", op["row"], op["col"], False)
+                    # Event positions are RECEIVER-local (the sender's
+                    # row/col indices mean nothing at this replica).
+                    r = self.rows.position_of_handle(key[0])
+                    c = self.cols.position_of_handle(key[1])
+                    if r is not None and c is not None:
+                        self.emit("cellChanged", r, c, False)
         else:
             pv = self.rows if "Rows" in kind else self.cols
             eng = pv.engine
@@ -196,6 +224,55 @@ class SharedMatrix(SharedObject):
             pv.engine.update_min_seq(
                 max(pv.engine.min_seq, msg.minimum_sequence_number)
             )
+
+    def resubmit(self, content: Any, local_metadata: Any) -> None:
+        """Reconnect replay with rebase: structural ops regenerate
+        their positions from their pending merge-tree groups (the
+        sequence DDS's regeneratePendingOp applied per axis); setCell
+        re-targets by handle at the current perspective (dropped if the
+        row/col has since been removed)."""
+        op = content
+        kind = op["type"]
+        if kind == "setCell":
+            key = local_metadata["key"]
+            r = self.rows.position_of_handle(key[0])
+            c = self.cols.position_of_handle(key[1])
+            if r is None or c is None:
+                # Target row/col is gone: the write is moot; clear the
+                # pending shadow it held.
+                n = self._pending_cells.get(key, 0) - 1
+                if n <= 0:
+                    self._pending_cells.pop(key, None)
+                else:
+                    self._pending_cells[key] = n
+                return
+            self.submit_local_message(
+                {"type": "setCell", "row": r, "col": c, "value": op["value"]},
+                local_metadata,
+            )
+            return
+        pv = self.rows if local_metadata["axis"] == "rows" else self.cols
+        grp = local_metadata["group"]
+        if all(g is not grp for g in pv.engine.pending):
+            return  # sequenced during catch-up
+        from ..protocol.mergetree_ops import GroupOp, InsertOp, RemoveOp
+
+        regenerated = pv.engine.regenerate_pending_op(
+            grp,
+            InsertOp(pos=op["pos"]) if kind.startswith("insert")
+            else RemoveOp(start=op["pos"], end=op["pos"] + op["count"]),
+        )
+        if regenerated is None:
+            return
+        subs = regenerated.ops if isinstance(regenerated, GroupOp) else [regenerated]
+        # Each regenerated sub-op submits as its own message (each pops
+        # one per-segment pending group on ack).
+        for sub in subs:
+            if isinstance(sub, InsertOp):
+                mop = {"type": kind, "pos": sub.pos, "count": len(sub.seg or sub.text)}
+            else:
+                mop = {"type": kind, "pos": sub.start, "count": sub.end - sub.start}
+            self.submit_local_message(mop, local_metadata)
 
     def apply_stashed_op(self, content: Any) -> Any:
         op = content

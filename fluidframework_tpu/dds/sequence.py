@@ -108,24 +108,24 @@ class SharedSegmentSequence(SharedObject):
     def _local_perspective(self):
         return self.engine.current_seq, self.engine.local_client_id
 
-    def _insert(self, pos: int, content: Any, props: Optional[dict]) -> None:
+    def _insert(self, pos: int, content: Any, props: Optional[dict]):
         if self.engine.collaborating:
-            self.engine.insert(
+            seg = self.engine.insert(
                 pos, content, self.engine.current_seq,
                 self.engine.local_client_id, UNASSIGNED_SEQ, props=props,
             )
         else:  # detached: applies as pre-collaboration content
-            self.engine.insert(
+            return self.engine.insert(
                 pos, content, UNIVERSAL_SEQ, NON_COLLAB_CLIENT,
                 UNIVERSAL_SEQ, props=props,
             )
-            return
         if isinstance(content, str):
             op = InsertOp(pos=pos, text=content, props=props)
         else:
             op = InsertOp(pos=pos, seg=content, props=props)
         self._submit_seq_op(op)
         self.emit("sequenceDelta", op, True)
+        return seg
 
     def remove_range(self, start: int, end: int) -> None:
         if self.engine.collaborating:
@@ -300,8 +300,8 @@ class SharedSegmentSequence(SharedObject):
 class SharedString(SharedSegmentSequence):
     """Collaborative text (reference SharedString, sharedString.ts)."""
 
-    def insert_text(self, pos: int, text: str, props: Optional[dict] = None) -> None:
-        self._insert(pos, text, props)
+    def insert_text(self, pos: int, text: str, props: Optional[dict] = None):
+        return self._insert(pos, text, props)
 
     def remove_text(self, start: int, end: int) -> None:
         self.remove_range(start, end)

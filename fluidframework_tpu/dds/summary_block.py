@@ -1,0 +1,45 @@
+"""SharedSummaryBlock: op-free, summary-only data.
+
+Reference packages/dds/shared-summary-block/src/sharedSummaryBlock.ts:38:
+values are written before attach (or by the summarizing client) and
+travel exclusively via summaries — the DDS submits no ops.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+
+class SharedSummaryBlock(SharedObject):
+    def initialize_local_core(self) -> None:
+        self.data: Dict[str, Any] = {}
+
+    def get(self, key: str) -> Any:
+        return self.data.get(key)
+
+    def set(self, key: str, value: Any) -> None:
+        # No op submission: state persists only through summaries.
+        self.data[key] = value
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        raise RuntimeError("SharedSummaryBlock does not process ops")
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        raise RuntimeError("SharedSummaryBlock has no ops to stash")
+
+    def summarize_core(self):
+        return SummaryTreeBuilder().add_json_blob("header", self.data).summary
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.data = json.loads(storage.read("header"))
+
+
+class SummaryBlockFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/shared-summary-block"
+    channel_class = SharedSummaryBlock

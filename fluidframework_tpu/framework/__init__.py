@@ -1,0 +1,31 @@
+"""Framework / public API layer (the reference's packages/framework/*,
+azure/packages/* — what app developers actually touch).
+
+- `fluid_static`: ContainerSchema + FluidContainer + TpuClient — the
+  service-agnostic simple API (framework/fluid-static, azure-client).
+- `data_object`: class-based app objects rooted on a SharedDirectory
+  (framework/aqueduct).
+- `undo_redo`: operation-grouped undo/redo stacks over DDS revertibles
+  (framework/undo-redo).
+- `attributor`: who-wrote-what, seq → {client, timestamp}
+  (framework/attributor).
+- `agent_scheduler`: distributed singleton task election
+  (framework/agent-scheduler).
+"""
+
+from .fluid_static import ContainerSchema, FluidContainer, TpuClient
+from .data_object import DataObject, DataObjectFactory
+from .undo_redo import UndoRedoStackManager
+from .attributor import Attributor
+from .agent_scheduler import AgentScheduler
+
+__all__ = [
+    "AgentScheduler",
+    "Attributor",
+    "ContainerSchema",
+    "DataObject",
+    "DataObjectFactory",
+    "FluidContainer",
+    "TpuClient",
+    "UndoRedoStackManager",
+]

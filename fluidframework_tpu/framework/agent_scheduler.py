@@ -1,0 +1,64 @@
+"""AgentScheduler: distributed-singleton task election.
+
+Mirrors `@fluidframework/agent-scheduler`
+(framework/agent-scheduler/src/scheduler.ts): clients `pick` tasks
+with a worker callback; exactly one connected client runs each task at
+a time, and tasks fail over when their holder leaves. Built on the
+TaskManager DDS's volunteer queues (the reference builds on
+ConsensusRegisterCollection — same server-ack election, newer DDS).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from ..dds.consensus import TaskManager
+
+LEADER_TASK = "__leader__"
+
+
+class AgentScheduler:
+    def __init__(self, task_manager: TaskManager):
+        self.tasks = task_manager
+        self._workers: Dict[str, Callable[[], None]] = {}
+        self._running: set = set()
+        task_manager.on("queueChanged", self._evaluate)
+        task_manager.on("assigned", lambda tid, cid: self._evaluate(tid))
+
+    # ------------------------------------------------------------- picks
+
+    def pick(self, task_id: str, worker: Callable[[], None]) -> None:
+        """Volunteer to run `task_id`; `worker()` fires when (and each
+        time) this client becomes the assignee."""
+        self._workers[task_id] = worker
+        self.tasks.volunteer_for_task(task_id)
+
+    def release(self, task_id: str) -> None:
+        self._workers.pop(task_id, None)
+        self._running.discard(task_id)
+        self.tasks.abandon(task_id)
+
+    def picked(self, task_id: str) -> bool:
+        return self.tasks.assigned(task_id)
+
+    def _evaluate(self, task_id: str) -> None:
+        worker = self._workers.get(task_id)
+        if worker is None:
+            return
+        if self.tasks.assigned(task_id):
+            if task_id not in self._running:
+                self._running.add(task_id)
+                worker()
+        else:
+            self._running.discard(task_id)
+
+    # ---------------------------------------------------------- leadership
+
+    def volunteer_for_leadership(self, on_leader: Callable[[], None]) -> None:
+        """The oldest-volunteer leadership pattern the reference's
+        LeaderElection builds on agent-scheduler."""
+        self.pick(LEADER_TASK, on_leader)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.picked(LEADER_TASK)

@@ -1,0 +1,83 @@
+"""Attribution: who wrote what, when.
+
+Mirrors `@fluid-experimental/attributor`
+(framework/attributor/src/attributor.ts:42 + mixinAttributor): maps
+sequence numbers to {client, timestamp} by observing the op stream,
+with an interned, run-length-packed serialization (the role of the
+reference's LZ4 + string-interning summary encoding,
+src/lz4Encoder.ts / src/stringInterner.ts — here delta+interning,
+which composes with the summary store's own compression).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+from ..protocol.messages import MessageType, SequencedMessage
+
+
+class Attributor:
+    def __init__(self):
+        self.entries: Dict[int, dict] = {}  # seq -> {"client", "timestamp"}
+
+    def record(self, seq: int, client: Any, timestamp: float) -> None:
+        self.entries[seq] = {"client": client, "timestamp": timestamp}
+
+    def get(self, seq: int) -> Optional[dict]:
+        return self.entries.get(seq)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    # ------------------------------------------------------ serialization
+
+    def serialize(self) -> str:
+        """Interned clients + delta-coded seqs/timestamps."""
+        seqs = sorted(self.entries)
+        clients: list = []
+        index: Dict[Any, int] = {}
+        c_ids, d_seqs, d_ts = [], [], []
+        prev_seq, prev_ts = 0, 0
+        for s in seqs:
+            e = self.entries[s]
+            c = e["client"]
+            if c not in index:
+                index[c] = len(clients)
+                clients.append(c)
+            c_ids.append(index[c])
+            d_seqs.append(s - prev_seq)
+            prev_seq = s
+            ts = int(e["timestamp"] * 1000)
+            d_ts.append(ts - prev_ts)
+            prev_ts = ts
+        return json.dumps(
+            {"clients": clients, "seqs": d_seqs, "ts": d_ts, "cids": c_ids}
+        )
+
+    @classmethod
+    def deserialize(cls, data: str) -> "Attributor":
+        obj = json.loads(data)
+        out = cls()
+        seq, ts = 0, 0
+        for ds, dt, ci in zip(obj["seqs"], obj["ts"], obj["cids"]):
+            seq += ds
+            ts += dt
+            out.entries[seq] = {
+                "client": obj["clients"][ci], "timestamp": ts / 1000
+            }
+        return out
+
+
+def mixin_attributor(runtime) -> Attributor:
+    """Attach an attributor to a container runtime's op stream
+    (mixinAttributor role). Returns it; also sets `runtime.attributor`."""
+    attributor = Attributor()
+
+    def on_op(msg: SequencedMessage, local: bool) -> None:
+        if msg.type == MessageType.OP:
+            attributor.record(msg.sequence_number, msg.client_id, msg.timestamp)
+
+    runtime.on("op", on_op)
+    runtime.attributor = attributor
+    return attributor

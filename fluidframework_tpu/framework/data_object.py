@@ -1,0 +1,64 @@
+"""Class-based app objects (the aqueduct role).
+
+Mirrors `DataObject`/`PureDataObject` + `DataObjectFactory`
+(framework/aqueduct/src/data-objects/dataObject.ts:22,
+dataObjectFactory.ts): an app class rooted on a SharedDirectory with
+initialize hooks — `initializing_first_time` on fresh create,
+`initializing_from_existing` on load, `has_initialized` on both.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Type
+
+from ..dds.map import DirectoryFactory, SharedDirectory
+from ..runtime.datastore import DataStoreRuntime
+
+ROOT_ID = "root"
+
+
+class DataObject:
+    """Base app object; `self.root` is its SharedDirectory."""
+
+    def __init__(self, runtime: DataStoreRuntime):
+        self.runtime = runtime
+        self.root: Optional[SharedDirectory] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    def initializing_first_time(self, props: Any = None) -> None:  # pragma: no cover
+        pass
+
+    def initializing_from_existing(self) -> None:  # pragma: no cover
+        pass
+
+    def has_initialized(self) -> None:  # pragma: no cover
+        pass
+
+
+class DataObjectFactory:
+    """Creates/loads a DataObject subclass over a datastore
+    (aqueduct DataObjectFactory)."""
+
+    def __init__(self, object_class: Type[DataObject],
+                 extra_channels: Optional[list] = None):
+        """`extra_channels`: [(channel_id, type_name)] created alongside
+        the root directory on first create."""
+        self.object_class = object_class
+        self.extra_channels = extra_channels or []
+
+    def create(self, runtime: DataStoreRuntime, props: Any = None) -> DataObject:
+        obj = self.object_class(runtime)
+        obj.root = runtime.create_channel(ROOT_ID, DirectoryFactory.type_name)
+        for cid, tname in self.extra_channels:
+            runtime.create_channel(cid, tname)
+        obj.initializing_first_time(props)
+        obj.has_initialized()
+        return obj
+
+    def load(self, runtime: DataStoreRuntime) -> DataObject:
+        obj = self.object_class(runtime)
+        obj.root = runtime.get_channel(ROOT_ID)
+        obj.initializing_from_existing()
+        obj.has_initialized()
+        return obj

@@ -1,0 +1,187 @@
+"""The service-agnostic simple API: schema-declared containers.
+
+Mirrors `@fluidframework/fluid-static` + the service clients
+(`AzureClient`/`TinyliciousClient`): a `ContainerSchema` declares the
+initial objects (framework/fluid-static/src/types.ts:85), a
+`FluidContainer` exposes them (src/fluidContainer.ts:201), and
+`TpuClient` creates/loads containers against an ordering service
+(azure/packages/azure-client/src/AzureClient.ts:51,77,144 — here the
+service is anything with the LocalServer surface: connect /
+upload_summary / download_summary).
+
+The default channel registry includes every built-in DDS family, so
+dynamic create of any type works out of the box (the reference's
+`dynamicObjectTypes`).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..dds import (
+    CellFactory,
+    ConsensusQueueFactory,
+    CounterFactory,
+    DirectoryFactory,
+    InkFactory,
+    MapFactory,
+    MatrixFactory,
+    PactMapFactory,
+    RegisterCollectionFactory,
+    StringFactory,
+    SummaryBlockFactory,
+    TaskManagerFactory,
+)
+from ..runtime import ChannelRegistry, ContainerRuntime
+from ..runtime.summary import SummaryTree
+from ..utils.events import EventEmitter
+
+DEFAULT_DATASTORE = "default"
+
+
+def default_registry() -> ChannelRegistry:
+    return ChannelRegistry(
+        [
+            MapFactory(),
+            DirectoryFactory(),
+            CellFactory(),
+            CounterFactory(),
+            StringFactory(),
+            MatrixFactory(),
+            ConsensusQueueFactory(),
+            RegisterCollectionFactory(),
+            TaskManagerFactory(),
+            PactMapFactory(),
+            InkFactory(),
+            SummaryBlockFactory(),
+        ]
+    )
+
+
+@dataclass
+class ContainerSchema:
+    """{name: DDS type} for the objects every container of this schema
+    starts with (reference ContainerSchema.initialObjects, types.ts:85).
+    Values may be factory classes, factory instances, or type-name
+    strings."""
+
+    initial_objects: Dict[str, Any] = field(default_factory=dict)
+
+    def type_name(self, value: Any) -> str:
+        if isinstance(value, str):
+            return value
+        if isinstance(value, type):
+            return value.type_name
+        return value.type_name
+
+
+class FluidContainer(EventEmitter):
+    """App-facing container wrapper (fluidContainer.ts:201)."""
+
+    def __init__(self, runtime: ContainerRuntime, schema: ContainerSchema,
+                 client: "TpuClient", doc_id: Optional[str] = None):
+        super().__init__()
+        self.runtime = runtime
+        self.schema = schema
+        self._client = client
+        self.doc_id = doc_id
+        runtime.on("connected", lambda cid: self.emit("connected", cid))
+        runtime.on("disconnected", lambda: self.emit("disconnected"))
+        runtime.on("saved", lambda: self.emit("saved"))
+
+    @property
+    def initial_objects(self) -> Dict[str, Any]:
+        ds = self.runtime.get_datastore(DEFAULT_DATASTORE)
+        return {name: ds.get_channel(name) for name in self.schema.initial_objects}
+
+    def create(self, type_name_or_factory: Any, channel_id: Optional[str] = None):
+        """Dynamically create another DDS (FluidContainer.create)."""
+        tname = self.schema.type_name(type_name_or_factory)
+        ds = self.runtime.get_datastore(DEFAULT_DATASTORE)
+        cid = channel_id or f"dyn-{uuid.uuid4().hex[:8]}"
+        ch = ds.create_channel(cid, tname)
+        if self.runtime.connection is not None:
+            # Announce first so the attach op sequences ahead of the
+            # channel's own ops, then go live.
+            self.runtime.submit_attach_op(DEFAULT_DATASTORE, ch)
+            ds.attach_channel(ch)
+            ch.on_connected()
+        return ch
+
+    @property
+    def attach_state(self) -> str:
+        return "Attached" if self.doc_id is not None else "Detached"
+
+    @property
+    def is_dirty(self) -> bool:
+        return self.runtime.is_dirty
+
+    def attach(self, doc_id: Optional[str] = None) -> str:
+        """Promote a detached container to a live document
+        (container.ts:1056 attach): persist the attach summary, then
+        connect."""
+        if self.doc_id is not None:
+            raise RuntimeError("already attached")
+        return self._client._attach(self, doc_id)
+
+    def connect(self) -> None:
+        self._client._connect(self)
+
+    def disconnect(self) -> None:
+        self.runtime.disconnect()
+
+    def flush(self) -> None:
+        self.runtime.flush()
+
+    def dispose(self) -> None:
+        self.runtime.disconnect()
+        self.emit("disposed")
+
+
+class TpuClient:
+    """Service client (AzureClient.ts:51 shape) over any server with
+    the LocalServer surface."""
+
+    def __init__(self, server, registry: Optional[ChannelRegistry] = None):
+        self.server = server
+        self.registry = registry or default_registry()
+
+    # ------------------------------------------------------------ create
+
+    def create_container(self, schema: ContainerSchema) -> FluidContainer:
+        """A detached container with the schema's initial objects
+        (AzureClient.createContainer :77)."""
+        rt = ContainerRuntime(self.registry)
+        ds = rt.create_datastore(DEFAULT_DATASTORE)
+        for name, t in schema.initial_objects.items():
+            ds.create_channel(name, schema.type_name(t))
+        return FluidContainer(rt, schema, self)
+
+    def _attach(self, container: FluidContainer, doc_id: Optional[str]) -> str:
+        doc_id = doc_id or uuid.uuid4().hex[:12]
+        wire = container.runtime.summarize().to_json()
+        handle = self.server.upload_summary(wire)
+        self.server.storage.set_ref(doc_id, handle)
+        container.doc_id = doc_id
+        self._connect(container)
+        return doc_id
+
+    def _connect(self, container: FluidContainer) -> None:
+        assert container.doc_id is not None, "attach before connecting"
+        container.runtime.connect(self.server.connect(container.doc_id))
+
+    # -------------------------------------------------------------- load
+
+    def get_container(self, doc_id: str, schema: ContainerSchema) -> FluidContainer:
+        """Load the latest summary and catch up (AzureClient
+        .getContainer :144)."""
+        rt = ContainerRuntime(self.registry)
+        wire = self.server.download_summary(doc_id)
+        if wire is None:
+            raise KeyError(f"unknown document {doc_id!r}")
+        rt.load(SummaryTree.from_json(wire))
+        container = FluidContainer(rt, schema, self, doc_id=doc_id)
+        self._connect(container)
+        return container

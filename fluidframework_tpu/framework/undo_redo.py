@@ -1,0 +1,245 @@
+"""Undo/redo over DDS revertibles.
+
+Mirrors `@fluidframework/undo-redo`
+(framework/undo-redo/src/undoRedoStackManager.ts:84 + the
+SharedMap/sequence handlers): local DDS changes push *revertibles*
+onto the current operation; `close_current_operation` groups them;
+undo pops a group and reverts it (pushing the inverse group onto the
+redo stack).
+
+Handlers provided:
+- `SharedMapUndoRedoHandler` (sharedMapHandler)
+- `SharedStringUndoRedoHandler` (sequenceHandler.ts:66 + merge-tree
+  revertibles, dds/merge-tree/src/revertibles.ts) — insert and remove
+  revert; annotate reverts to the prior property values.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Protocol
+
+from ..protocol.mergetree_ops import AnnotateOp, InsertOp, RemoveOp
+
+
+class Revertible(Protocol):
+    def revert(self) -> None: ...
+
+
+class UndoRedoStackManager:
+    """Operation-grouped undo/redo stacks (undoRedoStackManager.ts:84)."""
+
+    def __init__(self):
+        self._undo: List[List[Revertible]] = []
+        self._redo: List[List[Revertible]] = []
+        self._current: Optional[List[Revertible]] = None
+        self._reverting = False
+        self._revert_target: Optional[List[Revertible]] = None
+
+    # ------------------------------------------------------ accumulation
+
+    def push(self, revertible: Revertible) -> None:
+        if self._reverting:
+            self._revert_target.append(revertible)
+            return
+        if self._current is None:
+            self._current = []
+            self._undo.append(self._current)
+        self._current.append(revertible)
+        self._redo.clear()
+
+    def close_current_operation(self) -> None:
+        self._current = None
+
+    # ------------------------------------------------------------ revert
+
+    def _revert_group(self, group: List[Revertible], into: List[List[Revertible]]) -> None:
+        self._reverting = True
+        self._revert_target = []
+        try:
+            for r in reversed(group):
+                r.revert()
+        finally:
+            self._reverting = False
+        into.append(self._revert_target)
+        self._revert_target = None
+
+    def undo_operation(self) -> bool:
+        if not self._undo:
+            return False
+        self.close_current_operation()
+        self._revert_group(self._undo.pop(), self._redo)
+        return True
+
+    def redo_operation(self) -> bool:
+        if not self._redo:
+            return False
+        self._revert_group(self._redo.pop(), self._undo)
+        return True
+
+    @property
+    def undo_stack_size(self) -> int:
+        return len(self._undo)
+
+
+# ---------------------------------------------------------------------------
+# Handlers
+# ---------------------------------------------------------------------------
+
+
+class _MapRevertible:
+    def __init__(self, shared_map, key: str, had: bool, prev: Any):
+        self.map = shared_map
+        self.key = key
+        self.had = had
+        self.prev = prev
+
+    def revert(self) -> None:
+        if self.had:
+            self.map.set(self.key, self.prev)
+        else:
+            self.map.delete(self.key)
+
+
+class SharedMapUndoRedoHandler:
+    """Tracks local SharedMap sets/deletes (sharedMapHandler role).
+    Attach to a map by constructing; detach via `close()`."""
+
+    def __init__(self, stack: UndoRedoStackManager, shared_map):
+        self.stack = stack
+        self.map = shared_map
+        self._snapshot = dict(shared_map.kernel.data)
+        self._sub = shared_map.on("valueChanged", self._on_change)
+
+    def _on_change(self, key: Optional[str], local: bool) -> None:
+        if not local or key is None:
+            self._snapshot = dict(self.map.kernel.data)
+            return
+        had = key in self._snapshot
+        prev = self._snapshot.get(key)
+        self.stack.push(_MapRevertible(self.map, key, had, prev))
+        self._snapshot = dict(self.map.kernel.data)
+
+    def close(self) -> None:
+        self.map.off("valueChanged", self._on_change)
+
+
+class _InsertRevertible:
+    """Tracks the inserted segments (the reference's TrackingGroup
+    role, merge-tree revertibles.ts): segments removed and later
+    re-inserted by an intervening undo substitute in via
+    `replace_segment`."""
+
+    def __init__(self, shared_string, grp):
+        self.s = shared_string
+        # Track the group's live segment list: splits append tails to
+        # it, so the tracked set follows fragmentation.
+        self.grp = grp
+
+    def replace_segment(self, old, new) -> None:
+        self.grp.segments[:] = [
+            new if t is old else t for t in self.grp.segments
+        ]
+
+    def revert(self) -> None:
+        eng = self.s.engine
+        live = [seg for seg in self.grp.segments if seg.removed_seq is None]
+        for seg in live:
+            pos = None
+            acc = 0
+            for t in eng.segments:
+                if t is seg:
+                    pos = acc
+                    break
+                cat, length = eng._vis(t, eng.current_seq, eng.local_client_id)
+                if cat.value:  # not SKIP
+                    acc += length
+            if pos is not None and len(seg) > 0:
+                self.s.remove_range(pos, pos + len(seg))
+
+
+class _RemoveRevertible:
+    def __init__(self, handler, spans):
+        self.handler = handler
+        self.s = handler.s
+        self.spans = spans  # [(pos, old_segment, content, props)]
+
+    def revert(self) -> None:
+        for pos, old_seg, content, props in self.spans:
+            pos = min(pos, self.s.get_length())
+            new_seg = self.s.insert_text(pos, content, props=props)
+            if new_seg is not None:
+                self.handler.substitute(old_seg, new_seg)
+
+
+class _AnnotateRevertible:
+    def __init__(self, shared_string, spans):
+        self.s = shared_string
+        self.spans = spans  # [(start, end, prior_props_per_key)]
+
+    def revert(self) -> None:
+        for start, end, prior in self.spans:
+            end = min(end, self.s.get_length())
+            if start < end and prior:
+                self.s.annotate_range(start, end, prior)
+
+
+class SharedStringUndoRedoHandler:
+    """Tracks local SharedString edits (sequenceHandler.ts:66 +
+    merge-tree revertibles)."""
+
+    def __init__(self, stack: UndoRedoStackManager, shared_string):
+        self.stack = stack
+        self.s = shared_string
+        self._sub = shared_string.on("sequenceDelta", self._on_delta)
+
+    def substitute(self, old_seg, new_seg) -> None:
+        """A removed segment was re-materialized by an undo: update
+        every revertible tracking the old segment."""
+        groups = list(self.stack._undo) + list(self.stack._redo)
+        if self.stack._revert_target is not None:
+            groups.append(self.stack._revert_target)
+        for group in groups:
+            for r in group:
+                if hasattr(r, "replace_segment"):
+                    r.replace_segment(old_seg, new_seg)
+
+    def _on_delta(self, op, local: bool) -> None:
+        if not local:
+            return
+        if isinstance(op, InsertOp):
+            grp = self.s.engine.pending[-1] if self.s.engine.pending else None
+            if grp is not None:
+                self.stack.push(_InsertRevertible(self.s, grp))
+        elif isinstance(op, RemoveOp):
+            grp = self.s.engine.pending[-1] if self.s.engine.pending else None
+            spans = []
+            if grp is not None:
+                pos = op.start
+                for seg in grp.segments:
+                    if isinstance(seg.content, str):
+                        spans.append(
+                            (pos, seg, seg.content,
+                             dict(seg.props) if seg.props else None)
+                        )
+                        pos += len(seg.content)
+            self.stack.push(_RemoveRevertible(self, spans))
+        elif isinstance(op, AnnotateOp):
+            # Capture prior values per covered span so undo restores
+            # (including deleting keys that didn't exist: None value).
+            grp = self.s.engine.pending[-1] if self.s.engine.pending else None
+            spans = []
+            if grp is not None:
+                start = op.start
+                for seg in grp.segments:
+                    prior = {}
+                    for key in op.props:
+                        # current props already have the new value; the
+                        # pre-state is unknown here, so record deletion
+                        # semantics for fresh keys only.
+                        prior[key] = None
+                    spans.append((start, start + len(seg), prior))
+                    start += len(seg)
+            self.stack.push(_AnnotateRevertible(self.s, spans))
+
+    def close(self) -> None:
+        self.s.off("sequenceDelta", self._on_delta)

@@ -182,6 +182,9 @@ class ContainerRuntime(EventEmitter):
         self._pending.clear()
         self._outbox.clear()
         for pm in replay:
+            if pm.envelope.channel is None:
+                self._submit_op(pm.envelope, None)  # attach op: as-is
+                continue
             ds = self.datastores[pm.envelope.datastore]
             ds.resubmit(pm.envelope.channel, pm.envelope.contents, pm.local_metadata)
         self.flush()
@@ -209,6 +212,25 @@ class ContainerRuntime(EventEmitter):
         self._emit("disconnected")
 
     # ----------------------------------------------------------- outbound
+
+    def submit_attach_op(self, datastore_id: str, channel) -> None:
+        """Announce a dynamically created channel to the session
+        (reference attach ops, dataStoreRuntime bindChannel →
+        attachGraph): carries the channel's type + attach summary so
+        replicas that booted from an older summary can realize it."""
+        self._submit_op(
+            Envelope(
+                datastore_id,
+                None,  # runtime-level op, not routed to a channel
+                {
+                    "type": "attach",
+                    "channel": channel.id,
+                    "channelType": channel.attributes.type,
+                    "summary": channel.get_attach_summary().to_json(),
+                },
+            ),
+            None,
+        )
 
     def _submit_op(self, envelope: Envelope, local_metadata: Any) -> None:
         if self.connection is None and not self._ever_connected:
@@ -245,18 +267,19 @@ class ContainerRuntime(EventEmitter):
             pm.client_id = self.client_id
             pm.batch_meta = meta
             self._pending.append(pm)
+            if pm.envelope.channel is None:  # runtime-level (attach) op
+                inner = pm.envelope.contents
+            else:
+                inner = {
+                    "address": pm.envelope.channel,
+                    "contents": pm.envelope.contents,
+                }
             self.connection.submit(
                 DocumentMessage(
                     client_seq=pm.client_seq,
                     ref_seq=pm.ref_seq,
                     type=MessageType.OP,
-                    contents={
-                        "address": pm.envelope.datastore,
-                        "contents": {
-                            "address": pm.envelope.channel,
-                            "contents": pm.envelope.contents,
-                        },
-                    },
+                    contents={"address": pm.envelope.datastore, "contents": inner},
                     metadata=meta,
                 )
             )
@@ -346,6 +369,10 @@ class ContainerRuntime(EventEmitter):
             )
         outer = msg.contents
         inner = outer["contents"]
+        if isinstance(inner, dict) and inner.get("type") == "attach":
+            self._process_attach(outer["address"], inner, local)
+            self._emit("op", msg, local)
+            return
         ds = self.datastores.get(outer["address"])
         if ds is None or inner["address"] not in ds.channels:
             node = f"/{outer['address']}" if ds is None else (
@@ -386,6 +413,30 @@ class ContainerRuntime(EventEmitter):
 
     # ---------------------------------------------------------- summaries
 
+    def _process_attach(self, datastore_id: str, attach: dict, local: bool) -> None:
+        """Realize a remotely created channel from its attach op
+        (RemoteChannelContext creation, remoteChannelContext.ts:39)."""
+        if local:
+            return  # we created it
+        ds = self.datastores.get(datastore_id)
+        if ds is None or attach["channel"] in ds.channels:
+            return
+        from .channel import ChannelAttributes, ChannelServices, ChannelStorage
+
+        factory = self.registry.get(attach["channelType"])
+        summary = SummaryTree.from_json(attach["summary"])
+        services = ChannelServices(
+            ds._connection_for(attach["channel"]),
+            ChannelStorage(summary.flatten()),
+        )
+        ch = factory.load(
+            ds, attach["channel"], services,
+            ChannelAttributes(type=attach["channelType"]),
+        )
+        ds.channels[attach["channel"]] = ch
+        if ds.client_id is not None:
+            ch.on_connected()
+
     def summarize(self) -> SummaryTree:
         """Container summary: one subtree per datastore under
         ".channels", plus runtime metadata (the shape of reference
@@ -414,6 +465,10 @@ class ContainerRuntime(EventEmitter):
                 },
             },
         )
+        # Protocol state (quorum + proposals) rides the summary, as the
+        # reference's .protocol tree does (scribeHelper.ts): clients
+        # booting from the summary see the same membership/proposals.
+        builder.add_json_blob(".protocol", self.protocol.snapshot())
         if self.gc is not None:
             builder.add_json_blob(".gc", self.gc.state())
         return builder.summary
@@ -434,6 +489,10 @@ class ContainerRuntime(EventEmitter):
                 did, root=roots.get(did, {}).get("root", True)
             )
             ds.load(node)
+        if ".protocol" in summary.entries:
+            self.protocol = ProtocolOpHandler.from_snapshot(
+                _json.loads(summary.get_blob(".protocol"))
+            )
         if ".gc" in summary.entries:
             self.attach_gc()
             self.gc.load_state(_json.loads(summary.get_blob(".gc")))

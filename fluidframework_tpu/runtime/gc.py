@@ -71,8 +71,15 @@ class GarbageCollector:
     Nodes are "/<ds>" and "/<ds>/<channel>". A datastore created with
     root=True is a GC root. A channel is referenced iff its datastore
     is referenced or a handle points at it. `sweep_grace` is measured
-    in sequence numbers (the reference uses wall-clock sessionExpiry;
-    seq-space is the deterministic analog).
+    in sequence numbers (the reference uses wall-clock sessionExpiry).
+
+    Coordination model (as in the reference): GC runs as part of
+    summarization — the single elected summarizer calls collect(), and
+    the resulting unreferenced/tombstone state rides the summary
+    (SummaryManager wires this). Replicas therefore agree on GC state
+    at every summary boundary; tombstones absorb any straggler ops in
+    between. Ad-hoc collect() calls on multiple replicas are *not*
+    coordinated — use them only single-replica or in tests.
     """
 
     def __init__(self, runtime, sweep_grace: int = 0):

@@ -123,7 +123,11 @@ class SummaryManager:
 
     def summarize_now(self) -> str:
         """Serialize → upload → submit the summarize op. Returns the
-        storage handle (SURVEY.md §3.5 submitSummary)."""
+        storage handle (SURVEY.md §3.5 submitSummary). GC runs here —
+        the summarizer is the coordination point for GC state (the
+        reference runs collectGarbage inside submitSummary)."""
+        if self.runtime.gc is not None:
+            self.runtime.gc.collect()
         wire = self.runtime.summarize().to_json()
         handle = self.storage.upload_summary(wire)
         self._summary_in_flight = True

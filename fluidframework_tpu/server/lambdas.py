@@ -40,6 +40,7 @@ from ..protocol.messages import (
     SequencedMessage,
 )
 from ..protocol.quorum import ProtocolOpHandler
+from ..utils.events import BufferedListener
 from .castore import ContentAddressedStore
 from .log import LogConsumer, MessageLog
 from .sequencer import DocumentSequencer
@@ -287,34 +288,18 @@ class ScribeLambda:
 # --------------------------------------------------------------------------
 
 
-class _Socket:
+class _Socket(BufferedListener):
     """One client's connection through alfred (the shape ContainerRuntime
     expects: submit/listener/nack_listener/client_id/catch_up/disconnect)."""
 
     def __init__(self, server: "LocalServer", doc_id: str, client_id: int):
+        super().__init__()
         self.server = server
         self.doc_id = doc_id
         self.client_id = client_id
-        self._listener: Optional[Callable[[SequencedMessage], None]] = None
         self.nack_listener: Optional[Callable[[NackMessage], None]] = None
         self.connected = True
         self.join_seq = 0
-        # Ops delivered before the client assigned a listener buffer
-        # here and drain on assignment (the reference driver's
-        # early-op queueing, driver-base/src/documentDeltaConnection.ts:42).
-        self._backlog: List[SequencedMessage] = []
-
-    @property
-    def listener(self):
-        return self._listener
-
-    @listener.setter
-    def listener(self, fn) -> None:
-        self._listener = fn
-        if fn is not None:
-            backlog, self._backlog = self._backlog, []
-            for msg in backlog:
-                fn(msg)
 
     # broadcaster side
     def deliver(self, msg: SequencedMessage) -> None:
@@ -325,10 +310,7 @@ class _Socket:
                 return  # own join: surfaced via catch_up, not live
         if not self.connected or msg.sequence_number <= self.join_seq or self.join_seq == 0:
             return
-        if self._listener is None:
-            self._backlog.append(msg)
-        else:
-            self._listener(msg)
+        self._dispatch(msg)
 
     def nack(self, msg: NackMessage) -> None:
         if self.connected and self.nack_listener is not None:

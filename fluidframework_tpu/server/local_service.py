@@ -14,47 +14,26 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Union
 
 from ..protocol.messages import DocumentMessage, NackMessage, SequencedMessage
+from ..utils.events import BufferedListener
 from .sequencer import DocumentSequencer
 
 Listener = Callable[[SequencedMessage], None]
 NackListener = Callable[[NackMessage], None]
 
 
-class _Connection:
+class _Connection(BufferedListener):
     def __init__(self, service: "LocalOrderingService", doc_id: str, client_id: int):
+        super().__init__()
         self.service = service
         self.doc_id = doc_id
         self.client_id = client_id
-        self._listener: Optional[Listener] = None
         self.nack_listener: Optional[NackListener] = None
         self.connected = True
-        # Messages fanned out before a listener is assigned buffer here
-        # and drain on assignment (early-op queueing,
-        # driver-base/src/documentDeltaConnection.ts:42).
-        self._backlog: List[SequencedMessage] = []
         # Sequence number of this connection's join message: live
         # delivery covers strictly-later messages; everything at/before
         # it is fetched via catch_up (so a joiner never double-receives
         # messages queued before it connected).
         self.join_seq = 0
-
-    @property
-    def listener(self) -> Optional[Listener]:
-        return self._listener
-
-    @listener.setter
-    def listener(self, fn: Optional[Listener]) -> None:
-        self._listener = fn
-        if fn is not None:
-            backlog, self._backlog = self._backlog, []
-            for m in backlog:
-                fn(m)
-
-    def _receive(self, msg: SequencedMessage) -> None:
-        if self._listener is None:
-            self._backlog.append(msg)
-        else:
-            self._listener(msg)
 
     def submit(self, msg: DocumentMessage) -> None:
         if not self.connected:
@@ -151,7 +130,7 @@ class LocalOrderingService:
     def _fan_out(self, doc_id: str, msg: SequencedMessage) -> None:
         for conn in list(self.connections.get(doc_id, [])):
             if conn.connected and msg.sequence_number > conn.join_seq:
-                conn._receive(msg)
+                conn._dispatch(msg)
 
     # --------------------------------------------------- deferred drain
 

@@ -1,0 +1,90 @@
+"""Layered configuration / feature gates.
+
+Mirrors the reference's config system
+(packages/utils/telemetry-utils/src/config.ts:13,164):
+`ConfigProvider` resolves typed values through an ordered provider
+chain (first hit wins), and `MonitoringContext` bundles logger +
+config — the pair injected at every constructor boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+from .telemetry import TelemetryLogger
+
+RawProvider = Union[Dict[str, Any], Callable[[str], Any]]
+
+
+class ConfigProvider:
+    """Ordered lookup over raw providers with typed accessors
+    (CachedConfigProvider, config.ts:164)."""
+
+    def __init__(self, providers: Optional[List[RawProvider]] = None):
+        self._providers: List[Callable[[str], Any]] = []
+        self._cache: Dict[str, Any] = {}
+        for p in providers or []:
+            self.add_provider(p)
+
+    def add_provider(self, provider: RawProvider) -> None:
+        if isinstance(provider, dict):
+            self._providers.append(provider.get)
+        else:
+            self._providers.append(provider)
+        self._cache.clear()
+
+    def _raw(self, key: str) -> Any:
+        if key in self._cache:
+            return self._cache[key]
+        for p in self._providers:
+            try:
+                value = p(key)
+            except Exception:
+                value = None
+            if value is not None:
+                self._cache[key] = value
+                return value
+        self._cache[key] = None
+        return None
+
+    def get_bool(self, key: str, default: Optional[bool] = None) -> Optional[bool]:
+        v = self._raw(key)
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, str):
+            if v.lower() in ("true", "1"):
+                return True
+            if v.lower() in ("false", "0"):
+                return False
+        return default
+
+    def get_number(self, key: str, default: Optional[float] = None) -> Optional[float]:
+        v = self._raw(key)
+        if isinstance(v, bool):
+            return default
+        if isinstance(v, (int, float)):
+            return v
+        if isinstance(v, str):
+            try:
+                return float(v)
+            except ValueError:
+                return default
+        return default
+
+    def get_string(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        v = self._raw(key)
+        return v if isinstance(v, str) else default
+
+
+class MonitoringContext:
+    """logger + config pair (mixinMonitoringContext, config.ts)."""
+
+    def __init__(self, logger: Optional[TelemetryLogger] = None,
+                 config: Optional[ConfigProvider] = None):
+        self.logger = logger or TelemetryLogger()
+        self.config = config or ConfigProvider()
+
+    def child(self, namespace: str) -> "MonitoringContext":
+        from .telemetry import ChildLogger
+
+        return MonitoringContext(ChildLogger(self.logger, namespace), self.config)

@@ -11,6 +11,38 @@ from __future__ import annotations
 from typing import Callable, Dict, List
 
 
+class BufferedListener:
+    """Mixin for connection-like objects: messages dispatched before a
+    listener is assigned buffer and drain, in order, on assignment
+    (the reference driver's early-op queueing,
+    drivers/driver-base/src/documentDeltaConnection.ts:42).
+
+    Subclasses call `_dispatch(msg)`; consumers assign `.listener`.
+    """
+
+    def __init__(self):
+        self._listener = None
+        self._backlog = []
+
+    @property
+    def listener(self):
+        return self._listener
+
+    @listener.setter
+    def listener(self, fn) -> None:
+        self._listener = fn
+        if fn is not None:
+            backlog, self._backlog = self._backlog, []
+            for msg in backlog:
+                fn(msg)
+
+    def _dispatch(self, msg) -> None:
+        if self._listener is None:
+            self._backlog.append(msg)
+        else:
+            self._listener(msg)
+
+
 class EventEmitter:
     def __init__(self):
         self._listeners: Dict[str, List[Callable]] = {}

@@ -1,0 +1,154 @@
+"""Telemetry: logger hierarchy, performance spans, structured metrics.
+
+Mirrors the reference's client telemetry
+(packages/utils/telemetry-utils/src/logger.ts — TelemetryLogger /
+ChildLogger / PerformanceEvent / MockLogger) and the server's
+Lumberjack structured-metric API
+(server/routerlicious/packages/services-telemetry): one module serves
+both roles, since the TPU build runs client and service in one
+process tree.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class TelemetryLogger:
+    """Base logger: `send(event)` with category/eventName properties
+    (logger.ts TelemetryLogger)."""
+
+    def __init__(self, namespace: str = "", properties: Optional[dict] = None):
+        self.namespace = namespace
+        self.properties = dict(properties or {})
+        self._sinks: List[Callable[[dict], None]] = []
+
+    def add_sink(self, fn: Callable[[dict], None]) -> None:
+        self._sinks.append(fn)
+
+    def send(self, event: dict) -> None:
+        out = dict(self.properties)
+        out.update(event)
+        if self.namespace and "eventName" in out:
+            out["eventName"] = f"{self.namespace}:{out['eventName']}"
+        for fn in self._sinks:
+            fn(out)
+
+    # convenience categories (logger.ts sendTelemetryEvent & friends)
+    def send_telemetry_event(self, name: str, **props) -> None:
+        self.send({"category": "generic", "eventName": name, **props})
+
+    def send_error_event(self, name: str, error: Any = None, **props) -> None:
+        self.send(
+            {"category": "error", "eventName": name, "error": repr(error), **props}
+        )
+
+    def send_performance_event(self, name: str, duration_ms: float, **props) -> None:
+        self.send(
+            {"category": "performance", "eventName": name,
+             "durationMs": duration_ms, **props}
+        )
+
+
+class ChildLogger(TelemetryLogger):
+    """Namespaced child forwarding to its parent (logger.ts ChildLogger)."""
+
+    def __init__(self, parent: TelemetryLogger, namespace: str,
+                 properties: Optional[dict] = None):
+        full = f"{parent.namespace}:{namespace}" if parent.namespace else namespace
+        super().__init__(full, {**parent.properties, **(properties or {})})
+        self._parent = parent
+
+    def send(self, event: dict) -> None:
+        out = dict(self.properties)
+        out.update(event)
+        if "eventName" in out:
+            out["eventName"] = f"{self.namespace}:{out['eventName']}"
+        self._parent.send(out)  # parent applies its sinks
+
+    @classmethod
+    def create(cls, parent: TelemetryLogger, namespace: str,
+               properties: Optional[dict] = None) -> "ChildLogger":
+        return cls(parent, namespace, properties)
+
+
+class PerformanceEvent:
+    """Timed span reporting start/end/cancel (logger.ts
+    PerformanceEvent). Use as a context manager."""
+
+    def __init__(self, logger: TelemetryLogger, name: str, **props):
+        self.logger = logger
+        self.name = name
+        self.props = props
+        self._start = 0.0
+
+    def __enter__(self) -> "PerformanceEvent":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        dur = (time.perf_counter() - self._start) * 1000
+        if exc is None:
+            self.logger.send_performance_event(self.name, dur, **self.props)
+        else:
+            self.logger.send_error_event(
+                f"{self.name}_cancel", exc, durationMs=dur, **self.props
+            )
+
+
+class MockLogger(TelemetryLogger):
+    """Captures events for assertions (mockLogger.ts)."""
+
+    def __init__(self):
+        super().__init__()
+        self.events: List[dict] = []
+        self.add_sink(self.events.append)
+
+    def matches(self, expected: dict) -> bool:
+        return any(
+            all(e.get(k) == v for k, v in expected.items()) for e in self.events
+        )
+
+
+class Lumberjack:
+    """Structured server metrics (services-telemetry): named metrics
+    with properties + success/failure terminal states."""
+
+    _sinks: List[Callable[[dict], None]] = []
+
+    @classmethod
+    def add_sink(cls, fn: Callable[[dict], None]) -> None:
+        cls._sinks.append(fn)
+
+    @classmethod
+    def new_metric(cls, name: str, **props) -> "LumberMetric":
+        return LumberMetric(name, props, cls._sinks)
+
+
+class LumberMetric:
+    def __init__(self, name: str, props: Dict[str, Any], sinks):
+        self.name = name
+        self.props = dict(props)
+        self._sinks = sinks
+        self._start = time.perf_counter()
+
+    def set_property(self, key: str, value: Any) -> None:
+        self.props[key] = value
+
+    def _emit(self, status: str, message: str = "") -> None:
+        event = {
+            "metric": self.name,
+            "status": status,
+            "message": message,
+            "durationMs": (time.perf_counter() - self._start) * 1000,
+            **self.props,
+        }
+        for fn in self._sinks:
+            fn(event)
+
+    def success(self, message: str = "") -> None:
+        self._emit("success", message)
+
+    def error(self, message: str = "") -> None:
+        self._emit("error", message)

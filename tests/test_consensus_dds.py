@@ -1,0 +1,203 @@
+"""Consensus-family DDS tests: queue leases, versioned registers,
+task locks, pacts, ink, summary blocks — including quorum-leave
+cleanup driven through the real protocol stream.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds import (
+    READ_ATOMIC,
+    READ_LWW,
+    ConsensusQueueFactory,
+    InkFactory,
+    PactMapFactory,
+    RegisterCollectionFactory,
+    SummaryBlockFactory,
+    TaskManagerFactory,
+)
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.summary import SummaryTree
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+REGISTRY = ChannelRegistry(
+    [
+        ConsensusQueueFactory(),
+        RegisterCollectionFactory(),
+        TaskManagerFactory(),
+        PactMapFactory(),
+        InkFactory(),
+        SummaryBlockFactory(),
+    ]
+)
+
+
+def make_harness(n, channels):
+    return MultiClientHarness(n, REGISTRY, channel_types=list(channels))
+
+
+# ------------------------------------------------------------ ConsensusQueue
+
+
+def test_queue_acquire_order_and_complete():
+    h = make_harness(2, [("q", ConsensusQueueFactory.type_name)])
+    a, b = h.channel(0, "q"), h.channel(1, "q")
+    a.add("job1")
+    a.add("job2")
+    h.process_all()
+    got_a, got_b = [], []
+    a.acquire(got_a.append)
+    b.acquire(got_b.append)
+    h.process_all()
+    assert got_a[0]["value"] == "job1"  # a's acquire sequenced first
+    assert got_b[0]["value"] == "job2"
+    assert a.in_flight == b.in_flight
+    a.complete(got_a[0]["id"])
+    h.process_all()
+    assert got_a[0]["id"] not in b.in_flight
+
+
+def test_queue_release_and_leave_requeue():
+    h = make_harness(2, [("q", ConsensusQueueFactory.type_name)])
+    a, b = h.channel(0, "q"), h.channel(1, "q")
+    a.add("task")
+    h.process_all()
+    got = []
+    b.acquire(got.append)
+    h.process_all()
+    assert got[0]["value"] == "task" and len(b.queue) == 0
+    # b leaves: its lease returns to the queue on every replica.
+    h.runtimes[1].connection.disconnect()
+    h.process_all()
+    assert len(a.queue) == 1 and a.queue[0]["value"] == "task"
+    assert not a.in_flight
+
+
+def test_queue_acquire_empty_returns_none():
+    h = make_harness(1, [("q", ConsensusQueueFactory.type_name)])
+    a = h.channel(0, "q")
+    got = []
+    a.acquire(got.append)
+    h.process_all()
+    assert got == [None]
+
+
+# ------------------------------------------------ ConsensusRegisterCollection
+
+
+def test_register_concurrent_writes_keep_versions():
+    h = make_harness(2, [("r", RegisterCollectionFactory.type_name)])
+    a, b = h.channel(0, "r"), h.channel(1, "r")
+    a.write("k", "from-a")
+    b.write("k", "from-b")  # concurrent: b hasn't seen a's write
+    h.process_all()
+    # Both versions survive; atomic = first sequenced, LWW = last.
+    assert a.read_versions("k") == b.read_versions("k") == ["from-a", "from-b"]
+    assert a.read("k", READ_ATOMIC) == "from-a"
+    assert a.read("k", READ_LWW) == "from-b"
+    # A later (non-concurrent) write supersedes all seen versions.
+    a.write("k", "final")
+    h.process_all()
+    assert b.read_versions("k") == ["final"]
+
+
+# ------------------------------------------------------------- TaskManager
+
+
+def test_task_manager_lock_passes_on_abandon_and_leave():
+    h = make_harness(3, [("t", TaskManagerFactory.type_name)])
+    ts = [h.channel(i, "t") for i in range(3)]
+    for t in ts:
+        t.volunteer_for_task("leader")
+    h.process_all()
+    assert ts[0].assigned("leader")
+    assert not ts[1].assigned("leader")
+    ts[0].abandon("leader")
+    h.process_all()
+    assert ts[1].assigned("leader")
+    assert ts[2].queued("leader")
+    # Holder crashes: lock passes via quorum leave.
+    h.runtimes[1].connection.disconnect()
+    h.process_all()
+    assert ts[2].assigned("leader")
+
+
+# ---------------------------------------------------------------- PactMap
+
+
+def test_pact_map_first_sequenced_wins_commits_on_msn():
+    h = make_harness(2, [("p", PactMapFactory.type_name)])
+    a, b = h.channel(0, "p"), h.channel(1, "p")
+    a.set("color", "red")
+    b.set("color", "blue")  # concurrent competing set: loses
+    h.process_all()
+    # Committing needs the MSN to pass the set's seq: keep traffic
+    # flowing from both clients.
+    a.set("other", 1)
+    b.set("other2", 2)
+    h.process_all()
+    a.set("tick", 3)
+    b.set("tick2", 4)
+    h.process_all()
+    assert a.get("color") == b.get("color") == "red"
+
+
+# ------------------------------------------------------------------- Ink
+
+
+def test_ink_strokes_converge():
+    h = make_harness(2, [("i", InkFactory.type_name)])
+    a, b = h.channel(0, "i"), h.channel(1, "i")
+    sid = a.create_stroke({"color": "black"})
+    a.append_point(sid, 0, 0)
+    a.append_point(sid, 1, 1)
+    sid2 = b.create_stroke({"color": "red"})
+    b.append_point(sid2, 5, 5)
+    h.process_all()
+    assert len(a.get_strokes()) == len(b.get_strokes()) == 2
+    assert a.get_stroke(sid)["points"] == b.get_stroke(sid)["points"]
+    assert a.get_stroke(sid2)["pen"] == {"color": "red"}
+
+
+# ------------------------------------------------------- SharedSummaryBlock
+
+
+def test_summary_block_travels_via_summary_only():
+    h = make_harness(1, [("sb", SummaryBlockFactory.type_name)])
+    sb = h.channel(0, "sb")
+    sb.set("format", {"v": 2})
+    h.process_all()
+    wire = h.runtimes[0].summarize().to_json()
+    rt = ContainerRuntime(REGISTRY)
+    rt.load(SummaryTree.from_json(wire))
+    assert rt.get_datastore("default").get_channel("sb").get("format") == {"v": 2}
+
+
+# --------------------------------------------------------- summary roundtrip
+
+
+def test_consensus_summaries_roundtrip():
+    h = make_harness(2, [
+        ("q", ConsensusQueueFactory.type_name),
+        ("r", RegisterCollectionFactory.type_name),
+        ("p", PactMapFactory.type_name),
+        ("i", InkFactory.type_name),
+    ])
+    q, r, p, i = (h.channel(0, c) for c in "qrpi")
+    q.add("pending-job")
+    r.write("reg", 42)
+    p.set("pact", "v")
+    sid = i.create_stroke({})
+    i.append_point(sid, 1, 2)
+    h.process_all()
+    h.process_all()
+    wire = h.runtimes[0].summarize().to_json()
+    rt = ContainerRuntime(REGISTRY)
+    rt.load(SummaryTree.from_json(wire))
+    ds = rt.get_datastore("default")
+    assert ds.get_channel("q").queue[0]["value"] == "pending-job"
+    assert ds.get_channel("r").read("reg") == 42
+    assert ds.get_channel("i").get_stroke(sid)["points"] == [
+        {"x": 1, "y": 2, "pressure": 1.0}
+    ]

@@ -1,0 +1,254 @@
+"""Framework-layer tests: TpuClient/FluidContainer/ContainerSchema,
+DataObject, undo-redo, attributor, agent-scheduler, telemetry, config.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds import (
+    CounterFactory,
+    MapFactory,
+    SharedCounter,
+    SharedMap,
+    SharedString,
+    StringFactory,
+    TaskManagerFactory,
+)
+from fluidframework_tpu.framework import (
+    AgentScheduler,
+    Attributor,
+    ContainerSchema,
+    DataObject,
+    DataObjectFactory,
+    TpuClient,
+    UndoRedoStackManager,
+)
+from fluidframework_tpu.framework.attributor import mixin_attributor
+from fluidframework_tpu.framework.undo_redo import (
+    SharedMapUndoRedoHandler,
+    SharedStringUndoRedoHandler,
+)
+from fluidframework_tpu.server import LocalServer
+from fluidframework_tpu.utils.config import ConfigProvider, MonitoringContext
+from fluidframework_tpu.utils.telemetry import (
+    ChildLogger,
+    Lumberjack,
+    MockLogger,
+    PerformanceEvent,
+)
+
+SCHEMA = ContainerSchema(
+    initial_objects={
+        "text": StringFactory,
+        "meta": MapFactory.type_name,
+        "count": CounterFactory(),
+    }
+)
+
+
+def test_client_create_attach_get_flow():
+    server = LocalServer()
+    client = TpuClient(server)
+    c = client.create_container(SCHEMA)
+    assert c.attach_state == "Detached"
+    text = c.initial_objects["text"]
+    text.insert_text(0, "draft")
+    doc_id = c.attach()
+    assert c.attach_state == "Attached"
+    text.insert_text(0, "live ")
+    c.flush()
+
+    c2 = client.get_container(doc_id, SCHEMA)
+    objs = c2.initial_objects
+    assert objs["text"].get_text() == "live draft"
+    objs["count"].increment(5)
+    c2.flush()
+    assert c.initial_objects["count"].value == 5
+
+
+def test_container_dynamic_create():
+    server = LocalServer()
+    client = TpuClient(server)
+    c = client.create_container(SCHEMA)
+    c.attach()
+    dyn = c.create(MapFactory, "extra")
+    dyn.set("k", 1)
+    c.flush()
+    c2 = client.get_container(c.doc_id, SCHEMA)
+    assert c2.runtime.get_datastore("default").get_channel("extra").get("k") == 1
+
+
+def test_data_object_lifecycle():
+    server = LocalServer()
+    client = TpuClient(server)
+
+    events = []
+
+    class Todo(DataObject):
+        def initializing_first_time(self, props=None):
+            events.append("first")
+            self.root.set("title", (props or {}).get("title", "untitled"))
+
+        def initializing_from_existing(self):
+            events.append("existing")
+
+        def has_initialized(self):
+            events.append("ready")
+
+    factory = DataObjectFactory(Todo)
+    c = client.create_container(ContainerSchema())
+    ds = c.runtime.get_datastore("default")
+    todo = factory.create(ds, {"title": "shopping"})
+    assert todo.root.get("title") == "shopping"
+    doc_id = c.attach()
+
+    c2 = client.get_container(doc_id, ContainerSchema())
+    todo2 = factory.load(c2.runtime.get_datastore("default"))
+    assert todo2.root.get("title") == "shopping"
+    assert events == ["first", "ready", "existing", "ready"]
+
+
+# ----------------------------------------------------------------- undo/redo
+
+
+def test_map_undo_redo():
+    server = LocalServer()
+    client = TpuClient(server)
+    c = client.create_container(SCHEMA)
+    c.attach()
+    m: SharedMap = c.initial_objects["meta"]
+    stack = UndoRedoStackManager()
+    SharedMapUndoRedoHandler(stack, m)
+
+    m.set("k", 1)
+    stack.close_current_operation()
+    m.set("k", 2)
+    m.set("j", 9)
+    stack.close_current_operation()
+    c.flush()
+
+    assert stack.undo_operation()
+    c.flush()
+    assert m.get("k") == 1 and not m.has("j")
+    assert stack.undo_operation()
+    c.flush()
+    assert not m.has("k")
+    assert stack.redo_operation()
+    c.flush()
+    assert m.get("k") == 1
+
+
+def test_string_undo_redo():
+    server = LocalServer()
+    client = TpuClient(server)
+    c = client.create_container(SCHEMA)
+    c.attach()
+    s: SharedString = c.initial_objects["text"]
+    stack = UndoRedoStackManager()
+    SharedStringUndoRedoHandler(stack, s)
+
+    s.insert_text(0, "hello world")
+    stack.close_current_operation()
+    s.remove_text(0, 6)
+    stack.close_current_operation()
+    c.flush()
+    assert s.get_text() == "world"
+
+    assert stack.undo_operation()
+    c.flush()
+    assert s.get_text() == "hello world"
+    assert stack.undo_operation()
+    c.flush()
+    assert s.get_text() == ""
+    assert stack.redo_operation()
+    c.flush()
+    assert s.get_text() == "hello world"
+
+
+# ---------------------------------------------------------------- attributor
+
+
+def test_attributor_records_and_roundtrips():
+    server = LocalServer()
+    client = TpuClient(server)
+    c = client.create_container(SCHEMA)
+    c.attach()
+    att = mixin_attributor(c.runtime)
+    c2 = client.get_container(c.doc_id, SCHEMA)
+    c.initial_objects["meta"].set("a", 1)
+    c.flush()
+    c2.initial_objects["meta"].set("b", 2)
+    c2.flush()
+    assert len(att) == 2
+    entries = sorted(att.entries.items())
+    assert entries[0][1]["client"] != entries[1][1]["client"]
+    restored = Attributor.deserialize(att.serialize())
+    assert restored.entries.keys() == att.entries.keys()
+    for k in att.entries:
+        assert restored.entries[k]["client"] == att.entries[k]["client"]
+        assert abs(
+            restored.entries[k]["timestamp"] - att.entries[k]["timestamp"]
+        ) < 0.01
+
+
+# ------------------------------------------------------------ agent scheduler
+
+
+def test_agent_scheduler_failover():
+    server = LocalServer()
+    client = TpuClient(server)
+    schema = ContainerSchema(initial_objects={"tasks": TaskManagerFactory})
+    c1 = client.create_container(schema)
+    doc = c1.attach()
+    c2 = client.get_container(doc, schema)
+    s1 = AgentScheduler(c1.initial_objects["tasks"])
+    s2 = AgentScheduler(c2.initial_objects["tasks"])
+    runs = []
+    s1.pick("indexer", lambda: runs.append("c1"))
+    c1.flush()
+    s2.pick("indexer", lambda: runs.append("c2"))
+    c2.flush()
+    assert runs == ["c1"]
+    assert s1.picked("indexer") and not s2.picked("indexer")
+    c1.disconnect()  # holder leaves: task fails over
+    assert runs == ["c1", "c2"]
+    assert s2.picked("indexer")
+
+
+# ------------------------------------------------------- telemetry & config
+
+
+def test_telemetry_hierarchy_and_perf():
+    log = MockLogger()
+    child = ChildLogger(log, "runtime")
+    child.send_telemetry_event("opProcessed", seq=5)
+    assert log.matches({"eventName": "runtime:opProcessed", "seq": 5})
+    with PerformanceEvent(child, "summarize"):
+        pass
+    assert any(
+        e["category"] == "performance" and e["eventName"] == "runtime:summarize"
+        for e in log.events
+    )
+
+
+def test_lumberjack_metrics():
+    events = []
+    Lumberjack.add_sink(events.append)
+    m = Lumberjack.new_metric("DeliProcessBatch", doc="d1")
+    m.set_property("ops", 42)
+    m.success("done")
+    assert events[-1]["metric"] == "DeliProcessBatch"
+    assert events[-1]["status"] == "success"
+    assert events[-1]["ops"] == 42
+
+
+def test_config_provider_layering():
+    cfg = ConfigProvider([{"Fluid.GC.Enabled": "true"}])
+    cfg.add_provider({"Fluid.GC.Enabled": "false", "Fluid.Op.Max": 42})
+    # First provider wins.
+    assert cfg.get_bool("Fluid.GC.Enabled") is True
+    assert cfg.get_number("Fluid.Op.Max") == 42
+    assert cfg.get_string("Missing", "dflt") == "dflt"
+    mc = MonitoringContext(MockLogger(), cfg)
+    assert mc.child("sub").config is cfg

@@ -144,3 +144,132 @@ def test_malformed_propose_ignored():
     C(a_rt, "m").set("after", True)
     a_rt.flush()
     assert C(b_rt, "m").get("after") is True
+
+
+def test_pending_remove_overlapped_by_sequenced_remote_remove():
+    """A pending remove whose segments were ALSO removed by a sequenced
+    remote remove must not cite them on resubmit (they are tombstones
+    for every future perspective)."""
+    server = LocalServer(deferred=True)
+    a_rt, b_rt = mk(server, 1), mk(server, 2)
+    server.process_all()
+    a, b = C(a_rt), C(b_rt)
+    a.insert_text(0, "abcdef")
+    a_rt.flush()
+    server.process_all()
+
+    a.remove_text(1, 4)  # pending remove of 'bcd'
+    a_rt.disconnect()
+    server.process_all()
+    b.remove_text(1, 4)  # same range, sequences first
+    b_rt.flush()
+    server.process_all()
+    assert b.get_text() == "aef"
+
+    a_rt.connect(server.connect("doc"))
+    server.process_all()
+    a_rt.flush()
+    server.process_all()
+    assert a.get_text() == b.get_text() == "aef"
+
+
+def test_matrix_set_cell_rebases_on_reconnect():
+    """A pending setCell survives a remote row insert: it re-targets by
+    handle, not by stale position."""
+    from fluidframework_tpu.dds import MatrixFactory
+
+    reg = ChannelRegistry([MatrixFactory()])
+    server = LocalServer(deferred=True)
+
+    def mk_m(cid=None):
+        rt = ContainerRuntime(reg)
+        rt.create_datastore("default").create_channel(
+            "x", MatrixFactory.type_name
+        )
+        rt.connect(server.connect("doc-m", cid))
+        return rt
+
+    a_rt, b_rt = mk_m(1), mk_m(2)
+    server.process_all()
+    a = a_rt.get_datastore("default").get_channel("x")
+    b = b_rt.get_datastore("default").get_channel("x")
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 1)
+    a_rt.flush()
+    server.process_all()
+
+    a.set_cell(1, 0, "v")  # pending
+    a_rt.disconnect()
+    server.process_all()
+    b.insert_rows(0, 1)  # shifts a's target row to index 2
+    b_rt.flush()
+    server.process_all()
+
+    a_rt.connect(server.connect("doc-m"))
+    server.process_all()
+    a_rt.flush()
+    server.process_all()
+    assert a.to_dense() == b.to_dense()
+    assert b.get_cell(2, 0) == "v"
+
+
+def test_matrix_structural_op_rebases_on_reconnect():
+    from fluidframework_tpu.dds import MatrixFactory
+
+    reg = ChannelRegistry([MatrixFactory()])
+    server = LocalServer(deferred=True)
+
+    def mk_m(cid=None):
+        rt = ContainerRuntime(reg)
+        rt.create_datastore("default").create_channel(
+            "x", MatrixFactory.type_name
+        )
+        rt.connect(server.connect("doc-n", cid))
+        return rt
+
+    a_rt, b_rt = mk_m(1), mk_m(2)
+    server.process_all()
+    a = a_rt.get_datastore("default").get_channel("x")
+    b = b_rt.get_datastore("default").get_channel("x")
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 1)
+    a_rt.flush()
+    server.process_all()
+    a.set_cell(2, 0, "anchor")
+    a_rt.flush()
+    server.process_all()
+
+    a.remove_rows(0, 1)  # pending structural op
+    a_rt.disconnect()
+    server.process_all()
+    b.insert_rows(0, 2)
+    b_rt.flush()
+    server.process_all()
+
+    a_rt.connect(server.connect("doc-n"))
+    server.process_all()
+    a_rt.flush()
+    server.process_all()
+    assert a.to_dense() == b.to_dense()
+    assert a.row_count == 4  # 3 + 2 - 1
+    assert b.get_cell(3, 0) == "anchor"
+
+
+def test_protocol_state_rides_summary():
+    """A summary-booted client sees pre-summary quorum membership (no
+    duplicate summarizer election)."""
+    from fluidframework_tpu.runtime.summary import SummaryTree
+    from fluidframework_tpu.runtime.summary_manager import SummarizerElection
+
+    server = LocalServer()
+    a_rt = mk(server, 1)
+    C(a_rt, "m").set("x", 1)
+    a_rt.flush()
+    wire = a_rt.summarize().to_json()
+
+    cold = ContainerRuntime(REGISTRY)
+    cold.load(SummaryTree.from_json(wire))
+    cold.connect(server.connect("doc", 9))
+    assert 1 in cold.protocol.quorum  # pre-summary join restored
+    assert not SummarizerElection(cold).is_elected  # client 1 is older
+    assert SummarizerElection(a_rt).is_elected
