@@ -1,0 +1,27 @@
+"""Drivers: service adapters behind the document-service surface.
+
+The reference's packages/drivers/* role (SURVEY.md §1 L2 — the
+process/network boundary). Every driver exposes:
+
+    create_document(doc_id, summary_wire)
+    load_document(doc_id) -> summary_wire | None
+    connect(doc_id, client_id=None) -> connection
+    ops_from(doc_id, from_seq) -> [SequencedMessage]
+
+- `LocalDriver` — straight onto an in-proc LocalServer
+  (drivers/local-driver).
+- `ReplayDriver` — read-only playback of a recorded op stream with
+  stepping (drivers/replay-driver; benchmark config 2's transport).
+- `FileDriver` — snapshot+ops persisted to a directory
+  (drivers/file-driver, used by the replay tooling).
+- `FaultInjectionDriver` — wraps any driver; drops connections and
+  injects submit failures on demand
+  (test-service-load/src/faultInjectionDriver.ts:27).
+"""
+
+from .local_driver import LocalDriver
+from .replay_driver import ReplayDriver
+from .file_driver import FileDriver
+from .fault_injection import FaultInjectionDriver
+
+__all__ = ["FaultInjectionDriver", "FileDriver", "LocalDriver", "ReplayDriver"]

@@ -1,0 +1,91 @@
+"""Fault-injection driver wrapper.
+
+Reference packages/test/test-service-load/src/faultInjectionDriver.ts
+(:27 factory, :149 delta connection): wraps any driver and injects
+failures — dropped connections, submit errors — to exercise the
+reconnect/rebase/recovery machinery under test control.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+
+class _FaultConnection:
+    def __init__(self, inner, driver: "FaultInjectionDriver"):
+        self._inner = inner
+        self._driver = driver
+
+    # passthrough surface
+    @property
+    def client_id(self):
+        return self._inner.client_id
+
+    @property
+    def connected(self):
+        return self._inner.connected
+
+    @property
+    def listener(self):
+        return self._inner.listener
+
+    @listener.setter
+    def listener(self, fn):
+        self._inner.listener = fn
+
+    @property
+    def nack_listener(self):
+        return self._inner.nack_listener
+
+    @nack_listener.setter
+    def nack_listener(self, fn):
+        self._inner.nack_listener = fn
+
+    def catch_up(self, from_seq: int):
+        return self._inner.catch_up(from_seq)
+
+    def submit(self, msg) -> None:
+        if self._driver.submits_fail:
+            raise ConnectionError("injected submit failure")
+        if self._driver.drop_submits:
+            return  # silently lost (network partition)
+        self._inner.submit(msg)
+
+    def disconnect(self) -> None:
+        self._inner.disconnect()
+
+    # fault controls (injectDisconnect / injectError)
+    def inject_disconnect(self) -> None:
+        self._inner.disconnect()
+
+
+class FaultInjectionDriver:
+    def __init__(self, inner):
+        self.inner = inner
+        self.connections: List[_FaultConnection] = []
+        self.submits_fail = False
+        self.drop_submits = False
+
+    # ----------------------------------------------------- driver surface
+
+    def create_document(self, doc_id: str, summary_wire: str) -> None:
+        self.inner.create_document(doc_id, summary_wire)
+
+    def load_document(self, doc_id: str) -> Optional[str]:
+        return self.inner.load_document(doc_id)
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None):
+        conn = _FaultConnection(self.inner.connect(doc_id, client_id), self)
+        self.connections.append(conn)
+        return conn
+
+    def ops_from(self, doc_id: str, from_seq: int):
+        return self.inner.ops_from(doc_id, from_seq)
+
+    # ------------------------------------------------------ fault controls
+
+    def disconnect_all(self) -> None:
+        """Drop every live connection (random client kill)."""
+        for conn in list(self.connections):
+            if conn.connected:
+                conn.inject_disconnect()

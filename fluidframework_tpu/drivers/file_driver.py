@@ -1,0 +1,136 @@
+"""FileDriver: documents persisted as files.
+
+Reference drivers/file-driver (fileDocumentService): summaries and op
+streams stored in a directory —
+
+    <root>/<doc_id>/summary.json
+    <root>/<doc_id>/ops.jsonl      (one SequencedMessage per line)
+
+Reading yields a read-only replay document (connect goes through an
+internal ReplayDriver); `record()` captures a live document from any
+other driver into files. Sequence ops are wire-encoded with
+op_to_json, so recorded streams are plain JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, List, Optional
+
+from ..protocol.messages import MessageType, SequencedMessage
+from ..protocol.mergetree_ops import op_from_json, op_to_json
+from .replay_driver import ReplayDriver
+
+
+def message_to_json(msg: SequencedMessage) -> dict:
+    contents = msg.contents
+    if isinstance(contents, dict):
+        contents = _encode_contents(contents)
+    return {
+        "sequenceNumber": msg.sequence_number,
+        "minimumSequenceNumber": msg.minimum_sequence_number,
+        "clientId": msg.client_id,
+        "clientSequenceNumber": msg.client_seq,
+        "referenceSequenceNumber": msg.ref_seq,
+        "type": msg.type.value,
+        "contents": contents,
+        "metadata": msg.metadata,
+        "timestamp": msg.timestamp,
+    }
+
+
+def _encode_contents(contents: Any) -> Any:
+    if isinstance(contents, dict):
+        out = {}
+        for k, v in contents.items():
+            if k == "op" and dataclasses.is_dataclass(v):
+                out[k] = op_to_json(v)
+            elif isinstance(v, dict):
+                out[k] = _encode_contents(v)
+            else:
+                out[k] = v
+        return out
+    return contents
+
+
+def message_from_json(data: dict) -> SequencedMessage:
+    return SequencedMessage(
+        sequence_number=data["sequenceNumber"],
+        minimum_sequence_number=data["minimumSequenceNumber"],
+        client_id=data["clientId"],
+        client_seq=data["clientSequenceNumber"],
+        ref_seq=data["referenceSequenceNumber"],
+        type=MessageType(data["type"]),
+        contents=data["contents"],
+        metadata=data["metadata"],
+        timestamp=data.get("timestamp", 0.0),
+    )
+
+
+class FileDriver:
+    def __init__(self, root: str):
+        self.root = root
+        self._replay: Optional[ReplayDriver] = None
+
+    def _doc_dir(self, doc_id: str) -> str:
+        return os.path.join(self.root, doc_id)
+
+    # ----------------------------------------------------------- writing
+
+    def record(self, doc_id: str, summary_wire: Optional[str],
+               messages: List[SequencedMessage]) -> None:
+        """Capture a document (snapshot + ops) to files — the fetch-tool
+        / recorded-document workflow."""
+        d = self._doc_dir(doc_id)
+        os.makedirs(d, exist_ok=True)
+        if summary_wire is not None:
+            with open(os.path.join(d, "summary.json"), "w") as f:
+                f.write(summary_wire)
+        with open(os.path.join(d, "ops.jsonl"), "w") as f:
+            for m in messages:
+                f.write(json.dumps(message_to_json(m)) + "\n")
+        self._replay = None  # invalidate cache
+
+    # ----------------------------------------------------- driver surface
+
+    def _ensure_replay(self) -> ReplayDriver:
+        if self._replay is None:
+            streams, summaries = {}, {}
+            if os.path.isdir(self.root):
+                for doc_id in os.listdir(self.root):
+                    d = self._doc_dir(doc_id)
+                    ops_path = os.path.join(d, "ops.jsonl")
+                    if os.path.exists(ops_path):
+                        with open(ops_path) as f:
+                            streams[doc_id] = [
+                                message_from_json(json.loads(line))
+                                for line in f if line.strip()
+                            ]
+                    s_path = os.path.join(d, "summary.json")
+                    if os.path.exists(s_path):
+                        with open(s_path) as f:
+                            summaries[doc_id] = f.read()
+            self._replay = ReplayDriver(streams, summaries)
+        return self._replay
+
+    def create_document(self, doc_id: str, summary_wire: str) -> None:
+        self.record(doc_id, summary_wire, [])
+
+    def load_document(self, doc_id: str) -> Optional[str]:
+        return self._ensure_replay().load_document(doc_id)
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None):
+        return self._ensure_replay().connect(doc_id, client_id)
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        return self._ensure_replay().ops_from(doc_id, from_seq)
+
+    # --------------------------------------------------------- controller
+
+    def replay_all(self, doc_id: str) -> int:
+        return self._ensure_replay().replay_all(doc_id)
+
+    def step(self, doc_id: str, count: int = 1) -> int:
+        return self._ensure_replay().step(doc_id, count)

@@ -1,0 +1,30 @@
+"""LocalDriver: the in-proc service adapter.
+
+Reference drivers/local-driver (LocalDocumentServiceFactory →
+LocalDeltaConnectionServer): binds the loader to a LocalServer (full
+lambda pipeline) or LocalOrderingService instance.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..protocol.messages import SequencedMessage
+
+
+class LocalDriver:
+    def __init__(self, server):
+        self.server = server
+
+    def create_document(self, doc_id: str, summary_wire: str) -> None:
+        handle = self.server.upload_summary(summary_wire)
+        self.server.storage.set_ref(doc_id, handle)
+
+    def load_document(self, doc_id: str) -> Optional[str]:
+        return self.server.download_summary(doc_id)
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None):
+        return self.server.connect(doc_id, client_id)
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        return self.server.ops_from(doc_id, from_seq)

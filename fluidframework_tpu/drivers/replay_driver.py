@@ -1,0 +1,103 @@
+"""ReplayDriver: read-only playback of a recorded op stream.
+
+Reference drivers/replay-driver (ReplayController,
+replayDocumentDeltaConnection.ts): a container connects to a recorded
+document and receives the stream up to a controllable watermark —
+`replay_to(seq)` / `replay_all()` / `step(n)` — never submitting.
+This is the transport behind benchmark config 2 (1024-client replay)
+and the replay-tool workflows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..utils.events import BufferedListener
+
+
+class _ReplayConnection(BufferedListener):
+    """Read-only connection: delivery is driven by the controller."""
+
+    def __init__(self, driver: "ReplayDriver", doc_id: str):
+        super().__init__()
+        self.driver = driver
+        self.doc_id = doc_id
+        self.client_id = -999  # never matches any recorded op's author
+        self.nack_listener = None
+        self.connected = True
+        self.join_seq = 0  # deliver everything from the start
+
+    def submit(self, msg) -> None:
+        raise RuntimeError("replay documents are read-only")
+
+    def catch_up(self, from_seq: int) -> List[SequencedMessage]:
+        return []  # the controller pushes; no pull-gap exists
+
+    def disconnect(self) -> None:
+        self.connected = False
+
+
+class ReplayDriver:
+    def __init__(self, streams: Dict[str, List[SequencedMessage]],
+                 summaries: Optional[Dict[str, str]] = None):
+        """`streams`: doc id → full recorded sequenced stream;
+        `summaries`: optional doc id → summary wire to boot from (ops
+        below the summary's seq are skipped on delivery)."""
+        self.streams = streams
+        self.summaries = summaries or {}
+        self._connections: Dict[str, List[_ReplayConnection]] = {}
+        self._watermark: Dict[str, int] = {}
+
+    # ----------------------------------------------------- driver surface
+
+    def create_document(self, doc_id: str, summary_wire: str) -> None:
+        raise RuntimeError("replay documents are read-only")
+
+    def load_document(self, doc_id: str) -> Optional[str]:
+        return self.summaries.get(doc_id)
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None):
+        conn = _ReplayConnection(self, doc_id)
+        self._connections.setdefault(doc_id, []).append(conn)
+        return conn
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        mark = self._watermark.get(doc_id, 0)
+        return [
+            m for m in self.streams.get(doc_id, [])
+            if from_seq < m.sequence_number <= mark
+        ]
+
+    # --------------------------------------------------------- controller
+
+    def replay_to(self, doc_id: str, seq: int) -> int:
+        """Deliver recorded ops with sequence number <= seq; returns
+        the number delivered (ReplayController.replay)."""
+        mark = self._watermark.get(doc_id, 0)
+        batch = [
+            m for m in self.streams.get(doc_id, [])
+            if mark < m.sequence_number <= seq
+        ]
+        for msg in batch:
+            for conn in self._connections.get(doc_id, []):
+                if conn.connected:
+                    conn._dispatch(msg)
+        if batch:
+            self._watermark[doc_id] = batch[-1].sequence_number
+        return len(batch)
+
+    def replay_all(self, doc_id: str) -> int:
+        stream = self.streams.get(doc_id, [])
+        if not stream:
+            return 0
+        return self.replay_to(doc_id, stream[-1].sequence_number)
+
+    def step(self, doc_id: str, count: int = 1) -> int:
+        mark = self._watermark.get(doc_id, 0)
+        remaining = [
+            m for m in self.streams.get(doc_id, []) if m.sequence_number > mark
+        ]
+        if not remaining:
+            return 0
+        return self.replay_to(doc_id, remaining[: count][-1].sequence_number)

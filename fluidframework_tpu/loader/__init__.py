@@ -1,0 +1,14 @@
+"""Loader layer: container lifecycle over pluggable drivers.
+
+The reference's packages/loader/container-loader role (SURVEY.md §1
+L3): `Container` (load/createDetached/attach/close, container.ts:310),
+pausable delta queues (deltaQueue.ts:15), `ConnectionManager`-style
+auto-reconnect, `Audience` (audience.ts), and stashed-op close/resume
+(closeAndGetPendingLocalState → applyStashedOp).
+"""
+
+from .container import Container, Loader
+from .delta_queue import DeltaQueue
+from .audience import Audience
+
+__all__ = ["Audience", "Container", "DeltaQueue", "Loader"]

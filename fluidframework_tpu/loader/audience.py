@@ -1,0 +1,45 @@
+"""Audience: the connected-client roster.
+
+Reference loader/container-loader/src/audience.ts: a live view of the
+quorum's membership with add/remove events, fed from the runtime's
+protocol state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..utils.events import EventEmitter
+
+
+class Audience(EventEmitter):
+    def __init__(self, runtime=None):
+        super().__init__()
+        self._members: Dict[Any, Any] = {}
+        if runtime is not None:
+            self.bind(runtime)
+
+    def bind(self, runtime) -> None:
+        quorum = runtime.protocol.quorum
+        for cid, member in quorum.members.items():
+            self._members[cid] = member.detail
+        quorum.on("addMember", self._on_add(quorum))
+        quorum.on("removeMember", self._on_remove)
+
+    def _on_add(self, quorum):
+        def handler(client_id):
+            member = quorum.members.get(client_id)
+            self._members[client_id] = member.detail if member else None
+            self.emit("addMember", client_id)
+
+        return handler
+
+    def _on_remove(self, client_id) -> None:
+        self._members.pop(client_id, None)
+        self.emit("removeMember", client_id)
+
+    def get_members(self) -> Dict[Any, Any]:
+        return dict(self._members)
+
+    def get_member(self, client_id) -> Optional[Any]:
+        return self._members.get(client_id)

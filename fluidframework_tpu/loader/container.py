@@ -1,0 +1,160 @@
+"""Container + Loader: lifecycle over a driver.
+
+Reference Container (loader/container-loader/src/container.ts:310
+load, :376 createDetached, :1056 attach) and Loader (loader.ts). A
+*driver* here is any object with the document-service surface:
+
+    create_document(doc_id, summary_wire) -> None
+    load_document(doc_id) -> summary_wire | None
+    connect(doc_id, client_id=None) -> connection
+    ops_from(doc_id, from_seq) -> [SequencedMessage]
+
+(drivers.local_driver adapts LocalServer/LocalOrderingService; replay
+and file drivers provide read-only variants.)
+
+Also implements stashed-op close/resume: `close_and_get_pending_state`
+serializes unacked local ops (closeAndGetPendingLocalState), and
+`Loader.resolve(..., pending_state=...)` re-applies them through each
+DDS's applyStashedOp before connecting (client.ts:831 semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..protocol.mergetree_ops import op_to_json
+from ..runtime.channel import ChannelRegistry
+from ..runtime.container_runtime import ContainerRuntime, FlushMode
+from ..runtime.summary import SummaryTree
+from ..utils.events import EventEmitter
+from .audience import Audience
+
+
+def _encode_stash_content(content: Any) -> Any:
+    """Wire-encode a pending op's contents (sequence ops carry
+    dataclasses in-proc)."""
+    if isinstance(content, dict) and content.get("kind") == "seq":
+        op = content["op"]
+        return {"kind": "seq", "op": op if isinstance(op, dict) else op_to_json(op)}
+    return content
+
+
+class Container(EventEmitter):
+    def __init__(self, runtime: ContainerRuntime, driver, doc_id: Optional[str]):
+        super().__init__()
+        self.runtime = runtime
+        self.driver = driver
+        self.doc_id = doc_id
+        self.audience = Audience()
+        self.closed = False
+        runtime.on("connected", lambda cid: self.emit("connected", cid))
+        runtime.on("disconnected", lambda: self.emit("disconnected"))
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def attach_state(self) -> str:
+        return "Attached" if self.doc_id is not None else "Detached"
+
+    @property
+    def connected(self) -> bool:
+        return self.runtime.connection is not None
+
+    @property
+    def is_dirty(self) -> bool:
+        return self.runtime.is_dirty
+
+    # ---------------------------------------------------------- lifecycle
+
+    def attach(self, doc_id: Optional[str] = None) -> str:
+        """Persist the attach summary and go live (container.ts:1056)."""
+        assert self.doc_id is None, "already attached"
+        doc_id = doc_id or uuid.uuid4().hex[:12]
+        self.driver.create_document(doc_id, self.runtime.summarize().to_json())
+        self.doc_id = doc_id
+        self.connect()
+        return doc_id
+
+    def connect(self, client_id: Optional[int] = None) -> None:
+        assert self.doc_id is not None, "attach first"
+        self.runtime.connect(self.driver.connect(self.doc_id, client_id))
+        self.audience.bind(self.runtime)
+
+    def disconnect(self) -> None:
+        self.runtime.disconnect()
+
+    def flush(self) -> None:
+        self.runtime.flush()
+
+    def close(self) -> None:
+        self.disconnect()
+        self.closed = True
+        self.emit("closed")
+
+    def close_and_get_pending_state(self) -> str:
+        """Serialize unacked local ops for a later session
+        (closeAndGetPendingLocalState). The summary captured here is
+        the *acked* state; pending ops re-apply on top of it."""
+        pending = [
+            {
+                "datastore": pm.envelope.datastore,
+                "channel": pm.envelope.channel,
+                "contents": _encode_stash_content(pm.envelope.contents),
+            }
+            for pm in list(self.runtime._pending) + list(self.runtime._outbox)
+            if pm.envelope.channel is not None
+        ]
+        state = {
+            "docId": self.doc_id,
+            "baseSeq": self.runtime.current_seq,
+            "pending": pending,
+        }
+        self.close()
+        return json.dumps(state)
+
+
+class Loader:
+    """Resolves containers against a driver (loader.ts Loader)."""
+
+    def __init__(self, driver, registry: ChannelRegistry,
+                 flush_mode: FlushMode = FlushMode.TURN_BASED):
+        self.driver = driver
+        self.registry = registry
+        self.flush_mode = flush_mode
+
+    def create_detached(self) -> Container:
+        rt = ContainerRuntime(self.registry, flush_mode=self.flush_mode)
+        return Container(rt, self.driver, None)
+
+    def resolve(self, doc_id: str, connect: bool = True,
+                pending_state: Optional[str] = None,
+                client_id: Optional[int] = None) -> Container:
+        """Load from the latest summary + catch up (container.ts:310 →
+        :1374 load). With `pending_state`, stashed ops re-apply before
+        connecting, then replay through resubmit on connect."""
+        wire = self.driver.load_document(doc_id)
+        if wire is None:
+            raise KeyError(f"unknown document {doc_id!r}")
+        rt = ContainerRuntime(self.registry, flush_mode=self.flush_mode)
+        rt.load(SummaryTree.from_json(wire))
+        container = Container(rt, self.driver, doc_id)
+        if connect:
+            container.connect(client_id)
+        if pending_state is not None:
+            state = json.loads(pending_state)
+            assert state["docId"] == doc_id
+            # Ops from the stashed session re-apply as fresh pending
+            # local ops on the caught-up replica
+            # (IDeltaHandler.applyStashedOp, channel.ts:153) and flush
+            # into the stream under the new identity.
+            if not connect:
+                rt._ever_connected = True
+                for ds in rt.datastores.values():
+                    ds.attach_all()
+            for stashed in state["pending"]:
+                ds = rt.get_datastore(stashed["datastore"])
+                ds.apply_stashed_op(stashed["channel"], stashed["contents"])
+            rt.flush()
+        return container

@@ -1,0 +1,64 @@
+"""Pausable, inspectable delta queue.
+
+The reference DeltaQueue (loader/container-loader/src/deltaQueue.ts:15)
+drains asynchronously and can pause/resume — the mechanism behind
+batch-atomic processing and replay stepping. This synchronous version
+keeps the same surface: push enqueues, an unpaused queue drains through
+the handler, pause() holds delivery mid-stream, resume() continues.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Deque, Optional
+
+from ..utils.events import EventEmitter
+
+
+class DeltaQueue(EventEmitter):
+    def __init__(self, handler: Callable[[Any], None]):
+        super().__init__()
+        self._handler = handler
+        self._queue: Deque[Any] = deque()
+        self._paused = False
+        self._draining = False
+
+    @property
+    def length(self) -> int:
+        return len(self._queue)
+
+    @property
+    def paused(self) -> bool:
+        return self._paused
+
+    def push(self, item: Any) -> None:
+        self._queue.append(item)
+        self._drain()
+
+    def pause(self) -> None:
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+        self._drain()
+
+    def process_one(self) -> bool:
+        """Deliver a single item even while paused (replay stepping)."""
+        if not self._queue:
+            return False
+        item = self._queue.popleft()
+        self._handler(item)
+        self.emit("op", item)
+        return True
+
+    def _drain(self) -> None:
+        if self._draining:
+            return
+        self._draining = True
+        try:
+            while self._queue and not self._paused:
+                self.process_one()
+        finally:
+            self._draining = False
+        if not self._queue:
+            self.emit("idle")

@@ -1,0 +1,45 @@
+"""SharedTree: the rebase-based JSON document CRDT.
+
+TPU-native re-design of the reference's new SharedTree
+(packages/dds/tree, SURVEY.md §2.1): a forest of typed/valued nodes
+edited through *changesets* that compose, invert, and rebase
+(core/rebase/changeRebaser.ts laws); an EditManager
+(core/edit-manager/editManager.ts:47) maintaining the trunk of
+sequenced commits and rebasing concurrent edits into it; an
+IdCompressor (id-compressor/idCompressor.ts:272) translating
+session-local ids to compact final ids; and the SharedTree DDS
+(shared-tree/sharedTree.ts:211) binding it all behind the channel seam.
+
+Unlike the merge-tree family (tombstone CRDT), convergence here comes
+from *operational transformation of changesets onto the total order*:
+every replica rebases each incoming commit over the concurrent trunk
+commits it had not seen, deterministically.
+"""
+
+from .changeset import (
+    compose,
+    insert_op,
+    invert,
+    rebase_change,
+    remove_op,
+    set_value_op,
+)
+from .forest import Forest
+from .edit_manager import Commit, EditManager
+from .id_compressor import IdCompressor
+from .shared_tree import SharedTree, SharedTreeFactory
+
+__all__ = [
+    "Commit",
+    "EditManager",
+    "Forest",
+    "IdCompressor",
+    "SharedTree",
+    "SharedTreeFactory",
+    "compose",
+    "insert_op",
+    "invert",
+    "rebase_change",
+    "remove_op",
+    "set_value_op",
+]

@@ -1,0 +1,274 @@
+"""Changesets: the edit algebra (compose / invert / rebase).
+
+The role of the reference's change families
+(packages/dds/tree/src/core/rebase/changeRebaser.ts — the
+compose/invert/rebase contract — and
+feature-libraries/sequence-field/{rebase,compose,invert}.ts — the
+list-merge logic).
+
+A *change* is a list of primitive ops applied in order. Each op
+addresses a node by `path` — a list of [field, index] steps from the
+root — and edits one of its fields:
+
+- {"type": "insert", "path": P, "field": f, "index": i, "content": [trees]}
+- {"type": "remove", "path": P, "field": f, "index": i, "count": n,
+   "content": [trees]?}           (content captured on apply, for invert)
+- {"type": "setValue", "path": P, "value": v, "prev": u?}
+
+Rebase rules (sequence-field semantics):
+- an insert by the earlier op at/before your index shifts you right;
+- a remove overlapping your position slides you to its start;
+- edits under a removed subtree are dropped (the reference's
+  "muted"/detached marks);
+- two inserts at the same index: the earlier-sequenced op's content
+  lands first (ties shift the later op right) — deterministic because
+  every replica rebases in total-order.
+
+Tested against the rebase laws (the verifyChangeRebaser contract,
+core/rebase/verifyChangeRebaser.ts) and multi-client convergence fuzz.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+Change = List[dict]
+
+
+# --------------------------------------------------------------------------
+# constructors
+# --------------------------------------------------------------------------
+
+
+def insert_op(path: List[list], field: str, index: int, content: List[dict]) -> dict:
+    return {"type": "insert", "path": list(path), "field": field,
+            "index": index, "content": content}
+
+
+def remove_op(path: List[list], field: str, index: int, count: int = 1) -> dict:
+    return {"type": "remove", "path": list(path), "field": field,
+            "index": index, "count": count}
+
+
+def set_value_op(path: List[list], value: Any) -> dict:
+    return {"type": "setValue", "path": list(path), "value": value}
+
+
+# --------------------------------------------------------------------------
+# compose / invert
+# --------------------------------------------------------------------------
+
+
+def compose(changes: List[Change]) -> Change:
+    """Sequential composition (changeRebaser.compose). Changes are op
+    lists, so composition is concatenation — associativity and the
+    compose laws hold definitionally."""
+    out: Change = []
+    for c in changes:
+        out.extend(copy.deepcopy(c))
+    return out
+
+
+def invert(change: Change) -> Change:
+    """Inverse change (changeRebaser.invert): reversed list of per-op
+    inverses. Remove inverts to insert of the captured content;
+    setValue inverts to setValue of the captured prior value — both
+    captured by Forest.apply."""
+    out: Change = []
+    for op in reversed(change):
+        t = op["type"]
+        if t == "insert":
+            out.append(
+                {"type": "remove", "path": op["path"], "field": op["field"],
+                 "index": op["index"], "count": len(op["content"]),
+                 "content": copy.deepcopy(op["content"])}
+            )
+        elif t == "remove":
+            assert "content" in op, "invert needs an applied remove (content captured)"
+            out.append(
+                {"type": "insert", "path": op["path"], "field": op["field"],
+                 "index": op["index"], "content": copy.deepcopy(op["content"])}
+            )
+        elif t == "setValue":
+            assert "prev" in op, "invert needs an applied setValue (prev captured)"
+            out.append(
+                {"type": "setValue", "path": op["path"], "value": op["prev"]}
+            )
+    return out
+
+
+# --------------------------------------------------------------------------
+# rebase
+# --------------------------------------------------------------------------
+
+
+def _adjust_index(
+    index: int, base: dict, is_insert_at: bool, base_first: bool = True
+) -> Optional[int]:
+    """New index for a position `index` in the same field after `base`
+    applied. `is_insert_at`: position denotes an insertion gap (can sit
+    at either side of existing content) vs an existing-node reference.
+    `base_first`: for gap-vs-gap ties (two inserts at the same index),
+    whether base's content lands first — True when base sequenced
+    earlier (the caller's rebase direction decides). Returns None if
+    the referenced node was removed."""
+    if base["type"] == "insert":
+        b = base["index"]
+        n = len(base["content"])
+        if is_insert_at:
+            if b < index or (b == index and base_first):
+                return index + n
+            return index
+        # A node reference: content inserted at/before the node's slot
+        # lands before the node (pure position semantics, no tie).
+        return index + n if b <= index else index
+    if base["type"] == "remove":
+        b = base["index"]
+        n = base["count"]
+        if index < b:
+            return index
+        if is_insert_at:
+            return max(b, index - n)
+        if index < b + n:
+            return None  # the node itself was removed
+        return index - n
+    return index
+
+
+def _rebase_path(path: List[list], base: dict) -> Optional[List[list]]:
+    """Adjust a node path for `base`; None if an ancestor was removed."""
+    if base["type"] == "setValue":
+        return path
+    bpath = base["path"]
+    bfield = base["field"]
+    # Does base edit a field that is an ancestor step of `path`?
+    if len(path) <= len(bpath):
+        return path
+    for i, step in enumerate(bpath):
+        if path[i] != step:
+            return path  # divergent ancestry: unaffected
+    # path[len(bpath)] descends through the edited node's subtree iff
+    # its field matches.
+    field, index = path[len(bpath)]
+    if field != bfield:
+        return path
+    new_index = _adjust_index(index, base, is_insert_at=False)
+    if new_index is None:
+        return None  # ancestor removed: op is muted
+    if new_index == index:
+        return path
+    new_path = [list(s) for s in path]
+    new_path[len(bpath)] = [field, new_index]
+    return new_path
+
+
+def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
+    """Rebase one op over one base op (both relative to the same start
+    state); returns the adjusted op relative to post-base state, or
+    None if muted (its target no longer exists). `base_first` resolves
+    same-index insert ties (True when base sequenced earlier)."""
+    new_path = _rebase_path(op["path"], base)
+    if new_path is None:
+        return None
+    op = {**op, "path": new_path}
+    if op["type"] == "setValue":
+        # Concurrent setValue on the same node: last-sequenced wins —
+        # the earlier write mutes when rebased over the later one.
+        if (
+            base["type"] == "setValue"
+            and base["path"] == op["path"]
+            and not base_first
+        ):
+            return None
+        return op
+    # Same-field index adjustment.
+    if (
+        base["type"] != "setValue"
+        and base["path"] == op["path"]
+        and base["field"] == op["field"]
+    ):
+        if op["type"] == "insert":
+            idx = _adjust_index(
+                op["index"], base, is_insert_at=True, base_first=base_first
+            )
+            return {**op, "index": idx}
+        # remove: adjust both ends against the base edit.
+        start, count = op["index"], op["count"]
+        if base["type"] == "insert":
+            b, n = base["index"], len(base["content"])
+            if b <= start:
+                return {**op, "index": start + n}
+            if b < start + count:
+                # Base inserted strictly inside our removed range: the
+                # inserted content is kept — split into two removes
+                # (after-part first so the before-part's index stays
+                # valid when they apply sequentially).
+                left = b - start
+                return {
+                    "type": "multi",
+                    "ops": [
+                        {**op, "index": b + n, "count": count - left},
+                        {**op, "index": start, "count": left},
+                    ],
+                }
+            return op
+        else:  # base remove
+            b, n = base["index"], base["count"]
+            o_start, o_end = start, start + count
+            b_start, b_end = b, b + n
+            lost = max(0, min(o_end, b_end) - max(o_start, b_start))
+            new_count = count - lost
+            if new_count <= 0:
+                return None
+            new_start = o_start if o_start < b_start else max(b_start, o_start - n)
+            return {**op, "index": new_start, "count": new_count}
+    return op
+
+
+def _flatten_one(op: Optional[dict]) -> Change:
+    if op is None:
+        return []
+    if op.get("type") == "multi":
+        return list(op["ops"])
+    return [op]
+
+
+def rebase_change(change: Change, over: Change, over_first: bool = True) -> Change:
+    """Rebase `change` over `over` (changeRebaser.rebase): both start
+    from the same state; the result applies after `over`.
+
+    `over_first` resolves same-index insert ties: True when `over`
+    sequenced earlier than `change` (the normal direction); False when
+    rebasing an earlier-sequenced change over later local ops (e.g.
+    transforming a remote commit over the unsequenced local branch for
+    forest application).
+
+    Uses the transform ladder: each op of `change` is rebased over the
+    advancing base, and the base is advanced over each rebased-past op
+    (with the dual tie-break), so later ops of `change` — whose
+    coordinates assume their predecessors applied — transform against
+    a correctly shifted base.
+    """
+    current = [copy.deepcopy(op) for op in change]
+    for base0 in over:
+        bases = [base0]
+        nxt: Change = []
+        for op in current:
+            transformed: List[Optional[dict]] = [op]
+            new_bases: Change = []
+            for b in bases:
+                step: List[Optional[dict]] = []
+                for t in transformed:
+                    if t is None:
+                        continue
+                    step.append(rebase_op(t, b, base_first=over_first))
+                transformed = step
+                # Advance this base past the ORIGINAL op (dual tie).
+                adv = rebase_op(b, op, base_first=not over_first)
+                new_bases.extend(_flatten_one(adv))
+            bases = new_bases
+            for t in transformed:
+                nxt.extend(_flatten_one(t))
+        current = nxt
+    return current

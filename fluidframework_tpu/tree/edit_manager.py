@@ -1,0 +1,110 @@
+"""EditManager: trunk + concurrent-commit integration.
+
+The role of the reference EditManager
+(packages/dds/tree/src/core/edit-manager/editManager.ts:47): maintain
+the *trunk* (sequenced commits in total order, each stored in trunk
+coordinates — i.e. already rebased over everything before it) and a
+*local branch* of optimistic commits; integrate each incoming
+sequenced commit by rebasing it over the trunk commits its author had
+not seen; rebase the local branch over each integrated remote commit.
+
+The author-visibility rule: a commit from session S with reference
+sequence number r was authored against trunk@r *plus S's own commits
+sequenced in (r, now)* (a session's ops are FIFO). So the rebase set
+is exactly the trunk commits in (r, now) from *other* sessions — which
+is why the reference keeps per-peer branches as an optimization; we
+recompute from the trunk window directly (the collab window is kept
+small by MSN eviction, as zamboni does for merge-trees).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .changeset import Change, rebase_change
+from .forest import Forest
+
+
+@dataclass
+class Commit:
+    change: Change
+    session: Any  # client/session id
+    seq: int = 0  # sequence number once sequenced
+    ref_seq: int = 0  # trunk seq the author had seen
+
+
+class EditManager:
+    def __init__(self, forest: Optional[Forest] = None, session: Any = None):
+        self.session = session
+        self.trunk: List[Commit] = []  # sequenced, trunk coordinates
+        self.local: List[Commit] = []  # optimistic local commits
+        self.forest = forest if forest is not None else Forest()
+        self.trunk_seq = 0  # seq of the newest trunk commit
+
+    # -------------------------------------------------------------- local
+
+    def add_local(self, change: Change) -> Commit:
+        """Record an optimistic local commit (already applied to the
+        forest by the caller)."""
+        commit = Commit(change=change, session=self.session, ref_seq=self.trunk_seq)
+        self.local.append(commit)
+        return commit
+
+    # ----------------------------------------------------------- sequenced
+
+    def _concurrent_window(self, commit: Commit) -> List[Change]:
+        """Trunk changes the commit's author had not seen: sequenced
+        after its ref_seq, from other sessions."""
+        return [
+            c.change
+            for c in self.trunk
+            if c.seq > commit.ref_seq and c.session != commit.session
+        ]
+
+    def integrate_remote(self, change: Change, session: Any, seq: int,
+                         ref_seq: int) -> Change:
+        """A sequenced commit from another session: rebase it into
+        trunk coordinates, append to the trunk, apply to the forest,
+        and rebase the local branch over it. Returns the trunk-coords
+        change (what was applied)."""
+        commit = Commit(change=change, session=session, seq=seq, ref_seq=ref_seq)
+        window = self._concurrent_window(commit)
+        rebased = rebase_change(change, [op for ch in window for op in ch])
+        commit.change = rebased
+        self.trunk.append(commit)
+        self.trunk_seq = seq
+        # The forest holds trunk+local state, so the remote change is
+        # applied rebased over the (unsequenced) local branch — with
+        # the remote's content winning insert ties, since it sequenced
+        # first — while each local commit rebases over the advancing
+        # remote (the reference's SharedTreeBranch.rebaseOnto,
+        # shared-tree-core/branch.ts).
+        carry = rebased
+        for c in self.local:
+            new_change = rebase_change(c.change, carry, over_first=True)
+            carry = rebase_change(carry, c.change, over_first=False)
+            c.change = new_change
+        self.forest.apply(carry)
+        return carry
+
+    def ack_local(self, seq: int) -> Commit:
+        """Our oldest local commit was sequenced: it becomes the trunk
+        head. Its change is already in trunk coordinates — the local
+        branch was rebased over every interleaved remote commit."""
+        assert self.local, "ack with empty local branch"
+        commit = self.local.pop(0)
+        commit.seq = seq
+        commit.ref_seq = self.trunk_seq
+        self.trunk.append(commit)
+        self.trunk_seq = seq
+        return commit
+
+    # ------------------------------------------------------------ windows
+
+    def evict_below(self, min_seq: int) -> int:
+        """Drop trunk commits at/below the MSN (no future commit can
+        reference past them — the trunk-eviction of editManager.ts)."""
+        before = len(self.trunk)
+        self.trunk = [c for c in self.trunk if c.seq > min_seq]
+        return before - len(self.trunk)

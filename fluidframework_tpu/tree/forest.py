@@ -1,0 +1,99 @@
+"""Forest: the tree state store.
+
+The role of the reference's core/forest + object-forest
+(packages/dds/tree/src/feature-libraries/object-forest): holds the
+document tree and applies changesets. Nodes are plain dicts:
+
+    {"type": str?, "value": any?, "fields": {name: [child, ...]}}
+
+`apply` mutates the forest AND enriches the applied ops in place with
+the data invert needs (removed content, prior values) — the reference
+captures the same via repair data.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional
+
+from .changeset import Change
+
+
+def make_node(node_type: Optional[str] = None, value: Any = None,
+              fields: Optional[dict] = None) -> dict:
+    out: dict = {}
+    if node_type is not None:
+        out["type"] = node_type
+    if value is not None:
+        out["value"] = value
+    out["fields"] = dict(fields or {})
+    return out
+
+
+class Forest:
+    def __init__(self, root: Optional[dict] = None):
+        self.root = root if root is not None else make_node("root")
+
+    # ---------------------------------------------------------- navigation
+
+    def node_at(self, path: List[list]) -> Optional[dict]:
+        node = self.root
+        for field, index in path:
+            children = node.get("fields", {}).get(field)
+            if children is None or not (0 <= index < len(children)):
+                return None
+            node = children[index]
+        return node
+
+    def _field(self, path: List[list], field: str) -> Optional[list]:
+        node = self.node_at(path)
+        if node is None:
+            return None
+        return node.setdefault("fields", {}).setdefault(field, [])
+
+    # -------------------------------------------------------------- apply
+
+    def apply(self, change: Change) -> None:
+        """Apply ops in order; ops are enriched in place: removes gain
+        "content", setValues gain "prev" (for invert)."""
+        for op in change:
+            t = op["type"]
+            if t == "insert":
+                children = self._field(op["path"], op["field"])
+                if children is None:
+                    continue  # muted: target vanished (shouldn't happen post-rebase)
+                index = min(op["index"], len(children))
+                children[index:index] = copy.deepcopy(op["content"])
+            elif t == "remove":
+                children = self._field(op["path"], op["field"])
+                if children is None:
+                    continue
+                index = op["index"]
+                end = min(index + op["count"], len(children))
+                op["content"] = copy.deepcopy(children[index:end])
+                del children[index:end]
+            elif t == "setValue":
+                node = self.node_at(op["path"])
+                if node is None:
+                    continue
+                op["prev"] = node.get("value")
+                if op["value"] is None:
+                    node.pop("value", None)
+                else:
+                    node["value"] = op["value"]
+
+    # ------------------------------------------------------------- export
+
+    def to_json(self) -> dict:
+        return copy.deepcopy(self.root)
+
+    def clone(self) -> "Forest":
+        return Forest(copy.deepcopy(self.root))
+
+    def node_count(self) -> int:
+        def count(node: dict) -> int:
+            return 1 + sum(
+                count(c) for cs in node.get("fields", {}).values() for c in cs
+            )
+
+        return count(self.root)

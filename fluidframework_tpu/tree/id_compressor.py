@@ -1,0 +1,144 @@
+"""IdCompressor: session-space ↔ final-space compact ids.
+
+The role of the reference IdCompressor
+(packages/dds/tree/src/id-compressor/idCompressor.ts:272): sessions
+generate ids locally without coordination (negative *local* ids);
+when the ops carrying them are sequenced, ranges are *finalized* into
+compact non-negative final ids allocated in per-session clusters (so a
+session's consecutive ids stay contiguous — cheap range encoding).
+`normalize_to_op_space` translates local ids for the wire;
+`normalize_to_session_space` translates received final ids back.
+
+Every replica finalizes the same ranges in the same total order, so
+the local→final mapping is identical everywhere — the property the
+reference's compressed-id equality relies on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_CLUSTER_CAPACITY = 512
+
+
+@dataclass
+class _Cluster:
+    base_final: int
+    base_local: int  # first local ordinal (1-based count) covered
+    capacity: int
+    count: int = 0
+
+
+class IdCompressor:
+    def __init__(self, session_id: str,
+                 cluster_capacity: int = DEFAULT_CLUSTER_CAPACITY):
+        self.session_id = session_id
+        self.cluster_capacity = cluster_capacity
+        self._local_count = 0  # ids this session has generated
+        self._next_final = 0  # next unallocated final id (global)
+        # session -> clusters (in allocation order)
+        self._clusters: Dict[str, List[_Cluster]] = {}
+        # how many of each session's locals have been finalized
+        self._finalized: Dict[str, int] = {}
+
+    # ---------------------------------------------------------- generate
+
+    def generate_compressed_id(self) -> int:
+        """A new session-local id: -1, -2, ... (idCompressor
+        generateCompressedId)."""
+        self._local_count += 1
+        return -self._local_count
+
+    # ---------------------------------------------------------- finalize
+
+    def finalize_range(self, session: str, count: int) -> None:
+        """Finalize the next `count` locals of `session` (called in
+        total order on every replica as the carrying ops sequence)."""
+        done = self._finalized.get(session, 0)
+        clusters = self._clusters.setdefault(session, [])
+        remaining = count
+        while remaining > 0:
+            tail = clusters[-1] if clusters else None
+            if tail is None or tail.count == tail.capacity:
+                tail = _Cluster(
+                    base_final=self._next_final,
+                    base_local=done + 1,
+                    capacity=max(self.cluster_capacity, remaining),
+                )
+                self._next_final += tail.capacity
+                clusters.append(tail)
+            take = min(remaining, tail.capacity - tail.count)
+            tail.count += take
+            done += take
+            remaining -= take
+        self._finalized[session] = done
+
+    # --------------------------------------------------------- translate
+
+    def _local_to_final(self, session: str, local: int) -> Optional[int]:
+        ordinal = -local  # 1-based
+        for cl in self._clusters.get(session, []):
+            if cl.base_local <= ordinal < cl.base_local + cl.count:
+                return cl.base_final + (ordinal - cl.base_local)
+        return None
+
+    def normalize_to_op_space(self, local_id: int) -> int:
+        """Own local id → final (if finalized) or the local itself
+        (receivers resolve via the carrying op's session)."""
+        if local_id >= 0:
+            return local_id
+        final = self._local_to_final(self.session_id, local_id)
+        return final if final is not None else local_id
+
+    def normalize_to_session_space(self, op_id: int, originator: str) -> int:
+        """An id from the wire → this session's space: finals pass
+        through; a foreign local id maps via the originator's clusters
+        (it must have been finalized by the time we see it... unless it
+        is ours)."""
+        if op_id >= 0:
+            return op_id
+        if originator == self.session_id:
+            return op_id  # our own local: still usable locally
+        final = self._local_to_final(originator, op_id)
+        if final is None:
+            raise KeyError(
+                f"unfinalized foreign id {op_id} from session {originator}"
+            )
+        return final
+
+    def decompress(self, final_id: int) -> Tuple[str, int]:
+        """final id → (session, 1-based ordinal) (stable UUID-like
+        identity in the reference; the pair plays that role here)."""
+        for session, clusters in self._clusters.items():
+            for cl in clusters:
+                if cl.base_final <= final_id < cl.base_final + cl.count:
+                    return session, cl.base_local + (final_id - cl.base_final)
+        raise KeyError(f"unknown final id {final_id}")
+
+    # --------------------------------------------------------- serialize
+
+    def serialize(self) -> dict:
+        return {
+            "sessionId": self.session_id,
+            "clusterCapacity": self.cluster_capacity,
+            "localCount": self._local_count,
+            "nextFinal": self._next_final,
+            "finalized": dict(self._finalized),
+            "clusters": {
+                s: [[c.base_final, c.base_local, c.capacity, c.count] for c in cs]
+                for s, cs in self._clusters.items()
+            },
+        }
+
+    @classmethod
+    def deserialize(cls, data: dict, session_id: Optional[str] = None) -> "IdCompressor":
+        out = cls(session_id or data["sessionId"], data["clusterCapacity"])
+        out._local_count = data["localCount"] if session_id in (None, data["sessionId"]) else 0
+        out._next_final = data["nextFinal"]
+        out._finalized = dict(data["finalized"])
+        out._clusters = {
+            s: [_Cluster(a, b, c, d) for a, b, c, d in cs]
+            for s, cs in data["clusters"].items()
+        }
+        return out

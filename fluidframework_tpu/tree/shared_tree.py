@@ -1,0 +1,176 @@
+"""SharedTree DDS: the channel binding for the rebase-based tree.
+
+The role of reference `SharedTreeCore`/`SharedTree`
+(packages/dds/tree/src/shared-tree-core/sharedTreeCore.ts:93,
+shared-tree/sharedTree.ts:211): local edits apply optimistically and
+ride the op stream as commits {change, refTrunkSeq}; incoming
+sequenced commits integrate through the EditManager; reconnect
+resubmits pending commits rebased to the current trunk (their changes
+are maintained in up-to-date coordinates by the local-branch rebase,
+so resubmission is direct).
+
+Public editing API (the editable-tree role, simplified to explicit
+calls): `insert_node`, `remove_node`, `set_value`, plus `view()` for
+the current JSON tree and `generate_id()` via the id-compressor.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, List, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+from .changeset import Change, insert_op, remove_op, set_value_op
+from .edit_manager import EditManager
+from .forest import Forest
+from .id_compressor import IdCompressor
+
+
+class SharedTree(SharedObject):
+    def initialize_local_core(self) -> None:
+        self.forest = Forest()
+        self.edits = EditManager(self.forest, session=None)
+        self.id_compressor = IdCompressor(session_id=f"detached-{id(self)}")
+
+    def on_connected(self) -> None:
+        cid = self.runtime.client_id
+        self.edits.session = cid
+        self.id_compressor.session_id = str(cid)
+
+    # ------------------------------------------------------------ editing
+
+    def view(self) -> dict:
+        return self.forest.to_json()
+
+    def generate_id(self) -> int:
+        return self.id_compressor.generate_compressed_id()
+
+    def _commit(self, change: Change, id_count: int = 0) -> None:
+        """Apply locally + submit (SharedTreeCore.submitCommit)."""
+        self.forest.apply(change)
+        if self.edits.session is None or self.services is None:
+            # Detached: edits fold straight into the base forest.
+            return
+        commit = self.edits.add_local(change)
+        self.submit_local_message(
+            {
+                "change": copy.deepcopy(change),
+                "refTrunkSeq": commit.ref_seq,
+                "idCount": id_count,
+            },
+            commit,
+        )
+
+    def insert_node(self, path: List[list], field: str, index: int,
+                    content: List[dict], id_count: int = 0) -> None:
+        self._commit([insert_op(path, field, index, content)], id_count)
+
+    def remove_node(self, path: List[list], field: str, index: int,
+                    count: int = 1) -> None:
+        self._commit([remove_op(path, field, index, count)])
+
+    def set_value(self, path: List[list], value: Any) -> None:
+        self._commit([set_value_op(path, value)])
+
+    def edit(self, change: Change, id_count: int = 0) -> None:
+        """Submit a multi-op changeset as one atomic commit."""
+        self._commit(change, id_count)
+
+    # ------------------------------------------------------------ inbound
+
+    def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
+        op = msg.contents
+        if local:
+            commit = self.edits.ack_local(msg.sequence_number)
+            if op.get("idCount"):
+                self.id_compressor.finalize_range(
+                    str(msg.client_id), op["idCount"]
+                )
+        else:
+            self.edits.integrate_remote(
+                op["change"], msg.client_id, msg.sequence_number,
+                op["refTrunkSeq"],
+            )
+            if op.get("idCount"):
+                self.id_compressor.finalize_range(
+                    str(msg.client_id), op["idCount"]
+                )
+            self.emit("treeChanged", False)
+        self.edits.evict_below(msg.minimum_sequence_number)
+
+    def resubmit(self, content: Any, local_metadata: Any) -> None:
+        """Reconnect: the local branch is already maintained in
+        current-trunk coordinates by integrate_remote, so the pending
+        commit resubmits with its change as now rebased."""
+        commit = local_metadata
+        if commit is None or all(c is not commit for c in self.edits.local):
+            return  # sequenced during catch-up
+        commit.ref_seq = self.edits.trunk_seq
+        self.submit_local_message(
+            {
+                "change": copy.deepcopy(commit.change),
+                "refTrunkSeq": commit.ref_seq,
+                "idCount": content.get("idCount", 0),
+            },
+            commit,
+        )
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        self._commit(content["change"], content.get("idCount", 0))
+        return None
+
+    # ---------------------------------------------------------- summaries
+
+    def summarize_core(self):
+        """Forest snapshot + trunk tail (commits above the MSN, still
+        rebase-relevant) + id-compressor state (the reference's
+        summary shape: forest + EditManager + idCompressor)."""
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob(
+                "header",
+                {
+                    "trunkSeq": self.edits.trunk_seq,
+                    "trunk": [
+                        {
+                            "change": c.change,
+                            "session": c.session,
+                            "seq": c.seq,
+                            "refSeq": c.ref_seq,
+                        }
+                        for c in self.edits.trunk
+                    ],
+                },
+            )
+            .add_json_blob("forest", self.forest.to_json())
+            .add_json_blob("idCompressor", self.id_compressor.serialize())
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        header = json.loads(storage.read("header"))
+        self.forest.root = json.loads(storage.read("forest"))
+        self.edits.trunk_seq = header["trunkSeq"]
+        from .edit_manager import Commit
+
+        self.edits.trunk = [
+            Commit(
+                change=c["change"], session=c["session"], seq=c["seq"],
+                ref_seq=c["refSeq"],
+            )
+            for c in header["trunk"]
+        ]
+        self.id_compressor = IdCompressor.deserialize(
+            json.loads(storage.read("idCompressor")),
+            session_id=self.id_compressor.session_id,
+        )
+
+
+class SharedTreeFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/tree"
+    channel_class = SharedTree

@@ -1,0 +1,179 @@
+"""Loader + driver tests: Container lifecycle, delta-queue pausing,
+audience, stashed-op close/resume, replay/file drivers, fault
+injection (the reference's loader + drivers + stashed-op e2e shapes).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from fluidframework_tpu.dds import MapFactory, StringFactory
+from fluidframework_tpu.drivers import (
+    FaultInjectionDriver,
+    FileDriver,
+    LocalDriver,
+    ReplayDriver,
+)
+from fluidframework_tpu.drivers.file_driver import message_to_json
+from fluidframework_tpu.loader import Container, DeltaQueue, Loader
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.server import LocalServer
+
+REGISTRY = ChannelRegistry([MapFactory(), StringFactory()])
+
+
+def make_loader(server=None):
+    server = server or LocalServer()
+    return Loader(LocalDriver(server), REGISTRY), server
+
+
+def seed_container(loader):
+    c = loader.create_detached()
+    ds = c.runtime.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    ds.create_channel("m", MapFactory.type_name)
+    return c
+
+
+def chan(c, cid="s"):
+    return c.runtime.get_datastore("default").get_channel(cid)
+
+
+def test_container_lifecycle_and_audience():
+    loader, server = make_loader()
+    c1 = seed_container(loader)
+    chan(c1).insert_text(0, "content")
+    doc = c1.attach()
+    assert c1.attach_state == "Attached" and c1.connected
+
+    c2 = loader.resolve(doc)
+    assert chan(c2).get_text() == "content"
+    # Audience reflects the quorum on both sides.
+    assert set(c2.audience.get_members()) == {c1.runtime.client_id, c2.runtime.client_id}
+    left = []
+    c2.audience.on("removeMember", left.append)
+    c1.disconnect()
+    assert left == [c1.runtime.client_id]
+
+
+def test_stashed_ops_close_and_resume():
+    """closeAndGetPendingLocalState → new session applies stashed ops
+    and converges (client.ts:831 applyStashedOp path)."""
+    loader, server = make_loader()
+    c1 = seed_container(loader)
+    chan(c1).insert_text(0, "base")
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    # Unflushed edits at close time.
+    chan(c1).insert_text(4, "+tail")
+    chan(c1, "m").set("draft", True)
+    state = c1.close_and_get_pending_state()
+    assert c1.closed
+
+    # A later session resumes with the stashed ops.
+    c3 = loader.resolve(doc, pending_state=state)
+    assert chan(c3).get_text() == "base+tail"
+    assert chan(c2).get_text() == "base+tail"
+    assert chan(c2, "m").get("draft") is True
+    assert not c3.is_dirty
+
+
+def test_delta_queue_pause_resume_step():
+    seen = []
+    q = DeltaQueue(seen.append)
+    q.push(1)
+    assert seen == [1]
+    q.pause()
+    q.push(2)
+    q.push(3)
+    assert seen == [1] and q.length == 2
+    assert q.process_one()  # stepping while paused
+    assert seen == [1, 2]
+    q.resume()
+    assert seen == [1, 2, 3] and q.length == 0
+
+
+def test_replay_driver_stepping_and_readonly():
+    loader, server = make_loader()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    chan(c1).insert_text(0, "abc")
+    c1.flush()
+    chan(c1, "m").set("k", 1)
+    c1.flush()
+
+    stream = server.ops_from(doc, 0)
+    replay = ReplayDriver({doc: stream})
+    rloader = Loader(replay, REGISTRY)
+    rc = rloader.create_detached()
+    ds = rc.runtime.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    ds.create_channel("m", MapFactory.type_name)
+    rc.doc_id = doc
+    rc.connect()
+
+    assert chan(rc).get_text() == ""  # nothing delivered yet
+    replay.step(doc, len(stream) - 1)
+    replay.replay_all(doc)
+    assert chan(rc).get_text() == "abc"
+    assert chan(rc, "m").get("k") == 1
+    with pytest.raises(RuntimeError, match="read-only"):
+        chan(rc).insert_text(0, "x")
+        rc.runtime.flush()
+
+
+def test_file_driver_record_and_replay(tmp_path):
+    loader, server = make_loader()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    chan(c1).insert_text(0, "persisted text")
+    chan(c1).annotate_range(0, 9, {"bold": True})
+    c1.flush()
+
+    fd = FileDriver(str(tmp_path))
+    fd.record(doc, server.download_summary(doc), server.ops_from(doc, 0))
+    assert os.path.exists(tmp_path / doc / "ops.jsonl")
+
+    floader = Loader(FileDriver(str(tmp_path)), REGISTRY)
+    fc = floader.resolve(doc, connect=False)
+    fc.connect()
+    floader.driver.replay_all(doc)
+    assert chan(fc).get_text() == "persisted text"
+    assert chan(fc).annotated_spans() == chan(c1).annotated_spans()
+
+
+def test_fault_injection_reconnect_flow():
+    server = LocalServer()
+    fdriver = FaultInjectionDriver(LocalDriver(server))
+    loader = Loader(fdriver, REGISTRY)
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    chan(c1).insert_text(0, "before ")
+    c1.runtime.flush()
+    # Kill every connection mid-session with a pending local op.
+    chan(c1).insert_text(0, "pending-")
+    fdriver.disconnect_all()
+    assert not c1.connected and not c2.connected
+    # Both sides reconnect; the pending op replays.
+    c1.connect()
+    c2.connect()
+    c1.runtime.flush()
+    assert chan(c1).get_text() == chan(c2).get_text() == "pending-before "
+
+
+def test_fault_injection_submit_failures():
+    server = LocalServer()
+    fdriver = FaultInjectionDriver(LocalDriver(server))
+    loader = Loader(fdriver, REGISTRY)
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    fdriver.submits_fail = True
+    chan(c1, "m").set("x", 1)
+    with pytest.raises(ConnectionError, match="injected"):
+        c1.runtime.flush()
+    fdriver.submits_fail = False

@@ -1,0 +1,240 @@
+"""SharedTree tests: rebase laws (the verifyChangeRebaser contract,
+packages/dds/tree/src/core/rebase/verifyChangeRebaser.ts), TP1
+convergence of the transform, id-compressor semantics, and
+multi-client fuzz through the production runtime stack.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.summary import SummaryTree
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+from fluidframework_tpu.tree import (
+    Forest,
+    IdCompressor,
+    SharedTreeFactory,
+    compose,
+    insert_op,
+    invert,
+    rebase_change,
+    remove_op,
+    set_value_op,
+)
+from fluidframework_tpu.tree.forest import make_node
+
+REGISTRY = ChannelRegistry([SharedTreeFactory()])
+
+
+def leaf(v):
+    return make_node("leaf", value=v)
+
+
+def seeded_forest():
+    f = Forest()
+    f.root["fields"]["items"] = [leaf(i) for i in range(6)]
+    f.root["fields"]["items"][2]["fields"]["sub"] = [leaf("x"), leaf("y")]
+    return f
+
+
+def random_change(rng, forest):
+    """One random valid op against `forest`."""
+    items = forest.root["fields"]["items"]
+    r = rng.random()
+    if r < 0.4:
+        return [insert_op([], "items", rng.randint(0, len(items)),
+                          [leaf(rng.randint(100, 999))])]
+    if r < 0.7 and items:
+        i = rng.randrange(len(items))
+        count = min(rng.randint(1, 2), len(items) - i)
+        return [remove_op([], "items", i, count)]
+    if items:
+        i = rng.randrange(len(items))
+        return [set_value_op([["items", i]], rng.randint(0, 99))]
+    return [insert_op([], "items", 0, [leaf(0)])]
+
+
+# ------------------------------------------------------------- rebase laws
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_tp1_convergence(seed):
+    """apply(S, A ∘ T(B,A)) == apply(S, B ∘ T(A,B)) with priority:
+    A sequenced first."""
+    rng = random.Random(seed)
+    for _ in range(40):
+        S = seeded_forest()
+        A = random_change(rng, S)
+        B = random_change(rng, S)
+        left = S.clone()
+        left.apply([dict(op) for op in A])
+        left.apply(rebase_change(B, A, over_first=True))
+        right = S.clone()
+        right.apply([dict(op) for op in B])
+        right.apply(rebase_change(A, B, over_first=False))
+        assert left.to_json() == right.to_json(), (A, B)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_invert_roundtrip(seed):
+    rng = random.Random(100 + seed)
+    for _ in range(30):
+        S = seeded_forest()
+        before = S.to_json()
+        change = random_change(rng, S)
+        applied = [dict(op) for op in change]
+        S.apply(applied)  # enriches with content/prev
+        S.apply(invert(applied))
+        assert S.to_json() == before
+
+
+def test_rebase_over_composition_equals_sequential():
+    rng = random.Random(7)
+    S = seeded_forest()
+    A = random_change(rng, S)
+    SA = S.clone()
+    SA.apply([dict(o) for o in A])
+    B = random_change(rng, SA)  # B authored after A
+    C = random_change(rng, S)  # C concurrent with both
+    seq = rebase_change(rebase_change(C, A), B)
+    comp = rebase_change(C, compose([A, B]))
+    SL, SR = SA.clone(), SA.clone()
+    SL.apply([dict(o) for o in B])
+    SR.apply([dict(o) for o in B])
+    SL.apply(seq)
+    SR.apply(comp)
+    assert SL.to_json() == SR.to_json()
+
+
+def test_nested_edit_muted_by_ancestor_remove():
+    S = seeded_forest()
+    edit = [set_value_op([["items", 2], ["sub", 0]], "changed")]
+    kill = [remove_op([], "items", 2, 1)]
+    rebased = rebase_change(edit, kill)
+    assert rebased == []  # muted: its subtree is gone
+
+
+def test_nested_path_shifts_with_sibling_edits():
+    S = seeded_forest()
+    edit = [set_value_op([["items", 2], ["sub", 1]], "z")]
+    shift = [insert_op([], "items", 0, [leaf("new")])]
+    rebased = rebase_change(edit, shift)
+    assert rebased[0]["path"] == [["items", 3], ["sub", 1]]
+
+
+# ------------------------------------------------------------ id compressor
+
+
+def test_id_compressor_finalization_consistency():
+    a = IdCompressor("A", cluster_capacity=4)
+    b = IdCompressor("B", cluster_capacity=4)
+    ids = [a.generate_compressed_id() for _ in range(3)]
+    assert ids == [-1, -2, -3]
+    # Both replicas finalize the same ranges in the same order.
+    for c in (a, b):
+        c.finalize_range("A", 3)
+        c.finalize_range("B", 2)
+        c.finalize_range("A", 2)
+    # A's locals map to finals identically on both.
+    finals_on_a = [a.normalize_to_op_space(i) for i in ids]
+    finals_on_b = [a._local_to_final("A", i) for i in ids]
+    assert finals_on_a == finals_on_b
+    assert b.decompress(finals_on_a[0]) == ("A", 1)
+    # Cluster growth: A's 4th/5th ids spill into a new cluster.
+    assert a._local_to_final("A", -5) is not None
+    rt = IdCompressor.deserialize(a.serialize())
+    assert rt.decompress(finals_on_a[2]) == ("A", 3)
+
+
+# ----------------------------------------------------- DDS through runtime
+
+
+def make_harness(n=2):
+    return MultiClientHarness(
+        n, REGISTRY, channel_types=[("t", SharedTreeFactory.type_name)]
+    )
+
+
+def test_tree_basic_convergence():
+    h = make_harness()
+    a, b = h.channel(0, "t"), h.channel(1, "t")
+    a.insert_node([], "todo", 0, [leaf("buy milk")])
+    h.process_all()
+    b.insert_node([], "todo", 1, [leaf("walk dog")])
+    a.set_value([["todo", 0]], "buy oat milk")
+    h.process_all()
+    assert a.view() == b.view()
+    todos = a.view()["fields"]["todo"]
+    assert [t["value"] for t in todos] == ["buy oat milk", "walk dog"]
+
+
+def test_tree_concurrent_same_index_inserts():
+    h = make_harness()
+    a, b = h.channel(0, "t"), h.channel(1, "t")
+    a.insert_node([], "L", 0, [leaf("A")])
+    b.insert_node([], "L", 0, [leaf("B")])
+    h.process_all()
+    assert a.view() == b.view()
+    # a's op sequenced first: its content lands first.
+    assert [n["value"] for n in a.view()["fields"]["L"]] == ["A", "B"]
+
+
+def test_tree_concurrent_remove_and_edit():
+    h = make_harness()
+    a, b = h.channel(0, "t"), h.channel(1, "t")
+    a.edit([insert_op([], "L", 0, [leaf(i) for i in range(5)])])
+    h.process_all()
+    a.remove_node([], "L", 1, 3)
+    b.set_value([["L", 2]], "edited")  # inside a's removed range: muted
+    b.set_value([["L", 4]], "kept")  # outside: survives, slides to 1
+    h.process_all()
+    assert a.view() == b.view()
+    vals = [n["value"] for n in a.view()["fields"]["L"]]
+    assert vals == [0, "kept"]
+
+
+def test_tree_fuzz_convergence():
+    h = make_harness(3)
+    chans = [h.channel(i, "t") for i in range(3)]
+    chans[0].edit([insert_op([], "items", 0, [leaf(i) for i in range(4)])])
+    h.process_all()
+    rng = random.Random(11)
+    for _ in range(25):
+        for c in chans:
+            c.edit(random_change(rng, c.forest))
+        h.process_all()
+    views = [c.view() for c in chans]
+    assert views[0] == views[1] == views[2]
+
+
+def test_tree_summary_roundtrip_and_rejoin():
+    h = make_harness()
+    a = h.channel(0, "t")
+    a.insert_node([], "doc", 0, [make_node("para", fields={"runs": [leaf("hi")]})])
+    a.set_value([["doc", 0], ["runs", 0]], "hello")
+    h.process_all()
+    wire = h.runtimes[0].summarize().to_json()
+    rt = ContainerRuntime(REGISTRY)
+    rt.load(SummaryTree.from_json(wire))
+    t = rt.get_datastore("default").get_channel("t")
+    assert t.view() == a.view()
+    rt.connect(h.service.connect(h.doc_id, client_id=31))
+    t.insert_node([], "doc", 1, [leaf("appended")])
+    rt.flush()
+    h.process_all()
+    assert h.channel(1, "t").view() == t.view()
+
+
+def test_tree_ids_travel_with_commits():
+    h = make_harness()
+    a, b = h.channel(0, "t"), h.channel(1, "t")
+    nid = a.generate_id()
+    a.insert_node([], "k", 0, [make_node("n", value=nid)], id_count=1)
+    h.process_all()
+    # Both replicas finalized a's range identically.
+    fa = a.id_compressor.normalize_to_op_space(nid)
+    assert fa >= 0
+    assert b.id_compressor.decompress(fa) == (str(1), 1)
