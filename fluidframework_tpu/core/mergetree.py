@@ -519,22 +519,46 @@ class MergeTreeEngine:
                 return 0  # earlier pending remove sequences first
         return len(seg)
 
-    def regenerate_pending_op(
-        self, grp: "_PendingGroup", original: "MergeTreeOp"
-    ) -> Optional["MergeTreeOp"]:
-        """Rebase a pending local op against current state for
+    def regenerate_pending(
+        self, grps: List["_PendingGroup"], original: "MergeTreeOp"
+    ) -> "Tuple[Optional[MergeTreeOp], List[_PendingGroup]]":
+        """Rebase the pending local op backed by `grps` for
         resubmission after reconnect (reference
         Client.regeneratePendingOp / normalizeSegmentsOnRebase,
-        client.ts:917): positions are recomputed from the pending
-        group's segments, because remote edits sequenced since the op
-        was created may have shifted them. Range ops whose segments
-        became non-contiguous regenerate as a GroupOp of per-segment
-        ops (and their pending group splits to match, so the single
-        sequenced ack of the GroupOp pops one group per sub-op).
+        client.ts:917). `grps` is every pending group backing the one
+        wire message being resubmitted: one group for a first-time
+        resubmit, several when a previous reconnect already split a
+        range op into per-segment groups.
 
-        Returns the op to resubmit, or None if nothing remains (the
-        pending group is dropped from the FIFO in that case).
+        Returns ``(op, groups)`` where `groups` are the pending groups
+        backing the returned op, **in sub-op order** (len == number of
+        sub-ops; a GroupOp of N ops is backed by N groups, so its
+        single sequenced ack pops one group per sub-op). Callers MUST
+        store `groups` — not the stale input — as the resubmitted
+        message's local metadata, or a second reconnect will misread
+        the stale group's absence from the pending FIFO as "already
+        sequenced" and silently drop the op.
+
+        Returns ``(None, [])`` if nothing remains to resubmit (the
+        input groups are dropped from the FIFO in that case).
         """
+        ops: List[MergeTreeOp] = []
+        out_groups: List[_PendingGroup] = []
+        for grp in grps:
+            if all(g is not grp for g in self.pending):
+                continue  # this piece already sequenced during catch-up
+            sub_ops, sub_groups = self._regenerate_one(grp, original)
+            ops.extend(sub_ops)
+            out_groups.extend(sub_groups)
+        if not ops:
+            return None, []
+        if len(ops) == 1:
+            return ops[0], out_groups
+        return GroupOp(ops=ops), out_groups
+
+    def _regenerate_one(
+        self, grp: "_PendingGroup", original: "MergeTreeOp"
+    ) -> "Tuple[List[MergeTreeOp], List[_PendingGroup]]":
         order = list(self.pending)
         idx = order.index(grp)
         seg_pos = {id(s): i for i, s in enumerate(self.segments)}
@@ -558,7 +582,7 @@ class MergeTreeEngine:
         if grp.kind == MergeTreeDeltaType.INSERT:
             if not segs:
                 self.pending.remove(grp)
-                return None
+                return [], []
             text_parts = [s.content for s in segs]
             content = (
                 "".join(text_parts)
@@ -568,8 +592,8 @@ class MergeTreeEngine:
             props = original.props if isinstance(original, InsertOp) else None
             pos = base_pos(segs[0])
             if isinstance(content, str):
-                return InsertOp(pos=pos, text=content, props=props)
-            return InsertOp(pos=pos, seg=content, props=props)
+                return [InsertOp(pos=pos, text=content, props=props)], [grp]
+            return [InsertOp(pos=pos, seg=content, props=props)], [grp]
 
         # A segment whose removal has already *sequenced* (a remote
         # remove overtook our pending one) is a tombstone for every
@@ -581,7 +605,7 @@ class MergeTreeEngine:
         ]
         if not segs:
             self.pending.remove(grp)
-            return None
+            return [], []
 
         # Split the group: one per-segment group in place of the original.
         at = idx
@@ -607,9 +631,7 @@ class MergeTreeEngine:
                 ops.append(
                     AnnotateOp(start=start, end=end, props=dict(grp.props or {}))
                 )
-        if len(ops) == 1:
-            return ops[0]
-        return GroupOp(ops=ops)
+        return ops, new_groups
 
     # --------------------------------------------------- local references
 

@@ -252,13 +252,12 @@ class SharedMatrix(SharedObject):
             )
             return
         pv = self.rows if local_metadata["axis"] == "rows" else self.cols
-        grp = local_metadata["group"]
-        if all(g is not grp for g in pv.engine.pending):
-            return  # sequenced during catch-up
+        grps = local_metadata["group"]
+        grps = grps if isinstance(grps, list) else [grps]
         from ..protocol.mergetree_ops import GroupOp, InsertOp, RemoveOp
 
-        regenerated = pv.engine.regenerate_pending_op(
-            grp,
+        regenerated, new_groups = pv.engine.regenerate_pending(
+            grps,
             InsertOp(pos=op["pos"]) if kind.startswith("insert")
             else RemoveOp(start=op["pos"], end=op["pos"] + op["count"]),
         )
@@ -266,13 +265,18 @@ class SharedMatrix(SharedObject):
             return
         subs = regenerated.ops if isinstance(regenerated, GroupOp) else [regenerated]
         # Each regenerated sub-op submits as its own message (each pops
-        # one per-segment pending group on ack).
-        for sub in subs:
+        # one per-segment pending group on ack), carrying ITS OWN
+        # replacement group as metadata so a second reconnect can find
+        # it in the pending FIFO (stale-group metadata silently dropped
+        # resubmissions — advisor finding, round 1).
+        for sub, g in zip(subs, new_groups):
             if isinstance(sub, InsertOp):
                 mop = {"type": kind, "pos": sub.pos, "count": len(sub.seg or sub.text)}
             else:
                 mop = {"type": kind, "pos": sub.start, "count": sub.end - sub.start}
-            self.submit_local_message(mop, local_metadata)
+            self.submit_local_message(
+                mop, {"axis": local_metadata["axis"], "group": g}
+            )
 
     def apply_stashed_op(self, content: Any) -> Any:
         op = content

@@ -91,19 +91,29 @@ class SharedSegmentSequence(SharedObject):
     def resubmit(self, content: Any, local_metadata: Any) -> None:
         """Reconnect replay: rebase the pending op against current
         state before resubmitting (reference reSubmitCore →
-        Client.regeneratePendingOp, client.ts:917)."""
+        Client.regeneratePendingOp, client.ts:917).
+
+        `local_metadata` is the pending group backing the message, or
+        the *list* of groups a previous reconnect's regeneration split
+        it into; the resubmitted message's metadata is always the
+        replacement group list returned by `regenerate_pending`, so
+        membership checks stay valid across repeated reconnects."""
         if not (isinstance(content, dict) and content.get("kind") == "seq"):
             self.submit_local_message(content, local_metadata)
             return
-        grp = local_metadata
-        if grp is None or grp not in self.engine.pending:
-            return  # already sequenced during catch-up: nothing to send
+        grps = local_metadata if isinstance(local_metadata, list) else (
+            [] if local_metadata is None else [local_metadata]
+        )
         op = content["op"]
         if isinstance(op, dict):
             op = op_from_json(op)
-        regenerated = self.engine.regenerate_pending_op(grp, op)
+        # regenerate_pending skips groups no longer pending (sequenced
+        # during catch-up) and returns (None, []) when nothing remains.
+        regenerated, new_groups = self.engine.regenerate_pending(grps, op)
         if regenerated is not None:
-            self.submit_local_message({"kind": "seq", "op": regenerated}, grp)
+            self.submit_local_message(
+                {"kind": "seq", "op": regenerated}, new_groups
+            )
 
     def _local_perspective(self):
         return self.engine.current_seq, self.engine.local_client_id

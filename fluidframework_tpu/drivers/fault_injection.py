@@ -41,6 +41,14 @@ class _FaultConnection:
     def nack_listener(self, fn):
         self._inner.nack_listener = fn
 
+    @property
+    def disconnect_listener(self):
+        return self._inner.disconnect_listener
+
+    @disconnect_listener.setter
+    def disconnect_listener(self, fn):
+        self._inner.disconnect_listener = fn
+
     def catch_up(self, from_seq: int):
         return self._inner.catch_up(from_seq)
 
@@ -65,6 +73,9 @@ class FaultInjectionDriver:
         self.connections: List[_FaultConnection] = []
         self.submits_fail = False
         self.drop_submits = False
+        # Next N connect() calls raise ConnectionError (exercises the
+        # reconnect backoff ladder, connectionManager.ts:170).
+        self.connects_fail_remaining = 0
 
     # ----------------------------------------------------- driver surface
 
@@ -75,6 +86,9 @@ class FaultInjectionDriver:
         return self.inner.load_document(doc_id)
 
     def connect(self, doc_id: str, client_id: Optional[int] = None):
+        if self.connects_fail_remaining > 0:
+            self.connects_fail_remaining -= 1
+            raise ConnectionError("injected connect failure")
         conn = _FaultConnection(self.inner.connect(doc_id, client_id), self)
         self.connections.append(conn)
         return conn

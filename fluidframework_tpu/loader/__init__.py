@@ -8,7 +8,8 @@ auto-reconnect, `Audience` (audience.ts), and stashed-op close/resume
 """
 
 from .container import Container, Loader
+from .connection_manager import ConnectionManager
 from .delta_queue import DeltaQueue
 from .audience import Audience
 
-__all__ = ["Audience", "Container", "DeltaQueue", "Loader"]
+__all__ = ["Audience", "ConnectionManager", "Container", "DeltaQueue", "Loader"]

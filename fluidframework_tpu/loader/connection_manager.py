@@ -1,0 +1,88 @@
+"""ConnectionManager: automatic reconnect with a backoff ladder.
+
+Reference `ConnectionManager`
+(loader/container-loader/src/connectionManager.ts:170): when the
+transport drops, the loader retries the driver connection with
+exponential delay until it succeeds or the retry budget is exhausted;
+on success, the runtime's connect path replays pending ops (rebase +
+resubmit). Here the ladder is synchronous and the sleep function is
+injectable so tests run with zero wall-clock delay while still
+asserting the delay schedule.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional
+
+
+class ConnectionManager:
+    """Watches a Container's "disconnected" event and re-establishes
+    the connection through the container's driver.
+
+    Parameters mirror the reference's retry policy shape: delay
+    doubles per attempt from `base_delay` up to `max_delay`
+    (connectionManager.ts reconnect + driver-supplied retryAfter).
+    `sleep` is injectable for tests; `delays` records the schedule
+    actually used.
+    """
+
+    def __init__(
+        self,
+        container,
+        max_attempts: int = 8,
+        base_delay: float = 0.05,
+        max_delay: float = 5.0,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self.container = container
+        self.max_attempts = max_attempts
+        self.base_delay = base_delay
+        self.max_delay = max_delay
+        self.sleep = sleep
+        self.delays: List[float] = []
+        self.enabled = True
+        self._reconnecting = False
+        container.on("disconnected", self._on_disconnected)
+
+    def delay_for(self, attempt: int) -> float:
+        return min(self.base_delay * (2 ** attempt), self.max_delay)
+
+    def _on_disconnected(self) -> None:
+        if not self.enabled or self._reconnecting:
+            return
+        if self.container.closed or self.container.doc_id is None:
+            return
+        self._reconnecting = True
+        try:
+            self._run_ladder()
+        finally:
+            self._reconnecting = False
+
+    def _run_ladder(self) -> None:
+        last_exc: Optional[BaseException] = None
+        for attempt in range(self.max_attempts):
+            if self.container.closed:
+                return
+            try:
+                self.container.connect()
+                # connect() can "succeed" yet leave the container
+                # disconnected again (e.g. the replay flush was nacked
+                # mid-connect, which detaches the connection while
+                # _reconnecting suppresses the re-entrant event) —
+                # success is the container BEING connected.
+                if self.container.connected:
+                    return
+            except ConnectionError as exc:  # transient transport error
+                last_exc = exc
+                # A failure mid-connect (e.g. replay flush raising
+                # after the transport was established) may leave a
+                # half-wired connection whose listener still targets
+                # the runtime; tear it down or the next attempt would
+                # double-deliver every sequenced message.
+                self.container.disconnect()
+            if attempt + 1 < self.max_attempts:
+                delay = self.delay_for(attempt)
+                self.delays.append(delay)
+                self.sleep(delay)
+        self.container.emit("connectionFailure", last_exc)

@@ -24,9 +24,10 @@ import json
 import uuid
 from typing import Any, Dict, List, Optional
 
+from ..protocol.constants import PROVISIONAL_CLIENT
 from ..protocol.mergetree_ops import op_to_json
 from ..runtime.channel import ChannelRegistry
-from ..runtime.container_runtime import ContainerRuntime, FlushMode
+from ..runtime.container_runtime import ContainerRuntime, Envelope, FlushMode
 from ..runtime.summary import SummaryTree
 from ..utils.events import EventEmitter
 from .audience import Audience
@@ -89,14 +90,23 @@ class Container(EventEmitter):
         self.runtime.flush()
 
     def close(self) -> None:
-        self.disconnect()
+        # Mark closed BEFORE dropping the connection: the disconnect
+        # event fires listeners (e.g. ConnectionManager's reconnect
+        # ladder) that must see this as a deliberate close, not a
+        # transport loss to recover from.
         self.closed = True
+        self.disconnect()
         self.emit("closed")
 
     def close_and_get_pending_state(self) -> str:
         """Serialize unacked local ops for a later session
         (closeAndGetPendingLocalState). The summary captured here is
         the *acked* state; pending ops re-apply on top of it."""
+        # Runtime-level attach ops (channel is None) are serialized
+        # too: a dynamically created channel whose announcement was
+        # unacked at close must reach the resumed session (its attach
+        # summary rides the op contents), or the creator's channel
+        # silently vanishes.
         pending = [
             {
                 "datastore": pm.envelope.datastore,
@@ -104,7 +114,6 @@ class Container(EventEmitter):
                 "contents": _encode_stash_content(pm.envelope.contents),
             }
             for pm in list(self.runtime._pending) + list(self.runtime._outbox)
-            if pm.envelope.channel is not None
         ]
         state = {
             "docId": self.doc_id,
@@ -140,21 +149,52 @@ class Loader:
         rt = ContainerRuntime(self.registry, flush_mode=self.flush_mode)
         rt.load(SummaryTree.from_json(wire))
         container = Container(rt, self.driver, doc_id)
-        if connect:
-            container.connect(client_id)
         if pending_state is not None:
             state = json.loads(pending_state)
             assert state["docId"] == doc_id
-            # Ops from the stashed session re-apply as fresh pending
-            # local ops on the caught-up replica
-            # (IDeltaHandler.applyStashedOp, channel.ts:153) and flush
-            # into the stream under the new identity.
-            if not connect:
-                rt._ever_connected = True
-                for ds in rt.datastores.values():
-                    ds.attach_all()
+            # Stashed ops recorded positions at the stashed session's
+            # perspective (baseSeq). Re-applying them after a full
+            # catch-up would land them at stale positions whenever
+            # remote ops sequenced past the stash point (the reference
+            # applyStashedOp preserves the op's original refSeq). So:
+            # replay the op tail only UP TO baseSeq, apply the stash as
+            # fresh pending local ops at that perspective
+            # (IDeltaHandler.applyStashedOp, channel.ts:153), and let
+            # the normal connect catch-up rebase them through the
+            # pending-op path for anything sequenced later.
+            rt._ever_connected = True
+            # Channels must be *collaborating* for the stash to apply
+            # as pending local ops (not detached content); a real
+            # client id only arrives at connect, so stash under a
+            # provisional identity — connect's resubmit path re-stamps
+            # pending segments with the assigned id (client.ts:917).
+            rt.client_id = PROVISIONAL_CLIENT
+            for ds in rt.datastores.values():
+                ds.attach_all()
+            base = state["baseSeq"]
+            # ops_from is part of the required driver surface (module
+            # docstring); skipping this tail replay would re-apply the
+            # stash at the summary perspective — the stale-position
+            # bug — so its absence must fail loudly, not silently.
+            for msg in self.driver.ops_from(doc_id, rt.current_seq):
+                if msg.sequence_number > base:
+                    break
+                rt.process(msg)
             for stashed in state["pending"]:
-                ds = rt.get_datastore(stashed["datastore"])
-                ds.apply_stashed_op(stashed["channel"], stashed["contents"])
-            rt.flush()
+                if stashed["channel"] is None:
+                    # Pending attach op: realize the channel locally
+                    # from its carried attach summary, then queue the
+                    # announcement to resubmit as-is on connect.
+                    rt._process_attach(
+                        stashed["datastore"], stashed["contents"], local=False
+                    )
+                    rt._submit_op(
+                        Envelope(stashed["datastore"], None, stashed["contents"]),
+                        None,
+                    )
+                else:
+                    ds = rt.get_datastore(stashed["datastore"])
+                    ds.apply_stashed_op(stashed["channel"], stashed["contents"])
+        if connect:
+            container.connect(client_id)
         return container

@@ -24,6 +24,13 @@ NON_COLLAB_CLIENT = -2
 # "No client" marker for int32 tables (removing client slots, etc.).
 NO_CLIENT = -3
 
+# Provisional local identity for a rehydrating session applying
+# stashed ops before its first server connection assigns a real
+# client id (the reference's applyStashedOp runs on a container that
+# is not yet connected). Replaced — and pending segments re-stamped —
+# by the reconnect/resubmit path on connect.
+PROVISIONAL_CLIENT = -4
+
 # Effective-sequence-number encoding used by tie-breaks
 # (reference: mergeTree.ts:1719 breakTie). A *new* local pending op
 # compares as +inf; an *existing* local pending segment as +inf - 1.

@@ -159,6 +159,15 @@ class ContainerRuntime(EventEmitter):
         self._client_seq = 0
         if hasattr(connection, "nack_listener"):
             connection.nack_listener = self._on_nack
+        # Transport loss (server/driver-initiated included) must
+        # transition the runtime to disconnected — the reference
+        # DeltaManager surfaces the transport "disconnect" event to the
+        # container (connectionManager.ts:170); without it the runtime
+        # would keep a dead connection and report connected=True.
+        if hasattr(connection, "disconnect_listener"):
+            connection.disconnect_listener = (
+                lambda conn=connection: self._on_connection_lost(conn)
+            )
         for ds in self.datastores.values():
             ds.attach_all()
         # Delta catch-up BEFORE replaying pending: ops that *did*
@@ -204,11 +213,23 @@ class ContainerRuntime(EventEmitter):
         """Leave the current connection; unacked ops stay pending for
         replay on the next connect()."""
         conn, self.connection = self.connection, None
-        if conn is not None and hasattr(conn, "disconnect"):
+        if conn is None:
+            return
+        if hasattr(conn, "disconnect"):
             try:
                 conn.disconnect()
             except Exception:
                 pass
+        self._emit("disconnected")
+
+    def _on_connection_lost(self, conn) -> None:
+        """Transport-initiated disconnect (fault injection, server
+        eviction, socket loss). Idempotent with locally initiated
+        `disconnect()`: whichever runs first clears `connection`, so
+        the event fires exactly once."""
+        if self.connection is not conn:
+            return  # already detached from this connection
+        self.connection = None
         self._emit("disconnected")
 
     # ----------------------------------------------------------- outbound
@@ -255,6 +276,13 @@ class ContainerRuntime(EventEmitter):
         n = len(batch)
         if n == 0:
             return
+        conn = self.connection
+        # Stage the ENTIRE batch as in-flight before submitting any of
+        # it: a synchronous nack or transport loss during a submit
+        # triggers the reconnect replay, which must see the whole
+        # batch in _pending — otherwise the unsent remainder would
+        # later go out raw on a new connection, bypassing the DDS
+        # resubmit/rebase path and splitting batch atomicity.
         for i, pm in enumerate(batch):
             meta = None
             if n > 1:
@@ -267,6 +295,13 @@ class ContainerRuntime(EventEmitter):
             pm.client_id = self.client_id
             pm.batch_meta = meta
             self._pending.append(pm)
+        for pm in batch:
+            if self.connection is not conn:
+                # Connection died (or was replaced by a reconnect
+                # ladder) mid-batch: stop — every message of this
+                # batch was staged pending, so the reconnect replay
+                # owns them all now.
+                return
             if pm.envelope.channel is None:  # runtime-level (attach) op
                 inner = pm.envelope.contents
             else:
@@ -274,13 +309,13 @@ class ContainerRuntime(EventEmitter):
                     "address": pm.envelope.channel,
                     "contents": pm.envelope.contents,
                 }
-            self.connection.submit(
+            conn.submit(
                 DocumentMessage(
                     client_seq=pm.client_seq,
                     ref_seq=pm.ref_seq,
                     type=MessageType.OP,
                     contents={"address": pm.envelope.datastore, "contents": inner},
-                    metadata=meta,
+                    metadata=pm.batch_meta,
                 )
             )
 
@@ -362,7 +397,7 @@ class ContainerRuntime(EventEmitter):
         if local:
             pm = self._pending.popleft()
             local_metadata = pm.local_metadata
-        elif msg.client_id == self.client_id:
+        elif self.client_id is not None and msg.client_id == self.client_id:
             raise AssertionError(
                 f"own op seq={msg.sequence_number} clientSeq={msg.client_seq} "
                 "does not match pending head"

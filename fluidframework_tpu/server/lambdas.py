@@ -298,6 +298,11 @@ class _Socket(BufferedListener):
         self.doc_id = doc_id
         self.client_id = client_id
         self.nack_listener: Optional[Callable[[NackMessage], None]] = None
+        # Transport "disconnect" event surfaced to the runtime
+        # (connectionManager.ts:170); fires for both locally and
+        # server/driver-initiated disconnects. Assigned by
+        # ContainerRuntime.connect.
+        self.disconnect_listener: Optional[Callable[[], None]] = None
         self.connected = True
         self.join_seq = 0
 
@@ -333,6 +338,8 @@ class _Socket(BufferedListener):
         if self.connected:
             self.connected = False
             self.server.alfred_disconnect(self)
+            if self.disconnect_listener is not None:
+                self.disconnect_listener()
 
 
 class LocalServer:

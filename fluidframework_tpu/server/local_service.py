@@ -28,6 +28,12 @@ class _Connection(BufferedListener):
         self.doc_id = doc_id
         self.client_id = client_id
         self.nack_listener: Optional[NackListener] = None
+        # Invoked (once) when the connection dies — the transport
+        # "disconnect" event the reference DeltaManager surfaces to the
+        # container (connectionManager.ts:170). Assigned by
+        # ContainerRuntime.connect; fires for BOTH locally initiated
+        # and server/driver-initiated disconnects.
+        self.disconnect_listener: Optional[Callable[[], None]] = None
         self.connected = True
         # Sequence number of this connection's join message: live
         # delivery covers strictly-later messages; everything at/before
@@ -55,6 +61,8 @@ class _Connection(BufferedListener):
         if self.connected:
             self.connected = False
             self.service._leave(self.doc_id, self.client_id)
+            if self.disconnect_listener is not None:
+                self.disconnect_listener()
 
 
 class LocalOrderingService:

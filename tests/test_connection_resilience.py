@@ -1,0 +1,244 @@
+"""Connection-loss, reconnect-backoff, and repeated-reconnect tests.
+
+Covers the reference's ConnectionManager semantics
+(loader/container-loader/src/connectionManager.ts:170): transport
+disconnect events propagate to the container, reconnects retry on a
+backoff ladder, and pending local ops survive arbitrarily many
+reconnect cycles (including resubmissions lost in flight —
+client.ts:917 regeneratePendingOp across repeated reconnects).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds import MapFactory, StringFactory
+from fluidframework_tpu.drivers import FaultInjectionDriver, LocalDriver
+from fluidframework_tpu.loader import ConnectionManager, Loader
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.server import LocalServer
+
+REGISTRY = ChannelRegistry([MapFactory(), StringFactory()])
+
+
+def make_fault_stack():
+    server = LocalServer()
+    fdriver = FaultInjectionDriver(LocalDriver(server))
+    return Loader(fdriver, REGISTRY), fdriver, server
+
+
+def seed_container(loader):
+    c = loader.create_detached()
+    ds = c.runtime.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    ds.create_channel("m", MapFactory.type_name)
+    return c
+
+
+def chan(c, cid="s"):
+    return c.runtime.get_datastore("default").get_channel(cid)
+
+
+def test_driver_disconnect_propagates_to_container():
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    events = []
+    c1.on("disconnected", lambda: events.append("disconnected"))
+    fdriver.disconnect_all()
+    assert not c1.connected
+    assert events == ["disconnected"]
+    # Locally initiated disconnect after transport loss is a no-op.
+    c1.disconnect()
+    assert events == ["disconnected"]
+
+
+def test_resubmission_lost_then_second_reconnect_converges():
+    """A rebased resubmission dropped in flight must survive the NEXT
+    reconnect too (round-1 advisor finding: stale pending-group
+    metadata silently dropped the op and replicas diverged)."""
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    # Two separate sequenced segments, so the pending remove spans a
+    # multi-segment group and regeneration splits it.
+    chan(c1).insert_text(0, "abc")
+    c1.flush()
+    chan(c1).insert_text(3, "def")
+    c1.flush()
+    assert chan(c2).get_text() == "abcdef"
+
+    # Pending remove spanning both segments, then: reconnect #1 whose
+    # resubmission is dropped in flight, then reconnect #2.
+    chan(c1).remove_range(1, 5)
+    fdriver.disconnect_all()
+    fdriver.drop_submits = True
+    c1.connect()
+    c1.flush()  # resubmission lost (network partition)
+    fdriver.drop_submits = False
+    fdriver.disconnect_all()
+    c1.connect()
+    c2.connect()
+    c1.flush()
+
+    assert chan(c1).get_text() == "af"
+    assert chan(c2).get_text() == "af"
+    assert not c1.runtime.is_dirty
+    # No leaked pending groups in the engine.
+    assert not chan(c1).engine.pending
+
+
+def test_annotate_resubmission_survives_repeated_reconnects():
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+    chan(c1).insert_text(0, "ab")
+    c1.flush()
+    chan(c1).insert_text(2, "cd")
+    c1.flush()
+
+    chan(c1).annotate_range(1, 3, {"bold": True})
+    for _ in range(3):  # several lost resubmissions in a row
+        fdriver.disconnect_all()
+        fdriver.drop_submits = True
+        c1.connect()
+        c1.flush()
+        fdriver.drop_submits = False
+    fdriver.disconnect_all()
+    c1.connect()
+    c2.connect()
+    c1.flush()
+    assert chan(c1).annotated_spans() == chan(c2).annotated_spans()
+    assert not c1.runtime.is_dirty
+
+
+def test_connection_manager_backoff_ladder():
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    slept = []
+    cm = ConnectionManager(c1, base_delay=0.01, max_delay=0.04, sleep=slept.append)
+
+    chan(c1).insert_text(0, "x")
+    fdriver.connects_fail_remaining = 3
+    fdriver.disconnect_all()
+    # The manager retried through the ladder and reconnected.
+    assert c1.connected
+    assert slept == [0.01, 0.02, 0.04]
+    assert slept == cm.delays
+    c1.flush()
+    c2 = loader.resolve(doc)
+    assert chan(c2).get_text() == "x"
+
+
+def test_connection_manager_gives_up_and_reports():
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    c1.attach()
+    failures = []
+    c1.on("connectionFailure", failures.append)
+    ConnectionManager(c1, max_attempts=2, base_delay=0.0, sleep=lambda _: None)
+    fdriver.connects_fail_remaining = 99
+    fdriver.disconnect_all()
+    assert not c1.connected
+    assert len(failures) == 1 and isinstance(failures[0], ConnectionError)
+    fdriver.connects_fail_remaining = 0
+    c1.connect()
+    assert c1.connected
+
+
+def test_stashed_ops_rebase_past_remote_edits():
+    """Stashed ops re-apply at the recorded baseSeq perspective, not at
+    the caught-up head (round-1 advisor finding: a stashed tail-insert
+    landed mid-word after a remote prepend)."""
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    chan(c1).insert_text(0, "hello")
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    chan(c1).insert_text(5, "!")  # pending at close
+    state = c1.close_and_get_pending_state()
+
+    # Remote edits sequenced AFTER the stash point.
+    chan(c2).insert_text(0, "XXX")
+    c2.flush()
+
+    c3 = loader.resolve(doc, pending_state=state)
+    assert chan(c3).get_text() == "XXXhello!"
+    assert chan(c2).get_text() == "XXXhello!"
+    assert not c3.is_dirty
+
+
+def test_stash_includes_pending_attach_op():
+    """A dynamically created channel whose attach op was unacked at
+    close must reach the resumed session (round-1 advisor finding:
+    the attach op was filtered out of the stash)."""
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    ds = c1.runtime.get_datastore("default")
+    ch = ds.create_channel("dyn", MapFactory.type_name)
+    c1.runtime.submit_attach_op("default", ch)
+    ds.attach_channel(ch)
+    ch.on_connected()
+    ch.set("k", 42)
+    state = c1.close_and_get_pending_state()  # attach + set both stashed
+
+    c3 = loader.resolve(doc, pending_state=state)
+    assert c3.runtime.get_datastore("default").get_channel("dyn").get("k") == 42
+    assert c2.runtime.get_datastore("default").get_channel("dyn").get("k") == 42
+    assert not c3.is_dirty
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fault_injection_farm(seed):
+    """Full-stack convergence farm with random disconnect injection:
+    every round each container makes random edits; random clients get
+    their connections killed mid-round and reconnect (replaying
+    pending ops); all replicas must converge exactly (the reference's
+    reconnectFarm + faultInjectionDriver shapes combined)."""
+    rng = random.Random(seed)
+    loader, fdriver, server = make_fault_stack()
+    c0 = seed_container(loader)
+    chan(c0).insert_text(0, "seedtext")
+    doc = c0.attach()
+    containers = [c0] + [loader.resolve(doc) for _ in range(3)]
+
+    for _ in range(12):
+        for c in containers:
+            for _ in range(rng.randint(0, 3)):
+                s = chan(c)
+                n = len(s.get_text())
+                r = rng.random()
+                if r < 0.5 or n == 0:
+                    s.insert_text(rng.randint(0, n), rng.choice("abcdef") * rng.randint(1, 3))
+                elif r < 0.8:
+                    start = rng.randint(0, n - 1)
+                    s.remove_range(start, rng.randint(start + 1, min(n, start + 5)))
+                else:
+                    start = rng.randint(0, n - 1)
+                    s.annotate_range(start, rng.randint(start + 1, n), {"b": rng.randint(0, 3)})
+            if rng.random() < 0.25:
+                c.disconnect()  # voluntary drop with pending ops
+            elif rng.random() < 0.15 and c.connected:
+                # transport-initiated kill of just this container
+                c.runtime.connection.inject_disconnect()
+        for c in containers:
+            if not c.connected and not c.closed:
+                c.connect()
+            c.flush()
+
+    texts = [chan(c).get_text() for c in containers]
+    assert len(set(texts)) == 1, f"divergence (seed={seed}): {texts}"
+    spans = [chan(c).annotated_spans() for c in containers]
+    assert all(s == spans[0] for s in spans)
+    for c in containers:
+        assert not c.runtime.is_dirty
