@@ -59,6 +59,13 @@ class _FaultConnection:
             return  # silently lost (network partition)
         self._inner.submit(msg)
 
+    def submit_batch(self, msgs) -> None:
+        if self._driver.submits_fail:
+            raise ConnectionError("injected submit failure")
+        if self._driver.drop_submits:
+            return  # silently lost (network partition)
+        self._inner.submit_batch(msgs)
+
     def disconnect(self) -> None:
         self._inner.disconnect()
 
@@ -95,6 +102,14 @@ class FaultInjectionDriver:
 
     def ops_from(self, doc_id: str, from_seq: int):
         return self.inner.ops_from(doc_id, from_seq)
+
+    def upload_blob(self, doc_id: str, data: bytes) -> str:
+        if self.submits_fail:
+            raise ConnectionError("injected blob upload failure")
+        return self.inner.upload_blob(doc_id, data)
+
+    def read_blob(self, doc_id: str, blob_id: str) -> bytes:
+        return self.inner.read_blob(doc_id, blob_id)
 
     # ------------------------------------------------------ fault controls
 

@@ -28,3 +28,11 @@ class LocalDriver:
 
     def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
         return self.server.ops_from(doc_id, from_seq)
+
+    # Blob surface (reference IDocumentStorageService.createBlob/
+    # readBlob — backed server-side by the content-addressed store).
+    def upload_blob(self, doc_id: str, data: bytes) -> str:
+        return self.server.storage.put(data)
+
+    def read_blob(self, doc_id: str, blob_id: str) -> bytes:
+        return self.server.storage.get(blob_id)

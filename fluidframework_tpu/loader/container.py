@@ -52,6 +52,9 @@ class Container(EventEmitter):
         self.closed = False
         runtime.on("connected", lambda cid: self.emit("connected", cid))
         runtime.on("disconnected", lambda: self.emit("disconnected"))
+        # Bind the blob storage surface up front (re-binds the
+        # registry a summary load may have created driver-less).
+        runtime.attach_blob_manager(driver, lambda: self.doc_id)
 
     # ------------------------------------------------------------- state
 
@@ -89,6 +92,14 @@ class Container(EventEmitter):
     def flush(self) -> None:
         self.runtime.flush()
 
+    def create_blob(self, data: bytes) -> dict:
+        """Upload an attachment blob and get a GC-tracked handle
+        (reference IFluidContainer blob support, blobManager.ts:149)."""
+        return self.runtime.blobs.create_blob(data)
+
+    def get_blob(self, handle) -> bytes:
+        return self.runtime.blobs.get_blob(handle)
+
     def close(self) -> None:
         # Mark closed BEFORE dropping the connection: the disconnect
         # event fires listeners (e.g. ConnectionManager's reconnect
@@ -114,6 +125,10 @@ class Container(EventEmitter):
                 "contents": _encode_stash_content(pm.envelope.contents),
             }
             for pm in list(self.runtime._pending) + list(self.runtime._outbox)
+            # Synthetic chunk pieces (datastore None) are transport
+            # artifacts; the final chunk's entry owns the original op
+            # and re-chunks on the resumed session's flush.
+            if pm.envelope.datastore is not None
         ]
         state = {
             "docId": self.doc_id,

@@ -1,0 +1,437 @@
+"""Pallas TPU kernel for batched merge-tree op application.
+
+Semantics are identical to `ops.mergetree_kernel._apply_one` (the
+XLA-scan form of reference mergeTree.ts:1397/:1960/:1895 — see that
+module's docstring for the semantic mapping); what changes is the
+execution shape, twice over:
+
+1. The scan form dispatches ~40 small XLA ops per sequenced op; on
+   real hardware per-op cost is dominated by that dispatch chain
+   (~175µs/op, nearly independent of table size — measured round 2).
+   Here the WHOLE chunk runs inside ONE pallas kernel: the segment
+   table lives in VMEM as (C/128, 128) int32 tiles for the entire
+   batch and a `fori_loop` applies ops back-to-back.
+2. Within the loop, the body is pure VECTOR-domain code: there are
+   ZERO vector→scalar reductions per op (a VPU→SREG crossing costs
+   ~µs in pipeline stalls; a first draft with ~40 reductions/op ran
+   at 126µs/op). Scalar positions ("first row where...") are kept as
+   one-hot masks; suffix shifts use cumulative-mask keeps; the row
+   count is replaced by a `live` 0/1 column; error flags accumulate
+   in a vector tile, OR-reduced once at kernel end.
+
+Layout: every logical int32[C] table column is a (C//128, 128) tile
+array; flattened row-major index == document order. 2D columns
+(rem_clients[C, KR], props[C, KK]) are stored as KR/KK separate tile
+arrays stacked on a leading static axis. Op columns ride in SMEM
+(per-op dynamic scalar reads; the values are only ever used as vector
+splats, which is the cheap crossing direction).
+
+In-kernel primitives (rolls + masked selects, the VPU idiom):
+- `_cumsum_excl`: exclusive prefix sum over flattened order via
+  log-doubling along lanes then sublanes (the PartialSequenceLengths
+  role, partialLengths.ts:256).
+- `_allreduce_sum`: unmasked doubling — every element ends up holding
+  the grand total (an "any/total" broadcast without leaving the VPU).
+- `_roll1_flat`: flattened-order roll by one row.
+
+The public wrapper `apply_chunk` matches `apply_op_batch`'s contract
+(same SegmentTable/OpBatch pytrees) so the differential oracle gate
+(tests/test_kernel_vs_oracle.py) runs against both kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..protocol.constants import INT32_MAX
+from .mergetree_kernel import (
+    ERR_BAD_POS,
+    ERR_CAPACITY,
+    ERR_REMOVERS,
+    NO_CLIENT,
+    NO_KEY,
+    NOT_REMOVED,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_REMOVE,
+    OpBatch,
+    PROP_ABSENT,
+    PROP_DELETE,
+    SegmentTable,
+)
+
+LANES = 128
+
+
+def _lane_idx(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+
+
+def _row_idx(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+
+
+def _flat_idx(shape):
+    return _row_idx(shape) * LANES + _lane_idx(shape)
+
+
+def _cumsum_excl(v):
+    """Exclusive prefix sum over flattened (row-major) order."""
+    shape = v.shape
+    lane = _lane_idx(shape)
+    row = _row_idx(shape)
+    s = 1
+    acc = v
+    while s < LANES:  # inclusive along lanes (wrap masked off)
+        acc = acc + jnp.where(lane >= s, pltpu.roll(acc, s, 1), 0)
+        s *= 2
+    totals = jnp.broadcast_to(acc[:, LANES - 1 :], shape)
+    s = 1
+    rt = totals
+    while s < shape[0]:  # inclusive row-total cascade
+        rt = rt + jnp.where(row >= s, pltpu.roll(rt, s, 0), 0)
+        s *= 2
+    row_excl = jnp.where(row > 0, pltpu.roll(rt, 1, 0), 0)
+    return acc - v + row_excl
+
+
+def _allreduce_sum(v):
+    """Every element := sum of all elements (stays in vector domain)."""
+    s = 1
+    acc = v
+    while s < LANES:
+        acc = acc + pltpu.roll(acc, s, 1)
+        s *= 2
+    s = 1
+    while s < v.shape[0]:
+        acc = acc + pltpu.roll(acc, s, 0)
+        s *= 2
+    return acc
+
+
+def _roll1_flat(v):
+    """w[i] = v[i-1] in flattened order (w[0] = v[C-1], masked off by
+    callers)."""
+    w = pltpu.roll(v, 1, 1)
+    carry = pltpu.roll(w, 1, 0)
+    return jnp.where(_lane_idx(v.shape) == 0, carry, w)
+
+
+def _mergetree_chunk_kernel(
+    parts,  # static: which body sections run (profiling/bisection)
+    # scalars / op columns (SMEM)
+    nrows_in_ref, err_in_ref, nops_ref,
+    op_type_ref, pos1_ref, pos2_ref, seq_ref, client_ref,
+    buf_ref, ilen_ref, pkey_ref, pval_ref, ref_seq_ref,
+    # table columns in (VMEM)
+    t_buf_in, t_len_in, t_iseq_in, t_iclient_in, t_rseq_in,
+    t_rcl_in, t_props_in,
+    # table columns out (VMEM) + scalars out (SMEM)
+    t_buf, t_len, t_iseq, t_iclient, t_rseq, t_rcl, t_props,
+    nrows_out_ref, err_out_ref,
+    # scratch (VMEM)
+    t_live, t_err,
+):
+    KR = t_rcl_in.shape[0]
+    KK = t_props_in.shape[0]
+    B = pos1_ref.shape[0]
+    PK = pkey_ref.shape[0] // B
+    shape = t_len_in.shape
+    capacity = shape[0] * LANES
+    flat = _flat_idx(shape)
+    last = flat == (capacity - 1)
+
+    t_buf[...] = t_buf_in[...]
+    t_len[...] = t_len_in[...]
+    t_iseq[...] = t_iseq_in[...]
+    t_iclient[...] = t_iclient_in[...]
+    t_rseq[...] = t_rseq_in[...]
+    t_rcl[...] = t_rcl_in[...]
+    t_props[...] = t_props_in[...]
+    t_live[...] = jnp.where(flat < nrows_in_ref[0], 1, 0)
+    t_err[...] = jnp.where(flat == 0, err_in_ref[0], 0)
+
+    def visibility(ref_seq, client):
+        """(skip, vis_len) at a perspective — mergeTree.ts:916
+        nodeLength (same predicate as mergetree_kernel._visibility)."""
+        live = t_live[...] > 0
+        rseq = t_rseq[...]
+        removed = rseq != NOT_REMOVED
+        tomb = removed & (rseq <= ref_seq)
+        ins_vis = (t_iclient[...] == client) | (t_iseq[...] <= ref_seq)
+        among = t_rcl[0] == client
+        for k in range(1, KR):
+            among = among | (t_rcl[k] == client)
+        skip = (~live) | tomb | (removed & ~ins_vis)
+        visible = (~skip) & ins_vis & ~(removed & among)
+        vis_len = jnp.where(visible, t_len[...], 0)
+        return skip, vis_len
+
+    def shift_cols(keep):
+        """Suffix shift: col[i] = col[i] if keep[i] else col[i-1]
+        (vectorized memmove opening one row at the first ~keep).
+        Flags ERR_CAPACITY if a live last row falls off the end."""
+        t_err[...] = t_err[...] | jnp.where(
+            last & (t_live[...] > 0) & ~keep, ERR_CAPACITY, 0
+        )
+        for ref in (t_buf, t_len, t_iseq, t_iclient, t_rseq, t_live):
+            v = ref[...]
+            ref[...] = jnp.where(keep, v, _roll1_flat(v))
+        for k in range(KR):
+            v = t_rcl[k]
+            t_rcl[k] = jnp.where(keep, v, _roll1_flat(v))
+        for k in range(KK):
+            v = t_props[k]
+            t_props[k] = jnp.where(keep, v, _roll1_flat(v))
+
+    def split_at(pos, enable, orefseq, oclient):
+        """Masked boundary split (ensureIntervalBoundary,
+        mergeTree.ts:1706), vector-only: `inside` is a one-hot mask of
+        the row strictly containing visible position `pos`; the tail
+        inherits every field through the shift itself, then gets its
+        span offset fixed up."""
+        skip, vis_len = visibility(orefseq, oclient)
+        prefix = _cumsum_excl(vis_len)
+        inside = (
+            (~skip) & (prefix < pos) & (prefix + vis_len > pos) & enable
+        ).astype(jnp.int32)
+        after = _cumsum_excl(inside)  # 1 for i > j_split
+        keep = after == 0
+        shift_cols(keep)
+        # Tail row position: first ~keep (one-hot; empty if no split).
+        at = (~keep) & (_roll1_flat(keep.astype(jnp.int32)) > 0)
+        at = at & (flat > 0)  # keep[0] is always True; guard the wrap
+        off = pos - _roll1_flat(prefix)  # at tail pos: pos - prefix[j]
+        t_buf[...] = jnp.where(at, t_buf[...] + off, t_buf[...])
+        t_len[...] = jnp.where(at, t_len[...] - off, t_len[...])
+        # Head truncation (head row index is unchanged by the shift).
+        t_len[...] = jnp.where(inside > 0, pos - prefix, t_len[...])
+
+    def body(i, _):
+        otype = op_type_ref[i]
+        pos1 = pos1_ref[i]
+        pos2 = pos2_ref[i]
+        oseq = seq_ref[i]
+        orefseq = ref_seq_ref[i]
+        oclient = client_ref[i]
+        obuf = buf_ref[i]
+        oilen = ilen_ref[i]
+
+        is_ins = otype == OP_INSERT
+        is_rem = otype == OP_REMOVE
+        is_ann = otype == OP_ANNOTATE
+        is_range = is_rem | is_ann
+
+        if 'splits' in parts:
+            split_at(pos1, is_ins | is_range, orefseq, oclient)
+            split_at(pos2, is_range, orefseq, oclient)
+
+        # ---- insert landing + shift + write (insertingWalk + breakTie,
+        # mergeTree.ts:1740,:1719). Landing = first row at/after pos1
+        # that is visible content or loses the tie-break; the first
+        # non-live row is the virtual end boundary.
+        if 'insert' not in parts:
+            return 0
+        skip, vis_len = visibility(orefseq, oclient)
+        prefix = _cumsum_excl(vis_len)
+        total = _allreduce_sum(vis_len)
+        live_pre = t_live[...] > 0
+        land = (
+            (~skip) & (prefix >= pos1)
+            & ((vis_len > 0) | (oseq > t_iseq[...]))
+        ) | ~live_pre
+        land = land & is_ins
+        landi = land.astype(jnp.int32)
+        ft = land & (_cumsum_excl(landi) == 0)  # one-hot landing row
+        keep = (_cumsum_excl(landi) + landi) == 0  # i < landing index
+        shift_cols(keep)
+        # pos beyond visible length and no real landing row: flagged
+        # exactly like the scan kernel (ERR_BAD_POS).
+        t_err[...] = t_err[...] | jnp.where(
+            ft & ~live_pre & (total < pos1), ERR_BAD_POS, 0
+        )
+        t_buf[...] = jnp.where(ft, obuf, t_buf[...])
+        t_len[...] = jnp.where(ft, oilen, t_len[...])
+        t_iseq[...] = jnp.where(ft, oseq, t_iseq[...])
+        t_iclient[...] = jnp.where(ft, oclient, t_iclient[...])
+        t_rseq[...] = jnp.where(ft, NOT_REMOVED, t_rseq[...])
+        t_live[...] = jnp.where(ft, 1, t_live[...])
+        for k in range(KR):
+            t_rcl[k] = jnp.where(ft, NO_CLIENT, t_rcl[k])
+        for k in range(KK):
+            newv = jnp.int32(PROP_ABSENT)
+            for p in range(PK):
+                pkey = pkey_ref[p * B + i]
+                pval = pval_ref[p * B + i]
+                v = jnp.where(pval == PROP_DELETE, PROP_ABSENT, pval)
+                newv = jnp.where(pkey == k, v, newv)
+            t_props[k] = jnp.where(ft, newv, t_props[k])
+
+        if 'covered' not in parts:
+            return 0
+        # ---- covered-range updates (markRangeRemoved mergeTree.ts:1960
+        # / annotateRange :1895), visibility recomputed post-shift.
+        skip, vis_len = visibility(orefseq, oclient)
+        prefix = _cumsum_excl(vis_len)
+        covered = (
+            (~skip) & (vis_len > 0) & (prefix >= pos1)
+            & (prefix + vis_len <= pos2)
+        )
+        t_err[...] = t_err[...] | jnp.where(
+            is_range & (_allreduce_sum(vis_len) < pos2), ERR_BAD_POS, 0
+        )
+
+        # Remove: earliest sequenced rem_seq wins; removing client
+        # appended at the first free slot.
+        upd_rem = covered & is_rem
+        already = t_rseq[...] != NOT_REMOVED
+        t_rseq[...] = jnp.where(upd_rem & ~already, oseq, t_rseq[...])
+        first_free = jnp.full(shape, KR, jnp.int32)
+        for k in range(KR - 1, -1, -1):
+            first_free = jnp.where(t_rcl[k] == NO_CLIENT, k, first_free)
+        no_free = first_free == KR
+        slot = jnp.where(already, first_free, 0)
+        write = upd_rem & ~(already & no_free)
+        for k in range(KR):
+            t_rcl[k] = jnp.where(write & (slot == k), oclient, t_rcl[k])
+        t_err[...] = t_err[...] | jnp.where(
+            upd_rem & already & no_free, ERR_REMOVERS, 0
+        )
+
+        # Annotate: last writer wins, PROP_DELETE clears.
+        upd_ann = covered & is_ann
+        for p in range(PK):
+            pkey = pkey_ref[p * B + i]
+            pval = pval_ref[p * B + i]
+            valid = pkey != NO_KEY
+            newv = jnp.where(pval == PROP_DELETE, PROP_ABSENT, pval)
+            for k in range(KK):
+                t_props[k] = jnp.where(
+                    upd_ann & valid & (pkey == k), newv, t_props[k]
+                )
+        return 0
+
+    jax.lax.fori_loop(0, nops_ref[0], body, 0)
+
+    # Single vector→scalar crossing per kernel: n_rows and the OR of
+    # the error tile (per-bit max == bitwise OR for flag words).
+    nrows_out_ref[0] = jnp.sum(t_live[...])
+    err = t_err[...]
+    s = 1
+    while s < LANES:
+        err = err | pltpu.roll(err, s, 1)
+        s *= 2
+    s = 1
+    while s < err.shape[0]:
+        err = err | pltpu.roll(err, s, 0)
+        s *= 2
+    err_out_ref[0] = jnp.max(err)
+
+
+def _to_tiles(v):
+    """int32[C] -> int32[C//128, 128] (row-major == doc order)."""
+    return v.reshape(-1, LANES)
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4), donate_argnums=(0,))
+def apply_chunk_at(table: SegmentTable, stream_ops: OpBatch, lo,
+                   chunk: int, interpret: bool = False) -> SegmentTable:
+    """Apply ops [lo, lo+chunk) of a device-resident op stream.
+
+    The whole (NOOP-padded) stream is uploaded to the device ONCE;
+    each chunk is a dynamic slice taken on device, so the steady-state
+    replay loop performs zero host→device transfers (each transfer
+    pays a full round trip on a tunneled TPU — uploading per chunk
+    measured ~100x slower than the kernel itself)."""
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, lo, chunk, axis=0)
+    batch = OpBatch(
+        op_type=sl(stream_ops.op_type), pos1=sl(stream_ops.pos1),
+        pos2=sl(stream_ops.pos2), seq=sl(stream_ops.seq),
+        ref_seq=sl(stream_ops.ref_seq), client=sl(stream_ops.client),
+        buf_start=sl(stream_ops.buf_start), ins_len=sl(stream_ops.ins_len),
+        prop_keys=sl(stream_ops.prop_keys), prop_vals=sl(stream_ops.prop_vals),
+    )
+    return apply_chunk(table, batch, interpret)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def apply_chunk(table: SegmentTable, ops: OpBatch, interpret: bool = False,
+                parts: tuple = ('splits', 'insert', 'covered')
+                ) -> SegmentTable:
+    """Apply a chunk of sequenced ops (ascending seq order) in ONE
+    pallas kernel invocation. Drop-in equivalent of
+    `mergetree_kernel.apply_op_batch` (bit-identical results; gated by
+    the same differential tests)."""
+    capacity = table.length.shape[0]
+    KR = table.rem_clients.shape[1]
+    KK = table.props.shape[1]
+    B = ops.pos1.shape[0]
+    PK = ops.prop_keys.shape[1]
+    assert capacity % (8 * LANES) == 0, "capacity must be a multiple of 1024"
+
+    n_ops = jnp.asarray([B], jnp.int32)
+
+    tile_in = [
+        _to_tiles(table.buf_start), _to_tiles(table.length),
+        _to_tiles(table.ins_seq), _to_tiles(table.ins_client),
+        _to_tiles(table.rem_seq),
+        # [C, K] -> [K, C//128, 128]
+        jnp.moveaxis(table.rem_clients, 1, 0).reshape(KR, -1, LANES),
+        jnp.moveaxis(table.props, 1, 0).reshape(KK, -1, LANES),
+    ]
+    # Op columns ride in SMEM as flat [B] arrays: per-op dynamic
+    # scalar reads, used only as vector splats.
+    op_in = [
+        ops.op_type, ops.pos1, ops.pos2, ops.seq, ops.client,
+        ops.buf_start, ops.ins_len,
+        # [B, PK] -> [PK * B] (key p of op i at p * B + i)
+        jnp.moveaxis(ops.prop_keys, 1, 0).reshape(PK * B),
+        jnp.moveaxis(ops.prop_vals, 1, 0).reshape(PK * B),
+        ops.ref_seq,
+    ]
+
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    C8 = capacity // LANES
+    out_shapes = (
+        jax.ShapeDtypeStruct((C8, LANES), jnp.int32),  # buf
+        jax.ShapeDtypeStruct((C8, LANES), jnp.int32),  # len
+        jax.ShapeDtypeStruct((C8, LANES), jnp.int32),  # ins_seq
+        jax.ShapeDtypeStruct((C8, LANES), jnp.int32),  # ins_client
+        jax.ShapeDtypeStruct((C8, LANES), jnp.int32),  # rem_seq
+        jax.ShapeDtypeStruct((KR, C8, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((KK, C8, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # n_rows
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # error
+    )
+    outs = pl.pallas_call(
+        functools.partial(_mergetree_chunk_kernel, parts),
+        out_shape=out_shapes,
+        in_specs=[smem()] * 13 + [vmem()] * 7,
+        out_specs=tuple([vmem()] * 7 + [smem(), smem()]),
+        scratch_shapes=[
+            pltpu.VMEM((C8, LANES), jnp.int32),  # live column
+            pltpu.VMEM((C8, LANES), jnp.int32),  # error accumulator
+        ],
+        interpret=interpret,
+    )(
+        jnp.reshape(table.n_rows, (1,)), jnp.reshape(table.error, (1,)),
+        n_ops, *op_in, *tile_in,
+    )
+    (buf, length, iseq, iclient, rseq, rcl, props, nrows, err) = outs
+    return SegmentTable(
+        n_rows=nrows[0],
+        buf_start=buf.reshape(-1),
+        length=length.reshape(-1),
+        ins_seq=iseq.reshape(-1),
+        ins_client=iclient.reshape(-1),
+        rem_seq=rseq.reshape(-1),
+        rem_clients=jnp.moveaxis(rcl.reshape(KR, -1), 0, 1),
+        props=jnp.moveaxis(props.reshape(KK, -1), 0, 1),
+        error=err[0],
+    )

@@ -1,0 +1,265 @@
+"""Device-side zamboni: segment-table compaction as one XLA dispatch.
+
+The reference's zamboni (packages/dds/merge-tree/src/zamboni.ts:19)
+collects segments whose removal has passed below the minimum sequence
+number and merges adjacent settled segments. Round 1 did this host-side
+(core/columnar_replay.py compact()), costing a device→host→device
+round trip per compaction — ~500 round trips over the 1M-op replay.
+
+This version never leaves the device and never touches text:
+
+1. tombstone drop — rows removed at/below the MSN can never be seen
+   by any future perspective; a mask + prefix-sum + gather packs the
+   survivors (stable, preserving document order);
+2. adjacency coalescing — consecutive *settled* rows (insert seq ≤
+   MSN, not removed) with identical props whose text spans are
+   CONTIGUOUS IN THE ARENA (prev.buf_start + prev.length ==
+   next.buf_start) merge into one row. Contiguity replaces the host
+   version's text re-gather: split pieces are contiguous by
+   construction, and consecutive same-client inserts usually are, so
+   most of the coalescing survives without moving a single byte.
+
+Everything is masks, cumsums, and two gathers over [C] arrays —
+standard XLA, so it runs on any backend (tests exercise it on CPU)
+and costs ~one kernel dispatch on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..protocol.constants import NO_CLIENT
+from .mergetree_kernel import (
+    NOT_REMOVED,
+    PROP_ABSENT,
+    SegmentTable,
+)
+
+STREAM_BASE = 1 << 28  # stream-arena offsets start here (columnar_replay)
+
+
+@jax.jit
+def zamboni_device(table: SegmentTable, min_seq: jnp.ndarray) -> SegmentTable:
+    """Compact `table` under applied MSN `min_seq` (int32 scalar).
+
+    Returns a table with identical visible semantics for every
+    perspective with ref_seq >= min_seq (the only ones that can still
+    occur): dropped rows were invisible to all of them; coalesced rows
+    were identically visible to all of them.
+    """
+    C = table.length.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    live = idx < table.n_rows
+    removed = table.rem_seq != NOT_REMOVED
+
+    # ---- 1. tombstone drop (stable pack of survivors)
+    keep = live & ~(removed & (table.rem_seq <= min_seq))
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - keep  # dest of each kept row
+    n_keep = jnp.sum(keep.astype(jnp.int32))
+    # src[d] = source row of destination d (scatter the inverse map).
+    src = jnp.full(C, C - 1, jnp.int32).at[
+        jnp.where(keep, pos, C)
+    ].set(idx, mode="drop")
+    packed_valid = idx < n_keep
+
+    def pack(a, fill):
+        g = a[src]
+        if a.ndim == 1:
+            return jnp.where(packed_valid, g, fill)
+        return jnp.where(packed_valid[:, None], g, fill)
+
+    buf = pack(table.buf_start, 0)
+    length = pack(table.length, 0)
+    iseq = pack(table.ins_seq, 0)
+    iclient = pack(table.ins_client, NO_CLIENT)
+    rseq = pack(table.rem_seq, NOT_REMOVED)
+    rcl = pack(table.rem_clients, NO_CLIENT)
+    props = pack(table.props, PROP_ABSENT)
+
+    # ---- 2. adjacency coalescing of settled runs
+    settled = packed_valid & (rseq == NOT_REMOVED) & (iseq <= min_seq)
+    prev_settled = jnp.concatenate([jnp.zeros(1, bool), settled[:-1]])
+    prev_end = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), (buf + length)[:-1]]
+    )
+    same_props = jnp.concatenate(
+        [jnp.zeros(1, bool), jnp.all(props[1:] == props[:-1], axis=1)]
+    )
+    merge_into_prev = (
+        settled & prev_settled & same_props & (prev_end == buf)
+    )
+    start = packed_valid & ~merge_into_prev
+    run_id = jnp.cumsum(start.astype(jnp.int32)) - 1  # 0-based run index
+    m = jnp.sum(start.astype(jnp.int32))
+    run_len = jax.ops.segment_sum(
+        jnp.where(packed_valid, length, 0), run_id, num_segments=C
+    ).astype(jnp.int32)
+    # Gather each run's first row to its final position.
+    src2 = jnp.full(C, C - 1, jnp.int32).at[
+        jnp.where(start, run_id, C)
+    ].set(idx, mode="drop")
+    final_valid = idx < m
+
+    def take(a, fill):
+        g = a[src2]
+        if a.ndim == 1:
+            return jnp.where(final_valid, g, fill)
+        return jnp.where(final_valid[:, None], g, fill)
+
+    return SegmentTable(
+        n_rows=m,
+        buf_start=take(buf, 0),
+        length=jnp.where(final_valid, run_len, 0),
+        ins_seq=take(iseq, 0),
+        ins_client=take(iclient, NO_CLIENT),
+        rem_seq=take(rseq, NOT_REMOVED),
+        rem_clients=take(rcl, NO_CLIENT),
+        props=take(props, PROP_ABSENT),
+        error=table.error,
+    )
+
+
+def _pack_sort(key, cols):
+    """Stable-sort `cols` (tuple of int32[C] arrays) by int32 `key`."""
+    out = jax.lax.sort((key,) + tuple(cols), num_keys=1, is_stable=True)
+    return out[1:]
+
+
+@jax.jit
+def compact_gather_text(
+    table: SegmentTable,
+    min_seq: jnp.ndarray,
+    doc_arena: jnp.ndarray,
+    stream_text: jnp.ndarray,
+):
+    """Full compaction WITH device-side text re-gather, gather-free.
+
+    Interleaved multi-client editing leaves doc-order neighbors far
+    apart in the arenas, so pure adjacency coalescing stalls and the
+    row count grows with the document. The round-1 fix was a host
+    compaction that re-gathers all live text contiguously; this is
+    that compaction as ONE device dispatch — built ONLY from sorts,
+    scatters, and cumsums, because on TPU an XLA gather of N elements
+    lowers to an elementwise loop (~100ns/element measured: a 1M-
+    element gather costs ~100ms, while payload sorts and scatters of
+    the same size are ~fast vector ops):
+
+    1. tombstone drop: stable payload-sort by a kept-first key packs
+       surviving rows to the front (no inverse-permutation gather);
+    2. text move: each surviving span [buf, buf+len) must land at its
+       new contiguous offset. dest(e) = e + delta with delta piecewise
+       constant per span, so scatter +/-delta EVENTS at span
+       boundaries, cumsum them into a per-element delta over the
+       source arena, and SCATTER source elements to their
+       destinations (out-of-span elements get a poison delta and drop)
+       — the classic event-sweep trick, one pass per source region
+       (doc arena / stream text);
+    3. coalescing: every settled neighbor pair with equal props now
+       merges (text is contiguous by construction). Run lengths come
+       from prefix-sum differences at run starts (no segment_sum,
+       which scatter-adds per element); a second payload-sort packs
+       run starts to the front.
+
+    `doc_arena` addresses codepoints in [0, STREAM_BASE);
+    `stream_text` holds immutable op-inserted text addressed from
+    STREAM_BASE (core/columnar_replay.py's dual-region scheme).
+    Callers size `doc_arena` at initial_len + len(stream_text), which
+    no live document can exceed.
+
+    Returns ``(table, new_doc_arena)``.
+    """
+    C = table.length.shape[0]
+    A = doc_arena.shape[0]
+    S = stream_text.shape[0]
+    KR = table.rem_clients.shape[1]
+    KK = table.props.shape[1]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    live = idx < table.n_rows
+    removed = table.rem_seq != NOT_REMOVED
+
+    # ---- 1. tombstone drop: stable kept-first payload sort
+    keep = live & ~(removed & (table.rem_seq <= min_seq))
+    n_keep = jnp.sum(keep.astype(jnp.int32))
+    key = jnp.where(keep, 0, 1).astype(jnp.int32)
+    cols = (
+        table.buf_start, table.length, table.ins_seq, table.ins_client,
+        table.rem_seq,
+        *(table.rem_clients[:, k] for k in range(KR)),
+        *(table.props[:, k] for k in range(KK)),
+    )
+    packed = _pack_sort(key, cols)
+    buf, length, iseq, iclient, rseq = packed[:5]
+    rcl = packed[5:5 + KR]
+    props = packed[5 + KR:]
+    valid = idx < n_keep
+    length = jnp.where(valid, length, 0)
+
+    # ---- 2. text move (event sweep + element scatter, no gathers)
+    new_off = jnp.cumsum(length) - length
+    total = jnp.sum(length)
+
+    def sweep_region(region_len, base, arena_vals, out):
+        """Scatter this region's surviving spans into `out` at their
+        destinations. `base` rebases buf into region coordinates."""
+        DEAD = jnp.int32(A + region_len + 2)
+        in_region = valid & (buf >= base) & (buf < base + region_len)
+        rbuf = buf - base
+        delta = new_off - rbuf
+        ev_at = jnp.where(in_region, rbuf, region_len + 1)
+        ev = jnp.zeros(region_len + 2, jnp.int32).at[ev_at].add(
+            delta - DEAD, mode="drop"
+        )
+        ev_end = jnp.where(in_region, rbuf + length, region_len + 1)
+        ev = ev.at[ev_end].add(DEAD - delta, mode="drop")
+        delta_per_elem = DEAD + jnp.cumsum(ev)[:region_len]
+        e = jnp.arange(region_len, dtype=jnp.int32)
+        dest = e + delta_per_elem  # >= A for out-of-span elements
+        return out.at[dest].set(arena_vals, mode="drop")
+
+    new_arena = jnp.zeros(A, jnp.int32)
+    new_arena = sweep_region(A, 0, doc_arena, new_arena)
+    new_arena = sweep_region(S, STREAM_BASE, stream_text, new_arena)
+    buf = new_off  # every surviving span now lives contiguously
+
+    # ---- 3. maximal coalescing (arena adjacency holds by construction)
+    settled = valid & (rseq == NOT_REMOVED) & (iseq <= min_seq)
+    prev_settled = jnp.concatenate([jnp.zeros(1, bool), settled[:-1]])
+    props_m = jnp.stack(props, axis=1)
+    same_props = jnp.concatenate(
+        [jnp.zeros(1, bool), jnp.all(props_m[1:] == props_m[:-1], axis=1)]
+    )
+    start = valid & ~(settled & prev_settled & same_props)
+    m = jnp.sum(start.astype(jnp.int32))
+    key2 = jnp.where(start, 0, 1).astype(jnp.int32)
+    packed2 = _pack_sort(
+        key2,
+        (buf, iseq, iclient, rseq, *rcl, *props, new_off),
+    )
+    fbuf, fiseq, ficlient, frseq = packed2[:4]
+    frcl = packed2[4:4 + KR]
+    fprops = packed2[4 + KR:4 + KR + KK]
+    f_off = packed2[-1]
+    final_valid = idx < m
+    # Run length = next run's text offset - this run's (runs are
+    # contiguous in the new arena).
+    next_off = jnp.concatenate([f_off[1:], jnp.zeros(1, jnp.int32)])
+    next_off = jnp.where(idx == m - 1, total, next_off)
+    run_len = jnp.where(final_valid, next_off - f_off, 0)
+
+    out = SegmentTable(
+        n_rows=m,
+        buf_start=jnp.where(final_valid, fbuf, 0),
+        length=run_len,
+        ins_seq=jnp.where(final_valid, fiseq, 0),
+        ins_client=jnp.where(final_valid, ficlient, NO_CLIENT),
+        rem_seq=jnp.where(final_valid, frseq, NOT_REMOVED),
+        rem_clients=jnp.where(
+            final_valid[:, None], jnp.stack(frcl, axis=1), NO_CLIENT
+        ),
+        props=jnp.where(
+            final_valid[:, None], jnp.stack(fprops, axis=1), PROP_ABSENT
+        ),
+        error=table.error,
+    )
+    return out, new_arena

@@ -1,0 +1,84 @@
+"""Attachment blobs: out-of-band binary payloads with GC-tracked
+handles (node namespace /_blobs/<id>, the reference blobManagerBasePath).
+
+Reference `BlobManager`
+(packages/runtime/container-runtime/src/blobManager.ts:149): large
+binary content never rides the op stream — the client uploads the
+blob to storage, gets a storage id, announces it with a BlobAttach op
+(so every replica learns the id and the summarizer records it), and
+hands out a handle (`/blobs/<id>`) that DDS values can embed. GC
+treats blob nodes like any other node: unreferenced blobs age and are
+swept (gc integration via GarbageCollector.build_graph).
+
+The storage side is the driver's blob surface (`upload_blob` /
+`read_blob` — LocalServer backs it with the content-addressed store,
+server/castore.py, the gitrest role).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .gc import make_handle
+
+BLOB_ATTACH = "blobAttach"
+
+
+class BlobManager:
+    def __init__(self, runtime, driver, doc_id_fn):
+        self.runtime = runtime
+        self.driver = driver
+        self._doc_id_fn = doc_id_fn  # container's doc id (set at attach)
+        # storage id -> True once its BlobAttach op processed (or
+        # locally created and pending).
+        self.attached: Dict[str, bool] = {}
+
+    # ------------------------------------------------------------ create
+
+    def create_blob(self, data: bytes) -> dict:
+        """Upload + announce + return a handle (createBlob,
+        blobManager.ts:149). The upload happens out-of-band (storage
+        round trip); only the tiny id ever enters the op stream."""
+        doc_id = self._doc_id_fn()
+        if doc_id is None:
+            raise RuntimeError("attach the container before creating blobs")
+        storage_id = self.driver.upload_blob(doc_id, data)
+        self.attached[storage_id] = True
+        self.runtime._submit_op(
+            _blob_envelope({"type": BLOB_ATTACH, "id": storage_id}), None
+        )
+        return make_handle(f"/_blobs/{storage_id}")
+
+    # ------------------------------------------------------------- fetch
+
+    def get_blob(self, handle_or_id) -> bytes:
+        sid = handle_or_id
+        if isinstance(handle_or_id, dict):
+            sid = handle_or_id["url"].rsplit("/", 1)[-1]
+        elif isinstance(sid, str) and sid.startswith("/blobs/"):
+            sid = sid.rsplit("/", 1)[-1]
+        return self.driver.read_blob(self._doc_id_fn(), sid)
+
+    # ----------------------------------------------------------- inbound
+
+    def process_attach(self, contents: dict) -> None:
+        self.attached[contents["id"]] = True
+
+    def delete(self, storage_id: str) -> None:
+        """GC sweep callback: forget the blob (storage-level deletion
+        is the service's business, as in the reference)."""
+        self.attached.pop(storage_id, None)
+
+    # ----------------------------------------------------------- summary
+
+    def state(self) -> dict:
+        return {"ids": sorted(self.attached)}
+
+    def load_state(self, data: dict) -> None:
+        self.attached = {i: True for i in data.get("ids", [])}
+
+
+def _blob_envelope(contents: dict):
+    from .container_runtime import Envelope
+
+    return Envelope(".blobs", None, contents)

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..protocol.messages import DocumentMessage, MessageType, NackMessage, SequencedMessage
 from ..protocol.quorum import ProtocolOpHandler
 from ..utils.events import EventEmitter
+from . import op_lifecycle
 from .channel import ChannelRegistry
 from .datastore import DataStoreRuntime
 from .summary import SummaryTree, SummaryTreeBuilder
@@ -81,10 +82,21 @@ class ContainerRuntime(EventEmitter):
         self,
         registry: ChannelRegistry,
         flush_mode: FlushMode = FlushMode.TURN_BASED,
+        compression_threshold: Optional[int] = 16 * 1024,
+        max_op_bytes: int = 700 * 1024,
     ):
         super().__init__()
         self.registry = registry
         self.flush_mode = flush_mode
+        # Op lifecycle knobs (IContainerRuntimeOptions compression /
+        # chunking): batches over `compression_threshold` wire bytes
+        # compress (opCompressor.ts:20; None disables); any single
+        # message over `max_op_bytes` splits into chunk ops
+        # (opSplitter.ts:22) — kept under the service's 768KB nack cap.
+        self.compression_threshold = compression_threshold
+        self.max_op_bytes = max_op_bytes
+        self._reassembler = op_lifecycle.ChunkReassembler()
+        self._unpacked: List[Any] = []
         self.datastores: Dict[str, DataStoreRuntime] = {}
         self.connection = None
         self.client_id: Optional[int] = None
@@ -102,6 +114,8 @@ class ContainerRuntime(EventEmitter):
         self.protocol = ProtocolOpHandler()
         # GC driver (attach_gc); its state rides the summary.
         self.gc = None
+        # BlobManager (attach_blob_manager); its state rides the summary.
+        self.blobs = None
 
     def attach_gc(self, sweep_grace: int = 0):
         """Enable garbage collection for this container (the reference
@@ -113,6 +127,20 @@ class ContainerRuntime(EventEmitter):
         else:
             self.gc.sweep_grace = sweep_grace
         return self.gc
+
+    def attach_blob_manager(self, driver, doc_id_fn):
+        """Enable attachment blobs (reference blobManager.ts:149;
+        storage rides the driver's blob surface). Re-binding an
+        existing manager (e.g. after summary load created it with no
+        driver) preserves the attached-blob registry."""
+        from .blob_manager import BlobManager
+
+        if self.blobs is None:
+            self.blobs = BlobManager(self, driver, doc_id_fn)
+        else:
+            self.blobs.driver = driver
+            self.blobs._doc_id_fn = doc_id_fn
+        return self.blobs
 
     _emit = EventEmitter.emit
 
@@ -191,6 +219,10 @@ class ContainerRuntime(EventEmitter):
         self._pending.clear()
         self._outbox.clear()
         for pm in replay:
+            if pm.envelope.datastore is None:
+                # Synthetic chunk piece: the final chunk's pending
+                # entry owns the original op and re-chunks on flush.
+                continue
             if pm.envelope.channel is None:
                 self._submit_op(pm.envelope, None)  # attach op: as-is
                 continue
@@ -273,17 +305,67 @@ class ContainerRuntime(EventEmitter):
         if self.connection is None:
             return  # disconnected: outbox drains on reconnect
         batch, self._outbox = self._outbox, []
-        n = len(batch)
-        if n == 0:
+        if not batch:
             return
         conn = self.connection
+
+        def wire_contents(pm: _PendingMessage) -> Any:
+            if pm.envelope.channel is None:  # runtime-level (attach) op
+                inner = pm.envelope.contents
+            else:
+                inner = {
+                    "address": pm.envelope.channel,
+                    "contents": pm.envelope.contents,
+                }
+            return {"address": pm.envelope.datastore, "contents": inner}
+
+        # Serialize each message's wire contents ONCE; the dumped
+        # strings drive sizing, compression, and the chunking test.
+        items = [(pm, wire_contents(pm)) for pm in batch]
+        dumped = [op_lifecycle._dumps(c) for _, c in items]
+        # Compression (opCompressor.ts:20): pack the batch's contents
+        # into the head message when the total wire size crosses the
+        # threshold; the rest become placeholders so each op keeps its
+        # own sequence number.
+        if self.compression_threshold is not None:
+            total = sum(len(d) for d in dumped)
+            if total > self.compression_threshold:
+                packed = op_lifecycle.compress_batch_serialized(dumped)
+                items = [(pm, c) for (pm, _), c in zip(items, packed)]
+                dumped = [op_lifecycle._dumps(c) for _, c in items]
+        # Chunking (opSplitter.ts:22): any single message still over
+        # the op-size cap splits into chunk ops. Chunk pieces are
+        # synthetic pending entries (datastore None); the FINAL chunk
+        # keeps the original pending message so its sequenced echo
+        # routes (and, on reconnect, resubmits) the original op.
+        expanded: List[tuple] = []
+        for (pm, c), d in zip(items, dumped):
+            chunks = op_lifecycle.split_serialized(d, self.max_op_bytes)
+            if chunks is None:
+                expanded.append((pm, c))
+                continue
+            for piece in chunks[:-1]:
+                expanded.append(
+                    (
+                        _PendingMessage(
+                            0,
+                            Envelope(None, None, {"chunkPiece": True}),
+                            None,
+                            ref_seq=pm.ref_seq,
+                        ),
+                        piece,
+                    )
+                )
+            expanded.append((pm, chunks[-1]))
         # Stage the ENTIRE batch as in-flight before submitting any of
         # it: a synchronous nack or transport loss during a submit
         # triggers the reconnect replay, which must see the whole
         # batch in _pending — otherwise the unsent remainder would
         # later go out raw on a new connection, bypassing the DDS
         # resubmit/rebase path and splitting batch atomicity.
-        for i, pm in enumerate(batch):
+        n = len(expanded)
+        wire: List[DocumentMessage] = []
+        for i, (pm, c) in enumerate(expanded):
             meta = None
             if n > 1:
                 if i == 0:
@@ -295,29 +377,28 @@ class ContainerRuntime(EventEmitter):
             pm.client_id = self.client_id
             pm.batch_meta = meta
             self._pending.append(pm)
-        for pm in batch:
+            wire.append(
+                DocumentMessage(
+                    client_seq=pm.client_seq,
+                    ref_seq=pm.ref_seq,
+                    type=MessageType.OP,
+                    contents=c,
+                    metadata=meta,
+                )
+            )
+        # Boxcarring (pendingBoxcar.ts): one ingress record for the
+        # whole batch when the transport supports it.
+        if hasattr(conn, "submit_batch") and len(wire) > 1:
+            conn.submit_batch(wire)
+            return
+        for msg in wire:
             if self.connection is not conn:
                 # Connection died (or was replaced by a reconnect
                 # ladder) mid-batch: stop — every message of this
                 # batch was staged pending, so the reconnect replay
                 # owns them all now.
                 return
-            if pm.envelope.channel is None:  # runtime-level (attach) op
-                inner = pm.envelope.contents
-            else:
-                inner = {
-                    "address": pm.envelope.channel,
-                    "contents": pm.envelope.contents,
-                }
-            conn.submit(
-                DocumentMessage(
-                    client_seq=pm.client_seq,
-                    ref_seq=pm.ref_seq,
-                    type=MessageType.OP,
-                    contents={"address": pm.envelope.datastore, "contents": inner},
-                    metadata=pm.batch_meta,
-                )
-            )
+            conn.submit(msg)
 
     def order_sequentially(self, callback: Callable[[], Any]) -> Any:
         """Run `callback`; if it throws, roll back the ops it produced
@@ -403,9 +484,34 @@ class ContainerRuntime(EventEmitter):
                 "does not match pending head"
             )
         outer = msg.contents
+        # Inbound lifecycle transforms, in RemoteMessageProcessor
+        # order: reassemble chunked ops, then unpack compressed
+        # batches (placeholders consume the unpacked payloads).
+        if op_lifecycle.is_chunk(outer):
+            complete, orig = self._reassembler.feed(msg.client_id, outer)
+            if not complete:
+                self._emit("op", msg, local)
+                return
+            outer = orig
+        if op_lifecycle.is_packed_head(outer):
+            self._unpacked = op_lifecycle.decompress_batch(outer)
+            outer = self._unpacked.pop(0)
+        elif op_lifecycle.is_placeholder(outer):
+            outer = self._unpacked.pop(0)
         inner = outer["contents"]
         if isinstance(inner, dict) and inner.get("type") == "attach":
             self._process_attach(outer["address"], inner, local)
+            self._emit("op", msg, local)
+            return
+        if isinstance(inner, dict) and inner.get("type") == "blobAttach":
+            # Blob announcement (BlobAttach, blobManager.ts): record
+            # the storage id on EVERY replica — the registry must
+            # exist even on replicas that never touch blob APIs, or
+            # their summaries would forget the blobs.
+            if self.blobs is None:
+                self.attach_blob_manager(None, lambda: None)
+            if not local:
+                self.blobs.process_attach(inner)
             self._emit("op", msg, local)
             return
         ds = self.datastores.get(outer["address"])
@@ -506,6 +612,8 @@ class ContainerRuntime(EventEmitter):
         builder.add_json_blob(".protocol", self.protocol.snapshot())
         if self.gc is not None:
             builder.add_json_blob(".gc", self.gc.state())
+        if self.blobs is not None:
+            builder.add_json_blob(".blobs", self.blobs.state())
         return builder.summary
 
     def load(self, summary: SummaryTree) -> None:
@@ -531,6 +639,12 @@ class ContainerRuntime(EventEmitter):
         if ".gc" in summary.entries:
             self.attach_gc()
             self.gc.load_state(_json.loads(summary.get_blob(".gc")))
+        if ".blobs" in summary.entries:
+            # Always realize the registry (a later attach_blob_manager
+            # re-binds the driver); dropping it would forget every
+            # attached blob on boot.
+            self.attach_blob_manager(None, lambda: None)
+            self.blobs.load_state(_json.loads(summary.get_blob(".blobs")))
 
 
 def _reshape(msg: SequencedMessage, inner_contents: Any) -> SequencedMessage:

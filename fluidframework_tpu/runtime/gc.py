@@ -117,6 +117,12 @@ class GarbageCollector:
                 # A reachable channel keeps its datastore alive (a
                 # handle to a child implies the parent is loadable).
                 graph[f"/{did}/{cid}"] = refs + [ds_node]
+        # Attachment blobs are leaf nodes kept alive only by handles
+        # (blobManager.ts GC integration).
+        blobs = getattr(self.runtime, "blobs", None)
+        if blobs is not None:
+            for sid in blobs.attached:
+                graph[f"/_blobs/{sid}"] = []
         return graph, roots
 
     # --------------------------------------------------------------- run
@@ -144,6 +150,16 @@ class GarbageCollector:
             if now - since < self.sweep_grace:
                 continue
             parts = node.strip("/").split("/")
+            blobs = getattr(self.runtime, "blobs", None)
+            if (
+                parts[0] == "_blobs"
+                and blobs is not None
+                and len(parts) == 2
+                and parts[1] in blobs.attached
+            ):
+                blobs.delete(parts[1])
+                deleted.append(node)
+                continue
             if len(parts) == 1:
                 if self.runtime.datastores.pop(parts[0], None) is not None:
                     swept_ds.add(parts[0])

@@ -1,0 +1,139 @@
+"""Outbound op lifecycle: batch compression, chunking, reassembly.
+
+The reference pipeline (packages/runtime/container-runtime/src/
+opLifecycle/): `OpCompressor` (opCompressor.ts:20) compresses a
+batch's contents when it exceeds a size threshold — the first message
+carries the packed payload, the rest become empty placeholders so
+every op keeps its own sequence number; `OpSplitter` (opSplitter.ts:22)
+splits any single wire message above the service's op-size cap into
+chunk ops reassembled runtime-side (`RemoteMessageProcessor` order:
+reassemble chunks → decompress → route). The reference codec is LZ4;
+zlib plays that role here (stdlib; same contract, different codec —
+the codec name rides the wire so another can be added).
+
+Wire forms (inside DocumentMessage.contents):
+- packed batch head: {"packedContents": <b64>, "compression": "zlib"}
+- packed batch placeholder: {"placeholder": true}
+- chunk: {"chunkedOp": <i>, "total": <T>, "data": <b64 piece>}
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import zlib
+from typing import Any, List, Optional, Tuple
+
+COMPRESSION_ALGO = "zlib"
+
+
+def _wire_default(obj: Any) -> Any:
+    """JSON fallback for in-proc payloads: merge-tree op dataclasses
+    serialize to their wire-dict form (protocol.mergetree_ops), which
+    every DDS's process path already accepts — so a decompressed op
+    arriving as a dict routes identically to the in-proc object."""
+    from ..protocol.mergetree_ops import MergeTreeOp, op_to_json
+
+    if isinstance(obj, MergeTreeOp):
+        return op_to_json(obj)
+    return str(obj)
+
+
+def _dumps(value: Any) -> str:
+    return json.dumps(value, default=_wire_default)
+
+
+def wire_size(contents: Any) -> int:
+    try:
+        return len(_dumps(contents))
+    except (TypeError, ValueError):
+        return 0
+
+
+def compress_batch(contents_list: List[Any]) -> List[Any]:
+    """Pack a batch's contents into its head message (opCompressor.ts:20
+    semantics: payload on message 0, placeholders after)."""
+    return compress_batch_serialized([_dumps(c) for c in contents_list])
+
+
+def compress_batch_serialized(dumped: List[str]) -> List[Any]:
+    """As compress_batch, over already-serialized contents (the flush
+    hot path serializes once and reuses the strings for sizing,
+    compression, and the chunking test)."""
+    payload = base64.b64encode(
+        zlib.compress(("[" + ",".join(dumped) + "]").encode())
+    ).decode()
+    packed: List[Any] = [
+        {"packedContents": payload, "compression": COMPRESSION_ALGO}
+    ]
+    packed.extend({"placeholder": True} for _ in dumped[1:])
+    return packed
+
+
+def decompress_batch(head_contents: dict) -> List[Any]:
+    algo = head_contents.get("compression")
+    if algo != COMPRESSION_ALGO:
+        raise ValueError(f"unknown compression {algo!r}")
+    raw = zlib.decompress(base64.b64decode(head_contents["packedContents"]))
+    return json.loads(raw)
+
+
+def is_packed_head(contents: Any) -> bool:
+    return isinstance(contents, dict) and "packedContents" in contents
+
+
+def is_placeholder(contents: Any) -> bool:
+    return isinstance(contents, dict) and contents.get("placeholder") is True
+
+
+def split_contents(contents: Any, max_bytes: int) -> Optional[List[dict]]:
+    """Split one oversized wire contents into chunk ops
+    (opSplitter.ts:22). Returns None if it fits in max_bytes."""
+    return split_serialized(_dumps(contents), max_bytes)
+
+
+def split_serialized(blob: str, max_bytes: int) -> Optional[List[dict]]:
+    if len(blob) <= max_bytes:
+        return None
+    data = base64.b64encode(zlib.compress(blob.encode())).decode()
+    piece = max(1, max_bytes // 2)  # b64 pieces, margin for envelope
+    pieces = [data[i: i + piece] for i in range(0, len(data), piece)]
+    total = len(pieces)
+    return [
+        {"chunkedOp": i, "total": total, "data": p}
+        for i, p in enumerate(pieces)
+    ]
+
+
+def is_chunk(contents: Any) -> bool:
+    return isinstance(contents, dict) and "chunkedOp" in contents
+
+
+class ChunkReassembler:
+    """Per-client chunk accumulation (RemoteMessageProcessor /
+    opSplitter processRemoteMessage): feed chunks in sequence order;
+    the final chunk yields the original contents."""
+
+    def __init__(self):
+        self._buffers = {}
+
+    def feed(self, client_id: int, contents: dict) -> Tuple[bool, Any]:
+        """Returns (complete, original_contents | None)."""
+        buf = self._buffers.setdefault(client_id, [])
+        if contents["chunkedOp"] != len(buf):
+            raise ValueError(
+                f"chunk {contents['chunkedOp']} out of order "
+                f"(have {len(buf)}) from client {client_id}"
+            )
+        buf.append(contents["data"])
+        if len(buf) < contents["total"]:
+            return False, None
+        del self._buffers[client_id]
+        blob = zlib.decompress(base64.b64decode("".join(buf)))
+        return True, json.loads(blob)
+
+    def reset(self, client_id: Optional[int] = None) -> None:
+        if client_id is None:
+            self._buffers.clear()
+        else:
+            self._buffers.pop(client_id, None)

@@ -101,15 +101,29 @@ class DeliLambda:
                 contents=raw["contents"],
             )
             self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+        elif kind == "boxcar":
+            # Boxcarred submission (services-core pendingBoxcar.ts):
+            # one log record carrying several client ops, ticketed
+            # back-to-back so the batch sequences atomically. A nack
+            # aborts the REST of the boxcar — sequencing a partial
+            # "atomic" batch would both break batch atomicity for
+            # receivers and desync the sender's pending FIFO.
+            for msg in raw["msgs"]:
+                if not self._ticket(raw["doc"], doc, raw["client"], msg):
+                    break
         else:  # client op
-            out = doc.sequence(raw["client"], raw["msg"])
-            if isinstance(out, NackMessage):
-                self.deltas.append(
-                    {"doc": raw["doc"], "kind": "nack", "client": raw["client"],
-                     "msg": out}
-                )
-            else:
-                self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": out})
+            self._ticket(raw["doc"], doc, raw["client"], raw["msg"])
+
+    def _ticket(self, doc_id: str, doc: DocumentSequencer, client: int,
+                msg: DocumentMessage) -> bool:
+        out = doc.sequence(client, msg)
+        if isinstance(out, NackMessage):
+            self.deltas.append(
+                {"doc": doc_id, "kind": "nack", "client": client, "msg": out}
+            )
+            return False
+        self.deltas.append({"doc": doc_id, "kind": "op", "msg": out})
+        return True
 
     def checkpoint(self) -> dict:
         """Resumable state (deli checkpointContext.ts → Mongo)."""
@@ -327,6 +341,13 @@ class _Socket(BufferedListener):
             raise RuntimeError("socket closed")
         self.server.alfred_submit(self.doc_id, self.client_id, msg)
 
+    def submit_batch(self, msgs: List[DocumentMessage]) -> None:
+        """Boxcarred submit: the whole batch rides one ingress record
+        and sequences atomically (pendingBoxcar.ts role)."""
+        if not self.connected:
+            raise RuntimeError("socket closed")
+        self.server.alfred_submit_batch(self.doc_id, self.client_id, msgs)
+
     def catch_up(self, from_seq: int) -> List[SequencedMessage]:
         return [
             m
@@ -433,6 +454,35 @@ class LocalServer:
             self.log.topic("rawdeltas").append(
                 {"doc": doc_id, "kind": "op", "client": client_id, "msg": msg}
             )
+        self._auto_pump()
+
+    def alfred_submit_batch(
+        self, doc_id: str, client_id: int, msgs: List[DocumentMessage]
+    ) -> None:
+        """Boxcarred ingress: size-validate each op, then append ONE
+        rawdeltas record for the whole batch (pendingBoxcar.ts)."""
+        for msg in msgs:
+            try:
+                size = len(json.dumps(msg.contents, default=str))
+            except Exception:
+                size = 0
+            if size > MAX_OP_BYTES:
+                self.log.topic("deltas").append(
+                    {
+                        "doc": doc_id,
+                        "kind": "nack",
+                        "client": client_id,
+                        "msg": NackMessage(
+                            client_id, msg.client_seq, 413, "op too large"
+                        ),
+                    }
+                )
+                self._auto_pump()
+                return
+        self.log.topic("rawdeltas").append(
+            {"doc": doc_id, "kind": "boxcar", "client": client_id,
+             "msgs": list(msgs)}
+        )
         self._auto_pump()
 
     def alfred_disconnect(self, sock: _Socket) -> None:

@@ -46,6 +46,17 @@ class _Connection(BufferedListener):
             raise RuntimeError("connection closed")
         self.service._submit(self.doc_id, self.client_id, msg)
 
+    def submit_batch(self, msgs: List[DocumentMessage]) -> None:
+        """Boxcar parity with the lambda pipeline's socket: the simple
+        orderer sequences back-to-back, which is already atomic. A
+        synchronous nack mid-batch disconnects this connection; the
+        remainder stays pending client-side for the reconnect replay
+        (never raise into the caller's flush)."""
+        for msg in msgs:
+            if not self.connected:
+                return
+            self.submit(msg)
+
     def catch_up(self, from_seq: int) -> List[SequencedMessage]:
         """Ops in (from_seq, join_seq] — the gap between a loaded
         summary/last session and this connection (the

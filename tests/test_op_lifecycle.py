@@ -1,0 +1,153 @@
+"""Op lifecycle (compression, chunking, boxcar) + attachment blobs.
+
+Reference: opCompressor.ts:20, opSplitter.ts:22, pendingBoxcar.ts,
+blobManager.ts:149. The service nacks ops over 768KB, so a >1MB op
+only round-trips if the splitter kicks in.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds import MapFactory, StringFactory
+from fluidframework_tpu.drivers import FaultInjectionDriver, LocalDriver
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.runtime.gc import make_handle
+from fluidframework_tpu.runtime.op_lifecycle import (
+    ChunkReassembler,
+    compress_batch,
+    decompress_batch,
+    split_contents,
+)
+from fluidframework_tpu.server import LocalServer
+
+REGISTRY = ChannelRegistry([MapFactory(), StringFactory()])
+
+
+def make_pair():
+    server = LocalServer()
+    loader = Loader(LocalDriver(server), REGISTRY)
+    c1 = loader.create_detached()
+    ds = c1.runtime.create_datastore("default")
+    ds.create_channel("m", MapFactory.type_name)
+    ds.create_channel("s", StringFactory.type_name)
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+    return c1, c2, loader, server, doc
+
+
+def chan(c, cid="m"):
+    return c.runtime.get_datastore("default").get_channel(cid)
+
+
+def test_compress_roundtrip_unit():
+    contents = [{"a": 1}, {"b": [1, 2, 3]}, {"c": "x" * 100}]
+    packed = compress_batch(contents)
+    assert len(packed) == 3
+    assert "packedContents" in packed[0]
+    assert packed[1] == {"placeholder": True}
+    assert decompress_batch(packed[0]) == contents
+
+
+def test_split_and_reassemble_unit():
+    import random
+
+    rng = random.Random(0)  # incompressible payload so chunking kicks in
+    contents = {"data": "".join(chr(rng.randint(33, 0x2FFF)) for _ in range(9000))}
+    chunks = split_contents(contents, 1024)
+    assert chunks is not None and len(chunks) > 1
+    r = ChunkReassembler()
+    for ch in chunks[:-1]:
+        done, _ = r.feed(5, ch)
+        assert not done
+    done, orig = r.feed(5, chunks[-1])
+    assert done and orig == contents
+    assert split_contents({"small": 1}, 1024) is None
+
+
+def test_oversize_op_roundtrips_via_chunking():
+    """A >1MB op would be nacked by alfred (MAX_OP_BYTES); the
+    splitter must carry it through in chunks."""
+    c1, c2, *_ = make_pair()
+    big = "z" * 1_200_000
+    chan(c1).set("big", big)
+    c1.flush()
+    assert chan(c2).get("big") == big
+    assert chan(c1).get("big") == big
+    assert not c1.runtime.is_dirty
+
+
+def test_compressed_batch_roundtrips():
+    c1, c2, *_ = make_pair()
+    c1.runtime.compression_threshold = 64  # force compression
+    for i in range(8):
+        chan(c1).set(f"k{i}", "v" * 50)
+    chan(c1, "s").insert_text(0, "hello compression")
+    c1.flush()
+    for i in range(8):
+        assert chan(c2).get(f"k{i}") == "v" * 50
+    assert chan(c2, "s").get_text() == "hello compression"
+    assert not c1.runtime.is_dirty
+
+
+def test_chunked_op_survives_reconnect():
+    """Pending chunk pieces are synthetic: after a reconnect the
+    original op resubmits (and re-chunks) whole."""
+    server = LocalServer()
+    fdriver = FaultInjectionDriver(LocalDriver(server))
+    loader = Loader(fdriver, REGISTRY)
+    c1 = loader.create_detached()
+    ds = c1.runtime.create_datastore("default")
+    ds.create_channel("m", MapFactory.type_name)
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    big = "w" * 1_000_000
+    fdriver.drop_submits = True
+    chan(c1).set("big", big)
+    c1.flush()  # all chunks lost in flight
+    fdriver.drop_submits = False
+    fdriver.disconnect_all()
+    c1.connect()
+    c2.connect()
+    c1.flush()
+    assert chan(c2).get("big") == big
+    assert not c1.runtime.is_dirty
+
+
+def test_blob_create_fetch_and_gc():
+    c1, c2, loader, server, doc = make_pair()
+    payload = b"\x00\x01binary-blob" * 1000
+    handle = c1.create_blob(payload)
+    chan(c1).set("attachment", handle)
+    c1.flush()
+
+    # The other replica sees the handle and fetches out-of-band.
+    h2 = chan(c2).get("attachment")
+    assert c2.get_blob(h2) == payload
+    assert c1.get_blob(handle) == payload
+
+    # GC: referenced while the handle is reachable; swept after the
+    # reference is dropped.
+    gc = c1.runtime.attach_gc(sweep_grace=0)
+    referenced, _ = gc.collect()
+    blob_node = handle["url"]
+    assert blob_node in referenced
+    chan(c1).delete("attachment")
+    c1.flush()
+    deleted = gc.sweep()
+    assert blob_node in deleted
+    assert not c1.runtime.blobs.attached
+
+
+def test_batch_atomicity_with_boxcar():
+    """Boxcarred batches still apply atomically on receivers."""
+    c1, c2, *_ = make_pair()
+    seen = []
+    c2.runtime.on("op", lambda m, local: seen.append(m.sequence_number))
+    for i in range(5):
+        chan(c1).set(f"x{i}", i)
+    c1.flush()
+    for i in range(5):
+        assert chan(c2).get(f"x{i}") == i

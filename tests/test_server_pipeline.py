@@ -116,6 +116,11 @@ def test_quorum_proposal_commits_on_msn():
 def test_oversized_op_nacked():
     server = LocalServer()
     rt = connect_runtime(server, client_id=1, channels=(("m", MapFactory.type_name),))
+    # Disable the client-side splitter and compressor (opSplitter.ts /
+    # opCompressor.ts) so the raw oversized op reaches alfred and
+    # exercises the size-nack path.
+    rt.max_op_bytes = 1 << 30
+    rt.compression_threshold = None
     nacks = []
     rt.on("nack", nacks.append)
     chan(rt, "m").set("big", "x" * (800 * 1024))
