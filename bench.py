@@ -22,7 +22,7 @@ never compiles.
 
 Env knobs: BENCH_OPS (default 1_000_000), BENCH_GATE_OPS (20_000),
 BENCH_ORACLE_OPS (20_000), BENCH_CLIENTS (1024), BENCH_CHUNK (2048),
-BENCH_CAPACITY (16384 initial), BENCH_SYNC (8), BENCH_ENGINE (auto).
+BENCH_CAPACITY (32768 initial), BENCH_SYNC (4), BENCH_ENGINE (auto).
 """
 
 from __future__ import annotations
@@ -37,7 +37,8 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
 )
 
-MAX_CAPACITY = 1 << 19  # pre-compile ladder ceiling (rows)
+MAX_CAPACITY = 1 << 17  # ladder ceiling: 131072 rows (~10MB of VMEM tiles;
+#  2x that exceeds the core's VMEM and Mosaic refuses the kernel)
 
 
 def main() -> None:
@@ -46,8 +47,8 @@ def main() -> None:
     n_oracle = min(int(os.environ.get("BENCH_ORACLE_OPS", 20_000)), n_ops)
     n_clients = int(os.environ.get("BENCH_CLIENTS", 1024))
     chunk = int(os.environ.get("BENCH_CHUNK", 2048))
-    capacity = int(os.environ.get("BENCH_CAPACITY", 16384))
-    sync = int(os.environ.get("BENCH_SYNC", 8))
+    capacity = int(os.environ.get("BENCH_CAPACITY", 32768))
+    sync = int(os.environ.get("BENCH_SYNC", 4))
     engine = os.environ.get("BENCH_ENGINE", "auto")
     initial_len = 64
 

@@ -231,6 +231,9 @@ class ColumnarReplica:
         from ..ops.zamboni import compact_gather_text
 
         assert self.capacity % 1024 == 0, "pallas path: capacity % 1024"
+        # The table must absorb a FULL sync window before the first
+        # compaction can trim it: worst case 2 rows per op.
+        self._ensure_window_capacity(int(self.table.n_rows), B)
         arena_cap = self.arena_cap or (
             -(-(len(self.doc_text) + len(s.text) + 1) // (1 << 18)) * (1 << 18)
         )
@@ -299,12 +302,7 @@ class ColumnarReplica:
                     # host round trip; it rides the compaction cadence).
                     n_rows = int(self.table.n_rows)
                     self.check_errors()
-                    margin = 2 * B * self.sync_interval + 2
-                    if n_rows + margin > self.capacity:
-                        new_cap = self.capacity
-                        while n_rows + margin > new_cap:
-                            new_cap *= 2
-                        self._grow(new_cap)
+                    self._ensure_window_capacity(n_rows, B)
                 if done and limit_chunks is not None:
                     break
             if limit_chunks is not None and chunks_done >= limit_chunks:
@@ -357,6 +355,17 @@ class ColumnarReplica:
     def _grow(self, new_cap: int) -> None:
         self.table = grow_table(self.table, self.capacity, new_cap)
         self.capacity = new_cap
+
+    def _ensure_window_capacity(self, n_rows: int, B: int) -> None:
+        """Grow (doubling) until `n_rows` plus a full sync window's
+        worst-case growth (2 rows/op) fits."""
+        margin = 2 * B * self.sync_interval
+        if n_rows + margin <= self.capacity:
+            return
+        new_cap = self.capacity
+        while n_rows + margin > new_cap:
+            new_cap *= 2
+        self._grow(new_cap)
 
     # --------------------------------------------------------- compaction
 

@@ -16,6 +16,7 @@ every replica rebases each incoming commit over the concurrent trunk
 commits it had not seen, deterministically.
 """
 
+from .branch import SharedTreeBranch
 from .changeset import (
     compose,
     insert_op,
@@ -27,15 +28,23 @@ from .changeset import (
 from .forest import Forest
 from .edit_manager import Commit, EditManager
 from .id_compressor import IdCompressor
+from .rebase_kernel import rebase_batch, rebase_ops_columnar
+from .schema import FieldSchema, NodeSchema, TreeSchema
 from .shared_tree import SharedTree, SharedTreeFactory
 
 __all__ = [
     "Commit",
     "EditManager",
+    "FieldSchema",
     "Forest",
     "IdCompressor",
+    "NodeSchema",
     "SharedTree",
+    "SharedTreeBranch",
     "SharedTreeFactory",
+    "TreeSchema",
+    "rebase_batch",
+    "rebase_ops_columnar",
     "compose",
     "insert_op",
     "invert",

@@ -1,0 +1,118 @@
+"""Branch object API: isolated lines of development over a SharedTree.
+
+Reference `SharedTreeBranch`
+(packages/dds/tree/src/shared-tree-core/branch.ts:50-210): `fork()`
+captures an isolated view; edits apply to the branch only;
+`rebase_onto` replays the branch's commits on top of everything the
+main line has since sequenced; `merge_into` lands the (rebased)
+branch commits on the main tree as ordinary edits. Branch state is
+purely local — nothing rides the wire until merge.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, List
+
+from .changeset import (
+    Change,
+    insert_op,
+    rebase_change,
+    remove_op,
+    set_value_op,
+)
+from .forest import Forest
+
+
+class SharedTreeBranch:
+    def __init__(self, tree):
+        self.tree = tree
+        self.forest: Forest = tree.forest.clone()
+        self.base_seq: int = tree.edits.trunk_seq
+        # Local-to-the-tree commits present at fork time are part of
+        # the captured view: when they later sequence into the trunk
+        # they must NOT rebase under us a second time. Strong refs are
+        # held so commit-object identity (which ack_local preserves)
+        # stays unambiguous — a bare id() set could alias a recycled
+        # address after the commit is evicted and freed.
+        self._fork_local = list(tree.edits.local)
+        self.commits: List[Change] = []
+        self.merged = False
+
+    # ------------------------------------------------------------ editing
+
+    def view(self) -> dict:
+        return self.forest.to_json()
+
+    def edit(self, change: Change) -> None:
+        assert not self.merged, "branch already merged"
+        self.forest.apply(change)
+        self.commits.append(copy.deepcopy(change))
+
+    def insert_node(self, path, field, index, content) -> None:
+        self.edit([insert_op(path, field, index, content)])
+
+    def remove_node(self, path, field, index, count=1) -> None:
+        self.edit([remove_op(path, field, index, count)])
+
+    def set_value(self, path, value) -> None:
+        self.edit([set_value_op(path, value)])
+
+    # ------------------------------------------------------------- rebase
+
+    def _changes_since_fork(self) -> Change:
+        """Everything the tree applied since the fork that the branch
+        has not rebased over: trunk commits sequenced after base_seq
+        PLUS the tree's unacked local commits — the fork's forest view
+        rebuilds from tree.forest, which contains both."""
+        fork_ids = {id(c) for c in self._fork_local}
+        trunk = [
+            op
+            for c in self.tree.edits.trunk
+            if c.seq > self.base_seq and id(c) not in fork_ids
+            for op in c.change
+        ]
+        local = [
+            op
+            for c in self.tree.edits.local
+            if id(c) not in fork_ids
+            for op in c.change
+        ]
+        return trunk + local
+
+    def rebase_onto(self) -> None:
+        """Rebase this branch onto the tree's CURRENT state
+        (branch.ts rebaseOnto): every branch commit rewrites over the
+        trunk commits sequenced since the fork (earlier branch commits
+        carrying through, later ones rebasing over the carried base),
+        then the branch view rebuilds from the tree's current forest."""
+        evicted = getattr(self.tree.edits, "evicted_seq", 0)
+        if self.base_seq < evicted:
+            raise RuntimeError(
+                f"branch too old to rebase: trunk evicted to seq "
+                f"{evicted}, branch forked at {self.base_seq}"
+            )
+        carried = self._changes_since_fork()
+        rebased: List[Change] = []
+        for commit in self.commits:
+            rebased.append(rebase_change(commit, carried, over_first=True))
+            carried = rebase_change(carried, commit, over_first=False)
+        self.commits = rebased
+        self.forest = self.tree.forest.clone()
+        for c in self.commits:
+            self.forest.apply(c)
+        self.base_seq = self.tree.edits.trunk_seq
+        self._fork_local = list(self.tree.edits.local)
+
+    # -------------------------------------------------------------- merge
+
+    def merge_into(self) -> None:
+        """Land the branch on the main tree (branch.ts merge): rebase
+        up to date, then submit each commit as a normal tree edit (the
+        tree's optimistic-local + op-stream path takes over)."""
+        self.rebase_onto()
+        for c in self.commits:
+            if c:
+                self.tree.edit(copy.deepcopy(c))
+        self.commits = []
+        self.merged = True

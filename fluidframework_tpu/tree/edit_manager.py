@@ -107,4 +107,7 @@ class EditManager:
         reference past them — the trunk-eviction of editManager.ts)."""
         before = len(self.trunk)
         self.trunk = [c for c in self.trunk if c.seq > min_seq]
+        # Watermark for consumers that rebase against trunk history
+        # (branches refuse to rebase across an evicted window).
+        self.evicted_seq = max(getattr(self, "evicted_seq", 0), min_seq)
         return before - len(self.trunk)

@@ -1,0 +1,126 @@
+"""Batched changeset rebase: the config-4 TPU kernel.
+
+The reference rebases commits one at a time through the change-family
+code (core/edit-manager/editManager.ts:47 trunk rebase;
+feature-libraries/sequence-field/rebase.ts index arithmetic). For the
+bulk case — rebase a large pending branch over a trunk window, the
+BASELINE.json config-4 shape — the index arithmetic is data-parallel
+across the pending ops: each trunk op adjusts EVERY pending op's
+(index, count) with the same closed-form rules. This module runs that
+as a `lax.scan` over the trunk window with all pending ops as vector
+state (one XLA dispatch for the whole rebase).
+
+Semantics mirror changeset._adjust_index / rebase_op for single-field
+insert/remove streams exactly (differential test:
+tests/test_tree_depth.py), including: insert-over-insert
+shifts with the sequenced-earlier tie, insert sliding to a removed
+range's start, removes clipping against base removes (overlap is
+muted), and full mutes dropping the op (count -> 0).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+K_INSERT = 0
+K_REMOVE = 1
+
+
+def _rebase_step(state, base):
+    """Adjust all pending ops over ONE base op (the _adjust_index
+    rules, vectorized). state: (kind[N], index[N], count[N],
+    needs_split[N]); base: (kind, index, count). Muted ops end with
+    count 0. A base insert strictly INSIDE a pending remove's range
+    splits that remove in two (changeset.rebase_op "multi") — an
+    output-expanding case no fixed columnar row can hold, so the op is
+    FLAGGED and the caller reroutes it through the scalar path (the
+    kernel result for a flagged op is unspecified)."""
+    kind, idx, cnt, flag = state
+    bk, bi, bn = base
+    is_ins = kind == K_INSERT
+    flag = flag | (
+        (bk == K_INSERT) & (kind == K_REMOVE) & (bi > idx) & (bi < idx + cnt)
+    )
+
+    # ---- base insert: positions at/after shift right.
+    # insertion gaps: strict >, ties go to base (sequenced earlier);
+    # node references: >= (content before the node shifts it).
+    shift_ins = jnp.where(
+        is_ins,
+        jnp.where(idx >= bi, bn, 0),  # gap: bi < idx or tie -> shift
+        jnp.where(idx >= bi, bn, 0),  # node ref: bi <= idx -> shift
+    )
+    idx_after_ins = idx + shift_ins
+
+    # ---- base remove [bi, bi+bn): inserts inside slide to bi;
+    # removes clip: the overlap with the base range is already gone.
+    lo = jnp.maximum(idx, bi)
+    hi = jnp.minimum(idx + cnt, bi + bn)
+    overlap = jnp.maximum(0, hi - lo)
+    new_cnt_rem = cnt - overlap
+    # Surviving range start: nodes before bi keep their index; nodes
+    # at/inside the range slide to bi; nodes after subtract bn.
+    start_rem = jnp.where(
+        idx < bi, idx, jnp.where(idx < bi + bn, bi, idx - bn)
+    )
+    # If the head of the removed range was clipped, the survivors
+    # begin at the base-range start.
+    start_rem = jnp.where(
+        (kind == K_REMOVE) & (idx >= bi) & (idx < bi + bn),
+        bi,
+        start_rem,
+    )
+    idx_after_rem = jnp.where(
+        is_ins,
+        jnp.where(idx < bi, idx, jnp.maximum(bi, idx - bn)),
+        start_rem,
+    )
+    cnt_after_rem = jnp.where(is_ins, cnt, new_cnt_rem)
+
+    new_idx = jnp.where(bk == K_INSERT, idx_after_ins, idx_after_rem)
+    new_cnt = jnp.where(bk == K_INSERT, cnt, cnt_after_rem)
+    return (kind, new_idx, new_cnt, flag), None
+
+
+@jax.jit
+def rebase_batch(kinds: jnp.ndarray, idxs: jnp.ndarray, cnts: jnp.ndarray,
+                 base_kinds: jnp.ndarray, base_idxs: jnp.ndarray,
+                 base_cnts: jnp.ndarray
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Rebase N pending ops over M base ops (applied in order) in one
+    XLA computation: lax.scan over the base window, every pending op
+    adjusted in parallel per step."""
+    (k, i, c, f), _ = jax.lax.scan(
+        _rebase_step,
+        (kinds, idxs, cnts, jnp.zeros(kinds.shape, bool)),
+        (base_kinds, base_idxs, base_cnts),
+    )
+    return k, i, c, f
+
+
+def rebase_ops_columnar(ops: np.ndarray, base: np.ndarray):
+    """numpy convenience: ops/base are [N,3]/[M,3] arrays of
+    (kind, index, count). Returns (rebased [N,3], flagged [N]) —
+    flagged ops hit the split case and must reroute through the
+    scalar changeset path (count 0 = muted)."""
+    k, i, c, f = rebase_batch(
+        jnp.asarray(ops[:, 0]), jnp.asarray(ops[:, 1]), jnp.asarray(ops[:, 2]),
+        jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]), jnp.asarray(base[:, 2]),
+    )
+    out = np.stack([np.asarray(k), np.asarray(i), np.asarray(c)], axis=1)
+    return out, np.asarray(f)
+
+
+@functools.partial(jax.jit, static_argnums=())
+def rebase_commit_range(kinds, idxs, cnts, commit_ids, base_kinds,
+                        base_idxs, base_cnts):
+    """Config-4 shape: a RANGE of commits (ops tagged by commit id,
+    already concatenated columnar) rebases over a trunk window — same
+    scan, the commit structure rides along untouched."""
+    k, i, c, f = rebase_batch(kinds, idxs, cnts, base_kinds, base_idxs, base_cnts)
+    return k, i, c, f, commit_ids

@@ -1,0 +1,209 @@
+"""Tree schema + typed editable views.
+
+The reference's schema system + editable-tree proxy API
+(packages/dds/tree/src/feature-libraries/{modular-schema,
+editable-tree}/, src/core/schema-stored/): node types declare their
+fields with KINDS, documents validate against the schema, and edits go
+through typed node views instead of raw paths.
+
+Field kinds (the reference's FieldKinds):
+- "value":    exactly one child (or a leaf primitive value)
+- "optional": zero or one child
+- "sequence": any number of children
+
+`TreeSchema` is stored data (rides the SharedTree summary); views are
+ephemeral proxies resolving paths lazily so they stay valid as
+siblings shift (the editable-tree anchor behavior, simplified to
+re-resolution by index).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .changeset import insert_op, remove_op, set_value_op
+
+
+class FieldSchema:
+    def __init__(self, kind: str, types: Optional[List[str]] = None):
+        assert kind in ("value", "optional", "sequence"), kind
+        self.kind = kind
+        self.types = types  # allowed child node types (None = any)
+
+    def to_json(self) -> dict:
+        return {"kind": self.kind, "types": self.types}
+
+    @staticmethod
+    def from_json(data: dict) -> "FieldSchema":
+        return FieldSchema(data["kind"], data.get("types"))
+
+
+class NodeSchema:
+    def __init__(self, name: str, fields: Optional[Dict[str, FieldSchema]] = None,
+                 leaf: bool = False):
+        self.name = name
+        self.fields = fields or {}
+        self.leaf = leaf  # leaf nodes carry a value, no fields
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "leaf": self.leaf,
+            "fields": {k: f.to_json() for k, f in self.fields.items()},
+        }
+
+    @staticmethod
+    def from_json(data: dict) -> "NodeSchema":
+        return NodeSchema(
+            data["name"],
+            {k: FieldSchema.from_json(f) for k, f in data["fields"].items()},
+            data.get("leaf", False),
+        )
+
+
+class TreeSchema:
+    """A document schema: named node types + the root field."""
+
+    def __init__(self, nodes: Optional[Dict[str, NodeSchema]] = None,
+                 root: Optional[FieldSchema] = None):
+        self.nodes = nodes or {}
+        self.root = root or FieldSchema("sequence")
+
+    def define(self, name: str, **fields: FieldSchema) -> NodeSchema:
+        ns = NodeSchema(name, dict(fields))
+        self.nodes[name] = ns
+        return ns
+
+    def define_leaf(self, name: str) -> NodeSchema:
+        ns = NodeSchema(name, leaf=True)
+        self.nodes[name] = ns
+        return ns
+
+    # -------------------------------------------------------- validation
+
+    def validate_node(self, node: dict, errors: List[str], where: str) -> None:
+        t = node.get("type")
+        if t is None:
+            return  # untyped nodes permitted only by untyped fields
+        ns = self.nodes.get(t)
+        if ns is None:
+            errors.append(f"{where}: unknown node type {t!r}")
+            return
+        fields = node.get("fields", {})
+        if ns.leaf and fields:
+            errors.append(f"{where}: leaf type {t!r} has fields")
+        for fname, children in fields.items():
+            fs = ns.fields.get(fname)
+            if fs is None:
+                errors.append(f"{where}: field {fname!r} not in schema of {t!r}")
+                continue
+            n = len(children)
+            if fs.kind == "value" and n != 1:
+                errors.append(f"{where}.{fname}: value field has {n} children")
+            if fs.kind == "optional" and n > 1:
+                errors.append(f"{where}.{fname}: optional field has {n} children")
+            for i, child in enumerate(children):
+                if fs.types is not None and child.get("type") not in fs.types:
+                    errors.append(
+                        f"{where}.{fname}[{i}]: type {child.get('type')!r} "
+                        f"not allowed (want {fs.types})"
+                    )
+                self.validate_node(child, errors, f"{where}.{fname}[{i}]")
+        for fname, fs in ns.fields.items():
+            if fs.kind == "value" and fname not in fields:
+                errors.append(f"{where}: missing value field {fname!r} of {t!r}")
+
+    def validate(self, root: dict) -> List[str]:
+        """Errors for a whole document (root's synthetic node)."""
+        errors: List[str] = []
+        for i, child in enumerate(root.get("fields", {}).get("root", [])):
+            if self.root.types is not None and child.get("type") not in self.root.types:
+                errors.append(f"root[{i}]: type {child.get('type')!r} not allowed")
+            self.validate_node(child, errors, f"root[{i}]")
+        return errors
+
+    # ----------------------------------------------------------- storage
+
+    def to_json(self) -> dict:
+        return {
+            "nodes": {k: n.to_json() for k, n in self.nodes.items()},
+            "root": self.root.to_json(),
+        }
+
+    @staticmethod
+    def from_json(data: dict) -> "TreeSchema":
+        return TreeSchema(
+            {k: NodeSchema.from_json(n) for k, n in data["nodes"].items()},
+            FieldSchema.from_json(data["root"]),
+        )
+
+
+# --------------------------------------------------------------------------
+# typed editable views (editable-tree proxies)
+# --------------------------------------------------------------------------
+
+
+class NodeView:
+    """Proxy for one node: field access returns child views; edits
+    submit schema-checked changes through the owning SharedTree."""
+
+    def __init__(self, tree, path: List[list]):
+        self._tree = tree
+        self._path = path
+
+    def _node(self) -> dict:
+        node = self._tree.forest.node_at(self._path)
+        if node is None:
+            raise KeyError(f"no node at {self._path}")
+        return node
+
+    @property
+    def type(self) -> Optional[str]:
+        return self._node().get("type")
+
+    @property
+    def value(self) -> Any:
+        return self._node().get("value")
+
+    def set_value(self, value: Any) -> None:
+        self._tree.edit([set_value_op(self._path, value)])
+
+    def field(self, name: str) -> "FieldView":
+        return FieldView(self._tree, self._path, name)
+
+    def __getitem__(self, name: str) -> "FieldView":
+        return self.field(name)
+
+
+class FieldView:
+    """Proxy for one field of a node (sequence/value/optional)."""
+
+    def __init__(self, tree, parent_path: List[list], name: str):
+        self._tree = tree
+        self._parent = parent_path
+        self._name = name
+
+    def _children(self) -> list:
+        node = self._tree.forest.node_at(self._parent)
+        if node is None:
+            raise KeyError(f"no node at {self._parent}")
+        return node.get("fields", {}).get(self._name, [])
+
+    def __len__(self) -> int:
+        return len(self._children())
+
+    def node(self, index: int) -> NodeView:
+        return NodeView(self._tree, self._parent + [[self._name, index]])
+
+    def __getitem__(self, index: int) -> NodeView:
+        return self.node(index)
+
+    def insert(self, index: int, content: List[dict]) -> None:
+        self._tree.schema_check_insert(self._parent, self._name, content)
+        self._tree.edit([insert_op(self._parent, self._name, index, content)])
+
+    def append(self, content: List[dict]) -> None:
+        self.insert(len(self), content)
+
+    def remove(self, index: int, count: int = 1) -> None:
+        self._tree.edit([remove_op(self._parent, self._name, index, count)])

@@ -1,0 +1,370 @@
+"""Sequence-field mark calculus: compose / invert / rebase over marks.
+
+The reference's core list-merge machinery
+(packages/dds/tree/src/feature-libraries/sequence-field/{rebase,
+compose,invert}.ts): a changeset for one sequence field is a stream of
+MARKS walked against the field's input state. This module implements
+the calculus over the mark vocabulary:
+
+- {"skip": n}                        advance over n untouched nodes
+- {"insert": [c...], "tie": k}       new content (consumes no input);
+                                     `tie` orders same-position inserts
+- {"delete": n, "content": [...]}    detach n nodes (content captured
+                                     at apply time, fueling revive)
+- {"revive": [c...]}                 reattach deleted content (the
+                                     invert of delete)
+- {"moveOut": n, "id": m}            detach n nodes for a move
+- {"moveIn": "id": m}                reattach the nodes of pair m
+
+Moves are first-class (moveOut/moveIn pairs), delete is detach with
+capture, and edits rebased over a delete of their target range are
+MUTED (dropped) exactly as the reference mutes marks under detached
+ranges.
+
+The laws (core/rebase/verifyChangeRebaser.ts contract) are enforced by
+tests/test_sequence_field.py's fuzz suite:
+  apply(apply(s,A),B) == apply(s, compose(A,B))
+  apply(apply(s,A), invert(A)) == s
+  rebase(A, empty) == A
+  rebase(A, compose(B,C)) == rebase(rebase(A,B), C)
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+Mark = Dict[str, Any]
+MarkList = List[Mark]
+
+
+# --------------------------------------------------------------------------
+# constructors / normalization
+# --------------------------------------------------------------------------
+
+
+def skip(n: int) -> Mark:
+    return {"skip": n}
+
+
+def insert(content: List[Any], tie: int = 0) -> Mark:
+    return {"insert": list(content), "tie": tie}
+
+
+def delete(n: int) -> Mark:
+    return {"delete": n}
+
+
+def move_out(n: int, move_id: Any) -> Mark:
+    return {"moveOut": n, "id": move_id}
+
+
+def move_in(move_id: Any) -> Mark:
+    return {"moveIn": True, "id": move_id}
+
+
+def _input_len(mark: Mark) -> int:
+    """Input nodes the mark consumes."""
+    if "skip" in mark:
+        return mark["skip"]
+    if "delete" in mark:
+        return mark["delete"]
+    if "moveOut" in mark:
+        return mark["moveOut"]
+    return 0
+
+
+def _output_len(mark: Mark, moved: Optional[Dict[Any, List[Any]]] = None) -> int:
+    """Output nodes the mark produces."""
+    if "skip" in mark:
+        return mark["skip"]
+    if "insert" in mark:
+        return len(mark["insert"])
+    if "revive" in mark:
+        return len(mark["revive"])
+    if "moveIn" in mark:
+        if moved is not None and mark["id"] in moved:
+            return len(moved[mark["id"]])
+        return mark.get("count", 0)
+    return 0
+
+
+def normalize(marks: MarkList) -> MarkList:
+    """Merge adjacent same-kind marks, drop empties."""
+    out: MarkList = []
+    for m in marks:
+        if ("skip" in m and m["skip"] == 0) or ("delete" in m and m["delete"] == 0):
+            continue
+        if "insert" in m and not m["insert"]:
+            continue
+        if "revive" in m and not m["revive"]:
+            continue
+        if out:
+            p = out[-1]
+            if "skip" in p and "skip" in m:
+                p["skip"] += m["skip"]
+                continue
+            if "delete" in p and "delete" in m and "content" not in p and "content" not in m:
+                p["delete"] += m["delete"]
+                continue
+        out.append(dict(m))
+    # Trailing skips are identity.
+    while out and "skip" in out[-1] and True:
+        break
+    return out
+
+
+# --------------------------------------------------------------------------
+# apply
+# --------------------------------------------------------------------------
+
+
+def apply_marks(seq: List[Any], marks: MarkList,
+                capture: bool = True) -> List[Any]:
+    """Apply a mark stream to a sequence. With `capture`, delete and
+    moveOut marks record the content they detach (in place) so the
+    stream becomes invertible — the reference captures repair data the
+    same way (delta application feeds repair stores)."""
+    out: List[Any] = []
+    moved: Dict[Any, List[Any]] = {}
+    i = 0
+    # First pass: collect moved-out content so moveIn can land even if
+    # it appears before its moveOut in the stream.
+    j = 0
+    for m in marks:
+        n = _input_len(m)
+        if "moveOut" in m:
+            moved[m["id"]] = seq[j: j + n]
+        j += n
+    if j > len(seq):
+        raise ValueError(f"marks consume {j} nodes; sequence has {len(seq)}")
+    for m in marks:
+        if "skip" in m:
+            out.extend(seq[i: i + m["skip"]])
+            i += m["skip"]
+        elif "insert" in m:
+            out.extend(copy.deepcopy(m["insert"]))
+        elif "revive" in m:
+            out.extend(copy.deepcopy(m["revive"]))
+        elif "delete" in m:
+            if capture:
+                m["content"] = copy.deepcopy(seq[i: i + m["delete"]])
+            i += m["delete"]
+        elif "moveOut" in m:
+            if capture:
+                m["count"] = m["moveOut"]
+            i += m["moveOut"]
+        elif "moveIn" in m:
+            content = moved.get(m["id"], [])
+            if capture:
+                m["count"] = len(content)  # fuels invert (moveIn→moveOut)
+            out.extend(copy.deepcopy(content))
+    out.extend(seq[i:])
+    return out
+
+
+# --------------------------------------------------------------------------
+# invert
+# --------------------------------------------------------------------------
+
+
+def invert_marks(marks: MarkList) -> MarkList:
+    """Invert an APPLIED mark stream (delete marks carry captured
+    content). Walks the OUTPUT space of `marks`, producing a stream
+    that undoes it (invert.ts)."""
+    out: MarkList = []
+    for m in marks:
+        if "skip" in m:
+            out.append(skip(m["skip"]))
+        elif "insert" in m:
+            out.append(delete(len(m["insert"])))
+        elif "revive" in m:
+            out.append(delete(len(m["revive"])))
+        elif "delete" in m:
+            if "content" not in m:
+                raise ValueError("invert of an unapplied delete (no capture)")
+            out.append({"revive": copy.deepcopy(m["content"])})
+        elif "moveOut" in m:
+            out.append({"moveIn": True, "id": m["id"],
+                        "count": m.get("count", 0)})
+        elif "moveIn" in m:
+            out.append({"moveOut": m.get("count", 0), "id": m["id"]})
+    return normalize(out)
+
+
+# --------------------------------------------------------------------------
+# compose
+# --------------------------------------------------------------------------
+
+
+def _split(mark: Mark, n: int, by_input: bool) -> Tuple[Mark, Mark]:
+    """Split a mark so the first part covers n input (or output) nodes."""
+    m = dict(mark)
+    if "skip" in m:
+        return skip(n), skip(m["skip"] - n)
+    if "delete" in m:
+        a, b = delete(n), delete(m["delete"] - n)
+        if "content" in m:
+            a["content"] = m["content"][:n]
+            b["content"] = m["content"][n:]
+        return a, b
+    if "insert" in m:
+        return (
+            {"insert": m["insert"][:n], "tie": m.get("tie", 0)},
+            {"insert": m["insert"][n:], "tie": m.get("tie", 0)},
+        )
+    if "revive" in m:
+        return {"revive": m["revive"][:n]}, {"revive": m["revive"][n:]}
+    raise ValueError(f"cannot split mark {m}")  # moves split unsupported
+
+
+def compose_marks(a: MarkList, b: MarkList) -> MarkList:
+    """compose(A, B): one stream equivalent to applying A then B
+    (compose.ts). B is walked in A's OUTPUT space. Move marks are kept
+    only when untouched by the other stream (the reference composes
+    moves through a cross-field move table; this field-local calculus
+    requires non-overlapping moves, which normalize() preserves)."""
+    a = [dict(m) for m in normalize(a)]
+    b = [dict(m) for m in normalize(b)]
+    out: MarkList = []
+    ai = 0
+
+    def take_a(n: int) -> List[Mark]:
+        """Consume n OUTPUT nodes worth of A-marks. Zero-output marks
+        (deletes/moveOuts, invisible to B) ride along IN ORDER — they
+        must keep their position between the visible marks."""
+        nonlocal ai
+        got: List[Mark] = []
+        need = n
+        while need > 0:
+            if ai >= len(a):
+                got.append(skip(need))  # implicit trailing skip
+                return got
+            m = a[ai]
+            ol = _output_len(m)
+            if ol == 0:
+                got.append(m)  # delete/moveOut: invisible to B
+                ai += 1
+                continue
+            if ol <= need:
+                got.append(m)
+                ai += 1
+                need -= ol
+            else:
+                first, rest = _split(m, need, by_input=False)
+                got.append(first)
+                a[ai] = rest
+                need = 0
+        return got
+
+    for bm in b:
+        if "skip" in bm:
+            out.extend(take_a(bm["skip"]))
+        elif "insert" in bm or "revive" in bm or "moveIn" in bm:
+            out.append(bm)
+        elif "delete" in bm or "moveOut" in bm:
+            n = _input_len(bm)
+            covered = take_a(n)
+            # B deletes nodes that A produced: inserts/revives by A
+            # annihilate; A-skips become B-deletes of base content.
+            for am in covered:
+                if "skip" in am:
+                    d = delete(am["skip"])
+                    if "moveOut" in bm:
+                        d = {"moveOut": am["skip"], "id": bm["id"]}
+                    if "content" in bm:
+                        d["content"] = None  # re-captured on apply
+                    out.append(d)
+                elif "insert" in am or "revive" in am:
+                    pass  # created by A, destroyed by B: net nothing
+                else:
+                    out.append(am)
+            if "moveOut" in bm:
+                # mark id stays live for the paired moveIn
+                pass
+    # Remaining A-marks pass through.
+    while ai < len(a):
+        out.append(a[ai])
+        ai += 1
+    return normalize(out)
+
+
+# --------------------------------------------------------------------------
+# rebase
+# --------------------------------------------------------------------------
+
+
+def rebase_marks(a: MarkList, base: MarkList, base_first: bool = True) -> MarkList:
+    """rebase(A over B): rewrite A (authored against state S) to apply
+    after B (also authored against S) — rebase.ts. Walks both streams
+    in S's input space:
+
+    - base inserts/revives/moveIns shift A's positions (becoming skips
+      in A's frame); at the same position, base content goes FIRST
+      when `base_first` (the sequenced-earlier op wins the spot);
+    - base deletes/moveOuts drop that input range from A's frame: A's
+      edits of deleted nodes are MUTED (dropped), and A's inserts
+      inside the range slide to the range start.
+    """
+    a = [dict(m) for m in normalize(a)]
+    base = [dict(m) for m in normalize(base)]
+    out: MarkList = []
+    ai = 0
+    a_rem = a[ai] if a else None
+
+    def next_a():
+        nonlocal ai, a_rem
+        ai += 1
+        a_rem = a[ai] if ai < len(a) else None
+
+    def emit_zero_input_a():
+        """Flush A-marks that consume no input (inserts at the current
+        position) — called before base content claims the spot when A
+        should go first."""
+        nonlocal a_rem
+        while a_rem is not None and _input_len(a_rem) == 0:
+            out.append(a_rem)
+            next_a()
+
+    for bm in base:
+        if "insert" in bm or "revive" in bm or "moveIn" in bm:
+            if not base_first:
+                emit_zero_input_a()
+            out.append(skip(_output_len(bm, None) if "moveIn" not in bm
+                            else bm.get("count", 0)))
+            continue
+        n = _input_len(bm)
+        is_del = "delete" in bm or "moveOut" in bm
+        # Walk n input nodes of A's stream against this base mark.
+        while n > 0:
+            if a_rem is None:
+                if not is_del:
+                    out.append(skip(n))
+                n = 0
+                break
+            al = _input_len(a_rem)
+            if al == 0:
+                # A-insert inside the range: survives (slides to the
+                # current position).
+                out.append(a_rem)
+                next_a()
+                continue
+            step = min(al, n)
+            if al > step:
+                first, rest = _split(a_rem, step, by_input=True)
+                cur = first
+                a[ai] = rest
+                a_rem = rest
+            else:
+                cur = a_rem
+                next_a()
+            if is_del:
+                pass  # muted: the nodes A touched no longer exist
+            else:
+                out.append(cur)
+            n -= step
+    # Remaining A-marks apply beyond base's touched prefix.
+    while a_rem is not None:
+        out.append(a_rem)
+        next_a()
+    return normalize(out)

@@ -35,6 +35,7 @@ class SharedTree(SharedObject):
         self.forest = Forest()
         self.edits = EditManager(self.forest, session=None)
         self.id_compressor = IdCompressor(session_id=f"detached-{id(self)}")
+        self.schema = None  # TreeSchema; rides ops + summary
 
     def on_connected(self) -> None:
         cid = self.runtime.client_id
@@ -80,10 +81,97 @@ class SharedTree(SharedObject):
         """Submit a multi-op changeset as one atomic commit."""
         self._commit(change, id_count)
 
+    # ------------------------------------------------------ schema / views
+
+    def set_schema(self, schema) -> None:
+        """Install a document schema on every replica (the reference
+        stores schema as shared data edited through schema changes —
+        feature-libraries/modular-schema)."""
+        self.schema = schema
+        if self.edits.session is not None and self.services is not None:
+            self.submit_local_message(
+                {"schemaChange": schema.to_json()}, None
+            )
+
+    def schema_check_insert(self, parent_path, field, content) -> None:
+        """Validate an insert against BOTH the inserted nodes' own
+        schema and the target field's schema (allowed types, field
+        existence, cardinality)."""
+        if self.schema is None:
+            return
+        errors = []
+        # Target-field checks.
+        if not parent_path:
+            fs = self.schema.root if field == "root" else None
+        else:
+            parent = self.forest.node_at(parent_path)
+            ptype = (parent or {}).get("type")
+            ns = self.schema.nodes.get(ptype) if ptype else None
+            fs = ns.fields.get(field) if ns else None
+            if ns is not None and fs is None:
+                errors.append(
+                    f"field {field!r} not in schema of {ptype!r}"
+                )
+        if fs is not None:
+            for i, node in enumerate(content):
+                if fs.types is not None and node.get("type") not in fs.types:
+                    errors.append(
+                        f"insert[{i}]: type {node.get('type')!r} not "
+                        f"allowed in field {field!r} (want {fs.types})"
+                    )
+            if fs.kind in ("value", "optional"):
+                parent = self.forest.node_at(parent_path) if parent_path else self.forest.root
+                existing = len((parent or {}).get("fields", {}).get(field, []))
+                limit = 1
+                if existing + len(content) > limit:
+                    errors.append(
+                        f"field {field!r} ({fs.kind}) would hold "
+                        f"{existing + len(content)} children"
+                    )
+        # Inserted-subtree checks.
+        for i, node in enumerate(content):
+            self.schema.validate_node(node, errors, f"insert[{i}]")
+        if errors:
+            raise ValueError("schema violation: " + "; ".join(errors))
+
+    def validate(self):
+        """Whole-document schema check; returns a list of errors."""
+        if self.schema is None:
+            return []
+        return self.schema.validate(self.forest.root)
+
+    def node(self, path):
+        """Typed editable view of a node (editable-tree proxy)."""
+        from .schema import NodeView
+
+        return NodeView(self, list(path))
+
+    def root_field(self, name: str):
+        from .schema import FieldView
+
+        return FieldView(self, [], name)
+
+    def branch(self):
+        """Fork an isolated branch (shared-tree-core/branch.ts:50)."""
+        from .branch import SharedTreeBranch
+
+        return SharedTreeBranch(self)
+
     # ------------------------------------------------------------ inbound
 
     def process_core(self, msg: SequencedMessage, local: bool, local_metadata: Any) -> None:
         op = msg.contents
+        if "schemaChange" in op:
+            # Schema edits are not tree commits: they don't enter the
+            # EditManager; last-writer-wins in SEQUENCE order — which
+            # means the local echo must re-apply too (a concurrent
+            # remote schema may have overwritten ours in between; our
+            # sequenced-later op wins on every replica including us).
+            from .schema import TreeSchema
+
+            self.schema = TreeSchema.from_json(op["schemaChange"])
+            self.emit("schemaChanged", local)
+            return
         if local:
             commit = self.edits.ack_local(msg.sequence_number)
             if op.get("idCount"):
@@ -106,6 +194,9 @@ class SharedTree(SharedObject):
         """Reconnect: the local branch is already maintained in
         current-trunk coordinates by integrate_remote, so the pending
         commit resubmits with its change as now rebased."""
+        if isinstance(content, dict) and "schemaChange" in content:
+            self.submit_local_message(content, None)
+            return
         commit = local_metadata
         if commit is None or all(c is not commit for c in self.edits.local):
             return  # sequenced during catch-up
@@ -120,6 +211,12 @@ class SharedTree(SharedObject):
         )
 
     def apply_stashed_op(self, content: Any) -> Any:
+        if isinstance(content, dict) and "schemaChange" in content:
+            from .schema import TreeSchema
+
+            self.schema = TreeSchema.from_json(content["schemaChange"])
+            self.submit_local_message(content, None)
+            return None
         self._commit(content["change"], content.get("idCount", 0))
         return None
 
@@ -148,6 +245,10 @@ class SharedTree(SharedObject):
             )
             .add_json_blob("forest", self.forest.to_json())
             .add_json_blob("idCompressor", self.id_compressor.serialize())
+            .add_json_blob(
+                "schema",
+                self.schema.to_json() if self.schema is not None else None,
+            )
             .summary
         )
 
@@ -169,6 +270,12 @@ class SharedTree(SharedObject):
             json.loads(storage.read("idCompressor")),
             session_id=self.id_compressor.session_id,
         )
+        if storage.contains("schema"):
+            schema_json = json.loads(storage.read("schema"))
+            if schema_json is not None:
+                from .schema import TreeSchema
+
+                self.schema = TreeSchema.from_json(schema_json)
 
 
 class SharedTreeFactory(ChannelFactory):

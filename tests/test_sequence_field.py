@@ -1,0 +1,167 @@
+"""Sequence-field mark calculus: law-based fuzz (the
+verifyChangeRebaser contract, core/rebase/verifyChangeRebaser.ts) plus
+targeted mark-algebra cases (sequence-field/{rebase,compose,invert}.ts
+semantics: shifts, mutes, slides, moves)."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.tree.sequence_field import (
+    apply_marks,
+    compose_marks,
+    delete,
+    insert,
+    invert_marks,
+    move_in,
+    move_out,
+    normalize,
+    rebase_marks,
+    skip,
+)
+
+
+def rand_marks(rng: random.Random, seq_len: int, allow_moves: bool = False):
+    """A random well-formed mark stream over a sequence of seq_len."""
+    marks = []
+    i = 0
+    mid = 0
+    while i < seq_len:
+        r = rng.random()
+        if r < 0.35:
+            n = rng.randint(1, min(3, seq_len - i))
+            marks.append(skip(n))
+            i += n
+        elif r < 0.55:
+            marks.append(insert([f"n{rng.randint(0, 99)}"
+                                 for _ in range(rng.randint(1, 3))]))
+        elif r < 0.8:
+            n = rng.randint(1, min(3, seq_len - i))
+            marks.append(delete(n))
+            i += n
+        elif allow_moves and seq_len - i >= 1:
+            n = rng.randint(1, min(2, seq_len - i))
+            marks.append(move_out(n, f"m{mid}"))
+            marks.append(move_in(f"m{mid}"))
+            mid += 1
+            i += n
+        else:
+            n = rng.randint(1, min(3, seq_len - i))
+            marks.append(skip(n))
+            i += n
+    if rng.random() < 0.5:
+        marks.append(insert(["tail"]))
+    return marks
+
+
+def seq(n):
+    return [f"s{i}" for i in range(n)]
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_compose_law(seed):
+    """apply(apply(s, A), B) == apply(s, compose(A, B))."""
+    rng = random.Random(seed)
+    s = seq(rng.randint(0, 10))
+    a = rand_marks(rng, len(s))
+    mid = apply_marks(s, a)
+    b = rand_marks(rng, len(mid))
+    direct = apply_marks(mid, b)
+    composed = apply_marks(s, compose_marks(a, b))
+    assert direct == composed, f"compose law failed (seed {seed})"
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_invert_law(seed):
+    """apply(apply(s, A), invert(A)) == s (after capture)."""
+    rng = random.Random(seed)
+    s = seq(rng.randint(0, 10))
+    a = rand_marks(rng, len(s), allow_moves=True)
+    applied = apply_marks(s, a)  # captures delete content in-place
+    back = apply_marks(applied, invert_marks(a))
+    assert back == s, f"invert law failed (seed {seed})"
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_rebase_identity_and_composition_laws(seed):
+    """rebase(A, []) == A and
+    rebase(A, compose(B, C)) ~ rebase(rebase(A, B), C) (same effect)."""
+    rng = random.Random(seed)
+    s = seq(rng.randint(1, 10))
+    a = rand_marks(rng, len(s))
+    assert normalize(rebase_marks(a, [])) == normalize(a)
+
+    b = rand_marks(rng, len(s))
+    after_b = apply_marks(s, b)
+    c = rand_marks(rng, len(after_b))
+    after_bc = apply_marks(after_b, c)
+
+    iterated = rebase_marks(rebase_marks(a, b), c)
+    composed = rebase_marks(a, compose_marks(b, c))
+    # The law holds on EFFECT (states can admit several normal forms).
+    assert apply_marks(after_bc, iterated) == apply_marks(after_bc, composed), (
+        f"rebase-composition law failed (seed {seed})"
+    )
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_concurrent_convergence(seed):
+    """Both replicas converge: state after [B, rebase(A over B)] is the
+    same whether computed by A's author or B's author."""
+    rng = random.Random(seed)
+    s = seq(rng.randint(1, 10))
+    a = rand_marks(rng, len(s))
+    b = rand_marks(rng, len(s))
+    # B sequenced first; A rebases over B.
+    b_applied = apply_marks(s, [dict(m) for m in b])
+    final_1 = apply_marks(b_applied, rebase_marks(a, b, base_first=True))
+    # Recompute on another replica from scratch: identical inputs must
+    # give identical output (determinism).
+    b_applied_2 = apply_marks(s, [dict(m) for m in b])
+    final_2 = apply_marks(b_applied_2, rebase_marks(a, b, base_first=True))
+    assert final_1 == final_2
+
+
+def test_rebase_shift_over_insert():
+    # A inserts at index 2; base inserted 2 nodes at index 0.
+    a = [skip(2), insert(["x"])]
+    base = [insert(["p", "q"])]
+    out = rebase_marks(a, base)
+    assert apply_marks(["a", "b", "c"], base) == ["p", "q", "a", "b", "c"]
+    assert apply_marks(["p", "q", "a", "b", "c"], out) == [
+        "p", "q", "a", "b", "x", "c"]
+
+
+def test_rebase_same_position_base_first():
+    a = [insert(["mine"])]
+    base = [insert(["theirs"])]
+    out = rebase_marks(a, base, base_first=True)
+    assert apply_marks(["theirs"], out) == ["theirs", "mine"]
+    out2 = rebase_marks(a, base, base_first=False)
+    assert apply_marks(["theirs"], out2) == ["mine", "theirs"]
+
+
+def test_rebase_mute_over_delete():
+    # A deletes node 1; base already deleted nodes 0-1: A's delete mutes.
+    a = [skip(1), delete(1)]
+    base = [delete(2)]
+    out = rebase_marks(a, base)
+    assert apply_marks(["c"], out) == ["c"]  # nothing left to delete
+
+
+def test_rebase_insert_slides_to_deleted_range_start():
+    # A inserts inside a range base deleted: lands at the range start.
+    a = [skip(2), insert(["x"]), skip(1)]
+    base = [skip(1), delete(2)]
+    out = rebase_marks(a, base)
+    assert apply_marks(["s0"], out) == ["s0", "x"]
+
+
+def test_move_roundtrip():
+    s = ["a", "b", "c", "d"]
+    marks = [move_out(2, "m1"), skip(2), move_in("m1")]
+    moved = apply_marks(s, marks)
+    assert moved == ["c", "d", "a", "b"]
+    assert apply_marks(moved, invert_marks(marks)) == s
