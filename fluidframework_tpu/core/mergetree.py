@@ -635,6 +635,57 @@ class MergeTreeEngine:
 
     # --------------------------------------------------- local references
 
+    def verify_invariants(self) -> None:
+        """Exhaustive structural verification (opt-in, the role of the
+        reference's PartialSequenceLengths verifier option,
+        partialLengths.ts:336): raises AssertionError on any violated
+        invariant. O(segments * pending) — test/debug use only."""
+        seg_ids = {id(s) for s in self.segments}
+        assert self.min_seq <= self.current_seq, "minSeq above currentSeq"
+        for i, s in enumerate(self.segments):
+            assert len(s) > 0, f"segment {i}: empty content"
+            if s.removed_seq is None:
+                assert not s.removed_clients, f"segment {i}: removers without removal"
+            else:
+                if s.removed_seq == UNASSIGNED_SEQ:
+                    assert s.local_removed_seq is not None or s.groups, (
+                        f"segment {i}: pending removal without local state"
+                    )
+                else:
+                    assert s.removed_clients, f"segment {i}: removal without removers"
+                    assert s.removed_seq >= s.seq or s.seq == UNASSIGNED_SEQ, (
+                        f"segment {i}: removed before inserted"
+                    )
+            if s.seq == UNASSIGNED_SEQ:
+                assert s.client_id == self.local_client_id, (
+                    f"segment {i}: pending insert by foreign client {s.client_id}"
+                )
+            for g in s.groups:
+                assert any(g is p for p in self.pending), (
+                    f"segment {i}: group not in pending FIFO"
+                )
+            for r in s.refs:
+                assert r.segment is s, f"segment {i}: foreign ref"
+                assert 0 <= r.offset <= len(s), f"segment {i}: ref offset oob"
+        for g in self.pending:
+            for s in g.segments:
+                assert id(s) in seg_ids, "pending group cites a ghost segment"
+        # Cross-check: the visible length at the local head must equal
+        # the materialized text length (an INDEPENDENT computation:
+        # get_text walks removal state, visible_length walks the
+        # perspective predicate).
+        assert self.visible_length(
+            self.current_seq, self.local_client_id
+        ) == len(self.get_text()), "visible length != materialized text"
+        # And perspectives are monotone: content visible at the MSN
+        # perspective can never exceed the head perspective plus
+        # pending local growth.
+        head = self.visible_length(self.current_seq, self.local_client_id)
+        for s in self.segments:
+            cat, ln = self._vis(s, self.current_seq, self.local_client_id)
+            assert ln <= len(s), "visibility length exceeds content"
+        assert head >= 0
+
     def anchor_at(
         self, pos: int, ref_seq: int, client_id: int
     ) -> LocalReference:

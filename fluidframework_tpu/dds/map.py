@@ -275,9 +275,12 @@ class SubDirectory:
 
     def delete_subdirectory(self, name: str) -> bool:
         existed = name in self.subdirs
-        self.subdirs.pop(name, None)
+        removed = self.subdirs.pop(name, None)
+        # The removed subtree rides as local metadata so a rollback
+        # (orderSequentially abort) can reattach it intact.
         self._shared._submit_subdir_op(
-            {"type": "deleteSubDirectory", "path": self.path, "subdirName": name}
+            {"type": "deleteSubDirectory", "path": self.path, "subdirName": name},
+            removed,
         )
         return existed
 
@@ -349,8 +352,8 @@ class SharedDirectory(SharedObject):
     def _submit_storage_op(self, path: str, op: dict, md: Any = None) -> None:
         self.submit_local_message({**op, "path": path}, md)
 
-    def _submit_subdir_op(self, op: dict) -> None:
-        self.submit_local_message(op)
+    def _submit_subdir_op(self, op: dict, local_metadata=None) -> None:
+        self.submit_local_message(op, local_metadata)
 
     def _resolve(self, path: str, create: bool = False) -> Optional[SubDirectory]:
         node = self.root
@@ -411,7 +414,11 @@ class SharedDirectory(SharedObject):
             if parent is not None:
                 parent.subdirs.pop(op["subdirName"], None)
         elif kind == "deleteSubDirectory":
-            raise NotImplementedError("deleteSubDirectory rollback")
+            # Reattach the subtree captured at submit time (it kept
+            # its kernels and children; nothing observed the gap).
+            parent = self._resolve(op["path"])
+            if parent is not None and local_metadata is not None:
+                parent.subdirs[op["subdirName"]] = local_metadata
         else:
             node = self._resolve(op["path"])
             if node is not None:

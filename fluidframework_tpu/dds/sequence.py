@@ -200,9 +200,28 @@ class SharedSegmentSequence(SharedObject):
             self.engine.ack(seq)
 
     def apply_stashed_op(self, content: Any) -> Any:
-        op = content["op"] if content["kind"] == "seq" else None
-        if op is None:
-            raise NotImplementedError("stashed interval ops")
+        if content["kind"] == "intervals":
+            # Re-apply as a fresh pending local interval op. The
+            # original id is safe to keep: it embeds the stashed
+            # session's client id (collision-free with this session's
+            # fresh ids) and never sequenced anywhere.
+            coll = self.get_interval_collection(content["collection"])
+            iop = content["op"]
+            kind = iop["type"]
+            if kind == "add":
+                s_ref, e_ref = coll._anchor_local(iop["start"], iop["end"])
+                coll.intervals[iop["id"]] = SequenceInterval(
+                    iop["id"], s_ref, e_ref, dict(iop.get("props") or {})
+                )
+                coll._pending[iop["id"]] = coll._pending.get(iop["id"], 0) + 1
+                coll._submit(dict(iop))
+            elif kind == "change":
+                if iop["id"] in coll.intervals:
+                    coll.change(iop["id"], iop["start"], iop["end"])
+            elif kind == "delete":
+                coll.remove_interval_by_id(iop["id"])
+            return None
+        op = content["op"]
         if isinstance(op, dict):
             op = op_from_json(op)
         # Re-apply as a fresh pending local op (client.ts:831

@@ -407,3 +407,31 @@ apply_op_batch_docs = jax.vmap(apply_op_batch)
 @functools.partial(jax.jit, donate_argnums=0)
 def apply_op_batch_docs_jit(tables: SegmentTable, ops: OpBatch) -> SegmentTable:
     return apply_op_batch_docs(tables, ops)
+
+
+def verify_table_invariants(host_table: dict, capacity: int) -> None:
+    """Exhaustive host-side verification of an unpacked SegmentTable
+    (the partialLengths.ts:336 verifier role for the kernel path):
+    raises AssertionError on violations. Test/debug opt-in."""
+    import numpy as np
+
+    n = host_table["n_rows"]
+    assert 0 <= n <= capacity, f"n_rows {n} out of range"
+    length = host_table["length"][:n]
+    rem_seq = host_table["rem_seq"][:n]
+    rem_clients = host_table["rem_clients"][:n]
+    ins_seq = host_table["ins_seq"][:n]
+    assert (length > 0).all(), "zero/negative-length live row"
+    removed = rem_seq != NOT_REMOVED
+    has_removers = (rem_clients != NO_CLIENT).any(axis=1)
+    assert (removed == has_removers).all(), "removal/remover mismatch"
+    # Remover slots fill left-to-right (first-free-slot append).
+    free = rem_clients == NO_CLIENT
+    first_free = np.argmax(free, axis=1)
+    for k in range(rem_clients.shape[1]):
+        after_free = free.any(axis=1) & (k > first_free)
+        bad = after_free & (rem_clients[:, k] != NO_CLIENT)
+        assert not bad.any(), "remover slot gap"
+    assert (rem_seq[removed] >= ins_seq[removed]).all(), (
+        "removed before inserted"
+    )

@@ -116,6 +116,9 @@ class ContainerRuntime(EventEmitter):
         self.gc = None
         # BlobManager (attach_blob_manager); its state rides the summary.
         self.blobs = None
+        # Per-channel last-change sequence numbers (the summarizerNode
+        # dirty-tracking input): updated on every routed channel op.
+        self.channel_change_seq: Dict[tuple, int] = {}
 
     def attach_gc(self, sweep_grace: int = 0):
         """Enable garbage collection for this container (the reference
@@ -526,6 +529,9 @@ class ContainerRuntime(EventEmitter):
                 return
             raise KeyError(f"op addressed to unknown node {node}")
         ds.process(inner["address"], _reshape(msg, inner["contents"]), local, local_metadata)
+        self.channel_change_seq[(outer["address"], inner["address"])] = (
+            msg.sequence_number
+        )
         self._emit("op", msg, local)
         if not self.is_dirty:
             self._emit("saved")
@@ -578,10 +584,13 @@ class ContainerRuntime(EventEmitter):
         if ds.client_id is not None:
             ch.on_connected()
 
-    def summarize(self) -> SummaryTree:
+    def summarize(self, cache=None) -> SummaryTree:
         """Container summary: one subtree per datastore under
         ".channels", plus runtime metadata (the shape of reference
-        ContainerRuntime.summarize / summaryFormat.md).
+        ContainerRuntime.summarize / summaryFormat.md). With `cache`
+        (a SummarizerNodeCache held by the summarizer), unchanged
+        channels reuse their previously serialized subtrees — the
+        reference's incremental summarizerNode behavior.
 
         Refuses while local changes are unacked: pending state (e.g. a
         merge-tree segment at UNASSIGNED_SEQ) is not summarizable — the
@@ -594,7 +603,7 @@ class ContainerRuntime(EventEmitter):
         builder = SummaryTreeBuilder()
         channels = SummaryTreeBuilder()
         for did, ds in self.datastores.items():
-            channels.add_tree(did, ds.summarize())
+            channels.add_tree(did, ds.summarize(cache=cache))
         builder.add_tree(".channels", channels.summary)
         builder.add_json_blob(
             ".metadata",

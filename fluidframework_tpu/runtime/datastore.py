@@ -137,12 +137,26 @@ class DataStoreRuntime:
 
     # ---------------------------------------------------------- summaries
 
-    def summarize(self) -> SummaryTree:
+    def summarize(self, cache=None) -> SummaryTree:
         """Per-channel subtrees + attributes blobs (the shape
         FluidDataStoreRuntime.summarize produces from channel
-        summarizeCore outputs)."""
+        summarizeCore outputs). With `cache`, channels unchanged since
+        the cache's recorded sequence reuse their serialized subtree
+        (summarizerNode dirty tracking)."""
         builder = SummaryTreeBuilder()
+        change_seqs = (
+            self.container.channel_change_seq
+            if self.container is not None
+            else {}
+        )
         for cid, ch in self.channels.items():
+            key = (self.id, cid)
+            change_seq = change_seqs.get(key, 0)
+            if cache is not None:
+                hit = cache.lookup(key, change_seq)
+                if hit is not None:
+                    builder.add_tree(cid, hit)
+                    continue
             sub = ch.get_attach_summary()
             sub.add_blob(
                 ATTRIBUTES_BLOB,
@@ -153,6 +167,8 @@ class DataStoreRuntime:
                     }
                 ),
             )
+            if cache is not None:
+                cache.store(key, change_seq, sub)
             builder.add_tree(cid, sub)
         return builder.summary
 

@@ -124,3 +124,34 @@ class SummaryTreeBuilder:
     @property
     def summary(self) -> SummaryTree:
         return self._tree
+
+
+class SummarizerNodeCache:
+    """Incremental-summary dirty tracking (the reference's
+    summarizerNode subsystem, container-runtime/src/summary/
+    summarizerNode/): the summarizer holds this across summaries; a
+    channel whose last-change sequence number is unchanged since the
+    previous summary REUSES its serialized subtree instead of
+    re-running summarizeCore. `reused`/`reserialized` count the last
+    summarize pass (observability + tests)."""
+
+    def __init__(self):
+        # (datastore_id, channel_id) -> (change_seq, subtree)
+        self.entries: Dict[Tuple[str, str], Tuple[int, "SummaryTree"]] = {}
+        self.reused = 0
+        self.reserialized = 0
+
+    def begin_pass(self) -> None:
+        self.reused = 0
+        self.reserialized = 0
+
+    def lookup(self, key, change_seq):
+        hit = self.entries.get(key)
+        if hit is not None and hit[0] == change_seq:
+            self.reused += 1
+            return hit[1]
+        return None
+
+    def store(self, key, change_seq, subtree) -> None:
+        self.reserialized += 1
+        self.entries[key] = (change_seq, subtree)

@@ -89,6 +89,11 @@ class SummaryManager:
         self.collection = SummaryCollection(runtime)
         self._ops_since_ack = 0
         self._summary_in_flight = False
+        # Incremental summaries: unchanged channels reuse serialized
+        # subtrees across this manager's summaries (summarizerNode).
+        from .summary import SummarizerNodeCache
+
+        self.node_cache = SummarizerNodeCache()
         runtime.on("op", self._count)
         self.collection.on("ack", self._on_ack)
         self.collection.on("nack", self._on_nack)
@@ -100,6 +105,9 @@ class SummaryManager:
     def _on_ack(self, contents: dict) -> None:
         self._ops_since_ack = 0
         self._summary_in_flight = False
+        # node_cache survives acks deliberately: entries are keyed by
+        # change-seq and stay valid, which is what makes the NEXT
+        # summary incremental.
 
     def _on_nack(self, contents: dict) -> None:
         self._summary_in_flight = False  # retry on next heuristic pass
@@ -128,7 +136,8 @@ class SummaryManager:
         reference runs collectGarbage inside submitSummary)."""
         if self.runtime.gc is not None:
             self.runtime.gc.collect()
-        wire = self.runtime.summarize().to_json()
+        self.node_cache.begin_pass()
+        wire = self.runtime.summarize(cache=self.node_cache).to_json()
         handle = self.storage.upload_summary(wire)
         self._summary_in_flight = True
         self.runtime.submit_system_message(

@@ -26,6 +26,9 @@ class FarmConfig:
     rounds: int = 20
     ops_per_client_per_round: int = 4
     seed: int = 0
+    # Run MergeTreeEngine.verify_invariants on every replica each
+    # round (the exhaustive partialLengths.ts:336 verifier; slow).
+    verify_invariants_every: int = 0
     insert_weight: float = 0.5
     remove_weight: float = 0.3
     annotate_weight: float = 0.2
@@ -116,6 +119,12 @@ def run_sharedstring_farm(cfg: FarmConfig) -> FarmResult:
             assert all(s == spans[0] for s in spans), (
                 f"round {rnd}: divergent annotations (seed {cfg.seed})"
             )
+        if (
+            cfg.verify_invariants_every
+            and (rnd + 1) % cfg.verify_invariants_every == 0
+        ):
+            for c in clients:
+                c.engine.verify_invariants()
     return FarmResult(
         final_text=clients[0].get_text(), stream=stream, clients=clients
     )

@@ -56,3 +56,119 @@ def test_conflict_farm_remove_heavy():
             initial_text="the quick brown fox jumps over the lazy dog",
         )
     )
+
+
+# ------------------------------------------------------- scaled matrices
+
+@pytest.mark.parametrize("seed", range(3))
+def test_farm_16_clients_hundreds_of_rounds(seed):
+    """The reference's conflict-farm scale (client.conflictFarm.spec.ts
+    runs up to 32 clients x hundreds of rounds): 16 clients, 150
+    rounds, with the exhaustive invariant verifier sampling every 25
+    rounds (partialLengths.ts:336 verifier role)."""
+    run_sharedstring_farm(
+        FarmConfig(
+            num_clients=16,
+            rounds=150,
+            ops_per_client_per_round=2,
+            seed=100 + seed,
+            verify_invariants_every=25,
+        )
+    )
+
+
+def test_farm_invariant_verifier_catches_corruption():
+    """The verifier must actually detect broken state."""
+    from fluidframework_tpu.core.mergetree import CollabClient
+
+    c = CollabClient(1, initial="hello")
+    c.engine.segments[0].removed_clients.append(9)  # remover w/o removal
+    with pytest.raises(AssertionError):
+        c.engine.verify_invariants()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_stash_resume_farm(seed):
+    """Container-level farm with random close/stash/resume cycles
+    (the applyStashedOpFarm shape, client.applyStashedOpFarm.spec.ts)."""
+    import random as _random
+
+    from fluidframework_tpu.dds import MapFactory, StringFactory
+    from fluidframework_tpu.drivers import LocalDriver
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.server import LocalServer
+
+    rng = _random.Random(seed)
+    registry = ChannelRegistry([MapFactory(), StringFactory()])
+    loader = Loader(LocalDriver(LocalServer()), registry)
+    c0 = loader.create_detached()
+    ds = c0.runtime.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    c0.runtime.get_datastore("default").get_channel("s").insert_text(0, "seed")
+    doc = c0.attach()
+    containers = [c0] + [loader.resolve(doc) for _ in range(2)]
+
+    def s(c):
+        return c.runtime.get_datastore("default").get_channel("s")
+
+    for _ in range(10):
+        for i, c in enumerate(list(containers)):
+            n = len(s(c).get_text())
+            for _ in range(rng.randint(0, 2)):
+                r = rng.random()
+                if r < 0.6 or n == 0:
+                    s(c).insert_text(rng.randint(0, n), rng.choice("xyz"))
+                    n += 1
+                else:
+                    k = rng.randint(0, n - 1)
+                    s(c).remove_range(k, k + 1)
+                    n -= 1
+            if rng.random() < 0.3:
+                # Close with pending state; resume as a new session.
+                state = c.close_and_get_pending_state()
+                containers[i] = loader.resolve(doc, pending_state=state)
+            else:
+                c.flush()
+        for c in containers:
+            c.flush()
+    texts = {s(c).get_text() for c in containers}
+    assert len(texts) == 1, f"divergence (seed {seed}): {texts}"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_rollback_farm(seed):
+    """Random orderSequentially aborts interleaved with normal edits
+    (the rollbackFarm shape, client.rollbackFarm.spec.ts): aborted
+    work must leave no trace and replicas must converge."""
+    import random as _random
+
+    from fluidframework_tpu.dds import MapFactory, StringFactory
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+    rng = _random.Random(seed)
+    registry = ChannelRegistry([MapFactory(), StringFactory()])
+    h = MultiClientHarness(3, registry, channel_types=[("m", MapFactory.type_name)])
+
+    def m(i):
+        return h.runtimes[i].get_datastore("default").get_channel("m")
+
+    for rnd in range(20):
+        for i in range(3):
+            if rng.random() < 0.35:
+                try:
+                    def tx(i=i, rnd=rnd):
+                        m(i).set(f"tx{rnd}", i)
+                        m(i).delete(f"k{rng.randint(0, 5)}")
+                        raise RuntimeError("abort")
+                    h.runtimes[i].order_sequentially(tx)
+                except RuntimeError:
+                    pass
+            m(i).set(f"k{rng.randint(0, 5)}", rng.randint(0, 99))
+        h.process_all()
+    views = [
+        {k: m(i).get(k) for k in sorted(m(i).keys())} for i in range(3)
+    ]
+    assert views[0] == views[1] == views[2]
+    assert not any(k.startswith("tx") for k in views[0])

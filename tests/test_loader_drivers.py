@@ -177,3 +177,52 @@ def test_fault_injection_submit_failures():
     with pytest.raises(ConnectionError, match="injected"):
         c1.runtime.flush()
     fdriver.submits_fail = False
+
+def test_stashed_interval_ops_resume():
+    """Stashed interval-collection ops re-apply on resume (the
+    applyStashedOp path the round-1 snapshot left NotImplemented)."""
+    loader, server = make_loader()
+    c1 = seed_container(loader)
+    chan(c1).insert_text(0, "hello world")
+    doc = c1.attach()
+    c2 = loader.resolve(doc)
+
+    coll = chan(c1).get_interval_collection("comments")
+    iv = coll.add(0, 5, {"author": "me"})
+    state = c1.close_and_get_pending_state()
+
+    c3 = loader.resolve(doc, pending_state=state)
+    coll3 = chan(c3).get_interval_collection("comments")
+    assert iv.interval_id in coll3.intervals
+    assert coll3.intervals[iv.interval_id].props == {"author": "me"}
+    # The resubmitted op reached the other replica too.
+    coll2 = chan(c2).get_interval_collection("comments")
+    assert iv.interval_id in coll2.intervals
+    assert not c3.is_dirty
+
+
+def test_delete_subdirectory_rollback():
+    """orderSequentially abort restores a deleted subdirectory tree
+    (round-1 NotImplementedError path in dds/map.py)."""
+    from fluidframework_tpu.dds import DirectoryFactory
+
+    registry = ChannelRegistry([DirectoryFactory()])
+    loader = Loader(LocalDriver(LocalServer()), registry)
+    c1 = loader.create_detached()
+    ds = c1.runtime.create_datastore("default")
+    d = ds.create_channel("d", DirectoryFactory.type_name)
+    c1.attach()
+    sub = d.root.create_subdirectory("config")
+    sub.set("mode", "fast")
+    sub.create_subdirectory("nested").set("deep", 1)
+    c1.flush()
+
+    with pytest.raises(RuntimeError, match="abort"):
+        def tx():
+            d.root.delete_subdirectory("config")
+            raise RuntimeError("abort")
+        c1.runtime.order_sequentially(tx)
+    restored = d.root.get_subdirectory("config")
+    assert restored is not None
+    assert restored.get("mode") == "fast"
+    assert restored.get_subdirectory("nested").get("deep") == 1

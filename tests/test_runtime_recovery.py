@@ -171,3 +171,4 @@ def test_stale_refseq_nack_disconnects_then_reconnect_replays():
     assert b.get("y") == 2 and b.get("offline") == 3
     assert a.get("y") == 2 and a.get("x") == 1
     assert not rt.is_dirty
+

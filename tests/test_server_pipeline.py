@@ -179,3 +179,49 @@ def test_checkpoint_restore_preserves_quorum_and_protocol():
     assert 1 in proto.quorum
     # MSN == head with one client at head, so the proposal committed.
     assert proto.proposals.get("k") == "v"
+
+
+def test_incremental_summary_reserializes_only_touched_channel():
+    """summarizerNode dirty tracking (reference summary/summarizerNode):
+    a 1-op change re-serializes only the touched channel; everything
+    else reuses its cached subtree — and the summary boots correctly."""
+    from fluidframework_tpu.runtime.summary import (
+        SummarizerNodeCache,
+        SummaryTree,
+    )
+
+    server = LocalServer()
+    rt = connect_runtime(
+        server, client_id=1,
+        channels=(("s", StringFactory.type_name),
+                  ("m", MapFactory.type_name),
+                  ("m2", MapFactory.type_name)),
+    )
+    chan(rt, "s").insert_text(0, "seed")
+    chan(rt, "m").set("a", 1)
+    chan(rt, "m2").set("b", 2)
+    rt.flush()
+
+    cache = SummarizerNodeCache()
+    cache.begin_pass()
+    first = rt.summarize(cache=cache)
+    assert cache.reserialized == 3 and cache.reused == 0
+
+    chan(rt, "m").set("a", 99)  # touch ONE channel
+    rt.flush()
+    cache.begin_pass()
+    second = rt.summarize(cache=cache)
+    assert cache.reserialized == 1, "only the touched channel"
+    assert cache.reused == 2
+
+    # The incremental summary boots a correct replica.
+    rt2 = ContainerRuntime(REGISTRY)
+    rt2.load(SummaryTree.from_json(second.to_json()))
+    assert chan(rt2, "m").get("a") == 99
+    assert chan(rt2, "s").get_text() == "seed"
+    assert chan(rt2, "m2").get("b") == 2
+
+    # No changes at all: everything reuses.
+    cache.begin_pass()
+    rt.summarize(cache=cache)
+    assert cache.reserialized == 0 and cache.reused == 3

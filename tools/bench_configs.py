@@ -1,0 +1,205 @@
+"""Benchmark the remaining BASELINE.json configs (1, 3, 4, 5).
+
+The headline bench (bench.py) covers config 2 (1M-op 1024-client
+replay). This tool measures the rest and writes BENCH_DETAIL.json:
+
+- config 1: SharedString 2-client random insert/remove, 10k ops —
+  the interactive client path (host-side merge engine through the
+  sequencer), reference harness mergeTreeOperationRunner.ts.
+- config 3: SharedMatrix 256x256, row/col insert + setCell mix
+  through the production runtime stack (matrix.ts:80 shape).
+- config 4: SharedTree rebase over a trunk window at 100k-node
+  scale — the batched rebase kernel (one XLA dispatch for the whole
+  pending range; editManager.ts:47 / config-4 shape).
+- config 5: deli batch sequencing, 10k docs x 64 clients — the
+  vectorized sequencer kernel (deli/lambda.ts:818 ticket loop).
+
+The TypeScript baselines for these configs cannot be measured in this
+environment: the reference's harnesses need node + a pnpm/lerna
+monorepo install, and no node runtime is present (see BASELINE.md).
+
+Usage: python tools/bench_configs.py  (env: BC_SCALE=1.0 shrink knob)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+
+SCALE = float(os.environ.get("BC_SCALE", "1.0"))
+
+
+def config1_sharedstring_2client(n_ops: int = 10_000) -> dict:
+    from fluidframework_tpu.testing.farm import FarmConfig, run_sharedstring_farm
+
+    n_ops = int(n_ops * SCALE)
+    rounds = max(1, n_ops // (2 * 10))
+    t0 = time.perf_counter()
+    run_sharedstring_farm(
+        FarmConfig(
+            num_clients=2, rounds=rounds, ops_per_client_per_round=10,
+            seed=1, check_annotations=False, annotate_weight=0.0,
+            insert_weight=0.6, remove_weight=0.4,
+        )
+    )
+    dt = time.perf_counter() - t0
+    total = rounds * 2 * 10
+    return {
+        "config": "sharedstring_2client_insert_remove",
+        "ops": total, "seconds": round(dt, 3),
+        "ops_per_sec": round(total / dt, 1),
+    }
+
+
+def config3_matrix(size: int = 256, n_ops: int = 10_000) -> dict:
+    from fluidframework_tpu.dds import MatrixFactory
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+    n_ops = int(n_ops * SCALE)
+    registry = ChannelRegistry([MatrixFactory()])
+    h = MultiClientHarness(
+        2, registry, channel_types=[("mx", MatrixFactory.type_name)]
+    )
+    a = h.runtimes[0].get_datastore("default").get_channel("mx")
+    a.insert_rows(0, size)
+    a.insert_cols(0, size)
+    h.process_all()
+    rng = random.Random(3)
+    t0 = time.perf_counter()
+    done = 0
+    while done < n_ops:
+        r = rng.random()
+        if r < 0.9:
+            a.set_cell(rng.randrange(size), rng.randrange(size), done)
+        elif r < 0.95:
+            a.insert_rows(rng.randrange(a.row_count + 1), 1)
+        else:
+            a.insert_cols(rng.randrange(a.col_count + 1), 1)
+        done += 1
+        if done % 512 == 0:
+            h.process_all()
+    h.process_all()
+    dt = time.perf_counter() - t0
+    b = h.runtimes[1].get_datastore("default").get_channel("mx")
+    assert a.to_dense() == b.to_dense(), "matrix replicas diverged"
+    return {
+        "config": "matrix_256x256_setcell_insert_mix",
+        "ops": n_ops, "seconds": round(dt, 3),
+        "ops_per_sec": round(n_ops / dt, 1),
+    }
+
+
+def config4_tree_rebase(n_pending: int = 100_000, window: int = 64) -> dict:
+    import numpy as np
+
+    from fluidframework_tpu.tree.rebase_kernel import rebase_ops_columnar
+
+    n_pending = int(n_pending * SCALE)
+    rng = np.random.default_rng(4)
+    ops = np.stack(
+        [rng.integers(0, 2, n_pending), rng.integers(0, 100_000, n_pending),
+         rng.integers(1, 4, n_pending)], axis=1,
+    ).astype(np.int32)
+    base = np.stack(
+        [rng.integers(0, 2, window), rng.integers(0, 100_000, window),
+         rng.integers(1, 4, window)], axis=1,
+    ).astype(np.int32)
+    rebase_ops_columnar(ops, base)  # compile
+    t0 = time.perf_counter()
+    out, flagged = rebase_ops_columnar(ops, base)
+    dt = time.perf_counter() - t0
+    rebases = n_pending * window
+    return {
+        "config": "tree_rebase_100k_ops_over_64_commit_window",
+        "pending_ops": n_pending, "window": window,
+        "seconds": round(dt, 4),
+        "op_rebases_per_sec": round(rebases / dt, 1),
+        "flagged_for_scalar_path": int(flagged.sum()),
+    }
+
+
+def config5_deli(n_docs: int = 10_000, n_clients: int = 64,
+                 ops_per_doc: int = 128) -> dict:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops.sequencer_kernel import (
+        SUB_JOIN, SUB_OP, SeqBatch, make_state, sequence_batch_jit,
+    )
+
+    n_docs = max(8, int(n_docs * SCALE))
+    rng = np.random.default_rng(5)
+    # Every doc: joins for all clients, then random ops.
+    kind = np.full((n_docs, ops_per_doc), SUB_OP, np.int32)
+    kind[:, :n_clients] = SUB_JOIN
+    client = rng.integers(0, n_clients, (n_docs, ops_per_doc)).astype(np.int32)
+    client[:, :n_clients] = np.arange(n_clients)[None, :]
+    cseq = np.zeros((n_docs, ops_per_doc), np.int32)
+    # client_seq must be contiguous per (doc, client): compute by count.
+    counts = np.zeros((n_docs, n_clients), np.int32)
+    for j in range(n_clients, ops_per_doc):
+        c = client[:, j]
+        counts[np.arange(n_docs), c] += 1
+        cseq[:, j] = counts[np.arange(n_docs), c]
+    ref = np.zeros((n_docs, ops_per_doc), np.int32)  # refSeq 0 is valid
+    batch = SeqBatch(
+        kind=jnp.asarray(kind), client=jnp.asarray(client),
+        client_seq=jnp.asarray(cseq), ref_seq=jnp.asarray(ref),
+    )
+    state = make_state(n_docs, n_clients)
+    out = sequence_batch_jit(state, batch)
+    jax.block_until_ready(out)  # compile
+    state = make_state(n_docs, n_clients)
+    t0 = time.perf_counter()
+    new_state, res = sequence_batch_jit(state, batch)
+    jax.block_until_ready(res.seq)
+    dt = time.perf_counter() - t0
+    total = n_docs * ops_per_doc
+    return {
+        "config": "deli_batch_sequencing",
+        "docs": n_docs, "clients_per_doc": n_clients,
+        "submissions": total, "seconds": round(dt, 4),
+        "submissions_per_sec": round(total / dt, 1),
+    }
+
+
+def main() -> None:
+    results = []
+    for fn in (config1_sharedstring_2client, config3_matrix,
+               config4_tree_rebase, config5_deli):
+        r = fn()
+        results.append(r)
+        print(json.dumps(r), file=sys.stderr)
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "note": (
+                    "BASELINE.json configs 1/3/4/5; config 2 is bench.py. "
+                    "TS baselines unmeasurable here: no node runtime "
+                    "(see BASELINE.md)."
+                ),
+                "scale": SCALE,
+                "results": results,
+            },
+            f, indent=1,
+        )
+    print(json.dumps({"configs": len(results)}))
+
+
+if __name__ == "__main__":
+    main()
