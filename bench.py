@@ -14,15 +14,16 @@ same workload. A correctness gate first replays a prefix through both
 paths and asserts identical final text (the project's bit-identity
 contract, BASELINE.json north_star).
 
-Compilation is cached persistently (JAX_COMPILATION_CACHE_DIR,
-default <repo>/.jax_cache) — the first-ever run pays Mosaic compiles
-(minutes at the larger table capacities); later runs start warm. The
-warm-up phase pre-compiles the capacity ladder so the timed region
-never compiles.
+The jax persistent compilation cache does not engage on this
+backend (platform "axon" is outside jax's supported-cache list), so
+every process pays the Mosaic compile (~3-4 min for the chunk
+kernel). The bench therefore uses ONE fixed table capacity sized for
+the whole run — the gate replay compiles everything the timed run
+needs, and the timed region never compiles or grows.
 
 Env knobs: BENCH_OPS (default 1_000_000), BENCH_GATE_OPS (20_000),
 BENCH_ORACLE_OPS (20_000), BENCH_CLIENTS (1024), BENCH_CHUNK (2048),
-BENCH_CAPACITY (32768 initial), BENCH_SYNC (4), BENCH_ENGINE (auto).
+BENCH_CAPACITY (131072 fixed), BENCH_SYNC (4), BENCH_ENGINE (auto).
 """
 
 from __future__ import annotations
@@ -37,8 +38,9 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
 )
 
-MAX_CAPACITY = 1 << 17  # ladder ceiling: 131072 rows (~10MB of VMEM tiles;
-#  2x that exceeds the core's VMEM and Mosaic refuses the kernel)
+# 131072 rows (~10MB of VMEM tiles) holds the 1M-op stream's live row
+# count (~90k at the end) with the sync-window margin; 2x that exceeds
+# the core's VMEM and Mosaic refuses the kernel.
 
 
 def main() -> None:
@@ -47,7 +49,7 @@ def main() -> None:
     n_oracle = min(int(os.environ.get("BENCH_ORACLE_OPS", 20_000)), n_ops)
     n_clients = int(os.environ.get("BENCH_CLIENTS", 1024))
     chunk = int(os.environ.get("BENCH_CHUNK", 2048))
-    capacity = int(os.environ.get("BENCH_CAPACITY", 32768))
+    capacity = int(os.environ.get("BENCH_CAPACITY", 131072))
     sync = int(os.environ.get("BENCH_SYNC", 4))
     engine = os.environ.get("BENCH_ENGINE", "auto")
     initial_len = 64
@@ -61,6 +63,19 @@ def main() -> None:
             stream, initial_len=initial_len, chunk_size=chunk,
             capacity=cap, sync_interval=sync, engine=engine,
         )
+
+    # Fail fast if the fixed capacity cannot hold the stream: live
+    # rows grow ~0.1/op on this mix; growth inside the timed region
+    # would recompile (minutes) or exceed VMEM.
+    est_rows = int(n_ops * 0.12) + 2 * chunk * sync + 64
+    if est_rows > capacity:
+        print(
+            f"FATAL: BENCH_CAPACITY={capacity} too small for "
+            f"BENCH_OPS={n_ops} (est. {est_rows} live rows); raise "
+            "BENCH_CAPACITY (multiple of 1024; VMEM caps it at 131072).",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
     print(f"generating {n_ops} ops from {n_clients} clients...", file=sys.stderr)
     stream = generate_stream(
@@ -99,13 +114,12 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # ---- warm the compile caches for every capacity the run can use --
+    # ---- warm-up: compile the chunk kernel + compaction at the run's
+    # exact shapes (the gate used the same capacity, but the main
+    # stream's arena/segment shapes differ; two chunks suffice).
     t0 = time.perf_counter()
-    cap = capacity
-    while cap <= MAX_CAPACITY:
-        w = make_replica(stream, cap)
-        w.replay(limit_chunks=2)
-        cap *= 2
+    w = make_replica(stream)
+    w.replay(limit_chunks=2)
     print(f"warm-up done in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     # ---- kernel replay (timed) ---------------------------------------

@@ -1,0 +1,229 @@
+"""Socket driver: the loader's service adapter over a real TCP boundary.
+
+The client half of server/socket_service.py — the role of the
+reference's `DocumentDeltaConnection` over socket.io
+(drivers/driver-base/src/documentDeltaConnection.ts:42) plus the REST
+storage calls of routerlicious-driver. Every driver call runs over
+newline-delimited JSON frames; the delta connection holds a
+long-lived socket with a reader thread that dispatches pushed "op" /
+"nack" events, while storage/control calls use short-lived sockets.
+
+Semantics match the in-proc drivers: buffered early ops (events that
+arrive before a listener attaches are queued and drained on listener
+assignment), catch_up over the join gap, and disconnect events
+surfacing through disconnect_listener.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+from typing import Any, Callable, List, Optional
+
+from ..drivers.file_driver import message_from_json
+from ..protocol.messages import DocumentMessage, NackMessage, SequencedMessage
+
+
+class _Rpc:
+    """One request/response exchange over a fresh socket."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+
+    def call(self, **req) -> Any:
+        with socket.create_connection((self.host, self.port)) as s:
+            f = s.makefile("rw", encoding="utf-8")
+            req.setdefault("id", 1)
+            f.write(json.dumps(req) + "\n")
+            f.flush()
+            line = f.readline()
+            resp = json.loads(line)
+            if "error" in resp:
+                raise RuntimeError(f"server error: {resp['error']}")
+            return resp["result"]
+
+
+class _SocketConnection:
+    """A live delta connection (long-lived socket + reader thread)."""
+
+    def __init__(self, host: str, port: int, doc_id: str,
+                 client_id: Optional[int]):
+        self._sock = socket.create_connection((host, port))
+        self._file = self._sock.makefile("rw", encoding="utf-8")
+        self._req_id = 0
+        self._pending_resp: dict = {}
+        self._resp_cond = threading.Condition()
+        self._listener: Optional[Callable[[SequencedMessage], None]] = None
+        self.nack_listener: Optional[Callable[[NackMessage], None]] = None
+        self.disconnect_listener: Optional[Callable[[], None]] = None
+        self.connected = False
+        self._early: List[SequencedMessage] = []
+        self._lock = threading.RLock()
+        self._wlock = threading.Lock()
+
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+        info = self._call(cmd="connect", docId=doc_id, clientId=client_id)
+        self.client_id = info["clientId"]
+        self.join_seq = info["joinSeq"]
+        self.connected = True
+
+    # --------------------------------------------------------- framing
+
+    def _call(self, **req) -> Any:
+        with self._resp_cond:
+            self._req_id += 1
+            rid = self._req_id
+        req["id"] = rid
+        data = json.dumps(req) + "\n"
+        with self._wlock:  # reader-thread callbacks may also submit
+            self._file.write(data)
+            self._file.flush()
+        with self._resp_cond:
+            while rid not in self._pending_resp:
+                if not self._reader.is_alive():
+                    raise ConnectionError("socket reader died")
+                self._resp_cond.wait(timeout=10)
+            resp = self._pending_resp.pop(rid)
+        if "error" in resp:
+            raise RuntimeError(f"server error: {resp['error']}")
+        return resp["result"]
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._file:
+                frame = json.loads(line)
+                if "event" in frame:
+                    self._on_event(frame)
+                else:
+                    with self._resp_cond:
+                        self._pending_resp[frame["id"]] = frame
+                        self._resp_cond.notify_all()
+        except (OSError, ValueError):
+            pass
+        finally:
+            was = self.connected
+            self.connected = False
+            with self._resp_cond:
+                self._resp_cond.notify_all()
+            if was and self.disconnect_listener is not None:
+                self.disconnect_listener()
+
+    def _on_event(self, frame: dict) -> None:
+        if frame["event"] == "op":
+            msg = message_from_json(frame["msg"])
+            # Deliver under the lock: serializes against the listener
+            # setter's early-op drain so ops can neither strand in
+            # _early nor overtake buffered older ones.
+            with self._lock:
+                if self._listener is None:
+                    self._early.append(msg)
+                    return
+                self._listener(msg)
+        elif frame["event"] == "nack":
+            m = frame["msg"]
+            if self.nack_listener is not None:
+                self.nack_listener(
+                    NackMessage(m["clientId"], m["clientSeq"], m["code"],
+                                m["reason"])
+                )
+
+    # -------------------------------------------- connection surface
+
+    @property
+    def listener(self):
+        return self._listener
+
+    @listener.setter
+    def listener(self, fn) -> None:
+        # Draining buffered early ops on listener attach (the
+        # driver-base early-op queue, documentDeltaConnection.ts),
+        # under the same lock _on_event delivers with — attach-time
+        # races can neither strand an op in _early nor reorder.
+        with self._lock:
+            self._listener = fn
+            if fn is not None and self._early:
+                early, self._early = self._early, []
+                for m in early:
+                    fn(m)
+
+    def submit(self, msg: DocumentMessage) -> None:
+        from ..server.socket_service import document_message_to_json
+
+        if not self.connected:
+            raise RuntimeError("socket connection closed")
+        self._call(cmd="submit", msg=document_message_to_json(msg))
+
+    def submit_batch(self, msgs: List[DocumentMessage]) -> None:
+        from ..server.socket_service import document_message_to_json
+
+        if not self.connected:
+            raise RuntimeError("socket connection closed")
+        self._call(
+            cmd="submit_batch",
+            msgs=[document_message_to_json(m) for m in msgs],
+        )
+
+    def catch_up(self, from_seq: int) -> List[SequencedMessage]:
+        return [
+            message_from_json(m)
+            for m in self._call(cmd="catch_up", fromSeq=from_seq)
+        ]
+
+    def disconnect(self) -> None:
+        if not self.connected:
+            return
+        self.connected = False
+        try:
+            self._call(cmd="disconnect")
+        except Exception:
+            pass
+        try:
+            # shutdown unblocks the reader thread (a bare close can
+            # leave a concurrent blocking read stuck on Linux).
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self.disconnect_listener is not None:
+            self.disconnect_listener()
+
+
+class SocketDriver:
+    """Driver surface over TCP (create/load/connect/ops_from/blobs)."""
+
+    def __init__(self, host: str, port: int):
+        self.host, self.port = host, port
+        self._rpc = _Rpc(host, port)
+
+    def create_document(self, doc_id: str, summary_wire: str) -> None:
+        self._rpc.call(cmd="create_document", docId=doc_id, summary=summary_wire)
+
+    def load_document(self, doc_id: str) -> Optional[str]:
+        return self._rpc.call(cmd="load_document", docId=doc_id)
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None):
+        return _SocketConnection(self.host, self.port, doc_id, client_id)
+
+    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+        return [
+            message_from_json(m)
+            for m in self._rpc.call(cmd="ops_from", docId=doc_id,
+                                    fromSeq=from_seq)
+        ]
+
+    def upload_blob(self, doc_id: str, data: bytes) -> str:
+        return self._rpc.call(
+            cmd="upload_blob", docId=doc_id,
+            data=base64.b64encode(data).decode(),
+        )
+
+    def read_blob(self, doc_id: str, blob_id: str) -> bytes:
+        return base64.b64decode(
+            self._rpc.call(cmd="read_blob", docId=doc_id, blobId=blob_id)
+        )

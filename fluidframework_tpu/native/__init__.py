@@ -1,0 +1,143 @@
+"""Native (C++) components, bound via ctypes.
+
+The reference's only non-TypeScript pieces are native C/C++
+dependencies (librdkafka, libgit2/nodegit, zookeeper — SURVEY.md
+§2.5); this package plays the libgit2 role: `castore.cpp` is a
+content-addressed blob store with named refs, compiled on demand with
+the system g++ into `_castore.so` next to the source and loaded with
+ctypes (no pybind11 in this image). `load_castore()` returns None
+when no compiler is available — callers fall back to the pure-Python
+store (server/castore.py) with identical semantics and digests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "castore.cpp")
+_LIB = os.path.join(_DIR, "_castore.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    # Link to a process-unique temp path and rename atomically:
+    # several processes (e.g. a test run + its server subprocess) may
+    # build concurrently, and dlopen must never see a half-written .so.
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, _LIB)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
+def load_castore() -> Optional[ctypes.CDLL]:
+    """The castore shared library, building it on first use; None when
+    unavailable (no compiler)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed:
+            return None
+        if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.cas_new.restype = ctypes.c_void_p
+        lib.cas_free.argtypes = [ctypes.c_void_p]
+        lib.cas_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
+        ]
+        lib.cas_get_len.restype = ctypes.c_long
+        lib.cas_get_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.cas_get.restype = ctypes.c_long
+        lib.cas_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        lib.cas_contains.restype = ctypes.c_int
+        lib.cas_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.cas_set_ref.restype = ctypes.c_int
+        lib.cas_set_ref.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+        ]
+        lib.cas_get_ref.restype = ctypes.c_long
+        lib.cas_get_ref.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p
+        ]
+        lib.cas_list_refs.restype = ctypes.c_long
+        lib.cas_list_refs.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t
+        ]
+        _lib = lib
+        return _lib
+
+
+class NativeContentStore:
+    """ctypes wrapper over the C++ store (same surface and digests as
+    the pure-Python ContentAddressedStore)."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        self._ptr = ctypes.c_void_p(lib.cas_new())
+
+    def __del__(self):
+        ptr, self._ptr = getattr(self, "_ptr", None), None
+        if ptr and getattr(self, "_lib", None) is not None:
+            self._lib.cas_free(ptr)
+
+    def put(self, content) -> str:
+        if isinstance(content, str):
+            content = content.encode()
+        out = ctypes.create_string_buffer(65)
+        self._lib.cas_put(self._ptr, content, len(content), out)
+        return out.value.decode()
+
+    def get(self, key: str) -> bytes:
+        n = self._lib.cas_get_len(self._ptr, key.encode())
+        if n < 0:
+            raise KeyError(key)
+        buf = ctypes.create_string_buffer(max(n, 1))
+        self._lib.cas_get(self._ptr, key.encode(), buf, n)
+        return buf.raw[:n]
+
+    def contains(self, key: str) -> bool:
+        return bool(self._lib.cas_contains(self._ptr, key.encode()))
+
+    def set_ref(self, name: str, key: str) -> None:
+        if self._lib.cas_set_ref(self._ptr, name.encode(), key.encode()) != 0:
+            raise KeyError(f"unknown blob {key}")
+
+    def get_ref(self, name: str):
+        out = ctypes.create_string_buffer(65)
+        n = self._lib.cas_get_ref(self._ptr, name.encode(), out)
+        return None if n < 0 else out.value.decode()
+
+    def list_refs(self):
+        n = self._lib.cas_list_refs(self._ptr, None, 0)
+        buf = ctypes.create_string_buffer(n)
+        self._lib.cas_list_refs(self._ptr, buf, n)
+        names = buf.value.decode().split("\n")
+        return sorted(x for x in names if x)

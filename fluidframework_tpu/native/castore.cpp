@@ -1,0 +1,204 @@
+// Content-addressed blob store: the native storage backing.
+//
+// Plays the role the reference's git storage stack plays natively
+// (server/gitrest over nodegit/libgit2, a C++ library): immutable
+// blobs addressed by SHA-256, with named refs. Exposed to Python via
+// a C ABI consumed with ctypes (fluidframework_tpu/native/__init__.py);
+// server/castore.py routes through it when the shared library is
+// available and falls back to the pure-Python store otherwise.
+//
+// SHA-256 is implemented inline from the FIPS 180-4 specification so
+// the library has zero dependencies beyond the C++ standard library.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- sha256
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buf_len = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(init));
+  }
+
+  static uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + k[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const uint8_t* data, size_t n) {
+    len += n;
+    while (n > 0) {
+      size_t take = 64 - buf_len;
+      if (take > n) take = n;
+      std::memcpy(buf + buf_len, data, take);
+      buf_len += take;
+      data += take;
+      n -= take;
+      if (buf_len == 64) {
+        block(buf);
+        buf_len = 0;
+      }
+    }
+  }
+
+  void hex(char out[65]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    update(&pad, 1);
+    uint8_t zero = 0;
+    while (buf_len != 56) update(&zero, 1);
+    uint8_t lenb[8];
+    for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8 * i));
+    len -= 9;  // the padding bytes above bumped len; harmless but tidy
+    update(lenb, 8);
+    static const char* digits = "0123456789abcdef";
+    for (int i = 0; i < 8; i++)
+      for (int j = 0; j < 4; j++) {
+        uint8_t byte = uint8_t(h[i] >> (24 - 8 * j));
+        out[i * 8 + j * 2] = digits[byte >> 4];
+        out[i * 8 + j * 2 + 1] = digits[byte & 0xf];
+      }
+    out[64] = 0;
+  }
+};
+
+struct Store {
+  std::map<std::string, std::vector<uint8_t>> blobs;
+  std::map<std::string, std::string> refs;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* cas_new() { return new Store(); }
+
+void cas_free(void* p) { delete static_cast<Store*>(p); }
+
+void cas_put(void* p, const uint8_t* data, size_t n, char* out_key) {
+  Sha256 s;
+  s.update(data, n);
+  char key[65];
+  s.hex(key);
+  auto* st = static_cast<Store*>(p);
+  {
+    std::lock_guard<std::mutex> g(st->mu);
+    st->blobs.emplace(std::string(key),
+                      std::vector<uint8_t>(data, data + n));
+  }
+  std::memcpy(out_key, key, 65);
+}
+
+long cas_get_len(void* p, const char* key) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  auto it = st->blobs.find(key);
+  return it == st->blobs.end() ? -1 : long(it->second.size());
+}
+
+long cas_get(void* p, const char* key, uint8_t* buf, size_t buf_len) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  auto it = st->blobs.find(key);
+  if (it == st->blobs.end()) return -1;
+  size_t n = it->second.size();
+  if (buf && buf_len >= n) std::memcpy(buf, it->second.data(), n);
+  return long(n);
+}
+
+int cas_contains(void* p, const char* key) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  return st->blobs.count(key) ? 1 : 0;
+}
+
+int cas_set_ref(void* p, const char* name, const char* key) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  if (!st->blobs.count(key)) return -1;
+  st->refs[name] = key;
+  return 0;
+}
+
+long cas_get_ref(void* p, const char* name, char* out_key) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  auto it = st->refs.find(name);
+  if (it == st->refs.end()) return -1;
+  std::memcpy(out_key, it->second.c_str(), it->second.size() + 1);
+  return long(it->second.size());
+}
+
+long cas_ref_count(void* p) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  return long(st->refs.size());
+}
+
+// List ref names into a newline-joined buffer; returns needed size.
+long cas_list_refs(void* p, char* buf, size_t buf_len) {
+  auto* st = static_cast<Store*>(p);
+  std::lock_guard<std::mutex> g(st->mu);
+  std::string joined;
+  for (auto& kv : st->refs) {
+    joined += kv.first;
+    joined += '\n';
+  }
+  if (buf && buf_len >= joined.size() + 1)
+    std::memcpy(buf, joined.c_str(), joined.size() + 1);
+  return long(joined.size() + 1);
+}
+
+}  // extern "C"

@@ -1,11 +1,19 @@
 """Content-addressed summary/blob store.
 
 Plays the role git storage plays in the reference (gitrest over
-libgit2, server/gitrest; fronted by historian's cache): summaries are
-immutable blobs addressed by content hash, with named refs for each
-document's latest summary. The C++ implementation
-(fluidframework_tpu/native) backs the high-throughput path; this is
-the reference/fallback.
+libgit2 — a C++ library — server/gitrest; fronted by historian's
+cache): summaries and attachment blobs are immutable blobs addressed
+by SHA-256 content hash, with named refs pointing at each document's
+latest summary.
+
+Two backends with identical semantics and digests:
+- the C++ store (fluidframework_tpu/native/castore.cpp, ctypes-bound,
+  compiled on demand) — the native path, used when a compiler is
+  available;
+- a pure-Python dict store — the always-available fallback.
+
+`ContentAddressedStore(prefer_native=True)` picks automatically;
+`.backend` reports which one is live.
 """
 
 from __future__ import annotations
@@ -14,12 +22,12 @@ import hashlib
 from typing import Dict, List, Optional
 
 
-class ContentAddressedStore:
+class _PyStore:
     def __init__(self):
         self._blobs: Dict[str, bytes] = {}
-        self._refs: Dict[str, str] = {}  # doc id -> blob key
+        self._refs: Dict[str, str] = {}
 
-    def put(self, content: bytes) -> str:
+    def put(self, content) -> str:
         if isinstance(content, str):
             content = content.encode()
         key = hashlib.sha256(content).hexdigest()
@@ -32,8 +40,6 @@ class ContentAddressedStore:
     def contains(self, key: str) -> bool:
         return key in self._blobs
 
-    # ------------------------------------------------------------- refs
-
     def set_ref(self, name: str, key: str) -> None:
         if key not in self._blobs:
             raise KeyError(f"unknown blob {key}")
@@ -44,3 +50,41 @@ class ContentAddressedStore:
 
     def list_refs(self) -> List[str]:
         return sorted(self._refs)
+
+
+class ContentAddressedStore:
+    """Facade over the native or pure-Python backend."""
+
+    def __init__(self, prefer_native: bool = True):
+        self._impl = None
+        self.backend = "python"
+        if prefer_native:
+            try:
+                from ..native import NativeContentStore, load_castore
+
+                lib = load_castore()
+                if lib is not None:
+                    self._impl = NativeContentStore(lib)
+                    self.backend = "native"
+            except Exception:
+                self._impl = None
+        if self._impl is None:
+            self._impl = _PyStore()
+
+    def put(self, content) -> str:
+        return self._impl.put(content)
+
+    def get(self, key: str) -> bytes:
+        return self._impl.get(key)
+
+    def contains(self, key: str) -> bool:
+        return self._impl.contains(key)
+
+    def set_ref(self, name: str, key: str) -> None:
+        self._impl.set_ref(name, key)
+
+    def get_ref(self, name: str) -> Optional[str]:
+        return self._impl.get_ref(name)
+
+    def list_refs(self) -> List[str]:
+        return self._impl.list_refs()

@@ -1,0 +1,180 @@
+"""TCP socket front end for a LocalServer: a REAL process boundary.
+
+The reference's client↔service boundary is a socket
+(drivers/driver-base/src/documentDeltaConnection.ts:42 over socket.io;
+alfred's WS door, lambdas/src/alfred/index.ts:211). Round 1's drivers
+only ever met the server inside one interpreter; this module serves
+the full lambda pipeline over TCP so a container in another PROCESS
+(or host) collaborates through it via `drivers.socket_driver`.
+
+Protocol: newline-delimited JSON frames.
+- request:  {"id": n, "cmd": <name>, ...args}
+- response: {"id": n, "result": ...} | {"id": n, "error": "..."}
+- push (after "connect" on that socket):
+    {"event": "op", "msg": <sequenced-wire>}
+    {"event": "nack", "msg": {...}}
+
+One TCP connection == one session: it may perform storage/control
+calls and hold at most one delta connection. All server work is
+serialized under one lock (the in-proc pipeline is single-threaded by
+design, like the reference's per-partition lambdas).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import threading
+from typing import Any, Optional
+
+from ..drivers.file_driver import message_to_json
+from ..protocol.messages import DocumentMessage, MessageType, NackMessage
+
+
+def document_message_from_json(data: dict) -> DocumentMessage:
+    return DocumentMessage(
+        client_seq=data["clientSequenceNumber"],
+        ref_seq=data["referenceSequenceNumber"],
+        type=MessageType(data["type"]),
+        contents=data.get("contents"),
+        metadata=data.get("metadata"),
+        address=data.get("address"),
+    )
+
+
+def document_message_to_json(msg: DocumentMessage) -> dict:
+    from ..runtime.op_lifecycle import _dumps
+
+    return {
+        "clientSequenceNumber": msg.client_seq,
+        "referenceSequenceNumber": msg.ref_seq,
+        "type": msg.type.value,
+        # Round-trip through the wire encoder so in-proc dataclasses
+        # (merge-tree ops) become their wire-dict form.
+        "contents": json.loads(_dumps(msg.contents)),
+        "metadata": msg.metadata,
+        "address": msg.address,
+    }
+
+
+class _Session(socketserver.StreamRequestHandler):
+    # A stalled client (full TCP buffer) must not wedge the server:
+    # pushes time out and kill that session only.
+    timeout = 30
+
+    def setup(self) -> None:
+        super().setup()
+        self.connection.settimeout(30)
+
+    def handle(self) -> None:
+        srv: "SocketDeltaServer" = self.server.owner  # type: ignore
+        conn = None
+        try:
+            for line in self.rfile:
+                if not line.strip():
+                    continue
+                req = json.loads(line)
+                try:
+                    result, conn = self._dispatch(srv, req, conn)
+                    self._send({"id": req.get("id"), "result": result})
+                except Exception as exc:  # surfaced to the client
+                    self._send({"id": req.get("id"), "error": str(exc)})
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            if conn is not None:
+                with srv.lock:
+                    conn.disconnect()
+
+    def _send(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        with self.server.owner.lock_write:  # type: ignore
+            self.wfile.write(data)
+            self.wfile.flush()
+
+    def _dispatch(self, srv: "SocketDeltaServer", req: dict, conn):
+        cmd = req["cmd"]
+        ls = srv.local_server
+        with srv.lock:
+            if cmd == "create_document":
+                handle = ls.upload_summary(req["summary"])
+                ls.storage.set_ref(req["docId"], handle)
+                return True, conn
+            if cmd == "load_document":
+                return ls.download_summary(req["docId"]), conn
+            if cmd == "ops_from":
+                return [
+                    message_to_json(m)
+                    for m in ls.ops_from(req["docId"], req["fromSeq"])
+                ], conn
+            if cmd == "upload_blob":
+                return ls.storage.put(base64.b64decode(req["data"])), conn
+            if cmd == "read_blob":
+                return base64.b64encode(
+                    ls.storage.get(req["blobId"])
+                ).decode(), conn
+            if cmd == "connect":
+                assert conn is None, "session already holds a connection"
+                conn = ls.connect(req["docId"], req.get("clientId"))
+                conn.listener = lambda m: self._send(
+                    {"event": "op", "msg": message_to_json(m)}
+                )
+                conn.nack_listener = lambda n: self._send(
+                    {"event": "nack",
+                     "msg": {"clientId": n.client_id, "clientSeq": n.client_seq,
+                             "code": n.code, "reason": n.reason}}
+                )
+                return {"clientId": conn.client_id,
+                        "joinSeq": conn.join_seq}, conn
+            if cmd == "catch_up":
+                assert conn is not None
+                return [
+                    message_to_json(m) for m in conn.catch_up(req["fromSeq"])
+                ], conn
+            if cmd == "submit":
+                assert conn is not None
+                conn.submit(document_message_from_json(req["msg"]))
+                return True, conn
+            if cmd == "submit_batch":
+                assert conn is not None
+                conn.submit_batch(
+                    [document_message_from_json(m) for m in req["msgs"]]
+                )
+                return True, conn
+            if cmd == "disconnect":
+                if conn is not None:
+                    conn.disconnect()
+                return True, None
+        raise ValueError(f"unknown cmd {cmd!r}")
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class SocketDeltaServer:
+    """Serve a LocalServer over TCP (the LocalDeltaConnectionServer →
+    network door step)."""
+
+    def __init__(self, local_server, host: str = "127.0.0.1", port: int = 0):
+        self.local_server = local_server
+        self.lock = threading.RLock()
+        self.lock_write = threading.RLock()
+        self._tcp = _TCPServer((host, port), _Session)
+        self._tcp.owner = self  # type: ignore
+        self.host, self.port = self._tcp.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SocketDeltaServer":
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()

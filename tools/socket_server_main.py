@@ -1,0 +1,31 @@
+"""Standalone ordering-service process: LocalServer behind TCP.
+
+Run: python tools/socket_server_main.py [port]
+Prints "LISTENING <host> <port>" once ready, then serves until killed.
+Containers in other processes collaborate through it via
+drivers.socket_driver.SocketDriver (tests/test_socket_transport.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.server import LocalServer  # noqa: E402
+from fluidframework_tpu.server.socket_service import SocketDeltaServer  # noqa: E402
+
+
+def main() -> None:
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    srv = SocketDeltaServer(LocalServer(), port=port).start()
+    print(f"LISTENING {srv.host} {srv.port}", flush=True)
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()
