@@ -26,8 +26,12 @@ class LocalDriver:
     def connect(self, doc_id: str, client_id: Optional[int] = None):
         return self.server.connect(doc_id, client_id)
 
-    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
-        return self.server.ops_from(doc_id, from_seq)
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        ops = self.server.ops_from(doc_id, from_seq)
+        if to_seq is not None:
+            ops = [m for m in ops if m.sequence_number <= to_seq]
+        return ops
 
     # Blob surface (reference IDocumentStorageService.createBlob/
     # readBlob — backed server-side by the content-addressed store).

@@ -210,11 +210,12 @@ class SocketDriver:
     def connect(self, doc_id: str, client_id: Optional[int] = None):
         return _SocketConnection(self.host, self.port, doc_id, client_id)
 
-    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> List[SequencedMessage]:
         return [
             message_from_json(m)
             for m in self._rpc.call(cmd="ops_from", docId=doc_id,
-                                    fromSeq=from_seq)
+                                    fromSeq=from_seq, toSeq=to_seq)
         ]
 
     def upload_blob(self, doc_id: str, data: bytes) -> str:

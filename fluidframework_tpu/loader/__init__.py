@@ -8,8 +8,13 @@ auto-reconnect, `Audience` (audience.ts), and stashed-op close/resume
 """
 
 from .container import Container, Loader
+from .collab_window_tracker import CollabWindowTracker
 from .connection_manager import ConnectionManager
 from .delta_queue import DeltaQueue
+from .parallel_fetch import fetch_ops_parallel
 from .audience import Audience
 
-__all__ = ["Audience", "ConnectionManager", "Container", "DeltaQueue", "Loader"]
+__all__ = [
+    "Audience", "CollabWindowTracker", "ConnectionManager", "Container",
+    "DeltaQueue", "Loader", "fetch_ops_parallel",
+]

@@ -1,0 +1,47 @@
+"""CollabWindowTracker: noop heartbeats that keep the MSN moving.
+
+Reference `CollabWindowTracker`
+(loader/container-loader/src/collabWindowTracker.ts): the minimum
+sequence number only advances when EVERY client's reference sequence
+number advances, and a client's refSeq only advances when it submits
+something. An idle reader would therefore pin the MSN (and with it
+zamboni, proposal commits, and trunk eviction) forever. The tracker
+watches processed remote ops and submits a NOOP once enough
+unacknowledged remote traffic accumulates, advancing this client's
+refSeq without any user edit.
+"""
+
+from __future__ import annotations
+
+from ..protocol.messages import MessageType, SequencedMessage
+
+
+class CollabWindowTracker:
+    """Attach to a ContainerRuntime; submits NOOPs after `max_ops`
+    remote ops arrive with no local submission in between."""
+
+    def __init__(self, runtime, max_ops: int = 50):
+        self.runtime = runtime
+        self.max_ops = max_ops
+        self._since_local = 0
+        self.noops_sent = 0
+        runtime.on("op", self._on_op)
+
+    def _on_op(self, msg: SequencedMessage, local: bool) -> None:
+        if local:
+            self._since_local = 0
+            return
+        if msg.type != MessageType.OP:
+            # Heartbeats must not count noops/system messages —
+            # otherwise trackers feed each other (and their own echo)
+            # in an endless noop cycle, the exact ack-loop the
+            # reference's tracker filters out.
+            return
+        self._since_local += 1
+        if (
+            self._since_local >= self.max_ops
+            and self.runtime.connection is not None
+        ):
+            self._since_local = 0
+            self.noops_sent += 1
+            self.runtime.submit_system_message(MessageType.NOOP, None)

@@ -105,10 +105,11 @@ class _Session(socketserver.StreamRequestHandler):
             if cmd == "load_document":
                 return ls.download_summary(req["docId"]), conn
             if cmd == "ops_from":
-                return [
-                    message_to_json(m)
-                    for m in ls.ops_from(req["docId"], req["fromSeq"])
-                ], conn
+                ops = ls.ops_from(req["docId"], req["fromSeq"])
+                to_seq = req.get("toSeq")
+                if to_seq is not None:  # server-side ranged read
+                    ops = [m for m in ops if m.sequence_number <= to_seq]
+                return [message_to_json(m) for m in ops], conn
             if cmd == "upload_blob":
                 return ls.storage.put(base64.b64decode(req["data"])), conn
             if cmd == "read_blob":

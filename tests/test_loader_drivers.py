@@ -226,3 +226,49 @@ def test_delete_subdirectory_rollback():
     assert restored is not None
     assert restored.get("mode") == "fast"
     assert restored.get_subdirectory("nested").get("deep") == 1
+
+
+def test_collab_window_tracker_advances_msn():
+    """An idle reader pins the MSN; the tracker's noop heartbeats
+    unpin it (collabWindowTracker.ts role)."""
+    from fluidframework_tpu.loader import CollabWindowTracker
+
+    def run(with_tracker):
+        loader, server = make_loader()
+        writer = seed_container(loader)
+        doc = writer.attach()
+        reader = loader.resolve(doc)  # never edits
+        tracker = (
+            CollabWindowTracker(reader.runtime, max_ops=5)
+            if with_tracker else None
+        )
+        join_head = server.deli.sequencers[doc].seq
+        for i in range(12):
+            chan(writer).insert_text(0, f"{i}")
+            writer.flush()
+        return server.deli.sequencers[doc].min_seq, join_head, tracker
+
+    msn_without, join_without, _ = run(False)
+    msn_with, join_with, tracker = run(True)
+    # Without heartbeats the idle reader pins the MSN at its join
+    # point; with them the MSN advances past it.
+    assert msn_without <= join_without
+    assert tracker.noops_sent >= 2
+    assert msn_with > join_with
+
+
+def test_parallel_fetch_contiguous():
+    from fluidframework_tpu.loader import fetch_ops_parallel
+
+    loader, server = make_loader()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    for i in range(40):
+        chan(c1).insert_text(0, "x")
+        c1.flush()
+    head = server.deli.sequencers[doc].seq
+    ops = fetch_ops_parallel(loader.driver, doc, 0, head, chunk=7, workers=3)
+    assert [m.sequence_number for m in ops] == list(range(1, head + 1))
+    # Partial window.
+    ops = fetch_ops_parallel(loader.driver, doc, 10, 25, chunk=4)
+    assert [m.sequence_number for m in ops] == list(range(11, 26))
