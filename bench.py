@@ -65,9 +65,10 @@ def main() -> None:
         )
 
     # Fail fast if the fixed capacity cannot hold the stream: live
-    # rows grow ~0.1/op on this mix; growth inside the timed region
-    # would recompile (minutes) or exceed VMEM.
-    est_rows = int(n_ops * 0.12) + 2 * chunk * sync + 64
+    # rows grow ~0.091/op on this mix (measured: 91,172 rows after the
+    # 1M-op replay); growth inside the timed region would recompile
+    # (minutes) or exceed VMEM.
+    est_rows = int(n_ops * 0.10) + 2 * chunk * sync + 64
     if est_rows > capacity:
         print(
             f"FATAL: BENCH_CAPACITY={capacity} too small for "
@@ -186,5 +187,31 @@ def main() -> None:
     )
 
 
+def _main_with_retry() -> None:
+    """The tunneled TPU's remote compile helper occasionally 500s
+    (transient terminal-side env flake, observed repeatedly); a fresh
+    process retry succeeds. Retry the whole bench up to twice in a
+    subprocess so one infra hiccup doesn't record a failed round."""
+    attempt = int(os.environ.get("BENCH_ATTEMPT", "0"))
+    try:
+        main()
+        return
+    except SystemExit:
+        raise
+    except Exception as exc:
+        # Only the remote-compile-helper hiccup is transient; other
+        # INTERNAL errors are deterministic and must surface.
+        if "remote_compile" not in str(exc) or attempt >= 2:
+            raise
+        print(
+            f"transient TPU compile failure (attempt {attempt}); "
+            "retrying in a fresh process...", file=sys.stderr,
+        )
+    # Replace this process outright: the dying parent must not hold
+    # the TPU client while the retry initializes its own.
+    os.environ["BENCH_ATTEMPT"] = str(attempt + 1)
+    os.execv(sys.executable, [sys.executable, os.path.abspath(__file__)])
+
+
 if __name__ == "__main__":
-    main()
+    _main_with_retry()

@@ -123,7 +123,11 @@ def _roll1_flat(v):
 
 
 def _mergetree_chunk_kernel(
-    parts,  # static: which body sections run (profiling/bisection)
+    parts,  # static profiling/bisection knob; sections: 'splits' =
+    #   the pos1 boundary split, 'insert' = the merged pos2-split +
+    #   landing pass, 'covered' = range updates. Partial tuples are
+    #   for TIMING only — they do not produce semantically complete
+    #   states (e.g. 'covered' without 'insert' skips the pos2 split).
     # scalars / op columns (SMEM)
     nrows_in_ref, err_in_ref, nops_ref,
     op_type_ref, pos1_ref, pos2_ref, seq_ref, client_ref,
@@ -203,9 +207,18 @@ def _mergetree_chunk_kernel(
         after = _cumsum_excl(inside)  # 1 for i > j_split
         keep = after == 0
         shift_cols(keep)
-        # Tail row position: first ~keep (one-hot; empty if no split).
+        split_fixup(keep, prefix, pos, inside)
+
+    def split_fixup(keep, prefix, pos, inside, gate=None):
+        """Post-shift boundary-split repairs: the tail row (first
+        ~keep; inherits every field through the shift) gets its span
+        offset advanced, and the head row truncates to the split
+        offset. `gate` optionally restricts the tail mask (the merged
+        pass gates on is_range)."""
         at = (~keep) & (_roll1_flat(keep.astype(jnp.int32)) > 0)
         at = at & (flat > 0)  # keep[0] is always True; guard the wrap
+        if gate is not None:
+            at = at & jnp.broadcast_to(gate, shape)
         off = pos - _roll1_flat(prefix)  # at tail pos: pos - prefix[j]
         t_buf[...] = jnp.where(at, t_buf[...] + off, t_buf[...])
         t_len[...] = jnp.where(at, t_len[...] - off, t_len[...])
@@ -229,27 +242,39 @@ def _mergetree_chunk_kernel(
 
         if 'splits' in parts:
             split_at(pos1, is_ins | is_range, orefseq, oclient)
-            split_at(pos2, is_range, orefseq, oclient)
 
-        # ---- insert landing + shift + write (insertingWalk + breakTie,
-        # mergeTree.ts:1740,:1719). Landing = first row at/after pos1
-        # that is visible content or loses the tie-break; the first
-        # non-live row is the virtual end boundary.
+        # ---- merged structural pass: the pos2 boundary split (range
+        # ops) and the insert landing shift (insert ops) are mutually
+        # exclusive by op type, so ONE suffix shift serves both —
+        # saving a full 19-column shift per op vs doing them serially.
         if 'insert' not in parts:
             return 0
         skip, vis_len = visibility(orefseq, oclient)
         prefix = _cumsum_excl(vis_len)
         total = _allreduce_sum(vis_len)
         live_pre = t_live[...] > 0
+        # (a) pos2 split row (ensureIntervalBoundary for the range end).
+        inside2 = (
+            (~skip) & (prefix < pos2) & (prefix + vis_len > pos2) & is_range
+        ).astype(jnp.int32)
+        # (b) insert landing (insertingWalk + breakTie,
+        # mergeTree.ts:1740,:1719): first row at/after pos1 that is
+        # visible content or loses the tie-break; first non-live row is
+        # the virtual end boundary.
         land = (
             (~skip) & (prefix >= pos1)
             & ((vis_len > 0) | (oseq > t_iseq[...]))
         ) | ~live_pre
         land = land & is_ins
         landi = land.astype(jnp.int32)
-        ft = land & (_cumsum_excl(landi) == 0)  # one-hot landing row
-        keep = (_cumsum_excl(landi) + landi) == 0  # i < landing index
+        open_excl = _cumsum_excl(inside2 + landi)
+        ft = land & (open_excl == 0)  # one-hot landing row
+        # keep[i]: split2 keeps i <= j2 (tail opens AFTER the inside
+        # row); insert keeps i < landing (new row opens AT it).
+        keep = (open_excl + landi) == 0
         shift_cols(keep)
+        # Split-tail fixes (only when a range op split at pos2).
+        split_fixup(keep, prefix, pos2, inside2, gate=is_range)
         # pos beyond visible length and no real landing row: flagged
         # exactly like the scan kernel (ERR_BAD_POS).
         t_err[...] = t_err[...] | jnp.where(
