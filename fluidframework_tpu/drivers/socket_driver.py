@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import base64
 import json
+import queue
 import socket
 import threading
 from typing import Any, Callable, List, Optional
@@ -63,8 +64,18 @@ class _SocketConnection:
         self._lock = threading.RLock()
         self._wlock = threading.Lock()
 
+        # Events are dispatched from a dedicated thread, NOT the socket
+        # reader: a callback (nack -> disconnect, CollabWindowTracker
+        # NOOP) may issue an RPC, and only the reader thread can
+        # deliver RPC responses — running callbacks on the reader
+        # would deadlock the wait loop forever.
+        self._events: "queue.Queue" = queue.Queue()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True
+        )
         self._reader.start()
+        self._dispatcher.start()
         info = self._call(cmd="connect", docId=doc_id, clientId=client_id)
         self.client_id = info["clientId"]
         self.join_seq = info["joinSeq"]
@@ -78,7 +89,21 @@ class _SocketConnection:
             rid = self._req_id
         req["id"] = rid
         data = json.dumps(req) + "\n"
-        with self._wlock:  # reader-thread callbacks may also submit
+        if threading.current_thread() is self._reader:
+            # Safety net: the reader can never wait on itself to
+            # deliver the response (callbacks normally run on the
+            # dispatcher). Only a disconnect is safe fire-and-forget;
+            # anything else must fail loudly rather than silently
+            # return a missing result.
+            if req.get("cmd") != "disconnect":
+                raise RuntimeError(
+                    "RPC from the socket reader thread would deadlock"
+                )
+            with self._wlock:
+                self._file.write(data)
+                self._file.flush()
+            return None
+        with self._wlock:  # dispatcher-thread callbacks may also submit
             self._file.write(data)
             self._file.flush()
         with self._resp_cond:
@@ -96,7 +121,7 @@ class _SocketConnection:
             for line in self._file:
                 frame = json.loads(line)
                 if "event" in frame:
-                    self._on_event(frame)
+                    self._events.put(frame)
                 else:
                     with self._resp_cond:
                         self._pending_resp[frame["id"]] = frame
@@ -104,12 +129,39 @@ class _SocketConnection:
         except (OSError, ValueError):
             pass
         finally:
-            was = self.connected
+            was = self.connected  # False if disconnect() was local
             self.connected = False
             with self._resp_cond:
                 self._resp_cond.notify_all()
-            if was and self.disconnect_listener is not None:
-                self.disconnect_listener()
+            self._events.put({"__eof__": was})  # dispatcher exits
+
+    def _dispatch_loop(self) -> None:
+        """Drain pushed events in arrival order, off the reader thread."""
+        while True:
+            frame = self._events.get()
+            if "__eof__" in frame:
+                if frame["__eof__"]:
+                    # Reader died without a local disconnect(): surface
+                    # the transport loss (connectionManager.ts:170).
+                    if self.disconnect_listener is not None:
+                        self.disconnect_listener()
+                return
+            try:
+                self._on_event(frame)
+            except Exception:
+                # A failing listener means this replica can no longer
+                # trust its state (an op may be half-applied). Surface
+                # it the way the old reader did: traceback + transport
+                # teardown, so the container reconnects and catches up
+                # rather than silently diverging.
+                import traceback
+
+                traceback.print_exc()
+                try:
+                    self.disconnect()
+                except Exception:
+                    pass
+                return
 
     def _on_event(self, frame: dict) -> None:
         if frame["event"] == "op":

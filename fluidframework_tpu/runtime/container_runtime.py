@@ -464,6 +464,15 @@ class ContainerRuntime(EventEmitter):
         # (the reference routes all messages through ProtocolOpHandler).
         self.protocol.process_message(msg)
         if msg.type != MessageType.OP or not isinstance(msg.contents, dict):
+            if msg.type in (MessageType.CLIENT_JOIN, MessageType.CLIENT_LEAVE):
+                # A departed client's partial chunk stream can never
+                # complete; a rejoining client starts a fresh one.
+                # Either way the stale buffer must go, or it would leak
+                # (leave) or corrupt the new stream (rejoin).
+                c = msg.contents
+                cid = c.get("clientId") if isinstance(c, dict) else c
+                if cid is not None:
+                    self._reassembler.reset(cid)
             self._emit("op", msg, False)
             return
         # Local iff it matches the head of the pending FIFO by the

@@ -118,13 +118,21 @@ class ChunkReassembler:
         self._buffers = {}
 
     def feed(self, client_id: int, contents: dict) -> Tuple[bool, Any]:
-        """Returns (complete, original_contents | None)."""
+        """Returns (complete, original_contents | None).
+
+        Inconsistent sequences are DROPPED, not raised: a client that
+        disconnected mid-stream and restarted (same explicit client id)
+        begins a fresh stream at chunk 0 — raising here would crash
+        every remote replica's process() on a condition only the sender
+        misbehaved on. A fresh chunk 0 discards the stale partial; any
+        other gap discards the buffer and ignores the orphan chunk
+        (the restarted sender will resubmit from its pending queue)."""
         buf = self._buffers.setdefault(client_id, [])
         if contents["chunkedOp"] != len(buf):
-            raise ValueError(
-                f"chunk {contents['chunkedOp']} out of order "
-                f"(have {len(buf)}) from client {client_id}"
-            )
+            del self._buffers[client_id]
+            if contents["chunkedOp"] != 0:
+                return False, None
+            buf = self._buffers.setdefault(client_id, [])
         buf.append(contents["data"])
         if len(buf) < contents["total"]:
             return False, None

@@ -199,17 +199,42 @@ class BroadcasterLambda:
 
     def pump(self) -> int:
         n = 0
+        failed = []
         for entry in self.consumer.poll():
             doc = entry["doc"]
             if entry["kind"] == "op":
                 for sock in list(self.rooms.get(doc, [])):
-                    sock.deliver(entry["msg"])
+                    self._deliver_safe(doc, sock, "deliver", entry["msg"], failed)
             elif entry["kind"] == "nack":
                 for sock in list(self.rooms.get(doc, [])):
                     if sock.client_id == entry["client"]:
-                        sock.nack(entry["msg"])
+                        self._deliver_safe(doc, sock, "nack", entry["msg"], failed)
             n += 1
+        # Disconnect failures only AFTER the polled batch is fully
+        # delivered: disconnect() pumps the pipeline re-entrantly
+        # (leave sequencing), and doing that mid-batch would deliver
+        # newer ops to healthy sockets before the rest of this batch —
+        # out-of-order delivery.
+        for sock in failed:
+            try:
+                sock.disconnect()
+            except Exception:
+                pass
         return n
+
+    def _deliver_safe(self, doc: str, sock: Any, meth: str, msg: Any,
+                      failed: list) -> None:
+        """Per-socket error isolation: a dead/stalled transport (full
+        TCP buffer, closed pipe) must neither starve the rest of the
+        room nor surface an error to the submitter for an op that WAS
+        sequenced. Evict the failing socket only; it reconnects and
+        catches up from storage (alfred's room-eviction behavior,
+        alfred/index.ts:211)."""
+        try:
+            getattr(sock, meth)(msg)
+        except Exception:
+            self.leave_room(doc, sock)
+            failed.append(sock)
 
 
 # --------------------------------------------------------------------------

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import base64
 import json
+import queue
 import socket
 import socketserver
 import threading
@@ -61,12 +62,41 @@ def document_message_to_json(msg: DocumentMessage) -> dict:
 
 class _Session(socketserver.StreamRequestHandler):
     # A stalled client (full TCP buffer) must not wedge the server:
-    # pushes time out and kill that session only.
+    # its outbound queue fills and that session alone is evicted.
     timeout = 30
+    OUTQ_MAX = 4096
 
     def setup(self) -> None:
         super().setup()
         self.connection.settimeout(30)
+        # Per-session outbound queue drained by a writer thread:
+        # _send never blocks on the network, so pushes that run while
+        # the dispatcher holds srv.lock cannot stall other sessions
+        # (a global write lock would serialize every session behind
+        # the slowest socket for up to the 30s timeout).
+        self._outq: "queue.Queue" = queue.Queue(maxsize=self.OUTQ_MAX)
+        self._dead = threading.Event()
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+        self._writer.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            obj = self._outq.get()
+            if obj is None:
+                return
+            try:
+                self.wfile.write((json.dumps(obj) + "\n").encode())
+                self.wfile.flush()
+            except Exception:
+                self._kill()
+                return
+
+    def _kill(self) -> None:
+        self._dead.set()
+        try:
+            self.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def handle(self) -> None:
         srv: "SocketDeltaServer" = self.server.owner  # type: ignore
@@ -84,15 +114,24 @@ class _Session(socketserver.StreamRequestHandler):
         except (ConnectionError, ValueError, OSError):
             pass
         finally:
+            try:
+                self._outq.put_nowait(None)  # stop the writer
+            except queue.Full:
+                pass  # writer already dead (_kill); nothing to stop
             if conn is not None:
                 with srv.lock:
                     conn.disconnect()
 
     def _send(self, obj: dict) -> None:
-        data = (json.dumps(obj) + "\n").encode()
-        with self.server.owner.lock_write:  # type: ignore
-            self.wfile.write(data)
-            self.wfile.flush()
+        if self._dead.is_set():
+            raise ConnectionError("session transport dead")
+        try:
+            self._outq.put_nowait(obj)
+        except queue.Full:
+            # Slow client: evict this session only (the broadcaster's
+            # _deliver_safe catches this and keeps the room going).
+            self._kill()
+            raise ConnectionError("session outbound queue full")
 
     def _dispatch(self, srv: "SocketDeltaServer", req: dict, conn):
         cmd = req["cmd"]
@@ -163,7 +202,6 @@ class SocketDeltaServer:
     def __init__(self, local_server, host: str = "127.0.0.1", port: int = 0):
         self.local_server = local_server
         self.lock = threading.RLock()
-        self.lock_write = threading.RLock()
         self._tcp = _TCPServer((host, port), _Session)
         self._tcp.owner = self  # type: ignore
         self.host, self.port = self._tcp.server_address

@@ -151,3 +151,37 @@ def test_batch_atomicity_with_boxcar():
     c1.flush()
     for i in range(5):
         assert chan(c2).get(f"x{i}") == i
+
+
+def test_chunk_reassembler_restart_drops_stale_buffer():
+    """ADVICE r2 (low): a sender that dies mid-chunk-stream and
+    restarts with the same client id begins at chunk 0 again — the
+    stale partial must be discarded, not crash every replica."""
+    from fluidframework_tpu.runtime.op_lifecycle import (
+        ChunkReassembler, split_serialized,
+    )
+    import json
+
+    import hashlib
+
+    incompressible = "".join(
+        hashlib.sha256(str(i).encode()).hexdigest() for i in range(64)
+    )
+    blob = json.dumps({"payload": incompressible})
+    chunks = split_serialized(blob, 600)
+    assert chunks and len(chunks) >= 3
+    r = ChunkReassembler()
+    # Feed a partial stream, then "restart": fresh chunk 0 replaces it.
+    r.feed(7, chunks[0])
+    r.feed(7, chunks[1])
+    out = None
+    for c in chunks:
+        complete, out = r.feed(7, c)
+    assert complete and json.loads(json.dumps(out)) == json.loads(blob)
+    # An orphan mid-stream chunk (no preceding 0) is ignored, not raised.
+    complete, out = r.feed(9, chunks[2])
+    assert not complete and out is None
+    # ...and a subsequent clean stream still works.
+    for c in chunks:
+        complete, out = r.feed(9, c)
+    assert complete

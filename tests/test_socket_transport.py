@@ -149,3 +149,41 @@ def test_socket_blobs(server_process):
     assert c2.get_blob(chan(c2, "m").get("file")) == (
         b"cross-process blob \x00\x01" * 100
     )
+
+
+def test_rpc_from_event_callback_does_not_deadlock(server_process):
+    """ADVICE r2 (high): an RPC issued from inside an op/nack callback
+    used to wedge forever — callbacks ran on the socket READER thread,
+    the only thread that can deliver RPC responses. Events now dispatch
+    from a separate thread, so a callback-issued _call completes."""
+    host, port = server_process
+    from fluidframework_tpu.drivers.socket_driver import _SocketConnection
+
+    a = _SocketConnection(host, port, "dead-doc", None)
+    b = _SocketConnection(host, port, "dead-doc", None)
+    results = []
+
+    def on_op(msg):
+        # catch_up is a blocking RPC on the same connection.
+        results.append(len(a.catch_up(0)))
+
+    a.listener = on_op
+    from fluidframework_tpu.protocol.messages import DocumentMessage, MessageType
+
+    b.submit(DocumentMessage(client_seq=1, ref_seq=0, type=MessageType.OP,
+                             contents={"k": 1}))
+    assert wait_until(lambda: results), "callback RPC deadlocked"
+    assert results[0] >= 1
+
+    # disconnect() issued from inside a callback must also complete.
+    done = []
+
+    def on_op2(msg):
+        a.disconnect()
+        done.append(1)
+
+    a.listener = on_op2
+    b.submit(DocumentMessage(client_seq=2, ref_seq=0, type=MessageType.OP,
+                             contents={"k": 2}))
+    assert wait_until(lambda: done), "disconnect from callback deadlocked"
+    b.disconnect()
