@@ -90,19 +90,12 @@ class _SocketConnection:
         req["id"] = rid
         data = json.dumps(req) + "\n"
         if threading.current_thread() is self._reader:
-            # Safety net: the reader can never wait on itself to
-            # deliver the response (callbacks normally run on the
-            # dispatcher). Only a disconnect is safe fire-and-forget;
-            # anything else must fail loudly rather than silently
-            # return a missing result.
-            if req.get("cmd") != "disconnect":
-                raise RuntimeError(
-                    "RPC from the socket reader thread would deadlock"
-                )
-            with self._wlock:
-                self._file.write(data)
-                self._file.flush()
-            return None
+            # All callbacks run on the dispatcher thread, so an RPC
+            # from the reader is a bug — and it could never complete
+            # (the reader can't wait on itself for the response).
+            raise RuntimeError(
+                "RPC from the socket reader thread would deadlock"
+            )
         with self._wlock:  # dispatcher-thread callbacks may also submit
             self._file.write(data)
             self._file.flush()

@@ -1,0 +1,628 @@
+"""Overlay merge-tree: numpy reference semantics for the O(window) engine.
+
+The round-2 row-model kernels (ops/mergetree_kernel.py scan form,
+ops/mergetree_pallas.py chunk form) pay O(capacity) vector work per op
+because EVERY segment row — settled or not — lives in the kernel
+table. But settled rows (insert seq <= MSN, not removed, or removed
+<= MSN) are indistinguishable to every future perspective: any op's
+refSeq >= MSN (deli nacks stale refSeqs), so settled-visible text is
+visible to all of them and settled-removed text to none. The overlay
+model exploits this the way the reference's B-tree + partial-lengths
+cache bounds per-op work to O(log n) (mergeTree.ts:1397 insertSegments,
+partialLengths.ts:256): per-op work scales with the COLLAB WINDOW, not
+the document.
+
+Representation
+--------------
+- Settled content is a virtual coordinate space ``[0, S)`` — NO rows.
+  Its text/props live off-kernel (host arrays here; an append-only
+  fold log on device). Un-materialized settled text is visible to
+  every perspective by construction.
+- The overlay holds rows only for state the window still needs:
+    * TEXT rows — unsettled inserts. ``anchor`` = the settled
+      coordinate the row sits before (a point; consumes no settled
+      space). ``buf`` addresses an insert arena.
+    * SPAN rows — unsettled removes/annotates COVERING settled text.
+      ``anchor`` = first covered coordinate; the row consumes settled
+      space ``[anchor, anchor+len)``. ``buf = SETTLED_BASE + anchor``
+      (kept in sync through splits/folds). Created lazily ("gap
+      materialization") when a range op covers settled coordinates.
+- Storage order == document order. Invariants: anchors are
+  non-decreasing; span rows are disjoint in coordinates; no row is
+  anchored strictly inside a span row's range (splits enforce this).
+
+Position resolution
+-------------------
+``delta_j = vis_len_j - consume_j`` (consume = len for span rows else
+0). Visible prefix before row j at a perspective:
+``pre(j) = anchor_j + cumsum_excl(delta)(j)`` and total visible length
+``= S + sum(delta)`` — the partial-lengths role as one prefix sum over
+the window.
+
+Fold (settle-merge; the zamboni role, zamboni.ts:19)
+----------------------------------------------------
+At a sync point with applied MSN m:
+- rows removed at/below m DROP; span rows among them excise their
+  coordinates from settled space;
+- live text rows with ins_seq <= m become settled text at their
+  anchor;
+- live span rows fold unconditionally (annotations are write-only:
+  no visibility predicate ever reads props), merging their props into
+  settled props per key (PROP_DELETE cells clear);
+- surviving rows re-anchor by the prefix sums of excised/inserted
+  lengths (storage order == coordinate order makes both plain
+  cumsums).
+
+Property cells in SPAN rows use PROP_DELETE as an explicit tombstone
+(a delete of a settled prop must fold as a delete); TEXT rows are
+authoritative for their own text, so deletes store PROP_ABSENT as in
+the row model.
+
+This module is the executable semantic spec: pure numpy, one op at a
+time, dynamically sized arrays. It is differentially tested against
+the scalar oracle (core/mergetree.py) and gates the pallas overlay
+kernel bit-for-bit. ops/overlay_pallas.py is the TPU execution of
+exactly these semantics.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..protocol.constants import NO_CLIENT
+from .mergetree_kernel import (
+    ERR_BAD_POS,
+    ERR_REMOVERS,
+    NOT_REMOVED,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_REMOVE,
+    PROP_ABSENT,
+    PROP_DELETE,
+)
+
+SETTLED_BASE = 1 << 30  # buf encoding for span rows: SETTLED_BASE + coord
+
+
+class OverlayDoc:
+    """Numpy reference overlay document (dynamic arrays, one op/call)."""
+
+    def __init__(self, settled_text: np.ndarray, n_removers: int = 4,
+                 n_prop_keys: int = 8):
+        self.KR = n_removers
+        self.KK = n_prop_keys
+        # Settled state (host-side; the device engine keeps only S and
+        # reconstructs text/props from the fold log).
+        self.settled_text = np.asarray(settled_text, np.int32).copy()
+        self.settled_props = np.full(
+            (len(settled_text), n_prop_keys), PROP_ABSENT, np.int32
+        )
+        self.S = len(settled_text)
+        # Overlay rows (length-n arrays, storage order == doc order).
+        self.anchor = np.zeros(0, np.int32)
+        self.buf = np.zeros(0, np.int32)
+        self.length = np.zeros(0, np.int32)
+        self.iseq = np.zeros(0, np.int32)
+        self.iclient = np.zeros(0, np.int32)
+        self.rseq = np.zeros(0, np.int32)
+        self.rcl = np.zeros((0, n_removers), np.int32)
+        self.props = np.zeros((0, n_prop_keys), np.int32)
+        self.error = 0
+        # Peak overlay occupancy (capacity planning for the kernel).
+        self.peak_rows = 0
+        self.max_gaps_per_op = 0
+
+    # ------------------------------------------------------------ helpers
+
+    @property
+    def n(self) -> int:
+        return len(self.anchor)
+
+    def _is_span(self) -> np.ndarray:
+        return self.buf >= SETTLED_BASE
+
+    def _consume(self) -> np.ndarray:
+        return np.where(self._is_span(), self.length, 0)
+
+    def _visibility(self, ref_seq: int, client: int):
+        """Per-row (skip, vis_len) at a perspective — the
+        mergeTree.ts:916 nodeLength predicate, identical to
+        mergetree_kernel._visibility minus the live mask."""
+        removed = self.rseq != NOT_REMOVED
+        tomb = removed & (self.rseq <= ref_seq)
+        ins_vis = (self.iclient == client) | (self.iseq <= ref_seq)
+        among = (self.rcl == client).any(axis=1) if self.n else np.zeros(0, bool)
+        skip = tomb | (removed & ~ins_vis)
+        visible = ~skip & ins_vis & ~(removed & among)
+        vis_len = np.where(visible, self.length, 0)
+        return skip, vis_len
+
+    def _pre(self, vis_len: np.ndarray):
+        delta = vis_len - self._consume()
+        cum = np.cumsum(delta) - delta
+        return self.anchor + cum, int(delta.sum())
+
+    def _insert_row(self, at: int, anchor, buf, length, iseq, iclient,
+                    rseq, rcl_row=None, props_row=None) -> None:
+        def ins(a, v):
+            return np.insert(a, at, v, axis=0)
+
+        self.anchor = ins(self.anchor, anchor)
+        self.buf = ins(self.buf, buf)
+        self.length = ins(self.length, length)
+        self.iseq = ins(self.iseq, iseq)
+        self.iclient = ins(self.iclient, iclient)
+        self.rseq = ins(self.rseq, rseq)
+        self.rcl = ins(
+            self.rcl,
+            rcl_row if rcl_row is not None
+            else np.full(self.KR, NO_CLIENT, np.int32),
+        )
+        self.props = ins(
+            self.props,
+            props_row if props_row is not None
+            else np.full(self.KK, PROP_ABSENT, np.int32),
+        )
+        self.peak_rows = max(self.peak_rows, self.n)
+
+    def _split(self, pos: int, ref_seq: int, client: int) -> None:
+        """Boundary split (ensureIntervalBoundary, mergeTree.ts:1706):
+        if visible position `pos` falls strictly inside a row, split it.
+        Span-row tails advance their anchor with the offset (the tail
+        covers later coordinates); text-row tails keep the anchor (both
+        halves sit at the same point)."""
+        skip, vis = self._visibility(ref_seq, client)
+        pre, _ = self._pre(vis)
+        inside = ~skip & (pre < pos) & (pre + vis > pos)
+        if not inside.any():
+            return
+        j = int(np.argmax(inside))
+        off = pos - int(pre[j])
+        span = bool(self._is_span()[j])
+        self._insert_row(
+            j + 1,
+            self.anchor[j] + (off if span else 0),
+            self.buf[j] + off,
+            self.length[j] - off,
+            self.iseq[j], self.iclient[j], self.rseq[j],
+            self.rcl[j].copy(), self.props[j].copy(),
+        )
+        self.length[j] = off
+
+    def _coord_of(self, pos: int, pre: np.ndarray, delta_sum: int) -> int:
+        """Settled coordinate of visible position `pos` (assumes any
+        row strictly containing `pos` was already split)."""
+        cand = pre >= pos
+        if cand.any():
+            j = int(np.argmax(cand))
+            return int(self.anchor[j]) - (int(pre[j]) - pos)
+        return pos - delta_sum
+
+    # ------------------------------------------------------------- apply
+
+    def apply(self, op_type: int, pos1: int, pos2: int, seq: int,
+              ref_seq: int, client: int, buf_start: int, ins_len: int,
+              prop_keys, prop_vals) -> None:
+        if op_type == OP_INSERT:
+            self._apply_insert(pos1, seq, ref_seq, client, buf_start,
+                               ins_len, prop_keys, prop_vals)
+        elif op_type in (OP_REMOVE, OP_ANNOTATE):
+            self._apply_range(op_type, pos1, pos2, seq, ref_seq, client,
+                              prop_keys, prop_vals)
+        # NOOP: nothing.
+
+    def _apply_insert(self, pos1, seq, ref_seq, client, buf_start,
+                      ins_len, prop_keys, prop_vals) -> None:
+        self._split(pos1, ref_seq, client)
+        skip, vis = self._visibility(ref_seq, client)
+        pre, delta_sum = self._pre(vis)
+        total = self.S + delta_sum
+        # Landing (insertingWalk + breakTie, mergeTree.ts:1740,:1719):
+        # pre > pos1 means visible settled text intervenes — land
+        # before that row regardless of tie-breaks; at pre == pos1 the
+        # row-model walk applies (walk past skip rows and
+        # zero-visibility rows that win the tie).
+        land = (pre > pos1) | (
+            (pre == pos1) & ~skip & ((vis > 0) | (seq > self.iseq))
+        )
+        if land.any():
+            j = int(np.argmax(land))
+            anchor_new = int(self.anchor[j]) - (int(pre[j]) - pos1)
+        else:
+            j = self.n
+            if pos1 > total:
+                self.error |= ERR_BAD_POS
+            anchor_new = min(pos1 - delta_sum, self.S)
+        props_row = np.full(self.KK, PROP_ABSENT, np.int32)
+        for k, v in zip(prop_keys, prop_vals):
+            if k >= 0:
+                props_row[k] = PROP_ABSENT if v == PROP_DELETE else v
+        self._insert_row(
+            j, anchor_new, buf_start, ins_len, seq, client,
+            NOT_REMOVED, None, props_row,
+        )
+
+    def _apply_range(self, op_type, pos1, pos2, seq, ref_seq, client,
+                     prop_keys, prop_vals) -> None:
+        self._split(pos1, ref_seq, client)
+        self._split(pos2, ref_seq, client)
+        skip, vis = self._visibility(ref_seq, client)
+        pre, delta_sum = self._pre(vis)
+        total = self.S + delta_sum
+        if pos2 > total:
+            self.error |= ERR_BAD_POS
+        c1 = self._coord_of(pos1, pre, delta_sum)
+        c2 = self._coord_of(pos2, pre, delta_sum)
+
+        # Gap materialization: implicit settled coordinates covered by
+        # [c1, c2) become span rows, one per storage gap (gap k sits
+        # before row k; text anchors bound gaps, so materialized rows
+        # never contain a foreign anchor strictly inside).
+        consume = self._consume()
+        glo = np.concatenate([[0], self.anchor + consume]).astype(np.int64)
+        ghi = np.concatenate([self.anchor, [self.S]]).astype(np.int64)
+        lo = np.maximum(glo, c1)
+        hi = np.minimum(ghi, c2)
+        mat = np.nonzero(lo < hi)[0]
+        self.max_gaps_per_op = max(self.max_gaps_per_op, len(mat))
+        for k in mat[::-1]:  # descending: indices stay valid
+            self._insert_row(
+                int(k), int(lo[k]), SETTLED_BASE + int(lo[k]),
+                int(hi[k] - lo[k]), 0, NO_CLIENT, NOT_REMOVED,
+            )
+
+        # Covered-range updates (markRangeRemoved mergeTree.ts:1960 /
+        # annotateRange :1895), identical to the row-model kernel.
+        skip, vis = self._visibility(ref_seq, client)
+        pre, _ = self._pre(vis)
+        covered = ~skip & (vis > 0) & (pre >= pos1) & (pre + vis <= pos2)
+        if op_type == OP_REMOVE:
+            already = self.rseq != NOT_REMOVED
+            upd = covered
+            self.rseq = np.where(upd & ~already, seq, self.rseq)
+            free = self.rcl == NO_CLIENT
+            first_free = np.argmax(free, axis=1) if self.n else np.zeros(0, int)
+            no_free = ~free.any(axis=1) if self.n else np.zeros(0, bool)
+            slot = np.where(already, first_free, 0)
+            write = upd & ~(already & no_free)
+            for i in np.nonzero(write)[0]:
+                self.rcl[i, slot[i]] = client
+            if (upd & already & no_free).any():
+                self.error |= ERR_REMOVERS
+        else:  # annotate: last writer wins; deletes tombstone on spans
+            is_span = self._is_span()
+            for k, v in zip(prop_keys, prop_vals):
+                if k < 0:
+                    continue
+                idx = np.nonzero(covered)[0]
+                for i in idx:
+                    if v == PROP_DELETE:
+                        self.props[i, k] = (
+                            PROP_DELETE if is_span[i] else PROP_ABSENT
+                        )
+                    else:
+                        self.props[i, k] = v
+
+    # -------------------------------------------------------------- fold
+
+    def fold(self, msn: int) -> None:
+        """Settle-merge under applied MSN `msn` (see module docstring)."""
+        if self.n == 0:
+            return
+        removed = self.rseq != NOT_REMOVED
+        is_span = self._is_span()
+        drop = removed & (self.rseq <= msn)
+        settle_text = ~removed & ~is_span & (self.iseq <= msn)
+        settle_span = ~removed & is_span
+        folding = drop | settle_text | settle_span
+        if not folding.any():
+            return
+
+        exc_len = np.where(drop & is_span, self.length, 0)
+        ins_len = np.where(settle_text, self.length, 0)
+        exc_before = np.cumsum(exc_len) - exc_len
+        ins_before = np.cumsum(ins_len) - ins_len
+
+        # Rebuild settled text/props in coordinate (== storage) order.
+        pieces_t: List[np.ndarray] = []
+        pieces_p: List[np.ndarray] = []
+        cursor = 0
+
+        def take_settled(upto: int) -> None:
+            nonlocal cursor
+            pieces_t.append(self.settled_text[cursor:upto])
+            pieces_p.append(self.settled_props[cursor:upto])
+            cursor = upto
+
+        for i in np.nonzero(folding)[0]:
+            a = int(self.anchor[i])
+            ln = int(self.length[i])
+            if settle_text[i]:
+                take_settled(a)
+                pieces_t.append(self._row_text(i))
+                pieces_p.append(np.broadcast_to(
+                    self._fold_props_row(i, text_row=True), (ln, self.KK)
+                ).copy())
+            elif drop[i] and is_span[i]:
+                take_settled(a)
+                cursor = a + ln  # excise
+            elif settle_span[i]:
+                take_settled(a)
+                seg_p = self.settled_props[a: a + ln].copy()
+                row_p = self.props[i]
+                for k in range(self.KK):
+                    if row_p[k] == PROP_DELETE:
+                        seg_p[:, k] = PROP_ABSENT
+                    elif row_p[k] != PROP_ABSENT:
+                        seg_p[:, k] = row_p[k]
+                pieces_t.append(self.settled_text[a: a + ln])
+                pieces_p.append(seg_p)
+                cursor = a + ln
+            # drop & text row: nothing to do (just removed from overlay)
+        take_settled(self.S)
+        self.settled_text = np.concatenate(pieces_t) if pieces_t else (
+            np.zeros(0, np.int32)
+        )
+        self.settled_props = np.concatenate(pieces_p) if pieces_p else (
+            np.zeros((0, self.KK), np.int32)
+        )
+        self.S = len(self.settled_text)
+
+        keep = ~folding
+        new_anchor = self.anchor - exc_before + ins_before
+        self.anchor = new_anchor[keep].astype(np.int32)
+        self.buf = np.where(
+            is_span, SETTLED_BASE + new_anchor, self.buf
+        )[keep].astype(np.int32)
+        self.length = self.length[keep]
+        self.iseq = self.iseq[keep]
+        self.iclient = self.iclient[keep]
+        self.rseq = self.rseq[keep]
+        self.rcl = self.rcl[keep]
+        self.props = self.props[keep]
+
+    def _row_text(self, i: int) -> np.ndarray:
+        """Codepoints of row i (overridden by the replica to resolve
+        arena offsets; span rows read settled coordinates)."""
+        if self.buf[i] >= SETTLED_BASE:
+            a = int(self.buf[i]) - SETTLED_BASE
+            return self.settled_text[a: a + int(self.length[i])]
+        raise NotImplementedError("text rows need an arena resolver")
+
+    def _fold_props_row(self, i: int, text_row: bool) -> np.ndarray:
+        row = self.props[i].copy()
+        if text_row:
+            # Text rows are authoritative: ABSENT means absent.
+            row[row == PROP_DELETE] = PROP_ABSENT
+        return row
+
+    # ----------------------------------------------------- verification
+
+    def verify_invariants(self) -> None:
+        """Structural invariants of the overlay representation (the
+        partialLengths.ts:336 verifier role for this engine)."""
+        assert (self.length > 0).all(), "zero/negative-length row"
+        is_span = self._is_span()
+        consume = self._consume()
+        # Anchors non-decreasing; spans disjoint; anchors within bounds.
+        end = self.anchor + consume
+        assert (self.anchor >= 0).all() and (end <= self.S).all(), (
+            "anchor out of settled range"
+        )
+        if self.n > 1:
+            assert (self.anchor[1:] >= end[:-1]).all(), (
+                "anchor order / span overlap violation"
+            )
+        # Span buf encoding stays in sync with anchors.
+        assert (
+            self.buf[is_span] - SETTLED_BASE == self.anchor[is_span]
+        ).all(), "span buf/anchor desync"
+        # Removal bookkeeping mirrors the row model.
+        removed = self.rseq != NOT_REMOVED
+        has_removers = (self.rcl != NO_CLIENT).any(axis=1)
+        assert (removed == has_removers).all(), "removal/remover mismatch"
+        # Span rows are settled content: universal insert identity.
+        assert (self.iseq[is_span] == 0).all(), "span row with insert seq"
+
+
+class OverlayMessageReplica:
+    """SequencedMessage-driven overlay replica: the overlay engine
+    behind the same message surface as `core.kernel_replica
+    .KernelReplica`, so the farm differential tests (real concurrency:
+    lagging refSeqs, tie-breaks, overlapping removes) gate the overlay
+    semantics against the scalar oracle."""
+
+    def __init__(self, initial: str = "", fold_interval: int = 64,
+                 n_removers: int = 4, n_prop_keys: int = 8,
+                 max_prop_pairs: int = 4):
+        from ..core.kernel_replica import PropInterner, TextArena
+
+        self.arena = TextArena("")
+        self.props = PropInterner(n_prop_keys)
+        self.fold_interval = fold_interval
+        self.max_prop_pairs = max_prop_pairs
+        doc = OverlayDoc(
+            np.asarray([ord(c) for c in initial], np.int32),
+            n_removers, n_prop_keys,
+        )
+
+        def row_text(i: int) -> np.ndarray:
+            b = int(doc.buf[i])
+            ln = int(doc.length[i])
+            if b >= SETTLED_BASE:
+                a = b - SETTLED_BASE
+                return doc.settled_text[a: a + ln]
+            txt = self.arena.snapshot()[b: b + ln]
+            return np.asarray([ord(c) for c in txt], np.int32)
+
+        doc._row_text = row_text  # type: ignore[assignment]
+        self.doc = doc
+        self._since_fold = 0
+        self._msn = 0
+
+    def apply_messages(self, msgs) -> None:
+        from ..core.kernel_replica import KernelReplica
+        from ..protocol.messages import MessageType
+
+        enc = KernelReplica.__new__(KernelReplica)
+        enc.arena = self.arena
+        enc.props = self.props
+        enc.max_prop_pairs = self.max_prop_pairs
+        enc._encoded = []
+        enc._pending_rows_bound = 0
+        for msg in msgs:
+            if msg.type == MessageType.OP and msg.contents is not None:
+                enc._encode_op(msg.contents, msg)
+                for row in enc._encoded:
+                    (t, p1, p2, s, r, c, b, ln, ks, vs, msn) = row
+                    self.doc.apply(t, p1, p2, s, r, c, b, ln, ks, vs)
+                    self._msn = msn
+                enc._encoded = []
+                self._since_fold += 1
+                if self._since_fold >= self.fold_interval:
+                    self.doc.fold(self._msn)
+                    self._since_fold = 0
+            else:
+                self._msn = max(self._msn, msg.minimum_sequence_number)
+        self.doc.fold(self._msn)
+
+    def check_errors(self) -> None:
+        from .mergetree_kernel import raise_kernel_errors
+
+        raise_kernel_errors(self.doc.error)
+
+    def _doc_order(self):
+        return OverlayReplica._doc_order(self)  # type: ignore[arg-type]
+
+    def get_text(self) -> str:
+        return "".join(
+            "".join(map(chr, t)) for t, _ in self._doc_order()
+        )
+
+    def annotated_spans(self) -> List[Tuple[str, Optional[dict]]]:
+        spans: List[Tuple[str, Optional[dict]]] = []
+        for text, props in self._doc_order():
+            for j in range(len(text)):
+                row = np.asarray(props[j])
+                p = self.props.decode_row(
+                    np.where(row == PROP_DELETE, PROP_ABSENT, row)
+                )
+                spans.append((chr(int(text[j])), p))
+        return spans
+
+
+class OverlayReplica:
+    """Stream-driven overlay replica (numpy reference engine).
+
+    Consumes a `testing.synthetic.ColumnarStream` like
+    `core.columnar_replay.ColumnarReplica`, folding every
+    `fold_interval` ops. Exposes get_text()/annotated_spans() for
+    digest comparison. Text rows resolve through the stream arena
+    (offsets are rebased by STREAM_BASE like columnar_replay) or the
+    initial document text.
+    """
+
+    def __init__(self, stream, initial_len: int = 0,
+                 fold_interval: int = 2048, n_removers: int = 4,
+                 n_prop_keys: int = 8):
+        self.stream = stream
+        self.fold_interval = fold_interval
+        doc = OverlayDoc(
+            np.asarray(stream.text[:initial_len], np.int32),
+            n_removers, n_prop_keys,
+        )
+        stream_text = np.asarray(stream.text, np.int32)
+
+        def row_text(i: int) -> np.ndarray:
+            b = int(doc.buf[i])
+            ln = int(doc.length[i])
+            if b >= SETTLED_BASE:
+                a = b - SETTLED_BASE
+                return doc.settled_text[a: a + ln]
+            return stream_text[b: b + ln]
+
+        doc._row_text = row_text  # type: ignore[assignment]
+        self.doc = doc
+
+    def replay(self) -> None:
+        s = self.stream
+        d = self.doc
+        n = len(s)
+        for i in range(n):
+            d.apply(
+                int(s.op_type[i]), int(s.pos1[i]), int(s.pos2[i]),
+                int(s.seq[i]), int(s.ref_seq[i]), int(s.client[i]),
+                int(s.buf_start[i]), int(s.ins_len[i]),
+                [int(s.prop_key[i])], [int(s.prop_val[i])],
+            )
+            if (i + 1) % self.fold_interval == 0 or i + 1 == n:
+                d.fold(int(s.min_seq[i]))
+
+    def check_errors(self) -> None:
+        from .mergetree_kernel import raise_kernel_errors
+
+        raise_kernel_errors(self.doc.error)
+
+    # ------------------------------------------------------------ output
+
+    def _doc_order(self) -> List[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """(codepoints, per-char props | None) pieces in doc order:
+        implicit settled gaps interleaved with visible overlay rows."""
+        d = self.doc
+        out: List[Tuple[np.ndarray, Optional[np.ndarray]]] = []
+        cursor = 0
+        is_span = d._is_span()
+        for i in range(d.n):
+            a = int(d.anchor[i])
+            if a > cursor:
+                out.append((
+                    d.settled_text[cursor:a], d.settled_props[cursor:a]
+                ))
+                cursor = a
+            if int(d.rseq[i]) != NOT_REMOVED:
+                if is_span[i]:
+                    cursor = a + int(d.length[i])
+                continue
+            ln = int(d.length[i])
+            if is_span[i]:
+                seg_p = d.settled_props[a: a + ln].copy()
+                row_p = d.props[i]
+                for k in range(d.KK):
+                    if row_p[k] == PROP_DELETE:
+                        seg_p[:, k] = PROP_ABSENT
+                    elif row_p[k] != PROP_ABSENT:
+                        seg_p[:, k] = row_p[k]
+                out.append((d.settled_text[a: a + ln], seg_p))
+                cursor = a + ln
+            else:
+                row_p = d.props[i].copy()
+                row_p[row_p == PROP_DELETE] = PROP_ABSENT
+                out.append((
+                    d._row_text(i),
+                    np.broadcast_to(row_p, (ln, d.KK)),
+                ))
+        if cursor < d.S:
+            out.append((d.settled_text[cursor:], d.settled_props[cursor:]))
+        return out
+
+    def get_text(self) -> str:
+        return "".join(
+            "".join(map(chr, t)) for t, _ in self._doc_order()
+        )
+
+    def annotated_spans(self) -> List[Tuple[str, Optional[dict]]]:
+        """Per-char span list in the synthetic stream's key naming
+        (k<idx>), the same surface ColumnarReplica exposes for
+        digest comparison."""
+        spans: List[Tuple[str, Optional[dict]]] = []
+        for text, props in self._doc_order():
+            for j in range(len(text)):
+                p = {
+                    f"k{k}": int(props[j, k])
+                    for k in range(self.doc.KK)
+                    if props[j, k] != PROP_ABSENT
+                }
+                spans.append((chr(int(text[j])), p or None))
+        return spans

@@ -233,6 +233,12 @@ class BroadcasterLambda:
         try:
             getattr(sock, meth)(msg)
         except Exception:
+            import traceback
+
+            # Loud eviction: an application error in a replica's
+            # listener (vs a transport ConnectionError) must stay
+            # visible, or divergence debugging loses its stack trace.
+            traceback.print_exc()
             self.leave_room(doc, sock)
             failed.append(sock)
 

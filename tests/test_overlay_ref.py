@@ -1,0 +1,108 @@
+"""Differential tests: overlay engine (numpy reference) vs the oracle.
+
+The overlay model (ops/overlay_ref.py) keeps settled content as a
+virtual coordinate space and only unsettled rows in the table — the
+O(collab window) design behind the pallas overlay kernel. These tests
+gate its SEMANTICS against the scalar oracle on real-concurrency farm
+streams (lagging refSeqs, tie-breaks, overlapping removes) and against
+the scan engine on the synthetic bench mix, across fold cadences from
+"every op" to "never".
+"""
+
+import pytest
+
+from fluidframework_tpu.core.mergetree import replay_passive
+from fluidframework_tpu.ops.overlay_ref import OverlayMessageReplica, OverlayReplica
+from fluidframework_tpu.testing.farm import (
+    FarmConfig,
+    char_spans,
+    run_sharedstring_farm,
+)
+
+
+def overlay_vs_oracle(cfg: FarmConfig, fold_intervals=(1, 7, 10_000)):
+    farm = run_sharedstring_farm(cfg)
+    oracle = replay_passive(farm.stream, cfg.initial_text)
+    for fold_iv in fold_intervals:
+        r = OverlayMessageReplica(
+            initial=cfg.initial_text, fold_interval=fold_iv
+        )
+        r.apply_messages(farm.stream)
+        r.check_errors()
+        r.doc.verify_invariants()
+        assert r.get_text() == oracle.get_text(), f"fold={fold_iv}"
+        assert char_spans(r.annotated_spans()) == char_spans(
+            oracle.annotated_spans()
+        ), f"fold={fold_iv}"
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_overlay_matches_oracle_small(seed):
+    overlay_vs_oracle(
+        FarmConfig(num_clients=3, rounds=8, ops_per_client_per_round=3,
+                   seed=seed)
+    )
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_overlay_matches_oracle_more_clients(seed):
+    overlay_vs_oracle(
+        FarmConfig(num_clients=8, rounds=6, ops_per_client_per_round=4,
+                   seed=500 + seed)
+    )
+
+
+def test_overlay_insert_heavy_from_empty():
+    overlay_vs_oracle(
+        FarmConfig(num_clients=4, rounds=10, ops_per_client_per_round=5,
+                   seed=11, insert_weight=0.85, remove_weight=0.1,
+                   annotate_weight=0.05, initial_text="")
+    )
+
+
+def test_overlay_remove_heavy():
+    overlay_vs_oracle(
+        FarmConfig(
+            num_clients=4, rounds=10, ops_per_client_per_round=4, seed=12,
+            insert_weight=0.35, remove_weight=0.55, annotate_weight=0.1,
+            initial_text="the quick brown fox jumps over the lazy dog",
+        )
+    )
+
+
+def test_overlay_annotate_heavy():
+    # Annotations are the fragmentation driver in the row model; here
+    # they fold into settled props and the window stays small.
+    overlay_vs_oracle(
+        FarmConfig(
+            num_clients=6, rounds=10, ops_per_client_per_round=4, seed=99,
+            insert_weight=0.2, remove_weight=0.2, annotate_weight=0.6,
+            initial_text="annotation heavy doc " * 4,
+        )
+    )
+
+
+def test_overlay_matches_scan_engine_synthetic():
+    """Bench-mix stream: overlay vs the scan engine, window stats."""
+    from fluidframework_tpu.core.columnar_replay import ColumnarReplica
+    from fluidframework_tpu.testing.digest import state_digest
+    from fluidframework_tpu.testing.synthetic import generate_stream
+
+    stream = generate_stream(4000, n_clients=64, seed=3, initial_len=64,
+                             window=256)
+    scan = ColumnarReplica(stream, initial_len=64, engine="scan",
+                           chunk_size=256, capacity=4096)
+    scan.replay()
+    scan.check_errors()
+    ov = OverlayReplica(stream, initial_len=64, fold_interval=256)
+    ov.replay()
+    ov.check_errors()
+    ov.doc.verify_invariants()
+    assert state_digest(ov.annotated_spans()) == state_digest(
+        scan.annotated_spans()
+    )
+    # The whole point: the overlay window stays O(collab window), far
+    # below the row-model's live row count (which holds every settled
+    # annotation boundary).
+    assert ov.doc.peak_rows < 2500
+    assert int(scan.table.n_rows) > ov.doc.n
