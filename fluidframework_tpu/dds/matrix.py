@@ -39,10 +39,15 @@ from ..runtime.summary import SummaryTreeBuilder
 
 class PermutationVector:
     """One axis's order: a merge-tree over handle items
-    (reference PermutationVector, permutationvector.ts:151)."""
+    (reference PermutationVector, permutationvector.ts:151). Runs on
+    the native C++ engine when available (core/native_engine.py — the
+    interactive hot path, BENCH_DETAIL config 3), falling back to the
+    Python oracle engine."""
 
     def __init__(self):
-        self.engine = MergeTreeEngine(local_client_id=NON_COLLAB_CLIENT)
+        from ..core.native_engine import make_merge_engine
+
+        self.engine = make_merge_engine(NON_COLLAB_CLIENT)
         self._next_handle = 0
 
     def alloc(self, count: int) -> List[int]:
@@ -54,9 +59,12 @@ class PermutationVector:
 
     def handle_at(self, pos: int, ref_seq: int, client_id: int) -> int:
         """The handle at visible position `pos` of a perspective."""
+        eng = self.engine
+        if hasattr(eng, "item_at"):
+            return eng.item_at(pos, ref_seq, client_id)
         remaining = pos
-        for seg in self.engine.segments:
-            cat, length = self.engine._vis(seg, ref_seq, client_id)
+        for seg in eng.segments:
+            cat, length = eng._vis(seg, ref_seq, client_id)
             if cat == VisCategory.SKIP or length == 0:
                 continue
             if remaining < length:
@@ -80,10 +88,15 @@ class PermutationVector:
     def position_of_handle(self, handle: int) -> Optional[int]:
         """Current local visible position of a handle, or None if its
         row/col is no longer visible."""
+        eng = self.engine
+        if hasattr(eng, "position_of_item"):
+            return eng.position_of_item(
+                handle, eng.current_seq, eng.local_client_id
+            )
         pos = 0
-        for seg in self.engine.segments:
-            cat, length = self.engine._vis(
-                seg, self.engine.current_seq, self.engine.local_client_id
+        for seg in eng.segments:
+            cat, length = eng._vis(
+                seg, eng.current_seq, eng.local_client_id
             )
             if cat == VisCategory.SKIP or length == 0:
                 continue
@@ -328,13 +341,7 @@ class SharedMatrix(SharedObject):
             pv.engine.current_seq = header["currentSeq"]
             pv.engine.min_seq = header["minSeq"]
             if n:
-                pv.engine.segments.append(
-                    Segment(
-                        content=pv.alloc(n),
-                        seq=UNIVERSAL_SEQ,
-                        client_id=NON_COLLAB_CLIENT,
-                    )
-                )
+                pv.engine.load(pv.alloc(n))
         rh, ch = self.rows.handles(), self.cols.handles()
         for r, c, v in json.loads(storage.read("cells")):
             self._cells[(rh[r], ch[c])] = v

@@ -26,17 +26,17 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def _build() -> bool:
+def _build_lib(src: str, lib: str) -> bool:
     # Link to a process-unique temp path and rename atomically:
     # several processes (e.g. a test run + its server subprocess) may
     # build concurrently, and dlopen must never see a half-written .so.
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    tmp = f"{lib}.{os.getpid()}.tmp"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, _SRC],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src],
             check=True, capture_output=True, timeout=120,
         )
-        os.replace(tmp, _LIB)
+        os.replace(tmp, lib)
         return True
     except (OSError, subprocess.SubprocessError):
         try:
@@ -44,6 +44,10 @@ def _build() -> bool:
         except OSError:
             pass
         return False
+
+
+def _build() -> bool:
+    return _build_lib(_SRC, _LIB)
 
 
 def load_castore() -> Optional[ctypes.CDLL]:
@@ -141,3 +145,86 @@ class NativeContentStore:
         self._lib.cas_list_refs(self._ptr, buf, n)
         names = buf.value.decode().split("\n")
         return sorted(x for x in names if x)
+
+
+# ---------------------------------------------------------------------
+# hostmerge: the native interactive merge-tree engine (hostmerge.cpp),
+# playing the role of the reference's JIT-compiled merge-tree hot path
+# for interactive clients (mergeTree.ts insertingWalk et al).
+
+_HM_SRC = os.path.join(_DIR, "hostmerge.cpp")
+_HM_LIB = os.path.join(_DIR, "_hostmerge.so")
+_hm_lib: Optional[ctypes.CDLL] = None
+_hm_failed = False
+
+
+def load_hostmerge() -> Optional[ctypes.CDLL]:
+    """The hostmerge shared library, building on first use; None when
+    unavailable (no compiler)."""
+    global _hm_lib, _hm_failed
+    with _lock:
+        if _hm_lib is not None:
+            return _hm_lib
+        if _hm_failed:
+            return None
+        try:
+            stale = not os.path.exists(_HM_LIB) or (
+                os.path.getmtime(_HM_LIB) < os.path.getmtime(_HM_SRC)
+            )
+        except OSError:
+            # Source missing but a prebuilt .so exists: use it.
+            stale = not os.path.exists(_HM_LIB)
+        if stale:
+            if not _build_lib(_HM_SRC, _HM_LIB):
+                _hm_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_HM_LIB)
+        except OSError:
+            _hm_failed = True
+            return None
+        i32, i64, p = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+        ip = ctypes.POINTER(ctypes.c_int32)
+        lib.hm_new.restype = p
+        lib.hm_new.argtypes = [i32]
+        lib.hm_free.argtypes = [p]
+        lib.hm_set_identity.argtypes = [p, i32, i32]
+        lib.hm_load.argtypes = [p, ip, i64]
+        for name in ("hm_current_seq", "hm_min_seq", "hm_local_client",
+                     "hm_collaborating", "hm_pending_last_id"):
+            getattr(lib, name).restype = i32
+            getattr(lib, name).argtypes = [p]
+        for name in ("hm_set_current_seq", "hm_set_min_seq",
+                     "hm_update_min_seq", "hm_ack"):
+            getattr(lib, name).argtypes = [p, i32]
+        lib.hm_ack.restype = i32
+        lib.hm_segment_count.restype = i64
+        lib.hm_segment_count.argtypes = [p]
+        lib.hm_pending_count.restype = i64
+        lib.hm_pending_count.argtypes = [p]
+        lib.hm_content_total.restype = i64
+        lib.hm_content_total.argtypes = [p]
+        lib.hm_verify.restype = i32
+        lib.hm_verify.argtypes = [p]
+        lib.hm_insert.restype = i32
+        lib.hm_insert.argtypes = [p, i64, ip, i64, i32, i32, i32, ip, ip, i32]
+        lib.hm_remove.restype = i32
+        lib.hm_remove.argtypes = [p, i64, i64, i32, i32, i32]
+        lib.hm_annotate.restype = i32
+        lib.hm_annotate.argtypes = [p, i64, i64, ip, ip, i32, i32, i32, i32]
+        lib.hm_visible_length.restype = i64
+        lib.hm_visible_length.argtypes = [p, i32, i32]
+        lib.hm_get_items.restype = i64
+        lib.hm_get_items.argtypes = [p, ip, i64]
+        lib.hm_item_at.restype = i64
+        lib.hm_item_at.argtypes = [p, i64, i32, i32]
+        lib.hm_position_of_item.restype = i64
+        lib.hm_position_of_item.argtypes = [p, i32, i32, i32]
+        lib.hm_spans.restype = i64
+        lib.hm_spans.argtypes = [p, ip, i64]
+        lib.hm_group_props.restype = i64
+        lib.hm_group_props.argtypes = [p, i32, ip, i64]
+        lib.hm_regenerate.restype = i64
+        lib.hm_regenerate.argtypes = [p, ip, i32, ip, i64]
+        _hm_lib = lib
+        return _hm_lib

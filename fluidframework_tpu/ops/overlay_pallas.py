@@ -1,0 +1,674 @@
+"""Pallas TPU kernel for the overlay merge-tree: O(collab window)/op.
+
+Device execution of exactly the semantics specified by
+`ops.overlay_ref.OverlayDoc` (the numpy executable spec; see that
+module's docstring for the representation and its invariants). The
+round-2 chunk kernel (ops/mergetree_pallas.py) keeps EVERY segment row
+in VMEM and pays ~10 full-table vector passes per op — O(capacity) =
+131k rows of work per op no matter how small the live collaboration
+window is. Here the VMEM table holds ONLY unsettled rows (a few
+thousand on the bench mix); settled content is a virtual coordinate
+space represented by one scalar ``S`` whose text/props live off-kernel
+in an append-only fold log. Per-op vector work scales with the window,
+the way the reference bounds per-op work to O(log n) with its B-tree +
+partial-lengths cache (mergeTree.ts:1397 insertSegments,
+partialLengths.ts:256).
+
+Execution shape, per chunk of B sequenced ops:
+
+1. `_overlay_chunk_kernel` (pallas): the overlay columns live in VMEM
+   as (W/128, 128) int32 tiles for the whole chunk; a `fori_loop`
+   applies ops back-to-back with pure vector-domain bodies (one-hot
+   masks, log-doubling cumsums, masked suffix shifts — the idioms
+   proven in mergetree_pallas.py). Op-type branches use `pl.when` on
+   SMEM scalars so inserts skip range work and vice versa. The one
+   per-op vector->scalar crossing is the gap-materialization count of
+   range ops (a dynamic `fori_loop` inserts exactly that many span
+   rows; see overlay_ref.py "gap materialization").
+2. `fold_device` (plain XLA): the settle-merge (overlay_ref.fold /
+   the zamboni role, zamboni.ts:19). Folding rows leave the table
+   (payload sorts, not gathers — an XLA gather lowers to ~100ns/elem
+   on TPU, see ops/zamboni.py), survivors re-anchor by prefix sums,
+   and the folded rows are emitted as a dense record block.
+3. `replay_chunk_step` (one jit): kernel + fold + append of the fold
+   records into a preallocated HBM log (`lax.dynamic_update_slice`,
+   donated so XLA updates in place). The host replay loop performs
+   zero device syncs; `core.overlay_replay.OverlayDeviceReplica`
+   reconstructs the settled document from the log after the timed
+   region.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..protocol.constants import NO_CLIENT
+from .mergetree_kernel import (
+    ERR_BAD_POS,
+    ERR_CAPACITY,
+    ERR_REMOVERS,
+    NO_KEY,
+    NOT_REMOVED,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_REMOVE,
+    OpBatch,
+    PROP_ABSENT,
+    PROP_DELETE,
+)
+from .mergetree_pallas import (
+    LANES,
+    _allreduce_sum,
+    _cumsum_excl,
+    _flat_idx,
+    _roll1_flat,
+)
+from .overlay_ref import SETTLED_BASE
+from .zamboni import _pack_sort
+
+# Fold-record type codes (column 1 of a log record).
+REC_SETTLE_TEXT = 1  # unsettled insert becomes settled text at anchor
+REC_DROP_SPAN = 2  # settled coords [anchor, anchor+len) excised
+REC_SETTLE_SPAN = 3  # props merge into settled [anchor, anchor+len)
+
+
+class OverlayTable(NamedTuple):
+    """Device overlay state: unsettled rows + the settled length."""
+
+    n_rows: jnp.ndarray  # int32 scalar
+    anchor: jnp.ndarray  # int32[W] settled coordinate the row sits at
+    buf_start: jnp.ndarray  # int32[W]; >= SETTLED_BASE marks span rows
+    length: jnp.ndarray  # int32[W]
+    ins_seq: jnp.ndarray  # int32[W] (0 for span rows)
+    ins_client: jnp.ndarray  # int32[W]
+    rem_seq: jnp.ndarray  # int32[W] (NOT_REMOVED if live)
+    rem_clients: jnp.ndarray  # int32[W, KR]
+    props: jnp.ndarray  # int32[W, KK]
+    settled_len: jnp.ndarray  # int32 scalar: S
+    error: jnp.ndarray  # int32 scalar ERR_* flags
+
+
+def make_overlay_table(
+    window: int, n_removers: int = 4, n_prop_keys: int = 8,
+    settled_len: int = 0,
+) -> OverlayTable:
+    return OverlayTable(
+        n_rows=jnp.int32(0),
+        anchor=jnp.zeros(window, jnp.int32),
+        buf_start=jnp.zeros(window, jnp.int32),
+        length=jnp.zeros(window, jnp.int32),
+        ins_seq=jnp.zeros(window, jnp.int32),
+        ins_client=jnp.full(window, NO_CLIENT, jnp.int32),
+        rem_seq=jnp.full(window, NOT_REMOVED, jnp.int32),
+        rem_clients=jnp.full((window, n_removers), NO_CLIENT, jnp.int32),
+        props=jnp.full((window, n_prop_keys), PROP_ABSENT, jnp.int32),
+        settled_len=jnp.int32(settled_len),
+        error=jnp.int32(0),
+    )
+
+
+def _overlay_chunk_kernel(
+    # scalars / op columns (SMEM)
+    nrows_in_ref, err_in_ref, nops_ref, s_ref,
+    op_type_ref, pos1_ref, pos2_ref, seq_ref, client_ref,
+    buf_ref, ilen_ref, pkey_ref, pval_ref, ref_seq_ref,
+    # table columns in (VMEM)
+    t_anchor_in, t_buf_in, t_len_in, t_iseq_in, t_iclient_in, t_rseq_in,
+    t_rcl_in, t_props_in,
+    # table columns out (VMEM) + scalars out (SMEM)
+    t_anchor, t_buf, t_len, t_iseq, t_iclient, t_rseq, t_rcl, t_props,
+    nrows_out_ref, err_out_ref,
+    # scratch (VMEM)
+    t_live, t_err,
+):
+    KR = t_rcl_in.shape[0]
+    KK = t_props_in.shape[0]
+    B = pos1_ref.shape[0]
+    PK = pkey_ref.shape[0] // B
+    shape = t_len_in.shape
+    window = shape[0] * LANES
+    flat = _flat_idx(shape)
+    last = flat == (window - 1)
+    S = s_ref[0]
+
+    t_anchor[...] = t_anchor_in[...]
+    t_buf[...] = t_buf_in[...]
+    t_len[...] = t_len_in[...]
+    t_iseq[...] = t_iseq_in[...]
+    t_iclient[...] = t_iclient_in[...]
+    t_rseq[...] = t_rseq_in[...]
+    t_rcl[...] = t_rcl_in[...]
+    t_props[...] = t_props_in[...]
+    t_live[...] = jnp.where(flat < nrows_in_ref[0], 1, 0)
+    t_err[...] = jnp.where(flat == 0, err_in_ref[0], 0)
+
+    def visibility(ref_seq, client):
+        """(skip, vis_len) at a perspective — overlay_ref._visibility
+        (mergeTree.ts:916 nodeLength) plus the dead-row mask."""
+        live = t_live[...] > 0
+        rseq = t_rseq[...]
+        removed = rseq != NOT_REMOVED
+        tomb = removed & (rseq <= ref_seq)
+        ins_vis = (t_iclient[...] == client) | (t_iseq[...] <= ref_seq)
+        among = t_rcl[0] == client
+        for k in range(1, KR):
+            among = among | (t_rcl[k] == client)
+        skip = (~live) | tomb | (removed & ~ins_vis)
+        visible = (~skip) & ins_vis & ~(removed & among)
+        vis_len = jnp.where(visible, t_len[...], 0)
+        return skip, vis_len
+
+    def consume():
+        """Settled coords a row occupies (span rows only; dead masked)."""
+        live = t_live[...] > 0
+        is_span = t_buf[...] >= SETTLED_BASE
+        return jnp.where(live & is_span, t_len[...], 0)
+
+    def pre_delta(vis_len):
+        """Visible prefix before each row + the delta grand total (as a
+        broadcast tile): overlay_ref._pre — one prefix sum over the
+        WINDOW plays the partialLengths.ts:256 role for the whole
+        settled document."""
+        delta = vis_len - consume()
+        pre = t_anchor[...] + _cumsum_excl(delta)
+        dsum = _allreduce_sum(delta)
+        return pre, dsum
+
+    def shift_cols(keep):
+        """Suffix shift opening one row at the first ~keep (vectorized
+        memmove); flags ERR_CAPACITY if a live last row falls off."""
+        t_err[...] = t_err[...] | jnp.where(
+            last & (t_live[...] > 0) & ~keep, ERR_CAPACITY, 0
+        )
+        for ref in (t_anchor, t_buf, t_len, t_iseq, t_iclient, t_rseq,
+                    t_live):
+            v = ref[...]
+            ref[...] = jnp.where(keep, v, _roll1_flat(v))
+        for k in range(KR):
+            v = t_rcl[k]
+            t_rcl[k] = jnp.where(keep, v, _roll1_flat(v))
+        for k in range(KK):
+            v = t_props[k]
+            t_props[k] = jnp.where(keep, v, _roll1_flat(v))
+
+    def split_at(pos, orefseq, oclient):
+        """Boundary split (overlay_ref._split / ensureIntervalBoundary,
+        mergeTree.ts:1706): span tails advance their anchor with the
+        offset; text tails keep theirs (both halves at one point)."""
+        skip, vis = visibility(orefseq, oclient)
+        delta = vis - consume()
+        prefix = t_anchor[...] + _cumsum_excl(delta)
+        inside = (
+            (~skip) & (prefix < pos) & (prefix + vis > pos)
+        ).astype(jnp.int32)
+        after = _cumsum_excl(inside)
+        keep = after == 0
+        shift_cols(keep)
+        at = (~keep) & (_roll1_flat(keep.astype(jnp.int32)) > 0)
+        at = at & (flat > 0)
+        off = pos - _roll1_flat(prefix)
+        is_span_tail = t_buf[...] >= SETTLED_BASE
+        t_anchor[...] = jnp.where(
+            at & is_span_tail, t_anchor[...] + off, t_anchor[...]
+        )
+        t_buf[...] = jnp.where(at, t_buf[...] + off, t_buf[...])
+        t_len[...] = jnp.where(at, t_len[...] - off, t_len[...])
+        t_len[...] = jnp.where(inside > 0, pos - prefix, t_len[...])
+
+    def body(i, _):
+        otype = op_type_ref[i]
+        pos1 = pos1_ref[i]
+        pos2 = pos2_ref[i]
+        oseq = seq_ref[i]
+        orefseq = ref_seq_ref[i]
+        oclient = client_ref[i]
+        obuf = buf_ref[i]
+        oilen = ilen_ref[i]
+
+        is_ins = otype == OP_INSERT
+        is_rem = otype == OP_REMOVE
+        is_ann = otype == OP_ANNOTATE
+        is_range = is_rem | is_ann
+
+        @pl.when(is_ins | is_range)
+        def _():
+            split_at(pos1, orefseq, oclient)
+
+        @pl.when(is_ins)
+        def _():
+            # Landing (overlay_ref._apply_insert / insertingWalk +
+            # breakTie, mergeTree.ts:1740,:1719). pre > pos1 means
+            # visible SETTLED text intervenes — land before that row
+            # regardless of tie-breaks (the overlay-specific clause);
+            # at pre == pos1 the row-model walk applies.
+            skip, vis = visibility(orefseq, oclient)
+            pre, dsum = pre_delta(vis)
+            live_pre = t_live[...] > 0
+            total = S + dsum
+            land_real = live_pre & (
+                (pre > pos1)
+                | ((pre == pos1) & (~skip)
+                   & ((vis > 0) | (oseq > t_iseq[...])))
+            )
+            land_all = land_real | ~live_pre
+            landi = land_all.astype(jnp.int32)
+            open_excl = _cumsum_excl(landi)
+            ft = land_all & (open_excl == 0)  # one-hot landing row
+            # New-row anchor, evaluated pre-shift at the landing index.
+            A = jnp.where(
+                land_real,
+                t_anchor[...] - (pre - pos1),
+                jnp.minimum(pos1 - dsum, S),
+            )
+            keep = (open_excl + landi) == 0
+            shift_cols(keep)
+            t_err[...] = t_err[...] | jnp.where(
+                ft & ~live_pre & (total < pos1), ERR_BAD_POS, 0
+            )
+            t_anchor[...] = jnp.where(ft, A, t_anchor[...])
+            t_buf[...] = jnp.where(ft, obuf, t_buf[...])
+            t_len[...] = jnp.where(ft, oilen, t_len[...])
+            t_iseq[...] = jnp.where(ft, oseq, t_iseq[...])
+            t_iclient[...] = jnp.where(ft, oclient, t_iclient[...])
+            t_rseq[...] = jnp.where(ft, NOT_REMOVED, t_rseq[...])
+            t_live[...] = jnp.where(ft, 1, t_live[...])
+            for k in range(KR):
+                t_rcl[k] = jnp.where(ft, NO_CLIENT, t_rcl[k])
+            for k in range(KK):
+                newv = jnp.int32(PROP_ABSENT)
+                for p in range(PK):
+                    pkey = pkey_ref[p * B + i]
+                    pval = pval_ref[p * B + i]
+                    v = jnp.where(pval == PROP_DELETE, PROP_ABSENT, pval)
+                    newv = jnp.where(pkey == k, v, newv)
+                t_props[k] = jnp.where(ft, newv, t_props[k])
+
+        @pl.when(is_range)
+        def _():
+            split_at(pos2, orefseq, oclient)
+            skip, vis = visibility(orefseq, oclient)
+            pre, dsum = pre_delta(vis)
+            total = S + dsum
+            t_err[...] = t_err[...] | jnp.where(
+                total < pos2, ERR_BAD_POS, 0
+            )
+
+            def coord_of(pos):
+                """Settled coordinate of visible position `pos`
+                (overlay_ref._coord_of; rows containing `pos` were
+                split). Broadcast tile, vector-domain only."""
+                live = t_live[...] > 0
+                cand = live & (pre >= pos)
+                oh = cand & (_cumsum_excl(cand.astype(jnp.int32)) == 0)
+                val = _allreduce_sum(
+                    jnp.where(oh, t_anchor[...] - (pre - pos), 0)
+                )
+                has = _allreduce_sum(oh.astype(jnp.int32)) > 0
+                return jnp.where(has, val, pos - dsum)
+
+            c1 = coord_of(pos1)
+            c2 = coord_of(pos2)
+
+            def gaps():
+                """Mask of storage gaps (gap k sits before row k) whose
+                settled coords intersect [c1, c2) — the rows to
+                materialize (overlay_ref "gap materialization")."""
+                live = t_live[...] > 0
+                end = t_anchor[...] + consume()
+                glo = jnp.where(flat == 0, 0, _roll1_flat(end))
+                ghi = jnp.where(live, t_anchor[...], S)
+                prev_live = (flat == 0) | (_roll1_flat(t_live[...]) > 0)
+                gapvalid = live | prev_live
+                lo = jnp.maximum(glo, c1)
+                hi = jnp.minimum(ghi, c2)
+                return (gapvalid & (lo < hi), lo, hi)
+
+            mat0, _, _ = gaps()
+            # The one per-op vector->scalar crossing: how many span
+            # rows this range op must materialize (usually 0-2; each
+            # materialization removes exactly one gap, so the count is
+            # stable across iterations).
+            n_mat = jnp.sum(mat0.astype(jnp.int32))
+
+            def gap_body(_, carry):
+                mat, lo, hi = gaps()
+                mi = mat.astype(jnp.int32)
+                oh = mat & (_cumsum_excl(mi) == 0)
+                ohi = oh.astype(jnp.int32)
+                keep = (_cumsum_excl(ohi) + ohi) == 0
+                shift_cols(keep)
+                t_anchor[...] = jnp.where(oh, lo, t_anchor[...])
+                t_buf[...] = jnp.where(oh, SETTLED_BASE + lo, t_buf[...])
+                t_len[...] = jnp.where(oh, hi - lo, t_len[...])
+                t_iseq[...] = jnp.where(oh, 0, t_iseq[...])
+                t_iclient[...] = jnp.where(oh, NO_CLIENT, t_iclient[...])
+                t_rseq[...] = jnp.where(oh, NOT_REMOVED, t_rseq[...])
+                t_live[...] = jnp.where(oh, 1, t_live[...])
+                for k in range(KR):
+                    t_rcl[k] = jnp.where(oh, NO_CLIENT, t_rcl[k])
+                for k in range(KK):
+                    t_props[k] = jnp.where(oh, PROP_ABSENT, t_props[k])
+                return carry
+
+            lax.fori_loop(0, n_mat, gap_body, 0)
+
+            # Covered-range updates (markRangeRemoved mergeTree.ts:1960
+            # / annotateRange :1895), visibility recomputed after the
+            # splits and materializations.
+            skip, vis = visibility(orefseq, oclient)
+            delta = vis - consume()
+            prefix = t_anchor[...] + _cumsum_excl(delta)
+            covered = (
+                (~skip) & (vis > 0) & (prefix >= pos1)
+                & (prefix + vis <= pos2)
+            )
+
+            @pl.when(is_rem)
+            def _():
+                already = t_rseq[...] != NOT_REMOVED
+                t_rseq[...] = jnp.where(
+                    covered & ~already, oseq, t_rseq[...]
+                )
+                first_free = jnp.full(shape, KR, jnp.int32)
+                for k in range(KR - 1, -1, -1):
+                    first_free = jnp.where(
+                        t_rcl[k] == NO_CLIENT, k, first_free
+                    )
+                no_free = first_free == KR
+                slot = jnp.where(already, first_free, 0)
+                write = covered & ~(already & no_free)
+                for k in range(KR):
+                    t_rcl[k] = jnp.where(
+                        write & (slot == k), oclient, t_rcl[k]
+                    )
+                t_err[...] = t_err[...] | jnp.where(
+                    covered & already & no_free, ERR_REMOVERS, 0
+                )
+
+            @pl.when(is_ann)
+            def _():
+                # Last writer wins; a delete tombstones on span rows
+                # (it must fold as a delete of the settled prop) but
+                # clears on text rows (they are authoritative).
+                is_span = t_buf[...] >= SETTLED_BASE
+                for p in range(PK):
+                    pkey = pkey_ref[p * B + i]
+                    pval = pval_ref[p * B + i]
+                    valid = pkey != NO_KEY
+                    newv = jnp.where(
+                        pval == PROP_DELETE,
+                        jnp.where(is_span, PROP_DELETE, PROP_ABSENT),
+                        jnp.broadcast_to(pval, shape),
+                    )
+                    for k in range(KK):
+                        t_props[k] = jnp.where(
+                            covered & valid & (pkey == k), newv,
+                            t_props[k],
+                        )
+
+        return 0
+
+    lax.fori_loop(0, nops_ref[0], body, 0)
+
+    nrows_out_ref[0] = jnp.sum(t_live[...])
+    err = t_err[...]
+    s = 1
+    while s < LANES:
+        err = err | pltpu.roll(err, s, 1)
+        s *= 2
+    s = 1
+    while s < err.shape[0]:
+        err = err | pltpu.roll(err, s, 0)
+        s *= 2
+    err_out_ref[0] = jnp.max(err)
+
+
+def _to_tiles(v):
+    return v.reshape(-1, LANES)
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def overlay_apply_chunk(table: OverlayTable, ops: OpBatch,
+                        interpret: bool = False) -> OverlayTable:
+    """Apply a chunk of sequenced ops (ascending seq order) to the
+    overlay in ONE pallas kernel invocation. Bit-identical to
+    `overlay_ref.OverlayDoc.apply` run op-by-op (differentially gated
+    by tests/test_overlay_pallas.py)."""
+    window = table.length.shape[0]
+    KR = table.rem_clients.shape[1]
+    KK = table.props.shape[1]
+    B = ops.pos1.shape[0]
+    PK = ops.prop_keys.shape[1]
+    assert window % (8 * LANES) == 0, "window must be a multiple of 1024"
+
+    tile_in = [
+        _to_tiles(table.anchor), _to_tiles(table.buf_start),
+        _to_tiles(table.length), _to_tiles(table.ins_seq),
+        _to_tiles(table.ins_client), _to_tiles(table.rem_seq),
+        jnp.moveaxis(table.rem_clients, 1, 0).reshape(KR, -1, LANES),
+        jnp.moveaxis(table.props, 1, 0).reshape(KK, -1, LANES),
+    ]
+    op_in = [
+        ops.op_type, ops.pos1, ops.pos2, ops.seq, ops.client,
+        ops.buf_start, ops.ins_len,
+        jnp.moveaxis(ops.prop_keys, 1, 0).reshape(PK * B),
+        jnp.moveaxis(ops.prop_vals, 1, 0).reshape(PK * B),
+        ops.ref_seq,
+    ]
+
+    smem = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = lambda: pl.BlockSpec(memory_space=pltpu.VMEM)
+    W8 = window // LANES
+    out_shapes = (
+        jax.ShapeDtypeStruct((W8, LANES), jnp.int32),  # anchor
+        jax.ShapeDtypeStruct((W8, LANES), jnp.int32),  # buf
+        jax.ShapeDtypeStruct((W8, LANES), jnp.int32),  # len
+        jax.ShapeDtypeStruct((W8, LANES), jnp.int32),  # ins_seq
+        jax.ShapeDtypeStruct((W8, LANES), jnp.int32),  # ins_client
+        jax.ShapeDtypeStruct((W8, LANES), jnp.int32),  # rem_seq
+        jax.ShapeDtypeStruct((KR, W8, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((KK, W8, LANES), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # n_rows
+        jax.ShapeDtypeStruct((1,), jnp.int32),  # error
+    )
+    outs = pl.pallas_call(
+        _overlay_chunk_kernel,
+        out_shape=out_shapes,
+        in_specs=[smem()] * 14 + [vmem()] * 8,
+        out_specs=tuple([vmem()] * 8 + [smem(), smem()]),
+        scratch_shapes=[
+            pltpu.VMEM((W8, LANES), jnp.int32),  # live column
+            pltpu.VMEM((W8, LANES), jnp.int32),  # error accumulator
+        ],
+        interpret=interpret,
+    )(
+        jnp.reshape(table.n_rows, (1,)), jnp.reshape(table.error, (1,)),
+        jnp.asarray([B], jnp.int32),
+        jnp.reshape(table.settled_len, (1,)),
+        *op_in, *tile_in,
+    )
+    (anchor, buf, length, iseq, iclient, rseq, rcl, props, nrows,
+     err) = outs
+    return OverlayTable(
+        n_rows=nrows[0],
+        anchor=anchor.reshape(-1),
+        buf_start=buf.reshape(-1),
+        length=length.reshape(-1),
+        ins_seq=iseq.reshape(-1),
+        ins_client=iclient.reshape(-1),
+        rem_seq=rseq.reshape(-1),
+        rem_clients=jnp.moveaxis(rcl.reshape(KR, -1), 0, 1),
+        props=jnp.moveaxis(props.reshape(KK, -1), 0, 1),
+        settled_len=table.settled_len,
+        error=err[0],
+    )
+
+
+@jax.jit
+def fold_device(table: OverlayTable, msn: jnp.ndarray):
+    """Settle-merge under applied MSN `msn` (overlay_ref.fold; the
+    zamboni role, zamboni.ts:19) as one XLA dispatch.
+
+    Returns ``(table', records, n_rec)``: surviving rows re-anchored
+    and packed to the front (stable payload sort — no gathers, see
+    module docstring), plus the folded rows as a dense ``(W, 4+KK)``
+    record block in storage (== coordinate) order: columns
+    ``[anchor, code, buf, len, props...]`` with pre-fold anchors, for
+    the host-side settled-state reconstruction.
+    """
+    W = table.length.shape[0]
+    KR = table.rem_clients.shape[1]
+    KK = table.props.shape[1]
+    idx = jnp.arange(W, dtype=jnp.int32)
+    live = idx < table.n_rows
+    is_span = live & (table.buf_start >= SETTLED_BASE)
+    removed = live & (table.rem_seq != NOT_REMOVED)
+    drop = removed & (table.rem_seq <= msn)
+    settle_text = live & ~removed & ~is_span & (table.ins_seq <= msn)
+    settle_span = live & ~removed & is_span
+    folding = drop | settle_text | settle_span
+
+    exc = jnp.where(drop & is_span, table.length, 0)
+    ins = jnp.where(settle_text, table.length, 0)
+    exc_b = jnp.cumsum(exc) - exc
+    ins_b = jnp.cumsum(ins) - ins
+    new_anchor = (table.anchor - exc_b + ins_b).astype(jnp.int32)
+    new_s = table.settled_len + jnp.sum(ins) - jnp.sum(exc)
+
+    keep = live & ~folding
+    n_new = jnp.sum(keep.astype(jnp.int32))
+    new_buf = jnp.where(is_span, SETTLED_BASE + new_anchor,
+                        table.buf_start)
+    cols = (
+        new_anchor, new_buf, table.length, table.ins_seq,
+        table.ins_client, table.rem_seq,
+        *(table.rem_clients[:, k] for k in range(KR)),
+        *(table.props[:, k] for k in range(KK)),
+    )
+    packed = _pack_sort(jnp.where(keep, 0, 1).astype(jnp.int32), cols)
+    valid = idx < n_new
+
+    def fill(a, f):
+        return jnp.where(valid, a, f)
+
+    out = OverlayTable(
+        n_rows=n_new,
+        anchor=fill(packed[0], 0),
+        buf_start=fill(packed[1], 0),
+        length=fill(packed[2], 0),
+        ins_seq=fill(packed[3], 0),
+        ins_client=fill(packed[4], NO_CLIENT),
+        rem_seq=fill(packed[5], NOT_REMOVED),
+        rem_clients=jnp.where(
+            valid[:, None], jnp.stack(packed[6:6 + KR], axis=1), NO_CLIENT
+        ),
+        props=jnp.where(
+            valid[:, None], jnp.stack(packed[6 + KR:], axis=1), PROP_ABSENT
+        ),
+        settled_len=new_s.astype(jnp.int32),
+        error=table.error,
+    )
+
+    code = jnp.where(
+        settle_text, REC_SETTLE_TEXT,
+        jnp.where(drop & is_span, REC_DROP_SPAN,
+                  jnp.where(settle_span, REC_SETTLE_SPAN, 0)),
+    ).astype(jnp.int32)
+    recmask = code > 0  # dropped text rows reconstruct to nothing
+    n_rec = jnp.sum(recmask.astype(jnp.int32))
+    rcols = (
+        table.anchor, code, table.buf_start, table.length,
+        *(table.props[:, k] for k in range(KK)),
+    )
+    rpacked = _pack_sort(
+        jnp.where(recmask, 0, 1).astype(jnp.int32), rcols
+    )
+    records = jnp.stack(rpacked, axis=1)  # (W, 4+KK)
+    return out, records, n_rec
+
+
+@functools.partial(
+    jax.jit, static_argnums=(5, 6), donate_argnums=(0, 2, 3)
+)
+def replay_fused(
+    table: OverlayTable, stream_ops: OpBatch, log, counts, msn_by_chunk,
+    chunk: int, interpret: bool = False,
+):
+    """The WHOLE replay as one dispatch: `lax.fori_loop` over chunks,
+    each iteration = pallas apply + XLA fold + log append, all
+    device-resident (stream, msn schedule, log, table ride the loop
+    carry; XLA keeps the donated log in place). One host->device
+    dispatch replaces ~n/chunk of them — the host loop and its
+    per-chunk scalar uploads are the dominant cost once the kernel is
+    O(window), so fusing is worth ~10x wall-clock on a tunneled TPU.
+
+    `msn_by_chunk[ci]` is the applied MSN at chunk ci's end (the fold
+    perspective). Returns ``(table, log, counts, cursor)``."""
+    n_chunks = msn_by_chunk.shape[0]
+
+    def step(ci, carry):
+        table, log, counts, cursor = carry
+        table, log, counts, cursor = _chunk_step_body(
+            table, stream_ops, ci * chunk, chunk, msn_by_chunk[ci],
+            log, counts, cursor, ci, interpret,
+        )
+        return (table, log, counts, cursor)
+
+    return lax.fori_loop(
+        0, n_chunks, step, (table, log, counts, jnp.int32(0))
+    )
+
+
+def _chunk_step_body(
+    table, stream_ops, lo, chunk, msn, log, counts, cursor, epoch,
+    interpret,
+):
+    """One steady-state replay step, fully device-side: slice ops
+    [lo, lo+chunk) from the device-resident stream, run the pallas
+    chunk kernel, fold at the chunk boundary, and append the fold
+    records to the HBM log (donated: XLA updates in place).
+
+    Returns ``(table', log', counts', cursor')``; ``counts[epoch]``
+    records this epoch's record count so the host can reconstruct the
+    settled document epoch-by-epoch after the run."""
+    sl = lambda a: lax.dynamic_slice_in_dim(a, lo, chunk, axis=0)
+    batch = OpBatch(
+        op_type=sl(stream_ops.op_type), pos1=sl(stream_ops.pos1),
+        pos2=sl(stream_ops.pos2), seq=sl(stream_ops.seq),
+        ref_seq=sl(stream_ops.ref_seq), client=sl(stream_ops.client),
+        buf_start=sl(stream_ops.buf_start),
+        ins_len=sl(stream_ops.ins_len),
+        prop_keys=sl(stream_ops.prop_keys),
+        prop_vals=sl(stream_ops.prop_vals),
+    )
+    table = overlay_apply_chunk(table, batch, interpret)
+    table, records, n_rec = fold_device(table, msn)
+    log = lax.dynamic_update_slice(
+        log, records, (cursor, jnp.int32(0))
+    )
+    counts = counts.at[epoch].set(n_rec)
+    return table, log, counts, cursor + n_rec
+
+
+@functools.partial(
+    jax.jit, static_argnums=(3, 9), donate_argnums=(0, 5, 6)
+)
+def replay_chunk_step(
+    table: OverlayTable, stream_ops: OpBatch, lo, chunk: int,
+    msn, log, counts, cursor, epoch, interpret: bool = False,
+):
+    """One replay step as its own dispatch (the incremental form:
+    warm-up with `limit_chunks`, message-driven replicas, tests).
+    `replay_fused` runs the same body for the whole stream in one
+    dispatch."""
+    return _chunk_step_body(
+        table, stream_ops, lo, chunk, msn, log, counts, cursor, epoch,
+        interpret,
+    )

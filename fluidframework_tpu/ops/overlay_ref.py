@@ -86,6 +86,20 @@ from .mergetree_kernel import (
 SETTLED_BASE = 1 << 30  # buf encoding for span rows: SETTLED_BASE + coord
 
 
+def merge_span_props(seg_p: np.ndarray, row_p: np.ndarray) -> np.ndarray:
+    """Resolve a span row's prop cells over a settled-props slice:
+    PROP_DELETE tombstones clear the key, PROP_ABSENT leaves it, any
+    other value overwrites. The ONE definition of span-prop
+    resolution — used by fold, read-out, and log reconstruction."""
+    out = seg_p.copy()
+    for k in range(seg_p.shape[1]):
+        if row_p[k] == PROP_DELETE:
+            out[:, k] = PROP_ABSENT
+        elif row_p[k] != PROP_ABSENT:
+            out[:, k] = row_p[k]
+    return out
+
+
 class OverlayDoc:
     """Numpy reference overlay document (dynamic arrays, one op/call)."""
 
@@ -350,15 +364,10 @@ class OverlayDoc:
                 cursor = a + ln  # excise
             elif settle_span[i]:
                 take_settled(a)
-                seg_p = self.settled_props[a: a + ln].copy()
-                row_p = self.props[i]
-                for k in range(self.KK):
-                    if row_p[k] == PROP_DELETE:
-                        seg_p[:, k] = PROP_ABSENT
-                    elif row_p[k] != PROP_ABSENT:
-                        seg_p[:, k] = row_p[k]
                 pieces_t.append(self.settled_text[a: a + ln])
-                pieces_p.append(seg_p)
+                pieces_p.append(merge_span_props(
+                    self.settled_props[a: a + ln], self.props[i]
+                ))
                 cursor = a + ln
             # drop & text row: nothing to do (just removed from overlay)
         take_settled(self.S)
@@ -463,18 +472,13 @@ class OverlayMessageReplica:
         self._msn = 0
 
     def apply_messages(self, msgs) -> None:
-        from ..core.kernel_replica import KernelReplica
+        from ..core.kernel_replica import EncoderState, encode_op
         from ..protocol.messages import MessageType
 
-        enc = KernelReplica.__new__(KernelReplica)
-        enc.arena = self.arena
-        enc.props = self.props
-        enc.max_prop_pairs = self.max_prop_pairs
-        enc._encoded = []
-        enc._pending_rows_bound = 0
+        enc = EncoderState(self.arena, self.props, self.max_prop_pairs)
         for msg in msgs:
             if msg.type == MessageType.OP and msg.contents is not None:
-                enc._encode_op(msg.contents, msg)
+                encode_op(enc, msg.contents, msg)
                 for row in enc._encoded:
                     (t, p1, p2, s, r, c, b, ln, ks, vs, msn) = row
                     self.doc.apply(t, p1, p2, s, r, c, b, ln, ks, vs)
@@ -587,14 +591,10 @@ class OverlayReplica:
                 continue
             ln = int(d.length[i])
             if is_span[i]:
-                seg_p = d.settled_props[a: a + ln].copy()
-                row_p = d.props[i]
-                for k in range(d.KK):
-                    if row_p[k] == PROP_DELETE:
-                        seg_p[:, k] = PROP_ABSENT
-                    elif row_p[k] != PROP_ABSENT:
-                        seg_p[:, k] = row_p[k]
-                out.append((d.settled_text[a: a + ln], seg_p))
+                out.append((
+                    d.settled_text[a: a + ln],
+                    merge_span_props(d.settled_props[a: a + ln], d.props[i]),
+                ))
                 cursor = a + ln
             else:
                 row_p = d.props[i].copy()

@@ -232,13 +232,23 @@ class BroadcasterLambda:
         alfred/index.ts:211)."""
         try:
             getattr(sock, meth)(msg)
-        except Exception:
-            import traceback
-
+        except Exception as exc:
             # Loud eviction: an application error in a replica's
-            # listener (vs a transport ConnectionError) must stay
-            # visible, or divergence debugging loses its stack trace.
-            traceback.print_exc()
+            # listener must stay visible, or divergence debugging
+            # loses its stack trace. Transport failures (closed pipe,
+            # full buffer) are the routine eviction case and log as
+            # one line.
+            if isinstance(exc, (ConnectionError, OSError, TimeoutError)):
+                import sys
+
+                print(
+                    f"broadcaster: evicting socket on transport error "
+                    f"({exc!r})", file=sys.stderr,
+                )
+            else:
+                import traceback
+
+                traceback.print_exc()
             self.leave_room(doc, sock)
             failed.append(sock)
 

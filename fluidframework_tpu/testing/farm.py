@@ -42,7 +42,7 @@ def random_op_for(
     client: CollabClient, rng: random.Random, cfg: FarmConfig
 ) -> Optional[DocumentMessage]:
     """One random local op on `client` (insert/remove/annotate mix)."""
-    length = len(client.get_text())
+    length = client.visible_length()
     r = rng.random()
     total = cfg.insert_weight + cfg.remove_weight + cfg.annotate_weight
     r *= total

@@ -81,7 +81,7 @@ def test_farm_invariant_verifier_catches_corruption():
     """The verifier must actually detect broken state."""
     from fluidframework_tpu.core.mergetree import CollabClient
 
-    c = CollabClient(1, initial="hello")
+    c = CollabClient(1, initial="hello", engine="python")
     c.engine.segments[0].removed_clients.append(9)  # remover w/o removal
     with pytest.raises(AssertionError):
         c.engine.verify_invariants()

@@ -118,7 +118,7 @@ class TestConcurrency:
 class TestCollabClients:
     def _wire(self, n, initial=""):
         seqr = DocumentSequencer()
-        clients = [CollabClient(i + 1, initial=initial) for i in range(n)]
+        clients = [CollabClient(i + 1, initial=initial, engine="python") for i in range(n)]
         for c in clients:
             seqr.join(c.client_id)
         for c in clients:

@@ -1,0 +1,196 @@
+"""Differential tests: native C++ host engine vs the Python oracle.
+
+The native engine (native/hostmerge.cpp via core/native_engine.py) is
+a port of the oracle's exact segment-list algorithm; these farms gate
+it bit-for-bit on real concurrency (lagging refSeqs, tie-breaks,
+overlapping removes, pending-prop shadowing, acks, zamboni), plus the
+reconnect regeneration path and the permutation-vector queries the
+matrix DDS uses.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.core.mergetree import (
+    CollabClient,
+    MergeTreeEngine,
+    replay_passive,
+)
+from fluidframework_tpu.core.native_engine import (
+    NativeMergeEngine,
+    native_available,
+)
+from fluidframework_tpu.protocol.constants import UNASSIGNED_SEQ
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.testing.farm import (
+    FarmConfig,
+    char_spans,
+    run_sharedstring_farm,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no C++ compiler for hostmerge"
+)
+
+
+def replay_native(stream, initial=""):
+    """Passive native replica over a sequenced message stream (the
+    native analog of replay_passive)."""
+    from fluidframework_tpu.core.mergetree import apply_remote_op
+
+    eng = NativeMergeEngine()
+    if initial:
+        eng.load(initial)
+
+    class _Shim:
+        pass
+
+    for msg in stream:
+        if msg.type == MessageType.OP and msg.contents is not None:
+            apply_remote_op(
+                eng, msg.contents, msg.ref_seq, msg.client_id,
+                msg.sequence_number,
+            )
+        eng.current_seq = msg.sequence_number
+        eng.update_min_seq(max(eng.min_seq, msg.minimum_sequence_number))
+    return eng
+
+
+def farm_native_vs_oracle(cfg: FarmConfig):
+    farm = run_sharedstring_farm(cfg)
+    oracle = replay_passive(farm.stream, cfg.initial_text)
+    native = replay_native(farm.stream, cfg.initial_text)
+    assert native.get_text() == oracle.get_text()
+    assert char_spans(native.annotated_spans()) == char_spans(
+        oracle.annotated_spans()
+    )
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_native_passive_matches_oracle(seed):
+    farm_native_vs_oracle(
+        FarmConfig(num_clients=4, rounds=8, ops_per_client_per_round=4,
+                   seed=seed)
+    )
+
+
+def test_native_remove_heavy():
+    farm_native_vs_oracle(
+        FarmConfig(
+            num_clients=4, rounds=10, ops_per_client_per_round=4, seed=12,
+            insert_weight=0.3, remove_weight=0.6, annotate_weight=0.1,
+            initial_text="the quick brown fox jumps over the lazy dog",
+        )
+    )
+
+
+def test_native_annotate_heavy():
+    farm_native_vs_oracle(
+        FarmConfig(
+            num_clients=6, rounds=10, ops_per_client_per_round=4, seed=99,
+            insert_weight=0.2, remove_weight=0.2, annotate_weight=0.6,
+            initial_text="annotation heavy doc " * 3,
+        )
+    )
+
+
+class NativeCollabClient(CollabClient):
+    """CollabClient on the native engine (local pending ops + acks)."""
+
+    def __init__(self, client_id: int, initial: str = ""):
+        self.client_id = client_id
+        self.engine = NativeMergeEngine(client_id)
+        if initial:
+            self.engine.load(initial)
+        self.client_seq = 0
+
+
+def test_native_interactive_farm_convergence():
+    """Mixed farm: native and oracle clients collaborate in one
+    session and must converge identically — the strongest gate (local
+    pending state, acks, tie-breaks exercised on BOTH engines)."""
+    from fluidframework_tpu.server.sequencer import DocumentSequencer
+
+    rng = random.Random(7)
+    seqr = DocumentSequencer("mixed")
+    initial = "shared starting text"
+    clients = [
+        NativeCollabClient(1, initial),
+        CollabClient(2, initial),
+        NativeCollabClient(3, initial),
+        CollabClient(4, initial),
+    ]
+    stream = []
+    for c in clients:
+        stream.append(seqr.join(c.client_id))
+    for c in clients:
+        for m in stream:
+            c.apply_msg(m)
+        c.engine.current_seq = seqr.seq
+    from fluidframework_tpu.testing.farm import FarmConfig, random_op_for
+
+    cfg = FarmConfig()
+    for rnd in range(12):
+        pending = []
+        for c in clients:
+            for _ in range(3):
+                msg = random_op_for(c, rng, cfg)
+                if msg is not None:
+                    pending.append((c.client_id, msg))
+        seqd = []
+        for cid, msg in pending:
+            out = seqr.sequence(cid, msg)
+            assert out.__class__.__name__ == "SequencedMessage", out
+            seqd.append(out)
+        for c in clients:
+            for m in seqd:
+                c.apply_msg(m)
+        texts = [c.get_text() for c in clients]
+        assert len(set(texts)) == 1, f"round {rnd}: divergence"
+    spans = [char_spans(c.engine.annotated_spans()) for c in clients]
+    assert all(s == spans[0] for s in spans[1:])
+
+
+def test_native_regenerate_insert_and_remove():
+    """Reconnect regeneration parity: run the same pending state on
+    both engines, regenerate, and compare the resubmitted ops."""
+    from fluidframework_tpu.protocol.mergetree_ops import InsertOp, RemoveOp
+
+    for Engine in (MergeTreeEngine, NativeMergeEngine):
+        eng = (
+            Engine(local_client_id=9)
+            if Engine is MergeTreeEngine else Engine(9)
+        )
+        eng.collaborating = True
+        eng.load("abcdefgh")
+        eng.insert(4, "XY", 0, 9, UNASSIGNED_SEQ)
+        grp_ins = (
+            list(eng.pending)[-1]
+            if Engine is MergeTreeEngine else eng.pending[-1]
+        )
+        eng.remove_range(1, 3, 0, 9, UNASSIGNED_SEQ)
+        grp_rem = (
+            list(eng.pending)[-1]
+            if Engine is MergeTreeEngine else eng.pending[-1]
+        )
+        op_i, g_i = eng.regenerate_pending([grp_ins], InsertOp(pos=4, text="XY"))
+        op_r, g_r = eng.regenerate_pending([grp_rem], RemoveOp(start=1, end=3))
+        assert isinstance(op_i, InsertOp) and op_i.pos == 4
+        assert op_i.text == "XY"
+        assert isinstance(op_r, RemoveOp)
+        assert (op_r.start, op_r.end) == (1, 3)
+        assert len(g_i) == 1 and len(g_r) == 1
+
+
+def test_native_permutation_queries():
+    eng = NativeMergeEngine(5)
+    eng.collaborating = True
+    eng.load([10, 11, 12, 13])
+    eng.insert(2, [50, 51], 0, 5, UNASSIGNED_SEQ)
+    assert eng.get_items() == [10, 11, 50, 51, 12, 13]
+    assert eng.item_at(2, eng.current_seq, 5) == 50
+    assert eng.position_of_item(12, eng.current_seq, 5) == 4
+    assert eng.position_of_item(999, eng.current_seq, 5) is None
+    eng.remove_range(0, 2, 0, 5, UNASSIGNED_SEQ)
+    assert eng.get_items() == [50, 51, 12, 13]

@@ -31,30 +31,18 @@ def main() -> None:
     stage = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
     n_clients, seed, initial_len = 1024, 7, 64
 
-    from fluidframework_tpu.core.mergetree import (
-        MergeTreeEngine, apply_remote_op,
-    )
-    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.core.mergetree import replay_passive
     from fluidframework_tpu.testing.synthetic import generate_stream
 
     stream = generate_stream(
         n_ops, n_clients=n_clients, seed=seed, initial_len=initial_len
     )
-    engine = MergeTreeEngine()
-    engine.load("".join(map(chr, stream.text[:initial_len])))
 
     stages = {}
     t0 = time.perf_counter()
-    for i, msg in enumerate(stream.as_messages(), 1):
-        if msg.type == MessageType.OP and msg.contents is not None:
-            apply_remote_op(
-                engine, msg.contents, msg.ref_seq, msg.client_id,
-                msg.sequence_number,
-            )
-        engine.current_seq = msg.sequence_number
-        engine.update_min_seq(
-            max(engine.min_seq, msg.minimum_sequence_number)
-        )
+
+    def checkpoint(i0: int, engine) -> None:
+        i = i0 + 1
         if i % stage == 0 or i == n_ops:
             d = state_digest(engine.annotated_spans())
             stages[str(i)] = d
@@ -63,6 +51,15 @@ def main() -> None:
                 f"[oracle] {i}/{n_ops} ops, {el:.0f}s, digest {d[:16]}...",
                 flush=True,
             )
+
+    # The staged replay runs THROUGH replay_passive itself (per-message
+    # hook), so the recorded ground truth cannot drift from the oracle
+    # semantics every engine is gated against.
+    replay_passive(
+        stream.as_messages(),
+        initial="".join(map(chr, stream.text[:initial_len])),
+        on_message=checkpoint,
+    )
 
     digest = stages[str(n_ops)]
     path = os.path.join(
