@@ -36,6 +36,9 @@ class FarmConfig:
     annotate_keys: Tuple[str, ...] = ("bold", "color", "size")
     initial_text: str = "hello world"
     check_annotations: bool = True
+    # Annotate ops carry 1..len(annotate_keys) keys per op (PK>1
+    # coverage for the kernels' prop-pair loops).
+    multi_key_annotates: bool = False
 
 
 def random_op_for(
@@ -56,9 +59,15 @@ def random_op_for(
     end = rng.randint(start + 1, min(length, start + 8))
     if r < cfg.remove_weight:
         return client.remove_local(start, end)
-    key = rng.choice(cfg.annotate_keys)
-    value = rng.choice([rng.randint(0, 9), "x", None])
-    return client.annotate_local(start, end, {key: value})
+    if cfg.multi_key_annotates:
+        n_keys = rng.randint(1, len(cfg.annotate_keys))
+        keys = rng.sample(list(cfg.annotate_keys), n_keys)
+    else:
+        keys = [rng.choice(cfg.annotate_keys)]
+    props = {
+        k: rng.choice([rng.randint(0, 9), "x", None]) for k in keys
+    }
+    return client.annotate_local(start, end, props)
 
 
 @dataclass

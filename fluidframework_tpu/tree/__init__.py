@@ -20,6 +20,7 @@ from .branch import SharedTreeBranch
 from .changeset import (
     compose,
     insert_op,
+    move_op,
     invert,
     rebase_change,
     remove_op,

@@ -31,7 +31,7 @@ core/rebase/verifyChangeRebaser.ts) and multi-client convergence fuzz.
 from __future__ import annotations
 
 import copy
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 Change = List[dict]
 
@@ -53,6 +53,26 @@ def remove_op(path: List[list], field: str, index: int, count: int = 1) -> dict:
 
 def set_value_op(path: List[list], value: Any) -> dict:
     return {"type": "setValue", "path": list(path), "value": value}
+
+
+def move_op(path: List[list], field: str, index: int, count: int,
+            dst_path: List[list], dst_field: str, dst_index: int) -> dict:
+    """Move `count` nodes from (path, field)[index:index+count] to
+    (dst_path, dst_field) at gap `dst_index`. Cross-field and
+    cross-parent moves are first-class (the role of the reference's
+    cross-field move-effect table,
+    feature-libraries/sequence-field/compose.ts + moveEffectTable.ts).
+
+    ALL coordinates — source and destination — are in the op's input
+    (pre-op) frame; `Forest.apply` performs the detach-then-attach
+    conversion itself (a destination gap inside the moved range clamps
+    to its start). One uniform frame keeps the rebase arithmetic's
+    gap-tie comparisons exact."""
+    return {
+        "type": "move", "path": list(path), "field": field,
+        "index": index, "count": count, "dst_path": list(dst_path),
+        "dst_field": dst_field, "dst_index": dst_index,
+    }
 
 
 # --------------------------------------------------------------------------
@@ -95,6 +115,11 @@ def invert(change: Change) -> Change:
             out.append(
                 {"type": "setValue", "path": op["path"], "value": op["prev"]}
             )
+        elif t == "move":
+            if op.get("muted"):
+                continue  # applied as a no-op (cycle guard): nothing to undo
+            assert "inverse" in op, "invert needs an applied move"
+            out.append(copy.deepcopy(op["inverse"]))
     return out
 
 
@@ -136,10 +161,75 @@ def _adjust_index(
     return index
 
 
-def _rebase_path(path: List[list], base: dict) -> Optional[List[list]]:
-    """Adjust a node path for `base`; None if an ancestor was removed."""
+def _attach_gap(base: dict) -> int:
+    """A move's attach position in its POST-DETACH frame (the frame
+    adjustments operate in after applying the detach half). Gaps
+    inside the moved range clamp to its start."""
+    j = base["dst_index"]
+    if (base["dst_path"] == base["path"]
+            and base["dst_field"] == base["field"]):
+        i, n = base["index"], base["count"]
+        if j >= i + n:
+            return j - n
+        if j > i:
+            return i
+    return j
+
+
+def _dst_path_post(base: dict) -> List[list]:
+    """A move's destination path converted to the POST-BASE frame:
+    steps through the base's own source field shift when they sit
+    past the detached range (the conversion Forest.apply performs;
+    rebased follower ops must use the same frame)."""
+    dp = [list(s) for s in base["dst_path"]]
+    plen = len(base["path"])
+    if (len(dp) > plen and dp[:plen] == [list(s) for s in base["path"]]
+            and dp[plen][0] == base["field"]
+            and dp[plen][1] >= base["index"] + base["count"]):
+        dp[plen][1] -= base["count"]
+    return dp
+
+
+def _move_parts(base: dict) -> Tuple[dict, dict]:
+    """A move base as its detach (remove-like) and attach
+    (insert-like) halves. The attach half's index AND path are
+    converted to the post-detach frame so adjustments apply
+    detach-then-attach consistently."""
+    rm = {"type": "remove", "path": base["path"], "field": base["field"],
+          "index": base["index"], "count": base["count"]}
+    ins = {"type": "insert", "path": _dst_path_post(base),
+           "field": base["dst_field"], "index": _attach_gap(base),
+           "content": [None] * base["count"]}
+    return rm, ins
+
+
+def _rebase_path(path: List[list], base: dict,
+                 base_first: bool = True) -> Optional[List[list]]:
+    """Adjust a node path for `base`; None if an ancestor was removed.
+    A path descending through nodes a base MOVE carried away is
+    RE-ROOTED at the destination — edits follow moves (the reference's
+    move-effect semantics, sequence-field/moveEffectTable.ts)."""
     if base["type"] == "setValue":
         return path
+    if base["type"] == "move":
+        rm, ins = _move_parts(base)
+        bpath, bfield = base["path"], base["field"]
+        lo, n = base["index"], base["count"]
+        if len(path) > len(bpath) and path[: len(bpath)] == bpath:
+            field, index = path[len(bpath)]
+            if field == bfield and lo <= index < lo + n:
+                # Follow the move: re-root under the destination (in
+                # the POST-BASE frame).
+                new_step = [base["dst_field"], _attach_gap(base) + (index - lo)]
+                return (
+                    _dst_path_post(base)
+                    + [new_step]
+                    + [list(s) for s in path[len(bpath) + 1:]]
+                )
+        p = _rebase_path(path, rm, base_first)
+        if p is None:
+            return None  # unreachable: in-range refs follow above
+        return _rebase_path(p, ins, base_first)
     bpath = base["path"]
     bfield = base["field"]
     # Does base edit a field that is an ancestor step of `path`?
@@ -163,15 +253,263 @@ def _rebase_path(path: List[list], base: dict) -> Optional[List[list]]:
     return new_path
 
 
+def _same_field(a_path, a_field, b: dict) -> bool:
+    return b["path"] == a_path and b["field"] == a_field
+
+
+def _sequentialize(parts: List[dict]) -> Optional[dict]:
+    """Convert range-op parts expressed in ONE common frame (and in
+    source-node order) into a sequentially-applicable op list: each
+    part self-rebases over its predecessors (parts are disjoint, so
+    this never re-splits; shared destination gaps resolve
+    earlier-part-first, preserving source order)."""
+    out: List[dict] = []
+    for p in parts:
+        q: Optional[dict] = p
+        for prev in out:
+            q = rebase_op(q, prev, base_first=True)
+            if q is None:
+                break
+            assert q.get("type") != "multi", "disjoint parts re-split"
+        if q is not None:
+            out.append(q)
+    if not out:
+        return None
+    if len(out) == 1:
+        return out[0]
+    return {"type": "multi", "ops": out}
+
+
+def _range_over_base(op: dict, base: dict,
+                     base_first: bool) -> Optional[dict]:
+    """Adjust a RANGE op (remove, or the source end of a move) whose
+    (path, field) equals the base edit's. Returns op / multi / None."""
+    start, count = op["index"], op["count"]
+    if base["type"] == "insert":
+        b, n = base["index"], len(base["content"])
+        if b <= start:
+            return {**op, "index": start + n}
+        if b < start + count:
+            if op["type"] == "move":
+                # Content inserted strictly inside a moved block
+                # TRAVELS with it (the block is one unit; the dual
+                # gap rule sends inserts inside a moved range to the
+                # destination) — absorb it.
+                return {**op, "count": count + n}
+            # A remove must not consume content it never saw: split
+            # around it (parts in the common post-base frame, then
+            # sequentialized).
+            left = b - start
+            return _sequentialize([
+                {**op, "index": start, "count": left},
+                {**op, "index": b + n, "count": count - left},
+            ])
+        return op
+    if base["type"] == "remove":
+        b, n = base["index"], base["count"]
+        o_start, o_end = start, start + count
+        lost = max(0, min(o_end, b + n) - max(o_start, b))
+        new_count = count - lost
+        if new_count <= 0:
+            return None  # fully consumed: removed content wins
+        new_start = o_start if o_start < b else max(b, o_start - n)
+        return {**op, "index": new_start, "count": new_count}
+    if base["type"] == "move":
+        rm, ins = _move_parts(base)
+        if not _same_field(op["path"], op["field"], rm):
+            # Our range holds no moved-out nodes; only the attach side
+            # can shift or split it.
+            if _same_field(op["path"], op["field"], ins):
+                return _range_over_base(op, ins, base_first)
+            return op
+        b, n = base["index"], base["count"]
+        o_start, o_end = start, start + count
+        ov_lo, ov_hi = max(o_start, b), min(o_end, b + n)
+        if ov_lo >= ov_hi:
+            # No node overlap: source-field detach, then the full
+            # attach treatment (which splits a remove around — or has
+            # a move absorb — a same-field re-attach landing inside
+            # the adjusted range).
+            p = _range_over_base(op, rm, base_first)
+            return _multi_map(
+                p,
+                lambda q: (
+                    _range_over_base(q, ins, base_first)
+                    if _same_field(q["path"], q.get("field"), ins)
+                    else q
+                ),
+            )
+        # Overlapping nodes were carried to base's destination.
+        parts: List[dict] = []
+        for lo, hi, at_dst in (
+            (o_start, ov_lo, False), (ov_lo, ov_hi, True),
+            (ov_hi, o_end, False),
+        ):
+            if lo >= hi:
+                continue
+            cnt = hi - lo
+            if at_dst:
+                if op["type"] == "move" and not base_first:
+                    continue  # base sequenced LATER: its move wins
+                # Follow: the nodes now live at base's destination.
+                follow = {
+                    **op,
+                    "path": _dst_path_post(base),
+                    "field": base["dst_field"],
+                    "index": _attach_gap(base) + (lo - b),
+                    "count": cnt,
+                }
+                parts.append(follow)
+            else:
+                part = _range_over_base(
+                    {**op, "index": lo, "count": cnt}, rm, base_first
+                )
+                part = _multi_map(
+                    part,
+                    lambda q: (
+                        _range_over_base(q, ins, base_first)
+                        if _same_field(q["path"], q.get("field"), ins)
+                        else q
+                    ),
+                )
+                parts.extend(_flatten_one(part))
+        if not parts:
+            return None
+        # Parts were built in source-node order in the common
+        # post-base frame; sequentialize for application.
+        return _sequentialize(parts)
+    return op
+
+
+def _multi_map(op: Optional[dict], fn) -> Optional[dict]:
+    if op is None:
+        return None
+    if op.get("type") == "multi":
+        ops = []
+        for q in op["ops"]:
+            r = fn(q)
+            ops.extend(_flatten_one(r))
+        if not ops:
+            return None
+        return {"type": "multi", "ops": ops} if len(ops) > 1 else ops[0]
+    return fn(op)
+
+
+def _gap_over_base(index: int, path, field, base: dict,
+                   base_first: bool):
+    """Adjust an insertion GAP (insert index, or a move's destination
+    gap) in (path, field) over `base`. Returns ``(index, path,
+    field)`` — a gap strictly inside a base-moved block TRAVELS with
+    it to the destination field."""
+    if base["type"] == "setValue":
+        return index, path, field
+    if base["type"] == "move":
+        rm, ins = _move_parts(base)
+        idx = index
+        if _same_field(path, field, rm):
+            b, n = base["index"], base["count"]
+            if b < idx < b + n:
+                # A gap strictly inside the moved block travels with
+                # it to the destination (content is one unit; the
+                # dual: the move absorbs content inserted there).
+                return (
+                    _attach_gap(base) + (idx - b),
+                    _dst_path_post(base),
+                    base["dst_field"],
+                )
+            idx = _adjust_index(idx, rm, is_insert_at=True,
+                                base_first=base_first)
+        if _same_field(path, field, ins):
+            # Both gaps are now in the post-detach frame (ins.index is
+            # the converted attach gap), so ties compare exactly.
+            b, n = ins["index"], base["count"]
+            if b < idx or (b == idx and base_first):
+                idx = idx + n
+        return idx, path, field
+    if _same_field(path, field, base):
+        return (
+            _adjust_index(index, base, is_insert_at=True,
+                          base_first=base_first),
+            path, field,
+        )
+    return index, path, field
+
+
+def _is_noop_move(m: dict) -> bool:
+    """A move whose destination lies inside its own moved range (a
+    self-cycle): applies as a no-op on every replica."""
+    if m.get("type") != "move":
+        return False
+    plen = len(m["path"])
+    dp = m["dst_path"]
+    if len(dp) <= plen or dp[:plen] != m["path"]:
+        return False
+    f, k = dp[plen]
+    return f == m["field"] and m["index"] <= k < m["index"] + m["count"]
+
+
+def _src_inside_removed(rm_op: dict, descendant_path: List[list]) -> bool:
+    """Does `descendant_path` pass through a node `rm_op` removes?"""
+    plen = len(rm_op["path"])
+    if len(descendant_path) <= plen:
+        return False
+    if descendant_path[:plen] != rm_op["path"]:
+        return False
+    f, k = descendant_path[plen]
+    return f == rm_op["field"] and (
+        rm_op["index"] <= k < rm_op["index"] + rm_op["count"]
+    )
+
+
 def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
     """Rebase one op over one base op (both relative to the same start
-    state); returns the adjusted op relative to post-base state, or
-    None if muted (its target no longer exists). `base_first` resolves
-    same-index insert ties (True when base sequenced earlier)."""
-    new_path = _rebase_path(op["path"], base)
+    state); returns the adjusted op (possibly a {"type": "multi"}
+    bundle) relative to post-base state, or None if muted (its target
+    no longer exists). `base_first` resolves same-position ties (True
+    when base sequenced earlier).
+
+    Move semantics (the cross-field move-effect rules,
+    sequence-field/moveEffectTable.ts):
+    - edits whose path descends through moved nodes FOLLOW the move
+      (path re-rooted at the destination, _rebase_path);
+    - a remove overlapping moved nodes follows them, and a SUBTREE
+      remove chases nodes concurrently moved out of it (removal wins
+      over movement, in both rebase directions); a move into a
+      concurrently-removed destination kills its source nodes;
+    - two moves competing for the same nodes: the LATER-sequenced move
+      wins (it re-moves from the earlier move's destination; the
+      earlier move's claim mutes when rebased over the later one);
+    - content inserted strictly inside a moved block travels with it
+      (the move absorbs it); inserted inside a REMOVED range it stays,
+      sliding to the range start (removes split around it).
+
+    Known limitation (excluded from the nested fuzz, pinned in
+    tests/test_tree_moves.py): chains of same-field moves competing
+    for overlapping blocks can resolve position ties
+    direction-dependently; the reference's full move-effect table
+    carries per-move-id state across the whole changeset to close
+    these — a later-round depth item.
+    """
+    if _is_noop_move(base):
+        return op  # self-cycle base applies as a no-op everywhere
+    orig = op
+    new_path = _rebase_path(op["path"], base, base_first)
     if new_path is None:
-        return None
+        return None  # ancestor removed: muted (removal wins over all)
     op = {**op, "path": new_path}
+    if op["type"] == "move":
+        nd = _rebase_path(op["dst_path"], base, base_first)
+        if nd is None:
+            # Destination subtree removed: the move proceeds into the
+            # void — its nodes die with the destination (removal wins;
+            # the dual direction removes them inside the subtree).
+            return rebase_op(
+                {"type": "remove", "path": orig["path"],
+                 "field": orig["field"], "index": orig["index"],
+                 "count": orig["count"]},
+                base, base_first,
+            )
+        op = {**op, "dst_path": nd}
     if op["type"] == "setValue":
         # Concurrent setValue on the same node: last-sequenced wins —
         # the earlier write mutes when rebased over the later one.
@@ -182,47 +520,87 @@ def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
         ):
             return None
         return op
-    # Same-field index adjustment.
-    if (
-        base["type"] != "setValue"
-        and base["path"] == op["path"]
-        and base["field"] == op["field"]
-    ):
-        if op["type"] == "insert":
-            idx = _adjust_index(
-                op["index"], base, is_insert_at=True, base_first=base_first
+    if base["type"] == "setValue":
+        return op
+
+    if op["type"] == "insert":
+        if _same_field(op["path"], op["field"], base) or (
+            base["type"] == "move"
+            and (_same_field(op["path"], op["field"], _move_parts(base)[0])
+                 or _same_field(op["path"], op["field"], _move_parts(base)[1]))
+        ):
+            idx, npath, nfield = _gap_over_base(
+                op["index"], op["path"], op["field"], base, base_first
             )
-            return {**op, "index": idx}
-        # remove: adjust both ends against the base edit.
-        start, count = op["index"], op["count"]
-        if base["type"] == "insert":
-            b, n = base["index"], len(base["content"])
-            if b <= start:
-                return {**op, "index": start + n}
-            if b < start + count:
-                # Base inserted strictly inside our removed range: the
-                # inserted content is kept — split into two removes
-                # (after-part first so the before-part's index stays
-                # valid when they apply sequentially).
-                left = b - start
-                return {
-                    "type": "multi",
-                    "ops": [
-                        {**op, "index": b + n, "count": count - left},
-                        {**op, "index": start, "count": left},
-                    ],
-                }
-            return op
-        else:  # base remove
-            b, n = base["index"], base["count"]
-            o_start, o_end = start, start + count
-            b_start, b_end = b, b + n
-            lost = max(0, min(o_end, b_end) - max(o_start, b_start))
-            new_count = count - lost
-            if new_count <= 0:
-                return None
-            new_start = o_start if o_start < b_start else max(b_start, o_start - n)
-            return {**op, "index": new_start, "count": new_count}
+            return {**op, "index": idx, "path": npath, "field": nfield}
+        return op
+
+    if op["type"] == "remove":
+        if base["type"] == "move" and _src_inside_removed(op, base["path"]):
+            # Base moved nodes OUT of a subtree our remove covers:
+            # removal wins — chase the moved nodes to their
+            # destination (the dual of the muted move-out; both
+            # directions end with the nodes gone). The chase part is
+            # in the post-base frame; its destination coordinates
+            # survive only if the destination itself survives.
+            rm, ins = _move_parts(base)
+            adj = op
+            if _same_field(op["path"], op["field"], rm):
+                adj = _range_over_base(op, base, base_first)
+            elif _same_field(op["path"], op["field"], ins):
+                adj = _range_over_base(op, ins, base_first)
+            chase_path = _rebase_path(
+                [list(s) for s in base["dst_path"]], rm, base_first
+            )
+            parts = _flatten_one(adj)
+            if chase_path is not None and not _src_inside_removed(
+                op, base["dst_path"]
+            ):
+                parts = parts + [{
+                    "type": "remove", "path": chase_path,
+                    "field": base["dst_field"],
+                    "index": _attach_gap(base),
+                    "count": base["count"],
+                }]
+            return _sequentialize(parts)
+        if _same_field(op["path"], op["field"], base):
+            return _range_over_base(op, base, base_first)
+        if base["type"] == "move":
+            rm, ins = _move_parts(base)
+            if _same_field(op["path"], op["field"], rm):
+                return _range_over_base(op, base, base_first)
+            if _same_field(op["path"], op["field"], ins):
+                # Foreign content attached into our field: split around
+                # it like an insert.
+                return _range_over_base(op, ins, base_first)
+        return op
+
+    if op["type"] == "move":
+        # Source end: a range, like remove (follow/mute rules apply).
+        src_view = {**op}
+        if _same_field(op["path"], op["field"], base) or base["type"] == "move":
+            if base["type"] == "move":
+                affected = (
+                    _same_field(op["path"], op["field"], _move_parts(base)[0])
+                    or _same_field(op["path"], op["field"],
+                                   _move_parts(base)[1])
+                )
+            else:
+                affected = True
+            if affected:
+                src_view = _range_over_base(op, base, base_first)
+                if src_view is None:
+                    return None
+        # Destination end: a gap.
+        def fix_dst(q: dict) -> Optional[dict]:
+            d, dp, df = _gap_over_base(
+                q["dst_index"], q["dst_path"], q["dst_field"], base,
+                base_first,
+            )
+            return {**q, "dst_index": d, "dst_path": dp, "dst_field": df}
+
+        return _multi_map(src_view, fix_dst)
+
     return op
 
 
@@ -244,31 +622,32 @@ def rebase_change(change: Change, over: Change, over_first: bool = True) -> Chan
     transforming a remote commit over the unsequenced local branch for
     forest application).
 
-    Uses the transform ladder: each op of `change` is rebased over the
-    advancing base, and the base is advanced over each rebased-past op
-    (with the dual tie-break), so later ops of `change` — whose
-    coordinates assume their predecessors applied — transform against
-    a correctly shifted base.
+    Implemented as a recursive inclusion transform over op LISTS (the
+    operational-transform ladder in its general form): transforming
+    one op past another may split it into several sequential parts
+    (multi), and the dual side advances symmetrically, so both sides
+    are op lists throughout.
     """
-    current = [copy.deepcopy(op) for op in change]
-    for base0 in over:
-        bases = [base0]
-        nxt: Change = []
-        for op in current:
-            transformed: List[Optional[dict]] = [op]
-            new_bases: Change = []
-            for b in bases:
-                step: List[Optional[dict]] = []
-                for t in transformed:
-                    if t is None:
-                        continue
-                    step.append(rebase_op(t, b, base_first=over_first))
-                transformed = step
-                # Advance this base past the ORIGINAL op (dual tie).
-                adv = rebase_op(b, op, base_first=not over_first)
-                new_bases.extend(_flatten_one(adv))
-            bases = new_bases
-            for t in transformed:
-                nxt.extend(_flatten_one(t))
-        current = nxt
-    return current
+    a = [copy.deepcopy(op) for op in change]
+    b = [copy.deepcopy(op) for op in over]
+    return _xform(a, b, over_first)[0]
+
+
+def _xform(A: Change, B: Change, flag: bool) -> Tuple[Change, Change]:
+    """Inclusion transform of sequential op lists sharing one start
+    state: returns ``(A', B')`` with A' applying after B, and B'
+    after A. `flag`: B's content wins position ties (B sequenced
+    earlier)."""
+    if not A or not B:
+        return list(A), list(B)
+    if len(A) == 1 and len(B) == 1:
+        a_p = _flatten_one(rebase_op(A[0], B[0], base_first=flag))
+        b_p = _flatten_one(rebase_op(B[0], A[0], base_first=not flag))
+        return a_p, b_p
+    if len(A) > 1:
+        A1p, Bp = _xform(A[:1], B, flag)
+        A2p, Bpp = _xform(A[1:], Bp, flag)
+        return A1p + A2p, Bpp
+    Ap, B1p = _xform(A, B[:1], flag)
+    App, B2p = _xform(Ap, B[1:], flag)
+    return App, B1p + B2p

@@ -1,0 +1,376 @@
+"""Chunked forest: columnar uniform-chunk tree storage.
+
+The role of the reference's chunked-forest
+(packages/dds/tree/src/feature-libraries/chunked-forest/): tree
+content is stored in CHUNKS, and runs of same-shaped nodes share one
+compact representation instead of per-node objects. The TPU-idiomatic
+form of "uniform chunk" is COLUMNAR: a run of same-type leaf nodes is
+one numpy value array — bulk loads of tabular data cost one array, and
+`column()` exposes whole fields to numpy/JAX analytics without ever
+materializing node objects (the chunked-forest's cursor-over-chunks
+idea, re-pointed at array programs).
+
+`ChunkedForest` implements the SAME `apply(change)` contract as
+`forest.Forest` (inserts/removes/setValue/move with capture-for-
+invert enrichment) and is differentially fuzzed against it
+(tests/test_chunked_forest.py). Structure:
+
+- every field is a list of chunks;
+- `UniformChunk`: N same-type leaves, values in one numpy object
+  array (no per-node dicts);
+- `ObjectChunk`: one ordinary node dict (arbitrary subtree).
+
+Edits split uniform chunks copy-on-write at touch points; bulk
+same-type leaf inserts re-form uniform chunks.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .forest import FieldOps, apply_move_op, canon_json, make_node
+
+Change = List[dict]
+
+
+class UniformChunk:
+    """A run of same-type, field-less leaf nodes, stored columnar."""
+
+    __slots__ = ("type", "values")
+
+    def __init__(self, type_: Optional[str], values: np.ndarray):
+        self.type = type_
+        self.values = values  # object ndarray
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def materialize(self, i: int) -> dict:
+        return make_node(self.type, self.values[i])
+
+    def to_nodes(self) -> List[dict]:
+        return [self.materialize(i) for i in range(len(self.values))]
+
+    def slice(self, lo: int, hi: int) -> "UniformChunk":
+        return UniformChunk(self.type, self.values[lo:hi].copy())
+
+
+class ObjectChunk:
+    __slots__ = ("node",)
+
+    def __init__(self, node: dict):
+        self.node = node
+
+    def __len__(self) -> int:
+        return 1
+
+
+def _leafable(node: dict) -> bool:
+    return not any(node.get("fields", {}).values())
+
+
+def _chunk_nodes(nodes: List[dict]) -> List[Any]:
+    """Pack a node list into chunks: maximal same-type leaf runs
+    become uniform chunks (>= 2 nodes), everything else object
+    chunks."""
+    out: List[Any] = []
+    run: List[dict] = []
+
+    def flush():
+        if not run:
+            return
+        if len(run) >= 2:
+            out.append(UniformChunk(
+                run[0].get("type"),
+                np.array([n.get("value") for n in run], dtype=object),
+            ))
+        else:
+            out.extend(ObjectChunk(n) for n in run)
+        run.clear()
+
+    for n in nodes:
+        if _leafable(n):
+            if run and run[0].get("type") != n.get("type"):
+                flush()
+            run.append(n)
+        else:
+            flush()
+            out.append(ObjectChunk(n))
+    flush()
+    return out
+
+
+class ChunkedField:
+    """One field's children as a chunk list."""
+
+    __slots__ = ("chunks",)
+
+    def __init__(self, chunks: Optional[List[Any]] = None):
+        self.chunks = chunks or []
+
+    def __len__(self) -> int:
+        return sum(len(c) for c in self.chunks)
+
+    # ------------------------------------------------------- navigation
+
+    def _locate(self, index: int) -> Tuple[int, int]:
+        """(chunk index, offset) of node `index`; chunk index may be
+        len(chunks) with offset 0 for the end position."""
+        pos = 0
+        for ci, c in enumerate(self.chunks):
+            if index < pos + len(c):
+                return ci, index - pos
+            pos += len(c)
+        return len(self.chunks), 0
+
+    def _split_at(self, index: int) -> int:
+        """Split chunks so node boundary `index` falls between chunks;
+        returns the chunk index of the boundary."""
+        pos = 0
+        for ci, c in enumerate(self.chunks):
+            if index == pos:
+                return ci
+            if index < pos + len(c):
+                off = index - pos
+                if isinstance(c, UniformChunk):
+                    self.chunks[ci: ci + 1] = [
+                        c.slice(0, off), c.slice(off, len(c))
+                    ]
+                    return ci + 1
+                return ci  # object chunk: boundary can't be inside
+            pos += len(c)
+        return len(self.chunks)
+
+    def node_ref(self, index: int):
+        """(kind, ...) addressing node `index`: ("obj", node_dict) or
+        ("leaf", chunk, offset)."""
+        ci, off = self._locate(index)
+        if ci >= len(self.chunks):
+            return None
+        c = self.chunks[ci]
+        if isinstance(c, ObjectChunk):
+            return ("obj", c.node)
+        return ("leaf", c, off)
+
+    def get_node(self, index: int) -> Optional[dict]:
+        ref = self.node_ref(index)
+        if ref is None:
+            return None
+        if ref[0] == "obj":
+            return ref[1]
+        return ref[1].materialize(ref[2])
+
+    # -------------------------------------------------------- mutation
+
+    def insert(self, index: int, nodes: List[dict]) -> None:
+        ci = self._split_at(min(index, len(self)))
+        self.chunks[ci:ci] = _chunk_nodes(copy.deepcopy(nodes))
+
+    def detach(self, index: int, count: int) -> List[dict]:
+        lo = self._split_at(min(index, len(self)))
+        hi = self._split_at(min(index + count, len(self)))
+        taken = self.chunks[lo:hi]
+        del self.chunks[lo:hi]
+        out: List[dict] = []
+        for c in taken:
+            if isinstance(c, ObjectChunk):
+                out.append(c.node)
+            else:
+                out.extend(c.to_nodes())
+        return out
+
+    def set_value(self, index: int, value: Any) -> Tuple[bool, Any]:
+        """Set node's value in place; returns (ok, previous)."""
+        ref = self.node_ref(index)
+        if ref is None:
+            return False, None
+        if ref[0] == "obj":
+            node = ref[1]
+            prev = node.get("value")
+            if value is None:
+                node.pop("value", None)
+            else:
+                node["value"] = value
+            return True, prev
+        _, chunk, off = ref
+        prev = chunk.values[off]
+        chunk.values[off] = value
+        return True, prev
+
+    def to_nodes(self) -> List[dict]:
+        out: List[dict] = []
+        for c in self.chunks:
+            if isinstance(c, ObjectChunk):
+                out.append(c.node)
+            else:
+                out.extend(c.to_nodes())
+        return out
+
+    def column(self) -> np.ndarray:
+        """All child values as one array (uniform chunks contribute
+        their arrays directly; object nodes their value slot)."""
+        parts = []
+        for c in self.chunks:
+            if isinstance(c, UniformChunk):
+                parts.append(c.values)
+            else:
+                parts.append(np.array([c.node.get("value")], dtype=object))
+        if not parts:
+            return np.array([], dtype=object)
+        return np.concatenate(parts)
+
+    def uniform_ratio(self) -> float:
+        n = len(self)
+        if n == 0:
+            return 0.0
+        u = sum(len(c) for c in self.chunks if isinstance(c, UniformChunk))
+        return u / n
+
+
+class ChunkedForest:
+    """Forest with chunked field storage; `apply` contract identical
+    to `forest.Forest` (differential gate: tests/test_chunked_forest
+    .py fuzz)."""
+
+    def __init__(self, root: Optional[dict] = None):
+        self.root = root if root is not None else make_node("root")
+        # Chunked fields are stored per-NODE as a shadow dict on
+        # object nodes: node["fields"][f] is replaced lazily by a
+        # ChunkedField under this wrapper's management.
+
+    # ---------------------------------------------------------- fields
+
+    def _field_of(self, node: dict, field: str,
+                  create: bool = False) -> Optional[ChunkedField]:
+        fields = node.setdefault("fields", {})
+        cur = fields.get(field)
+        if isinstance(cur, ChunkedField):
+            return cur
+        if cur is None:
+            if not create:
+                return None
+            cf = ChunkedField()
+            fields[field] = cf
+            return cf
+        cf = ChunkedField(_chunk_nodes(cur))
+        fields[field] = cf
+        return cf
+
+    def node_at(self, path: List[list]) -> Optional[dict]:
+        node = self.root
+        for field, index in path:
+            cf = self._field_of(node, field)
+            if cf is None:
+                return None
+            ref = cf.node_ref(index)
+            if ref is None:
+                return None
+            if ref[0] == "leaf":
+                # Leaves have no fields, so a path can only END here.
+                # Return a materialized COPY — reads must not erode
+                # uniform chunks; all mutation paths (set_value /
+                # insert / detach / move) go through ChunkedField
+                # methods that operate on chunks directly.
+                _, chunk, off = ref
+                node = chunk.materialize(off)
+            else:
+                node = ref[1]
+        return node
+
+    def _field(self, path: List[list], field: str) -> Optional[ChunkedField]:
+        node = self.node_at(path)
+        if node is None:
+            return None
+        return self._field_of(node, field, create=True)
+
+    # ------------------------------------------------------------ apply
+
+    def apply(self, change: Change) -> None:
+        for op in change:
+            t = op["type"]
+            if t == "insert":
+                cf = self._field(op["path"], op["field"])
+                if cf is None:
+                    continue
+                cf.insert(min(op["index"], len(cf)), op["content"])
+            elif t == "remove":
+                cf = self._field(op["path"], op["field"])
+                if cf is None:
+                    continue
+                index = op["index"]
+                end = min(index + op["count"], len(cf))
+                nodes = cf.detach(index, max(end - index, 0))
+                op["content"] = [self._deep_json(n) for n in nodes]
+            elif t == "setValue":
+                path = op["path"]
+                if not path:
+                    # Root value (same semantics as Forest.apply).
+                    op["prev"] = self.root.get("value")
+                    if op["value"] is None:
+                        self.root.pop("value", None)
+                    else:
+                        self.root["value"] = op["value"]
+                    continue
+                parent = self.node_at(path[:-1])
+                if parent is None:
+                    continue
+                f, i = path[-1]
+                cf = self._field_of(parent, f)
+                if cf is None:
+                    continue
+                ok, prev = cf.set_value(i, op["value"])
+                if ok:
+                    op["prev"] = prev
+            elif t == "move":
+                self._apply_move(op)
+
+    def _apply_move(self, op: dict) -> None:
+        apply_move_op(op, self._resolve_field_ops)
+
+    def _resolve_field_ops(self, path, field) -> Optional[FieldOps]:
+        cf = self._field(path, field)
+        if cf is None:
+            return None
+        return FieldOps(cf, lambda: len(cf), cf.detach, cf.insert)
+
+    # ------------------------------------------------------------ export
+
+    def _deep_json(self, node: dict) -> dict:
+        return canon_json(node)
+
+    def to_json(self) -> dict:
+        return canon_json(self.root)
+
+    def clone(self) -> "ChunkedForest":
+        return ChunkedForest(copy.deepcopy(self.to_json()))
+
+    def node_count(self) -> int:
+        def count(node: dict) -> int:
+            total = 1
+            for f, cs in node.get("fields", {}).items():
+                kids = cs.to_nodes() if isinstance(cs, ChunkedField) else cs
+                total += sum(count(c) for c in kids)
+            return total
+
+        return count(self.root)
+
+    # --------------------------------------------------------- analytics
+
+    def column(self, path: List[list], field: str) -> np.ndarray:
+        """Bulk value read of one field — uniform chunks feed their
+        arrays straight through (zero node materialization)."""
+        node = self.node_at(path)
+        if node is None:
+            return np.array([], dtype=object)
+        cf = self._field_of(node, field)
+        if cf is None:
+            return np.array([], dtype=object)
+        return cf.column()
+
+    def uniform_ratio(self, path: List[list], field: str) -> float:
+        node = self.node_at(path)
+        cf = self._field_of(node, field) if node else None
+        return cf.uniform_ratio() if cf else 0.0

@@ -65,28 +65,38 @@ class EditManager:
     def integrate_remote(self, change: Change, session: Any, seq: int,
                          ref_seq: int) -> Change:
         """A sequenced commit from another session: rebase it into
-        trunk coordinates, append to the trunk, apply to the forest,
-        and rebase the local branch over it. Returns the trunk-coords
-        change (what was applied)."""
+        trunk coordinates, append to the trunk, and integrate via the
+        INVERT-SANDWICH (the reference's SharedTreeBranch.rebaseOnto,
+        shared-tree-core/branch.ts:50): unwind the optimistic local
+        branch, apply the remote against sequenced state, then
+        re-apply each local commit rebased over it. The sandwich —
+        not a forward transform of the remote over the local branch —
+        is what keeps state-dependent conflict resolutions (e.g. the
+        move cycle guard) identical on every replica: each rebased
+        change applies against the same sequenced-prefix state
+        everywhere. Returns the trunk-coords change."""
+        import copy as _copy
+
         commit = Commit(change=change, session=session, seq=seq, ref_seq=ref_seq)
         window = self._concurrent_window(commit)
         rebased = rebase_change(change, [op for ch in window for op in ch])
         commit.change = rebased
         self.trunk.append(commit)
         self.trunk_seq = seq
-        # The forest holds trunk+local state, so the remote change is
-        # applied rebased over the (unsequenced) local branch — with
-        # the remote's content winning insert ties, since it sequenced
-        # first — while each local commit rebases over the advancing
-        # remote (the reference's SharedTreeBranch.rebaseOnto,
-        # shared-tree-core/branch.ts).
-        carry = rebased
+        from .changeset import invert
+
+        for c in reversed(self.local):
+            self.forest.apply(invert(c.change))
+        applied = _copy.deepcopy(rebased)
+        self.forest.apply(applied)
+        commit.change = applied  # trunk keeps the capture-enriched form
+        carry = applied
         for c in self.local:
-            new_change = rebase_change(c.change, carry, over_first=True)
-            carry = rebase_change(carry, c.change, over_first=False)
-            c.change = new_change
-        self.forest.apply(carry)
-        return carry
+            old = c.change
+            c.change = rebase_change(old, carry, over_first=True)
+            carry = rebase_change(carry, old, over_first=False)
+            self.forest.apply(c.change)
+        return applied
 
     def ack_local(self, seq: int) -> Commit:
         """Our oldest local commit was sequenced: it becomes the trunk

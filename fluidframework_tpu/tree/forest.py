@@ -30,6 +30,81 @@ def make_node(node_type: Optional[str] = None, value: Any = None,
     return out
 
 
+class FieldOps:
+    """Uniform mutation surface over a field container (plain list or
+    chunked_forest.ChunkedField) for the shared move application."""
+
+    def __init__(self, container, size, take, put):
+        self.container = container
+        self.size = size
+        self.take = take  # (i, n) -> detached node list
+        self.put = put  # (i, nodes) -> None
+
+
+def apply_move_op(op: dict, resolve) -> None:
+    """Shared move application: detach-then-attach with the pre-op ->
+    post-detach coordinate conversion, the rebase-created-cycle guard
+    (destination under a moved node => deterministic no-op), and exact
+    inverse recording. `resolve(path, field)` returns a FieldOps or
+    None; storage-specific forests supply it (Forest, ChunkedForest —
+    ONE copy of the trickiest apply logic)."""
+    src = resolve(op["path"], op["field"])
+    if src is None:
+        op["muted"] = True
+        return
+    i = min(op["index"], src.size())
+    end = min(i + op["count"], src.size())
+    n = max(end - i, 0)
+    nodes = src.take(i, n)
+    dpath = [list(s) for s in op["dst_path"]]
+    plen = len(op["path"])
+    if (len(dpath) > plen
+            and dpath[:plen] == [list(s) for s in op["path"]]
+            and dpath[plen][0] == op["field"]):
+        k = dpath[plen][1]
+        if i <= k < i + n:
+            src.put(i, nodes)  # destination under a moved node: cycle
+            op["muted"] = True
+            return
+        if k >= i + n:
+            dpath[plen][1] = k - n
+    dst = resolve(dpath, op["dst_field"])
+    if dst is None:
+        src.put(i, nodes)  # restore: no-op move
+        op["muted"] = True
+        return
+    j = op["dst_index"]
+    same = dst.container is src.container
+    if same:
+        j = j - n if j >= i + n else (i if j > i else j)
+    j = min(max(j, 0), dst.size())
+    dst.put(j, nodes)
+    op["muted"] = False
+    op["count"] = n
+    inv_dst = i if (not same or i <= j) else i + n
+    op["inverse"] = {
+        "type": "move",
+        "path": dpath, "field": op["dst_field"], "index": j, "count": n,
+        "dst_path": [list(s) for s in op["path"]],
+        "dst_field": op["field"], "dst_index": inv_dst,
+    }
+
+
+def canon_json(node: dict) -> dict:
+    """Canonical JSON form of a node: empty field lists pruned; field
+    containers may be plain lists or chunked (anything exposing
+    to_nodes())."""
+    out = {k: v for k, v in node.items() if k != "fields"}
+    fields = {}
+    for f, cs in node.get("fields", {}).items():
+        kids = cs.to_nodes() if hasattr(cs, "to_nodes") else cs
+        if kids:
+            fields[f] = [canon_json(c) for c in kids]
+    if fields:
+        out["fields"] = fields
+    return out
+
+
 class Forest:
     def __init__(self, root: Optional[dict] = None):
         self.root = root if root is not None else make_node("root")
@@ -81,11 +156,33 @@ class Forest:
                     node.pop("value", None)
                 else:
                     node["value"] = op["value"]
+            elif t == "move":
+                # Shared detach-then-attach application (cycle guard,
+                # pre-op frame conversion, inverse recording).
+                apply_move_op(op, self._resolve_field_ops)
 
     # ------------------------------------------------------------- export
 
+    def _resolve_field_ops(self, path, field) -> Optional[FieldOps]:
+        children = self._field(path, field)
+        if children is None:
+            return None
+
+        def take(i, n):
+            nodes = children[i:i + n]
+            del children[i:i + n]
+            return nodes
+
+        def put(i, nodes):
+            children[i:i] = nodes
+
+        return FieldOps(children, lambda: len(children), take, put)
+
     def to_json(self) -> dict:
-        return copy.deepcopy(self.root)
+        """Canonical JSON form: empty field lists are pruned (an empty
+        field is semantically absent; transient empties appear when
+        unwound/muted moves materialize a destination field)."""
+        return canon_json(self.root)
 
     def clone(self) -> "Forest":
         return Forest(copy.deepcopy(self.root))

@@ -1,4 +1,4 @@
-"""IdCompressor: session-space ↔ final-space compact ids.
+"""IdCompressor: session-space <-> final-space compact ids.
 
 The role of the reference IdCompressor
 (packages/dds/tree/src/id-compressor/idCompressor.ts:272): sessions
@@ -9,14 +9,28 @@ session's consecutive ids stay contiguous — cheap range encoding).
 `normalize_to_op_space` translates local ids for the wire;
 `normalize_to_session_space` translates received final ids back.
 
+Cluster machinery (the reference's scale features, idCompressor.ts):
+
+- **Cluster expansion**: when a session exhausts its tail cluster and
+  that cluster is still the newest allocation in final space, it
+  EXPANDS in place instead of allocating a new cluster — a dominant
+  writer occupies one ever-growing cluster rather than many.
+- **Eager finals**: once a session owns a cluster with spare
+  capacity, freshly generated ids map into it IMMEDIATELY (non-
+  negative ids straight from `generate_compressed_id`), skipping the
+  local->final translation on every later use.
+- **O(log n) translation**: lookups bisect over cluster bases instead
+  of scanning (1M-id scale, tests/test_tree_depth.py).
+
 Every replica finalizes the same ranges in the same total order, so
-the local→final mapping is identical everywhere — the property the
+the local->final mapping is identical everywhere — the property the
 reference's compressed-id equality relies on.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from bisect import bisect_right
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 DEFAULT_CLUSTER_CAPACITY = 512
@@ -37,20 +51,42 @@ class IdCompressor:
         self.cluster_capacity = cluster_capacity
         self._local_count = 0  # ids this session has generated
         self._next_final = 0  # next unallocated final id (global)
-        # session -> clusters (in allocation order)
+        # session -> clusters (in allocation order; base_local ascending)
         self._clusters: Dict[str, List[_Cluster]] = {}
         # how many of each session's locals have been finalized
         self._finalized: Dict[str, int] = {}
+        # global final-space index: sorted cluster base_finals + refs
+        self._final_bases: List[int] = []
+        self._final_refs: List[Tuple[str, _Cluster]] = []
 
     # ---------------------------------------------------------- generate
 
     def generate_compressed_id(self) -> int:
-        """A new session-local id: -1, -2, ... (idCompressor
-        generateCompressedId)."""
+        """A new id: an EAGER FINAL when this session's tail cluster
+        already has reserved capacity for it, else a session-local id
+        -1, -2, ... (idCompressor generateCompressedId)."""
         self._local_count += 1
-        return -self._local_count
+        ordinal = self._local_count
+        clusters = self._clusters.get(self.session_id)
+        if clusters:
+            tail = clusters[-1]
+            if tail.base_local <= ordinal < tail.base_local + tail.capacity:
+                return tail.base_final + (ordinal - tail.base_local)
+        return -ordinal
 
     # ---------------------------------------------------------- finalize
+
+    def _add_cluster(self, session: str, base_local: int,
+                     capacity: int) -> _Cluster:
+        cl = _Cluster(
+            base_final=self._next_final, base_local=base_local,
+            capacity=capacity,
+        )
+        self._next_final += capacity
+        self._clusters.setdefault(session, []).append(cl)
+        self._final_bases.append(cl.base_final)
+        self._final_refs.append((session, cl))
+        return cl
 
     def finalize_range(self, session: str, count: int) -> None:
         """Finalize the next `count` locals of `session` (called in
@@ -60,31 +96,47 @@ class IdCompressor:
         remaining = count
         while remaining > 0:
             tail = clusters[-1] if clusters else None
-            if tail is None or tail.count == tail.capacity:
-                tail = _Cluster(
-                    base_final=self._next_final,
-                    base_local=done + 1,
-                    capacity=max(self.cluster_capacity, remaining),
-                )
-                self._next_final += tail.capacity
-                clusters.append(tail)
-            take = min(remaining, tail.capacity - tail.count)
-            tail.count += take
-            done += take
-            remaining -= take
+            if tail is not None and tail.count < tail.capacity:
+                take = min(remaining, tail.capacity - tail.count)
+                tail.count += take
+                done += take
+                remaining -= take
+                continue
+            if (
+                tail is not None
+                and tail.base_final + tail.capacity == self._next_final
+            ):
+                # Tail is the newest allocation in final space: expand
+                # in place (idCompressor cluster expansion) — the
+                # session keeps one contiguous block.
+                # Reserve headroom beyond the immediate need so the
+                # session's NEXT ids are eager finals.
+                grow = remaining + self.cluster_capacity
+                tail.capacity += grow
+                self._next_final += grow
+                continue
+            self._add_cluster(
+                session, done + 1, remaining + self.cluster_capacity
+            )
         self._finalized[session] = done
 
     # --------------------------------------------------------- translate
 
     def _local_to_final(self, session: str, local: int) -> Optional[int]:
         ordinal = -local  # 1-based
-        for cl in self._clusters.get(session, []):
-            if cl.base_local <= ordinal < cl.base_local + cl.count:
-                return cl.base_final + (ordinal - cl.base_local)
+        clusters = self._clusters.get(session)
+        if not clusters:
+            return None
+        i = bisect_right(clusters, ordinal, key=lambda c: c.base_local) - 1
+        if i < 0:
+            return None
+        cl = clusters[i]
+        if ordinal < cl.base_local + cl.count:
+            return cl.base_final + (ordinal - cl.base_local)
         return None
 
     def normalize_to_op_space(self, local_id: int) -> int:
-        """Own local id → final (if finalized) or the local itself
+        """Own local id -> final (if finalized) or the local itself
         (receivers resolve via the carrying op's session)."""
         if local_id >= 0:
             return local_id
@@ -92,7 +144,7 @@ class IdCompressor:
         return final if final is not None else local_id
 
     def normalize_to_session_space(self, op_id: int, originator: str) -> int:
-        """An id from the wire → this session's space: finals pass
+        """An id from the wire -> this session's space: finals pass
         through; a foreign local id maps via the originator's clusters
         (it must have been finalized by the time we see it... unless it
         is ours)."""
@@ -108,13 +160,20 @@ class IdCompressor:
         return final
 
     def decompress(self, final_id: int) -> Tuple[str, int]:
-        """final id → (session, 1-based ordinal) (stable UUID-like
+        """final id -> (session, 1-based ordinal) (stable UUID-like
         identity in the reference; the pair plays that role here)."""
-        for session, clusters in self._clusters.items():
-            for cl in clusters:
-                if cl.base_final <= final_id < cl.base_final + cl.count:
-                    return session, cl.base_local + (final_id - cl.base_final)
+        i = bisect_right(self._final_bases, final_id) - 1
+        if i >= 0:
+            session, cl = self._final_refs[i]
+            if final_id < cl.base_final + cl.capacity:
+                # Identity is fixed at cluster allocation (capacity
+                # reservation), so eager finals decompress before
+                # their range's own finalize catches count up.
+                return session, cl.base_local + (final_id - cl.base_final)
         raise KeyError(f"unknown final id {final_id}")
+
+    def cluster_count(self) -> int:
+        return len(self._final_refs)
 
     # --------------------------------------------------------- serialize
 
@@ -141,4 +200,11 @@ class IdCompressor:
             s: [_Cluster(a, b, c, d) for a, b, c, d in cs]
             for s, cs in data["clusters"].items()
         }
+        refs = [
+            (cl.base_final, s, cl)
+            for s, cs in out._clusters.items() for cl in cs
+        ]
+        refs.sort(key=lambda x: x[0])
+        out._final_bases = [r[0] for r in refs]
+        out._final_refs = [(r[1], r[2]) for r in refs]
         return out

@@ -174,6 +174,14 @@ class NodeView:
     def __getitem__(self, name: str) -> "FieldView":
         return self.field(name)
 
+    def __getattr__(self, name: str) -> "FieldView":
+        # Attribute-style field access (editable-tree proxy idiom:
+        # node.title instead of node["title"]). Underscored names are
+        # real attributes.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.field(name)
+
 
 class FieldView:
     """Proxy for one field of a node (sequence/value/optional)."""
@@ -183,14 +191,12 @@ class FieldView:
         self._parent = parent_path
         self._name = name
 
-    def _children(self) -> list:
+    def __len__(self) -> int:
         node = self._tree.forest.node_at(self._parent)
         if node is None:
             raise KeyError(f"no node at {self._parent}")
-        return node.get("fields", {}).get(self._name, [])
-
-    def __len__(self) -> int:
-        return len(self._children())
+        kids = node.get("fields", {}).get(self._name, [])
+        return len(kids)  # list OR ChunkedField (both sized)
 
     def node(self, index: int) -> NodeView:
         return NodeView(self._tree, self._parent + [[self._name, index]])
@@ -207,3 +213,25 @@ class FieldView:
 
     def remove(self, index: int, count: int = 1) -> None:
         self._tree.edit([remove_op(self._parent, self._name, index, count)])
+
+    def move_to(self, index: int, count: int, dst: "FieldView",
+                dst_index: int) -> None:
+        """Move children into another field (cross-field move through
+        the proxy — reference editable-tree move editing)."""
+        from .changeset import move_op
+
+        self._tree.edit([
+            move_op(self._parent, self._name, index, count,
+                    dst._parent, dst._name, dst_index)
+        ])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self.node(i)
+
+    def values(self) -> list:
+        """Bulk child-value read (columnar on a chunked forest)."""
+        forest = self._tree.forest
+        if hasattr(forest, "column"):
+            return list(forest.column(self._parent, self._name))
+        return [n.value for n in self]

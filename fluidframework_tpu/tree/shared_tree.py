@@ -24,7 +24,7 @@ from ..protocol.messages import SequencedMessage
 from ..runtime.channel import ChannelFactory, ChannelStorage
 from ..runtime.shared_object import SharedObject
 from ..runtime.summary import SummaryTreeBuilder
-from .changeset import Change, insert_op, remove_op, set_value_op
+from .changeset import Change, insert_op, move_op, remove_op, set_value_op
 from .edit_manager import EditManager
 from .forest import Forest
 from .id_compressor import IdCompressor
@@ -46,6 +46,15 @@ class SharedTree(SharedObject):
 
     def view(self) -> dict:
         return self.forest.to_json()
+
+    def use_chunked_forest(self) -> None:
+        """Swap this replica's storage to the chunked forest (columnar
+        uniform chunks; chunked_forest.py). Storage-only: wire format,
+        rebase, and views are unchanged, so replicas mix freely."""
+        from .chunked_forest import ChunkedForest
+
+        self.forest = ChunkedForest(self.forest.to_json())
+        self.edits.forest = self.forest
 
     def generate_id(self) -> int:
         return self.id_compressor.generate_compressed_id()
@@ -76,6 +85,17 @@ class SharedTree(SharedObject):
 
     def set_value(self, path: List[list], value: Any) -> None:
         self._commit([set_value_op(path, value)])
+
+    def move_node(self, path: List[list], field: str, index: int,
+                  count: int, dst_path: List[list], dst_field: str,
+                  dst_index: int) -> None:
+        """Move nodes across arbitrary fields/parents (the reference's
+        cross-field move, sequence-field moveOut/moveIn pairs composed
+        through the move-effect table)."""
+        self._commit([
+            move_op(path, field, index, count, dst_path, dst_field,
+                    dst_index)
+        ])
 
     def edit(self, change: Change, id_count: int = 0) -> None:
         """Submit a multi-op changeset as one atomic commit."""

@@ -1,0 +1,125 @@
+"""Chunked forest: differential fuzz vs the object forest + columnar
+storage properties (reference feature-libraries/chunked-forest)."""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.tree import (
+    Forest,
+    insert_op,
+    invert,
+    move_op,
+    remove_op,
+    set_value_op,
+)
+from fluidframework_tpu.tree.chunked_forest import ChunkedForest
+from fluidframework_tpu.tree.forest import make_node
+
+
+def bulk_leaves(n, type_="num", base=0):
+    return [make_node(type_, base + i) for i in range(n)]
+
+
+def test_bulk_leaf_insert_forms_uniform_chunks():
+    f = ChunkedForest()
+    f.apply([insert_op([], "data", 0, bulk_leaves(1000))])
+    assert f.uniform_ratio([], "data") > 0.99
+    col = f.column([], "data")
+    assert len(col) == 1000 and col[0] == 0 and col[999] == 999
+    # One edit splits only locally: ratio stays high.
+    f.apply([set_value_op([["data", 500]], -1)])
+    assert f.column([], "data")[500] == -1
+    assert f.uniform_ratio([], "data") > 0.9
+
+
+def test_mixed_content_chunking():
+    f = ChunkedForest()
+    branchy = make_node("obj")
+    branchy["fields"]["sub"] = bulk_leaves(3)
+    f.apply([insert_op([], "x", 0,
+                       bulk_leaves(5) + [branchy] + bulk_leaves(5, "str"))])
+    j = f.to_json()
+    assert len(j["fields"]["x"]) == 11
+    assert j["fields"]["x"][5]["fields"]["sub"][2]["value"] == 2
+
+
+def random_change(rng, forest, n_ops):
+    sim = forest.clone()
+    out = []
+    for _ in range(n_ops):
+        kind = rng.choice(["insert", "insert", "remove", "set", "move"])
+        field = rng.choice(["a", "b"])
+        kids = sim.to_json().get("fields", {}).get(field, [])
+        if kind == "insert" or not kids:
+            n = rng.randint(1, 5)
+            op = insert_op([], field, rng.randint(0, len(kids)),
+                           bulk_leaves(n, rng.choice(["num", "str"]),
+                                       rng.randint(0, 99)))
+        elif kind == "remove":
+            i = rng.randrange(len(kids))
+            op = remove_op([], field, i, rng.randint(1, min(3, len(kids) - i)))
+        elif kind == "set":
+            op = set_value_op([[field, rng.randrange(len(kids))]],
+                              rng.randint(0, 999))
+        else:
+            i = rng.randrange(len(kids))
+            cnt = rng.randint(1, min(3, len(kids) - i))
+            dfield = rng.choice(["a", "b"])
+            dlen = len(sim.to_json().get("fields", {}).get(dfield, []))
+            op = move_op([], field, i, cnt, [], dfield,
+                         rng.randint(0, dlen))
+        sim.apply([copy.deepcopy(op)])
+        out.append(op)
+    return out
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_chunked_matches_object_forest(seed):
+    """Differential fuzz: identical JSON state after every change,
+    including capture enrichment driving invert round-trips."""
+    rng = random.Random(seed)
+    obj = Forest()
+    chk = ChunkedForest()
+    for _ in range(6):
+        change = random_change(rng, obj, rng.randint(1, 4))
+        c1 = copy.deepcopy(change)
+        c2 = copy.deepcopy(change)
+        obj.apply(c1)
+        chk.apply(c2)
+        assert obj.to_json() == chk.to_json(), f"seed {seed}"
+    # Invert round-trip through the CHUNKED captures.
+    before = chk.to_json()
+    change = random_change(rng, obj, 3)
+    applied = copy.deepcopy(change)
+    chk.apply(applied)
+    chk.apply(invert(applied))
+    assert chk.to_json() == before
+
+
+def test_shared_tree_on_chunked_forest():
+    """SharedTree runs on the chunked forest end-to-end (flag), with
+    convergence against an object-forest replica."""
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.testing.mocks import MultiClientHarness
+    from fluidframework_tpu.tree.shared_tree import SharedTreeFactory
+
+    reg = ChannelRegistry([SharedTreeFactory()])
+    h = MultiClientHarness(
+        2, reg, channel_types=[("t", SharedTreeFactory.type_name)]
+    )
+    t0 = h.runtimes[0].get_datastore("default").get_channel("t")
+    t1 = h.runtimes[1].get_datastore("default").get_channel("t")
+    t0.use_chunked_forest()
+    t0.insert_node([], "rows", 0, bulk_leaves(100))
+    h.process_all()
+    t1.remove_node([], "rows", 10, 5)
+    t0.set_value([["rows", 0]], "edited")
+    t0.move_node([], "rows", 50, 3, [], "archive", 0)
+    h.process_all()
+    assert t0.view() == t1.view()
+    assert t0.forest.uniform_ratio([], "rows") > 0.5
+    col = t0.forest.column([], "archive")
+    assert list(col) == [50, 51, 52]
