@@ -151,16 +151,29 @@ def main() -> None:
     # The stream upload is the load phase (the reference replay tool
     # pre-parses op files before its timed loop); replay is timed from
     # device-resident ops.
-    replica = make_replica(stream)
-    if engine == "overlay":
-        replica.prepare()
-    t0 = time.perf_counter()
-    replica.replay()
-    # A value FETCH (not block_until_ready) closes the timing region:
-    # on the tunneled backend, block_until_ready can return before the
-    # device finishes, but a fetch of loop-dependent state cannot.
-    replica.check_errors()
-    t_kernel = time.perf_counter() - t0
+    repeats = int(os.environ.get("BENCH_REPEATS", 3))
+    times = []
+    replica = None
+    for _ in range(max(repeats, 1)):
+        replica = make_replica(stream)
+        if engine == "overlay":
+            replica.prepare()
+        t0 = time.perf_counter()
+        replica.replay()
+        # A value FETCH (not block_until_ready) closes the timing
+        # region: on the tunneled backend, block_until_ready can
+        # return before the device finishes; a fetch of
+        # loop-dependent state cannot.
+        replica.check_errors()
+        times.append(time.perf_counter() - t0)
+    t_kernel = sum(times) / len(times)
+    stddev = (
+        sum((t - t_kernel) ** 2 for t in times) / len(times)
+    ) ** 0.5
+    print(
+        f"runs: {[round(t, 3) for t in times]} mean {t_kernel:.3f}s "
+        f"stddev {stddev:.3f}s", file=sys.stderr,
+    )
     kernel_ops_s = n_ops / t_kernel
     if engine == "overlay":
         detail = (

@@ -151,6 +151,9 @@ def _eff_seq(seq: int) -> int:
     return seq
 
 
+_MISSING = object()  # annotate rollback: key absent before the op
+
+
 @dataclass(eq=False)
 class _PendingGroup:
     """One local op's segment group awaiting ack (reference SegmentGroup)."""
@@ -159,6 +162,9 @@ class _PendingGroup:
     segments: List[Segment] = field(default_factory=list)
     props: Optional[Dict[str, Any]] = None  # for annotate acks
     local_seq: Optional[int] = None
+    # Per-segment prior prop values (aligned with `segments`), captured
+    # by local annotates to make rollback exact (mergeTree.ts:2057).
+    prevs: Optional[List[Dict[str, Any]]] = None
 
 
 class MergeTreeEngine:
@@ -424,6 +430,7 @@ class MergeTreeEngine:
             self.local_seq += 1
 
         pending_segs: List[Segment] = []
+        prevs: List[Dict[str, Any]] = []
         pos = 0
         for seg in self.segments:
             if pos >= end:
@@ -434,8 +441,10 @@ class MergeTreeEngine:
             if pos >= start:
                 if seg.props is None:
                     seg.props = {}
+                prev: Dict[str, Any] = {}
                 for key, value in props.items():
                     if is_local:
+                        prev[key] = seg.props.get(key, _MISSING)
                         if seg.pending_props is None:
                             seg.pending_props = {}
                         seg.pending_props[key] = seg.pending_props.get(key, 0) + 1
@@ -445,6 +454,7 @@ class MergeTreeEngine:
                             continue  # shadowed by pending local write
                         _set_prop(seg.props, key, value)
                 pending_segs.append(seg)
+                prevs.append(prev)
             pos += length
 
         if is_local:
@@ -452,6 +462,7 @@ class MergeTreeEngine:
                 kind=MergeTreeDeltaType.ANNOTATE,
                 props=dict(props),
                 local_seq=self.local_seq,
+                prevs=prevs,
             )
             for s in pending_segs:
                 grp.segments.append(s)
@@ -490,6 +501,70 @@ class MergeTreeEngine:
                                 del seg.pending_props[key]
                             else:
                                 seg.pending_props[key] = cnt - 1
+
+    # ------------------------------------------------------------ rollback
+
+    def rollback(self, grp: "_PendingGroup") -> None:
+        """Roll back the MOST RECENT pending local op (reference
+        MergeTree.rollback, mergeTree.ts:2057 — the orderSequentially
+        abort path, which unwinds in LIFO order before any other op
+        can interleave).
+
+        - insert: the pending segments are physically dropped (no
+          other replica ever saw them); references slide forward to
+          the next survivor, as in zamboni collection;
+        - remove: the pending removal marks are cleared;
+        - annotate: prior values (captured at apply) are restored and
+          the pending-write shadow counts decremented.
+        """
+        assert self.pending and self.pending[-1] is grp, (
+            "rollback out of order: only the newest pending op can roll back"
+        )
+        self.pending.pop()
+        for s in grp.segments:
+            s.groups = [g for g in s.groups if g is not grp]
+        if grp.kind == MergeTreeDeltaType.INSERT:
+            dead = {id(s) for s in grp.segments}
+            kept: List[Segment] = []
+            orphaned: List[LocalReference] = []
+            for s in self.segments:
+                if id(s) in dead:
+                    orphaned.extend(s.refs)
+                    s.refs = []
+                else:
+                    if orphaned:
+                        for r in orphaned:
+                            r.segment = s
+                            r.offset = 0
+                            s.refs.append(r)
+                        orphaned = []
+                    kept.append(s)
+            for r in orphaned:
+                r.segment = None
+                r.offset = 0
+            self.segments = kept
+        elif grp.kind == MergeTreeDeltaType.REMOVE:
+            for s in grp.segments:
+                if s.removed_seq == UNASSIGNED_SEQ:
+                    s.removed_seq = None
+                    s.local_removed_seq = None
+                    s.removed_clients = []
+        else:  # ANNOTATE
+            for s, prev in zip(grp.segments, grp.prevs or []):
+                for key, prior in prev.items():
+                    if prior is _MISSING:
+                        if s.props is not None:
+                            s.props.pop(key, None)
+                    else:
+                        if s.props is None:
+                            s.props = {}
+                        s.props[key] = prior
+                    cnt = (s.pending_props or {}).get(key)
+                    if cnt:
+                        if cnt == 1:
+                            del s.pending_props[key]
+                        else:
+                            s.pending_props[key] = cnt - 1
 
     # ------------------------------------------------- reconnect / rebase
 

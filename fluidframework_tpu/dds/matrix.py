@@ -142,18 +142,30 @@ class SharedMatrix(SharedObject):
             )
         else:
             eng.insert(pos, handles, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ)
+        self.emit(
+            "localAxisInsert",
+            "rows" if pv is self.rows else "cols", handles,
+        )
 
     def _axis_remove(self, pv: PermutationVector, pos: int, count: int, op_type: str) -> None:
+        axis = "rows" if pv is self.rows else "cols"
+        # Capture for undo (the productSet/bspSet role: removed
+        # region's identity + cell payload) — one pass over the cell
+        # map, not O(count x other-axis).
+        handles = [pv.local_handle_at(p) for p in range(pos, pos + count)]
+        hs = set(handles)
+        hi = 0 if pv is self.rows else 1
+        cells = {k: v for k, v in self._cells.items() if k[hi] in hs}
         eng = pv.engine
         if eng.collaborating:
             eng.remove_range(pos, pos + count, eng.current_seq, eng.local_client_id, UNASSIGNED_SEQ)
             self.submit_local_message(
                 {"type": op_type, "pos": pos, "count": count},
-                {"axis": "rows" if pv is self.rows else "cols",
-                 "group": eng.pending[-1]},
+                {"axis": axis, "group": eng.pending[-1]},
             )
         else:
             eng.remove_range(pos, pos + count, UNIVERSAL_SEQ, NON_COLLAB_CLIENT, UNIVERSAL_SEQ)
+        self.emit("localAxisRemove", axis, pos, handles, cells)
 
     def insert_rows(self, pos: int, count: int = 1) -> None:
         self._axis_insert(self.rows, pos, count, "insertRows")
@@ -175,6 +187,8 @@ class SharedMatrix(SharedObject):
 
     def set_cell(self, row: int, col: int, value: Any) -> None:
         key = (self.rows.local_handle_at(row), self.cols.local_handle_at(col))
+        had = key in self._cells
+        prev = self._cells.get(key)
         self._cells[key] = value
         if self.rows.engine.collaborating:
             self._pending_cells[key] = self._pending_cells.get(key, 0) + 1
@@ -182,7 +196,19 @@ class SharedMatrix(SharedObject):
                 {"type": "setCell", "row": row, "col": col, "value": value},
                 {"key": key},
             )
+        self.emit("localCellSet", key, had, prev)
         self.emit("cellChanged", row, col, True)
+
+    def set_cell_by_handle(self, key, value: Any) -> None:
+        """Set a cell addressed by its stable (row, col) HANDLES —
+        the undo path's addressing, immune to concurrent permutation.
+        No-op if either handle's row/col is no longer visible (the
+        cell died with its axis; reference matrix undo skips too)."""
+        r = self.rows.position_of_handle(key[0])
+        c = self.cols.position_of_handle(key[1])
+        if r is None or c is None:
+            return
+        self.set_cell(r, c, value)
 
     def to_dense(self) -> List[List[Any]]:
         """The visible grid (row-major), for assertions and export."""

@@ -88,6 +88,22 @@ class SharedSegmentSequence(SharedObject):
         grp = self.engine.pending[-1] if self.engine.pending else None
         self.submit_local_message({"kind": "seq", "op": op}, grp)
 
+    def rollback(self, content: Any, local_metadata: Any) -> None:
+        """Undo a just-applied local sequence op (orderSequentially
+        abort; reference revertSharedStringRevertibles path over
+        MergeTree.rollback, mergeTree.ts:2057). `local_metadata` is
+        the op's pending group."""
+        if content.get("kind") != "seq" or local_metadata is None:
+            raise NotImplementedError(
+                "rollback supports sequence ops with pending metadata"
+            )
+        grps = (
+            local_metadata
+            if isinstance(local_metadata, list) else [local_metadata]
+        )
+        for grp in reversed(grps):
+            self.engine.rollback(grp)
+
     def resubmit(self, content: Any, local_metadata: Any) -> None:
         """Reconnect replay: rebase the pending op against current
         state before resubmitting (reference reSubmitCore →

@@ -34,13 +34,16 @@ class _Rpc:
         self.host, self.port = host, port
 
     def call(self, **req) -> Any:
+        from ..server.framing import read_frame, write_frame
+
         with socket.create_connection((self.host, self.port)) as s:
-            f = s.makefile("rw", encoding="utf-8")
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            f = s.makefile("rwb")
             req.setdefault("id", 1)
-            f.write(json.dumps(req) + "\n")
-            f.flush()
-            line = f.readline()
-            resp = json.loads(line)
+            write_frame(f, req)
+            resp = read_frame(f)
+            if resp is None:
+                raise ConnectionError("server closed during RPC")
             if "error" in resp:
                 raise RuntimeError(f"server error: {resp['error']}")
             return resp["result"]
@@ -52,7 +55,8 @@ class _SocketConnection:
     def __init__(self, host: str, port: int, doc_id: str,
                  client_id: Optional[int]):
         self._sock = socket.create_connection((host, port))
-        self._file = self._sock.makefile("rw", encoding="utf-8")
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._file = self._sock.makefile("rwb")
         self._req_id = 0
         self._pending_resp: dict = {}
         self._resp_cond = threading.Condition()
@@ -88,7 +92,6 @@ class _SocketConnection:
             self._req_id += 1
             rid = self._req_id
         req["id"] = rid
-        data = json.dumps(req) + "\n"
         if threading.current_thread() is self._reader:
             # All callbacks run on the dispatcher thread, so an RPC
             # from the reader is a bug — and it could never complete
@@ -96,9 +99,10 @@ class _SocketConnection:
             raise RuntimeError(
                 "RPC from the socket reader thread would deadlock"
             )
+        from ..server.framing import write_frame
+
         with self._wlock:  # dispatcher-thread callbacks may also submit
-            self._file.write(data)
-            self._file.flush()
+            write_frame(self._file, req)
         with self._resp_cond:
             while rid not in self._pending_resp:
                 if not self._reader.is_alive():
@@ -110,9 +114,23 @@ class _SocketConnection:
         return resp["result"]
 
     def _read_loop(self) -> None:
+        import json as _json
+
+        from ..server.framing import KIND_OPS, read_frame_raw
+
         try:
-            for line in self._file:
-                frame = json.loads(line)
+            while True:
+                raw = read_frame_raw(self._file)
+                if raw is None:
+                    break
+                kind, body = raw
+                if kind == KIND_OPS:
+                    # Batched broadcast: routed WITHOUT parsing (the
+                    # dispatcher defers the parse until a consumer is
+                    # attached — idle fan-out costs no CPU).
+                    self._events.put({"__raw_ops__": body})
+                    continue
+                frame = _json.loads(body)
                 if "event" in frame:
                     self._events.put(frame)
                 else:
@@ -157,16 +175,34 @@ class _SocketConnection:
                 return
 
     def _on_event(self, frame: dict) -> None:
-        if frame["event"] == "op":
-            msg = message_from_json(frame["msg"])
-            # Deliver under the lock: serializes against the listener
-            # setter's early-op drain so ops can neither strand in
-            # _early nor overtake buffered older ones.
+        if "__raw_ops__" in frame:
             with self._lock:
                 if self._listener is None:
-                    self._early.append(msg)
+                    # Wire bytes buffer as-is; decoded on attach.
+                    self._early.append(frame["__raw_ops__"])
                     return
-                self._listener(msg)
+            import json as _json
+
+            frame = _json.loads(frame["__raw_ops__"])
+        if frame["event"] == "ops":
+            # Batched broadcast (one frame per broadcaster pump —
+            # fan-out cost amortizes across the room's ops).
+            for m in frame["msgs"]:
+                self._on_event({"event": "op", "msg": m})
+            return
+        if frame["event"] == "op":
+            # The buffer-or-deliver decision is made under the lock
+            # (serializing against the setter's early-op drain so ops
+            # neither strand in _early nor overtake buffered ones);
+            # the captured listener is invoked outside it so decode
+            # stays off the critical section. Buffered ops stay in
+            # WIRE form — decode defers until a consumer attaches.
+            with self._lock:
+                listener = self._listener
+                if listener is None:
+                    self._early.append(frame["msg"])
+                    return
+            listener(message_from_json(frame["msg"]))
         elif frame["event"] == "nack":
             m = frame["msg"]
             if self.nack_listener is not None:
@@ -192,7 +228,13 @@ class _SocketConnection:
             if fn is not None and self._early:
                 early, self._early = self._early, []
                 for m in early:
-                    fn(m)
+                    if isinstance(m, bytes):  # deferred ops frame
+                        import json as _json
+
+                        for w in _json.loads(m)["msgs"]:
+                            fn(message_from_json(w))
+                    else:
+                        fn(message_from_json(m))
 
     def submit(self, msg: DocumentMessage) -> None:
         from ..server.socket_service import document_message_to_json

@@ -243,3 +243,99 @@ class SharedStringUndoRedoHandler:
 
     def close(self) -> None:
         self.s.off("sequenceDelta", self._on_delta)
+
+
+# ----------------------------------------------------------------- matrix
+
+
+class _CellSetRevertible:
+    """Undo one setCell: restore the prior value at the cell's stable
+    HANDLE address (immune to concurrent row/col permutation — the
+    productSet/bspSet role of tracking 2D targets by identity)."""
+
+    def __init__(self, matrix, key, had: bool, prev: Any):
+        self.matrix = matrix
+        self.key = key
+        self.prev = prev if had else None
+
+    def revert(self) -> None:
+        self.matrix.set_cell_by_handle(self.key, self.prev)
+
+
+class _AxisInsertRevertible:
+    """Undo insertRows/insertCols: remove the inserted rows/cols at
+    their CURRENT positions (handles may have scattered under
+    concurrent permutation; each is located and removed by handle)."""
+
+    def __init__(self, matrix, axis: str, handles):
+        self.matrix = matrix
+        self.axis = axis
+        self.handles = list(handles)
+
+    def revert(self) -> None:
+        pv = self.matrix.rows if self.axis == "rows" else self.matrix.cols
+        remove = (
+            self.matrix.remove_rows
+            if self.axis == "rows" else self.matrix.remove_cols
+        )
+        # Positions shift as we remove; re-resolve each handle.
+        for h in self.handles:
+            pos = pv.position_of_handle(h)
+            if pos is not None:
+                remove(pos, 1)
+
+
+class _AxisRemoveRevertible:
+    """Undo removeRows/removeCols: re-insert the rows/cols and restore
+    their captured cell payload. Restored cells land at the NEW
+    handles for the reinserted axis, keyed through the surviving
+    cross-axis handles."""
+
+    def __init__(self, matrix, axis: str, pos: int, handles, cells):
+        self.matrix = matrix
+        self.axis = axis
+        self.pos = pos
+        self.handles = list(handles)
+        self.cells = dict(cells)
+
+    def revert(self) -> None:
+        m = self.matrix
+        rows_axis = self.axis == "rows"
+        pv = m.rows if rows_axis else m.cols
+        insert = m.insert_rows if rows_axis else m.insert_cols
+        pos = min(self.pos, pv.length())
+        insert(pos, len(self.handles))
+        new_handles = [pv.local_handle_at(pos + i)
+                       for i in range(len(self.handles))]
+        remap = dict(zip(self.handles, new_handles))
+        for (rh, ch), value in self.cells.items():
+            key = (
+                (remap[rh], ch) if rows_axis else (rh, remap[ch])
+            )
+            m.set_cell_by_handle(key, value)
+
+
+class SharedMatrixUndoRedoHandler:
+    """Connects a SharedMatrix to the undo/redo stack (the reference
+    matrix's IUndoConsumer over productSet/bspSet undo tracking,
+    packages/dds/matrix/src/{productSet,bspSet}.ts — re-expressed over
+    stable handles instead of spatial BSP sets: handle identity gives
+    permutation-independent targeting for free)."""
+
+    def __init__(self, stack: UndoRedoStackManager, matrix):
+        self.stack = stack
+        self.matrix = matrix
+        matrix.on("localCellSet", self._on_cell)
+        matrix.on("localAxisInsert", self._on_insert)
+        matrix.on("localAxisRemove", self._on_remove)
+
+    def _on_cell(self, key, had, prev) -> None:
+        self.stack.push(_CellSetRevertible(self.matrix, key, had, prev))
+
+    def _on_insert(self, axis, handles) -> None:
+        self.stack.push(_AxisInsertRevertible(self.matrix, axis, handles))
+
+    def _on_remove(self, axis, pos, handles, cells) -> None:
+        self.stack.push(
+            _AxisRemoveRevertible(self.matrix, axis, pos, handles, cells)
+        )

@@ -71,6 +71,8 @@ def load_castore() -> Optional[ctypes.CDLL]:
             _load_failed = True
             return None
         lib.cas_new.restype = ctypes.c_void_p
+        lib.cas_open.restype = ctypes.c_void_p
+        lib.cas_open.argtypes = [ctypes.c_char_p]
         lib.cas_free.argtypes = [ctypes.c_void_p]
         lib.cas_put.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
@@ -103,9 +105,12 @@ class NativeContentStore:
     """ctypes wrapper over the C++ store (same surface and digests as
     the pure-Python ContentAddressedStore)."""
 
-    def __init__(self, lib: ctypes.CDLL):
+    def __init__(self, lib: ctypes.CDLL, directory: Optional[str] = None):
         self._lib = lib
-        self._ptr = ctypes.c_void_p(lib.cas_new())
+        if directory:
+            self._ptr = ctypes.c_void_p(lib.cas_open(directory.encode()))
+        else:
+            self._ptr = ctypes.c_void_p(lib.cas_new())
 
     def __del__(self):
         ptr, self._ptr = getattr(self, "_ptr", None), None

@@ -10,7 +10,12 @@
 // SHA-256 is implemented inline from the FIPS 180-4 specification so
 // the library has zero dependencies beyond the C++ standard library.
 
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -117,6 +122,75 @@ struct Store {
   std::map<std::string, std::vector<uint8_t>> blobs;
   std::map<std::string, std::string> refs;
   std::mutex mu;
+  // Durable mode (the gitrest role's persistence): blobs as files
+  // under <dir>/objects/<h[0:2]>/<hash>, refs in an append-only
+  // fsynced journal <dir>/refs.log (last writer wins on replay).
+  std::string dir;  // empty => in-memory only
+  int refs_fd = -1;
+
+  ~Store() {
+    if (refs_fd >= 0) ::close(refs_fd);
+  }
+
+  std::string blob_path(const std::string& key) const {
+    return dir + "/objects/" + key.substr(0, 2) + "/" + key;
+  }
+
+  bool persist_blob(const std::string& key, const uint8_t* data, size_t n) {
+    if (dir.empty()) return true;
+    std::string path = blob_path(key);
+    struct stat sb;
+    if (::stat(path.c_str(), &sb) == 0) return true;  // content-addressed: done
+    std::string d1 = dir + "/objects";
+    ::mkdir(d1.c_str(), 0777);
+    std::string d2 = d1 + "/" + key.substr(0, 2);
+    ::mkdir(d2.c_str(), 0777);
+    std::string tmp = path + ".tmp." + std::to_string(::getpid());
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0666);
+    if (fd < 0) return false;
+    size_t off = 0;
+    while (off < n) {
+      ssize_t w = ::write(fd, data + off, n - off);
+      if (w <= 0) { ::close(fd); ::unlink(tmp.c_str()); return false; }
+      off += size_t(w);
+    }
+    ::fsync(fd);
+    ::close(fd);
+    if (::rename(tmp.c_str(), path.c_str()) != 0) {
+      ::unlink(tmp.c_str());
+      return false;
+    }
+    return true;
+  }
+
+  bool load_blob(const std::string& key) {
+    if (dir.empty()) return false;
+    int fd = ::open(blob_path(key).c_str(), O_RDONLY);
+    if (fd < 0) return false;
+    std::vector<uint8_t> data;
+    uint8_t buf[1 << 16];
+    ssize_t r;
+    while ((r = ::read(fd, buf, sizeof(buf))) > 0)
+      data.insert(data.end(), buf, buf + r);
+    ::close(fd);
+    blobs.emplace(key, std::move(data));
+    return true;
+  }
+
+  bool persist_ref(const std::string& name, const std::string& key) {
+    if (dir.empty()) return true;
+    if (refs_fd < 0) {
+      refs_fd = ::open((dir + "/refs.log").c_str(),
+                       O_WRONLY | O_CREAT | O_APPEND, 0666);
+      if (refs_fd < 0) return false;
+    }
+    std::string line = name + " " + key + "\n";
+    if (::write(refs_fd, line.data(), line.size()) !=
+        ssize_t(line.size()))
+      return false;
+    ::fsync(refs_fd);  // a ref update IS the durability point
+    return true;
+  }
 };
 
 }  // namespace
@@ -124,6 +198,23 @@ struct Store {
 extern "C" {
 
 void* cas_new() { return new Store(); }
+
+// Durable store rooted at `dir` (created if absent); refs replay from
+// the journal, blobs load lazily from the object files.
+void* cas_open(const char* dir) {
+  auto* st = new Store();
+  st->dir = dir;
+  ::mkdir(dir, 0777);
+  ::mkdir((st->dir + "/objects").c_str(), 0777);
+  FILE* f = ::fopen((st->dir + "/refs.log").c_str(), "r");
+  if (f) {
+    char name[512], key[80];
+    while (::fscanf(f, "%511s %79s", name, key) == 2)
+      st->refs[name] = key;  // journal replay: last writer wins
+    ::fclose(f);
+  }
+  return st;
+}
 
 void cas_free(void* p) { delete static_cast<Store*>(p); }
 
@@ -137,6 +228,7 @@ void cas_put(void* p, const uint8_t* data, size_t n, char* out_key) {
     std::lock_guard<std::mutex> g(st->mu);
     st->blobs.emplace(std::string(key),
                       std::vector<uint8_t>(data, data + n));
+    st->persist_blob(key, data, n);
   }
   std::memcpy(out_key, key, 65);
 }
@@ -145,14 +237,21 @@ long cas_get_len(void* p, const char* key) {
   auto* st = static_cast<Store*>(p);
   std::lock_guard<std::mutex> g(st->mu);
   auto it = st->blobs.find(key);
-  return it == st->blobs.end() ? -1 : long(it->second.size());
+  if (it == st->blobs.end()) {
+    if (!st->load_blob(key)) return -1;
+    it = st->blobs.find(key);
+  }
+  return long(it->second.size());
 }
 
 long cas_get(void* p, const char* key, uint8_t* buf, size_t buf_len) {
   auto* st = static_cast<Store*>(p);
   std::lock_guard<std::mutex> g(st->mu);
   auto it = st->blobs.find(key);
-  if (it == st->blobs.end()) return -1;
+  if (it == st->blobs.end()) {
+    if (!st->load_blob(key)) return -1;
+    it = st->blobs.find(key);
+  }
   size_t n = it->second.size();
   if (buf && buf_len >= n) std::memcpy(buf, it->second.data(), n);
   return long(n);
@@ -161,14 +260,23 @@ long cas_get(void* p, const char* key, uint8_t* buf, size_t buf_len) {
 int cas_contains(void* p, const char* key) {
   auto* st = static_cast<Store*>(p);
   std::lock_guard<std::mutex> g(st->mu);
-  return st->blobs.count(key) ? 1 : 0;
+  if (st->blobs.count(key)) return 1;
+  if (st->dir.empty()) return 0;
+  struct stat sb;
+  return ::stat(st->blob_path(key).c_str(), &sb) == 0 ? 1 : 0;
 }
 
 int cas_set_ref(void* p, const char* name, const char* key) {
   auto* st = static_cast<Store*>(p);
   std::lock_guard<std::mutex> g(st->mu);
-  if (!st->blobs.count(key)) return -1;
+  if (!st->blobs.count(key)) {
+    struct stat sb;
+    if (st->dir.empty() ||
+        ::stat(st->blob_path(key).c_str(), &sb) != 0)
+      return -1;
+  }
   st->refs[name] = key;
+  if (!st->persist_ref(name, key)) return -2;
   return 0;
 }
 

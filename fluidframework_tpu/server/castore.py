@@ -19,31 +19,79 @@ Two backends with identical semantics and digests:
 from __future__ import annotations
 
 import hashlib
+import os
 from typing import Dict, List, Optional
 
 
 class _PyStore:
-    def __init__(self):
+    """In-memory or disk-backed store, on-disk format IDENTICAL to the
+    native store (objects/<h[0:2]>/<hash> blob files + fsynced
+    refs.log journal) so the backends interchange freely."""
+
+    def __init__(self, directory: Optional[str] = None):
         self._blobs: Dict[str, bytes] = {}
         self._refs: Dict[str, str] = {}
+        self._dir = directory
+        self._refs_f = None
+        if directory:
+            os.makedirs(os.path.join(directory, "objects"), exist_ok=True)
+            refs_path = os.path.join(directory, "refs.log")
+            if os.path.exists(refs_path):
+                with open(refs_path) as f:
+                    for line in f:
+                        parts = line.split()
+                        if len(parts) == 2:
+                            self._refs[parts[0]] = parts[1]
+
+    def _blob_path(self, key: str) -> str:
+        return os.path.join(self._dir, "objects", key[:2], key)
 
     def put(self, content) -> str:
         if isinstance(content, str):
             content = content.encode()
         key = hashlib.sha256(content).hexdigest()
         self._blobs[key] = content
+        if self._dir:
+            path = self._blob_path(key)
+            if not os.path.exists(path):
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    f.write(content)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
         return key
 
     def get(self, key: str) -> bytes:
-        return self._blobs[key]
+        if key in self._blobs:
+            return self._blobs[key]
+        if self._dir:
+            path = self._blob_path(key)
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    data = f.read()
+                self._blobs[key] = data
+                return data
+        raise KeyError(key)
 
     def contains(self, key: str) -> bool:
-        return key in self._blobs
+        if key in self._blobs:
+            return True
+        return bool(self._dir) and os.path.exists(self._blob_path(key))
 
     def set_ref(self, name: str, key: str) -> None:
-        if key not in self._blobs:
+        if not self.contains(key):
             raise KeyError(f"unknown blob {key}")
         self._refs[name] = key
+        if self._dir:
+            if self._refs_f is None:
+                self._refs_f = open(
+                    os.path.join(self._dir, "refs.log"), "a"
+                )
+            self._refs_f.write(f"{name} {key}\n")
+            self._refs_f.flush()
+            os.fsync(self._refs_f.fileno())  # ref update = durability point
 
     def get_ref(self, name: str) -> Optional[str]:
         return self._refs.get(name)
@@ -55,21 +103,27 @@ class _PyStore:
 class ContentAddressedStore:
     """Facade over the native or pure-Python backend."""
 
-    def __init__(self, prefer_native: bool = True):
+    def __init__(self, prefer_native: bool = True,
+                 directory: Optional[str] = None):
+        """`directory` switches on DURABLE mode (the gitrest role's
+        persistence): blobs as content-addressed object files, refs in
+        an fsynced append-only journal, state surviving process
+        restart. Both backends share the on-disk format."""
         self._impl = None
         self.backend = "python"
+        self.directory = directory
         if prefer_native:
             try:
                 from ..native import NativeContentStore, load_castore
 
                 lib = load_castore()
                 if lib is not None:
-                    self._impl = NativeContentStore(lib)
+                    self._impl = NativeContentStore(lib, directory)
                     self.backend = "native"
             except Exception:
                 self._impl = None
         if self._impl is None:
-            self._impl = _PyStore()
+            self._impl = _PyStore(directory)
 
     def put(self, content) -> str:
         return self._impl.put(content)

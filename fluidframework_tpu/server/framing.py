@@ -1,0 +1,73 @@
+"""Length-prefixed binary framing for the TCP transport.
+
+Round 2's transport used newline-delimited JSON — fine for a demo,
+but content containing newlines needs escaping, partial reads corrupt
+the stream, and framing costs a scan of every byte. Frames are now
+``>I`` big-endian length + payload (JSON bytes today; the scheme is
+payload-agnostic, matching how the reference rides socket.io's binary
+packet framing). A max-frame guard kills malformed/hostile streams
+instead of attempting a multi-GB allocation.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Optional
+
+_HEADER = struct.Struct(">IB")
+MAX_FRAME = 64 << 20  # 64 MiB: far above any legitimate frame
+
+# Frame kinds (outside the payload, so receivers can route/defer a
+# frame WITHOUT parsing it — an idle connection buffers kind-OPS
+# frames as raw bytes at zero CPU).
+KIND_MSG = 0  # RPC request/response or single event: parse on receipt
+KIND_OPS = 1  # batched sequenced-op broadcast: parse lazily
+
+
+def encode_frame(obj: Any, kind: int = KIND_MSG) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode()
+    if len(payload) > MAX_FRAME:
+        raise ValueError(f"frame too large: {len(payload)} bytes")
+    return _HEADER.pack(len(payload), kind) + payload
+
+
+def write_frame(wfile, obj: Any, kind: int = KIND_MSG) -> None:
+    wfile.write(encode_frame(obj, kind))
+    wfile.flush()
+
+
+def _read_exact(rfile, n: int) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        part = rfile.read(n - got)
+        if not part:
+            return None  # EOF
+        chunks.append(part)
+        got += len(part)
+    return b"".join(chunks)
+
+
+def read_frame_raw(rfile):
+    """Next frame as ``(kind, payload_bytes)``; None on clean EOF at
+    a frame boundary."""
+    hdr = _read_exact(rfile, _HEADER.size)
+    if hdr is None:
+        return None
+    n, kind = _HEADER.unpack(hdr)
+    if n > MAX_FRAME:
+        raise ValueError(f"frame length {n} exceeds cap {MAX_FRAME}")
+    body = _read_exact(rfile, n)
+    if body is None:
+        raise ConnectionError("truncated frame")
+    return kind, body
+
+
+def read_frame(rfile) -> Optional[Any]:
+    """Next frame parsed, or None on clean EOF (kind discarded —
+    server-side requests are always KIND_MSG)."""
+    raw = read_frame_raw(rfile)
+    if raw is None:
+        return None
+    return json.loads(raw[1])

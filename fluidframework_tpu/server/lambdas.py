@@ -200,16 +200,33 @@ class BroadcasterLambda:
     def pump(self) -> int:
         n = 0
         failed = []
+        pending: Dict[str, List[Any]] = {}
+
+        def flush(doc: str) -> None:
+            msgs = pending.pop(doc, None)
+            if not msgs:
+                return
+            memo: Dict[str, Any] = {}
+            for sock in list(self.rooms.get(doc, [])):
+                self._deliver_safe(
+                    doc, sock, "deliver_batch", (msgs, memo), failed
+                )
+
         for entry in self.consumer.poll():
             doc = entry["doc"]
             if entry["kind"] == "op":
-                for sock in list(self.rooms.get(doc, [])):
-                    self._deliver_safe(doc, sock, "deliver", entry["msg"], failed)
+                # Batch per doc per pump (broadcaster/lambda.ts:49's
+                # per-tick batching); flushed before any nack so
+                # per-client ordering holds.
+                pending.setdefault(doc, []).append(entry["msg"])
             elif entry["kind"] == "nack":
+                flush(doc)
                 for sock in list(self.rooms.get(doc, [])):
                     if sock.client_id == entry["client"]:
                         self._deliver_safe(doc, sock, "nack", entry["msg"], failed)
             n += 1
+        for doc in list(pending):
+            flush(doc)
         # Disconnect failures only AFTER the polled batch is fully
         # delivered: disconnect() pumps the pipeline re-entrantly
         # (leave sequencing), and doing that mid-batch would deliver
@@ -231,7 +248,10 @@ class BroadcasterLambda:
         catches up from storage (alfred's room-eviction behavior,
         alfred/index.ts:211)."""
         try:
-            getattr(sock, meth)(msg)
+            if meth == "deliver_batch":
+                sock.deliver_batch(*msg)
+            else:
+                getattr(sock, meth)(msg)
         except Exception as exc:
             # Loud eviction: an application error in a replica's
             # listener must stay visible, or divergence debugging
@@ -360,14 +380,52 @@ class _Socket(BufferedListener):
         self.disconnect_listener: Optional[Callable[[], None]] = None
         self.connected = True
         self.join_seq = 0
+        # Optional batched delivery sink (the TCP front end sets it:
+        # one pre-encoded frame per broadcaster pump instead of one
+        # per op — the reference broadcaster's per-tick batching,
+        # broadcaster/lambda.ts:49).
+        self.batch_listener: Optional[Callable] = None
 
     # broadcaster side
-    def deliver(self, msg: SequencedMessage) -> None:
+    def deliver_batch(self, msgs: List[SequencedMessage],
+                      memo: Optional[dict] = None) -> None:
+        """Deliver a run of sequenced ops. Per-socket join/seq
+        filtering still applies; sockets that accept the FULL batch
+        share `memo` so the transport encodes the frame once per
+        room."""
+        if (self.connected and self.join_seq
+                and msgs[0].sequence_number > self.join_seq):
+            out = msgs  # steady state: the whole batch is deliverable
+        else:
+            out = []
+            for m in msgs:
+                if self._filter_own_join(m):
+                    continue
+                if (not self.connected or self.join_seq == 0
+                        or m.sequence_number <= self.join_seq):
+                    continue
+                out.append(m)
+            if not out:
+                return
+        if self.batch_listener is not None:
+            self.batch_listener(
+                out, memo if len(out) == len(msgs) else None
+            )
+        else:
+            for m in out:
+                self._dispatch(m)
+
+    def _filter_own_join(self, msg: SequencedMessage) -> bool:
         if self.join_seq == 0 and msg.type == MessageType.CLIENT_JOIN:
             cid = msg.contents if not isinstance(msg.contents, dict) else msg.contents.get("clientId")
             if cid == self.client_id:
                 self.join_seq = msg.sequence_number
-                return  # own join: surfaced via catch_up, not live
+                return True  # own join: surfaced via catch_up, not live
+        return False
+
+    def deliver(self, msg: SequencedMessage) -> None:
+        if self._filter_own_join(msg):
+            return
         if not self.connected or msg.sequence_number <= self.join_seq or self.join_seq == 0:
             return
         self._dispatch(msg)
@@ -414,11 +472,34 @@ class LocalServer:
         deferred: bool = False,
         checkpoints: Optional[dict] = None,
         log: Optional[MessageLog] = None,
+        persist_dir: Optional[str] = None,
     ):
         """Restart contract: pass the previous instance's `log` (the
         durable substrate, as Kafka retains topics across lambda
         crashes), `storage`, and `checkpoints()`; every lambda resumes
-        from its checkpointed offset/state."""
+        from its checkpointed offset/state.
+
+        `persist_dir` makes the contract hold across PROCESS restarts
+        (the gitrest+Kafka durability, SURVEY.md §2.5): blob store and
+        topic journals live on disk there, lambda checkpoints write to
+        <dir>/checkpoints.json after every pump, and a fresh
+        LocalServer(persist_dir=same) resumes the documents."""
+        self.persist_dir = persist_dir
+        if persist_dir is not None:
+            import os
+
+            os.makedirs(persist_dir, exist_ok=True)
+            if log is None:
+                log = MessageLog(os.path.join(persist_dir, "topics"))
+            if storage is None:
+                storage = ContentAddressedStore(
+                    directory=os.path.join(persist_dir, "store")
+                )
+            if checkpoints is None:
+                cp_path = os.path.join(persist_dir, "checkpoints.json")
+                if os.path.exists(cp_path):
+                    with open(cp_path) as f:
+                        checkpoints = json.load(f)
         self.log = log if log is not None else MessageLog()
         self.storage = storage if storage is not None else ContentAddressedStore()
         cp = checkpoints or {}
@@ -432,6 +513,16 @@ class LocalServer:
         self.scribe = ScribeLambda(self.log, self.storage, cp.get("scribe"))
         self.deferred = deferred
         self._next_client: Dict[str, int] = {}
+        if persist_dir is not None:
+            # Never re-issue a client id from a previous life: replay
+            # the journaled joins (stale ids would collide with the
+            # dead clients' ops during catch-up).
+            for entry in self.log.topic("rawdeltas").read(0):
+                if isinstance(entry, dict) and entry.get("kind") == "join":
+                    doc = entry["doc"]
+                    self._next_client[doc] = max(
+                        self._next_client.get(doc, 1), entry["client"] + 1
+                    )
         # Broadcaster must lag scriptorium so catch_up is complete by
         # the time a live op arrives; pump order below guarantees it.
 
@@ -446,8 +537,25 @@ class LocalServer:
             moved += self.scribe.pump()
             moved += self.broadcaster.pump()
             if moved == 0:
+                if n and self.persist_dir is not None:
+                    self._persist_checkpoints()
                 return n
             n += moved
+
+    def _persist_checkpoints(self) -> None:
+        import os
+
+        # Durability order: the journals the checkpoint offsets refer
+        # to must reach disk BEFORE the checkpoint that cites them —
+        # else a crash replays a log with holes.
+        self.log.sync()
+        path = os.path.join(self.persist_dir, "checkpoints.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.checkpoints(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def _auto_pump(self) -> None:
         if not self.deferred:
@@ -540,14 +648,43 @@ class LocalServer:
 
     def upload_summary(self, wire: str) -> str:
         """Client summary upload (the storage.uploadSummaryWithContext
-        role): returns the handle to cite in the summarize op."""
-        return self.storage.put(wire.encode())
+        role): returns the handle to cite in the summarize op.
+
+        Summaries are stored SHREDDED (the gitrest tree-structure /
+        shreddedSummaryDocumentStorageService role): every blob leaf
+        becomes its own content-addressed object and the manifest
+        references them by hash. Content addressing dedups across
+        summaries automatically, so an incremental summary (one dirty
+        channel re-serialized) stores only that channel's new blob +
+        a small manifest — unchanged channels are not rewritten."""
+        shredded = self._shred(json.loads(wire))
+        return self.storage.put(
+            json.dumps({"shredded": 1, "tree": shredded}).encode()
+        )
+
+    def _shred(self, node: Any) -> Any:
+        if isinstance(node, dict) and node.get("type") == "blob":
+            raw = json.dumps(node, sort_keys=True).encode()
+            return {"type": "blobref", "key": self.storage.put(raw)}
+        if isinstance(node, dict):
+            return {k: self._shred(v) for k, v in node.items()}
+        return node
+
+    def _unshred(self, node: Any) -> Any:
+        if isinstance(node, dict) and node.get("type") == "blobref":
+            return json.loads(self.storage.get(node["key"]).decode())
+        if isinstance(node, dict):
+            return {k: self._unshred(v) for k, v in node.items()}
+        return node
 
     def download_summary(self, doc_id: str) -> Optional[str]:
         key = self.storage.get_ref(doc_id)
         if key is None:
             return None
-        return self.storage.get(key).decode()
+        data = json.loads(self.storage.get(key).decode())
+        if isinstance(data, dict) and data.get("shredded"):
+            return json.dumps(self._unshred(data["tree"]))
+        return json.dumps(data)
 
     # -------------------------------------------------------- lifecycle
 

@@ -16,24 +16,127 @@ version is the reference and fallback.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Dict, List, Optional
 
 
-class LogTopic:
-    """One append-only, offset-addressed message log."""
+def _encode_entry(entry: Any) -> Any:
+    """Log entries carry protocol message + merge-tree op OBJECTS;
+    tag-encode them so the durable journal is plain JSON and replay
+    reconstructs the exact in-memory forms."""
+    import dataclasses
 
-    def __init__(self, name: str):
+    from ..protocol.messages import DocumentMessage, SequencedMessage
+
+    if isinstance(entry, SequencedMessage):
+        return {"__seqmsg__": {
+            "seq": entry.sequence_number, "msn": entry.minimum_sequence_number,
+            "client": entry.client_id, "cseq": entry.client_seq,
+            "ref": entry.ref_seq, "type": entry.type.value,
+            "contents": _encode_entry(entry.contents),
+            "metadata": _encode_entry(entry.metadata),
+            "address": entry.address, "ts": entry.timestamp,
+        }}
+    if isinstance(entry, DocumentMessage):
+        return {"__docmsg__": {
+            "cseq": entry.client_seq, "ref": entry.ref_seq,
+            "type": entry.type.value,
+            "contents": _encode_entry(entry.contents),
+            "metadata": _encode_entry(entry.metadata),
+            "address": entry.address,
+        }}
+    if dataclasses.is_dataclass(entry) and not isinstance(entry, type):
+        from ..protocol.mergetree_ops import op_to_json
+
+        return {"__op__": op_to_json(entry)}
+    if isinstance(entry, dict):
+        return {k: _encode_entry(v) for k, v in entry.items()}
+    if isinstance(entry, list):
+        return [_encode_entry(v) for v in entry]
+    return entry
+
+
+def _decode_entry(data: Any) -> Any:
+    from ..protocol.messages import (
+        DocumentMessage,
+        MessageType,
+        SequencedMessage,
+    )
+
+    if isinstance(data, dict):
+        if "__seqmsg__" in data:
+            d = data["__seqmsg__"]
+            return SequencedMessage(
+                sequence_number=d["seq"], minimum_sequence_number=d["msn"],
+                client_id=d["client"], client_seq=d["cseq"],
+                ref_seq=d["ref"], type=MessageType(d["type"]),
+                contents=_decode_entry(d["contents"]),
+                metadata=_decode_entry(d["metadata"]),
+                address=d.get("address"), timestamp=d.get("ts", 0.0),
+            )
+        if "__docmsg__" in data:
+            d = data["__docmsg__"]
+            return DocumentMessage(
+                client_seq=d["cseq"], ref_seq=d["ref"],
+                type=MessageType(d["type"]),
+                contents=_decode_entry(d["contents"]),
+                metadata=_decode_entry(d["metadata"]),
+                address=d.get("address"),
+            )
+        if "__op__" in data:
+            from ..protocol.mergetree_ops import op_from_json
+
+            return op_from_json(data["__op__"])
+        return {k: _decode_entry(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [_decode_entry(v) for v in data]
+    return data
+
+
+class LogTopic:
+    """One append-only, offset-addressed message log. With a backing
+    `path`, every append also journals to disk (JSONL, flushed) and
+    the topic replays from the journal on open — the Kafka topic
+    retention that makes lambda restart/catch-up real across PROCESS
+    restarts."""
+
+    def __init__(self, name: str, path: Optional[str] = None):
         self.name = name
         self._messages: List[Any] = []
         self._subscribers: List[Callable[[int, Any], None]] = []
+        self._path = path
+        self._file = None
+        if path and os.path.exists(path):
+            import json
+
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self._messages.append(
+                            _decode_entry(json.loads(line))
+                        )
 
     def append(self, message: Any) -> int:
         """Append; returns the message's offset."""
         off = len(self._messages)
         self._messages.append(message)
+        if self._path is not None:
+            import json
+
+            if self._file is None:
+                self._file = open(self._path, "a")
+            self._file.write(json.dumps(_encode_entry(message)) + "\n")
+            self._file.flush()
         for fn in list(self._subscribers):
             fn(off, message)
         return off
+
+    def sync(self) -> None:
+        """fsync the journal (called at durability points: summary
+        refs, checkpoint writes)."""
+        if self._file is not None:
+            os.fsync(self._file.fileno())
 
     def read(self, from_offset: int, max_count: Optional[int] = None) -> List[Any]:
         end = len(self._messages)
@@ -51,15 +154,27 @@ class LogTopic:
 
 
 class MessageLog:
-    """Named topics (the broker)."""
+    """Named topics (the broker). With `directory`, topics journal to
+    <directory>/<topic>.jsonl and replay on open."""
 
-    def __init__(self):
+    def __init__(self, directory: Optional[str] = None):
         self.topics: Dict[str, LogTopic] = {}
+        self.directory = directory
+        if directory:
+            os.makedirs(directory, exist_ok=True)
 
     def topic(self, name: str) -> LogTopic:
         if name not in self.topics:
-            self.topics[name] = LogTopic(name)
+            path = (
+                os.path.join(self.directory, f"{name}.jsonl")
+                if self.directory else None
+            )
+            self.topics[name] = LogTopic(name, path)
         return self.topics[name]
+
+    def sync(self) -> None:
+        for t in self.topics.values():
+            t.sync()
 
 
 class LogConsumer:

@@ -48,6 +48,12 @@ class DocumentSequencer:
         self.seq = 0
         self.min_seq = 0
         self.clients: Dict[int, _ClientState] = {}
+        # MSN = min over clients' refSeqs; recomputing per stamp costs
+        # O(clients) on the hottest path, so the min is cached and
+        # recomputed only when a refSeq or the membership changes
+        # (deli's ClientSequenceNumberManager keeps a HEAP for the
+        # same reason, clientSeqManager.ts:22).
+        self._msn_dirty = True
 
     # ------------------------------------------------------- membership
 
@@ -57,6 +63,7 @@ class DocumentSequencer:
         self.clients[client_id] = _ClientState(
             ref_seq=self.seq, client_seq=0, last_update=now or time.time()
         )
+        self._msn_dirty = True
         return self._stamp(
             client_id=client_id,
             client_seq=0,
@@ -69,6 +76,7 @@ class DocumentSequencer:
         if client_id not in self.clients:
             return None
         self.clients.pop(client_id)
+        self._msn_dirty = True
         return self._stamp(
             client_id=client_id,
             client_seq=0,
@@ -116,7 +124,9 @@ class DocumentSequencer:
                 f"clientSeq {msg.client_seq}, expected {state.client_seq + 1}",
             )
         state.client_seq = msg.client_seq
-        state.ref_seq = msg.ref_seq
+        if msg.ref_seq != state.ref_seq:
+            state.ref_seq = msg.ref_seq
+            self._msn_dirty = True
         state.last_update = now or time.time()
         return self._stamp(
             client_id=client_id,
@@ -158,7 +168,10 @@ class DocumentSequencer:
         # MSN trails the head (deli: msn == seq when no clients so
         # summaries can collect everything).
         if self.clients:
+            if not self._msn_dirty:
+                return  # cached: no refSeq/membership change since
             msn = min(s.ref_seq for s in self.clients.values())
+            self._msn_dirty = False
         else:
             msn = self.seq
         # MSN is monotone even across eviction races.

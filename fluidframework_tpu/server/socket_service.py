@@ -7,7 +7,8 @@ only ever met the server inside one interpreter; this module serves
 the full lambda pipeline over TCP so a container in another PROCESS
 (or host) collaborates through it via `drivers.socket_driver`.
 
-Protocol: newline-delimited JSON frames.
+Protocol: length-prefixed binary frames (server/framing.py: 4-byte
+big-endian length + JSON payload).
 - request:  {"id": n, "cmd": <name>, ...args}
 - response: {"id": n, "result": ...} | {"id": n, "error": "..."}
 - push (after "connect" on that socket):
@@ -32,6 +33,7 @@ from typing import Any, Optional
 
 from ..drivers.file_driver import message_to_json
 from ..protocol.messages import DocumentMessage, MessageType, NackMessage
+from .framing import encode_frame, read_frame, write_frame
 
 
 def document_message_from_json(data: dict) -> DocumentMessage:
@@ -45,16 +47,28 @@ def document_message_from_json(data: dict) -> DocumentMessage:
     )
 
 
-def document_message_to_json(msg: DocumentMessage) -> dict:
+def _wire_contents(contents):
+    """Wire form of op contents: plain JSON types pass through
+    untouched (the hot path); anything carrying dataclasses (in-proc
+    merge-tree ops) round-trips through the wire encoder."""
+    if contents is None or isinstance(contents, (str, int, float, bool)):
+        return contents
+    if isinstance(contents, dict) and all(
+        v is None or isinstance(v, (str, int, float, bool))
+        for v in contents.values()
+    ):
+        return contents
     from ..runtime.op_lifecycle import _dumps
 
+    return json.loads(_dumps(contents))
+
+
+def document_message_to_json(msg: DocumentMessage) -> dict:
     return {
         "clientSequenceNumber": msg.client_seq,
         "referenceSequenceNumber": msg.ref_seq,
         "type": msg.type.value,
-        # Round-trip through the wire encoder so in-proc dataclasses
-        # (merge-tree ops) become their wire-dict form.
-        "contents": json.loads(_dumps(msg.contents)),
+        "contents": _wire_contents(msg.contents),
         "metadata": msg.metadata,
         "address": msg.address,
     }
@@ -69,6 +83,11 @@ class _Session(socketserver.StreamRequestHandler):
     def setup(self) -> None:
         super().setup()
         self.connection.settimeout(30)
+        # Nagle + delayed-ACK interaction stalls small request/response
+        # frames ~40ms each; this is an RPC socket, not a bulk pipe.
+        self.connection.setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+        )
         # Per-session outbound queue drained by a writer thread:
         # _send never blocks on the network, so pushes that run while
         # the dispatcher holds srv.lock cannot stall other sessions
@@ -85,8 +104,11 @@ class _Session(socketserver.StreamRequestHandler):
             if obj is None:
                 return
             try:
-                self.wfile.write((json.dumps(obj) + "\n").encode())
-                self.wfile.flush()
+                if isinstance(obj, bytes):  # pre-encoded frame
+                    self.wfile.write(obj)
+                    self.wfile.flush()
+                else:
+                    write_frame(self.wfile, obj)
             except Exception:
                 self._kill()
                 return
@@ -102,10 +124,10 @@ class _Session(socketserver.StreamRequestHandler):
         srv: "SocketDeltaServer" = self.server.owner  # type: ignore
         conn = None
         try:
-            for line in self.rfile:
-                if not line.strip():
-                    continue
-                req = json.loads(line)
+            while True:
+                req = read_frame(self.rfile)
+                if req is None:
+                    break
                 try:
                     result, conn = self._dispatch(srv, req, conn)
                     self._send({"id": req.get("id"), "result": result})
@@ -122,7 +144,25 @@ class _Session(socketserver.StreamRequestHandler):
                 with srv.lock:
                     conn.disconnect()
 
-    def _send(self, obj: dict) -> None:
+    def _send_ops_batch(self, msgs, memo) -> None:
+        """Batched broadcast push: ONE frame per broadcaster pump,
+        encoded once per room (`memo` shared across the room's
+        sessions when they accept the full batch)."""
+        if memo is not None and "frame" in memo:
+            data = memo["frame"]
+        else:
+            from .framing import KIND_OPS
+
+            data = encode_frame(
+                {"event": "ops",
+                 "msgs": [message_to_json(m) for m in msgs]},
+                kind=KIND_OPS,
+            )
+            if memo is not None:
+                memo["frame"] = data
+        self._send(data)
+
+    def _send(self, obj) -> None:
         if self._dead.is_set():
             raise ConnectionError("session transport dead")
         try:
@@ -161,6 +201,7 @@ class _Session(socketserver.StreamRequestHandler):
                 conn.listener = lambda m: self._send(
                     {"event": "op", "msg": message_to_json(m)}
                 )
+                conn.batch_listener = self._send_ops_batch
                 conn.nack_listener = lambda n: self._send(
                     {"event": "nack",
                      "msg": {"clientId": n.client_id, "clientSeq": n.client_seq,

@@ -622,22 +622,27 @@ def rebase_change(change: Change, over: Change, over_first: bool = True) -> Chan
     transforming a remote commit over the unsequenced local branch for
     forest application).
 
-    Implemented as a recursive inclusion transform over op LISTS (the
+    Implemented as an inclusion transform over op LISTS (the
     operational-transform ladder in its general form): transforming
     one op past another may split it into several sequential parts
     (multi), and the dual side advances symmetrically, so both sides
-    are op lists throughout.
+    are op lists throughout. The walk over `over` is an explicit loop
+    (each base op's successors are already expressed in its output
+    frame, so no advancement of later base ops over `change` is
+    needed at this level) — recursion depth stays bounded by the
+    CHANGE's length, not the rebase window's.
     """
     a = [copy.deepcopy(op) for op in change]
-    b = [copy.deepcopy(op) for op in over]
-    return _xform(a, b, over_first)[0]
+    for b in over:
+        a, _ = _xform(a, [copy.deepcopy(b)], over_first)
+    return a
 
 
 def _xform(A: Change, B: Change, flag: bool) -> Tuple[Change, Change]:
     """Inclusion transform of sequential op lists sharing one start
     state: returns ``(A', B')`` with A' applying after B, and B'
     after A. `flag`: B's content wins position ties (B sequenced
-    earlier)."""
+    earlier). Recursion depth is O(len(A) + len(B) + splits)."""
     if not A or not B:
         return list(A), list(B)
     if len(A) == 1 and len(B) == 1:

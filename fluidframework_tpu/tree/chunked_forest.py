@@ -259,7 +259,13 @@ class ChunkedForest:
         fields[field] = cf
         return cf
 
-    def node_at(self, path: List[list]) -> Optional[dict]:
+    def node_at(self, path: List[list],
+                for_mutation: bool = False) -> Optional[dict]:
+        """Resolve a path. Reads return a materialized COPY for leaf
+        chunks (reads must not erode uniform chunks); MUTATION paths
+        pass ``for_mutation=True`` so a targeted leaf splits out of
+        its chunk in place and edits (e.g. creating a field under a
+        leaf) land in the real tree."""
         node = self.root
         for field, index in path:
             cf = self._field_of(node, field)
@@ -269,19 +275,21 @@ class ChunkedForest:
             if ref is None:
                 return None
             if ref[0] == "leaf":
-                # Leaves have no fields, so a path can only END here.
-                # Return a materialized COPY — reads must not erode
-                # uniform chunks; all mutation paths (set_value /
-                # insert / detach / move) go through ChunkedField
-                # methods that operate on chunks directly.
                 _, chunk, off = ref
-                node = chunk.materialize(off)
+                if for_mutation:
+                    node_d = chunk.materialize(off)
+                    cf.detach(index, 1)
+                    cf.insert(index, [node_d])
+                    ref2 = cf.node_ref(index)
+                    node = ref2[1] if ref2[0] == "obj" else node_d
+                else:
+                    node = chunk.materialize(off)
             else:
                 node = ref[1]
         return node
 
     def _field(self, path: List[list], field: str) -> Optional[ChunkedField]:
-        node = self.node_at(path)
+        node = self.node_at(path, for_mutation=True)
         if node is None:
             return None
         return self._field_of(node, field, create=True)
@@ -314,7 +322,7 @@ class ChunkedForest:
                     else:
                         self.root["value"] = op["value"]
                     continue
-                parent = self.node_at(path[:-1])
+                parent = self.node_at(path[:-1], for_mutation=True)
                 if parent is None:
                     continue
                 f, i = path[-1]

@@ -93,8 +93,10 @@ def apply_move_op(op: dict, resolve) -> None:
 def canon_json(node: dict) -> dict:
     """Canonical JSON form of a node: empty field lists pruned; field
     containers may be plain lists or chunked (anything exposing
-    to_nodes())."""
-    out = {k: v for k, v in node.items() if k != "fields"}
+    to_nodes()). Values are DEEP-COPIED — snapshots must be isolated
+    from the live tree (mutating a view must never corrupt replica
+    state)."""
+    out = {k: copy.deepcopy(v) for k, v in node.items() if k != "fields"}
     fields = {}
     for f, cs in node.get("fields", {}).items():
         kids = cs.to_nodes() if hasattr(cs, "to_nodes") else cs

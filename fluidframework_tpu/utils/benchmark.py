@@ -1,0 +1,97 @@
+"""Statistical benchmark runner (the @fluid-tools/benchmark role).
+
+The reference's harness (tools/benchmark/src/Runner.ts) runs each
+benchmark many times and reports statistics, with a separate
+memory-pressure mode (MemoryTestRunner.ts). This module provides the
+same contract for the project's config benches: N timed repeats after
+warm-up, mean/stddev/min/max/percentiles, and an optional memory mode
+measuring per-run Python allocation peaks (tracemalloc) plus process
+peak-RSS growth.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(math.floor(idx))
+    hi = int(math.ceil(idx))
+    if lo == hi:
+        return sorted_vals[lo]
+    frac = idx - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def run_benchmark(
+    fn: Callable[[], Any],
+    repeats: int = 5,
+    warmups: int = 1,
+    memory: bool = False,
+) -> Dict[str, Any]:
+    """Run `fn` `warmups + repeats` times; time the repeats.
+
+    Returns statistics over the timed runs (seconds):
+    ``{"runs", "warmups", "mean", "stddev", "min", "max", "p50",
+    "p90", "warm_seconds"}`` plus, with ``memory=True``,
+    ``{"alloc_peak_mb_mean", "alloc_peak_mb_max", "rss_growth_mb"}``.
+    """
+    t0 = time.perf_counter()
+    for _ in range(warmups):
+        fn()
+    warm_seconds = time.perf_counter() - t0
+
+    times: List[float] = []
+    rss_before = _peak_rss_mb()
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    alloc_peaks: List[float] = []
+    if memory:
+        # Memory is measured in a SEPARATE traced pass so tracemalloc
+        # overhead never pollutes the timed runs (the reference keeps
+        # Runner.ts and MemoryTestRunner.ts separate for the same
+        # reason).
+        import tracemalloc
+
+        tracemalloc.start()
+        fn()
+        _, peak = tracemalloc.get_traced_memory()
+        tracemalloc.stop()
+        alloc_peaks.append(peak / 1e6)
+    mean = sum(times) / len(times)
+    var = sum((t - mean) ** 2 for t in times) / len(times)
+    srt = sorted(times)
+    out: Dict[str, Any] = {
+        "runs": repeats,
+        "warmups": warmups,
+        "mean": round(mean, 6),
+        "stddev": round(math.sqrt(var), 6),
+        "min": round(srt[0], 6),
+        "max": round(srt[-1], 6),
+        "p50": round(_percentile(srt, 0.5), 6),
+        "p90": round(_percentile(srt, 0.9), 6),
+        "warm_seconds": round(warm_seconds, 6),
+    }
+    if memory and alloc_peaks:
+        out["alloc_peak_mb_mean"] = round(
+            sum(alloc_peaks) / len(alloc_peaks), 3
+        )
+        out["alloc_peak_mb_max"] = round(max(alloc_peaks), 3)
+        out["rss_growth_mb"] = round(_peak_rss_mb() - rss_before, 3)
+    return out
+
+
+def _peak_rss_mb() -> float:
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    except Exception:
+        return 0.0

@@ -149,26 +149,60 @@ def test_rollback_farm(seed):
 
     rng = _random.Random(seed)
     registry = ChannelRegistry([MapFactory(), StringFactory()])
-    h = MultiClientHarness(3, registry, channel_types=[("m", MapFactory.type_name)])
+    h = MultiClientHarness(
+        3, registry,
+        channel_types=[("m", MapFactory.type_name),
+                       ("s", StringFactory.type_name)],
+    )
 
     def m(i):
         return h.runtimes[i].get_datastore("default").get_channel("m")
+
+    def s(i):
+        return h.runtimes[i].get_datastore("default").get_channel("s")
+
+    def random_string_op(i):
+        ch = s(i)
+        n = len(ch.get_text())
+        r = rng.random()
+        if r < 0.5 or n == 0:
+            ch.insert_text(rng.randint(0, n), rng.choice("abcdef") * 2)
+        elif r < 0.8:
+            a = rng.randrange(n)
+            ch.remove_range(a, min(n, a + rng.randint(1, 3)))
+        else:
+            a = rng.randrange(n)
+            ch.annotate_range(a, min(n, a + rng.randint(1, 3)),
+                              {"mark": rng.randint(0, 9)})
 
     for rnd in range(20):
         for i in range(3):
             if rng.random() < 0.35:
                 try:
                     def tx(i=i, rnd=rnd):
+                        # Mixed map + STRING work, all aborted: the
+                        # string ops roll back through the merge-tree
+                        # rollback path (mergeTree.ts:2057).
                         m(i).set(f"tx{rnd}", i)
+                        s(i).insert_text(0, "ROLLEDBACK")
+                        random_string_op(i)
                         m(i).delete(f"k{rng.randint(0, 5)}")
                         raise RuntimeError("abort")
                     h.runtimes[i].order_sequentially(tx)
                 except RuntimeError:
                     pass
             m(i).set(f"k{rng.randint(0, 5)}", rng.randint(0, 99))
+            random_string_op(i)
         h.process_all()
     views = [
         {k: m(i).get(k) for k in sorted(m(i).keys())} for i in range(3)
     ]
     assert views[0] == views[1] == views[2]
     assert not any(k.startswith("tx") for k in views[0])
+    texts = {s(i).get_text() for i in range(3)}
+    assert len(texts) == 1, texts
+    assert "ROLLEDBACK" not in texts.pop()
+    from fluidframework_tpu.testing.farm import char_spans
+
+    spans = [char_spans(s(i).engine.annotated_spans()) for i in range(3)]
+    assert spans[0] == spans[1] == spans[2]

@@ -1,0 +1,156 @@
+"""SharedMatrix undo/conflict machinery (the productSet/bspSet role,
+packages/dds/matrix/src/{productSet,bspSet}.ts): set-cell undo with
+prior values, axis insert/remove undo with cell payload restoration,
+all addressed by stable handles so undo survives CONCURRENT row/col
+permutation from other clients."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds import MatrixFactory
+from fluidframework_tpu.framework.undo_redo import (
+    SharedMatrixUndoRedoHandler,
+    UndoRedoStackManager,
+)
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+
+def make(n=2):
+    h = MultiClientHarness(
+        n, ChannelRegistry([MatrixFactory()]),
+        channel_types=[("mx", MatrixFactory.type_name)],
+    )
+    return h, [
+        h.runtimes[i].get_datastore("default").get_channel("mx")
+        for i in range(n)
+    ]
+
+
+def test_set_cell_undo_redo_basic():
+    h, (a, b) = make()
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    h.process_all()
+    stack = UndoRedoStackManager()
+    SharedMatrixUndoRedoHandler(stack, a)
+    a.set_cell(0, 0, "x")
+    stack.close_current_operation()
+    a.set_cell(0, 0, "y")
+    stack.close_current_operation()
+    h.process_all()
+    assert b.get_cell(0, 0) == "y"
+    stack.undo_operation()
+    h.process_all()
+    assert a.get_cell(0, 0) == "x" and b.get_cell(0, 0) == "x"
+    stack.undo_operation()
+    h.process_all()
+    assert a.get_cell(0, 0) is None and b.get_cell(0, 0) is None
+    stack.redo_operation()
+    h.process_all()
+    assert b.get_cell(0, 0) == "x"
+
+
+def test_undo_survives_concurrent_permutation():
+    """Client A sets a cell; client B concurrently inserts rows/cols
+    BEFORE it (shifting positions). A's undo still hits the right
+    cell (handle addressing)."""
+    h, (a, b) = make()
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 3)
+    h.process_all()
+    stack = UndoRedoStackManager()
+    SharedMatrixUndoRedoHandler(stack, a)
+    a.set_cell(1, 1, "target")
+    stack.close_current_operation()
+    h.process_all()
+    # Concurrent permutation: the target cell shifts to (3, 2).
+    b.insert_rows(0, 2)
+    b.insert_cols(0, 1)
+    h.process_all()
+    assert a.get_cell(3, 2) == "target"
+    stack.undo_operation()
+    h.process_all()
+    assert a.get_cell(3, 2) is None and b.get_cell(3, 2) is None
+
+
+def test_axis_insert_undo_removes_rows():
+    h, (a, b) = make()
+    a.insert_rows(0, 2)
+    a.insert_cols(0, 2)
+    h.process_all()
+    stack = UndoRedoStackManager()
+    SharedMatrixUndoRedoHandler(stack, a)
+    a.insert_rows(1, 2)
+    stack.close_current_operation()
+    a.set_cell(1, 0, "in-new-row")
+    stack.close_current_operation()
+    h.process_all()
+    assert a.row_count == 4
+    stack.undo_operation()  # undo the set
+    stack.undo_operation()  # undo the insert: rows disappear
+    h.process_all()
+    assert a.row_count == 2 and b.row_count == 2
+    assert a.to_dense() == b.to_dense()
+
+
+def test_axis_remove_undo_restores_cells():
+    h, (a, b) = make()
+    a.insert_rows(0, 3)
+    a.insert_cols(0, 2)
+    for r in range(3):
+        for c in range(2):
+            a.set_cell(r, c, f"{r}.{c}")
+    h.process_all()
+    stack = UndoRedoStackManager()
+    SharedMatrixUndoRedoHandler(stack, a)
+    a.remove_rows(1, 1)
+    stack.close_current_operation()
+    h.process_all()
+    assert a.row_count == 2
+    stack.undo_operation()
+    h.process_all()
+    assert a.row_count == 3 and b.row_count == 3
+    assert a.to_dense() == b.to_dense()
+    assert a.get_cell(1, 0) == "1.0" and b.get_cell(1, 1) == "1.1"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_matrix_undo_concurrent_farm(seed):
+    """The verdict's gate: matrix undo survives concurrent row/col
+    insert + setCell farms — random mixed edits on both clients, with
+    client A undoing a random subset of its operations, and replicas
+    always converging."""
+    rng = random.Random(seed)
+    h, (a, b) = make()
+    a.insert_rows(0, 4)
+    a.insert_cols(0, 4)
+    h.process_all()
+    stack = UndoRedoStackManager()
+    SharedMatrixUndoRedoHandler(stack, a)
+
+    def random_edit(mx, undoable):
+        r = rng.random()
+        if r < 0.55 and mx.row_count and mx.col_count:
+            mx.set_cell(rng.randrange(mx.row_count),
+                        rng.randrange(mx.col_count), rng.randint(0, 99))
+        elif r < 0.7:
+            mx.insert_rows(rng.randint(0, mx.row_count), 1)
+        elif r < 0.85:
+            mx.insert_cols(rng.randint(0, mx.col_count), 1)
+        elif mx.row_count > 1:
+            mx.remove_rows(rng.randrange(mx.row_count), 1)
+        if undoable:
+            stack.close_current_operation()
+
+    for rnd in range(12):
+        for _ in range(2):
+            random_edit(a, undoable=True)
+        for _ in range(2):
+            random_edit(b, undoable=False)
+        h.process_all()
+        while rng.random() < 0.4 and stack.undo_stack_size:
+            stack.undo_operation()
+            h.process_all()
+        assert a.to_dense() == b.to_dense(), f"seed {seed} round {rnd}"

@@ -1,5 +1,11 @@
 """Benchmark the remaining BASELINE.json configs (1, 3, 4, 5).
 
+Every config runs under the statistical runner
+(fluidframework_tpu/utils/benchmark.py — the @fluid-tools/benchmark
+Runner.ts role): warm-up + N timed repeats with mean/stddev/
+percentiles, plus a separate memory-traced pass (MemoryTestRunner.ts
+role) for the host-side configs.
+
 The headline bench (bench.py) covers config 2 (1M-op 1024-client
 replay). This tool measures the rest and writes BENCH_DETAIL.json:
 
@@ -38,27 +44,32 @@ os.environ.setdefault(
 )
 
 SCALE = float(os.environ.get("BC_SCALE", "1.0"))
+REPEATS = int(os.environ.get("BC_REPEATS", "5"))
 
 
 def config1_sharedstring_2client(n_ops: int = 10_000) -> dict:
     from fluidframework_tpu.testing.farm import FarmConfig, run_sharedstring_farm
+    from fluidframework_tpu.utils.benchmark import run_benchmark
 
     n_ops = int(n_ops * SCALE)
     rounds = max(1, n_ops // (2 * 10))
-    t0 = time.perf_counter()
-    run_sharedstring_farm(
-        FarmConfig(
-            num_clients=2, rounds=rounds, ops_per_client_per_round=10,
-            seed=1, check_annotations=False, annotate_weight=0.0,
-            insert_weight=0.6, remove_weight=0.4,
-        )
-    )
-    dt = time.perf_counter() - t0
     total = rounds * 2 * 10
+
+    def workload():
+        run_sharedstring_farm(
+            FarmConfig(
+                num_clients=2, rounds=rounds, ops_per_client_per_round=10,
+                seed=1, check_annotations=False, annotate_weight=0.0,
+                insert_weight=0.6, remove_weight=0.4,
+            )
+        )
+
+    stats = run_benchmark(workload, repeats=REPEATS, warmups=1, memory=True)
     return {
         "config": "sharedstring_2client_insert_remove",
-        "ops": total, "seconds": round(dt, 3),
-        "ops_per_sec": round(total / dt, 1),
+        "ops": total, "seconds": stats["mean"],
+        "ops_per_sec": round(total / stats["mean"], 1),
+        "stats": stats,
     }
 
 
@@ -67,37 +78,42 @@ def config3_matrix(size: int = 256, n_ops: int = 10_000) -> dict:
     from fluidframework_tpu.runtime import ChannelRegistry
     from fluidframework_tpu.testing.mocks import MultiClientHarness
 
+    from fluidframework_tpu.utils.benchmark import run_benchmark
+
     n_ops = int(n_ops * SCALE)
-    registry = ChannelRegistry([MatrixFactory()])
-    h = MultiClientHarness(
-        2, registry, channel_types=[("mx", MatrixFactory.type_name)]
-    )
-    a = h.runtimes[0].get_datastore("default").get_channel("mx")
-    a.insert_rows(0, size)
-    a.insert_cols(0, size)
-    h.process_all()
-    rng = random.Random(3)
-    t0 = time.perf_counter()
-    done = 0
-    while done < n_ops:
-        r = rng.random()
-        if r < 0.9:
-            a.set_cell(rng.randrange(size), rng.randrange(size), done)
-        elif r < 0.95:
-            a.insert_rows(rng.randrange(a.row_count + 1), 1)
-        else:
-            a.insert_cols(rng.randrange(a.col_count + 1), 1)
-        done += 1
-        if done % 512 == 0:
-            h.process_all()
-    h.process_all()
-    dt = time.perf_counter() - t0
-    b = h.runtimes[1].get_datastore("default").get_channel("mx")
-    assert a.to_dense() == b.to_dense(), "matrix replicas diverged"
+
+    def workload():
+        registry = ChannelRegistry([MatrixFactory()])
+        h = MultiClientHarness(
+            2, registry, channel_types=[("mx", MatrixFactory.type_name)]
+        )
+        a = h.runtimes[0].get_datastore("default").get_channel("mx")
+        a.insert_rows(0, size)
+        a.insert_cols(0, size)
+        h.process_all()
+        rng = random.Random(3)
+        done = 0
+        while done < n_ops:
+            r = rng.random()
+            if r < 0.9:
+                a.set_cell(rng.randrange(size), rng.randrange(size), done)
+            elif r < 0.95:
+                a.insert_rows(rng.randrange(a.row_count + 1), 1)
+            else:
+                a.insert_cols(rng.randrange(a.col_count + 1), 1)
+            done += 1
+            if done % 512 == 0:
+                h.process_all()
+        h.process_all()
+        b = h.runtimes[1].get_datastore("default").get_channel("mx")
+        assert a.to_dense() == b.to_dense(), "matrix replicas diverged"
+
+    stats = run_benchmark(workload, repeats=REPEATS, warmups=1, memory=True)
     return {
         "config": "matrix_256x256_setcell_insert_mix",
-        "ops": n_ops, "seconds": round(dt, 3),
-        "ops_per_sec": round(n_ops / dt, 1),
+        "ops": n_ops, "seconds": stats["mean"],
+        "ops_per_sec": round(n_ops / stats["mean"], 1),
+        "stats": stats,
     }
 
 
@@ -116,17 +132,24 @@ def config4_tree_rebase(n_pending: int = 100_000, window: int = 64) -> dict:
         [rng.integers(0, 2, window), rng.integers(0, 100_000, window),
          rng.integers(1, 4, window)], axis=1,
     ).astype(np.int32)
-    rebase_ops_columnar(ops, base)  # compile
-    t0 = time.perf_counter()
-    out, flagged = rebase_ops_columnar(ops, base)
-    dt = time.perf_counter() - t0
+    from fluidframework_tpu.utils.benchmark import run_benchmark
+
+    flagged_box = {}
+
+    def workload():
+        out, flagged = rebase_ops_columnar(ops, base)
+        flagged_box["n"] = int(flagged.sum())
+
+    stats = run_benchmark(workload, repeats=REPEATS, warmups=1,
+                          memory=True)
     rebases = n_pending * window
     return {
         "config": "tree_rebase_100k_ops_over_64_commit_window",
         "pending_ops": n_pending, "window": window,
-        "seconds": round(dt, 4),
-        "op_rebases_per_sec": round(rebases / dt, 1),
-        "flagged_for_scalar_path": int(flagged.sum()),
+        "seconds": stats["mean"],
+        "op_rebases_per_sec": round(rebases / stats["mean"], 1),
+        "flagged_for_scalar_path": flagged_box["n"],
+        "stats": stats,
     }
 
 
@@ -159,20 +182,24 @@ def config5_deli(n_docs: int = 10_000, n_clients: int = 64,
         kind=jnp.asarray(kind), client=jnp.asarray(client),
         client_seq=jnp.asarray(cseq), ref_seq=jnp.asarray(ref),
     )
-    state = make_state(n_docs, n_clients)
-    out = sequence_batch_jit(state, batch)
-    jax.block_until_ready(out)  # compile
-    state = make_state(n_docs, n_clients)
-    t0 = time.perf_counter()
-    new_state, res = sequence_batch_jit(state, batch)
-    jax.block_until_ready(res.seq)
-    dt = time.perf_counter() - t0
+    from fluidframework_tpu.utils.benchmark import run_benchmark
+
+    def workload():
+        state = make_state(n_docs, n_clients)
+        new_state, res = sequence_batch_jit(state, batch)
+        jax.block_until_ready(res.seq)
+        # Force completion on tunneled backends (block_until_ready
+        # can return before the device finishes there).
+        int(res.seq[0, 0])
+
+    stats = run_benchmark(workload, repeats=REPEATS, warmups=1)
     total = n_docs * ops_per_doc
     return {
         "config": "deli_batch_sequencing",
         "docs": n_docs, "clients_per_doc": n_clients,
-        "submissions": total, "seconds": round(dt, 4),
-        "submissions_per_sec": round(total / dt, 1),
+        "submissions": total, "seconds": stats["mean"],
+        "submissions_per_sec": round(total / stats["mean"], 1),
+        "stats": stats,
     }
 
 

@@ -1,9 +1,15 @@
 """Standalone ordering-service process: LocalServer behind TCP.
 
-Run: python tools/socket_server_main.py [port]
+Run: python tools/socket_server_main.py [port] [--storage-dir DIR]
 Prints "LISTENING <host> <port>" once ready, then serves until killed.
 Containers in other processes collaborate through it via
 drivers.socket_driver.SocketDriver (tests/test_socket_transport.py).
+
+With --storage-dir, the service is DURABLE: summaries/blobs persist in
+the content-addressed store, sequenced ops in topic journals, and
+lambda checkpoints on disk — kill the process, start a new one on the
+same dir, and clients boot documents from the persisted summary + op
+tail (tests/test_durable_storage.py).
 """
 
 from __future__ import annotations
@@ -18,8 +24,16 @@ from fluidframework_tpu.server.socket_service import SocketDeltaServer  # noqa: 
 
 
 def main() -> None:
-    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
-    srv = SocketDeltaServer(LocalServer(), port=port).start()
+    args = sys.argv[1:]
+    storage_dir = None
+    if "--storage-dir" in args:
+        i = args.index("--storage-dir")
+        storage_dir = args[i + 1]
+        del args[i: i + 2]
+    port = int(args[0]) if args else 0
+    srv = SocketDeltaServer(
+        LocalServer(persist_dir=storage_dir), port=port
+    ).start()
     print(f"LISTENING {srv.host} {srv.port}", flush=True)
     try:
         srv._thread.join()
