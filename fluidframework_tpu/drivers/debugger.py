@@ -1,0 +1,112 @@
+"""Debugger driver: record and step through a document's op stream.
+
+The reference's debugger (packages/drivers/debugger) wraps any driver
+and lets a developer replay a session interactively — pause inbound
+delivery, step one op at a time, resume live. This wrapper
+interposes on the connection a wrapped driver returns: ops flow into
+a paused DeltaQueue; `step()` delivers one, `play()` drains and goes
+live, and everything delivered is recorded for inspection (the same
+pause/step machinery DeltaQueue gives replay tooling)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..loader.delta_queue import DeltaQueue
+
+
+class DebuggerConnection:
+    """Wraps a live connection; inbound ops route through a pausable
+    queue under the controller's command."""
+
+    def __init__(self, inner, controller: "DebuggerController"):
+        self._inner = inner
+        self._controller = controller
+        self._queue = DeltaQueue(self._deliver)
+        self._queue.pause()
+        self._listener = None
+        inner.listener = self._on_op
+        controller._register(self)
+
+    def _on_op(self, msg) -> None:
+        self._controller.recorded.append(msg)
+        self._queue.push(msg)
+        if self._controller.live:
+            self._queue.process_one()
+
+    def _deliver(self, msg) -> None:
+        if self._listener is not None:
+            self._listener(msg)
+
+    # ---- connection surface (delegate + interpose)
+
+    @property
+    def listener(self):
+        return self._listener
+
+    @listener.setter
+    def listener(self, fn) -> None:
+        self._listener = fn
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)
+
+    # ---- stepping
+
+    def step(self) -> bool:
+        return self._queue.process_one()
+
+    def drain(self) -> int:
+        n = 0
+        while self._queue.process_one():
+            n += 1
+        return n
+
+    @property
+    def pending(self) -> int:
+        return self._queue.length
+
+
+class DebuggerController:
+    """Controls stepping across a debugged document's connections and
+    holds the recorded stream (the debugger UI's model)."""
+
+    def __init__(self, live: bool = False):
+        self.live = live
+        self.recorded: List[Any] = []
+        self._connections: List[DebuggerConnection] = []
+
+    def _register(self, conn: DebuggerConnection) -> None:
+        self._connections.append(conn)
+
+    def pause(self) -> None:
+        self.live = False
+
+    def play(self) -> None:
+        """Deliver everything buffered and go live."""
+        self.live = True
+        for c in self._connections:
+            c.drain()
+
+    def step(self) -> int:
+        return sum(1 for c in self._connections if c.step())
+
+    @property
+    def pending(self) -> int:
+        return sum(c.pending for c in self._connections)
+
+
+class DebugDriver:
+    """Driver wrapper: same factory surface, connections interposed
+    (FluidDebugger's createFromService shape)."""
+
+    def __init__(self, inner, controller: Optional[DebuggerController] = None):
+        self._inner = inner
+        self.controller = controller or DebuggerController()
+
+    def connect(self, doc_id: str, client_id: Optional[int] = None):
+        conn = self._inner.connect(doc_id, client_id)
+        return DebuggerConnection(conn, self.controller)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._inner, name)

@@ -1,0 +1,66 @@
+"""DDS interception wrappers (the @fluid-experimental/dds-interceptions
+role, packages/framework/dds-interceptions): wrap a DDS so every
+LOCAL edit is transformed — canonically, auto-attaching properties
+(attribution tags) to sequence inserts/annotates and map sets —
+without the calling code knowing.
+
+The wrappers delegate everything else to the underlying channel, so
+they drop into existing call sites (the reference's
+createSharedStringWithInterception /
+createSharedMapWithInterception factories)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+
+class SharedStringWithInterception:
+    """SharedString wrapper injecting properties into local edits
+    (sequence/sharedStringWithInterception.ts)."""
+
+    def __init__(self, shared_string,
+                 props_interceptor: Callable[[Optional[dict]], dict]):
+        self._s = shared_string
+        self._intercept = props_interceptor
+
+    def insert_text(self, pos: int, text: str,
+                    props: Optional[dict] = None) -> None:
+        self._s.insert_text(pos, text, props=self._intercept(props))
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        self._s.annotate_range(start, end, self._intercept(props))
+
+    def remove_range(self, start: int, end: int) -> None:
+        self._s.remove_range(start, end)
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._s, name)
+
+
+class SharedMapWithInterception:
+    """SharedMap wrapper transforming values on local set
+    (map/sharedMapWithInterception.ts)."""
+
+    def __init__(self, shared_map,
+                 set_interceptor: Callable[[str, Any], Any]):
+        self._m = shared_map
+        self._intercept = set_interceptor
+
+    def set(self, key: str, value: Any) -> None:
+        self._m.set(key, self._intercept(key, value))
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._m, name)
+
+
+def create_attribution_interceptor(client_id_fn: Callable[[], Any],
+                                   key: str = "author"):
+    """Props interceptor stamping the local identity on every edit —
+    the canonical interception use (attribution props)."""
+
+    def interceptor(props: Optional[dict]) -> dict:
+        out = dict(props or {})
+        out.setdefault(key, client_id_fn())
+        return out
+
+    return interceptor

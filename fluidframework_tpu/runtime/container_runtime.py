@@ -207,8 +207,16 @@ class ContainerRuntime(EventEmitter):
         # not resubmitted (double-apply). (Container.load
         # attachOpHandler + DeltaManager catch-up, SURVEY.md §3.4.)
         if hasattr(connection, "catch_up"):
-            for msg in connection.catch_up(self.current_seq):
-                self.process(msg)
+            # Catch-up drains through the DeltaScheduler: a long
+            # offline gap can mean tens of thousands of ops, and the
+            # host thread must get breathing room between time slices
+            # (deltaScheduler.ts:25 cooperative yielding).
+            from .delta_scheduler import drain_sliced
+
+            drain_sliced(
+                connection.catch_up(self.current_seq), self.process,
+                yield_hook=getattr(self, "yield_hook", None),
+            )
         # Attach the live listener only after catch-up: ops sequenced
         # in between were buffered by the connection and drain, in
         # order, on assignment.

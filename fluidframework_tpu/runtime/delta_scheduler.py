@@ -1,0 +1,121 @@
+"""DeltaScheduler + Throttler: cooperative inbound pacing.
+
+- `DeltaScheduler` (reference container-runtime/src/deltaScheduler.ts
+  :25): when a large inbound backlog drains (boot catch-up, long
+  offline gap), processing is TIME-SLICED — after `slice_ms` of
+  continuous processing the scheduler yields control (invoking
+  `yield_hook`, the requestIdleCallback/setTimeout turn break in the
+  reference) before resuming, so a host UI thread is never starved by
+  a 50k-op catch-up.
+- `Throttler` (reference container-runtime/src/throttler.ts):
+  client-side backpressure formula — delay grows with the number of
+  recent attempts inside a sliding window and decays as attempts age
+  out. Used for reconnect storms and summarizer retry pacing.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Iterable, Optional
+
+class DeltaScheduler:
+    """Drains a queue-like object (duck-typed: `length` property +
+    `process_one()`, e.g. the loader's DeltaQueue) in time slices —
+    this module lives in the RUNTIME layer like the reference's
+    deltaScheduler.ts, consuming the loader's queue through its
+    surface only.
+
+    `drain()` processes queued messages until the queue empties or the
+    slice budget is spent; it then calls `yield_hook()` (if any) and
+    continues, repeating until empty. Returns the number processed.
+    Instrumentation counters mirror the reference's telemetry
+    (deltaScheduler.ts tracks processing time across yields).
+    """
+
+    def __init__(self, queue, slice_ms: float = 20.0,
+                 yield_hook: Optional[Callable[[], None]] = None):
+        self.queue = queue
+        self.slice_ms = slice_ms
+        self.yield_hook = yield_hook
+        self.yields = 0
+        self.processed = 0
+        self.busy_ms = 0.0
+
+    def drain(self) -> int:
+        n = 0
+        while self.queue.length:
+            slice_start = time.perf_counter()
+            while self.queue.length:
+                if not self.queue.process_one():
+                    break
+                n += 1
+                elapsed = (time.perf_counter() - slice_start) * 1000
+                if elapsed >= self.slice_ms:
+                    break
+            self.busy_ms += (time.perf_counter() - slice_start) * 1000
+            if self.queue.length:
+                self.yields += 1
+                if self.yield_hook is not None:
+                    self.yield_hook()
+        self.processed += n
+        return n
+
+
+class Throttler:
+    """Sliding-window attempt throttle (throttler.ts).
+
+    Each `get_delay()` call records an attempt and returns how long
+    the caller should wait before acting: zero while attempts are
+    sparse, growing linearly with the number of attempts still inside
+    `window_ms`, capped at `max_delay_ms`.
+    """
+
+    def __init__(self, max_delay_ms: float = 60_000.0,
+                 window_ms: float = 60_000.0,
+                 delay_per_attempt_ms: float = 1_000.0,
+                 now: Callable[[], float] = time.monotonic):
+        self.max_delay_ms = max_delay_ms
+        self.window_ms = window_ms
+        self.delay_per_attempt_ms = delay_per_attempt_ms
+        self._now = now
+        self._attempts: Deque[float] = deque()
+
+    def get_delay(self) -> float:
+        """Record an attempt; return the wait (ms) before acting."""
+        t = self._now() * 1000.0
+        cutoff = t - self.window_ms
+        while self._attempts and self._attempts[0] < cutoff:
+            self._attempts.popleft()
+        self._attempts.append(t)
+        extra = len(self._attempts) - 1  # first attempt is free
+        return min(extra * self.delay_per_attempt_ms, self.max_delay_ms)
+
+    @property
+    def attempts_in_window(self) -> int:
+        return len(self._attempts)
+
+
+def drain_sliced(messages: Iterable[Any], handler: Callable[[Any], None],
+                 slice_ms: float = 20.0,
+                 yield_hook: Optional[Callable[[], None]] = None) -> int:
+    """Time-sliced processing of a pre-fetched message list (the
+    catch-up path: no queue object needed)."""
+
+    class _ListQueue:
+        def __init__(self, items):
+            self._items = deque(items)
+
+        @property
+        def length(self):
+            return len(self._items)
+
+        def process_one(self):
+            if not self._items:
+                return False
+            handler(self._items.popleft())
+            return True
+
+    return DeltaScheduler(
+        _ListQueue(messages), slice_ms=slice_ms, yield_hook=yield_hook
+    ).drain()

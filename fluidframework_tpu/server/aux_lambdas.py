@@ -1,0 +1,161 @@
+"""Auxiliary pipeline lambdas: copier, foreman, moira.
+
+The small routerlicious lambdas beyond the core four
+(server/routerlicious/packages/lambdas/src/{copier,foreman,moira}):
+
+- `CopierLambda` — archives the RAW (pre-sequencing) op stream to
+  storage verbatim (copier/lambda.ts): the forensic record of exactly
+  what clients submitted, before deli stamped or nacked anything.
+- `ForemanLambda` — distributes help tasks to agent clients
+  (foreman/lambda.ts): watches the sequenced stream for task
+  requests and assigns each to a registered agent (round-robin),
+  emitting assignment control messages.
+- `MoiraLambda` — revision pusher (moira/lambda.ts): collects
+  summary acks and "pushes" each accepted revision (doc, seq, handle)
+  to a registry sink.
+
+All three consume the shared topics the way the core lambdas do and
+checkpoint their offsets, so they slot into LocalServer's pump and
+restart contract."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+from ..protocol.messages import MessageType
+from .castore import ContentAddressedStore
+from .log import LogConsumer, MessageLog, _encode_entry
+
+
+class CopierLambda:
+    """Raw-op archiver: every rawdeltas record lands in the content
+    store under a per-doc archive ref chain."""
+
+    def __init__(self, log: MessageLog, storage: ContentAddressedStore,
+                 checkpoint: Optional[dict] = None,
+                 batch_size: int = 256):
+        self.storage = storage
+        self.batch_size = batch_size
+        offset = checkpoint["offset"] if checkpoint else 0
+        self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
+        self._pending: List[Any] = []
+        self._chunks: Dict[str, int] = (
+            dict(checkpoint["chunks"]) if checkpoint else {}
+        )
+
+    def pump(self) -> int:
+        n = 0
+        for entry in self.consumer.poll():
+            self._pending.append(entry)
+            n += 1
+            if len(self._pending) >= self.batch_size:
+                self._flush()
+        if self._pending:
+            self._flush()
+        return n
+
+    def _flush(self) -> None:
+        by_doc: Dict[str, List[Any]] = {}
+        for e in self._pending:
+            by_doc.setdefault(e.get("doc", "?"), []).append(e)
+        self._pending = []
+        for doc, entries in by_doc.items():
+            idx = self._chunks.get(doc, 0)
+            key = self.storage.put(
+                json.dumps([_encode_entry(e) for e in entries]).encode()
+            )
+            self.storage.set_ref(f"rawarchive/{doc}/{idx}", key)
+            self._chunks[doc] = idx + 1
+
+    def archived_chunks(self, doc: str) -> int:
+        return self._chunks.get(doc, 0)
+
+    def read_archive(self, doc: str) -> List[Any]:
+        from .log import _decode_entry
+
+        out: List[Any] = []
+        for i in range(self._chunks.get(doc, 0)):
+            key = self.storage.get_ref(f"rawarchive/{doc}/{i}")
+            out.extend(
+                _decode_entry(e)
+                for e in json.loads(self.storage.get(key).decode())
+            )
+        return out
+
+    def checkpoint(self) -> dict:
+        return {"offset": self.consumer.checkpoint(),
+                "chunks": dict(self._chunks)}
+
+
+class ForemanLambda:
+    """Task distributor: sequenced {"task": name} help requests are
+    assigned round-robin to registered agents (the reference assigns
+    tasks like 'intel'/'translation' to agent runtimes)."""
+
+    def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None):
+        offset = checkpoint["offset"] if checkpoint else 0
+        self.consumer = LogConsumer(log.topic("deltas"), offset)
+        self.agents: List[Any] = []  # objects with assign(doc, task)
+        self.assignments: List[dict] = []
+        self._rr = 0
+
+    def register_agent(self, agent: Any) -> None:
+        self.agents.append(agent)
+
+    def pump(self) -> int:
+        n = 0
+        for entry in self.consumer.poll():
+            n += 1
+            if entry.get("kind") != "op":
+                continue
+            msg = entry["msg"]
+            contents = getattr(msg, "contents", None)
+            if (msg.type == MessageType.OP and isinstance(contents, dict)
+                    and "helpTask" in contents and self.agents):
+                agent = self.agents[self._rr % len(self.agents)]
+                self._rr += 1
+                record = {
+                    "doc": entry["doc"], "task": contents["helpTask"],
+                    "seq": msg.sequence_number, "agent": id(agent),
+                }
+                self.assignments.append(record)
+                agent.assign(entry["doc"], contents["helpTask"])
+        return n
+
+    def checkpoint(self) -> dict:
+        return {"offset": self.consumer.checkpoint()}
+
+
+class MoiraLambda:
+    """Revision pusher: accepted summaries (summaryAck control
+    messages) become revision records delivered to a sink."""
+
+    def __init__(self, log: MessageLog,
+                 sink: Optional[Callable[[dict], None]] = None,
+                 checkpoint: Optional[dict] = None):
+        offset = checkpoint["offset"] if checkpoint else 0
+        self.consumer = LogConsumer(log.topic("deltas"), offset)
+        self.revisions: List[dict] = []
+        self.sink = sink
+
+    def pump(self) -> int:
+        n = 0
+        for entry in self.consumer.poll():
+            n += 1
+            if entry.get("kind") != "op":
+                continue
+            msg = entry["msg"]
+            if msg.type == MessageType.SUMMARY_ACK:
+                rev = {
+                    "doc": entry["doc"],
+                    "seq": msg.sequence_number,
+                    "handle": (msg.contents or {}).get("handle"),
+                }
+                self.revisions.append(rev)
+                if self.sink is not None:
+                    self.sink(rev)
+        return n
+
+    def checkpoint(self) -> dict:
+        return {"offset": self.consumer.checkpoint()}

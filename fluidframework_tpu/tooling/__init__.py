@@ -1,0 +1,9 @@
+"""Offline tooling: op-stream analysis + cross-engine replay
+validation (the fetch-tool / replay-tool roles,
+packages/tools/fetch-tool/src/fluidAnalyzeMessages.ts and
+packages/tools/replay-tool/src/replayMessages.ts)."""
+
+from .analyzer import analyze_messages
+from .replay_validator import validate_replay
+
+__all__ = ["analyze_messages", "validate_replay"]

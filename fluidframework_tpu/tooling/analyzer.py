@@ -1,0 +1,102 @@
+"""Op-stream analyzer (the fluidAnalyzeMessages role,
+packages/tools/fetch-tool/src/fluidAnalyzeMessages.ts): offline
+statistics over a sequenced message stream — message-type histogram,
+per-client activity, op sizes, session duration/rates, MSN lag, and
+channel-level op routing counts."""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Any, Dict, Iterable, List
+
+from ..protocol.messages import MessageType, SequencedMessage
+
+
+def _op_size(msg: SequencedMessage) -> int:
+    from ..runtime.op_lifecycle import wire_size
+
+    return wire_size(msg.contents)
+
+
+def _channel_of(contents: Any) -> str:
+    """Best-effort channel address of a runtime op envelope."""
+    if isinstance(contents, dict):
+        inner = contents.get("contents")
+        addr = contents.get("address")
+        if isinstance(inner, dict) and "address" in inner:
+            return f"{addr}/{inner['address']}"
+        if addr is not None:
+            return str(addr)
+    return "<raw>"
+
+
+def analyze_messages(stream: Iterable[SequencedMessage]) -> Dict[str, Any]:
+    """Aggregate statistics over a sequenced stream."""
+    type_counts: Counter = Counter()
+    client_counts: Counter = Counter()
+    channel_counts: Counter = Counter()
+    sizes: List[int] = []
+    msn_lags: List[int] = []
+    first_ts = last_ts = None
+    n = 0
+    max_seq = 0
+    for msg in stream:
+        n += 1
+        max_seq = max(max_seq, msg.sequence_number)
+        type_counts[msg.type.name] += 1
+        client_counts[msg.client_id] += 1
+        msn_lags.append(msg.sequence_number - msg.minimum_sequence_number)
+        if msg.type == MessageType.OP:
+            sizes.append(_op_size(msg))
+            channel_counts[_channel_of(msg.contents)] += 1
+        if msg.timestamp:
+            if first_ts is None:
+                first_ts = msg.timestamp
+            last_ts = msg.timestamp
+    duration = (last_ts - first_ts) if first_ts and last_ts else 0.0
+    sizes.sort()
+
+    def pct(vals: List[int], q: float) -> int:
+        if not vals:
+            return 0
+        return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+    return {
+        "messages": n,
+        "maxSeq": max_seq,
+        "types": dict(type_counts),
+        "clients": {
+            "count": len(client_counts),
+            "top": client_counts.most_common(5),
+        },
+        "channels": dict(channel_counts.most_common(10)),
+        "opSizeBytes": {
+            "count": len(sizes),
+            "total": sum(sizes),
+            "p50": pct(sizes, 0.5),
+            "p90": pct(sizes, 0.9),
+            "max": sizes[-1] if sizes else 0,
+        },
+        "msnLag": {
+            "mean": round(sum(msn_lags) / n, 1) if n else 0,
+            "max": max(msn_lags, default=0),
+        },
+        "durationSeconds": round(duration, 3),
+        "opsPerSecond": round(n / duration, 1) if duration > 0 else None,
+    }
+
+
+def main() -> None:  # pragma: no cover - CLI shim
+    import sys
+
+    from ..drivers.file_driver import message_from_json
+
+    path = sys.argv[1]
+    with open(path) as f:
+        msgs = [message_from_json(m) for m in json.load(f)]
+    print(json.dumps(analyze_messages(msgs), indent=1, default=str))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

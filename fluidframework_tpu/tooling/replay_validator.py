@@ -1,0 +1,111 @@
+"""Cross-engine replay validation (the replay-tool role,
+packages/tools/replay-tool/src/replayMessages.ts): replay one recorded
+op stream through MULTIPLE engines, capturing staged state digests,
+and assert they are bit-identical at every stage — the reference uses
+this to cross-validate snapshots between runtime versions; here it
+cross-validates the independent merge engines (scalar oracle, numpy
+overlay, scan kernel, pallas overlay interpret)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from ..protocol.messages import SequencedMessage
+from ..testing.digest import state_digest
+
+
+def _stage_points(n: int, stages: int) -> List[int]:
+    if stages <= 1 or n <= 1:
+        return [n]
+    step = max(1, n // stages)
+    pts = list(range(step, n, step)) + [n]
+    return sorted(set(pts))
+
+
+def validate_replay(
+    messages: Sequence[SequencedMessage],
+    initial: str = "",
+    engines: Optional[List[str]] = None,
+    stages: int = 4,
+) -> Dict[str, Any]:
+    """Replay `messages` through each engine with staged digests.
+
+    Engines: "oracle" (core/mergetree.py), "overlay" (numpy
+    overlay, ops/overlay_ref.py), "kernel" (scan kernel via
+    KernelReplica), "overlay-device" (pallas overlay, interpret mode).
+    Returns {"stages": [...], "digests": {engine: [...]}, "ok": bool,
+    "mismatches": [...]}; raises nothing — callers inspect "ok".
+    """
+    engines = engines or ["oracle", "overlay", "kernel"]
+    msgs = list(messages)
+    pts = _stage_points(len(msgs), stages)
+    digests: Dict[str, List[str]] = {}
+
+    for name in engines:
+        digests[name] = _replay_staged(name, msgs, initial, pts)
+
+    base = engines[0]
+    mismatches = []
+    for i, pt in enumerate(pts):
+        vals = {name: digests[name][i] for name in engines}
+        if len(set(vals.values())) != 1:
+            mismatches.append({"stage": pt, "digests": vals})
+    return {
+        "stages": pts,
+        "digests": digests,
+        "ok": not mismatches,
+        "mismatches": mismatches,
+        "baseline": base,
+    }
+
+
+def _replay_staged(engine: str, msgs, initial: str,
+                   pts: List[int]) -> List[str]:
+    out: List[str] = []
+    if engine == "oracle":
+        from ..core.mergetree import replay_passive
+
+        marks = set(pts)
+
+        def hook(i, eng):
+            if i + 1 in marks:
+                out.append(state_digest(eng.annotated_spans()))
+
+        replay_passive(msgs, initial, on_message=hook)
+        return out
+    if engine == "overlay":
+        from ..ops.overlay_ref import OverlayMessageReplica
+
+        return _staged_apply(
+            OverlayMessageReplica(initial=initial, fold_interval=64),
+            msgs, pts,
+        )
+    if engine == "kernel":
+        from ..core.kernel_replica import KernelReplica
+
+        return _staged_apply(
+            KernelReplica(initial=initial, chunk_size=64, capacity=4096),
+            msgs, pts,
+        )
+    if engine == "overlay-device":
+        from ..core.overlay_replay import OverlayKernelMessageReplica
+
+        return _staged_apply(
+            OverlayKernelMessageReplica(
+                initial=initial, chunk_size=64, window=2048,
+                interpret=True,
+            ),
+            msgs, pts,
+        )
+    raise ValueError(f"unknown engine {engine!r}")
+
+
+def _staged_apply(replica, msgs, pts: List[int]) -> List[str]:
+    out: List[str] = []
+    lo = 0
+    for pt in pts:
+        replica.apply_messages(msgs[lo:pt])
+        lo = pt
+        out.append(state_digest(replica.annotated_spans()))
+    replica.check_errors()
+    return out

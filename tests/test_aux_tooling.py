@@ -1,0 +1,273 @@
+"""Aux subsystems: DeltaScheduler/Throttler, op-stream analyzer,
+cross-engine replay validator, DDS interceptions, debugger driver,
+copier/foreman/moira lambdas, and the layer-check lint."""
+
+import os
+import sys
+
+import pytest
+
+from fluidframework_tpu.testing.farm import FarmConfig, run_sharedstring_farm
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- scheduler / throttler
+
+
+def test_delta_scheduler_slices_and_yields():
+    from fluidframework_tpu.loader.delta_queue import DeltaQueue
+    from fluidframework_tpu.runtime.delta_scheduler import DeltaScheduler
+
+    seen = []
+    q = DeltaQueue(seen.append)
+    q.pause()
+    for i in range(500):
+        q.push(i)
+    yields = []
+    sched = DeltaScheduler(q, slice_ms=0.0, yield_hook=lambda: yields.append(1))
+    n = sched.drain()
+    assert n == 500 and seen == list(range(500))
+    # slice_ms=0 forces a yield after every message but the last.
+    assert sched.yields == 499 and len(yields) == 499
+    assert sched.busy_ms >= 0
+
+
+def test_drain_sliced_catch_up_path():
+    from fluidframework_tpu.runtime.delta_scheduler import drain_sliced
+
+    out = []
+    n = drain_sliced(range(100), out.append, slice_ms=0.0)
+    assert n == 100 and out == list(range(100))
+
+
+def test_throttler_window():
+    from fluidframework_tpu.runtime.delta_scheduler import Throttler
+
+    clock = [0.0]
+    t = Throttler(max_delay_ms=5000, window_ms=10_000,
+                  delay_per_attempt_ms=1000, now=lambda: clock[0])
+    assert t.get_delay() == 0  # first attempt free
+    assert t.get_delay() == 1000
+    assert t.get_delay() == 2000
+    clock[0] += 11.0  # attempts age out of the window
+    assert t.get_delay() == 0
+    for _ in range(10):
+        d = t.get_delay()
+    assert d == 5000  # capped
+
+
+# -------------------------------------------------------------- analyzer
+
+
+def test_analyzer_reports_stream_statistics():
+    from fluidframework_tpu.tooling import analyze_messages
+
+    farm = run_sharedstring_farm(
+        FarmConfig(num_clients=3, rounds=6, ops_per_client_per_round=3,
+                   seed=4)
+    )
+    stats = analyze_messages(farm.stream)
+    assert stats["messages"] == len(farm.stream)
+    assert stats["types"]["OP"] > 0 and stats["types"]["CLIENT_JOIN"] == 3
+    assert stats["clients"]["count"] >= 3
+    assert stats["opSizeBytes"]["count"] == stats["types"]["OP"]
+    assert stats["msnLag"]["max"] >= 0
+
+
+# ------------------------------------------------------ replay validator
+
+
+def test_replay_validator_cross_engine_identity():
+    from fluidframework_tpu.tooling import validate_replay
+
+    farm = run_sharedstring_farm(
+        FarmConfig(num_clients=4, rounds=6, ops_per_client_per_round=3,
+                   seed=9)
+    )
+    report = validate_replay(
+        farm.stream, initial="hello world",
+        engines=["oracle", "overlay", "kernel"], stages=3,
+    )
+    assert report["ok"], report["mismatches"]
+    assert len(report["stages"]) >= 3
+
+
+def test_replay_validator_catches_divergence():
+    from fluidframework_tpu.tooling import validate_replay
+
+    farm = run_sharedstring_farm(
+        FarmConfig(num_clients=2, rounds=3, ops_per_client_per_round=2,
+                   seed=5)
+    )
+    # Tamper: drop one op for the second engine by giving it a
+    # different stream via a wrapper engine name — instead, corrupt
+    # the stream between stages by comparing different initials.
+    good = validate_replay(farm.stream, initial="hello world",
+                           engines=["oracle", "overlay"], stages=2)
+    assert good["ok"]
+    bad = validate_replay(
+        farm.stream[:-2] + farm.stream[-1:], initial="hello world",
+        engines=["oracle"], stages=2,
+    )
+    # Single engine can't mismatch itself; tamper check is that the
+    # digests change when the stream changes.
+    assert bad["digests"]["oracle"][-1] != good["digests"]["oracle"][-1]
+
+
+# ---------------------------------------------------------- interceptions
+
+
+def test_shared_string_interception_stamps_props():
+    from fluidframework_tpu.dds import MapFactory, StringFactory
+    from fluidframework_tpu.framework.interceptions import (
+        SharedMapWithInterception,
+        SharedStringWithInterception,
+        create_attribution_interceptor,
+    )
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+    h = MultiClientHarness(
+        2, ChannelRegistry([StringFactory(), MapFactory()]),
+        channel_types=[("s", StringFactory.type_name),
+                       ("m", MapFactory.type_name)],
+    )
+    raw = h.runtimes[0].get_datastore("default").get_channel("s")
+    s = SharedStringWithInterception(
+        raw, create_attribution_interceptor(lambda: "alice")
+    )
+    s.insert_text(0, "hi")
+    s.annotate_range(0, 1, {"bold": True})
+    h.process_all()
+    peer = h.runtimes[1].get_datastore("default").get_channel("s")
+    spans = peer.annotated_spans()
+    assert all(p and p.get("author") == "alice" for _, p in spans), spans
+    assert spans[0][1].get("bold") is True
+
+    m = SharedMapWithInterception(
+        h.runtimes[0].get_datastore("default").get_channel("m"),
+        lambda k, v: {"v": v, "by": "alice"},
+    )
+    m.set("k", 7)
+    h.process_all()
+    assert h.runtimes[1].get_datastore("default").get_channel("m").get(
+        "k") == {"v": 7, "by": "alice"}
+
+
+# -------------------------------------------------------------- debugger
+
+
+def test_debugger_driver_records_and_steps():
+    from fluidframework_tpu.dds import StringFactory
+    from fluidframework_tpu.drivers import LocalDriver
+    from fluidframework_tpu.drivers.debugger import DebugDriver
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.server import LocalServer
+
+    registry = ChannelRegistry([StringFactory()])
+    server = LocalServer()
+    loader = Loader(LocalDriver(server), registry)
+    c0 = loader.create_detached()
+    c0.runtime.create_datastore("default").create_channel(
+        "s", StringFactory.type_name
+    )
+    doc = c0.attach()
+
+    dbg = DebugDriver(LocalDriver(server))
+    loader2 = Loader(dbg, registry)
+    c1 = loader2.resolve(doc)
+    s1 = c1.runtime.get_datastore("default").get_channel("s")
+    s0 = c0.runtime.get_datastore("default").get_channel("s")
+
+    s0.insert_text(0, "abc")
+    c0.flush()
+    # Paused: the debugged container hasn't seen the ops yet.
+    assert s1.get_text() == "" and dbg.controller.pending > 0
+    stepped = dbg.controller.step()
+    assert stepped >= 1
+    dbg.controller.play()
+    assert s1.get_text() == "abc"
+    assert dbg.controller.recorded  # the stream is on record
+    # Live mode: subsequent ops deliver immediately.
+    s0.insert_text(3, "!")
+    c0.flush()
+    assert s1.get_text() == "abc!"
+
+
+# ------------------------------------------------------------ aux lambdas
+
+
+def test_copier_foreman_moira():
+    from fluidframework_tpu.core import CollabClient
+    from fluidframework_tpu.protocol.messages import (
+        DocumentMessage,
+        MessageType,
+    )
+    from fluidframework_tpu.server import LocalServer
+    from fluidframework_tpu.server.aux_lambdas import (
+        CopierLambda,
+        ForemanLambda,
+        MoiraLambda,
+    )
+
+    srv = LocalServer()
+    copier = CopierLambda(srv.log, srv.storage)
+    foreman = ForemanLambda(srv.log)
+    revisions = []
+    moira = MoiraLambda(srv.log, sink=revisions.append)
+
+    class Agent:
+        def __init__(self):
+            self.tasks = []
+
+        def assign(self, doc, task):
+            self.tasks.append((doc, task))
+
+    agent = Agent()
+    foreman.register_agent(agent)
+
+    sock = srv.connect("doc", client_id=1)
+    client = CollabClient(1, initial="")
+    sock.listener = client.apply_msg
+    srv.process_all()
+    client.engine.current_seq = srv.deli.sequencers["doc"].seq
+    sock.submit(client.insert_local(0, "hello"))
+    sock.submit_raw = getattr(sock, "submit_raw", None)
+    # A help-task request rides the op stream as plain contents.
+    srv.log.topic("rawdeltas").append(
+        {"doc": "doc", "kind": "control", "type": MessageType.OP,
+         "contents": {"helpTask": "translate"}}
+    )
+    # A summary cycle for moira.
+    handle = srv.upload_summary('{"entries": {}}')
+    srv.log.topic("rawdeltas").append(
+        {"doc": "doc", "kind": "control", "type": MessageType.SUMMARIZE,
+         "contents": {"handle": handle}}
+    )
+    srv.process_all()
+    copier.pump()
+    foreman.pump()
+    moira.pump()
+
+    assert copier.archived_chunks("doc") >= 1
+    archived = copier.read_archive("doc")
+    assert any(e.get("kind") == "join" for e in archived)
+    assert agent.tasks == [("doc", "translate")]
+    assert revisions and revisions[0]["handle"] == handle
+    # Checkpoint/resume contract.
+    cp = copier.checkpoint()
+    copier2 = CopierLambda(srv.log, srv.storage, cp)
+    assert copier2.pump() == 0  # nothing new
+
+
+# ------------------------------------------------------------ layer check
+
+
+def test_layer_check_clean():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import layer_check
+
+    violations = layer_check.check(REPO)
+    assert violations == [], "\n".join(violations)

@@ -1,0 +1,116 @@
+"""Layer-check: enforce the package layering mechanically.
+
+The reference enforces its layer DAG with a build-tools lint
+(build-tools/packages/build-tools/src/layerCheck, surfaced in
+PACKAGES.md); this is that role for fluidframework_tpu: every
+intra-package import must point to the SAME or a LOWER layer. Run
+directly or via tests/test_layer_check.py.
+
+Layering (bottom-up, mirroring SURVEY.md §1):
+
+    protocol, utils                 L0  definitions + plumbing
+    native                          L0  (C++ bindings; imports nothing)
+    core, ops, parallel             L1  engines/kernels
+    testing                         L2  harnesses (may reach anything
+                                        below, incl. server mocks)
+    runtime                         L2  container/datastore runtime
+    dds, tree                       L3  data structures
+    drivers, loader                 L4  service adapters + loader
+    framework                       L5  public API
+    server                          L4s the service (peer of loader;
+                                        shares L0-L2)
+    tooling                         L6  offline analysis (any layer)
+
+Exceptions (mirroring the reference's own):
+- drivers.local_driver/socket_driver import `server` — the reference's
+  local-driver likewise depends on local-server (SURVEY.md §2.3).
+- server.socket_service imports drivers.file_driver's wire codec (a
+  shared L0-shape concern living next to its primary consumer).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+PKG = "fluidframework_tpu"
+
+LAYERS: Dict[str, int] = {
+    "protocol": 0, "utils": 0, "native": 0,
+    "core": 1, "ops": 1, "parallel": 1,
+    "runtime": 2, "testing": 2,
+    "dds": 3, "tree": 3,
+    "drivers": 4, "loader": 4, "server": 4,
+    "framework": 5,
+    "tooling": 6,
+}
+
+# (from_subpackage, to_subpackage) pairs allowed despite layer order.
+EXCEPTIONS: Set[Tuple[str, str]] = {
+    ("drivers", "server"),   # local/socket drivers meet the service
+    ("server", "drivers"),   # wire codec shared with file_driver
+    ("testing", "server"),   # harnesses wire mock services
+    ("testing", "dds"),
+    ("core", "testing"),     # replicas consume synthetic streams
+    ("core", "ops"),
+}
+
+
+def _subpackage(module: str) -> str:
+    parts = module.split(".")
+    if len(parts) < 2 or parts[0] != PKG:
+        return ""
+    return parts[1]
+
+
+def check(root: str) -> List[str]:
+    pkg_root = os.path.join(root, PKG)
+    violations: List[str] = []
+    for dirpath, _, files in os.walk(pkg_root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root).replace(os.sep, ".")[:-3]
+            sub = _subpackage(rel)
+            if sub not in LAYERS:
+                continue
+            tree = ast.parse(open(path).read(), filename=path)
+            for node in ast.walk(tree):
+                targets: List[str] = []
+                if isinstance(node, ast.Import):
+                    targets = [a.name for a in node.names]
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:  # relative: resolve against rel
+                        base = rel.split(".")[: -node.level]
+                        mod = ".".join(base + ([node.module] if node.module else []))
+                        targets = [mod]
+                    elif node.module:
+                        targets = [node.module]
+                for t in targets:
+                    tsub = _subpackage(t)
+                    if not tsub or tsub == sub or tsub not in LAYERS:
+                        continue
+                    if (sub, tsub) in EXCEPTIONS:
+                        continue
+                    if LAYERS[tsub] > LAYERS[sub]:
+                        violations.append(
+                            f"{rel}: layer {LAYERS[sub]} ({sub}) imports "
+                            f"layer {LAYERS[tsub]} ({tsub}) via {t}"
+                        )
+    return violations
+
+
+def main() -> None:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    v = check(root)
+    for line in v:
+        print(line)
+    print(f"{len(v)} layering violations")
+    sys.exit(1 if v else 0)
+
+
+if __name__ == "__main__":
+    main()
