@@ -267,6 +267,17 @@ class NativeMergeEngine:
         # max(min_seq, msn)); the C++ zamboni is idempotent regardless.
         self._lib.hm_update_min_seq(self._ptr, min_seq)
 
+    def pack_settled(self) -> None:
+        """Merge adjacent fully-settled same-props segments (the
+        zamboni.ts:19 packParent role; run length capped in C++).
+        PASSIVE replicas only: pending local groups may hold pointers
+        into merged-away tails."""
+        if len(self.pending):
+            raise RuntimeError(
+                "pack_settled on an engine with pending local ops"
+            )
+        self._lib.hm_pack_settled(self._ptr)
+
     def verify_invariants(self) -> None:
         """Exhaustive structural verification in the C++ engine (the
         MergeTreeEngine.verify_invariants role; violation codes are

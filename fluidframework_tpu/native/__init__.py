@@ -195,6 +195,7 @@ def load_hostmerge() -> Optional[ctypes.CDLL]:
         lib.hm_free.argtypes = [p]
         lib.hm_set_identity.argtypes = [p, i32, i32]
         lib.hm_load.argtypes = [p, ip, i64]
+        lib.hm_pack_settled.argtypes = [p]
         for name in ("hm_current_seq", "hm_min_seq", "hm_local_client",
                      "hm_collaborating", "hm_pending_last_id"):
             getattr(lib, name).restype = i32

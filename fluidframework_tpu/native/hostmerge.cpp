@@ -375,6 +375,37 @@ struct Engine {
     segments.swap(kept);
   }
 
+  // Merge adjacent fully-settled same-props segments (the
+  // zamboni.ts:19 packParent role). Settled segments (acked at or
+  // below min_seq, not removed) are indistinguishable to every valid
+  // future perspective (any refSeq >= MSN sees them), so merging
+  // preserves all visibility/position semantics. Runs are capped so a
+  // later insert that lands inside settled content splits an O(cap)
+  // segment, not an O(document) one (the reference likewise packs
+  // under a segment-size budget). Opt-in for PASSIVE replicas only:
+  // pending local groups may hold pointers into merged-away tails, so
+  // interactive engines must not call this.
+  static constexpr size_t PACK_RUN_CAP = 4096;
+  void pack_settled() {
+    std::vector<Seg*> kept;
+    kept.reserve(segments.size());
+    Seg* run = nullptr;
+    for (Seg* s : segments) {
+      bool settled = s->seq != UNASSIGNED_SEQ && s->seq <= min_seq &&
+                     s->removed_seq == REMOVED_NONE &&
+                     s->pending_props.empty();
+      if (settled && run != nullptr && run->props == s->props &&
+          run->content.size() + s->content.size() <= PACK_RUN_CAP) {
+        run->content.insert(run->content.end(), s->content.begin(),
+                            s->content.end());
+        continue;
+      }
+      kept.push_back(s);
+      run = settled ? s : nullptr;
+    }
+    segments.swap(kept);
+  }
+
   // ---- queries
   int64_t visible_length(int32_t ref_seq, int32_t client) const {
     int64_t total = 0, len;
@@ -585,6 +616,8 @@ int32_t hm_annotate(void* h, int64_t start, int64_t end, const int32_t* pkeys,
 }
 
 int32_t hm_ack(void* h, int32_t seq) { return E(h)->ack(seq); }
+
+void hm_pack_settled(void* h) { E(h)->pack_settled(); }
 
 void hm_update_min_seq(void* h, int32_t min_seq) {
   E(h)->update_min_seq(min_seq);

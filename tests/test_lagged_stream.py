@@ -1,0 +1,168 @@
+"""Gates for the lagged-refSeq synthetic stream (the honest headline
+workload): real per-client perspective lag, cross-engine convergence,
+and the passive-replica settled-segment packing that keeps the
+generator's view oracle O(window).
+
+Reference analog: mergeTreeOperationRunner.ts interleaves clients that
+have not seen each other's ops; every engine must resolve those ops at
+their lagging perspectives and still converge.
+"""
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.core.mergetree import replay_passive
+from fluidframework_tpu.testing.digest import state_digest
+from fluidframework_tpu.testing.synthetic import (
+    generate_lagged_stream,
+    generate_stream,
+)
+
+N_OPS = 2000
+N_CLIENTS = 64
+WINDOW = 256
+SEED = 3
+
+
+@pytest.fixture(scope="module")
+def lagged_stream():
+    return generate_lagged_stream(
+        N_OPS, n_clients=N_CLIENTS, seed=SEED, window=WINDOW,
+        initial_len=32,
+    )
+
+
+@pytest.fixture(scope="module")
+def oracle_digest(lagged_stream):
+    eng = replay_passive(
+        lagged_stream.as_messages(),
+        initial="".join(map(chr, lagged_stream.text[:32])),
+    )
+    return state_digest(eng.annotated_spans())
+
+
+def test_stream_has_real_lag(lagged_stream):
+    s = lagged_stream
+    lag = s.seq - 1 - s.ref_seq
+    assert np.all(lag >= 0)
+    assert np.all(s.ref_seq >= s.min_seq)
+    lagged_frac = np.mean(lag > 0)
+    assert lagged_frac > 0.4, f"only {lagged_frac:.0%} ops lag"
+    assert np.max(lag) >= WINDOW // 2
+    # Per-client refSeq is non-decreasing (a client cannot unsee ops).
+    for c in range(1, N_CLIENTS + 1):
+        refs = s.ref_seq[s.client == c]
+        assert np.all(np.diff(refs) >= 0)
+
+
+def test_lag_exercises_concurrency(lagged_stream):
+    """Ops must routinely resolve against state containing concurrent
+    (unseen) inserts — the partialLengths.ts:256 workload."""
+    s = lagged_stream
+    ins_seqs = s.seq[s.op_type == 0]
+    concurrent = 0
+    for i in np.nonzero(s.seq - 1 - s.ref_seq > 0)[0][:500]:
+        lo, hi = s.ref_seq[i], s.seq[i]
+        if np.any((ins_seqs > lo) & (ins_seqs < hi)):
+            concurrent += 1
+    assert concurrent > 300
+
+
+def test_overlay_numpy_matches_oracle(lagged_stream, oracle_digest):
+    from fluidframework_tpu.ops.overlay_ref import OverlayMessageReplica
+
+    rep = OverlayMessageReplica(
+        initial="".join(map(chr, lagged_stream.text[:32])),
+        fold_interval=64, n_removers=16,
+    )
+    rep.apply_messages(list(lagged_stream.as_messages()))
+    assert rep.doc.error == 0
+    assert state_digest(rep.annotated_spans()) == oracle_digest
+
+
+def test_overlay_pallas_matches_oracle(lagged_stream, oracle_digest):
+    from fluidframework_tpu.core.overlay_replay import (
+        OverlayKernelMessageReplica,
+    )
+
+    rep = OverlayKernelMessageReplica(
+        initial="".join(map(chr, lagged_stream.text[:32])),
+        chunk_size=64, window=1024, n_removers=16, interpret=True,
+    )
+    rep.apply_messages(list(lagged_stream.as_messages()))
+    rep.check_errors()
+    assert state_digest(rep.annotated_spans()) == oracle_digest
+
+
+def test_native_engine_matches_oracle(lagged_stream, oracle_digest):
+    from fluidframework_tpu.core.native_engine import NativeMergeEngine
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    eng = NativeMergeEngine(local_client_id=-3)
+    eng.load("".join(map(chr, lagged_stream.text[:32])))
+    for msg in lagged_stream.as_messages():
+        op = msg.contents
+        kind = type(op).__name__
+        if kind == "InsertOp":
+            eng.insert(op.pos, op.text, msg.ref_seq, msg.client_id,
+                       msg.sequence_number)
+        elif kind == "RemoveOp":
+            eng.remove_range(op.start, op.end, msg.ref_seq,
+                             msg.client_id, msg.sequence_number)
+        else:
+            eng.annotate_range(op.start, op.end, op.props, msg.ref_seq,
+                               msg.client_id, msg.sequence_number)
+        eng.current_seq = msg.sequence_number
+        eng.update_min_seq(max(eng.min_seq, msg.minimum_sequence_number))
+    assert state_digest(eng.annotated_spans()) == oracle_digest
+
+
+def test_pack_settled_preserves_state(lagged_stream, oracle_digest):
+    """hm_pack_settled (the generator's O(window) guarantee) must not
+    change visible document state."""
+    from fluidframework_tpu.core.native_engine import NativeMergeEngine
+
+    eng = NativeMergeEngine(local_client_id=-3)
+    eng.load("".join(map(chr, lagged_stream.text[:32])))
+    for i, msg in enumerate(lagged_stream.as_messages()):
+        op = msg.contents
+        kind = type(op).__name__
+        if kind == "InsertOp":
+            eng.insert(op.pos, op.text, msg.ref_seq, msg.client_id,
+                       msg.sequence_number)
+        elif kind == "RemoveOp":
+            eng.remove_range(op.start, op.end, msg.ref_seq,
+                             msg.client_id, msg.sequence_number)
+        else:
+            eng.annotate_range(op.start, op.end, op.props, msg.ref_seq,
+                               msg.client_id, msg.sequence_number)
+        eng.current_seq = msg.sequence_number
+        eng.update_min_seq(max(eng.min_seq, msg.minimum_sequence_number))
+        if i % 97 == 0:
+            eng.pack_settled()
+            eng.verify_invariants()
+    eng.pack_settled()
+    assert state_digest(eng.annotated_spans()) == oracle_digest
+
+
+def test_cache_roundtrip(tmp_path):
+    a = generate_lagged_stream(
+        300, n_clients=16, seed=11, window=64, initial_len=16,
+        cache_dir=str(tmp_path),
+    )
+    b = generate_lagged_stream(
+        300, n_clients=16, seed=11, window=64, initial_len=16,
+        cache_dir=str(tmp_path),
+    )
+    for f in a.__dataclass_fields__:
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+
+
+def test_lagged_defaults_match_headline_params():
+    """The headline bench shape (1024 clients) generates cleanly."""
+    s = generate_lagged_stream(3000, seed=7, initial_len=64)
+    t = generate_stream(3000, seed=7, initial_len=64)
+    # Same op-mix machinery: types drawn from the same weights.
+    assert abs(
+        np.mean(s.op_type == 0) - np.mean(t.op_type == 0)
+    ) < 0.05
