@@ -1,11 +1,14 @@
 """Headline benchmark: merge-tree sequenced-op replay throughput.
 
-Replays a synthetic mixed SharedString op stream (insert/remove/
-annotate from 1024 round-robin clients — BASELINE.md config 2 shape)
+Replays the LAGGED synthetic SharedString op stream (insert/remove/
+annotate from 1024 round-robin clients whose refSeqs trail the head by
+up to the collaboration window — real concurrent-perspective
+resolution on every lagged op, the honest BASELINE.md config-2 shape)
 through the OVERLAY pallas TPU engine (ops/overlay_pallas.py via
-core/overlay_replay.py: per-op work scales with the collab window,
-settled content folds out to an HBM log), and through the scalar
-Python oracle as the baseline, then prints ONE JSON line:
+core/overlay_replay.py: fused per-op kernel, per-op work scales with
+the collab window, settled content folds out to an HBM log), and
+through the scalar Python oracle as the baseline, then prints ONE
+JSON line:
 
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 
@@ -13,18 +16,21 @@ Python oracle as the baseline, then prints ONE JSON line:
 same workload. A correctness gate first replays a prefix through both
 paths and asserts identical final text, and the FULL-stream final
 state is gated against GOLDEN.json (the bit-identity contract,
-BASELINE.json north_star).
+BASELINE.json north_star; recorded by tools/lagged_golden.py from the
+native C++ engine with all staged digests, oracle-grounded prefix).
 
 The jax persistent compilation cache does not engage on this
 backend (platform "axon" is outside jax's supported-cache list), so
 every process pays the Mosaic compile. The bench uses ONE fixed
 window/chunk geometry: the warm-up compiles everything the timed run
-needs, and the timed region never compiles or grows.
+needs, and the timed region never compiles, grows, or waits on
+uploads (the op stream is drained to the device before t0).
 
 Env knobs: BENCH_OPS (default 1_000_000), BENCH_GATE_OPS (20_000),
-BENCH_ORACLE_OPS (20_000), BENCH_CLIENTS (1024), BENCH_CHUNK (1024),
-BENCH_WINDOW (4096 overlay) / BENCH_CAPACITY (131072 row-model),
-BENCH_SYNC (4), BENCH_ENGINE (auto | overlay | pallas | scan).
+BENCH_ORACLE_OPS (20_000), BENCH_CLIENTS (1024), BENCH_CHUNK (256),
+BENCH_WINDOW (2048 overlay) / BENCH_CAPACITY (131072 row-model),
+BENCH_REMOVERS (24), BENCH_LAGGED (1), BENCH_SYNC (4),
+BENCH_ENGINE (auto | overlay | pallas | scan).
 """
 
 from __future__ import annotations
@@ -44,9 +50,12 @@ def main() -> None:
     n_gate = min(int(os.environ.get("BENCH_GATE_OPS", 20_000)), n_ops)
     n_oracle = min(int(os.environ.get("BENCH_ORACLE_OPS", 20_000)), n_ops)
     n_clients = int(os.environ.get("BENCH_CLIENTS", 1024))
-    chunk = int(os.environ.get("BENCH_CHUNK", 1024))
+    chunk = int(os.environ.get("BENCH_CHUNK", 256))
     capacity = int(os.environ.get("BENCH_CAPACITY", 131072))
-    window = int(os.environ.get("BENCH_WINDOW", 4096))
+    window = int(os.environ.get("BENCH_WINDOW", 2048))
+    n_removers = int(os.environ.get("BENCH_REMOVERS", 24))
+    lagged = os.environ.get("BENCH_LAGGED", "1") != "0"
+    collab_window = 1024
     sync = int(os.environ.get("BENCH_SYNC", 4))
     engine = os.environ.get("BENCH_ENGINE", "auto")
     initial_len = 64
@@ -56,7 +65,10 @@ def main() -> None:
     from fluidframework_tpu.core.columnar_replay import ColumnarReplica
     from fluidframework_tpu.core.mergetree import replay_passive
     from fluidframework_tpu.core.overlay_replay import OverlayDeviceReplica
-    from fluidframework_tpu.testing.synthetic import generate_stream
+    from fluidframework_tpu.testing.synthetic import (
+        generate_lagged_stream,
+        generate_stream,
+    )
 
     if engine == "auto":
         engine = (
@@ -65,15 +77,30 @@ def main() -> None:
             else "scan"
         )
 
+    def gen(n):
+        if lagged:
+            return generate_lagged_stream(
+                n, n_clients=n_clients, seed=7, window=collab_window,
+                initial_len=initial_len,
+                cache_dir=os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    ".stream_cache",
+                ),
+            )
+        return generate_stream(
+            n, n_clients=n_clients, seed=7, initial_len=initial_len
+        )
+
     def make_replica(stream):
         if engine == "overlay":
             return OverlayDeviceReplica(
                 stream, initial_len=initial_len, chunk_size=chunk,
-                window=window,
+                window=window, n_removers=n_removers,
             )
         return ColumnarReplica(
             stream, initial_len=initial_len, chunk_size=chunk,
             capacity=capacity, sync_interval=sync, engine=engine,
+            n_removers=n_removers,
         )
 
     # Row-model engines keep every live row in the kernel table; fail
@@ -92,15 +119,15 @@ def main() -> None:
         )
         sys.exit(1)
 
-    print(f"generating {n_ops} ops from {n_clients} clients...", file=sys.stderr)
-    stream = generate_stream(
-        n_ops, n_clients=n_clients, seed=7, initial_len=initial_len
+    print(
+        f"generating {n_ops} {'lagged ' if lagged else ''}ops from "
+        f"{n_clients} clients...",
+        file=sys.stderr,
     )
+    stream = gen(n_ops)
 
     # ---- correctness gate: kernel vs scalar oracle on a prefix --------
-    gate_stream = generate_stream(
-        n_gate, n_clients=n_clients, seed=7, initial_len=initial_len
-    )
+    gate_stream = gen(n_gate)
     gate = make_replica(gate_stream)
     if engine == "overlay":
         # Incremental per-chunk path (the fused executable is shape-
@@ -157,7 +184,13 @@ def main() -> None:
     for _ in range(max(repeats, 1)):
         replica = make_replica(stream)
         if engine == "overlay":
+            # Drain the stream upload before the timed region: an
+            # in-flight async transfer queues the replay dispatch
+            # behind it and pollutes the measurement (the round-3
+            # run-to-run variance).
             replica.prepare()
+            jax.block_until_ready(replica._dev)
+            jax.block_until_ready(replica.log)
         t0 = time.perf_counter()
         replica.replay()
         # A value FETCH (not block_until_ready) closes the timing
@@ -210,6 +243,8 @@ def main() -> None:
             "n_ops": n_ops, "n_clients": n_clients, "seed": 7,
             "initial_len": initial_len,
         }
+        if lagged:
+            params.update({"lagged": True, "window": collab_window})
         if golden.get("params") == params:
             from fluidframework_tpu.testing.digest import state_digest
 

@@ -267,6 +267,27 @@ class NativeMergeEngine:
         # max(min_seq, msn)); the C++ zamboni is idempotent regardless.
         self._lib.hm_update_min_seq(self._ptr, min_seq)
 
+    def apply_sequenced(self, msg) -> None:
+        """Apply one remote `SequencedMessage` (passive-replica path:
+        route by op type, advance current_seq and the MSN window —
+        the replay_passive loop's per-message body)."""
+        op = msg.contents
+        if isinstance(op, InsertOp):
+            self.insert(op.pos, op.text, msg.ref_seq, msg.client_id,
+                        msg.sequence_number)
+        elif isinstance(op, RemoveOp):
+            self.remove_range(op.start, op.end, msg.ref_seq,
+                              msg.client_id, msg.sequence_number)
+        elif isinstance(op, AnnotateOp):
+            self.annotate_range(op.start, op.end, op.props, msg.ref_seq,
+                                msg.client_id, msg.sequence_number)
+        else:
+            raise TypeError(f"unsupported sequenced op {type(op)!r}")
+        self.current_seq = msg.sequence_number
+        self.update_min_seq(
+            max(self.min_seq, msg.minimum_sequence_number)
+        )
+
     def pack_settled(self) -> None:
         """Merge adjacent fully-settled same-props segments (the
         zamboni.ts:19 packParent role; run length capped in C++).

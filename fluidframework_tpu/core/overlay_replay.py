@@ -45,6 +45,7 @@ from ..ops.mergetree_kernel import (
 )
 from ..ops.overlay_pallas import (
     REC_DROP_SPAN,
+    REC_NONE,
     REC_SETTLE_SPAN,
     REC_SETTLE_TEXT,
     OverlayTable,
@@ -109,6 +110,8 @@ def reconstruct_settled(
                     merge_span_props(settled_p[a: a + ln], props)
                 )
                 cursor = a + ln
+            elif code == REC_NONE:
+                pass  # dropped text row: reconstructs to nothing
             else:
                 raise ValueError(f"bad fold-log code {code}")
         pieces_t.append(settled_t[cursor:])
@@ -292,6 +295,36 @@ class OverlayDeviceReplica:
 
     def verify_invariants(self) -> None:
         self._materialize().verify_invariants()
+
+
+def stack_replicas(reps: List["OverlayDeviceReplica"]):
+    """Stack prepared replicas into the leading-docs-axis input layout
+    of `parallel.mesh.sharded_overlay_replay`:
+    ``(tables, ops, logs, counts, msn_by_chunk)``."""
+    stack = lambda *xs: jnp.stack(xs)
+    return (
+        jax.tree_util.tree_map(stack, *[r.table for r in reps]),
+        jax.tree_util.tree_map(stack, *[r._dev for r in reps]),
+        jnp.stack([r.log for r in reps]),
+        jnp.stack([r.counts for r in reps]),
+        jnp.stack([r._msn_by_chunk for r in reps]),
+    )
+
+
+def restore_shard(
+    rep: "OverlayDeviceReplica", out_tables, out_logs, out_counts,
+    cursors, d: int,
+) -> "OverlayDeviceReplica":
+    """Load document `d`'s sharded-replay outputs into `rep` so its
+    host-side readout (get_text / annotated_spans / check_errors)
+    reflects the mesh run."""
+    rep.table = jax.tree_util.tree_map(lambda a: a[d], out_tables)
+    rep.log = out_logs[d]
+    rep.counts = out_counts[d]
+    rep.cursor = cursors[d]
+    rep.chunks_done = rep.n_chunks
+    rep._doc = None
+    return rep
 
 
 class OverlayKernelMessageReplica:

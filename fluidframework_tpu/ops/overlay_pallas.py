@@ -65,15 +65,16 @@ from .mergetree_kernel import (
 )
 from .mergetree_pallas import (
     LANES,
-    _allreduce_sum,
-    _cumsum_excl,
     _flat_idx,
     _roll1_flat,
+    _row_idx,
 )
 from .overlay_ref import SETTLED_BASE
-from .zamboni import _pack_sort
+from .zamboni import _pack_partition
 
 # Fold-record type codes (column 1 of a log record).
+REC_NONE = 0  # dropped text row: nothing to reconstruct (kept in the
+#               block so one stable partition serves table + records)
 REC_SETTLE_TEXT = 1  # unsettled insert becomes settled text at anchor
 REC_DROP_SPAN = 2  # settled coords [anchor, anchor+len) excised
 REC_SETTLE_SPAN = 3  # props merge into settled [anchor, anchor+len)
@@ -125,9 +126,29 @@ def _overlay_chunk_kernel(
     # table columns out (VMEM) + scalars out (SMEM)
     t_anchor, t_buf, t_len, t_iseq, t_iclient, t_rseq, t_rcl, t_props,
     nrows_out_ref, err_out_ref,
-    # scratch (VMEM)
-    t_live, t_err,
+    # scratch: stacked table + gap staging (VMEM), scalars (SMEM)
+    T, G, nlive_ref, err_ref,
 ):
+    """FUSED per-op form (round 4). Semantics identical to the round-3
+    kernel / overlay_ref.OverlayDoc.apply (differential farm gates);
+    the execution shape is redesigned for the serial-latency bound the
+    round-3 profile exposed (per-op cost was ~window-independent —
+    dominated by the NUMBER of dependent small vector ops, not data):
+
+    - ONE perspective pass per op (visibility + prefix scan), with
+      ``pre``/``vis``/``skip`` kept as scratch COLUMNS of the stacked
+      table so split fixups and the covered phase never recompute the
+      scan (the incremental-partial-lengths role, partialLengths.ts:256).
+    - All landing/split indices move to the SCALAR domain via full
+      reductions (jnp.min over one-hot masks) instead of mask cumsums
+      + vector broadcasts.
+    - The whole table is ONE stacked (C, W8, 128) tensor; a segment
+      split + row insert is one or two dest-based masked rolls of the
+      full stack (insertingWalk's memmove, mergeTree.ts:1740) — a few
+      big instructions instead of ~20 per-column roll sequences.
+    - Rows live in a packed prefix tracked by an SMEM ``n_live``
+      scalar (no live column; capacity checks are scalar compares).
+    """
     KR = t_rcl_in.shape[0]
     KK = t_props_in.shape[0]
     B = pos1_ref.shape[0]
@@ -135,92 +156,121 @@ def _overlay_chunk_kernel(
     shape = t_len_in.shape
     window = shape[0] * LANES
     flat = _flat_idx(shape)
-    last = flat == (window - 1)
     S = s_ref[0]
+    W = jnp.int32(window)
+    IMIN = jnp.int32(-2147483647)
 
-    t_anchor[...] = t_anchor_in[...]
-    t_buf[...] = t_buf_in[...]
-    t_len[...] = t_len_in[...]
-    t_iseq[...] = t_iseq_in[...]
-    t_iclient[...] = t_iclient_in[...]
-    t_rseq[...] = t_rseq_in[...]
-    t_rcl[...] = t_rcl_in[...]
-    t_props[...] = t_props_in[...]
-    t_live[...] = jnp.where(flat < nrows_in_ref[0], 1, 0)
-    t_err[...] = jnp.where(flat == 0, err_in_ref[0], 0)
+    # Stacked column layout.
+    A_, B_, L_, IS_, IC_, RS_ = 0, 1, 2, 3, 4, 5
+    RC0 = 6
+    PP0 = RC0 + KR
+    PRE_ = PP0 + KK
+    VIS_ = PRE_ + 1
 
-    def visibility(ref_seq, client):
-        """(skip, vis_len) at a perspective — overlay_ref._visibility
-        (mergeTree.ts:916 nodeLength) plus the dead-row mask."""
-        live = t_live[...] > 0
-        rseq = t_rseq[...]
+    T[A_] = t_anchor_in[...]
+    T[B_] = t_buf_in[...]
+    T[L_] = t_len_in[...]
+    T[IS_] = t_iseq_in[...]
+    T[IC_] = t_iclient_in[...]
+    T[RS_] = t_rseq_in[...]
+    for k in range(KR):
+        T[RC0 + k] = t_rcl_in[k]
+    for k in range(KK):
+        T[PP0 + k] = t_props_in[k]
+    T[PRE_] = jnp.zeros(shape, jnp.int32)
+    T[VIS_] = jnp.zeros(shape, jnp.int32)
+    nlive_ref[0] = nrows_in_ref[0]
+    err_ref[0] = err_in_ref[0]
+
+    lane1 = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    # Upper-triangular ones: the lane-inclusive prefix sum becomes ONE
+    # MXU matmul (v @ U) instead of a log2(128)-step roll chain. Exact:
+    # every partial sum is an integer below 2^24 (document length bound
+    # 2^23), representable in f32; HIGHEST precision avoids the bf16
+    # fast path. Hoisted out of the op loop.
+    U_tri = (
+        jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 0)
+        <= jax.lax.broadcasted_iota(jnp.int32, (LANES, LANES), 1)
+    ).astype(jnp.float32)
+    row_i = _row_idx(shape)
+
+    def cumsum_and_total(v):
+        """(exclusive flat prefix sum, grand total) of int32 tiles."""
+        inc = jax.lax.dot(
+            v.astype(jnp.float32), U_tri,
+            precision=jax.lax.Precision.HIGHEST,
+        ).astype(jnp.int32)
+        totals = jnp.broadcast_to(inc[:, LANES - 1:], shape)
+        s = 1
+        rt = totals
+        while s < shape[0]:
+            rt = rt + jnp.where(row_i >= s, pltpu.roll(rt, s, 0), 0)
+            s *= 2
+        row_excl = jnp.where(row_i > 0, pltpu.roll(rt, 1, 0), 0)
+        return (inc - v) + row_excl, rt[shape[0] - 1, 0]
+
+    def at(ci, j):
+        """Scalar value of stacked column `ci` at flat row `j`: one
+        dynamic-sublane (1, LANES) load + a lane-only reduce — far
+        cheaper than a full-window masked reduce. `j` is clamped;
+        out-of-range results are selected away by callers."""
+        jc = jnp.minimum(j, W - 1)
+        row = T[ci, pl.ds(jc // LANES, 1), :]
+        return jnp.max(jnp.where(lane1 == jc % LANES, row, IMIN))
+
+    def at_g(gref, ci, j):
+        row = gref[ci, pl.ds(j // LANES, 1), :]
+        return jnp.max(jnp.where(lane1 == j % LANES, row, IMIN))
+
+    def first_idx(mask):
+        """Index of the first set row, or W when none."""
+        return jnp.min(jnp.where(mask, flat, W))
+
+    def roll_from(thr):
+        """Dest-based masked roll of the WHOLE stack: row j takes row
+        j-1 for j >= thr (insertingWalk's memmove as ~4 wide ops).
+        Row max(thr,1)-1 keeps its value; the opened slot holds a
+        stale copy the caller overwrites. thr >= W: full no-op mask
+        (callers pl.when-guard to skip the work entirely)."""
+        v = T[...]
+        w = pltpu.roll(v, 1, 2)
+        carry = pltpu.roll(w, 1, 1)
+        lane = jax.lax.broadcasted_iota(jnp.int32, v.shape, 2)
+        rolled = jnp.where(lane == 0, carry, w)
+        T[...] = jnp.where(flat[None] >= thr, rolled, v)
+
+    def vis_pass(r, c):
+        """The ONE perspective pass (overlay_ref._visibility + _pre;
+        mergeTree.ts:916 nodeLength, partialLengths.ts:256): writes
+        pre/vis scratch columns, returns (skip, dsum). Note vis > 0
+        implies ~skip, so downstream phases that only touch visible
+        rows never need skip."""
+        nl = nlive_ref[0]
+        live = flat < nl
+        rseq = T[RS_]
         removed = rseq != NOT_REMOVED
-        tomb = removed & (rseq <= ref_seq)
-        ins_vis = (t_iclient[...] == client) | (t_iseq[...] <= ref_seq)
-        among = t_rcl[0] == client
-        for k in range(1, KR):
-            among = among | (t_rcl[k] == client)
+        tomb = removed & (rseq <= r)
+        ins_vis = (T[IC_] == c) | (T[IS_] <= r)
+        among = jnp.any(T[RC0:PP0] == c, axis=0)
         skip = (~live) | tomb | (removed & ~ins_vis)
         visible = (~skip) & ins_vis & ~(removed & among)
-        vis_len = jnp.where(visible, t_len[...], 0)
-        return skip, vis_len
+        vis = jnp.where(visible, T[L_], 0)
+        is_span = T[B_] >= SETTLED_BASE
+        consume = jnp.where(live & is_span, T[L_], 0)
+        delta = vis - consume
+        excl, dsum = cumsum_and_total(delta)
+        T[PRE_] = T[A_] + excl
+        T[VIS_] = vis
+        return skip, dsum
 
-    def consume():
-        """Settled coords a row occupies (span rows only; dead masked)."""
-        live = t_live[...] > 0
-        is_span = t_buf[...] >= SETTLED_BASE
-        return jnp.where(live & is_span, t_len[...], 0)
+    def clear_new_row(ohn):
+        """Remover/prop columns of a freshly opened slot."""
+        oh3 = ohn[None]
+        T[RC0:PP0] = jnp.where(oh3, NO_CLIENT, T[RC0:PP0])
+        T[PP0:PRE_] = jnp.where(oh3, PROP_ABSENT, T[PP0:PRE_])
 
-    def pre_delta(vis_len):
-        """Visible prefix before each row + the delta grand total (as a
-        broadcast tile): overlay_ref._pre — one prefix sum over the
-        WINDOW plays the partialLengths.ts:256 role for the whole
-        settled document."""
-        delta = vis_len - consume()
-        pre = t_anchor[...] + _cumsum_excl(delta)
-        dsum = _allreduce_sum(delta)
-        return pre, dsum
-
-    def shift_cols(keep):
-        """Suffix shift opening one row at the first ~keep (vectorized
-        memmove); flags ERR_CAPACITY if a live last row falls off."""
-        t_err[...] = t_err[...] | jnp.where(
-            last & (t_live[...] > 0) & ~keep, ERR_CAPACITY, 0
-        )
-        for ref in (t_anchor, t_buf, t_len, t_iseq, t_iclient, t_rseq,
-                    t_live):
-            v = ref[...]
-            ref[...] = jnp.where(keep, v, _roll1_flat(v))
-        for k in range(KR):
-            v = t_rcl[k]
-            t_rcl[k] = jnp.where(keep, v, _roll1_flat(v))
-        for k in range(KK):
-            v = t_props[k]
-            t_props[k] = jnp.where(keep, v, _roll1_flat(v))
-
-    def split_at(pos, orefseq, oclient):
-        """Boundary split (overlay_ref._split / ensureIntervalBoundary,
-        mergeTree.ts:1706): span tails advance their anchor with the
-        offset; text tails keep theirs (both halves at one point)."""
-        skip, vis = visibility(orefseq, oclient)
-        delta = vis - consume()
-        prefix = t_anchor[...] + _cumsum_excl(delta)
-        inside = (
-            (~skip) & (prefix < pos) & (prefix + vis > pos)
-        ).astype(jnp.int32)
-        after = _cumsum_excl(inside)
-        keep = after == 0
-        shift_cols(keep)
-        at = (~keep) & (_roll1_flat(keep.astype(jnp.int32)) > 0)
-        at = at & (flat > 0)
-        off = pos - _roll1_flat(prefix)
-        is_span_tail = t_buf[...] >= SETTLED_BASE
-        t_anchor[...] = jnp.where(
-            at & is_span_tail, t_anchor[...] + off, t_anchor[...]
-        )
-        t_buf[...] = jnp.where(at, t_buf[...] + off, t_buf[...])
-        t_len[...] = jnp.where(at, t_len[...] - off, t_len[...])
-        t_len[...] = jnp.where(inside > 0, pos - prefix, t_len[...])
+    def set1(ci, oh, val):
+        T[ci] = jnp.where(oh, val, T[ci])
 
     def body(i, _):
         otype = op_type_ref[i]
@@ -237,159 +287,295 @@ def _overlay_chunk_kernel(
         is_ann = otype == OP_ANNOTATE
         is_range = is_rem | is_ann
 
-        @pl.when(is_ins | is_range)
-        def _():
-            split_at(pos1, orefseq, oclient)
-
         @pl.when(is_ins)
         def _():
             # Landing (overlay_ref._apply_insert / insertingWalk +
-            # breakTie, mergeTree.ts:1740,:1719). pre > pos1 means
-            # visible SETTLED text intervenes — land before that row
-            # regardless of tie-breaks (the overlay-specific clause);
-            # at pre == pos1 the row-model walk applies.
-            skip, vis = visibility(orefseq, oclient)
-            pre, dsum = pre_delta(vis)
-            live_pre = t_live[...] > 0
+            # breakTie, mergeTree.ts:1740,:1719) fused with the
+            # boundary split: both indices resolve in pre-split
+            # coordinates from the single perspective pass. An inside
+            # row (pre < pos < pre+vis) always precedes every landing
+            # row (pre >= pos; pre is non-decreasing), so ONE reduce
+            # finds whichever applies, and the row's scalars serve
+            # both cases.
+            skip, dsum = vis_pass(orefseq, oclient)
+            nl = nlive_ref[0]
+            live = flat < nl
+            pre = T[PRE_]
+            vis = T[VIS_]
             total = S + dsum
-            land_real = live_pre & (
+            inside = (pre < pos1) & (pre + vis > pos1)
+            land = live & (
                 (pre > pos1)
                 | ((pre == pos1) & (~skip)
-                   & ((vis > 0) | (oseq > t_iseq[...])))
+                   & ((vis > 0) | (oseq > T[IS_])))
             )
-            land_all = land_real | ~live_pre
-            landi = land_all.astype(jnp.int32)
-            open_excl = _cumsum_excl(landi)
-            ft = land_all & (open_excl == 0)  # one-hot landing row
-            # New-row anchor, evaluated pre-shift at the landing index.
-            A = jnp.where(
-                land_real,
-                t_anchor[...] - (pre - pos1),
-                jnp.minimum(pos1 - dsum, S),
+            j0 = first_idx(inside | land)
+            preX = at(PRE_, j0)
+            visX = at(VIS_, j0)
+            ancX = at(A_, j0)
+            bufX = at(B_, j0)
+            has_split = (j0 < W) & (preX < pos1) & (preX + visX > pos1)
+            land_dead = j0 >= nl
+            j_l = jnp.minimum(j0, nl)
+            span_s = bufX >= SETTLED_BASE
+            off = pos1 - preX
+            A_nosplit = jnp.where(
+                land_dead, jnp.minimum(pos1 - dsum, S),
+                ancX - (preX - pos1),
             )
-            keep = (open_excl + landi) == 0
-            shift_cols(keep)
-            t_err[...] = t_err[...] | jnp.where(
-                ft & ~live_pre & (total < pos1), ERR_BAD_POS, 0
+            Aval = jnp.where(
+                has_split, ancX + jnp.where(span_s, off, 0), A_nosplit
             )
-            t_anchor[...] = jnp.where(ft, A, t_anchor[...])
-            t_buf[...] = jnp.where(ft, obuf, t_buf[...])
-            t_len[...] = jnp.where(ft, oilen, t_len[...])
-            t_iseq[...] = jnp.where(ft, oseq, t_iseq[...])
-            t_iclient[...] = jnp.where(ft, oclient, t_iclient[...])
-            t_rseq[...] = jnp.where(ft, NOT_REMOVED, t_rseq[...])
-            t_live[...] = jnp.where(ft, 1, t_live[...])
-            for k in range(KR):
-                t_rcl[k] = jnp.where(ft, NO_CLIENT, t_rcl[k])
-            for k in range(KK):
-                newv = jnp.int32(PROP_ABSENT)
-                for p in range(PK):
-                    pkey = pkey_ref[p * B + i]
-                    pval = pval_ref[p * B + i]
-                    v = jnp.where(pval == PROP_DELETE, PROP_ABSENT, pval)
-                    newv = jnp.where(pkey == k, v, newv)
-                t_props[k] = jnp.where(ft, newv, t_props[k])
+            t1 = jnp.where(has_split, j0 + 1, j_l)
+            n_new = jnp.where(has_split, 2, 1)
+            err_ref[0] = err_ref[0] | jnp.where(
+                (~has_split) & land_dead & (total < pos1),
+                ERR_BAD_POS, 0,
+            ) | jnp.where(nl + n_new > W, ERR_CAPACITY, 0)
+            roll_from(t1)
+
+            @pl.when(has_split)
+            def _():
+                roll_from(t1)
+                oh_h = flat == (t1 - 1)  # head (row j_s)
+                set1(L_, oh_h, off)
+                set1(VIS_, oh_h, off)
+                oh_t = flat == (t1 + 1)  # tail (raw copy of j_s)
+                set1(B_, oh_t, T[B_] + off)
+                set1(L_, oh_t, T[L_] - off)
+
+                @pl.when(span_s)
+                def _():
+                    set1(A_, oh_t, T[A_] + off)
+
+                set1(PRE_, oh_t, pos1)
+                set1(VIS_, oh_t, T[VIS_] - off)
+
+            ohn = flat == t1
+            set1(A_, ohn, Aval)
+            set1(B_, ohn, obuf)
+            set1(L_, ohn, oilen)
+            set1(IS_, ohn, oseq)
+            set1(IC_, ohn, oclient)
+            set1(RS_, ohn, NOT_REMOVED)
+            clear_new_row(ohn)
+            for p in range(PK):
+                pkey = pkey_ref[p * B + i]
+                pval = pval_ref[p * B + i]
+
+                @pl.when(pkey != NO_KEY)
+                def _(pkey=pkey, pval=pval):
+                    v = jnp.where(
+                        pval == PROP_DELETE, PROP_ABSENT, pval
+                    )
+                    for k in range(KK):
+                        @pl.when(pkey == k)
+                        def _(k=k, v=v):
+                            set1(PP0 + k, ohn, v)
+            set1(PRE_, ohn, pos1)
+            set1(VIS_, ohn, oilen)
+            nlive_ref[0] = nl + n_new
 
         @pl.when(is_range)
         def _():
-            split_at(pos2, orefseq, oclient)
-            skip, vis = visibility(orefseq, oclient)
-            pre, dsum = pre_delta(vis)
+            # Both boundary splits resolve in pre-split coordinates
+            # from one perspective pass, then compose as two
+            # dest-based rolls (ensureIntervalBoundary,
+            # mergeTree.ts:1706).
+            skip, dsum = vis_pass(orefseq, oclient)
+            nl = nlive_ref[0]
+            live = flat < nl
+            pre = T[PRE_]
+            vis = T[VIS_]
             total = S + dsum
-            t_err[...] = t_err[...] | jnp.where(
+            err_ref[0] = err_ref[0] | jnp.where(
                 total < pos2, ERR_BAD_POS, 0
             )
+            inside1 = (pre < pos1) & (pre + vis > pos1)
+            inside2 = (pre < pos2) & (pre + vis > pos2)
+            j1 = first_idx(inside1)
+            j2 = first_idx(inside2)
+            has1 = j1 < W
+            has2 = j2 < W
+            pre1 = at(PRE_, j1)
+            anc1 = at(A_, j1)
+            buf1 = at(B_, j1)
+            pre2 = at(PRE_, j2)
+            anc2 = at(A_, j2)
+            buf2 = at(B_, j2)
+            off1 = pos1 - pre1
+            off2 = pos2 - pre2
+            span1 = buf1 >= SETTLED_BASE
+            span2 = buf2 >= SETTLED_BASE
+            # Settled coordinates of the range ends, resolved from the
+            # PRE-split state: a split's tail has pre == pos exactly,
+            # so c = tail anchor; otherwise the first live row with
+            # pre >= pos (unchanged by the splits) anchors the
+            # coordinate, falling back past the live rows.
+            jc1 = first_idx(live & (pre >= pos1))
+            jc2 = first_idx(live & (pre >= pos2))
+            c1_nos = jnp.where(
+                jc1 < W, at(A_, jc1) - (at(PRE_, jc1) - pos1),
+                pos1 - dsum,
+            )
+            c2_nos = jnp.where(
+                jc2 < W, at(A_, jc2) - (at(PRE_, jc2) - pos2),
+                pos2 - dsum,
+            )
+            c1 = jnp.where(
+                has1, anc1 + jnp.where(span1, off1, 0), c1_nos
+            )
+            c2 = jnp.where(
+                has2, anc2 + jnp.where(span2, off2, 0), c2_nos
+            )
+            r1 = jnp.where(
+                has1, j1 + 1, jnp.where(has2, j2 + 1, W)
+            )
+            err_ref[0] = err_ref[0] | jnp.where(
+                nl + has1.astype(jnp.int32) + has2.astype(jnp.int32)
+                > W,
+                ERR_CAPACITY, 0,
+            )
 
-            def coord_of(pos):
-                """Settled coordinate of visible position `pos`
-                (overlay_ref._coord_of; rows containing `pos` were
-                split). Broadcast tile, vector-domain only."""
-                live = t_live[...] > 0
-                cand = live & (pre >= pos)
-                oh = cand & (_cumsum_excl(cand.astype(jnp.int32)) == 0)
-                val = _allreduce_sum(
-                    jnp.where(oh, t_anchor[...] - (pre - pos), 0)
-                )
-                has = _allreduce_sum(oh.astype(jnp.int32)) > 0
-                return jnp.where(has, val, pos - dsum)
+            @pl.when(has1 | has2)
+            def _():
+                roll_from(r1)
 
-            c1 = coord_of(pos1)
-            c2 = coord_of(pos2)
+            @pl.when(has1 & has2)
+            def _():
+                roll_from(j2 + 2)
 
+            @pl.when(has1)
+            def _():
+                oh_h = flat == j1
+                set1(L_, oh_h, off1)
+                set1(VIS_, oh_h, off1)
+                oh_t = flat == (j1 + 1)
+                set1(B_, oh_t, T[B_] + off1)
+                set1(L_, oh_t, T[L_] - off1)
+
+                @pl.when(span1)
+                def _():
+                    set1(A_, oh_t, T[A_] + off1)
+
+                set1(PRE_, oh_t, pos1)
+                set1(VIS_, oh_t, T[VIS_] - off1)
+
+            @pl.when(has2)
+            def _():
+                d2 = j2 + has1.astype(jnp.int32)
+                base = jnp.where(has1 & (j1 == j2), off1, 0)
+                oh_d = flat == d2
+                set1(L_, oh_d, off2 - base)
+                set1(VIS_, oh_d, off2 - base)
+                # tail2 is ALWAYS a raw copy of the ORIGINAL row j2
+                # (untouched by split1 fixups), so adjust by off2
+                # against the original even when j1 == j2.
+                oh_t = flat == (d2 + 1)
+                set1(B_, oh_t, T[B_] + off2)
+                set1(L_, oh_t, T[L_] - off2)
+
+                @pl.when(span2)
+                def _():
+                    set1(A_, oh_t, T[A_] + off2)
+
+                set1(PRE_, oh_t, pos2)
+                set1(VIS_, oh_t, T[VIS_] - off2)
+
+            nlive_ref[0] = (
+                nl + has1.astype(jnp.int32) + has2.astype(jnp.int32)
+            )
+
+            # ---- gap materialization (overlay_ref "gap
+            # materialization"): lazily create span rows for settled
+            # coords the range covers. Per-gap bounds stage through
+            # the G scratch so the loop's scalars are cheap row loads.
             def gaps():
-                """Mask of storage gaps (gap k sits before row k) whose
-                settled coords intersect [c1, c2) — the rows to
-                materialize (overlay_ref "gap materialization")."""
-                live = t_live[...] > 0
-                end = t_anchor[...] + consume()
+                nl = nlive_ref[0]
+                live = flat < nl
+                is_span = T[B_] >= SETTLED_BASE
+                consume = jnp.where(live & is_span, T[L_], 0)
+                end = T[A_] + consume
                 glo = jnp.where(flat == 0, 0, _roll1_flat(end))
-                ghi = jnp.where(live, t_anchor[...], S)
-                prev_live = (flat == 0) | (_roll1_flat(t_live[...]) > 0)
+                ghi = jnp.where(live, T[A_], S)
+                prev_live = (flat == 0) | (
+                    _roll1_flat(live.astype(jnp.int32)) > 0
+                )
                 gapvalid = live | prev_live
                 lo = jnp.maximum(glo, c1)
                 hi = jnp.minimum(ghi, c2)
-                return (gapvalid & (lo < hi), lo, hi)
+                G[0] = lo
+                G[1] = hi
+                G[2] = ghi
+                return gapvalid & (lo < hi)
 
-            mat0, _, _ = gaps()
-            # The one per-op vector->scalar crossing: how many span
-            # rows this range op must materialize (usually 0-2; each
-            # materialization removes exactly one gap, so the count is
-            # stable across iterations).
-            n_mat = jnp.sum(mat0.astype(jnp.int32))
+            n_mat = jnp.sum(gaps().astype(jnp.int32))
 
             def gap_body(_, carry):
-                mat, lo, hi = gaps()
-                mi = mat.astype(jnp.int32)
-                oh = mat & (_cumsum_excl(mi) == 0)
-                ohi = oh.astype(jnp.int32)
-                keep = (_cumsum_excl(ohi) + ohi) == 0
-                shift_cols(keep)
-                t_anchor[...] = jnp.where(oh, lo, t_anchor[...])
-                t_buf[...] = jnp.where(oh, SETTLED_BASE + lo, t_buf[...])
-                t_len[...] = jnp.where(oh, hi - lo, t_len[...])
-                t_iseq[...] = jnp.where(oh, 0, t_iseq[...])
-                t_iclient[...] = jnp.where(oh, NO_CLIENT, t_iclient[...])
-                t_rseq[...] = jnp.where(oh, NOT_REMOVED, t_rseq[...])
-                t_live[...] = jnp.where(oh, 1, t_live[...])
-                for k in range(KR):
-                    t_rcl[k] = jnp.where(oh, NO_CLIENT, t_rcl[k])
-                for k in range(KK):
-                    t_props[k] = jnp.where(oh, PROP_ABSENT, t_props[k])
+                mat = gaps()
+                nl = nlive_ref[0]
+                j = first_idx(mat)
+                loJ = at_g(G, 0, j)
+                hiJ = at_g(G, 1, j)
+                ghiJ = at_g(G, 2, j)
+                # Visible prefix of the new span row: the displaced
+                # row's prefix minus the settled run [loJ, ghiJ) that
+                # still sits between them (gap after the live rows:
+                # against the grand total).
+                preJ = at(PRE_, j)
+                pre_new = jnp.where(
+                    j < nl, preJ, S + dsum
+                ) - (ghiJ - loJ)
+                err_ref[0] = err_ref[0] | jnp.where(
+                    nl + 1 > W, ERR_CAPACITY, 0
+                )
+                roll_from(j)
+                oh = flat == j
+                set1(A_, oh, loJ)
+                set1(B_, oh, SETTLED_BASE + loJ)
+                set1(L_, oh, hiJ - loJ)
+                set1(IS_, oh, 0)
+                set1(IC_, oh, NO_CLIENT)
+                set1(RS_, oh, NOT_REMOVED)
+                clear_new_row(oh)
+                set1(PRE_, oh, pre_new)
+                set1(VIS_, oh, hiJ - loJ)
+                nlive_ref[0] = nl + 1
                 return carry
 
             lax.fori_loop(0, n_mat, gap_body, 0)
 
-            # Covered-range updates (markRangeRemoved mergeTree.ts:1960
-            # / annotateRange :1895), visibility recomputed after the
-            # splits and materializations.
-            skip, vis = visibility(orefseq, oclient)
-            delta = vis - consume()
-            prefix = t_anchor[...] + _cumsum_excl(delta)
+            # ---- covered-range updates (markRangeRemoved
+            # mergeTree.ts:1960 / annotateRange :1895) straight off
+            # the maintained columns — no rescan (vis > 0 already
+            # implies the row is live, unskipped and visible).
+            pre = T[PRE_]
+            vis = T[VIS_]
             covered = (
-                (~skip) & (vis > 0) & (prefix >= pos1)
-                & (prefix + vis <= pos2)
+                (vis > 0) & (pre >= pos1) & (pre + vis <= pos2)
+                & (flat < nlive_ref[0])
             )
 
             @pl.when(is_rem)
             def _():
-                already = t_rseq[...] != NOT_REMOVED
-                t_rseq[...] = jnp.where(
-                    covered & ~already, oseq, t_rseq[...]
+                rcl = T[RC0:PP0]
+                already = T[RS_] != NOT_REMOVED
+                set1(RS_, covered & ~already, oseq)
+                iota_k = jax.lax.broadcasted_iota(
+                    jnp.int32, rcl.shape, 0
                 )
-                first_free = jnp.full(shape, KR, jnp.int32)
-                for k in range(KR - 1, -1, -1):
-                    first_free = jnp.where(
-                        t_rcl[k] == NO_CLIENT, k, first_free
-                    )
+                first_free = jnp.min(
+                    jnp.where(rcl == NO_CLIENT, iota_k, KR), axis=0
+                )
                 no_free = first_free == KR
                 slot = jnp.where(already, first_free, 0)
                 write = covered & ~(already & no_free)
-                for k in range(KR):
-                    t_rcl[k] = jnp.where(
-                        write & (slot == k), oclient, t_rcl[k]
-                    )
-                t_err[...] = t_err[...] | jnp.where(
-                    covered & already & no_free, ERR_REMOVERS, 0
+                T[RC0:PP0] = jnp.where(
+                    write[None] & (iota_k == slot[None]), oclient, rcl
+                )
+                err_ref[0] = err_ref[0] | jnp.where(
+                    jnp.any(covered & already & no_free),
+                    ERR_REMOVERS, 0,
                 )
 
             @pl.when(is_ann)
@@ -397,37 +583,36 @@ def _overlay_chunk_kernel(
                 # Last writer wins; a delete tombstones on span rows
                 # (it must fold as a delete of the settled prop) but
                 # clears on text rows (they are authoritative).
-                is_span = t_buf[...] >= SETTLED_BASE
+                is_span = T[B_] >= SETTLED_BASE
                 for p in range(PK):
                     pkey = pkey_ref[p * B + i]
                     pval = pval_ref[p * B + i]
-                    valid = pkey != NO_KEY
                     newv = jnp.where(
                         pval == PROP_DELETE,
                         jnp.where(is_span, PROP_DELETE, PROP_ABSENT),
                         jnp.broadcast_to(pval, shape),
                     )
                     for k in range(KK):
-                        t_props[k] = jnp.where(
-                            covered & valid & (pkey == k), newv,
-                            t_props[k],
-                        )
+                        @pl.when(pkey == k)
+                        def _(k=k, newv=newv):
+                            set1(PP0 + k, covered, newv)
 
         return 0
 
     lax.fori_loop(0, nops_ref[0], body, 0)
 
-    nrows_out_ref[0] = jnp.sum(t_live[...])
-    err = t_err[...]
-    s = 1
-    while s < LANES:
-        err = err | pltpu.roll(err, s, 1)
-        s *= 2
-    s = 1
-    while s < err.shape[0]:
-        err = err | pltpu.roll(err, s, 0)
-        s *= 2
-    err_out_ref[0] = jnp.max(err)
+    t_anchor[...] = T[A_]
+    t_buf[...] = T[B_]
+    t_len[...] = T[L_]
+    t_iseq[...] = T[IS_]
+    t_iclient[...] = T[IC_]
+    t_rseq[...] = T[RS_]
+    for k in range(KR):
+        t_rcl[k] = T[RC0 + k]
+    for k in range(KK):
+        t_props[k] = T[PP0 + k]
+    nrows_out_ref[0] = nlive_ref[0]
+    err_out_ref[0] = err_ref[0]
 
 
 def _to_tiles(v):
@@ -478,14 +663,17 @@ def overlay_apply_chunk(table: OverlayTable, ops: OpBatch,
         jax.ShapeDtypeStruct((1,), jnp.int32),  # n_rows
         jax.ShapeDtypeStruct((1,), jnp.int32),  # error
     )
+    C = 8 + KR + KK  # 6 scalar cols + rcl + props + pre/vis
     outs = pl.pallas_call(
         _overlay_chunk_kernel,
         out_shape=out_shapes,
         in_specs=[smem()] * 14 + [vmem()] * 8,
         out_specs=tuple([vmem()] * 8 + [smem(), smem()]),
         scratch_shapes=[
-            pltpu.VMEM((W8, LANES), jnp.int32),  # live column
-            pltpu.VMEM((W8, LANES), jnp.int32),  # error accumulator
+            pltpu.VMEM((C, W8, LANES), jnp.int32),  # stacked table
+            pltpu.VMEM((3, W8, LANES), jnp.int32),  # gap lo/hi/ghi
+            pltpu.SMEM((1,), jnp.int32),  # n_live
+            pltpu.SMEM((1,), jnp.int32),  # error flags
         ],
         interpret=interpret,
     )(
@@ -516,12 +704,15 @@ def fold_device(table: OverlayTable, msn: jnp.ndarray):
     """Settle-merge under applied MSN `msn` (overlay_ref.fold; the
     zamboni role, zamboni.ts:19) as one XLA dispatch.
 
-    Returns ``(table', records, n_rec)``: surviving rows re-anchored
-    and packed to the front (stable payload sort — no gathers, see
-    module docstring), plus the folded rows as a dense ``(W, 4+KK)``
-    record block in storage (== coordinate) order: columns
-    ``[anchor, code, buf, len, props...]`` with pre-fold anchors, for
-    the host-side settled-state reconstruction.
+    Returns ``(table', records, n_rec)``: ONE stable binary partition
+    (log-shift compaction, `_pack_partition` — no sort network, no
+    gathers) packs surviving rows to the front (re-anchored) and the
+    folding rows to the back; because the partition is stable, the
+    back IS the fold-record block in storage (== coordinate) order.
+    Records are ``(W, 4+KK)`` columns ``[anchor, code, buf, len,
+    props...]`` with pre-fold anchors; ``code == REC_NONE`` rows
+    (dropped text) reconstruct to nothing but stay in the block so
+    one partition serves both outputs.
     """
     W = table.length.shape[0]
     KR = table.rem_clients.shape[1]
@@ -544,15 +735,22 @@ def fold_device(table: OverlayTable, msn: jnp.ndarray):
 
     keep = live & ~folding
     n_new = jnp.sum(keep.astype(jnp.int32))
+    n_rec = jnp.sum(folding.astype(jnp.int32))
     new_buf = jnp.where(is_span, SETTLED_BASE + new_anchor,
                         table.buf_start)
+    code = jnp.where(
+        settle_text, REC_SETTLE_TEXT,
+        jnp.where(drop & is_span, REC_DROP_SPAN,
+                  jnp.where(settle_span, REC_SETTLE_SPAN, 0)),
+    ).astype(jnp.int32)
     cols = (
         new_anchor, new_buf, table.length, table.ins_seq,
         table.ins_client, table.rem_seq,
         *(table.rem_clients[:, k] for k in range(KR)),
         *(table.props[:, k] for k in range(KK)),
+        table.anchor, code,
     )
-    packed = _pack_sort(jnp.where(keep, 0, 1).astype(jnp.int32), cols)
+    packed = _pack_partition(~keep, cols)
     valid = idx < n_new
 
     def fill(a, f):
@@ -570,27 +768,21 @@ def fold_device(table: OverlayTable, msn: jnp.ndarray):
             valid[:, None], jnp.stack(packed[6:6 + KR], axis=1), NO_CLIENT
         ),
         props=jnp.where(
-            valid[:, None], jnp.stack(packed[6 + KR:], axis=1), PROP_ABSENT
+            valid[:, None], jnp.stack(packed[6 + KR:6 + KR + KK], axis=1),
+            PROP_ABSENT,
         ),
         settled_len=new_s.astype(jnp.int32),
         error=table.error,
     )
 
-    code = jnp.where(
-        settle_text, REC_SETTLE_TEXT,
-        jnp.where(drop & is_span, REC_DROP_SPAN,
-                  jnp.where(settle_span, REC_SETTLE_SPAN, 0)),
-    ).astype(jnp.int32)
-    recmask = code > 0  # dropped text rows reconstruct to nothing
-    n_rec = jnp.sum(recmask.astype(jnp.int32))
-    rcols = (
-        table.anchor, code, table.buf_start, table.length,
-        *(table.props[:, k] for k in range(KK)),
-    )
-    rpacked = _pack_sort(
-        jnp.where(recmask, 0, 1).astype(jnp.int32), rcols
-    )
-    records = jnp.stack(rpacked, axis=1)  # (W, 4+KK)
+    # The back of the partition holds the folding rows in storage
+    # order (stable), directly followed by dead rows; rotate them to
+    # the front of the record block for the log append.
+    old_anchor_p = packed[6 + KR + KK]
+    code_p = packed[6 + KR + KK + 1]
+    rec_cols = (old_anchor_p, code_p, packed[1], packed[2],
+                *packed[6 + KR:6 + KR + KK])
+    records = jnp.roll(jnp.stack(rec_cols, axis=1), -n_new, axis=0)
     return out, records, n_rec
 
 

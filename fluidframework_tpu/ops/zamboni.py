@@ -126,6 +126,61 @@ def _pack_sort(key, cols):
     return out[1:]
 
 
+def _pack_partition(drop, cols):
+    """Stable binary partition: rows with ``drop == False`` pack to the
+    front, dropped rows to the back, both preserving order — the
+    result of ``_pack_sort(drop ? 1 : 0, cols)`` without the sort
+    network. A 0/1 key needs only a monotone variable shift: keepers
+    move DOWN by (# dropped before them), dropped rows move UP by
+    (# keepers after them); both shifts are 1-Lipschitz in the row
+    index, so applying them bit-by-bit (log2 W masked rolls per
+    direction) never collides. A ridden original-index column guards
+    each pull (a slot qualifies as a source only if its element's
+    already-applied low shift bits land it exactly there), so stale
+    copies left behind by earlier moves can never be re-pulled.
+
+    ~2x log2(W) fused select/roll passes over the stacked columns
+    replaces lax.sort's ~log^2(W) compare-exchange stages — the fold
+    runs per chunk, so this is on the replay's critical path.
+    """
+    W = drop.shape[0]
+    idx = jnp.arange(W, dtype=jnp.int32)
+    di = drop.astype(jnp.int32)
+    keep = 1 - di
+    s_down = jnp.cumsum(di) - di
+    ka_up = jnp.sum(keep) - jnp.cumsum(keep)
+    n_keep = jnp.sum(keep)
+    base = jnp.stack(cols, 0)
+
+    def compact(stack, flag, shift, down):
+        st = jnp.concatenate(
+            [stack, flag[None], shift[None], idx[None]], 0
+        )
+        b = 1
+        while b < W:
+            if down:
+                src = jnp.roll(st, -b, axis=1)
+                src_pos = idx + b
+                valid = src_pos < W
+                at_pos = src[-1] - (src[-2] % b) == src_pos
+            else:
+                src = jnp.roll(st, b, axis=1)
+                src_pos = idx - b
+                valid = src_pos >= 0
+                at_pos = src[-1] + (src[-2] % b) == src_pos
+            pull = (
+                valid & (src[-3] > 0) & ((src[-2] & b) > 0) & at_pos
+            )
+            st = jnp.where(pull[None], src, st)
+            b <<= 1
+        return st[: stack.shape[0]]
+
+    front = compact(base, keep, s_down, down=True)
+    back = compact(base, di, ka_up, down=False)
+    out = jnp.where((idx < n_keep)[None], front, back)
+    return tuple(out)
+
+
 @jax.jit
 def compact_gather_text(
     table: SegmentTable,

@@ -13,6 +13,7 @@ from .mesh import (
     docs_sharding,
     make_docs_mesh,
     replicate_sharding,
+    sharded_overlay_replay,
     sharded_pipeline_step,
     shard_tables,
 )
@@ -22,5 +23,6 @@ __all__ = [
     "docs_sharding",
     "replicate_sharding",
     "shard_tables",
+    "sharded_overlay_replay",
     "sharded_pipeline_step",
 ]

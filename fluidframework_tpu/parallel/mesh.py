@@ -65,6 +65,79 @@ def shard_tables(tables: SegmentTable, mesh: Mesh, axis: str = "docs") -> Segmen
     return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), tables)
 
 
+def sharded_overlay_replay(
+    mesh: Mesh, chunk: int, interpret: bool = False, axis: str = "docs"
+):
+    """Compile the doc-sharded OVERLAY fused replay for `mesh`.
+
+    The flagship engine on the mesh (the reference's per-partition
+    deli model: one sequencer/replayer per document partition,
+    server/routerlicious/packages/lambdas-driver/src/document-router/,
+    deli/lambda.ts:215): every per-document array carries a leading
+    `docs` axis laid out across the mesh (one document per device);
+    inside `shard_map` each device runs the WHOLE fused overlay replay
+    (ops/overlay_pallas.replay_fused — pallas chunk kernel + fold +
+    HBM log append, one dispatch) on its local document, then the
+    fleet reduces the global MSN (min over documents — the
+    clientSeqManager.ts:22 role, lowered by XLA to an ICI collective)
+    and or-combines the per-document error flags.
+
+    Returns a jitted
+    ``step(tables, ops, logs, counts, msn_by_chunk) ->
+    (tables', logs', counts', cursors, global_msn, error)``
+    where every input/output has a leading docs axis of size
+    ``mesh.size`` (one document per device; batch more documents by
+    calling with a docs axis that is a multiple of the mesh via an
+    outer vmap).
+
+    `interpret=True` runs the pallas kernel through the interpreter —
+    required on CPU backends (the virtual-device dry run); on a real
+    TPU slice the compiled kernel runs per-device unchanged.
+    """
+    from jax import shard_map
+
+    from ..ops.overlay_pallas import OverlayTable, replay_fused
+
+    docs = P(axis)
+
+    def local_replay(tables, ops, logs, counts, msns):
+        # Local shard views carry a docs_per_device == 1 leading axis.
+        t = jax.tree_util.tree_map(lambda a: a[0], tables)
+        o = jax.tree_util.tree_map(lambda a: a[0], ops)
+        t, log, cnt, cursor = replay_fused(
+            t, o, logs[0], counts[0], msns[0], chunk, interpret
+        )
+        # Fleet reductions over ICI: global applied MSN and error or.
+        gmsn = jax.lax.pmin(msns[0, -1], axis)
+        bits = jnp.arange(31, dtype=jnp.int32)
+        err = jax.lax.pmax((t.error >> bits) & 1, axis)
+        gerr = jnp.sum(err << bits)
+        up = lambda a: a[None]
+        return (
+            jax.tree_util.tree_map(up, t), log[None], cnt[None],
+            cursor[None], gmsn, gerr,
+        )
+
+    table_specs = OverlayTable(
+        n_rows=docs, anchor=docs, buf_start=docs, length=docs,
+        ins_seq=docs, ins_client=docs, rem_seq=docs, rem_clients=docs,
+        props=docs, settled_len=docs, error=docs,
+    )
+    op_specs = OpBatch(
+        op_type=docs, pos1=docs, pos2=docs, seq=docs, ref_seq=docs,
+        client=docs, buf_start=docs, ins_len=docs, prop_keys=docs,
+        prop_vals=docs,
+    )
+    step = shard_map(
+        local_replay,
+        mesh=mesh,
+        in_specs=(table_specs, op_specs, docs, docs, docs),
+        out_specs=(table_specs, docs, docs, docs, P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
 def sharded_pipeline_step(mesh: Mesh, axis: str = "docs"):
     """Compile the full multi-document op-application step for `mesh`.
 

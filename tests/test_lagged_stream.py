@@ -12,6 +12,12 @@ import numpy as np
 import pytest
 
 from fluidframework_tpu.core.mergetree import replay_passive
+from fluidframework_tpu.native import load_hostmerge
+
+pytestmark = pytest.mark.skipif(
+    load_hostmerge() is None,
+    reason="lagged generator needs the native hostmerge engine",
+)
 from fluidframework_tpu.testing.digest import state_digest
 from fluidframework_tpu.testing.synthetic import (
     generate_lagged_stream,
@@ -96,24 +102,11 @@ def test_overlay_pallas_matches_oracle(lagged_stream, oracle_digest):
 
 def test_native_engine_matches_oracle(lagged_stream, oracle_digest):
     from fluidframework_tpu.core.native_engine import NativeMergeEngine
-    from fluidframework_tpu.protocol.messages import MessageType
 
     eng = NativeMergeEngine(local_client_id=-3)
     eng.load("".join(map(chr, lagged_stream.text[:32])))
     for msg in lagged_stream.as_messages():
-        op = msg.contents
-        kind = type(op).__name__
-        if kind == "InsertOp":
-            eng.insert(op.pos, op.text, msg.ref_seq, msg.client_id,
-                       msg.sequence_number)
-        elif kind == "RemoveOp":
-            eng.remove_range(op.start, op.end, msg.ref_seq,
-                             msg.client_id, msg.sequence_number)
-        else:
-            eng.annotate_range(op.start, op.end, op.props, msg.ref_seq,
-                               msg.client_id, msg.sequence_number)
-        eng.current_seq = msg.sequence_number
-        eng.update_min_seq(max(eng.min_seq, msg.minimum_sequence_number))
+        eng.apply_sequenced(msg)
     assert state_digest(eng.annotated_spans()) == oracle_digest
 
 
@@ -125,19 +118,7 @@ def test_pack_settled_preserves_state(lagged_stream, oracle_digest):
     eng = NativeMergeEngine(local_client_id=-3)
     eng.load("".join(map(chr, lagged_stream.text[:32])))
     for i, msg in enumerate(lagged_stream.as_messages()):
-        op = msg.contents
-        kind = type(op).__name__
-        if kind == "InsertOp":
-            eng.insert(op.pos, op.text, msg.ref_seq, msg.client_id,
-                       msg.sequence_number)
-        elif kind == "RemoveOp":
-            eng.remove_range(op.start, op.end, msg.ref_seq,
-                             msg.client_id, msg.sequence_number)
-        else:
-            eng.annotate_range(op.start, op.end, op.props, msg.ref_seq,
-                               msg.client_id, msg.sequence_number)
-        eng.current_seq = msg.sequence_number
-        eng.update_min_seq(max(eng.min_seq, msg.minimum_sequence_number))
+        eng.apply_sequenced(msg)
         if i % 97 == 0:
             eng.pack_settled()
             eng.verify_invariants()

@@ -39,3 +39,61 @@ def test_graft_entry_compiles():
     jax.block_until_ready(out)
     assert int(out.n_rows) > 1
     assert int(out.error) == 0
+
+
+def test_sharded_overlay_replay_digest_equality_4dev():
+    """The flagship overlay engine doc-sharded over a 4-device mesh:
+    per-document digests must equal independent single-device fused
+    replays (the north-star bit-identity contract on the mesh), and
+    the MSN min-reduce must ride the mesh axis."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 (virtual) devices")
+    import numpy as np
+
+    from fluidframework_tpu.core.overlay_replay import (
+        OverlayDeviceReplica,
+        restore_shard,
+        stack_replicas,
+    )
+    from fluidframework_tpu.parallel import (
+        make_docs_mesh,
+        sharded_overlay_replay,
+    )
+    from fluidframework_tpu.testing.digest import state_digest
+    from fluidframework_tpu.testing.synthetic import generate_lagged_stream
+
+    n_dev, n_ops, chunk, window = 4, 512, 64, 1024
+    mesh = make_docs_mesh(n_dev)
+    step = sharded_overlay_replay(mesh, chunk, interpret=True)
+    streams = [
+        generate_lagged_stream(
+            n_ops, n_clients=6, seed=200 + d, window=48, initial_len=12
+        )
+        for d in range(n_dev)
+    ]
+
+    def make_rep(s):
+        return OverlayDeviceReplica(
+            s, initial_len=12, chunk_size=chunk, window=window,
+            n_removers=10, interpret=True,
+        )
+
+    reps = [make_rep(s) for s in streams]
+    for r in reps:
+        r.prepare()
+    tables, ops, logs, counts, msns = stack_replicas(reps)
+
+    out_tables, out_logs, out_counts, cursors, gmsn, gerr = step(
+        tables, ops, logs, counts, msns
+    )
+    assert int(gerr) == 0
+    assert int(gmsn) == min(int(m[-1]) for m in np.asarray(msns))
+    for d, (s, ref) in enumerate(zip(streams, reps)):
+        ref.replay()
+        ref.check_errors()
+        sharded = restore_shard(
+            make_rep(s), out_tables, out_logs, out_counts, cursors, d
+        )
+        assert state_digest(sharded.annotated_spans()) == state_digest(
+            ref.annotated_spans()
+        )
