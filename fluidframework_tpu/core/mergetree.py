@@ -130,10 +130,15 @@ class LocalReference:
     packages/dds/merge-tree/src/localReference.ts). `segment is None`
     means the reference points at the end of the document. When the
     anchor segment is removed, resolution *slides* the position to the
-    nearest surviving position (SlideOnRemove semantics)."""
+    nearest surviving position (SlideOnRemove semantics). `after`
+    marks an after-side anchor (reference Side.After): the reference
+    denotes the position one past its anchor character while that
+    character is visible, and collapses to the slid position once it
+    is not."""
 
     segment: Optional[Segment]
     offset: int = 0
+    after: bool = False
 
     def detach(self) -> None:
         if self.segment is not None and self in self.segment.refs:
@@ -403,7 +408,49 @@ class MergeTreeEngine:
                     grp.segments.append(s)
                     s.groups.append(grp)
             self.pending.append(grp)
+        else:
+            # SEQUENCED removal: slide references off the tombstones NOW
+            # (SlideOnRemove, localReference.ts). Every replica executes
+            # this at the same point in the total order, when the
+            # visible neighborhood is convergent — sliding later (at
+            # zamboni) would race replica-local pending inserts adjacent
+            # to the tombstone and anchor different characters.
+            for s in marked:
+                if s.refs:
+                    self._slide_refs_off(s)
         return marked
+
+    def _slide_refs_off(self, seg: Segment) -> None:
+        """Move `seg`'s references to the start of the next segment
+        that is not removed at all (acked or pending); document end if
+        none. Pending-removed targets re-slide when their own removal
+        sequences, so fully-acked replicas always converge."""
+        refs, seg.refs = seg.refs, []
+        if not refs:
+            return
+        try:
+            i = self.segments.index(seg)
+        except ValueError:
+            i = len(self.segments)
+        target: Optional[Segment] = None
+        for s in self.segments[i + 1:]:
+            # Skip pending local inserts (seq UNASSIGNED): they exist
+            # only on this replica, and anchoring to one would diverge
+            # from replicas that slide before seeing it sequenced.
+            if (
+                s.removed_seq is None and len(s.content) > 0
+                and s.seq != UNASSIGNED_SEQ
+            ):
+                target = s
+                break
+        for r in refs:
+            r.segment = target
+            r.offset = 0
+            # "after X" with X gone collapses to X's old spot — the
+            # slid-to segment's start, not one past it.
+            r.after = False
+            if target is not None:
+                target.refs.append(r)
 
     # ----------------------------------------------------------- annotate
 
@@ -491,6 +538,11 @@ class MergeTreeEngine:
                 # else: an overlapping remote remove was sequenced first
                 # and already owns removed_seq (keep earliest).
                 seg.local_removed_seq = None
+                # The removal is now sequenced: slide references off the
+                # tombstone at this total-order point (SlideOnRemove —
+                # see remove_range's sequenced branch).
+                if seg.refs:
+                    self._slide_refs_off(seg)
         elif grp.kind == MergeTreeDeltaType.ANNOTATE:
             for seg in grp.segments:
                 if seg.pending_props:
@@ -762,20 +814,36 @@ class MergeTreeEngine:
         assert head >= 0
 
     def anchor_at(
-        self, pos: int, ref_seq: int, client_id: int
+        self, pos: int, ref_seq: int, client_id: int,
+        after: bool = False,
     ) -> LocalReference:
         """Anchor a reference at visible position `pos` of perspective
         (ref_seq, client_id) (reference createLocalReferencePosition,
         client.ts / mergeTree.ts). pos == visible length anchors the
-        document end (segment None)."""
+        document end (segment None). `after` marks an after-side
+        anchor (cleared if the anchor immediately slides)."""
         remaining = pos
         for seg in self.segments:
             cat, length = self._vis(seg, ref_seq, client_id)
             if cat == VisCategory.SKIP or length == 0:
                 continue
             if remaining < length:
-                ref = LocalReference(segment=seg, offset=remaining)
+                ref = LocalReference(
+                    segment=seg, offset=remaining, after=after
+                )
                 seg.refs.append(ref)
+                if (
+                    seg.removed_seq is not None
+                    and seg.removed_seq != UNASSIGNED_SEQ
+                ):
+                    # The char is visible at the op's perspective but
+                    # its removal ALREADY sequenced — the slide pass
+                    # for that removal has run, so slide now (every
+                    # replica anchoring after the removal in total
+                    # order does the same; ones that anchored before
+                    # it slid at the removal). No reference may sit on
+                    # an acked tombstone.
+                    self._slide_refs_off(seg)
                 return ref
             remaining -= length
         if remaining > 0:
@@ -786,6 +854,15 @@ class MergeTreeEngine:
         """Resolve a reference to a visible position at the local
         perspective, sliding forward off removed segments
         (SlideOnRemove, localReference.ts)."""
+        return self._resolve_ref(ref, honor_after=False)
+
+    def resolve_reference(self, ref: LocalReference) -> int:
+        """`local_position` honoring the reference's after-side: one
+        past the anchor character while it is visible, collapsed to
+        the slid position once it is not (Side.After resolution)."""
+        return self._resolve_ref(ref, honor_after=True)
+
+    def _resolve_ref(self, ref: LocalReference, honor_after: bool) -> int:
         if ref.segment is None:
             return self.visible_length(self.current_seq, self.local_client_id)
         pos = 0
@@ -793,7 +870,10 @@ class MergeTreeEngine:
             cat, length = self._vis(seg, self.current_seq, self.local_client_id)
             if seg is ref.segment:
                 if cat == VisCategory.VISIBLE:
-                    return pos + min(ref.offset, length)
+                    p = pos + min(ref.offset, length)
+                    if honor_after and ref.after:
+                        p += 1
+                    return p
                 return pos  # removed anchor: slide to nearest survivor
             if cat != VisCategory.SKIP:
                 pos += length
@@ -854,6 +934,33 @@ class MergeTreeEngine:
         for seg in self.segments:
             if seg.removed_seq is None:
                 out.extend(seg.content)
+        return out
+
+    def enable_attribution(self) -> None:
+        """Parity seam with the native engine's attribution tracking.
+        The oracle never coalesces segments, so per-position insert
+        attribution is fully derived from segment metadata (key =
+        insert seq; UNASSIGNED while pending; 0 for loaded content) —
+        enabling is a no-op flag."""
+        self._track_attr = True
+
+    def attribution_spans(self) -> List[Tuple[int, int]]:
+        """(run_length, attribution key) runs over the visible
+        document, adjacent equal keys merged — must match the native
+        engine's hm_attr_spans bit-for-bit (attributionCollection.ts
+        role; farm-gated)."""
+        out: List[Tuple[int, int]] = []
+        for s in self.segments:
+            if s.removed_seq is not None or len(s.content) == 0:
+                continue
+            if s.client_id == NON_COLLAB_CLIENT:
+                key = 0
+            else:
+                key = s.seq
+            if out and out[-1][1] == key:
+                out[-1] = (out[-1][0] + len(s.content), key)
+            else:
+                out.append((len(s.content), key))
         return out
 
     def annotated_spans(self) -> List[Tuple[Any, Optional[dict]]]:
@@ -1004,6 +1111,17 @@ class CollabClient:
         self.engine.update_min_seq(
             max(self.engine.min_seq, msg.minimum_sequence_number)
         )
+
+    def apply_msgs(self, msgs) -> None:
+        """Apply a run of sequenced messages; one native batch call
+        when the engine supports it (hm_apply_batch), else the
+        per-message loop. Identical semantics either way."""
+        batch = getattr(self.engine, "apply_sequenced_batch", None)
+        if batch is not None:
+            batch(msgs)
+            return
+        for m in msgs:
+            self.apply_msg(m)
 
     def _ack_op(self, op: MergeTreeOp, seq: int) -> None:
         if isinstance(op, GroupOp):

@@ -288,6 +288,117 @@ class NativeMergeEngine:
             max(self.min_seq, msg.minimum_sequence_number)
         )
 
+    def apply_sequenced_batch(self, msgs) -> None:
+        """Apply a run of `SequencedMessage`s in ONE native call
+        (hm_apply_batch — the client.ts:858 applyMsg loop with the
+        Python/ctypes frame cost paid per BATCH, not per message).
+        Own-client messages ack the pending FIFO, remote ops apply at
+        their perspectives; the MSN advances once at batch end, which
+        is semantics-preserving (zamboni timing never changes visible
+        state; min_seq only enters visibility on the local-perspective
+        read path, which no remote apply or ack touches)."""
+        from ..protocol.messages import MessageType
+
+        kind: List[int] = []
+        pos1: List[int] = []
+        pos2: List[int] = []
+        ref: List[int] = []
+        cli: List[int] = []
+        seq: List[int] = []
+        aoff: List[int] = []
+        alen: List[int] = []
+        chunks: List[str] = []
+        items_mode = False
+        item_chunks: List[List[int]] = []
+        pk: List[int] = []
+        pv: List[int] = []
+        poff: List[int] = [0]
+        coder = self._props
+        local = self.local_client_id
+        final_msn = self.min_seq
+        cursor = 0
+
+        def row(k, p1=0, p2=0, r=0, c=0, s=0, ao=0, al=0):
+            kind.append(k)
+            pos1.append(p1)
+            pos2.append(p2)
+            ref.append(r)
+            cli.append(c)
+            seq.append(s)
+            aoff.append(ao)
+            alen.append(al)
+            poff.append(len(pk))
+
+        for msg in msgs:
+            if msg.minimum_sequence_number > final_msn:
+                final_msn = msg.minimum_sequence_number
+            sq = msg.sequence_number
+            if msg.type != MessageType.OP or msg.contents is None:
+                row(4, s=sq)
+                continue
+            ops = (
+                msg.contents.ops
+                if isinstance(msg.contents, GroupOp)
+                else (msg.contents,)
+            )
+            for op in ops:
+                if msg.client_id == local:
+                    row(3, s=sq)
+                elif isinstance(op, InsertOp):
+                    if op.text is not None:
+                        content_len = len(op.text)
+                        chunks.append(op.text)
+                    else:
+                        content_len = len(op.seg)
+                        items_mode = True
+                        item_chunks.append(list(op.seg))
+                    if op.props:
+                        for k, v in op.props.items():
+                            if v is None:
+                                continue
+                            pk.append(coder.key_id(k))
+                            pv.append(coder.val_id(v))
+                    row(0, p1=op.pos, r=msg.ref_seq, c=msg.client_id,
+                        s=sq, ao=cursor, al=content_len)
+                    cursor += content_len
+                elif isinstance(op, RemoveOp):
+                    row(1, p1=op.start, p2=op.end, r=msg.ref_seq,
+                        c=msg.client_id, s=sq)
+                elif isinstance(op, AnnotateOp):
+                    for k, v in op.props.items():
+                        pk.append(coder.key_id(k))
+                        pv.append(coder.val_id(v))
+                    row(2, p1=op.start, p2=op.end, r=msg.ref_seq,
+                        c=msg.client_id, s=sq)
+                else:
+                    raise TypeError(f"unsupported op {type(op)!r}")
+
+        if chunks:
+            self._is_text = True
+        if items_mode:
+            if chunks:
+                raise TypeError("mixed str/item inserts in one batch")
+            arena = _arr([x for ch in item_chunks for x in ch])
+            self._is_text = False
+        else:
+            joined = "".join(chunks)
+            arena = (
+                np.frombuffer(joined.encode("utf-32-le"), np.int32)
+                if joined else _arr([])
+            )
+        rc = self._lib.hm_apply_batch(
+            self._ptr, len(kind), _ptr(_arr(kind)), _ptr(_arr(pos1)),
+            _ptr(_arr(pos2)), _ptr(_arr(ref)), _ptr(_arr(cli)),
+            _ptr(_arr(seq)), _ptr(np.ascontiguousarray(arena)),
+            _ptr(_arr(aoff)), _ptr(_arr(alen)), _ptr(_arr(pk)),
+            _ptr(_arr(pv)), _ptr(_arr(poff)), final_msn,
+        )
+        if rc != 0:
+            raise ValueError(
+                f"apply_sequenced_batch failed at row {-rc - 1} "
+                f"(kind {kind[-rc - 1]}, seq {seq[-rc - 1]})"
+            )
+
     def pack_settled(self) -> None:
         """Merge adjacent fully-settled same-props segments (the
         zamboni.ts:19 packParent role; run length capped in C++).
@@ -339,6 +450,29 @@ class NativeMergeEngine:
             self._ptr, item, ref_seq, client_id
         ))
         return None if v < 0 else v
+
+    def enable_attribution(self) -> None:
+        """Track per-position insert attribution (attribution key =
+        insert seq; the attributionCollection.ts/attributionPolicy.ts
+        role). Existing content backfills: loaded text to key 0,
+        sequenced segments to their seq, pending locals assigned on
+        ack. Runs survive splits, zamboni and settled-run packing."""
+        self._lib.hm_enable_attr(self._ptr)
+
+    def attribution_spans(self) -> List[Tuple[int, int]]:
+        """(run_length, attribution key) runs over the visible
+        document, adjacent equal keys merged."""
+        n = int(self._lib.hm_attr_spans(self._ptr, None, 0))
+        buf = np.empty(max(n, 1), np.int32)
+        self._lib.hm_attr_spans(self._ptr, _ptr(buf), n)
+        out: List[Tuple[int, int]] = []
+        for i in range(0, n, 2):
+            ln, key = int(buf[i]), int(buf[i + 1])
+            if out and out[-1][1] == key:
+                out[-1] = (out[-1][0] + ln, key)
+            else:
+                out.append((ln, key))
+        return out
 
     def annotated_spans(self) -> List[Tuple[Any, Optional[dict]]]:
         n = int(self._lib.hm_spans(self._ptr, None, 0))

@@ -68,17 +68,19 @@ def reconstruct_settled(
     log: np.ndarray,
     counts: List[int],
     n_prop_keys: int,
-) -> Tuple[np.ndarray, np.ndarray]:
-    """Replay the fold log into the final settled (text, props).
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Replay the fold log into the final settled (text, props, attr).
 
     Each epoch's records are in storage (== coordinate) order with
     anchors in that epoch's settled space — exactly the walk
     `overlay_ref.OverlayDoc.fold` performs in-place; here it runs once
     per epoch over the logged rows instead (same codes, same
-    PROP_DELETE tombstone semantics)."""
+    PROP_DELETE tombstone semantics; `attr` carries each settled
+    position's insert-attribution key, record column 4)."""
     KK = n_prop_keys
     settled_t = np.asarray(initial_text, np.int32)
     settled_p = np.full((len(settled_t), KK), PROP_ABSENT, np.int32)
+    settled_a = np.zeros(len(settled_t), np.int32)
     off = 0
     for cnt in counts:
         recs = log[off: off + cnt]
@@ -87,21 +89,25 @@ def reconstruct_settled(
             continue
         pieces_t: List[np.ndarray] = []
         pieces_p: List[np.ndarray] = []
+        pieces_a: List[np.ndarray] = []
         cursor = 0
         for r in recs:
             a = int(r[0])
             code = int(r[1])
             b = int(r[2])
             ln = int(r[3])
-            props = r[4:]
+            iseq = int(r[4])
+            props = r[5:]
             pieces_t.append(settled_t[cursor:a])
             pieces_p.append(settled_p[cursor:a])
+            pieces_a.append(settled_a[cursor:a])
             cursor = a
             if code == REC_SETTLE_TEXT:
                 pieces_t.append(stream_text[b: b + ln])
                 row = props.copy()
                 row[row == PROP_DELETE] = PROP_ABSENT
                 pieces_p.append(np.broadcast_to(row, (ln, KK)).copy())
+                pieces_a.append(np.full(ln, iseq, np.int32))
             elif code == REC_DROP_SPAN:
                 cursor = a + ln
             elif code == REC_SETTLE_SPAN:
@@ -109,6 +115,7 @@ def reconstruct_settled(
                 pieces_p.append(
                     merge_span_props(settled_p[a: a + ln], props)
                 )
+                pieces_a.append(settled_a[a: a + ln])
                 cursor = a + ln
             elif code == REC_NONE:
                 pass  # dropped text row: reconstructs to nothing
@@ -116,6 +123,7 @@ def reconstruct_settled(
                 raise ValueError(f"bad fold-log code {code}")
         pieces_t.append(settled_t[cursor:])
         pieces_p.append(settled_p[cursor:])
+        pieces_a.append(settled_a[cursor:])
         settled_t = np.concatenate(pieces_t) if pieces_t else (
             np.zeros(0, np.int32)
         )
@@ -123,7 +131,11 @@ def reconstruct_settled(
             np.concatenate(pieces_p)
             if pieces_p else np.zeros((0, KK), np.int32)
         )
-    return settled_t, settled_p
+        settled_a = (
+            np.concatenate(pieces_a)
+            if pieces_a else np.zeros(0, np.int32)
+        )
+    return settled_t, settled_p, settled_a
 
 
 class OverlayDeviceReplica:
@@ -162,7 +174,7 @@ class OverlayDeviceReplica:
         self.table = make_overlay_table(
             window, n_removers, n_prop_keys, settled_len=initial_len
         )
-        self.log = jnp.zeros((self.log_cap, 4 + n_prop_keys), jnp.int32)
+        self.log = jnp.zeros((self.log_cap, 5 + n_prop_keys), jnp.int32)
         self.counts = jnp.zeros(max(self.n_chunks, 1), jnp.int32)
         self.cursor = jnp.int32(0)
         self.chunks_done = 0
@@ -250,12 +262,13 @@ class OverlayDeviceReplica:
             )
         counts = np.asarray(self.counts)[: self.chunks_done].tolist()
         log = np.asarray(self.log[:cursor])
-        settled_t, settled_p = reconstruct_settled(
+        settled_t, settled_p, settled_a = reconstruct_settled(
             self.stream.text[: self.initial_len], self.stream.text,
             log, counts, self.n_prop_keys,
         )
         doc = OverlayDoc(settled_t, self.n_removers, self.n_prop_keys)
         doc.settled_props = settled_p
+        doc.settled_attr = settled_a
         t = self.table
         m = int(t.n_rows)
         doc.anchor = np.asarray(t.anchor[:m])
@@ -292,6 +305,12 @@ class OverlayDeviceReplica:
 
     def annotated_spans(self):
         return OverlayReplica.annotated_spans(self._shim())
+
+    def attribution_spans(self):
+        """(run_length, insert-attribution key) runs over the visible
+        document — settled keys ride the fold log's ins_seq column,
+        unsettled rows derive theirs from the table's ins_seq."""
+        return OverlayReplica.attribution_spans(self._shim())
 
     def verify_invariants(self) -> None:
         self._materialize().verify_invariants()
@@ -439,14 +458,15 @@ class OverlayKernelMessageReplica:
         counts = [n for _, n in self._epochs]
         log = (
             np.concatenate([r[:n] for r, n in self._epochs])
-            if self._epochs else np.zeros((0, 4 + self.n_prop_keys),
+            if self._epochs else np.zeros((0, 5 + self.n_prop_keys),
                                           np.int32)
         )
-        settled_t, settled_p = reconstruct_settled(
+        settled_t, settled_p, settled_a = reconstruct_settled(
             self._initial_np, arena_text, log, counts, self.n_prop_keys
         )
         doc = OverlayDoc(settled_t, self.n_removers, self.n_prop_keys)
         doc.settled_props = settled_p
+        doc.settled_attr = settled_a
         t = self.table
         m = int(t.n_rows)
         doc.anchor = np.asarray(t.anchor[:m])

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import json
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..core.mergetree import LocalReference, MergeTreeEngine, apply_remote_op
 from ..protocol.constants import NON_COLLAB_CLIENT, UNASSIGNED_SEQ, UNIVERSAL_SEQ
@@ -372,6 +372,29 @@ class SharedString(SharedSegmentSequence):
     def annotated_spans(self):
         return self.engine.annotated_spans()
 
+    # ----------------------------------------------------- attribution
+
+    def enable_attribution(self) -> None:
+        """Track per-position insert attribution (attribution key =
+        insert seq; attributionPolicy.ts role). Resolve keys to
+        {client, timestamp} through a `framework.attributor.Attributor`
+        observing the same op stream."""
+        self.engine.enable_attribution()
+
+    def attribution_spans(self):
+        """(run_length, attribution key) runs over the visible text."""
+        return self.engine.attribution_spans()
+
+    def attribution_at(self, pos: int) -> int:
+        """Attribution key of the character at visible position `pos`
+        (0 = initial content, UNASSIGNED_SEQ = pending local)."""
+        off = pos
+        for ln, key in self.engine.attribution_spans():
+            if off < ln:
+                return key
+            off -= ln
+        raise IndexError(f"position {pos} beyond visible length")
+
 
 class StringFactory(ChannelFactory):
     type_name = "https://graph.microsoft.com/types/mergeTree"
@@ -387,19 +410,89 @@ class SequenceFactory(StringFactory):
 # ---------------------------------------------------------------------------
 
 
+SIDE_BEFORE = "before"
+SIDE_AFTER = "after"
+
+
 @dataclass
 class SequenceInterval:
     """An anchored range (reference SequenceInterval,
     intervalCollection.ts:404): endpoints are merge-tree local
-    references that slide on remove."""
+    references that slide on remove.
+
+    Endpoint SIDEDNESS (reference Side/stickiness,
+    sequencePlace.ts / intervalCollection.ts): a `before` endpoint
+    anchors to the character AT the position, so concurrent inserts
+    at the boundary push it along (the interval expands); an `after`
+    endpoint anchors to the PREVIOUS character and resolves one past
+    it, so boundary inserts land outside (the interval does not
+    expand). (start=before, end=after) is "full stickiness" for
+    exclusive-end ranges."""
 
     interval_id: str
     start_ref: LocalReference
     end_ref: LocalReference
     props: Dict[str, Any] = field(default_factory=dict)
+    start_side: str = SIDE_BEFORE
+    end_side: str = SIDE_BEFORE
 
     def bounds(self, engine: MergeTreeEngine):
-        return engine.local_position(self.start_ref), engine.local_position(self.end_ref)
+        # After-ness lives on the references themselves (set at anchor
+        # time, cleared when a removal slides them), so degraded
+        # anchors (after at position 0) and slid anchors resolve
+        # correctly; the declared sides only drive (re)anchoring.
+        return (
+            engine.resolve_reference(self.start_ref),
+            engine.resolve_reference(self.end_ref),
+        )
+
+
+class _IntervalIndex:
+    """Augmented sorted-endpoint search index (the
+    findOverlappingIntervals role, intervalCollection.ts:958 backed
+    by the reference's IntervalTree): intervals sorted by resolved
+    start with a running prefix-max of ends; queries binary-search
+    the start bound and walk an implicit balanced tree with max-end
+    pruning — O(log n + k) per query, matching the columnar stance
+    (two parallel arrays, no pointer tree).
+
+    Anchored endpoints move with every sequence edit, so the arrays
+    rebuild lazily on the first query after any mutation (an edit
+    version bump or an interval op)."""
+
+    def __init__(self):
+        self.starts: List[int] = []
+        self.ends: List[int] = []
+        self.maxend: List[int] = []
+        self.ids: List[str] = []
+
+    def rebuild(self, intervals, engine) -> None:
+        rows = sorted(
+            (iv.bounds(engine) + (iid,) for iid, iv in intervals.items()),
+        )
+        self.starts = [r[0] for r in rows]
+        self.ends = [r[1] for r in rows]
+        self.ids = [r[2] for r in rows]
+        self.maxend = []
+        m = -(1 << 60)
+        for e in self.ends:
+            m = max(m, e)
+            self.maxend.append(m)
+
+    def query(self, start: int, end: int) -> List[str]:
+        """Ids of intervals [s, e] with s <= end and e >= start, in
+        start order. maxend prunes whole prefixes whose intervals all
+        end before `start`; bisect bounds the suffix whose starts
+        exceed `end`."""
+        import bisect
+
+        hi = bisect.bisect_right(self.starts, end)
+        out: List[str] = []
+        lo = bisect.bisect_left(self.maxend, start)  # maxend is sorted
+        for i in range(lo, hi):
+            if self.ends[i] >= start:
+                out.append(self.ids[i])
+        return out
 
 
 class IntervalCollection:
@@ -416,7 +509,11 @@ class IntervalCollection:
         self.name = name
         self.intervals: Dict[str, SequenceInterval] = {}
         self._pending: Dict[str, int] = {}
+        self._pending_props: Dict[Tuple[str, str], int] = {}
         self._next_local_id = 0
+        self._index = _IntervalIndex()
+        self._index_key: Optional[tuple] = None
+        self._mutations = 0
 
     # ----------------------------------------------------------- local API
 
@@ -425,20 +522,44 @@ class IntervalCollection:
             {"kind": "intervals", "collection": self.name, "op": op}
         )
 
-    def _anchor_local(self, start: int, end: int):
+    def _anchor(self, pos: int, side: str, ref_seq: int, cid: int):
+        """Anchor one endpoint honoring its side: `after` anchors to
+        the previous character with the reference's after flag set
+        (resolution adds 1 back while the char is visible), so
+        boundary inserts land outside the interval; position 0
+        degrades to `before` (there is no previous character)."""
+        eng = self.sequence.engine
+        if side == SIDE_AFTER and pos > 0:
+            return eng.anchor_at(pos - 1, ref_seq, cid, after=True)
+        return eng.anchor_at(pos, ref_seq, cid)
+
+    def _anchor_local(self, start: int, end: int,
+                      start_side: str = SIDE_BEFORE,
+                      end_side: str = SIDE_BEFORE):
         eng = self.sequence.engine
         ref_seq, cid = eng.current_seq, eng.local_client_id
-        return eng.anchor_at(start, ref_seq, cid), eng.anchor_at(end, ref_seq, cid)
+        return (
+            self._anchor(start, start_side, ref_seq, cid),
+            self._anchor(end, end_side, ref_seq, cid),
+        )
 
-    def add(self, start: int, end: int, props: Optional[dict] = None) -> SequenceInterval:
+    def add(self, start: int, end: int, props: Optional[dict] = None,
+            start_side: str = SIDE_BEFORE,
+            end_side: str = SIDE_BEFORE) -> SequenceInterval:
         self._next_local_id += 1
         iid = f"{self.sequence.engine.local_client_id}-{self._next_local_id}"
-        s_ref, e_ref = self._anchor_local(start, end)
-        iv = SequenceInterval(iid, s_ref, e_ref, dict(props or {}))
+        s_ref, e_ref = self._anchor_local(start, end, start_side, end_side)
+        iv = SequenceInterval(
+            iid, s_ref, e_ref, dict(props or {}),
+            start_side=start_side, end_side=end_side,
+        )
         self.intervals[iid] = iv
         self._pending[iid] = self._pending.get(iid, 0) + 1
+        self._mutations += 1
         self._submit(
-            {"type": "add", "id": iid, "start": start, "end": end, "props": props or {}}
+            {"type": "add", "id": iid, "start": start, "end": end,
+             "props": props or {}, "startSide": start_side,
+             "endSide": end_side}
         )
         return iv
 
@@ -446,9 +567,27 @@ class IntervalCollection:
         iv = self.intervals[iid]
         iv.start_ref.detach()
         iv.end_ref.detach()
-        iv.start_ref, iv.end_ref = self._anchor_local(start, end)
+        iv.start_ref, iv.end_ref = self._anchor_local(
+            start, end, iv.start_side, iv.end_side
+        )
         self._pending[iid] = self._pending.get(iid, 0) + 1
+        self._mutations += 1
         self._submit({"type": "change", "id": iid, "start": start, "end": end})
+
+    def change_properties(self, iid: str, props: Dict[str, Any]) -> None:
+        """Per-KEY last-writer-wins property merge with pending-local
+        shadowing (the reference's propertyManager on intervals /
+        defaultMap kernel semantics): `None` deletes a key."""
+        iv = self.intervals[iid]
+        for k, v in props.items():
+            if v is None:
+                iv.props.pop(k, None)
+            else:
+                iv.props[k] = v
+            pk = (iid, k)
+            self._pending_props[pk] = self._pending_props.get(pk, 0) + 1
+        self._mutations += 1
+        self._submit({"type": "props", "id": iid, "props": dict(props)})
 
     def remove_interval_by_id(self, iid: str) -> None:
         iv = self.intervals.pop(iid, None)
@@ -456,6 +595,7 @@ class IntervalCollection:
             iv.start_ref.detach()
             iv.end_ref.detach()
         self._pending[iid] = self._pending.get(iid, 0) + 1
+        self._mutations += 1
         self._submit({"type": "delete", "id": iid})
 
     def get_interval_by_id(self, iid: str) -> Optional[SequenceInterval]:
@@ -467,10 +607,36 @@ class IntervalCollection:
     def __len__(self) -> int:
         return len(self.intervals)
 
+    # -------------------------------------------------------------- queries
+
+    def find_overlapping_intervals(
+        self, start: int, end: int
+    ) -> List[SequenceInterval]:
+        """Intervals whose resolved range [s, e] intersects
+        [start, end] (findOverlappingIntervals,
+        intervalCollection.ts:958,2312), via the lazily rebuilt
+        sorted-endpoint index — O(log n + candidates) per query
+        between mutations, not an O(n) interval scan."""
+        eng = self.sequence.engine
+        key = (eng.current_seq, eng.local_seq, self._mutations)
+        if self._index_key != key:
+            self._index.rebuild(self.intervals, eng)
+            self._index_key = key
+        return [
+            self.intervals[iid]
+            for iid in self._index.query(start, end)
+            if iid in self.intervals
+        ]
+
     # -------------------------------------------------------------- apply
 
     def _process(self, op: dict, msg: SequencedMessage, local: bool) -> None:
         iid = op["id"]
+        kind = op["type"]
+        self._mutations += 1
+        if kind == "props":
+            self._process_props(op, local)
+            return
         if local:
             n = self._pending.get(iid, 0) - 1
             if n <= 0:
@@ -481,7 +647,6 @@ class IntervalCollection:
         if self._pending.get(iid, 0) > 0:
             return  # pending local change shadows the remote one
         eng = self.sequence.engine
-        kind = op["type"]
         if kind == "delete":
             iv = self.intervals.pop(iid, None)
             if iv is not None:
@@ -489,12 +654,22 @@ class IntervalCollection:
                 iv.end_ref.detach()
             return
         # Anchor at the op's perspective — every replica resolves the
-        # same segments (merge-tree remote-perspective contract).
-        s_ref = eng.anchor_at(op["start"], msg.ref_seq, msg.client_id)
-        e_ref = eng.anchor_at(op["end"], msg.ref_seq, msg.client_id)
+        # same segments (merge-tree remote-perspective contract) —
+        # honoring the interval's endpoint sides.
+        if kind == "add":
+            ss = op.get("startSide", SIDE_BEFORE)
+            es = op.get("endSide", SIDE_BEFORE)
+        else:
+            iv0 = self.intervals.get(iid)
+            ss = iv0.start_side if iv0 is not None else SIDE_BEFORE
+            es = iv0.end_side if iv0 is not None else SIDE_BEFORE
+        rs, cid = msg.ref_seq, msg.client_id
+        s_ref = self._anchor(op["start"], ss, rs, cid)
+        e_ref = self._anchor(op["end"], es, rs, cid)
         if kind == "add":
             self.intervals[iid] = SequenceInterval(
-                iid, s_ref, e_ref, dict(op.get("props") or {})
+                iid, s_ref, e_ref, dict(op.get("props") or {}),
+                start_side=ss, end_side=es,
             )
         elif kind == "change":
             iv = self.intervals.get(iid)
@@ -506,25 +681,66 @@ class IntervalCollection:
             iv.end_ref.detach()
             iv.start_ref, iv.end_ref = s_ref, e_ref
 
+    def _process_props(self, op: dict, local: bool) -> None:
+        """Per-key LWW with pending shadowing; sequenced remote writes
+        on keys with outstanding local writes are shadowed (the local
+        value rewins when its own op sequences)."""
+        iid = op["id"]
+        if local:
+            for k in op["props"]:
+                pk = (iid, k)
+                n = self._pending_props.get(pk, 0) - 1
+                if n <= 0:
+                    self._pending_props.pop(pk, None)
+                else:
+                    self._pending_props[pk] = n
+            return
+        iv = self.intervals.get(iid)
+        if iv is None:
+            return
+        for k, v in op["props"].items():
+            if self._pending_props.get((iid, k), 0) > 0:
+                continue
+            if v is None:
+                iv.props.pop(k, None)
+            else:
+                iv.props[k] = v
+
     # ---------------------------------------------------------- summaries
 
     def _to_serializable(self) -> list:
+        # Store LOGICAL endpoint positions (bounds), not raw anchor
+        # positions: _load re-applies the side adjustment when it
+        # re-anchors, so storing anchors would shift after-endpoints
+        # by one on every summarize/load cycle.
         eng = self.sequence.engine
-        return [
-            {
-                "id": iv.interval_id,
-                "start": eng.local_position(iv.start_ref),
-                "end": eng.local_position(iv.end_ref),
-                "props": iv.props,
-            }
-            for iv in self.intervals.values()
-        ]
+        rows = []
+        for iv in self.intervals.values():
+            s, e = iv.bounds(eng)
+            rows.append(
+                {
+                    "id": iv.interval_id,
+                    "start": s,
+                    "end": e,
+                    "props": iv.props,
+                    "startSide": iv.start_side,
+                    "endSide": iv.end_side,
+                }
+            )
+        return rows
 
     def _load(self, data: list) -> None:
         eng = self.sequence.engine
         for row in data:
-            s_ref = eng.anchor_at(row["start"], eng.current_seq, eng.local_client_id)
-            e_ref = eng.anchor_at(row["end"], eng.current_seq, eng.local_client_id)
+            ss = row.get("startSide", SIDE_BEFORE)
+            es = row.get("endSide", SIDE_BEFORE)
+            s_ref = self._anchor(
+                row["start"], ss, eng.current_seq, eng.local_client_id
+            )
+            e_ref = self._anchor(
+                row["end"], es, eng.current_seq, eng.local_client_id
+            )
             self.intervals[row["id"]] = SequenceInterval(
-                row["id"], s_ref, e_ref, dict(row.get("props") or {})
+                row["id"], s_ref, e_ref, dict(row.get("props") or {}),
+                start_side=ss, end_side=es,
             )

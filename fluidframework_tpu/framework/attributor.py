@@ -68,6 +68,37 @@ class Attributor:
             }
         return out
 
+    def serialize_packed(self) -> str:
+        """Compressed summary form: interning + delta coding, then
+        DEFLATE over the whole table (the reference's LZ4 encoder
+        role, attributor/src/lz4Encoder.ts — zlib is this
+        environment's codec), base64-armored for summary blobs."""
+        import base64
+        import zlib
+
+        return base64.b64encode(
+            zlib.compress(self.serialize().encode(), 6)
+        ).decode()
+
+    @classmethod
+    def deserialize_packed(cls, data: str) -> "Attributor":
+        import base64
+        import zlib
+
+        return cls.deserialize(
+            zlib.decompress(base64.b64decode(data)).decode()
+        )
+
+    # ------------------------------------------------- segment bridge
+
+    def entry_at(self, channel, pos: int) -> Optional[dict]:
+        """{client, timestamp} for the character at visible position
+        `pos` of an attribution-tracking sequence channel: the
+        per-segment key (insert seq) resolves through this op-stream
+        table (the attributionCollection.ts -> attributor.ts:42
+        pipeline)."""
+        return self.get(channel.attribution_at(pos))
+
 
 def mixin_attributor(runtime) -> Attributor:
     """Attach an attributor to a container runtime's op stream

@@ -196,6 +196,11 @@ def load_hostmerge() -> Optional[ctypes.CDLL]:
         lib.hm_set_identity.argtypes = [p, i32, i32]
         lib.hm_load.argtypes = [p, ip, i64]
         lib.hm_pack_settled.argtypes = [p]
+        lib.hm_apply_batch.restype = i32
+        lib.hm_apply_batch.argtypes = [p, i64] + [ip] * 12 + [i32]
+        lib.hm_enable_attr.argtypes = [p]
+        lib.hm_attr_spans.restype = i64
+        lib.hm_attr_spans.argtypes = [p, ip, i64]
         for name in ("hm_current_seq", "hm_min_seq", "hm_local_client",
                      "hm_collaborating", "hm_pending_last_id"):
             getattr(lib, name).restype = i32

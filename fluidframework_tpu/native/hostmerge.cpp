@@ -62,6 +62,13 @@ struct Seg {
   std::map<int32_t, int32_t> props;          // key -> value
   std::map<int32_t, int32_t> pending_props;  // key -> pending count
   std::vector<Group*> groups;
+  // Per-position insert-attribution runs (offset, key): the
+  // attributionCollection.ts role. One run per fresh segment (key =
+  // insert seq; UNASSIGNED until ack); runs concatenate when
+  // pack_settled merges segments, so attribution survives coalescing
+  // exactly the way the reference's collection survives append().
+  // Empty when tracking is off.
+  std::vector<std::pair<int32_t, int32_t>> attr;
 };
 
 struct Group {
@@ -146,6 +153,22 @@ struct Engine {
     tail->pending_props = s->pending_props;
     tail->groups = s->groups;
     for (Group* g : tail->groups) g->segs.push_back(tail);
+    if (!s->attr.empty()) {
+      // Slice attribution runs at the split point (the
+      // attributionCollection.ts splitAt role). Run 0 starts at
+      // offset 0 < offset, so i >= 1 on exit.
+      size_t i = 0;
+      while (i < s->attr.size() && s->attr[i].first < offset) i++;
+      bool boundary_run = !(i < s->attr.size() &&
+                            s->attr[i].first == offset);
+      if (boundary_run)
+        // Run i-1 straddles the boundary: tail starts with its key.
+        tail->attr.push_back({0, s->attr[i - 1].second});
+      for (size_t k = i; k < s->attr.size(); k++)
+        tail->attr.push_back(
+            {(int32_t)(s->attr[k].first - offset), s->attr[k].second});
+      s->attr.resize(i);
+    }
     return tail;
   }
 
@@ -158,6 +181,7 @@ struct Engine {
     int32_t lseq = LOCAL_NONE;
     if (seq == UNASSIGNED_SEQ) lseq = ++local_seq;
     Seg* ns = new_seg();
+    if (track_attr) ns->attr.push_back({0, seq});
     ns->content.assign(items, items + n);
     ns->seq = seq;
     ns->client_id = client;
@@ -249,6 +273,7 @@ struct Engine {
             // Our pending local remove lost the race.
             s->removed_clients.insert(s->removed_clients.begin(), client);
             s->removed_seq = seq;
+            note_tomb(seq);
           } else {
             s->removed_clients.push_back(client);
           }
@@ -257,6 +282,7 @@ struct Engine {
           s->removed_clients.assign(1, client);
           s->local_removed_seq = lseq;
           if (seq == UNASSIGNED_SEQ) newly_ours.push_back(s);
+          else note_tomb(seq);
         }
       }
       pos += len;
@@ -338,10 +364,15 @@ struct Engine {
       for (Seg* s : g->segs) {
         s->seq = seq;
         s->local_seq = LOCAL_NONE;
+        for (auto& run : s->attr)
+          if (run.second == UNASSIGNED_SEQ) run.second = seq;
       }
     } else if (g->kind == KIND_REMOVE) {
       for (Seg* s : g->segs) {
-        if (s->removed_seq == UNASSIGNED_SEQ) s->removed_seq = seq;
+        if (s->removed_seq == UNASSIGNED_SEQ) {
+          s->removed_seq = seq;
+          note_tomb(seq);
+        }
         // else: an overlapping remote remove owns removed_seq.
         s->local_removed_seq = LOCAL_NONE;
       }
@@ -361,30 +392,60 @@ struct Engine {
     return 0;
   }
 
+  // Insert-attribution tracking (attributionPolicy.ts role); opt-in
+  // because every segment then carries a run vector.
+  bool track_attr = false;
+  void enable_attr_tracking() {
+    if (track_attr) return;
+    track_attr = true;
+    // Backfill existing segments: preloaded content attributes to
+    // key 0 (the "detached/load" attribution), sequenced segments to
+    // their insert seq, pending locals to UNASSIGNED (acks fill it).
+    for (Seg* s : segments)
+      if (s->attr.empty())
+        s->attr.push_back(
+            {0, s->seq == UNASSIGNED_SEQ ? UNASSIGNED_SEQ
+                 : (s->client_id == NON_COLLAB_CLIENT ? 0 : s->seq)});
+  }
+
+  // Smallest acked removed_seq still in the list (INT32_MAX_ when no
+  // collectible tombstone exists) — lets update_min_seq run O(1) per
+  // message until the MSN actually passes a tombstone.
+  int32_t min_tomb = INT32_MAX_;
+  void note_tomb(int32_t s) {
+    if (s < min_tomb) min_tomb = s;
+  }
+
   // ---- windows (mergetree.py update_min_seq; zamboni.ts:19)
   void update_min_seq(int32_t new_min) {
     min_seq = new_min;
-    std::vector<Seg*> kept;
-    kept.reserve(segments.size());
-    for (Seg* s : segments) {
-      bool dead = s->removed_seq != REMOVED_NONE &&
-                  s->removed_seq != UNASSIGNED_SEQ &&
-                  s->removed_seq <= new_min;
-      if (!dead) kept.push_back(s);
+    if (min_tomb <= new_min) {
+      std::vector<Seg*> kept;
+      kept.reserve(segments.size());
+      min_tomb = INT32_MAX_;
+      for (Seg* s : segments) {
+        bool acked_tomb = s->removed_seq != REMOVED_NONE &&
+                          s->removed_seq != UNASSIGNED_SEQ;
+        if (acked_tomb && s->removed_seq <= new_min) continue;
+        if (acked_tomb) note_tomb(s->removed_seq);
+        kept.push_back(s);
+      }
+      segments.swap(kept);
     }
-    segments.swap(kept);
+    maybe_autopack();
   }
 
   // Merge adjacent fully-settled same-props segments (the
   // zamboni.ts:19 packParent role). Settled segments (acked at or
-  // below min_seq, not removed) are indistinguishable to every valid
-  // future perspective (any refSeq >= MSN sees them), so merging
-  // preserves all visibility/position semantics. Runs are capped so a
-  // later insert that lands inside settled content splits an O(cap)
-  // segment, not an O(document) one (the reference likewise packs
-  // under a segment-size budget). Opt-in for PASSIVE replicas only:
-  // pending local groups may hold pointers into merged-away tails, so
-  // interactive engines must not call this.
+  // below min_seq, not removed, no live pending-group references —
+  // `groups` holds exactly the UNacked groups, ack() removes itself
+  // from every member) are indistinguishable to every valid future
+  // perspective (any refSeq >= MSN sees them), and nothing can later
+  // address them through a group, so merging preserves all
+  // visibility/position/ack semantics for interactive engines too.
+  // Runs are capped so a later insert that lands inside settled
+  // content splits an O(cap) segment, not an O(document) one (the
+  // reference likewise packs under a segment-size budget).
   static constexpr size_t PACK_RUN_CAP = 4096;
   void pack_settled() {
     std::vector<Seg*> kept;
@@ -393,17 +454,35 @@ struct Engine {
     for (Seg* s : segments) {
       bool settled = s->seq != UNASSIGNED_SEQ && s->seq <= min_seq &&
                      s->removed_seq == REMOVED_NONE &&
-                     s->pending_props.empty();
+                     s->pending_props.empty() && s->groups.empty();
       if (settled && run != nullptr && run->props == s->props &&
           run->content.size() + s->content.size() <= PACK_RUN_CAP) {
+        int32_t base = (int32_t)run->content.size();
         run->content.insert(run->content.end(), s->content.begin(),
                             s->content.end());
+        for (auto& r : s->attr) {
+          int32_t off = base + r.first;
+          if (!run->attr.empty() && run->attr.back().second == r.second)
+            continue;  // coalesce equal adjacent keys
+          run->attr.push_back({off, r.second});
+        }
         continue;
       }
       kept.push_back(s);
       run = settled ? s : nullptr;
     }
     segments.swap(kept);
+  }
+
+  // Growth-triggered packing: amortized O(1) per op, keeps the
+  // per-op document walks O(collab window + doc/PACK_RUN_CAP) the way
+  // the reference's zamboni + B-tree bound them.
+  size_t pack_watermark = 64;
+  void maybe_autopack() {
+    if (segments.size() >= pack_watermark * 2) {
+      pack_settled();
+      pack_watermark = std::max<size_t>(64, segments.size());
+    }
   }
 
   // ---- queries
@@ -586,7 +665,38 @@ void hm_load(void* h, const int32_t* items, int64_t n) {
   s->content.assign(items, items + n);
   s->seq = UNIVERSAL_SEQ;
   s->client_id = NON_COLLAB_CLIENT;
+  if (e->track_attr) s->attr.push_back({0, 0});
   e->segments.push_back(s);
+}
+
+void hm_enable_attr(void* h) { E(h)->enable_attr_tracking(); }
+
+// Per-position insert-attribution runs over the visible document:
+// flat stream of (run_len, key) pairs (adjacent equal keys NOT merged
+// across segments — callers normalize). Two-call sizing like hm_spans.
+int64_t hm_attr_spans(void* h, int32_t* out, int64_t cap) {
+  Engine* e = E(h);
+  int64_t n = 0;
+  auto put = [&](int32_t v) {
+    if (out && n < cap) out[n] = v;
+    n++;
+  };
+  for (const Seg* s : e->segments) {
+    if (s->removed_seq != REMOVED_NONE) continue;
+    int64_t len = (int64_t)s->content.size();
+    if (len == 0) continue;
+    if (s->attr.empty()) {
+      put((int32_t)len);
+      put(s->seq);
+      continue;
+    }
+    for (size_t i = 0; i < s->attr.size(); i++) {
+      int64_t end = (i + 1 < s->attr.size()) ? s->attr[i + 1].first : len;
+      put((int32_t)(end - s->attr[i].first));
+      put(s->attr[i].second);
+    }
+  }
+  return n;
 }
 
 int32_t hm_current_seq(void* h) { return E(h)->current_seq; }
@@ -618,6 +728,57 @@ int32_t hm_annotate(void* h, int64_t start, int64_t end, const int32_t* pkeys,
 int32_t hm_ack(void* h, int32_t seq) { return E(h)->ack(seq); }
 
 void hm_pack_settled(void* h) { E(h)->pack_settled(); }
+
+// Batched sequenced-message application: the client.ts:858 applyMsg
+// loop crossed ONCE per batch instead of once per message (the
+// interactive path's bottleneck was per-op Python/ctypes frames, not
+// the merge walks). Row kinds: 0 insert, 1 remove, 2 annotate,
+// 3 ack (own op), 4 window-only (join/noop). Deferring the MSN to one
+// update_min_seq(final_msn) at batch end is semantics-preserving:
+// zamboni timing never changes visible state, and min_seq only enters
+// vis() on the LOCAL perspective, which no remote apply or ack reads.
+// Returns 0, or -(i+1) for the first failing row i.
+int32_t hm_apply_batch(void* h, int64_t n, const int32_t* kind,
+                       const int32_t* pos1, const int32_t* pos2,
+                       const int32_t* ref_seq, const int32_t* client,
+                       const int32_t* seq,
+                       const int32_t* arena, const int32_t* aoff,
+                       const int32_t* alen,
+                       const int32_t* pk, const int32_t* pv,
+                       const int32_t* poff, int32_t final_msn) {
+  Engine* e = E(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int rc = 0;
+    switch (kind[i]) {
+      case 0:
+        rc = e->insert(pos1[i], arena + aoff[i], alen[i], ref_seq[i],
+                       client[i], seq[i], pk + poff[i],
+                       pv + poff[i], poff[i + 1] - poff[i]);
+        break;
+      case 1:
+        rc = e->remove_range(pos1[i], pos2[i], ref_seq[i], client[i],
+                             seq[i]);
+        break;
+      case 2:
+        rc = e->annotate_range(pos1[i], pos2[i], pk + poff[i],
+                               pv + poff[i], poff[i + 1] - poff[i],
+                               ref_seq[i], client[i], seq[i]);
+        break;
+      case 3:
+        rc = e->ack(seq[i]);
+        break;
+      case 4:
+        break;
+      default:
+        rc = -1;
+    }
+    if (rc != 0) return (int32_t)(-(i + 1));
+    e->current_seq = seq[i];
+  }
+  if (final_msn > e->min_seq) e->update_min_seq(final_msn);
+  else e->maybe_autopack();
+  return 0;
+}
 
 void hm_update_min_seq(void* h, int32_t min_seq) {
   E(h)->update_min_seq(min_seq);

@@ -709,8 +709,10 @@ def fold_device(table: OverlayTable, msn: jnp.ndarray):
     gathers) packs surviving rows to the front (re-anchored) and the
     folding rows to the back; because the partition is stable, the
     back IS the fold-record block in storage (== coordinate) order.
-    Records are ``(W, 4+KK)`` columns ``[anchor, code, buf, len,
-    props...]`` with pre-fold anchors; ``code == REC_NONE`` rows
+    Records are ``(W, 5+KK)`` columns ``[anchor, code, buf, len,
+    ins_seq, props...]`` with pre-fold anchors (ins_seq carries the
+    per-position insert-attribution key into the settled state — the
+    attributionCollection.ts role); ``code == REC_NONE`` rows
     (dropped text) reconstruct to nothing but stay in the block so
     one partition serves both outputs.
     """
@@ -780,7 +782,7 @@ def fold_device(table: OverlayTable, msn: jnp.ndarray):
     # the front of the record block for the log append.
     old_anchor_p = packed[6 + KR + KK]
     code_p = packed[6 + KR + KK + 1]
-    rec_cols = (old_anchor_p, code_p, packed[1], packed[2],
+    rec_cols = (old_anchor_p, code_p, packed[1], packed[2], packed[3],
                 *packed[6 + KR:6 + KR + KK])
     records = jnp.roll(jnp.stack(rec_cols, axis=1), -n_new, axis=0)
     return out, records, n_rec

@@ -113,6 +113,10 @@ class OverlayDoc:
         self.settled_props = np.full(
             (len(settled_text), n_prop_keys), PROP_ABSENT, np.int32
         )
+        # Per-position insert-attribution keys (insert seq; 0 for
+        # loaded content) — the attributionCollection.ts role carried
+        # through folds (unsettled rows derive theirs from iseq).
+        self.settled_attr = np.zeros(len(settled_text), np.int32)
         self.S = len(settled_text)
         # Overlay rows (length-n arrays, storage order == doc order).
         self.anchor = np.zeros(0, np.int32)
@@ -339,15 +343,18 @@ class OverlayDoc:
         exc_before = np.cumsum(exc_len) - exc_len
         ins_before = np.cumsum(ins_len) - ins_len
 
-        # Rebuild settled text/props in coordinate (== storage) order.
+        # Rebuild settled text/props/attr in coordinate (== storage)
+        # order.
         pieces_t: List[np.ndarray] = []
         pieces_p: List[np.ndarray] = []
+        pieces_a: List[np.ndarray] = []
         cursor = 0
 
         def take_settled(upto: int) -> None:
             nonlocal cursor
             pieces_t.append(self.settled_text[cursor:upto])
             pieces_p.append(self.settled_props[cursor:upto])
+            pieces_a.append(self.settled_attr[cursor:upto])
             cursor = upto
 
         for i in np.nonzero(folding)[0]:
@@ -359,6 +366,7 @@ class OverlayDoc:
                 pieces_p.append(np.broadcast_to(
                     self._fold_props_row(i, text_row=True), (ln, self.KK)
                 ).copy())
+                pieces_a.append(np.full(ln, self.iseq[i], np.int32))
             elif drop[i] and is_span[i]:
                 take_settled(a)
                 cursor = a + ln  # excise
@@ -368,6 +376,7 @@ class OverlayDoc:
                 pieces_p.append(merge_span_props(
                     self.settled_props[a: a + ln], self.props[i]
                 ))
+                pieces_a.append(self.settled_attr[a: a + ln])
                 cursor = a + ln
             # drop & text row: nothing to do (just removed from overlay)
         take_settled(self.S)
@@ -376,6 +385,9 @@ class OverlayDoc:
         )
         self.settled_props = np.concatenate(pieces_p) if pieces_p else (
             np.zeros((0, self.KK), np.int32)
+        )
+        self.settled_attr = np.concatenate(pieces_a) if pieces_a else (
+            np.zeros(0, np.int32)
         )
         self.S = len(self.settled_text)
 
@@ -570,6 +582,41 @@ class OverlayReplica:
         raise_kernel_errors(self.doc.error)
 
     # ------------------------------------------------------------ output
+
+    def attribution_spans(self) -> List[Tuple[int, int]]:
+        """(run_length, attribution key) runs over the visible
+        document, adjacent equal keys merged — same surface as the
+        scalar/native engines' attribution_spans (farm-gated); keys
+        are insert seqs, 0 for initial content, carried through folds
+        by `OverlayDoc.settled_attr`."""
+        d = self.doc
+        keys: List[np.ndarray] = []
+        cursor = 0
+        is_span = d._is_span()
+        for i in range(d.n):
+            a = int(d.anchor[i])
+            if a > cursor:
+                keys.append(d.settled_attr[cursor:a])
+                cursor = a
+            if int(d.rseq[i]) != NOT_REMOVED:
+                if is_span[i]:
+                    cursor = a + int(d.length[i])
+                continue
+            ln = int(d.length[i])
+            if is_span[i]:
+                keys.append(d.settled_attr[a: a + ln])
+                cursor = a + ln
+            else:
+                keys.append(np.full(ln, int(d.iseq[i]), np.int32))
+        keys.append(d.settled_attr[cursor:])
+        out: List[Tuple[int, int]] = []
+        for arr in keys:
+            for k in np.asarray(arr).tolist():
+                if out and out[-1][1] == k:
+                    out[-1] = (out[-1][0] + 1, k)
+                else:
+                    out.append((1, k))
+        return out
 
     def _doc_order(self) -> List[Tuple[np.ndarray, Optional[np.ndarray]]]:
         """(codepoints, per-char props | None) pieces in doc order:

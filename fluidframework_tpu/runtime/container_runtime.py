@@ -330,27 +330,45 @@ class ContainerRuntime(EventEmitter):
                 }
             return {"address": pm.envelope.datastore, "contents": inner}
 
-        # Serialize each message's wire contents ONCE; the dumped
-        # strings drive sizing, compression, and the chunking test.
+        # Size each message's wire contents ONCE; sizes drive only
+        # compression and chunking, so when a conservative bound
+        # clears both thresholds the batch skips serialization
+        # entirely (the interactive hot path: tiny ops, huge caps).
         items = [(pm, wire_contents(pm)) for pm in batch]
-        dumped = [op_lifecycle._dumps(c) for _, c in items]
-        # Compression (opCompressor.ts:20): pack the batch's contents
-        # into the head message when the total wire size crosses the
-        # threshold; the rest become placeholders so each op keeps its
-        # own sequence number.
+        limit = self.max_op_bytes
         if self.compression_threshold is not None:
-            total = sum(len(d) for d in dumped)
-            if total > self.compression_threshold:
-                packed = op_lifecycle.compress_batch_serialized(dumped)
-                items = [(pm, c) for (pm, _), c in zip(items, packed)]
-                dumped = [op_lifecycle._dumps(c) for _, c in items]
+            limit = min(limit, self.compression_threshold)
+        bound = 0
+        for _, c in items:
+            s = op_lifecycle.approx_wire_size(c, limit - bound)
+            if s < 0:
+                bound = -1
+                break
+            bound += s
+            if bound > limit:
+                break
+        if 0 <= bound <= limit:
+            expanded: List[tuple] = list(items)
+            dumped = items = None  # all small: no compress, no chunk
+        else:
+            dumped = [op_lifecycle._dumps(c) for _, c in items]
+            # Compression (opCompressor.ts:20): pack the batch's
+            # contents into the head message when the total wire size
+            # crosses the threshold; the rest become placeholders so
+            # each op keeps its own sequence number.
+            if self.compression_threshold is not None:
+                total = sum(len(d) for d in dumped)
+                if total > self.compression_threshold:
+                    packed = op_lifecycle.compress_batch_serialized(dumped)
+                    items = [(pm, c) for (pm, _), c in zip(items, packed)]
+                    dumped = [op_lifecycle._dumps(c) for _, c in items]
+            expanded = []
         # Chunking (opSplitter.ts:22): any single message still over
         # the op-size cap splits into chunk ops. Chunk pieces are
         # synthetic pending entries (datastore None); the FINAL chunk
         # keeps the original pending message so its sequenced echo
         # routes (and, on reconnect, resubmits) the original op.
-        expanded: List[tuple] = []
-        for (pm, c), d in zip(items, dumped):
+        for (pm, c), d in zip(items or [], dumped or []):
             chunks = op_lifecycle.split_serialized(d, self.max_op_bytes)
             if chunks is None:
                 expanded.append((pm, c))

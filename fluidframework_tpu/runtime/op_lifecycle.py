@@ -43,6 +43,55 @@ def _dumps(value: Any) -> str:
     return json.dumps(value, default=_wire_default)
 
 
+def approx_wire_size(obj: Any, budget: int) -> int:
+    """Conservative (over-estimating) wire-size bound with early exit:
+    returns a value > `budget` as soon as the bound crosses it, or -1
+    for payload types it cannot bound (caller falls back to exact
+    serialization). Lets the outbox skip per-op json for the common
+    small-batch case — the sizes only gate compression/chunking, and
+    both thresholds are orders of magnitude above typical ops."""
+    if obj is None or isinstance(obj, bool):
+        return 5
+    if isinstance(obj, int):
+        # json renders arbitrary-precision ints in full; only bound
+        # the machine-word range.
+        if -(1 << 53) < obj < (1 << 53):
+            return 24
+        return -1
+    if isinstance(obj, float):
+        return 32
+    if isinstance(obj, str):
+        if obj.isascii():
+            return 2 + 2 * len(obj)  # escaping can at most double
+        # ensure_ascii renders non-ASCII as \uXXXX (6 bytes/char;
+        # surrogate pairs 12, still <= 12*len).
+        return 2 + 12 * len(obj)
+    if isinstance(obj, dict):
+        total = 2
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                return -1
+            total += 4 + 2 * len(k)
+            s = approx_wire_size(v, budget - total)
+            if s < 0:
+                return -1
+            total += s + 1
+            if total > budget:
+                return total
+        return total
+    if isinstance(obj, (list, tuple)):
+        total = 2
+        for v in obj:
+            s = approx_wire_size(v, budget - total)
+            if s < 0:
+                return -1
+            total += s + 1
+            if total > budget:
+                return total
+        return total
+    return -1
+
+
 def wire_size(contents: Any) -> int:
     try:
         return len(_dumps(contents))

@@ -36,6 +36,10 @@ class FarmConfig:
     annotate_keys: Tuple[str, ...] = ("bold", "color", "size")
     initial_text: str = "hello world"
     check_annotations: bool = True
+    # Convergence-assert cadence: every round by default (the farm's
+    # correctness role); throughput configs raise it so the measured
+    # region is the client/sequencer path, not O(doc) text pulls.
+    check_every: int = 1
     # Annotate ops carry 1..len(annotate_keys) keys per op (PK>1
     # coverage for the kernels' prop-pair loops).
     multi_key_annotates: bool = False
@@ -52,7 +56,7 @@ def random_op_for(
     if r < cfg.insert_weight or length == 0:
         pos = rng.randint(0, length)
         n = rng.randint(1, cfg.max_insert_len)
-        text = "".join(rng.choice(string.ascii_lowercase) for _ in range(n))
+        text = "".join(rng.choices(string.ascii_lowercase, k=n))
         return client.insert_local(pos, text)
     r -= cfg.insert_weight
     start = rng.randint(0, length - 1)
@@ -112,12 +116,14 @@ def run_sharedstring_farm(cfg: FarmConfig) -> FarmResult:
             out = seqr.sequence(cid, per_client[cid].pop(0))
             assert isinstance(out, SequencedMessage), f"unexpected nack {out}"
             sequenced.append(out)
-        # Phase 3: drain to all clients in total order.
+        # Phase 3: drain to all clients in total order (clients are
+        # independent, so each takes the round as one batched apply).
         stream.extend(sequenced)
-        for m in sequenced:
-            for c in clients:
-                c.apply_msg(m)
+        for c in clients:
+            c.apply_msgs(sequenced)
         # Phase 4: convergence.
+        if (rnd + 1) % cfg.check_every and rnd + 1 != cfg.rounds:
+            continue
         texts = [c.get_text() for c in clients]
         assert all(t == texts[0] for t in texts), (
             f"round {rnd}: divergent texts (seed {cfg.seed}):\n"

@@ -1,0 +1,209 @@
+"""Interval collections: overlap search index, endpoint sidedness,
+per-key property merge, and randomized convergence vs an O(n) scalar
+model (reference intervalCollection.ts:958 findOverlappingIntervals,
+sequencePlace.ts sides, the interval propertyManager)."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds import StringFactory
+from fluidframework_tpu.dds.sequence import SIDE_AFTER, SIDE_BEFORE
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+
+def make_pair():
+    registry = ChannelRegistry([StringFactory()])
+    h = MultiClientHarness(
+        2, registry, channel_types=[("text", StringFactory.type_name)]
+    )
+    a = h.runtimes[0].get_datastore("default").get_channel("text")
+    b = h.runtimes[1].get_datastore("default").get_channel("text")
+    return h, a, b
+
+
+def naive_overlap(coll, start, end):
+    eng = coll.sequence.engine
+    out = []
+    for iv in coll:
+        s, e = iv.bounds(eng)
+        if s <= end and e >= start:
+            out.append(iv.interval_id)
+    return sorted(out)
+
+
+def test_overlap_index_matches_scan():
+    h, a, b = make_pair()
+    a.insert_text(0, "x" * 200)
+    h.process_all()
+    coll = a.get_interval_collection("c")
+    rng = random.Random(7)
+    for _ in range(60):
+        s = rng.randrange(0, 180)
+        e = min(199, s + rng.randrange(0, 40))
+        coll.add(s, e)
+    h.process_all()
+    for _ in range(100):
+        qs = rng.randrange(0, 200)
+        qe = min(199, qs + rng.randrange(0, 50))
+        got = sorted(
+            iv.interval_id
+            for iv in coll.find_overlapping_intervals(qs, qe)
+        )
+        assert got == naive_overlap(coll, qs, qe)
+
+
+def test_overlap_index_invalidates_on_edits():
+    h, a, b = make_pair()
+    a.insert_text(0, "abcdefghij")
+    h.process_all()
+    coll = a.get_interval_collection("c")
+    iv = coll.add(2, 5)
+    h.process_all()
+    assert [i.interval_id for i in coll.find_overlapping_intervals(2, 2)] == [
+        iv.interval_id
+    ]
+    # An edit BEFORE the interval shifts it; the index must rebuild.
+    a.insert_text(0, "ZZZZ")
+    h.process_all()
+    assert coll.find_overlapping_intervals(2, 2) == []
+    assert [i.interval_id for i in coll.find_overlapping_intervals(6, 6)] == [
+        iv.interval_id
+    ]
+
+
+def test_endpoint_sidedness_on_boundary_inserts():
+    """before-endpoints expand with boundary inserts; after-endpoints
+    do not (the reference's stickiness contract)."""
+    h, a, b = make_pair()
+    a.insert_text(0, "abcdef")
+    h.process_all()
+    coll = a.get_interval_collection("c")
+    exp = coll.add(2, 4, start_side=SIDE_BEFORE, end_side=SIDE_BEFORE)
+    fix = coll.add(2, 4, start_side=SIDE_AFTER, end_side=SIDE_AFTER)
+    h.process_all()
+    eng = a.engine
+    assert exp.bounds(eng) == (2, 4)
+    assert fix.bounds(eng) == (2, 4)
+    # Insert exactly at the end boundary (position 4).
+    b.insert_text(4, "XY")
+    h.process_all()
+    coll_b = b.get_interval_collection("c")
+    for coll_x, eng_x in ((coll, a.engine), (coll_b, b.engine)):
+        got = {
+            iv.interval_id: iv.bounds(eng_x) for iv in coll_x
+        }
+        # before-end anchored to the char at 4: pushed right (expands).
+        assert got[exp.interval_id] == (2, 6)
+        # after-end anchored to char 3: boundary insert lands outside.
+        assert got[fix.interval_id] == (2, 4)
+    # Insert exactly at the start boundary (position 2).
+    b.insert_text(2, "Q")
+    h.process_all()
+    eng = a.engine
+    exp2 = coll.get_interval_by_id(exp.interval_id)
+    fix2 = coll.get_interval_by_id(fix.interval_id)
+    # before-start anchored at char 2: pushed right (shrinks from left).
+    assert exp2.bounds(eng)[0] == 3
+    # after-start anchored to char 1: insert at 2 lands after it... the
+    # start stays put, absorbing the new text into the interval.
+    assert fix2.bounds(eng)[0] == 2
+
+
+def test_per_key_property_merge_lww():
+    h, a, b = make_pair()
+    a.insert_text(0, "hello world")
+    h.process_all()
+    ca = a.get_interval_collection("c")
+    cb = b.get_interval_collection("c")
+    iv = ca.add(0, 5, {"bold": 1, "color": "red"})
+    h.process_all()
+    # Concurrent per-key writes on DIFFERENT keys both land.
+    ca.change_properties(iv.interval_id, {"bold": 2})
+    cb.change_properties(iv.interval_id, {"color": "blue", "size": 9})
+    h.process_all()
+    pa = ca.get_interval_by_id(iv.interval_id).props
+    pb = cb.get_interval_by_id(iv.interval_id).props
+    assert pa == pb
+    assert pa["bold"] == 2  # a's write to bold survives b's batch
+    assert pa["color"] == "blue"
+    assert pa["size"] == 9
+    # None deletes converge.
+    cb.change_properties(iv.interval_id, {"size": None})
+    h.process_all()
+    assert "size" not in ca.get_interval_by_id(iv.interval_id).props
+    assert "size" not in cb.get_interval_by_id(iv.interval_id).props
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_interval_fuzz_convergence(seed):
+    """Random interleaving of text edits + interval add/change/delete/
+    props across two clients: resolved bounds, sides, and props
+    converge, and the indexed query always equals the O(n) model."""
+    h, a, b = make_pair()
+    a.insert_text(0, "0123456789" * 6)
+    h.process_all()
+    rng = random.Random(seed)
+    colls = [x.get_interval_collection("f") for x in (a, b)]
+    strings = [a, b]
+    for rnd in range(25):
+        for idx in (0, 1):
+            s_ch, coll = strings[idx], colls[idx]
+            for _ in range(3):
+                ln = s_ch.get_length()
+                r = rng.random()
+                if r < 0.30 or ln < 10:
+                    pos = rng.randrange(0, ln + 1)
+                    s_ch.insert_text(pos, "".join(
+                        rng.choices("abz", k=rng.randint(1, 4))
+                    ))
+                elif r < 0.45:
+                    st = rng.randrange(0, ln - 1)
+                    s_ch.remove_text(st, min(ln, st + rng.randint(1, 5)))
+                elif r < 0.70:
+                    st = rng.randrange(0, ln)
+                    en = min(ln - 1, st + rng.randrange(0, 12))
+                    coll.add(
+                        st, en,
+                        {"k": rng.randint(0, 9)},
+                        start_side=rng.choice([SIDE_BEFORE, SIDE_AFTER]),
+                        end_side=rng.choice([SIDE_BEFORE, SIDE_AFTER]),
+                    )
+                elif coll.intervals:
+                    iid = rng.choice(list(coll.intervals))
+                    rr = rng.random()
+                    if rr < 0.4:
+                        st = rng.randrange(0, ln)
+                        en = min(ln - 1, st + rng.randrange(0, 8))
+                        coll.change(iid, st, en)
+                    elif rr < 0.7:
+                        coll.change_properties(
+                            iid, {"k": rng.randint(0, 9),
+                                  "m": rng.choice([1, None])}
+                        )
+                    else:
+                        coll.remove_interval_by_id(iid)
+        h.process_all()
+        # Convergence of text + full interval state.
+        assert a.get_text() == b.get_text()
+        state = []
+        for s_ch, coll in zip(strings, colls):
+            eng = s_ch.engine
+            state.append(sorted(
+                (iv.interval_id, iv.bounds(eng), iv.start_side,
+                 iv.end_side, tuple(sorted(iv.props.items())))
+                for iv in coll
+            ))
+        assert state[0] == state[1], f"round {rnd} diverged"
+        # Indexed query == O(n) model on both replicas.
+        ln = a.get_length()
+        for _ in range(5):
+            qs = rng.randrange(0, max(ln, 1))
+            qe = min(ln, qs + rng.randrange(0, 20))
+            for coll in colls:
+                got = sorted(
+                    iv.interval_id
+                    for iv in coll.find_overlapping_intervals(qs, qe)
+                )
+                assert got == naive_overlap(coll, qs, qe)

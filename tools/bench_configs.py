@@ -60,7 +60,7 @@ def config1_sharedstring_2client(n_ops: int = 10_000) -> dict:
             FarmConfig(
                 num_clients=2, rounds=rounds, ops_per_client_per_round=10,
                 seed=1, check_annotations=False, annotate_weight=0.0,
-                insert_weight=0.6, remove_weight=0.4,
+                insert_weight=0.6, remove_weight=0.4, check_every=32,
             )
         )
 
