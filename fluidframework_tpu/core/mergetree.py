@@ -189,6 +189,10 @@ class MergeTreeEngine:
         self.local_seq = 0
         self.pending: deque[_PendingGroup] = deque()
         self.zamboni_enabled = True
+        # Bumped on structural changes that no (current_seq, local_seq)
+        # pair captures (rollback restores state without advancing
+        # either) — position-index caches key on it.
+        self.structure_version = 0
 
     # ---------------------------------------------------------------- load
 
@@ -375,14 +379,17 @@ class MergeTreeEngine:
             local_seq = self.local_seq
 
         marked: List[Segment] = []
+        marked_refs: List[tuple] = []  # (segment, index) for sliding
         pos = 0
-        for seg in self.segments:
+        for seg_i, seg in enumerate(self.segments):
             if pos >= end:
                 break
             cat, length = self._vis(seg, ref_seq, client_id)
             if cat == VisCategory.SKIP or length == 0:
                 continue
             if pos >= start:  # boundary splits guarantee full containment
+                if seg.refs:
+                    marked_refs.append((seg, seg_i))
                 if seg.removed_seq is not None:
                     if seg.removed_seq == UNASSIGNED_SEQ:
                         # Our pending local remove lost the race: the
@@ -415,34 +422,47 @@ class MergeTreeEngine:
             # visible neighborhood is convergent — sliding later (at
             # zamboni) would race replica-local pending inserts adjacent
             # to the tombstone and anchor different characters.
-            for s in marked:
+            for s, i in marked_refs:
                 if s.refs:
-                    self._slide_refs_off(s)
+                    self._slide_refs_off(s, hint_index=i)
         return marked
 
-    def _slide_refs_off(self, seg: Segment) -> None:
+    def _slide_refs_off(
+        self, seg: Segment, hint_index: Optional[int] = None
+    ) -> None:
         """Move `seg`'s references to the start of the next segment
-        that is not removed at all (acked or pending); document end if
-        none. Pending-removed targets re-slide when their own removal
-        sequences, so fully-acked replicas always converge."""
+        that is neither acked-removed nor a replica-local pending
+        insert; document end if none. The target set is exactly the
+        segments every replica agrees exist at this total-order point:
+        pending-REMOVED segments are included (alive on other
+        replicas; when their removal sequences, every replica —
+        including this one — re-slides them together), pending local
+        INSERTS are excluded (they exist only here). This keeps
+        fully-acked replicas convergent."""
         refs, seg.refs = seg.refs, []
         if not refs:
             return
-        try:
-            i = self.segments.index(seg)
-        except ValueError:
-            i = len(self.segments)
+        if hint_index is not None and (
+            hint_index < len(self.segments)
+            and self.segments[hint_index] is seg
+        ):
+            i = hint_index
+        else:
+            try:
+                i = self.segments.index(seg)
+            except ValueError:
+                i = len(self.segments)
         target: Optional[Segment] = None
         for s in self.segments[i + 1:]:
-            # Skip pending local inserts (seq UNASSIGNED): they exist
-            # only on this replica, and anchoring to one would diverge
-            # from replicas that slide before seeing it sequenced.
             if (
-                s.removed_seq is None and len(s.content) > 0
-                and s.seq != UNASSIGNED_SEQ
+                s.removed_seq != UNASSIGNED_SEQ
+                and s.removed_seq is not None
             ):
-                target = s
-                break
+                continue  # acked tombstone: gone everywhere
+            if s.seq == UNASSIGNED_SEQ or len(s.content) == 0:
+                continue  # pending local insert: exists only here
+            target = s
+            break
         for r in refs:
             r.segment = target
             r.offset = 0
@@ -572,6 +592,7 @@ class MergeTreeEngine:
         assert self.pending and self.pending[-1] is grp, (
             "rollback out of order: only the newest pending op can roll back"
         )
+        self.structure_version += 1
         self.pending.pop()
         for s in grp.segments:
             s.groups = [g for g in s.groups if g is not grp]

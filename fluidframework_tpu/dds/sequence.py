@@ -618,7 +618,10 @@ class IntervalCollection:
         sorted-endpoint index — O(log n + candidates) per query
         between mutations, not an O(n) interval scan."""
         eng = self.sequence.engine
-        key = (eng.current_seq, eng.local_seq, self._mutations)
+        key = (
+            eng.current_seq, eng.local_seq,
+            getattr(eng, "structure_version", 0), self._mutations,
+        )
         if self._index_key != key:
             self._index.rebuild(self.intervals, eng)
             self._index_key = key

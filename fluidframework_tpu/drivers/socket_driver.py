@@ -30,12 +30,15 @@ from ..protocol.messages import DocumentMessage, NackMessage, SequencedMessage
 class _Rpc:
     """One request/response exchange over a fresh socket."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, auth: Optional[dict] = None):
         self.host, self.port = host, port
+        self.auth = auth
 
     def call(self, **req) -> Any:
         from ..server.framing import read_frame, write_frame
 
+        if self.auth:
+            req.update(self.auth)
         with socket.create_connection((self.host, self.port)) as s:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             f = s.makefile("rwb")
@@ -53,7 +56,9 @@ class _SocketConnection:
     """A live delta connection (long-lived socket + reader thread)."""
 
     def __init__(self, host: str, port: int, doc_id: str,
-                 client_id: Optional[int]):
+                 client_id: Optional[int], auth: Optional[dict] = None):
+        self._auth = auth
+        self._doc_id = doc_id
         self._sock = socket.create_connection((host, port))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._file = self._sock.makefile("rwb")
@@ -88,6 +93,9 @@ class _SocketConnection:
     # --------------------------------------------------------- framing
 
     def _call(self, **req) -> Any:
+        if self._auth:
+            req.update(self._auth)
+            req.setdefault("docId", self._doc_id)
         with self._resp_cond:
             self._req_id += 1
             rid = self._req_id
@@ -284,9 +292,17 @@ class _SocketConnection:
 class SocketDriver:
     """Driver surface over TCP (create/load/connect/ops_from/blobs)."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 tenant_id: Optional[str] = None,
+                 token: Optional[str] = None):
+        """`tenant_id`/`token`: riddler credentials (signed per-document
+        token; see server.riddler.sign_token) attached to every
+        request when the server runs with a TenantManager."""
         self.host, self.port = host, port
-        self._rpc = _Rpc(host, port)
+        self._auth = (
+            {"tenantId": tenant_id, "token": token} if token else None
+        )
+        self._rpc = _Rpc(host, port, self._auth)
 
     def create_document(self, doc_id: str, summary_wire: str) -> None:
         self._rpc.call(cmd="create_document", docId=doc_id, summary=summary_wire)
@@ -295,7 +311,9 @@ class SocketDriver:
         return self._rpc.call(cmd="load_document", docId=doc_id)
 
     def connect(self, doc_id: str, client_id: Optional[int] = None):
-        return _SocketConnection(self.host, self.port, doc_id, client_id)
+        return _SocketConnection(
+            self.host, self.port, doc_id, client_id, self._auth
+        )
 
     def ops_from(self, doc_id: str, from_seq: int,
                  to_seq: Optional[int] = None) -> List[SequencedMessage]:

@@ -32,6 +32,11 @@ from .channel import ChannelRegistry
 from .datastore import DataStoreRuntime
 from .summary import SummaryTree, SummaryTreeBuilder
 
+# The summary wire-format version this runtime writes; `load` reads
+# every version from 1 up to it (tests/test_snapshot_compat.py pins
+# fixtures produced by earlier rounds).
+SUMMARY_FORMAT_VERSION = 2
+
 
 
 @dataclass
@@ -553,7 +558,7 @@ class ContainerRuntime(EventEmitter):
             self._emit("op", msg, local)
             return
         ds = self.datastores.get(outer["address"])
-        if ds is None or inner["address"] not in ds.channels:
+        if ds is None or not ds.has_channel(inner["address"]):
             node = f"/{outer['address']}" if ds is None else (
                 f"/{outer['address']}/{inner['address']}"
             )
@@ -601,7 +606,7 @@ class ContainerRuntime(EventEmitter):
         if local:
             return  # we created it
         ds = self.datastores.get(datastore_id)
-        if ds is None or attach["channel"] in ds.channels:
+        if ds is None or ds.has_channel(attach["channel"]):
             return
         from .channel import ChannelAttributes, ChannelServices, ChannelStorage
 
@@ -643,6 +648,12 @@ class ContainerRuntime(EventEmitter):
         builder.add_json_blob(
             ".metadata",
             {
+                # Summary wire-format version (the back-compat
+                # contract, reference summaryFormat.md /
+                # snapshotV1.ts:30): bumped ONLY with a loader that
+                # still reads every older version; pinned fixtures in
+                # tests/fixtures are booted by test_snapshot_compat.
+                "formatVersion": SUMMARY_FORMAT_VERSION,
                 "sequenceNumber": self.current_seq,
                 "minimumSequenceNumber": self.min_seq,
                 "datastores": {
@@ -666,6 +677,12 @@ class ContainerRuntime(EventEmitter):
         import json as _json
 
         meta = _json.loads(summary.get_blob(".metadata"))
+        ver = meta.get("formatVersion", 1)
+        if not 1 <= ver <= SUMMARY_FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported summary format version {ver} "
+                f"(this loader reads 1..{SUMMARY_FORMAT_VERSION})"
+            )
         self.current_seq = meta["sequenceNumber"]
         self.min_seq = meta["minimumSequenceNumber"]
         roots = meta.get("datastores", {})

@@ -47,6 +47,15 @@ class DataStoreRuntime:
         self.registry = registry
         self._submit_fn = submit_fn
         self.channels: Dict[str, SharedObject] = {}
+        # Channels loaded from a summary but not yet materialized:
+        # cid -> (type_name, SummaryTree). Ops for them queue in
+        # _pending_channel_ops until first access (the
+        # RemoteChannelContext lazy-load contract,
+        # remoteChannelContext.ts:39,131 / snapshotV1.ts:31-37): a
+        # container boots and catches up touching only channel
+        # HEADERS-worth of work; bodies parse on first read.
+        self._unrealized: Dict[str, tuple] = {}
+        self._pending_channel_ops: Dict[str, list] = {}
         self._local_metadata: Dict[str, Any] = {}
         self.connected = False
         # Back-reference to the hosting container runtime (None when
@@ -81,7 +90,40 @@ class DataStoreRuntime:
         return ch
 
     def get_channel(self, channel_id: str) -> SharedObject:
+        if channel_id not in self.channels and channel_id in self._unrealized:
+            self._realize(channel_id)
         return self.channels[channel_id]
+
+    def has_channel(self, channel_id: str) -> bool:
+        return channel_id in self.channels or channel_id in self._unrealized
+
+    @property
+    def realized_channels(self) -> list:
+        """Materialized channel ids (unrealized ones queue their ops)."""
+        return sorted(self.channels)
+
+    def _realize(self, channel_id: str) -> None:
+        """Materialize a lazily-loaded channel and replay its queued
+        ops (RemoteChannelContext.getChannel → load + pending apply,
+        remoteChannelContext.ts:131)."""
+        tname, node = self._unrealized.pop(channel_id)
+        storage = ChannelStorage(
+            {
+                k: v
+                for k, v in node.flatten().items()
+                if k != ATTRIBUTES_BLOB
+            }
+        )
+        services = ChannelServices(self._connection_for(channel_id), storage)
+        factory = self.registry.get(tname)
+        ch = factory.load(
+            self, channel_id, services, ChannelAttributes(type=tname)
+        )
+        self.channels[channel_id] = ch
+        if self.client_id is not None:
+            ch.on_connected()
+        for msg, local, md in self._pending_channel_ops.pop(channel_id, []):
+            ch.services.delta_connection.process(msg, local, md)
 
     def _connection_for(self, channel_id: str) -> DeltaConnection:
         return DeltaConnection(
@@ -115,23 +157,30 @@ class DataStoreRuntime:
     def process(self, channel_id: str, msg: SequencedMessage, local: bool,
                 local_metadata: Any) -> None:
         """Route one sequenced channel op (dataStoreRuntime.ts:591
-        process → channel delta handler)."""
+        process → channel delta handler). Ops for unrealized channels
+        queue until first access — catch-up never forces a body parse
+        (remoteChannelContext.ts:131)."""
+        if channel_id not in self.channels and channel_id in self._unrealized:
+            self._pending_channel_ops.setdefault(channel_id, []).append(
+                (msg, local, local_metadata)
+            )
+            return
         ch = self.channels[channel_id]
         assert ch.services is not None, f"channel {channel_id} not attached"
         ch.services.delta_connection.process(msg, local, local_metadata)
 
     def resubmit(self, channel_id: str, content: Any, local_metadata: Any) -> None:
-        ch = self.channels[channel_id]
+        ch = self.get_channel(channel_id)
         assert ch.services is not None
         ch.services.delta_connection.resubmit(content, local_metadata)
 
     def rollback(self, channel_id: str, content: Any, local_metadata: Any) -> None:
-        ch = self.channels[channel_id]
+        ch = self.get_channel(channel_id)
         assert ch.services is not None
         ch.services.delta_connection.rollback(content, local_metadata)
 
     def apply_stashed_op(self, channel_id: str, content: Any) -> Any:
-        ch = self.channels[channel_id]
+        ch = self.get_channel(channel_id)
         assert ch.services is not None
         return ch.services.delta_connection.apply_stashed_op(content)
 
@@ -149,6 +198,15 @@ class DataStoreRuntime:
             if self.container is not None
             else {}
         )
+        # Unrealized channels with queued ops must materialize to
+        # summarize; clean ones reuse their loaded subtree verbatim
+        # (they cannot have changed — the incremental-summary fast
+        # path for never-touched channels).
+        for cid in list(self._unrealized):
+            if self._pending_channel_ops.get(cid):
+                self._realize(cid)
+        for cid, (tname, node) in self._unrealized.items():
+            builder.add_tree(cid, node)
         for cid, ch in self.channels.items():
             key = (self.id, cid)
             change_seq = change_seqs.get(key, 0)
@@ -173,23 +231,13 @@ class DataStoreRuntime:
         return builder.summary
 
     def load(self, summary: SummaryTree) -> None:
-        """Rehydrate every channel from a datastore summary subtree
-        (the RemoteChannelContext lazy-load path, remoteChannelContext.ts:39 —
-        eager here; laziness is an optimization, not semantics)."""
+        """Register every channel from a datastore summary subtree
+        WITHOUT materializing it (the RemoteChannelContext lazy-load
+        path, remoteChannelContext.ts:39): boot reads one attributes
+        blob per channel; bodies parse on first `get_channel`, and
+        catch-up ops queue per channel until then."""
         for cid, node in summary.entries.items():
             assert isinstance(node, SummaryTree), f"unexpected blob {cid}"
             attrs = json.loads(node.get_blob(ATTRIBUTES_BLOB))
-            factory = self.registry.get(attrs["type"])
-            storage = ChannelStorage(
-                {
-                    k: v
-                    for k, v in node.flatten().items()
-                    if k != ATTRIBUTES_BLOB
-                }
-            )
-            services = ChannelServices(self._connection_for(cid), storage)
-            ch = factory.load(
-                self, cid, services, ChannelAttributes(type=attrs["type"])
-            )
-            self.channels[cid] = ch
+            self._unrealized[cid] = (attrs["type"], node)
         self.connected = True

@@ -100,6 +100,11 @@ class GarbageCollector:
         roots: List[str] = []
         for did, ds in self.runtime.datastores.items():
             ds_node = f"/{did}"
+            # GC must see every channel's outbound handles; realize
+            # lazily-loaded ones (the reference's GC likewise walks
+            # full gc data on the summarizer cadence).
+            for cid in list(getattr(ds, "_unrealized", ())):
+                ds.get_channel(cid)
             ch_nodes = [f"/{did}/{cid}" for cid in ds.channels]
             graph[ds_node] = list(ch_nodes)  # a live datastore refs its channels
             if getattr(ds, "is_root", True):
@@ -169,7 +174,10 @@ class GarbageCollector:
                     deleted.append(node)  # went down with its datastore
                     continue
                 ds = self.runtime.datastores.get(parts[0])
-                if ds is not None and ds.channels.pop(parts[1], None) is not None:
+                if ds is not None and (
+                    ds.channels.pop(parts[1], None) is not None
+                    or ds._unrealized.pop(parts[1], None) is not None
+                ):
                     deleted.append(node)
         for node in deleted:
             self.unreferenced_since.pop(node, None)

@@ -62,7 +62,11 @@ def approx_wire_size(obj: Any, budget: int) -> int:
         return 32
     if isinstance(obj, str):
         if obj.isascii():
-            return 2 + 2 * len(obj)  # escaping can at most double
+            if obj.isprintable():
+                # Printable ASCII escapes only \ and " (2 bytes each).
+                return 2 + 2 * len(obj)
+            # Control chars render as \u00XX (6 bytes/char).
+            return 2 + 6 * len(obj)
         # ensure_ascii renders non-ASCII as \uXXXX (6 bytes/char;
         # surrogate pairs 12, still <= 12*len).
         return 2 + 12 * len(obj)

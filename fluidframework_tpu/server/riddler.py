@@ -1,0 +1,151 @@
+"""Tenant and token management: the riddler role.
+
+Mirrors the reference's tenant manager + alfred token validation
+(server/routerlicious/packages/routerlicious-base/src/riddler/
+tenantManager.ts; token check at lambdas/src/alfred/index.ts:595):
+every tenant owns a shared signing key; clients present a signed
+token scoped to (tenant, document, scopes, expiry); the front door
+validates before any connect/submit/storage access.
+
+Tokens are compact HMAC-SHA256 JWTs (header.payload.signature,
+base64url) — the reference signs with jsonwebtoken/HS256; this is the
+same construction from the standard library.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import secrets
+import time
+from typing import Dict, List, Optional
+
+SCOPE_READ = "doc:read"
+SCOPE_WRITE = "doc:write"
+
+# Command -> required scope at the socket front door. Anything not
+# listed requires a valid token with any scope.
+WRITE_CMDS = {"create_document", "upload_blob", "submit", "submit_batch",
+              "connect"}
+READ_CMDS = {"load_document", "ops_from", "read_blob", "catch_up"}
+
+
+class AuthError(Exception):
+    """Token/tenant validation failure (alfred nacks these)."""
+
+
+def _b64(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _unb64(data: str) -> bytes:
+    pad = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + pad)
+
+
+def sign_token(
+    key: str,
+    tenant_id: str,
+    document_id: str,
+    scopes: List[str],
+    user: Optional[dict] = None,
+    lifetime_s: float = 3600.0,
+    now: Optional[float] = None,
+) -> str:
+    """HS256 JWT for (tenant, document) — the reference's
+    generateToken (services-utils) shape."""
+    now = time.time() if now is None else now
+    header = {"alg": "HS256", "typ": "JWT"}
+    payload = {
+        "tenantId": tenant_id,
+        "documentId": document_id,
+        "scopes": list(scopes),
+        "user": user or {"id": "anonymous"},
+        "iat": int(now),
+        "exp": int(now + lifetime_s),
+    }
+    signing = (
+        _b64(json.dumps(header, sort_keys=True).encode())
+        + "."
+        + _b64(json.dumps(payload, sort_keys=True).encode())
+    )
+    sig = hmac.new(key.encode(), signing.encode(), hashlib.sha256).digest()
+    return signing + "." + _b64(sig)
+
+
+class TenantManager:
+    """Tenant registry + token validation (riddler/tenantManager.ts)."""
+
+    def __init__(self):
+        self._tenants: Dict[str, str] = {}
+
+    def create_tenant(self, tenant_id: str, key: Optional[str] = None) -> str:
+        """Register a tenant; returns its signing key."""
+        if tenant_id in self._tenants:
+            raise ValueError(f"tenant {tenant_id!r} exists")
+        key = key or secrets.token_hex(16)
+        self._tenants[tenant_id] = key
+        return key
+
+    def get_key(self, tenant_id: str) -> str:
+        try:
+            return self._tenants[tenant_id]
+        except KeyError:
+            raise AuthError(f"unknown tenant {tenant_id!r}") from None
+
+    def has_tenant(self, tenant_id: str) -> bool:
+        return tenant_id in self._tenants
+
+    def validate_token(
+        self,
+        token: str,
+        tenant_id: str,
+        document_id: Optional[str] = None,
+        now: Optional[float] = None,
+    ) -> dict:
+        """Verify signature, tenant binding, document binding, and
+        expiry; returns the claims (alfred/index.ts:595 +
+        verifyToken, services-utils/src/auth.ts)."""
+        key = self.get_key(tenant_id)
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthError("malformed token")
+        signing = parts[0] + "." + parts[1]
+        want = hmac.new(
+            key.encode(), signing.encode(), hashlib.sha256
+        ).digest()
+        if not hmac.compare_digest(want, _unb64(parts[2])):
+            raise AuthError("bad token signature")
+        try:
+            claims = json.loads(_unb64(parts[1]))
+        except ValueError:
+            raise AuthError("malformed token payload") from None
+        if claims.get("tenantId") != tenant_id:
+            raise AuthError("token tenant mismatch")
+        if document_id is not None and claims.get("documentId") != document_id:
+            raise AuthError("token document mismatch")
+        now = time.time() if now is None else now
+        if now >= float(claims.get("exp", 0)):
+            raise AuthError("token expired")
+        return claims
+
+    def authorize_command(
+        self,
+        cmd: str,
+        token: Optional[str],
+        tenant_id: Optional[str],
+        document_id: Optional[str],
+    ) -> dict:
+        """Front-door gate for one socket command: validates the token
+        and checks its scopes cover the command's access class."""
+        if not token or not tenant_id:
+            raise AuthError("missing tenant credentials")
+        claims = self.validate_token(token, tenant_id, document_id)
+        scopes = set(claims.get("scopes") or ())
+        if cmd in WRITE_CMDS and SCOPE_WRITE not in scopes:
+            raise AuthError(f"scope {SCOPE_WRITE} required for {cmd}")
+        if cmd in READ_CMDS and not scopes & {SCOPE_READ, SCOPE_WRITE}:
+            raise AuthError(f"scope {SCOPE_READ} required for {cmd}")
+        return claims

@@ -176,6 +176,13 @@ class _Session(socketserver.StreamRequestHandler):
     def _dispatch(self, srv: "SocketDeltaServer", req: dict, conn):
         cmd = req["cmd"]
         ls = srv.local_server
+        if srv.tenants is not None:
+            # Riddler gate: signed token bound to (tenant, document),
+            # scopes checked per command class (alfred/index.ts:595).
+            srv.tenants.authorize_command(
+                cmd, req.get("token"), req.get("tenantId"),
+                req.get("docId"),
+            )
         with srv.lock:
             if cmd == "create_document":
                 handle = ls.upload_summary(req["summary"])
@@ -240,8 +247,17 @@ class SocketDeltaServer:
     """Serve a LocalServer over TCP (the LocalDeltaConnectionServer →
     network door step)."""
 
-    def __init__(self, local_server, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, local_server, host: str = "127.0.0.1", port: int = 0,
+                 tenants=None):
+        """`tenants`: an optional `server.riddler.TenantManager`. When
+        set, EVERY command must carry valid tenant credentials
+        (tenantId + signed token bound to the document, with scopes
+        covering the command) — the alfred token gate
+        (alfred/index.ts:595); failures surface as error responses
+        (the auth-nack path). When None the server is open, the
+        tinylicious-style dev mode."""
         self.local_server = local_server
+        self.tenants = tenants
         self.lock = threading.RLock()
         self._tcp = _TCPServer((host, port), _Session)
         self._tcp.owner = self  # type: ignore

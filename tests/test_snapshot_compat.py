@@ -1,0 +1,86 @@
+"""Back-compat corpus: boot every PINNED summary fixture + op tail.
+
+The packages/test/snapshots role: tests/fixtures/summary_v*.json were
+produced by earlier code (tools/make_compat_fixture.py at the round
+that introduced each format version) and are never regenerated — a
+loader change that cannot boot an old summary, or a DDS change that
+replays its op tail differently, fails here.
+"""
+
+import glob
+import json
+import os
+
+import pytest
+
+from fluidframework_tpu.dds import MapFactory, MatrixFactory, StringFactory
+from fluidframework_tpu.drivers.file_driver import message_from_json
+from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+from fluidframework_tpu.runtime.container_runtime import (
+    SUMMARY_FORMAT_VERSION,
+)
+from fluidframework_tpu.runtime.summary import SummaryTree
+
+FIXTURES = sorted(
+    glob.glob(
+        os.path.join(os.path.dirname(__file__), "fixtures", "summary_v*.json")
+    )
+)
+
+
+def registry():
+    return ChannelRegistry([MapFactory(), StringFactory(), MatrixFactory()])
+
+
+def test_corpus_exists_and_covers_current_version():
+    assert FIXTURES, "no pinned summary fixtures"
+    versions = [json.load(open(p))["formatVersion"] for p in FIXTURES]
+    assert SUMMARY_FORMAT_VERSION in versions, (
+        "current summary format has no pinned fixture — run "
+        "tools/make_compat_fixture.py and check the output in"
+    )
+
+
+@pytest.mark.parametrize("path", FIXTURES, ids=os.path.basename)
+def test_boot_pinned_fixture(path):
+    with open(path) as f:
+        fx = json.load(f)
+    rt = ContainerRuntime(registry())
+    rt.load(SummaryTree.from_json(fx["wire"]))
+    assert rt.current_seq == fx["summarySeq"]
+    # Replay the recorded post-summary op tail (catch-up).
+    for row in fx["tail"]:
+        rt.process(message_from_json(row))
+    ds = rt.get_datastore("default")
+    expect = fx["expect"]
+    assert ds.get_channel("text").get_text() == expect["text"]
+    kv = ds.get_channel("kv")
+    for k, v in expect["kv"].items():
+        assert kv.get(k) == v
+    grid = ds.get_channel("grid")
+    for key, v in expect["grid_cells"].items():
+        r, c = map(int, key.split(","))
+        assert grid.get_cell(r, c) == v
+
+
+def test_future_format_version_refused():
+    with open(FIXTURES[-1]) as f:
+        fx = json.load(f)
+    tree = SummaryTree.from_json(fx["wire"])
+    meta = json.loads(tree.get_blob(".metadata"))
+    meta["formatVersion"] = SUMMARY_FORMAT_VERSION + 1
+    # Rebuild the tree with a bumped version: the loader must refuse
+    # rather than misread a future format.
+    from fluidframework_tpu.runtime.summary import SummaryTreeBuilder
+
+    b = SummaryTreeBuilder()
+    for name, node in tree.entries.items():
+        if name == ".metadata":
+            b.add_json_blob(".metadata", meta)
+        elif isinstance(node, SummaryTree):
+            b.add_tree(name, node)
+        else:
+            b.add_blob(name, node)
+    rt = ContainerRuntime(registry())
+    with pytest.raises(ValueError, match="unsupported summary format"):
+        rt.load(b.summary)

@@ -187,3 +187,116 @@ def test_rpc_from_event_callback_does_not_deadlock(server_process):
                              contents={"k": 2}))
     assert wait_until(lambda: done), "disconnect from callback deadlocked"
     b.disconnect()
+
+
+# ---------------------------------------------------------------------------
+# Auth/tenancy (the riddler role + alfred token gate, riddler/
+# tenantManager.ts, alfred/index.ts:595)
+# ---------------------------------------------------------------------------
+
+TENANT, KEY = "acme", "s3cret-key"
+
+
+@pytest.fixture()
+def secure_server():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "socket_server_main.py"),
+         "--tenant", f"{TENANT}:{KEY}"],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    _, host, port = line.split()
+    yield host, int(port)
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _token(doc, scopes=None, key=KEY, tenant=TENANT, lifetime=3600.0):
+    from fluidframework_tpu.server.riddler import (
+        SCOPE_READ, SCOPE_WRITE, sign_token,
+    )
+
+    return sign_token(
+        key, tenant, doc,
+        scopes if scopes is not None else [SCOPE_READ, SCOPE_WRITE],
+        lifetime_s=lifetime,
+    )
+
+
+def test_unauthenticated_connect_refused(secure_server):
+    host, port = secure_server
+    loader = Loader(SocketDriver(host, port), REGISTRY)
+    c = loader.create_detached()
+    c.runtime.create_datastore("default").create_channel(
+        "s", StringFactory.type_name
+    )
+    with pytest.raises(RuntimeError, match="missing tenant credentials"):
+        c.attach(doc_id="doc1")
+
+
+def test_authenticated_flow_and_token_binding(secure_server):
+    host, port = secure_server
+    doc = "doc-auth"
+    drv = SocketDriver(host, port, tenant_id=TENANT, token=_token(doc))
+    loader = Loader(drv, REGISTRY)
+    c = loader.create_detached()
+    c.runtime.create_datastore("default").create_channel(
+        "s", StringFactory.type_name
+    )
+    c.attach(doc_id=doc)
+    chan(c).insert_text(0, "hi")
+    c.runtime.flush()
+
+    # Second client with its own valid token converges.
+    drv2 = SocketDriver(host, port, tenant_id=TENANT, token=_token(doc))
+    l2 = Loader(drv2, REGISTRY)
+    c2 = l2.resolve(doc)
+    assert wait_until(lambda: chan(c2).get_text() == "hi")
+
+    # A token bound to ANOTHER document is refused.
+    bad = SocketDriver(host, port, tenant_id=TENANT,
+                       token=_token("other-doc"))
+    with pytest.raises(RuntimeError, match="token document mismatch"):
+        bad.load_document(doc)
+    # Wrong signing key is refused.
+    forged = SocketDriver(host, port, tenant_id=TENANT,
+                          token=_token(doc, key="wrong-key"))
+    with pytest.raises(RuntimeError, match="bad token signature"):
+        forged.load_document(doc)
+    # Unknown tenant is refused.
+    ghost = SocketDriver(host, port, tenant_id="ghost",
+                         token=_token(doc, tenant="ghost"))
+    with pytest.raises(RuntimeError, match="unknown tenant"):
+        ghost.load_document(doc)
+    # Expired token is refused.
+    stale = SocketDriver(host, port, tenant_id=TENANT,
+                         token=_token(doc, lifetime=-5.0))
+    with pytest.raises(RuntimeError, match="token expired"):
+        stale.load_document(doc)
+
+
+def test_read_scope_cannot_write(secure_server):
+    from fluidframework_tpu.server.riddler import SCOPE_READ
+
+    host, port = secure_server
+    doc = "doc-ro"
+    rw = SocketDriver(host, port, tenant_id=TENANT, token=_token(doc))
+    loader = Loader(rw, REGISTRY)
+    c = loader.create_detached()
+    c.runtime.create_datastore("default").create_channel(
+        "s", StringFactory.type_name
+    )
+    c.attach(doc_id=doc)
+
+    ro = SocketDriver(host, port, tenant_id=TENANT,
+                      token=_token(doc, scopes=[SCOPE_READ]))
+    # Reads work...
+    assert ro.load_document(doc) is not None
+    assert ro.ops_from(doc, 0) is not None
+    # ...writes are refused (connect is a write: it joins the quorum).
+    with pytest.raises(RuntimeError, match="doc:write required"):
+        ro.connect(doc)
+    with pytest.raises(RuntimeError, match="doc:write required"):
+        ro.upload_blob(doc, b"x")

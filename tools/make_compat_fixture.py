@@ -1,0 +1,106 @@
+"""Produce a pinned summary+op-tail fixture for the back-compat
+corpus (the packages/test/snapshots role).
+
+Runs a deterministic two-client session over the runtime stack,
+summarizes MID-SESSION, records the post-summary op tail, and writes
+tests/fixtures/summary_v{N}.json with the expected final state. The
+fixture is CHECKED IN; tests/test_snapshot_compat.py boots every
+pinned fixture forever after — a loader change that cannot boot an old
+round's summary + tail fails CI.
+
+Usage: python tools/make_compat_fixture.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.dds import (  # noqa: E402
+    MapFactory,
+    MatrixFactory,
+    StringFactory,
+)
+from fluidframework_tpu.runtime import ChannelRegistry  # noqa: E402
+from fluidframework_tpu.runtime.container_runtime import (  # noqa: E402
+    SUMMARY_FORMAT_VERSION,
+)
+from fluidframework_tpu.drivers.file_driver import (  # noqa: E402
+    message_to_json,
+)
+from fluidframework_tpu.testing.mocks import MultiClientHarness  # noqa: E402
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures",
+)
+
+
+def registry() -> ChannelRegistry:
+    return ChannelRegistry([MapFactory(), StringFactory(), MatrixFactory()])
+
+
+def main() -> None:
+    h = MultiClientHarness(
+        2, registry(),
+        channel_types=[
+            ("text", StringFactory.type_name),
+            ("kv", MapFactory.type_name),
+            ("grid", MatrixFactory.type_name),
+        ],
+    )
+    a = h.runtimes[0].get_datastore("default")
+    text, kv, grid = (
+        a.get_channel("text"), a.get_channel("kv"), a.get_channel("grid")
+    )
+    text.insert_text(0, "hello world")
+    text.annotate_range(0, 5, {"bold": 1})
+    kv.set("k1", "v1")
+    kv.set("k2", [1, 2, 3])
+    grid.insert_rows(0, 4)
+    grid.insert_cols(0, 4)
+    grid.set_cell(1, 2, 42)
+    h.process_all()
+    b = h.runtimes[1].get_datastore("default")
+    b.get_channel("text").insert_text(5, ", brave")
+    b.get_channel("kv").set("k3", {"nested": True})
+    h.process_all()
+
+    wire = h.runtimes[0].summarize().to_json()
+    summary_seq = h.runtimes[0].current_seq
+
+    # Post-summary tail: more edits, recorded as sequenced messages.
+    text.insert_text(0, ">> ")
+    grid.set_cell(3, 3, 99)
+    b.get_channel("text").remove_text(3, 5)
+    h.process_all()
+    tail = [
+        message_to_json(m)
+        for m in h.service.ops_from("doc", summary_seq)
+    ]
+
+    fixture = {
+        "formatVersion": SUMMARY_FORMAT_VERSION,
+        "summarySeq": summary_seq,
+        "wire": wire,
+        "tail": tail,
+        "expect": {
+            "text": text.get_text(),
+            "kv": {"k1": "v1", "k2": [1, 2, 3], "k3": {"nested": True}},
+            "grid_cells": {"1,2": 42, "3,3": 99},
+        },
+    }
+    os.makedirs(FIXTURES, exist_ok=True)
+    path = os.path.join(
+        FIXTURES, f"summary_v{SUMMARY_FORMAT_VERSION}.json"
+    )
+    with open(path, "w") as f:
+        json.dump(fixture, f, indent=1, sort_keys=True)
+    print(f"wrote {path} (text={fixture['expect']['text']!r})")
+
+
+if __name__ == "__main__":
+    main()

@@ -30,9 +30,20 @@ def main() -> None:
         i = args.index("--storage-dir")
         storage_dir = args[i + 1]
         del args[i: i + 2]
+    tenants = None
+    while "--tenant" in args:
+        # --tenant id:key enables the riddler gate (repeatable); every
+        # request must then carry a signed per-document token.
+        from fluidframework_tpu.server.riddler import TenantManager
+
+        i = args.index("--tenant")
+        tid, key = args[i + 1].split(":", 1)
+        del args[i: i + 2]
+        tenants = tenants or TenantManager()
+        tenants.create_tenant(tid, key)
     port = int(args[0]) if args else 0
     srv = SocketDeltaServer(
-        LocalServer(persist_dir=storage_dir), port=port
+        LocalServer(persist_dir=storage_dir), port=port, tenants=tenants
     ).start()
     print(f"LISTENING {srv.host} {srv.port}", flush=True)
     try:
