@@ -406,6 +406,11 @@ def _gap_over_base(index: int, path, field, base: dict,
     if base["type"] == "move":
         rm, ins = _move_parts(base)
         idx = index
+        # The gap's ORIGINAL adjacency to the moved block: a gap
+        # hugging the block keeps its side when the attach lands on
+        # it (in particular, a same-field no-op move shifts nothing);
+        # only coincidental ties fall back to sequencing order.
+        adjacency = None
         if _same_field(path, field, rm):
             b, n = base["index"], base["count"]
             if b < idx < b + n:
@@ -417,14 +422,23 @@ def _gap_over_base(index: int, path, field, base: dict,
                     _dst_path_post(base),
                     base["dst_field"],
                 )
+            if idx == b:
+                adjacency = "before"
+            elif idx == b + n:
+                adjacency = "after"
             idx = _adjust_index(idx, rm, is_insert_at=True,
                                 base_first=base_first)
         if _same_field(path, field, ins):
             # Both gaps are now in the post-detach frame (ins.index is
             # the converted attach gap), so ties compare exactly.
             b, n = ins["index"], base["count"]
-            if b < idx or (b == idx and base_first):
+            if b < idx:
                 idx = idx + n
+            elif b == idx:
+                if adjacency == "after":
+                    idx = idx + n
+                elif adjacency is None and base_first:
+                    idx = idx + n
         return idx, path, field
     if _same_field(path, field, base):
         return (
@@ -436,10 +450,20 @@ def _gap_over_base(index: int, path, field, base: dict,
 
 
 def _is_noop_move(m: dict) -> bool:
-    """A move whose destination lies inside its own moved range (a
-    self-cycle): applies as a no-op on every replica."""
+    """A move that applies as a no-op on every replica: a self-cycle
+    (destination inside its own moved nodes), or a same-field identity
+    (destination gap touching or inside its own source range — detach
+    + reattach at the same spot). Canonicalizing these matters for
+    convergence: an identity move's numeric gap would otherwise
+    tie-break against concurrent attaches direction-dependently."""
     if m.get("type") != "move":
         return False
+    if (
+        m["dst_path"] == m["path"]
+        and m["dst_field"] == m["field"]
+        and m["index"] <= m["dst_index"] <= m["index"] + m["count"]
+    ):
+        return True
     plen = len(m["path"])
     dp = m["dst_path"]
     if len(dp) <= plen or dp[:plen] != m["path"]:
@@ -491,7 +515,9 @@ def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
     these — a later-round depth item.
     """
     if _is_noop_move(base):
-        return op  # self-cycle base applies as a no-op everywhere
+        return op  # no-op base: nothing to adjust for
+    if _is_noop_move(op):
+        return None  # an identity move rebases to nothing
     orig = op
     new_path = _rebase_path(op["path"], base, base_first)
     if new_path is None:

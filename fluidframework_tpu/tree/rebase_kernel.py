@@ -31,31 +31,13 @@ K_INSERT = 0
 K_REMOVE = 1
 
 
-def _rebase_step(state, base):
-    """Adjust all pending ops over ONE base op (the _adjust_index
-    rules, vectorized). state: (kind[N], index[N], count[N],
-    needs_split[N]); base: (kind, index, count). Muted ops end with
-    count 0. A base insert strictly INSIDE a pending remove's range
-    splits that remove in two (changeset.rebase_op "multi") — an
-    output-expanding case no fixed columnar row can hold, so the op is
-    FLAGGED and the caller reroutes it through the scalar path (the
-    kernel result for a flagged op is unspecified)."""
-    kind, idx, cnt, flag = state
-    bk, bi, bn = base
+def _piece_over_base(kind, idx, cnt, bk, bi, bn):
+    """Adjust ONE (kind, idx, cnt) piece over one base op — the
+    _adjust_index rules, vectorized and split-free."""
     is_ins = kind == K_INSERT
-    flag = flag | (
-        (bk == K_INSERT) & (kind == K_REMOVE) & (bi > idx) & (bi < idx + cnt)
-    )
 
     # ---- base insert: positions at/after shift right.
-    # insertion gaps: strict >, ties go to base (sequenced earlier);
-    # node references: >= (content before the node shifts it).
-    shift_ins = jnp.where(
-        is_ins,
-        jnp.where(idx >= bi, bn, 0),  # gap: bi < idx or tie -> shift
-        jnp.where(idx >= bi, bn, 0),  # node ref: bi <= idx -> shift
-    )
-    idx_after_ins = idx + shift_ins
+    idx_after_ins = idx + jnp.where(idx >= bi, bn, 0)
 
     # ---- base remove [bi, bi+bn): inserts inside slide to bi;
     # removes clip: the overlap with the base range is already gone.
@@ -63,13 +45,9 @@ def _rebase_step(state, base):
     hi = jnp.minimum(idx + cnt, bi + bn)
     overlap = jnp.maximum(0, hi - lo)
     new_cnt_rem = cnt - overlap
-    # Surviving range start: nodes before bi keep their index; nodes
-    # at/inside the range slide to bi; nodes after subtract bn.
     start_rem = jnp.where(
         idx < bi, idx, jnp.where(idx < bi + bn, bi, idx - bn)
     )
-    # If the head of the removed range was clipped, the survivors
-    # begin at the base-range start.
     start_rem = jnp.where(
         (kind == K_REMOVE) & (idx >= bi) & (idx < bi + bn),
         bi,
@@ -84,36 +62,96 @@ def _rebase_step(state, base):
 
     new_idx = jnp.where(bk == K_INSERT, idx_after_ins, idx_after_rem)
     new_cnt = jnp.where(bk == K_INSERT, cnt, cnt_after_rem)
-    return (kind, new_idx, new_cnt, flag), None
+    return new_idx, new_cnt
+
+
+def _rebase_step(state, base):
+    """Adjust all pending ops over ONE base op. state: (kind[N],
+    index[N], count[N], spare_idx[N], spare_cnt[N], spare_act[N],
+    flag[N]); base: (kind, index, count). Muted ops end with count 0.
+
+    A base insert strictly INSIDE a pending remove's range splits that
+    remove (changeset.rebase_op "multi"): the head keeps the primary
+    slot and the tail occupies the op's PREALLOCATED SPARE slot — one
+    split per pending op is handled natively (the overwhelmingly
+    common case; config-4's 'flagged_for_scalar_path' was exactly
+    these). A SECOND split on the same op (base insert inside either
+    live piece again) exceeds the two-slot budget and FLAGS the op for
+    the scalar path."""
+    kind, idx, cnt, s_idx, s_cnt, s_act, flag = state
+    bk, bi, bn = base
+
+    split_p = (
+        (bk == K_INSERT) & (kind == K_REMOVE) & (cnt > 0)
+        & (bi > idx) & (bi < idx + cnt)
+    )
+    split_s = (
+        (bk == K_INSERT) & s_act & (s_cnt > 0)
+        & (bi > s_idx) & (bi < s_idx + s_cnt)
+    )
+    # One native split per op: a primary split uses the spare; any
+    # split beyond that (primary again, or the spare itself) flags.
+    use_spare = split_p & ~s_act
+    flag = flag | (split_p & s_act) | split_s
+
+    # Tail of a fresh split, in post-base coordinates.
+    tail_idx = bi + bn
+    tail_cnt = (idx + cnt) - bi
+
+    new_idx, new_cnt = _piece_over_base(kind, idx, cnt, bk, bi, bn)
+    sp_idx, sp_cnt = _piece_over_base(kind, s_idx, s_cnt, bk, bi, bn)
+
+    # Apply the split AFTER the generic adjust: the head clips to the
+    # base insert's position, the tail starts past the inserted run.
+    new_cnt = jnp.where(use_spare, bi - idx, new_cnt)
+    new_idx = jnp.where(use_spare, idx, new_idx)
+    sp_idx = jnp.where(use_spare, tail_idx, sp_idx)
+    sp_cnt = jnp.where(use_spare, tail_cnt, sp_cnt)
+    s_act = s_act | use_spare
+
+    return (kind, new_idx, new_cnt, sp_idx, sp_cnt, s_act, flag), None
 
 
 @jax.jit
 def rebase_batch(kinds: jnp.ndarray, idxs: jnp.ndarray, cnts: jnp.ndarray,
                  base_kinds: jnp.ndarray, base_idxs: jnp.ndarray,
-                 base_cnts: jnp.ndarray
-                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                 base_cnts: jnp.ndarray):
     """Rebase N pending ops over M base ops (applied in order) in one
     XLA computation: lax.scan over the base window, every pending op
-    adjusted in parallel per step."""
-    (k, i, c, f), _ = jax.lax.scan(
+    adjusted in parallel per step. Returns
+    ``(kind, idx, cnt, spare_idx, spare_cnt, spare_active, flagged)``
+    — a split remove occupies its primary slot (head) plus its spare
+    slot (tail); `flagged` marks the rare double-split ops that must
+    reroute through the scalar changeset path."""
+    zeros = jnp.zeros(kinds.shape, jnp.int32)
+    (k, i, c, si, sc, sa, f), _ = jax.lax.scan(
         _rebase_step,
-        (kinds, idxs, cnts, jnp.zeros(kinds.shape, bool)),
+        (kinds, idxs, cnts, zeros, zeros,
+         jnp.zeros(kinds.shape, bool), jnp.zeros(kinds.shape, bool)),
         (base_kinds, base_idxs, base_cnts),
     )
-    return k, i, c, f
+    return k, i, c, si, sc, sa, f
 
 
 def rebase_ops_columnar(ops: np.ndarray, base: np.ndarray):
     """numpy convenience: ops/base are [N,3]/[M,3] arrays of
-    (kind, index, count). Returns (rebased [N,3], flagged [N]) —
-    flagged ops hit the split case and must reroute through the
-    scalar changeset path (count 0 = muted)."""
-    k, i, c, f = rebase_batch(
+    (kind, index, count). Returns (rebased [N,3], spares [N,3] with
+    count 0 for unsplit ops, flagged [N]) — flagged ops double-split
+    and must reroute through the scalar changeset path (count 0 =
+    muted). Spare pieces are SEQUENTIALIZED like the scalar path's
+    multi bundles: a split remove's tail index assumes its head
+    applied first."""
+    k, i, c, si, sc, sa, f = rebase_batch(
         jnp.asarray(ops[:, 0]), jnp.asarray(ops[:, 1]), jnp.asarray(ops[:, 2]),
         jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]), jnp.asarray(base[:, 2]),
     )
     out = np.stack([np.asarray(k), np.asarray(i), np.asarray(c)], axis=1)
-    return out, np.asarray(f)
+    act = np.asarray(sa)
+    sp_idx = np.where(act, np.asarray(si) - out[:, 2], 0)
+    spares = np.stack(
+        [out[:, 0], sp_idx, np.where(act, np.asarray(sc), 0)], axis=1
+    )
+    return out, spares, np.asarray(f)
 
 
 @functools.partial(jax.jit, static_argnums=())
@@ -122,5 +160,7 @@ def rebase_commit_range(kinds, idxs, cnts, commit_ids, base_kinds,
     """Config-4 shape: a RANGE of commits (ops tagged by commit id,
     already concatenated columnar) rebases over a trunk window — same
     scan, the commit structure rides along untouched."""
-    k, i, c, f = rebase_batch(kinds, idxs, cnts, base_kinds, base_idxs, base_cnts)
-    return k, i, c, f, commit_ids
+    k, i, c, si, sc, sa, f = rebase_batch(
+        kinds, idxs, cnts, base_kinds, base_idxs, base_cnts
+    )
+    return k, i, c, si, sc, sa, f, commit_ids

@@ -137,8 +137,9 @@ def config4_tree_rebase(n_pending: int = 100_000, window: int = 64) -> dict:
     flagged_box = {}
 
     def workload():
-        out, flagged = rebase_ops_columnar(ops, base)
+        out, spares, flagged = rebase_ops_columnar(ops, base)
         flagged_box["n"] = int(flagged.sum())
+        flagged_box["splits"] = int(((spares[:, 2] > 0) & ~flagged).sum())
 
     stats = run_benchmark(workload, repeats=REPEATS, warmups=1,
                           memory=True)
@@ -149,6 +150,7 @@ def config4_tree_rebase(n_pending: int = 100_000, window: int = 64) -> dict:
         "seconds": stats["mean"],
         "op_rebases_per_sec": round(rebases / stats["mean"], 1),
         "flagged_for_scalar_path": flagged_box["n"],
+        "native_splits": flagged_box["splits"],
         "stats": stats,
     }
 
