@@ -20,7 +20,6 @@ muted), and full mutes dropping the op (count -> 0).
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
@@ -153,14 +152,3 @@ def rebase_ops_columnar(ops: np.ndarray, base: np.ndarray):
     )
     return out, spares, np.asarray(f)
 
-
-@functools.partial(jax.jit, static_argnums=())
-def rebase_commit_range(kinds, idxs, cnts, commit_ids, base_kinds,
-                        base_idxs, base_cnts):
-    """Config-4 shape: a RANGE of commits (ops tagged by commit id,
-    already concatenated columnar) rebases over a trunk window — same
-    scan, the commit structure rides along untouched."""
-    k, i, c, si, sc, sa, f = rebase_batch(
-        kinds, idxs, cnts, base_kinds, base_idxs, base_cnts
-    )
-    return k, i, c, si, sc, sa, f, commit_ids

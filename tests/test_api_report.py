@@ -1,0 +1,44 @@
+"""Public-API surface regression (the api-report / api-extractor
+role): the checked-in reports under api_report/ are the public-API
+contract; any surface change must be re-approved by regenerating them
+(python tools/api_report.py) and reviewing the diff."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+)
+
+import api_report  # noqa: E402
+
+
+@pytest.mark.parametrize("pkg", api_report.PACKAGES)
+def test_api_surface_pinned(pkg):
+    path = os.path.join(api_report.REPORT_DIR, pkg + ".api.txt")
+    assert os.path.exists(path), (
+        f"missing API report for {pkg}; run tools/api_report.py"
+    )
+    want = open(path).read()
+    got = api_report.render(pkg)
+    assert got == want, (
+        f"public API of {pkg} changed; review the diff and run "
+        "tools/api_report.py to re-approve"
+    )
+
+
+def test_no_orphaned_reports():
+    """A package removed from PACKAGES must not leave a stale report
+    silently pinning a deleted surface."""
+    expected = {pkg + ".api.txt" for pkg in api_report.PACKAGES}
+    on_disk = {
+        f for f in os.listdir(api_report.REPORT_DIR)
+        if f.endswith(".api.txt")
+    }
+    assert on_disk == expected, (
+        f"orphaned/missing API reports: {on_disk ^ expected}; run "
+        "tools/api_report.py"
+    )

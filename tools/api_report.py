@@ -1,0 +1,145 @@
+"""Generate the public-API surface reports (the api-report role).
+
+The reference checks in `api-report/*.api.md` per package
+(api-extractor output) as the public-API regression contract: any
+surface change shows up as a diff a reviewer must approve. This tool
+walks each package's public surface (module `__all__` when present,
+else underscore filtering) and renders classes/functions with their
+signatures into `api_report/<package>.api.txt`, deterministically.
+
+tests/test_api_report.py regenerates the reports in-memory and fails
+on any drift, naming this tool — the same accept-the-diff workflow.
+
+Usage: python tools/api_report.py [--check]
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PACKAGES = [
+    "fluidframework_tpu.core.mergetree",
+    "fluidframework_tpu.core.native_engine",
+    "fluidframework_tpu.core.overlay_replay",
+    "fluidframework_tpu.core.columnar_replay",
+    "fluidframework_tpu.ops.mergetree_kernel",
+    "fluidframework_tpu.ops.overlay_pallas",
+    "fluidframework_tpu.ops.overlay_ref",
+    "fluidframework_tpu.ops.sequencer_kernel",
+    "fluidframework_tpu.dds",
+    "fluidframework_tpu.dds.sequence",
+    "fluidframework_tpu.dds.map",
+    "fluidframework_tpu.dds.matrix",
+    "fluidframework_tpu.tree",
+    "fluidframework_tpu.runtime",
+    "fluidframework_tpu.runtime.container_runtime",
+    "fluidframework_tpu.runtime.datastore",
+    "fluidframework_tpu.loader",
+    "fluidframework_tpu.drivers",
+    "fluidframework_tpu.server",
+    "fluidframework_tpu.server.riddler",
+    "fluidframework_tpu.framework",
+    "fluidframework_tpu.parallel",
+    "fluidframework_tpu.protocol",
+    "fluidframework_tpu.testing",
+    "fluidframework_tpu.utils",
+]
+
+REPORT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "api_report",
+)
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def _public_names(mod) -> list:
+    names = getattr(mod, "__all__", None)
+    if names is None:
+        names = [n for n in vars(mod) if not n.startswith("_")]
+        # Without __all__, skip re-exported modules and foreign names.
+        names = [
+            n for n in names
+            if getattr(getattr(mod, n), "__module__", mod.__name__)
+            == mod.__name__
+            and not inspect.ismodule(getattr(mod, n))
+        ]
+    return sorted(names)
+
+
+def render(module_name: str) -> str:
+    mod = importlib.import_module(module_name)
+    lines = [f"## API report: {module_name}", ""]
+    for name in _public_names(mod):
+        obj = getattr(mod, name)
+        if inspect.isclass(obj):
+            bases = ", ".join(
+                b.__name__ for b in obj.__bases__ if b is not object
+            )
+            lines.append(f"class {name}({bases})" if bases else f"class {name}")
+            members = []
+            for mname, m in sorted(vars(obj).items()):
+                if mname.startswith("_") and mname != "__init__":
+                    continue
+                if inspect.isfunction(m):
+                    members.append(f"    def {mname}{_sig(m)}")
+                elif isinstance(m, property):
+                    members.append(f"    property {mname}")
+                elif isinstance(m, (classmethod, staticmethod)):
+                    members.append(
+                        f"    def {mname}{_sig(m.__func__)}  # {type(m).__name__}"
+                    )
+            lines.extend(members)
+        elif inspect.isfunction(obj):
+            lines.append(f"def {name}{_sig(obj)}")
+        elif not inspect.ismodule(obj):
+            lines.append(f"{name} = {type(obj).__name__}")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def main() -> None:
+    check = "--check" in sys.argv
+    drift = []
+    os.makedirs(REPORT_DIR, exist_ok=True)
+    for pkg in PACKAGES:
+        text = render(pkg)
+        path = os.path.join(REPORT_DIR, pkg + ".api.txt")
+        if check:
+            old = open(path).read() if os.path.exists(path) else None
+            if old != text:
+                drift.append(pkg)
+        else:
+            with open(path, "w") as f:
+                f.write(text)
+    expected = {pkg + ".api.txt" for pkg in PACKAGES}
+    orphans = sorted(
+        f for f in os.listdir(REPORT_DIR)
+        if f.endswith(".api.txt") and f not in expected
+    )
+    if check:
+        if orphans:
+            drift.extend(f"orphan:{f}" for f in orphans)
+        if drift:
+            print("API drift in:", ", ".join(drift))
+            sys.exit(1)
+        print(f"{len(PACKAGES)} API reports clean")
+    else:
+        for f in orphans:
+            os.remove(os.path.join(REPORT_DIR, f))
+        print(f"wrote {len(PACKAGES)} reports to {REPORT_DIR}"
+              + (f"; removed {len(orphans)} orphans" if orphans else ""))
+
+
+if __name__ == "__main__":
+    main()
