@@ -29,11 +29,31 @@ reference's compressed-id equality relies on.
 
 from __future__ import annotations
 
+import uuid
 from bisect import bisect_right
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 DEFAULT_CLUSTER_CAPACITY = 512
+
+# uuid5 namespace for sessions whose ids are not themselves UUIDs.
+_SESSION_NS = uuid.UUID("7d0efc6f-6a66-4b6c-9f3c-0d7f1f3a0000")
+
+
+def session_uuid(session_id: str) -> uuid.UUID:
+    """The session's STABLE-ID base UUID (the reference requires UUID
+    session ids; non-UUID ids derive one deterministically)."""
+    try:
+        return uuid.UUID(session_id)
+    except ValueError:
+        return uuid.uuid5(_SESSION_NS, session_id)
+
+
+def _uuid_add(base: uuid.UUID, offset: int) -> str:
+    """Numeric UUID arithmetic (the reference's
+    stableIdFromNumericUuid): stable ids within a session are the
+    session UUID plus the id's ordinal offset."""
+    return str(uuid.UUID(int=(base.int + offset) & ((1 << 128) - 1)))
 
 
 @dataclass
@@ -90,7 +110,10 @@ class IdCompressor:
 
     def finalize_range(self, session: str, count: int) -> None:
         """Finalize the next `count` locals of `session` (called in
-        total order on every replica as the carrying ops sequence)."""
+        total order on every replica as the carrying ops sequence).
+        A zero count is a true no-op (no session registration)."""
+        if count <= 0:
+            return
         done = self._finalized.get(session, 0)
         clusters = self._clusters.setdefault(session, [])
         remaining = count
@@ -175,6 +198,74 @@ class IdCompressor:
     def cluster_count(self) -> int:
         return len(self._final_refs)
 
+    # --------------------------------------------------------- stable ids
+
+    def stable_id_of(self, id_: int, originator: Optional[str] = None) -> str:
+        """The permanent UUID identity of a compressed id (the
+        reference's decompress -> StableId): session base UUID +
+        ordinal offset, so a session's consecutive ids are consecutive
+        UUIDs (the property cluster allocation exploits)."""
+        if id_ >= 0:
+            session, ordinal = self.decompress(id_)
+        else:
+            session = originator or self.session_id
+            ordinal = -id_
+        return _uuid_add(session_uuid(session), ordinal - 1)
+
+    def _session_base(self, session: str) -> int:
+        cache = getattr(self, "_base_cache", None)
+        if cache is None:
+            cache = self._base_cache = {}
+        base = cache.get(session)
+        if base is None:
+            base = cache[session] = session_uuid(session).int
+        return base
+
+    def _ordinal_to_final_reserved(
+        self, session: str, ordinal: int
+    ) -> Optional[int]:
+        """Ordinal -> final over RESERVED capacity (identity is fixed
+        at cluster allocation, so eager finals resolve before their
+        range's finalize catches the count up — mirrors decompress)."""
+        clusters = self._clusters.get(session)
+        if not clusters:
+            return None
+        i = bisect_right(clusters, ordinal, key=lambda c: c.base_local) - 1
+        if i < 0:
+            return None
+        cl = clusters[i]
+        if ordinal < cl.base_local + cl.capacity:
+            return cl.base_final + (ordinal - cl.base_local)
+        return None
+
+    def recompress(self, stable: str) -> int:
+        """StableId -> compressed id in THIS session's space (the
+        reference's recompress): reserved finals (including eager
+        finals whose finalize hasn't caught up) resolve to finals,
+        our own others to locals, KeyError for unknown ids."""
+        target = uuid.UUID(stable).int
+        best: Optional[Tuple[str, int]] = None
+        for session in self._clusters:
+            off = target - self._session_base(session)
+            if 0 <= off < (1 << 64):
+                if best is None or off < best[1]:
+                    best = (session, off)
+        own_off = target - self._session_base(self.session_id)
+        if 0 <= own_off < self._local_count and (
+            best is None or own_off < best[1]
+        ):
+            best = (self.session_id, own_off)
+        if best is None:
+            raise KeyError(f"unknown stable id {stable}")
+        session, off = best
+        ordinal = off + 1
+        final = self._ordinal_to_final_reserved(session, ordinal)
+        if final is not None:
+            return final
+        if session == self.session_id and ordinal <= self._local_count:
+            return -ordinal
+        raise KeyError(f"stable id {stable} not finalized here")
+
     # --------------------------------------------------------- serialize
 
     def serialize(self) -> dict:
@@ -189,6 +280,113 @@ class IdCompressor:
                 for s, cs in self._clusters.items()
             },
         }
+
+    # The reference persists a compact binary form
+    # (idCompressor.ts serialize: version + session table + packed
+    # cluster rows), not a JSON object graph. Layout (all integers
+    # LEB128 varints unless noted):
+    #   header:  magic "IDC2", clusterCapacity, localCount, nextFinal,
+    #            nSessions, nClusters, serializerSessionIdx
+    #   session: idLen, utf8 id bytes, finalizedCount   (per session)
+    #   cluster: sessionIdx, baseFinalDelta (from previous cluster's
+    #            base), baseLocal, capacity, count  (final-space order)
+    def serialize_binary(self) -> bytes:
+        sessions = sorted(
+            set(self._clusters) | set(self._finalized)
+            | {self.session_id}
+        )
+        sidx = {s: i for i, s in enumerate(sessions)}
+        out = [b"IDC2"]
+
+        def put(v: int) -> None:
+            while True:
+                b = v & 0x7F
+                v >>= 7
+                out.append(bytes([b | (0x80 if v else 0)]))
+                if not v:
+                    return
+
+        put(self.cluster_capacity)
+        put(self._local_count)
+        put(self._next_final)
+        put(len(sessions))
+        put(len(self._final_refs))
+        put(sidx[self.session_id])
+        for sess in sessions:
+            raw = sess.encode()
+            put(len(raw))
+            out.append(raw)
+            put(self._finalized.get(sess, 0))
+        prev_base = 0
+        for sess, cl in self._final_refs:
+            put(sidx[sess])
+            put(cl.base_final - prev_base)
+            prev_base = cl.base_final
+            put(cl.base_local)
+            put(cl.capacity)
+            put(cl.count)
+        return b"".join(out)
+
+    @classmethod
+    def deserialize_binary(
+        cls, blob: bytes, session_id: Optional[str] = None
+    ) -> "IdCompressor":
+        if blob[:4] != b"IDC2":
+            raise ValueError("bad id-compressor blob")
+        try:
+            return cls._parse_binary(blob, session_id)
+        except (IndexError, UnicodeDecodeError) as exc:
+            raise ValueError(
+                f"truncated/corrupt id-compressor blob: {exc}"
+            ) from None
+
+    @classmethod
+    def _parse_binary(
+        cls, blob: bytes, session_id: Optional[str]
+    ) -> "IdCompressor":
+        pos = [4]
+
+        def get() -> int:
+            v, shift = 0, 0
+            while True:
+                b = blob[pos[0]]
+                pos[0] += 1
+                v |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    return v
+                shift += 7
+
+        cap = get()
+        local_count = get()
+        next_final = get()
+        n_sessions = get()
+        n_clusters = get()
+        ser_idx = get()
+        sessions: List[str] = []
+        finalized: Dict[str, int] = {}
+        for _ in range(n_sessions):
+            ln = get()
+            sess = blob[pos[0]: pos[0] + ln].decode()
+            pos[0] += ln
+            sessions.append(sess)
+            finalized[sess] = get()
+        serial_session = sessions[ser_idx] if sessions else ""
+        out = cls(session_id or serial_session, cap)
+        out._next_final = next_final
+        out._finalized = finalized
+        out._local_count = (
+            local_count
+            if session_id in (None, serial_session) else 0
+        )
+        prev_base = 0
+        for _ in range(n_clusters):
+            si = get()
+            prev_base += get()
+            cl = _Cluster(prev_base, get(), get(), get())
+            out._clusters.setdefault(sessions[si], []).append(cl)
+            out._final_bases.append(cl.base_final)
+            out._final_refs.append((sessions[si], cl))
+        return out
 
     @classmethod
     def deserialize(cls, data: dict, session_id: Optional[str] = None) -> "IdCompressor":

@@ -1,0 +1,25 @@
+"""Smoke-run every example (the reference ships examples/ apps; these
+are the user-facing end-to-end surfaces)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+)
+
+
+@pytest.mark.parametrize(
+    "name", ["collab_text.py", "todo_app.py", "tpu_replay.py"]
+)
+def test_example_runs(name):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", REPLAY_OPS="800")
+    res = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, name)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert res.stdout.strip()

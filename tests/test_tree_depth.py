@@ -377,3 +377,111 @@ def test_editable_proxy_attributes_iteration_and_moves():
     c = rt.get_datastore("default").get_channel("t")
     assert c.root_field("root")[1].items[0].title[0].value == "edited"
     assert c.validate() == []
+
+
+# ----------------------------------------------- id-compressor depth
+
+
+def test_id_compressor_stable_ids_roundtrip():
+    """StableId space (idCompressor.ts decompress/recompress): a
+    session's consecutive ids are consecutive UUIDs off its base;
+    stable ids survive finalization and recompress on any replica."""
+    from fluidframework_tpu.tree.id_compressor import IdCompressor
+    import uuid as _uuid
+
+    a = IdCompressor("11111111-1111-1111-1111-111111111111")
+    b = IdCompressor("22222222-2222-2222-2222-222222222222")
+    locals_a = [a.generate_compressed_id() for _ in range(5)]
+    stables = [a.stable_id_of(i) for i in locals_a]
+    # Consecutive UUID arithmetic off the session base.
+    nums = [_uuid.UUID(s).int for s in stables]
+    assert nums == list(range(nums[0], nums[0] + 5))
+    # Finalize on both replicas in the same order.
+    for c in (a, b):
+        c.finalize_range("11111111-1111-1111-1111-111111111111", 5)
+    finals = [a.normalize_to_op_space(i) for i in locals_a]
+    assert all(f >= 0 for f in finals)
+    # Stable identity is preserved across spaces and replicas.
+    for lo, fi, st in zip(locals_a, finals, stables):
+        assert a.stable_id_of(fi) == st
+        assert b.stable_id_of(fi) == st
+        assert a.recompress(st) == fi
+        assert b.recompress(st) == fi
+
+
+def test_id_compressor_recompress_unknown():
+    from fluidframework_tpu.tree.id_compressor import IdCompressor
+
+    c = IdCompressor("s1")
+    with pytest.raises(KeyError):
+        c.recompress("99999999-9999-4999-8999-999999999999")
+
+
+def test_id_compressor_binary_serialization():
+    """The compact binary persisted form (idCompressor.ts serialize):
+    round-trips exactly, resumes generation/finalization, and is
+    materially smaller than the JSON object form."""
+    import json
+
+    from fluidframework_tpu.tree.id_compressor import IdCompressor
+
+    a = IdCompressor("sessA", cluster_capacity=8)
+    peers = [f"peer{i}" for i in range(6)]
+    rng = random.Random(9)
+    for step in range(200):
+        n = rng.randint(1, 7)
+        for _ in range(n):
+            a.generate_compressed_id()
+        a.finalize_range("sessA", n)
+        p = rng.choice(peers)
+        a.finalize_range(p, rng.randint(1, 9))
+    blob = a.serialize_binary()
+    back = IdCompressor.deserialize_binary(blob)
+    assert back.session_id == "sessA"
+    assert back.serialize() == a.serialize()  # full state equality
+    # Resumes: new ids + finalization continue the same mapping.
+    x1, x2 = a.generate_compressed_id(), back.generate_compressed_id()
+    assert x1 == x2
+    a.finalize_range("sessA", 1)
+    back.finalize_range("sessA", 1)
+    assert a.serialize() == back.serialize()
+    # Compact: beats the JSON form by a wide margin.
+    assert len(blob) < len(json.dumps(a.serialize())) / 2
+    # A reader adopting a different identity keeps the shared state
+    # but not the serializer's local counter.
+    reader = IdCompressor.deserialize_binary(blob, session_id="other")
+    assert reader._local_count == 0
+    assert reader.decompress(0) == a.decompress(0)
+
+
+def test_id_compressor_eager_final_recompress():
+    """Eager finals round-trip through stable ids BEFORE their
+    finalize catches up (identity is reserved at cluster allocation),
+    on the owner and on peers."""
+    from fluidframework_tpu.tree.id_compressor import IdCompressor
+
+    a = IdCompressor("33333333-3333-3333-3333-333333333333",
+                     cluster_capacity=4)
+    b = IdCompressor("44444444-4444-4444-4444-444444444444",
+                     cluster_capacity=4)
+    for _ in range(2):
+        a.generate_compressed_id()
+    for c in (a, b):
+        c.finalize_range("33333333-3333-3333-3333-333333333333", 2)
+    eager = a.generate_compressed_id()
+    assert eager >= 0  # eager final from reserved headroom
+    st = a.stable_id_of(eager)
+    assert a.recompress(st) == eager
+    assert b.recompress(st) == eager  # peer resolves reserved identity
+
+
+def test_id_compressor_binary_rejects_truncation():
+    from fluidframework_tpu.tree.id_compressor import IdCompressor
+
+    a = IdCompressor("sessT")
+    a.generate_compressed_id()
+    a.finalize_range("sessT", 1)
+    blob = a.serialize_binary()
+    for cut in (3, 7, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(ValueError):
+            IdCompressor.deserialize_binary(blob[:cut])
