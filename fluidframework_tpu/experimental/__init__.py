@@ -1,0 +1,19 @@
+"""Experimental DDS families (the reference's experimental/ tree,
+SURVEY.md §1 row X): PropertyDDS — the typed property tree + changeset
+family."""
+
+from .property_dds import (
+    ChangeSet,
+    PropertySet,
+    PropertyTemplate,
+    SharedPropertyTree,
+    SharedPropertyTreeFactory,
+)
+
+__all__ = [
+    "ChangeSet",
+    "PropertySet",
+    "PropertyTemplate",
+    "SharedPropertyTree",
+    "SharedPropertyTreeFactory",
+]

@@ -1,0 +1,422 @@
+"""PropertyDDS: typed property trees + changesets (minimal family).
+
+The reference's experimental PropertyDDS
+(experimental/PropertyDDS/packages): `property-properties` defines
+TYPED property sets built from schema templates (typeid + typed
+fields); `property-changeset` defines the nested
+insert/modify/remove ChangeSet format with `applyChangeSet` and
+`squash` (changeset.ts, changeset_operations/); `property-dds`'s
+SharedPropertyTree synchronizes a property set by submitting
+changesets over the op stream (rebase.ts resolves concurrency —
+last-sequenced-writer-wins per leaf path here, the format's modify
+semantics).
+
+This is the minimal faithful core of that family: typed templates
+with validation, hierarchical property sets, the nested changeset
+algebra (apply / squash with the reference's insert∘modify and
+remove-cancels-insert laws), and a DDS channel with pending-op
+rebottoming and summary round-trip. The full reference family
+(property-binder, proxies, query) remains out of scope.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional
+
+from ..protocol.messages import SequencedMessage
+from ..runtime.channel import ChannelFactory, ChannelStorage
+from ..runtime.shared_object import SharedObject
+from ..runtime.summary import SummaryTreeBuilder
+
+PRIMITIVES = {"Int32", "Float64", "String", "Bool"}
+NODE = "NodeProperty"
+
+
+class PropertyTemplate:
+    """A typed schema (property-properties templates,
+    property-changeset/src/templateValidator.ts): typeid + fields,
+    each a primitive, NodeProperty, or another registered typeid."""
+
+    def __init__(self, typeid: str, properties: List[dict]):
+        self.typeid = typeid
+        self.properties = list(properties)
+        ids = [p["id"] for p in properties]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate field ids in {typeid}")
+        for p in properties:
+            if "id" not in p or "typeid" not in p:
+                raise ValueError(f"field needs id+typeid: {p}")
+
+
+class _Registry:
+    def __init__(self):
+        self._templates: Dict[str, PropertyTemplate] = {}
+
+    def register(self, template: PropertyTemplate) -> None:
+        self._templates[template.typeid] = template
+
+    def get(self, typeid: str) -> Optional[PropertyTemplate]:
+        return self._templates.get(typeid)
+
+
+def _default_value(typeid: str, registry: _Registry) -> Any:
+    if typeid == "Int32":
+        return 0
+    if typeid == "Float64":
+        return 0.0
+    if typeid == "String":
+        return ""
+    if typeid == "Bool":
+        return False
+    return PropertySet(typeid, registry)
+
+
+class PropertySet:
+    """A typed hierarchical property tree (BaseProperty/NodeProperty
+    roles). Dynamic children may be inserted under any node; typed
+    children come from the node's template."""
+
+    def __init__(self, typeid: str, registry: _Registry):
+        self.typeid = typeid
+        self._registry = registry
+        self._children: Dict[str, Any] = {}
+        tpl = registry.get(typeid)
+        if tpl is not None:
+            for field in tpl.properties:
+                self._children[field["id"]] = _default_value(
+                    field["typeid"], registry
+                )
+
+    # -------------------------------------------------------- accessors
+
+    def get(self, path: str) -> Any:
+        node: Any = self
+        for part in path.split("."):
+            if not isinstance(node, PropertySet) or part not in node._children:
+                raise KeyError(path)
+            node = node._children[part]
+        return node
+
+    def set_value(self, path: str, value: Any) -> None:
+        *head, leaf = path.split(".")
+        node = self.get(".".join(head)) if head else self
+        if not isinstance(node, PropertySet) or leaf not in node._children:
+            raise KeyError(path)
+        cur = node._children[leaf]
+        if isinstance(cur, PropertySet):
+            raise TypeError(f"{path} is a container")
+        node._children[leaf] = _check_type(cur, value, path)
+
+    def insert(self, path: str, typeid: str) -> "PropertySet":
+        """Insert a dynamic child property at `path` (NodeProperty
+        insert semantics)."""
+        *head, name = path.split(".")
+        node = self.get(".".join(head)) if head else self
+        if name in node._children:
+            raise KeyError(f"{path} exists")
+        child = _default_value(typeid, self._registry)
+        node._children[name] = child
+        return child if isinstance(child, PropertySet) else node
+
+    def remove(self, path: str) -> None:
+        *head, name = path.split(".")
+        node = self.get(".".join(head)) if head else self
+        del node._children[name]
+
+    def to_json(self) -> dict:
+        out: Dict[str, Any] = {"typeid": self.typeid, "fields": {}}
+        for k, v in sorted(self._children.items()):
+            out["fields"][k] = (
+                v.to_json() if isinstance(v, PropertySet) else
+                {"value": v, "typeid": _typeid_of(v)}
+            )
+        return out
+
+    @classmethod
+    def from_json(cls, data: dict, registry: _Registry) -> "PropertySet":
+        ps = cls.__new__(cls)
+        ps.typeid = data["typeid"]
+        ps._registry = registry
+        ps._children = {}
+        for k, v in data["fields"].items():
+            if "fields" in v:
+                ps._children[k] = cls.from_json(v, registry)
+            else:
+                ps._children[k] = v["value"]
+        return ps
+
+
+def _typeid_of(value: Any) -> str:
+    if isinstance(value, bool):
+        return "Bool"
+    if isinstance(value, int):
+        return "Int32"
+    if isinstance(value, float):
+        return "Float64"
+    return "String"
+
+
+def _check_type(current: Any, value: Any, path: str) -> Any:
+    want = _typeid_of(current)
+    got = _typeid_of(value)
+    if want == "Float64" and got == "Int32":
+        return float(value)
+    if want != got:
+        raise TypeError(f"{path}: expected {want}, got {got}")
+    return value
+
+
+class ChangeSet:
+    """The nested changeset form (property-changeset/src/changeset.ts):
+    per node, `insert` (subtree payloads by name), `modify` (nested
+    changesets / leaf values), `remove` (names). `apply` mutates a
+    PropertySet; `squash` composes a later changeset into this one
+    under the reference's laws (modify-after-insert folds into the
+    insert; remove-after-insert cancels; modify-after-modify is
+    last-writer-wins per leaf)."""
+
+    def __init__(self, data: Optional[dict] = None):
+        self.data = data or {}
+
+    # ----------------------------------------------------------- apply
+
+    def apply(self, ps: PropertySet,
+              shadowed: Optional[Dict[str, List[int]]] = None) -> None:
+        """`shadowed`: leaf-path -> [pending modifies, pending
+        structural ops] (the map-kernel shadowing convention, made
+        KIND-AWARE for the nested tree — the rule set below is the
+        unique convergent assignment of winners given that pending
+        local ops always sequence after currently-arriving remotes):
+
+        - a remote REMOVE always applies (concurrent edits' echoes
+          mute as modifies of a removed child on every remote);
+        - a remote INSERT skips iff a pending local STRUCTURAL op
+          (insert: ours recreates at its echo; remove: ours deletes at
+          its sequencing on remotes) holds the path — a pending
+          modify CANNOT recreate a node, so it never shadows inserts;
+        - a remote MODIFY skips iff any pending local write holds the
+          path (a pending insert's payload carries the local value).
+        """
+        self._apply_node(self.data, ps, shadowed or {}, "")
+
+    @staticmethod
+    def _shadow_at(shadowed, path: str, slot: int) -> bool:
+        entry = shadowed.get(path)
+        return entry is not None and entry[slot] > 0
+
+    def _apply_node(self, cs: dict, node: PropertySet,
+                    shadowed: Dict[str, List[int]], prefix: str) -> None:
+        def path_of(name: str) -> str:
+            return f"{prefix}{name}"
+
+        for name in cs.get("remove", []):
+            node._children.pop(name, None)
+        for name, payload in cs.get("insert", {}).items():
+            if self._shadow_at(shadowed, path_of(name), 1):
+                continue
+            node._children[name] = (
+                PropertySet.from_json(payload, node._registry)
+                if isinstance(payload, dict) and "fields" in payload
+                else payload["value"]
+            )
+        for name, sub in cs.get("modify", {}).items():
+            child = node._children.get(name)
+            if child is None:
+                continue  # modify of a concurrently removed child mutes
+            p = path_of(name)
+            if isinstance(child, PropertySet):
+                if "value" in sub:
+                    continue  # leaf write vs now-container: shape mutes
+                self._apply_node(sub, child, shadowed, p + ".")
+            elif "value" not in sub:
+                continue  # nested modify vs now-primitive: shape mutes
+            elif not (
+                self._shadow_at(shadowed, p, 0)
+                or self._shadow_at(shadowed, p, 1)
+            ):
+                node._children[name] = sub["value"]
+
+    def paths(self) -> List[tuple]:
+        """(path, slot) for every write: slot 0 = modify, slot 1 =
+        structural (insert/remove) — the shadow bookkeeping keys."""
+        out: List[tuple] = []
+
+        def walk(cs: dict, prefix: str) -> None:
+            for name in cs.get("remove", []):
+                out.append((prefix + name, 1))
+            for name in cs.get("insert", {}):
+                out.append((prefix + name, 1))
+            for name, sub in cs.get("modify", {}).items():
+                if "value" in sub:
+                    out.append((prefix + name, 0))
+                else:
+                    walk(sub, prefix + name + ".")
+
+        walk(self.data, "")
+        return out
+
+    # ---------------------------------------------------------- squash
+
+    def squash(self, later: "ChangeSet") -> "ChangeSet":
+        """this ∘ later (changeset_operations squash laws)."""
+        return ChangeSet(
+            _squash_node(copy.deepcopy(self.data), later.data)
+        )
+
+
+def _squash_node(base: dict, later: dict) -> dict:
+    for name in later.get("remove", []):
+        if name in base.get("insert", {}):
+            del base["insert"][name]  # remove cancels our insert
+        else:
+            base.setdefault("remove", []).append(name)
+        base.get("modify", {}).pop(name, None)
+    for name, payload in later.get("insert", {}).items():
+        base.setdefault("insert", {})[name] = copy.deepcopy(payload)
+    for name, sub in later.get("modify", {}).items():
+        ins = base.get("insert", {}).get(name)
+        if ins is not None:
+            # modify folds into our pending insert's payload.
+            _fold_modify_into_insert(ins, sub)
+            continue
+        cur = base.setdefault("modify", {}).get(name)
+        if cur is None or "value" in sub:
+            base["modify"][name] = copy.deepcopy(sub)  # leaf LWW
+        else:
+            base["modify"][name] = _squash_node(cur, sub)
+    return base
+
+
+def _fold_modify_into_insert(ins: dict, sub: dict) -> None:
+    if "value" in sub:
+        ins["value"] = sub["value"]
+        return
+    for name in sub.get("remove", []):
+        ins.get("fields", {}).pop(name, None)
+    for name, payload in sub.get("insert", {}).items():
+        ins.setdefault("fields", {})[name] = copy.deepcopy(payload)
+    for name, inner in sub.get("modify", {}).items():
+        child = ins.get("fields", {}).get(name)
+        if child is not None:
+            _fold_modify_into_insert(child, inner)
+
+
+class SharedPropertyTree(SharedObject):
+    """The DDS channel (property-dds SharedPropertyTree): local edits
+    accumulate into a pending changeset submitted on commit();
+    sequenced changesets apply in total order on every replica
+    (rebase.ts's effective policy for non-conflicting paths; leaf
+    conflicts resolve last-sequenced-wins via modify semantics)."""
+
+    ROOT_TYPEID = NODE
+
+    def initialize_local_core(self) -> None:
+        self.registry = _Registry()
+        self.root = PropertySet(self.ROOT_TYPEID, self.registry)
+        self._pending = ChangeSet()
+        self._shadow: Dict[str, List[int]] = {}
+
+    def register_template(self, template: PropertyTemplate) -> None:
+        self.registry.register(template)
+
+    # -------------------------------------------------------- local API
+
+    @staticmethod
+    def _singleton(kind: str, path: str, payload: Any) -> ChangeSet:
+        """One primitive edit as a changeset; pending edits fold via
+        `squash`, so the algebra is the single source of truth."""
+        *head, name = path.split(".")
+        if kind == "set":
+            leaf: Dict[str, Any] = {"modify": {name: {"value": payload}}}
+        elif kind == "insert":
+            leaf = {"insert": {name: payload}}
+        else:
+            leaf = {"remove": [name]}
+        for part in reversed(head):
+            leaf = {"modify": {part: leaf}}
+        return ChangeSet(leaf)
+
+    def _fold(self, kind: str, path: str, payload: Any = None) -> None:
+        self._pending = self._pending.squash(
+            self._singleton(kind, path, payload)
+        )
+
+    def set_value(self, path: str, value: Any) -> None:
+        self.root.set_value(path, value)
+        self._fold("set", path, value)
+
+    def insert_property(self, path: str, typeid: str) -> None:
+        self.root.insert(path, typeid)
+        child = self.root.get(path)
+        payload = (
+            child.to_json() if isinstance(child, PropertySet)
+            else {"value": child, "typeid": typeid}
+        )
+        self._fold("insert", path, payload)
+
+    def remove_property(self, path: str) -> None:
+        self.root.remove(path)
+        self._fold("remove", path)
+
+    def commit(self) -> None:
+        """Submit the accumulated pending changeset as ONE op (the
+        reference's commit granularity). Written paths shadow remote
+        writes until this op's own echo sequences (then the sequenced
+        order is authoritative)."""
+        if not self._pending.data:
+            return
+        cs, self._pending = self._pending, ChangeSet()
+        for p, slot in cs.paths():
+            entry = self._shadow.setdefault(p, [0, 0])
+            entry[slot] += 1
+        self.submit_local_message({"cs": cs.data}, None)
+
+    # ----------------------------------------------------------- apply
+
+    def process_core(self, msg: SequencedMessage, local: bool,
+                     local_metadata: Any) -> None:
+        cs = ChangeSet(msg.contents["cs"])
+        if local:
+            # Applied optimistically at edit time; release the shadows.
+            for p, slot in cs.paths():
+                entry = self._shadow.get(p)
+                if entry is not None:
+                    entry[slot] = max(0, entry[slot] - 1)
+                    if entry == [0, 0]:
+                        self._shadow.pop(p, None)
+            # The echo is the authoritative sequenced point for THIS
+            # op: re-applying it (over the shadows that remain for
+            # later still-pending local commits) converges the
+            # optimistic state with what every remote just computed —
+            # corrective when concurrent earlier-sequenced ops
+            # perturbed our optimistic values (e.g. a racing
+            # remove+reinsert), idempotent otherwise.
+            cs.apply(self.root, self._shadow)
+            return
+        cs.apply(self.root, self._shadow)
+
+    def apply_stashed_op(self, content: Any) -> Any:
+        ChangeSet(content["cs"]).apply(self.root)
+        return None
+
+    # --------------------------------------------------------- summary
+
+    def summarize_core(self):
+        return (
+            SummaryTreeBuilder()
+            .add_json_blob("root", self.root.to_json())
+            .summary
+        )
+
+    def load_core(self, storage: ChannelStorage) -> None:
+        self.initialize_local_core()
+        self.root = PropertySet.from_json(
+            json.loads(storage.read("root")), self.registry
+        )
+
+
+class SharedPropertyTreeFactory(ChannelFactory):
+    type_name = "https://graph.microsoft.com/types/PropertyDDS"
+    channel_class = SharedPropertyTree

@@ -1,0 +1,115 @@
+"""Historian: the caching tier in front of summary storage.
+
+Mirrors the reference's historian service (server/historian — a Redis-
+backed caching REST proxy in front of gitrest): content-addressed
+blobs are IMMUTABLE, so they cache forever under an LRU budget; refs
+(mutable head pointers) cache with explicit invalidation on writes
+through this tier and a TTL against out-of-band writers. Every store
+surface this repo uses (`server.castore.ContentAddressedStore`, the
+native C++ store, the durable on-disk store) shares the same
+put/get/contains/set_ref/get_ref/list_refs contract, so the historian
+wraps any of them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+
+class HistorianCache:
+    """LRU blob cache + TTL ref cache over a backing store.
+
+    `blob_budget_bytes` bounds cached blob payloads (immutable:
+    eviction only, never invalidation); `ref_ttl` bounds staleness for
+    refs written by OTHER processes (writes through this historian
+    invalidate immediately)."""
+
+    def __init__(self, backing, blob_budget_bytes: int = 64 * 1024 * 1024,
+                 ref_ttl: float = 1.0):
+        self.backing = backing
+        self.blob_budget = blob_budget_bytes
+        self.ref_ttl = ref_ttl
+        self._blobs: "OrderedDict[str, bytes]" = OrderedDict()
+        self._blob_bytes = 0
+        self._refs: Dict[str, Tuple[float, Optional[str]]] = {}
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+
+    # ------------------------------------------------------------- blobs
+
+    def put(self, content) -> str:
+        key = self.backing.put(content)
+        if isinstance(content, str):
+            content = content.encode()
+        with self._lock:
+            self._admit(key, bytes(content))
+        return key
+
+    def get(self, key: str) -> bytes:
+        with self._lock:
+            data = self._blobs.get(key)
+            if data is not None:
+                self._blobs.move_to_end(key)
+                self.hits += 1
+                return data
+            self.misses += 1
+        data = self.backing.get(key)
+        with self._lock:
+            self._admit(key, data)
+        return data
+
+    def contains(self, key: str) -> bool:
+        with self._lock:
+            if key in self._blobs:
+                return True
+        return self.backing.contains(key)
+
+    def _admit(self, key: str, data: bytes) -> None:
+        if key in self._blobs:
+            self._blobs.move_to_end(key)
+            return
+        if len(data) > self.blob_budget:
+            return  # never cache a blob bigger than the whole budget
+        self._blobs[key] = data
+        self._blob_bytes += len(data)
+        while self._blob_bytes > self.blob_budget:
+            _, old = self._blobs.popitem(last=False)
+            self._blob_bytes -= len(old)
+
+    # -------------------------------------------------------------- refs
+
+    def set_ref(self, name: str, key: str) -> None:
+        self.backing.set_ref(name, key)
+        with self._lock:
+            self._refs[name] = (time.monotonic(), key)
+
+    def get_ref(self, name: str) -> Optional[str]:
+        with self._lock:
+            hit = self._refs.get(name)
+            if hit is not None and time.monotonic() - hit[0] < self.ref_ttl:
+                self.hits += 1
+                return hit[1]
+            self.misses += 1
+        val = self.backing.get_ref(name)
+        with self._lock:
+            self._refs[name] = (time.monotonic(), val)
+        return val
+
+    def list_refs(self) -> List[str]:
+        return self.backing.list_refs()  # enumeration stays authoritative
+
+    # ------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "cached_blobs": len(self._blobs),
+                "cached_bytes": self._blob_bytes,
+                "cached_refs": len(self._refs),
+            }

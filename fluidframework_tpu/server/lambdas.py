@@ -473,6 +473,7 @@ class LocalServer:
         checkpoints: Optional[dict] = None,
         log: Optional[MessageLog] = None,
         persist_dir: Optional[str] = None,
+        historian_budget: Optional[int] = None,
     ):
         """Restart contract: pass the previous instance's `log` (the
         durable substrate, as Kafka retains topics across lambda
@@ -502,6 +503,19 @@ class LocalServer:
                         checkpoints = json.load(f)
         self.log = log if log is not None else MessageLog()
         self.storage = storage if storage is not None else ContentAddressedStore()
+        if historian_budget:
+            # Caching tier in front of storage (the historian role,
+            # server/historian): immutable blobs LRU-cache; the
+            # durable store underneath stays authoritative. Pays off
+            # over disk-backed/native stores; the pure in-memory store
+            # is already a dict lookup. Never double-wrap on restart
+            # (the restart contract passes the previous storage).
+            from .historian import HistorianCache
+
+            if not isinstance(self.storage, HistorianCache):
+                self.storage = HistorianCache(
+                    self.storage, blob_budget_bytes=historian_budget
+                )
         cp = checkpoints or {}
         self.deli = DeliLambda(self.log, cp.get("deli"))
         self.scriptorium = ScriptoriumLambda(self.log, cp.get("scriptorium"))

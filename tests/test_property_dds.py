@@ -1,0 +1,237 @@
+"""PropertyDDS family (experimental/PropertyDDS role): typed
+templates, the nested changeset algebra (apply/squash laws), and
+SharedPropertyTree convergence through the runtime stack."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.experimental import (
+    ChangeSet,
+    PropertySet,
+    PropertyTemplate,
+    SharedPropertyTree,
+    SharedPropertyTreeFactory,
+)
+from fluidframework_tpu.experimental.property_dds import _Registry
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+
+POINT = PropertyTemplate(
+    "test:point-1.0.0",
+    [{"id": "x", "typeid": "Float64"},
+     {"id": "y", "typeid": "Float64"},
+     {"id": "label", "typeid": "String"}],
+)
+
+
+def make_registry():
+    r = _Registry()
+    r.register(POINT)
+    return r
+
+
+def test_template_validation():
+    with pytest.raises(ValueError):
+        PropertyTemplate("t", [{"id": "a", "typeid": "Int32"},
+                               {"id": "a", "typeid": "Int32"}])
+    with pytest.raises(ValueError):
+        PropertyTemplate("t", [{"id": "a"}])
+
+
+def test_typed_property_set():
+    ps = PropertySet("test:point-1.0.0", make_registry())
+    assert ps.get("x") == 0.0 and ps.get("label") == ""
+    ps.set_value("x", 3)  # Int32 widens into Float64
+    assert ps.get("x") == 3.0
+    with pytest.raises(TypeError):
+        ps.set_value("label", 7)
+    with pytest.raises(KeyError):
+        ps.get("nope")
+    # Round-trip.
+    back = PropertySet.from_json(ps.to_json(), make_registry())
+    assert back.to_json() == ps.to_json()
+
+
+def test_changeset_apply_and_squash_laws():
+    reg = make_registry()
+
+    def fresh():
+        ps = PropertySet("NodeProperty", reg)
+        return ps
+
+    a = ChangeSet({"insert": {"p": {
+        "typeid": "test:point-1.0.0",
+        "fields": {"x": {"value": 1.0, "typeid": "Float64"},
+                   "y": {"value": 2.0, "typeid": "Float64"},
+                   "label": {"value": "P", "typeid": "String"}},
+    }}})
+    b = ChangeSet({"modify": {"p": {"modify": {"x": {"value": 9.0}}}}})
+    c = ChangeSet({"remove": ["p"]})
+
+    # squash(a, b) applied == a then b applied (the squash law).
+    s1, s2 = fresh(), fresh()
+    a.apply(s1)
+    b.apply(s1)
+    a.squash(b).apply(s2)
+    assert s1.to_json() == s2.to_json()
+    # modify-after-insert folded INTO the insert payload.
+    assert a.squash(b).data["insert"]["p"]["fields"]["x"]["value"] == 9.0
+    # remove cancels a pending insert.
+    assert "p" not in a.squash(c).data.get("insert", {})
+    s3 = fresh()
+    a.squash(c).apply(s3)
+    assert "p" not in s3.to_json()["fields"]
+    # modify of a concurrently removed child mutes.
+    s4 = fresh()
+    c2 = ChangeSet({"modify": {"ghost": {"value": 1}}})
+    c2.apply(s4)
+    assert s4.to_json()["fields"] == {}
+
+
+def make_pair():
+    registry = ChannelRegistry([SharedPropertyTreeFactory()])
+    h = MultiClientHarness(
+        2, registry,
+        channel_types=[("props", SharedPropertyTreeFactory.type_name)],
+    )
+    a = h.runtimes[0].get_datastore("default").get_channel("props")
+    b = h.runtimes[1].get_datastore("default").get_channel("props")
+    for t in (a, b):
+        t.register_template(POINT)
+    return h, a, b
+
+
+def test_shared_property_tree_convergence():
+    h, a, b = make_pair()
+    a.insert_property("origin", "test:point-1.0.0")
+    a.set_value("origin.label", "O")
+    a.commit()
+    h.process_all()
+    assert b.root.get("origin.label") == "O"
+
+    # Concurrent leaf writes: last-sequenced wins on both replicas.
+    a.set_value("origin.x", 1.0)
+    a.commit()
+    b.set_value("origin.x", 2.0)
+    b.commit()
+    h.process_all()
+    assert a.root.get("origin.x") == b.root.get("origin.x")
+
+    # Concurrent modify vs remove: the removal mutes the edit.
+    a.set_value("origin.y", 5.0)
+    a.commit()
+    b.remove_property("origin")
+    b.commit()
+    h.process_all()
+    assert a.root.to_json() == b.root.to_json()
+
+
+def test_shared_property_tree_summary_boot():
+    from fluidframework_tpu.runtime import ContainerRuntime
+    from fluidframework_tpu.runtime.summary import SummaryTree
+
+    h, a, b = make_pair()
+    a.insert_property("cfg", "NodeProperty")
+    a.insert_property("cfg.depth", "Int32")
+    a.set_value("cfg.depth", 4)
+    a.commit()
+    h.process_all()
+    wire = h.runtimes[0].summarize().to_json()
+    registry = ChannelRegistry([SharedPropertyTreeFactory()])
+    cold = ContainerRuntime(registry)
+    cold.load(SummaryTree.from_json(wire))
+    tree = cold.get_datastore("default").get_channel("props")
+    assert tree.root.get("cfg.depth") == 4
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_property_tree_fuzz_convergence(seed):
+    """Concurrent insert/set/remove AND the remove+reinsert composite
+    (the racing-structural hotspot) across two clients: replicas
+    converge every round."""
+    h, a, b = make_pair()
+    a.insert_property("n", "NodeProperty")
+    a.commit()
+    h.process_all()
+    rng = random.Random(1000 + seed)
+    names = [f"k{i}" for i in range(4)]
+    for rnd in range(25):
+        for t in (a, b):
+            for _ in range(3):
+                name = rng.choice(names)
+                path = f"n.{name}"
+                exists = name in t.root.get("n")._children
+                r = rng.random()
+                if not exists and r < 0.55:
+                    t.insert_property(path, "Int32")
+                elif exists and r < 0.45:
+                    t.set_value(path, rng.randint(0, 99))
+                elif exists and r < 0.8:
+                    t.remove_property(path)
+                elif exists:
+                    t.remove_property(path)
+                    t.insert_property(path, "Int32")
+                    t.set_value(path, rng.randint(100, 199))
+            t.commit()
+        h.process_all()
+        assert a.root.to_json() == b.root.to_json(), f"round {rnd}"
+
+
+def test_pending_insert_survives_racing_remove():
+    """B re-inserts a name while A concurrently removes it: B's insert
+    sequences later, so every replica — including B, whose optimistic
+    insert the remove popped — ends with B's payload."""
+    h, a, b = make_pair()
+    a.insert_property("k", "Int32")
+    a.commit()
+    h.process_all()
+    a.remove_property("k")
+    a.commit()
+    b.remove_property("k")
+    b.insert_property("k", "Int32")
+    b.set_value("k", 7)
+    b.commit()
+    h.process_all()
+    assert a.root.to_json() == b.root.to_json()
+    assert b.root.get("k") == 7
+
+
+def test_nested_modify_vs_replaced_child_shapes_mute():
+    """A nested modify arriving after its target container was
+    replaced by a primitive (or vice versa) mutes instead of
+    crashing/clobbering — on every replica."""
+    h, a, b = make_pair()
+    a.insert_property("c", "NodeProperty")
+    a.insert_property("c.x", "Int32")
+    a.commit()
+    h.process_all()
+    # A replaces container c with an Int32; B edits c.x concurrently.
+    a.remove_property("c")
+    a.insert_property("c", "Int32")
+    a.set_value("c", 1)
+    a.commit()
+    b.set_value("c.x", 5)
+    b.commit()
+    h.process_all()
+    assert a.root.to_json() == b.root.to_json()
+    assert a.root.get("c") == 1
+
+
+def test_echo_respects_later_pending_commits():
+    """An earlier commit's echo must not clobber optimistic values of
+    a LATER still-pending commit."""
+    h, a, b = make_pair()
+    a.insert_property("k", "Int32")
+    a.commit()
+    h.process_all()
+    a.set_value("k", 1)
+    a.commit()
+    h.runtimes[0].flush()
+    h.service.process_all()  # sequence commit 1 without delivering 2
+    a.set_value("k", 2)
+    a.commit()
+    assert a.root.get("k") == 2  # optimistic value survives the echo
+    h.process_all()
+    assert a.root.get("k") == b.root.get("k") == 2
