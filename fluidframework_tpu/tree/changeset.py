@@ -199,7 +199,7 @@ def _move_parts(base: dict) -> Tuple[dict, dict]:
           "index": base["index"], "count": base["count"]}
     ins = {"type": "insert", "path": _dst_path_post(base),
            "field": base["dst_field"], "index": _attach_gap(base),
-           "content": [None] * base["count"]}
+           "content": [None] * base["count"], "from_move": True}
     return rm, ins
 
 
@@ -260,19 +260,29 @@ def _same_field(a_path, a_field, b: dict) -> bool:
 def _sequentialize(parts: List[dict]) -> Optional[dict]:
     """Convert range-op parts expressed in ONE common frame (and in
     source-node order) into a sequentially-applicable op list: each
-    part self-rebases over its predecessors (parts are disjoint, so
-    this never re-splits; shared destination gaps resolve
-    earlier-part-first, preserving source order)."""
+    part rebases over its predecessors (a part CAN re-split over a
+    previous part — e.g. the previous part's attach landing inside
+    its range under the mutual arbitration — so parts advance as op
+    lists; shared destination gaps resolve earlier-part-first,
+    preserving source order)."""
     out: List[dict] = []
     for p in parts:
-        q: Optional[dict] = p
+        # mute_noop=False: a part can be identity-SHAPED in the
+        # common frame by coincidence (its source block adjacent
+        # to the shared destination gap) while still carrying
+        # reorder meaning through the parts' shared-gap tie
+        # resolution — only user-level ops canonicalize away.
+        # A part CAN re-split over a previous part (e.g. the previous
+        # part's attach landing inside its range under the mutual
+        # arbitration), so each part advances as an op LIST.
+        queue: Change = [copy.deepcopy(p)]
         for prev in out:
-            q = rebase_op(q, prev, base_first=True)
-            if q is None:
+            if not queue:
                 break
-            assert q.get("type") != "multi", "disjoint parts re-split"
-        if q is not None:
-            out.append(q)
+            queue, _ = _xform(
+                queue, [copy.deepcopy(prev)], True, mute_noop=False
+            )
+        out.extend(queue)
     if not out:
         return None
     if len(out) == 1:
@@ -280,25 +290,36 @@ def _sequentialize(parts: List[dict]) -> Optional[dict]:
     return {"type": "multi", "ops": out}
 
 
-def _range_over_base(op: dict, base: dict,
-                     base_first: bool) -> Optional[dict]:
+def _range_over_base(op: dict, base: dict, base_first: bool,
+                     absorb_attach: bool = True,
+                     dst_traveled: bool = False) -> Optional[dict]:
     """Adjust a RANGE op (remove, or the source end of a move) whose
-    (path, field) equals the base edit's. Returns op / multi / None."""
+    (path, field) equals the base edit's. Returns op / multi / None.
+    `absorb_attach=False` (the MUTUAL-containment arbitration): a
+    move-attach landing inside our moved range splits us instead of
+    being absorbed — see rebase_op's mutual check. `dst_traveled`:
+    the op's own destination gap traveled with base's moved block
+    (it sat strictly inside), so an op-move losing a claim
+    competition to a later base still re-moves the nodes WITHIN the
+    landed block instead of muting (a block-internal rearrangement)."""
     start, count = op["index"], op["count"]
     if base["type"] == "insert":
         b, n = base["index"], len(base["content"])
         if b <= start:
             return {**op, "index": start + n}
         if b < start + count:
-            if op["type"] == "move":
+            if op["type"] == "move" and (
+                absorb_attach or not base.get("from_move")
+            ):
                 # Content inserted strictly inside a moved block
                 # TRAVELS with it (the block is one unit; the dual
                 # gap rule sends inserts inside a moved range to the
                 # destination) — absorb it.
                 return {**op, "count": count + n}
-            # A remove must not consume content it never saw: split
-            # around it (parts in the common post-base frame, then
-            # sequentialized).
+            # A remove (or an earlier move losing the mutual-
+            # containment arbitration) must not consume content it
+            # never saw: split around it (parts in the common
+            # post-base frame, then sequentialized).
             left = b - start
             return _sequentialize([
                 {**op, "index": start, "count": left},
@@ -320,7 +341,7 @@ def _range_over_base(op: dict, base: dict,
             # Our range holds no moved-out nodes; only the attach side
             # can shift or split it.
             if _same_field(op["path"], op["field"], ins):
-                return _range_over_base(op, ins, base_first)
+                return _range_over_base(op, ins, base_first, absorb_attach)
             return op
         b, n = base["index"], base["count"]
         o_start, o_end = start, start + count
@@ -334,45 +355,57 @@ def _range_over_base(op: dict, base: dict,
             return _multi_map(
                 p,
                 lambda q: (
-                    _range_over_base(q, ins, base_first)
+                    _range_over_base(q, ins, base_first, absorb_attach)
                     if _same_field(q["path"], q.get("field"), ins)
                     else q
                 ),
             )
-        # Overlapping nodes were carried to base's destination.
-        parts: List[dict] = []
-        for lo, hi, at_dst in (
-            (o_start, ov_lo, False), (ov_lo, ov_hi, True),
-            (ov_hi, o_end, False),
-        ):
-            if lo >= hi:
-                continue
-            cnt = hi - lo
-            if at_dst:
-                if op["type"] == "move" and not base_first:
-                    continue  # base sequenced LATER: its move wins
-                # Follow: the nodes now live at base's destination.
-                follow = {
-                    **op,
-                    "path": _dst_path_post(base),
-                    "field": base["dst_field"],
-                    "index": _attach_gap(base) + (lo - b),
-                    "count": cnt,
-                }
-                parts.append(follow)
-            else:
-                part = _range_over_base(
-                    {**op, "index": lo, "count": cnt}, rm, base_first
-                )
-                part = _multi_map(
-                    part,
-                    lambda q: (
-                        _range_over_base(q, ins, base_first)
-                        if _same_field(q["path"], q.get("field"), ins)
-                        else q
-                    ),
-                )
-                parts.extend(_flatten_one(part))
+        # Overlapping nodes were carried to base's destination. The
+        # remainder sub-ranges (outside the overlap) adjust first so
+        # we can tell whether base's attach was ABSORBED into one of
+        # them — if it was, the moved nodes (overlap included) are
+        # already re-claimed inside the absorbing range, and a follow
+        # part would DOUBLE-claim them (the base rearranged nodes
+        # within our block; no chase needed).
+        absorbed = False
+
+        def _remainder(lo: int, hi: int) -> List[dict]:
+            nonlocal absorbed
+            part = _range_over_base(
+                {**op, "index": lo, "count": hi - lo}, rm, base_first
+            )
+
+            def fix(q: dict) -> Optional[dict]:
+                nonlocal absorbed
+                if _same_field(q["path"], q.get("field"), ins):
+                    r = _range_over_base(q, ins, base_first, absorb_attach)
+                    if (
+                        r is not None
+                        and r.get("type") != "multi"
+                        and r.get("count", 0) > q["count"]
+                    ):
+                        absorbed = True
+                    return r
+                return q
+
+            return _flatten_one(_multi_map(part, fix))
+
+        pre_parts = _remainder(o_start, ov_lo) if o_start < ov_lo else []
+        post_parts = _remainder(ov_hi, o_end) if ov_hi < o_end else []
+        follow_parts: List[dict] = []
+        muted_claim = (
+            op["type"] == "move" and not base_first and not dst_traveled
+        )
+        if not muted_claim and not absorbed:
+            # Follow: the nodes now live at base's destination.
+            follow_parts = [{
+                **op,
+                "path": _dst_path_post(base),
+                "field": base["dst_field"],
+                "index": _attach_gap(base) + (ov_lo - b),
+                "count": ov_hi - ov_lo,
+            }]
+        parts = pre_parts + follow_parts + post_parts
         if not parts:
             return None
         # Parts were built in source-node order in the common
@@ -396,11 +429,15 @@ def _multi_map(op: Optional[dict], fn) -> Optional[dict]:
 
 
 def _gap_over_base(index: int, path, field, base: dict,
-                   base_first: bool):
+                   base_first: bool, travel: bool = True):
     """Adjust an insertion GAP (insert index, or a move's destination
     gap) in (path, field) over `base`. Returns ``(index, path,
     field)`` — a gap strictly inside a base-moved block TRAVELS with
-    it to the destination field."""
+    it to the destination field. `travel=False` (the
+    mutual-containment arbitration for a LATER move that will absorb
+    base's block — see rebase_op): the gap slides to the detach start
+    instead, since traveling would land it inside its own absorbed
+    range (a self-cycle)."""
     if base["type"] == "setValue":
         return index, path, field
     if base["type"] == "move":
@@ -413,7 +450,7 @@ def _gap_over_base(index: int, path, field, base: dict,
         adjacency = None
         if _same_field(path, field, rm):
             b, n = base["index"], base["count"]
-            if b < idx < b + n:
+            if travel and b < idx < b + n:
                 # A gap strictly inside the moved block travels with
                 # it to the destination (content is one unit; the
                 # dual: the move absorbs content inserted there).
@@ -485,7 +522,8 @@ def _src_inside_removed(rm_op: dict, descendant_path: List[list]) -> bool:
     )
 
 
-def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
+def rebase_op(op: dict, base: dict, base_first: bool = True,
+              mute_noop: bool = True) -> Optional[dict]:
     """Rebase one op over one base op (both relative to the same start
     state); returns the adjusted op (possibly a {"type": "multi"}
     bundle) relative to post-base state, or None if muted (its target
@@ -507,16 +545,24 @@ def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
       (the move absorbs it); inserted inside a REMOVED range it stays,
       sliding to the range start (removes split around it).
 
-    Known limitation (excluded from the nested fuzz, pinned in
-    tests/test_tree_moves.py): chains of same-field moves competing
-    for overlapping blocks can resolve position ties
-    direction-dependently; the reference's full move-effect table
-    carries per-move-id state across the whole changeset to close
-    these — a later-round depth item.
+    Overlapping/competing block claims (the reference's per-move-id
+    move-effect table, sequence-field/moveEffectTable.ts) resolve via
+    three arbitration rules, exhaustively verified convergent
+    (tests/test_tree_moves.py sweeps: 2916 + 11025 pairs, zero
+    divergence):
+    - parts sequentialize in ONE post-base frame (destination gap
+      converts before the source range splits);
+    - MUTUAL containment (each move's gap strictly inside the other's
+      block): the later move absorbs but its gap slides instead of
+      traveling (self-cycle guard); the earlier splits around the
+      later's attach instead of absorbing;
+    - a losing earlier move whose destination traveled with the
+      winner's block re-moves nodes WITHIN the landed block instead
+      of muting (block-internal rearrangement).
     """
     if _is_noop_move(base):
         return op  # no-op base: nothing to adjust for
-    if _is_noop_move(op):
+    if mute_noop and _is_noop_move(op):
         return None  # an identity move rebases to nothing
     orig = op
     new_path = _rebase_path(op["path"], base, base_first)
@@ -602,8 +648,47 @@ def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
         return op
 
     if op["type"] == "move":
+        # MUTUAL containment (in the common frame, using the ORIGINAL
+        # coordinates): our gap sits strictly inside base's moved
+        # block AND base's gap sits strictly inside ours — cyclic
+        # block claims, which absorbed each other into a
+        # direction-dependent identity. Arbitrate by sequencing: the
+        # LATER move absorbs as usual; the EARLIER one (rebasing over
+        # a later base) splits around the base's attach instead
+        # (reference: per-move-id move-effect table,
+        # sequence-field/moveEffectTable.ts).
+        gap_in_base_block = (
+            base["type"] == "move"
+            and orig["dst_path"] == base["path"]
+            and orig["dst_field"] == base["field"]
+            and base["index"] < orig["dst_index"]
+            < base["index"] + base["count"]
+        )
+        mutual = (
+            gap_in_base_block
+            and base["dst_path"] == orig["path"]
+            and base["dst_field"] == orig["field"]
+            and orig["index"] < base["dst_index"]
+            < orig["index"] + orig["count"]
+        )
+        # Destination end FIRST: the gap converts to the post-base
+        # frame, so the source parts built below — and their
+        # sequentialization, whose per-part rebases adjust this gap
+        # over earlier parts — all share ONE frame. (Adjusting the gap
+        # after sequentialization composed the base- and
+        # preceding-part shifts in the wrong order, the former 52-pair
+        # same-field divergence class.)
+        # Did our destination gap travel with base's moved block (it
+        # sat strictly inside base's source range)? A losing earlier
+        # move whose destination traveled still rearranges WITHIN the
+        # landed block instead of muting.
+        traveled = gap_in_base_block and not (mutual and base_first)
+        d, dp, df = _gap_over_base(
+            op["dst_index"], op["dst_path"], op["dst_field"], base,
+            base_first, travel=not (mutual and base_first),
+        )
+        op = {**op, "dst_index": d, "dst_path": dp, "dst_field": df}
         # Source end: a range, like remove (follow/mute rules apply).
-        src_view = {**op}
         if _same_field(op["path"], op["field"], base) or base["type"] == "move":
             if base["type"] == "move":
                 affected = (
@@ -614,18 +699,12 @@ def rebase_op(op: dict, base: dict, base_first: bool = True) -> Optional[dict]:
             else:
                 affected = True
             if affected:
-                src_view = _range_over_base(op, base, base_first)
-                if src_view is None:
-                    return None
-        # Destination end: a gap.
-        def fix_dst(q: dict) -> Optional[dict]:
-            d, dp, df = _gap_over_base(
-                q["dst_index"], q["dst_path"], q["dst_field"], base,
-                base_first,
-            )
-            return {**q, "dst_index": d, "dst_path": dp, "dst_field": df}
-
-        return _multi_map(src_view, fix_dst)
+                return _range_over_base(
+                    op, base, base_first,
+                    absorb_attach=not (mutual and not base_first),
+                    dst_traveled=traveled,
+                )
+        return op
 
     return op
 
@@ -664,7 +743,8 @@ def rebase_change(change: Change, over: Change, over_first: bool = True) -> Chan
     return a
 
 
-def _xform(A: Change, B: Change, flag: bool) -> Tuple[Change, Change]:
+def _xform(A: Change, B: Change, flag: bool,
+           mute_noop: bool = True) -> Tuple[Change, Change]:
     """Inclusion transform of sequential op lists sharing one start
     state: returns ``(A', B')`` with A' applying after B, and B'
     after A. `flag`: B's content wins position ties (B sequenced
@@ -672,13 +752,18 @@ def _xform(A: Change, B: Change, flag: bool) -> Tuple[Change, Change]:
     if not A or not B:
         return list(A), list(B)
     if len(A) == 1 and len(B) == 1:
-        a_p = _flatten_one(rebase_op(A[0], B[0], base_first=flag))
-        b_p = _flatten_one(rebase_op(B[0], A[0], base_first=not flag))
+        a_p = _flatten_one(
+            rebase_op(A[0], B[0], base_first=flag, mute_noop=mute_noop)
+        )
+        b_p = _flatten_one(
+            rebase_op(B[0], A[0], base_first=not flag,
+                      mute_noop=mute_noop)
+        )
         return a_p, b_p
     if len(A) > 1:
-        A1p, Bp = _xform(A[:1], B, flag)
-        A2p, Bpp = _xform(A[1:], Bp, flag)
+        A1p, Bp = _xform(A[:1], B, flag, mute_noop)
+        A2p, Bpp = _xform(A[1:], Bp, flag, mute_noop)
         return A1p + A2p, Bpp
-    Ap, B1p = _xform(A, B[:1], flag)
-    App, B2p = _xform(Ap, B[1:], flag)
+    Ap, B1p = _xform(A, B[:1], flag, mute_noop)
+    App, B2p = _xform(Ap, B[1:], flag, mute_noop)
     return App, B1p + B2p

@@ -327,8 +327,8 @@ def _flat_forest(n=5):
     return f
 
 
-def _tp1(A, B):
-    start = _flat_forest()
+def _tp1(A, B, n=5):
+    start = _flat_forest(n)
     left = start.clone()
     a1 = copy.deepcopy(A)
     left.apply(a1)
@@ -354,32 +354,47 @@ def test_identity_moves_are_neutral():
             )
 
 
-def test_same_field_move_pair_corner():
-    """Exhaustive same-field single-move pairs over a 5-node field:
-    pins the EXACT remaining divergence count of the documented
-    corner (competing/interleaved block claims, which need the
-    reference's per-move-id move-effect table,
-    sequence-field/moveEffectTable.ts). Round 4 cut it from 150+ to
-    52 of 2916; a fix should shrink this number, and any regression
-    grows it loudly."""
+def _pair_sweep(n, counts):
+    """Exhaustive same-field single-move TP1 sweep over an n-node
+    field; returns (total, diverging)."""
     import itertools
 
-    n = 5
     diverging = 0
     total = 0
-    for ai, ac, ad in itertools.product(range(n), (1, 2), range(n + 1)):
+    for ai, ac, ad in itertools.product(range(n), counts, range(n + 1)):
         if ai + ac > n or ad > n:
             continue
-        for bi, bc, bd in itertools.product(
-            range(n), (1, 2), range(n + 1)
-        ):
+        for bi, bc, bd in itertools.product(range(n), counts, range(n + 1)):
             if bi + bc > n or bd > n:
                 continue
             total += 1
             if not _tp1([_flat_move(ai, ac, ad)],
-                        [_flat_move(bi, bc, bd)]):
+                        [_flat_move(bi, bc, bd)], n=n):
                 diverging += 1
+    return total, diverging
+
+
+def test_same_field_move_pair_corner():
+    """Exhaustive same-field single-move pairs over a 5-node field:
+    the formerly-pinned corner (competing/interleaved block claims,
+    the reference's per-move-id move-effect table role,
+    sequence-field/moveEffectTable.ts) is CLOSED — round 5's
+    one-frame sequentialization + mutual-containment arbitration +
+    traveled-destination follow rules take this from 52/2916
+    diverging to ZERO. Any divergence is now a regression."""
+    total, diverging = _pair_sweep(5, (1, 2))
     assert total == 2916
-    assert diverging <= 52, (
+    assert diverging == 0, (
         f"same-field move-pair convergence regressed: {diverging}/2916"
+    )
+
+
+def test_same_field_move_pair_wide_sweep():
+    """Wider exhaustive sweep: 6-node field, counts up to 3 — covers
+    strict-containment and mutual-containment block claims the 5-node
+    sweep cannot express. 11,025 pairs, zero divergence."""
+    total, diverging = _pair_sweep(6, (1, 2, 3))
+    assert total == 11025
+    assert diverging == 0, (
+        f"wide move-pair convergence regressed: {diverging}/11025"
     )
