@@ -52,28 +52,52 @@ class UndoRedoStackManager:
 
     # ------------------------------------------------------------ revert
 
-    def _revert_group(self, group: List[Revertible], into: List[List[Revertible]]) -> None:
+    def _revert_group(
+        self,
+        group: List[Revertible],
+        source: List[List[Revertible]],
+        into: List[List[Revertible]],
+    ) -> None:
         self._reverting = True
         self._revert_target = []
+        reverted = 0
         try:
             for r in reversed(group):
                 r.revert()
+                reverted += 1
+        except BaseException:
+            # Exception safety (a revertible CAN raise — e.g. a tree
+            # commit evicted beyond the collab window): the unreverted
+            # prefix goes back on the stack it came from, and whatever
+            # the reverted suffix captured becomes a (partial) inverse
+            # group — no state is stranded outside both stacks.
+            remaining = group[: len(group) - reverted]
+            if remaining:
+                source.append(remaining)
+            if self._revert_target:
+                into.append(self._revert_target)
+            raise
+        else:
+            if self._revert_target:
+                # An empty capture (e.g. an inverse fully muted by
+                # concurrent history) records nothing — pushing []
+                # would create phantom undo/redo entries.
+                into.append(self._revert_target)
         finally:
             self._reverting = False
-        into.append(self._revert_target)
-        self._revert_target = None
+            self._revert_target = None
 
     def undo_operation(self) -> bool:
         if not self._undo:
             return False
         self.close_current_operation()
-        self._revert_group(self._undo.pop(), self._redo)
+        self._revert_group(self._undo.pop(), self._undo, self._redo)
         return True
 
     def redo_operation(self) -> bool:
         if not self._redo:
             return False
-        self._revert_group(self._redo.pop(), self._undo)
+        self._revert_group(self._redo.pop(), self._redo, self._undo)
         return True
 
     @property
@@ -243,6 +267,72 @@ class SharedStringUndoRedoHandler:
 
     def close(self) -> None:
         self.s.off("sequenceDelta", self._on_delta)
+
+
+# ------------------------------------------------------------------- tree
+
+
+class _TreeCommitRevertible:
+    """Undo one SharedTree commit through its repair data: the change
+    as applied carries everything invert needs (removed content, prior
+    values, move inverses — the reference's repair store,
+    captured by Forest.apply). The inverse rebases over every commit
+    applied AFTER the target (trunk commits past it plus the local
+    branch — both maintained in current coordinates by the
+    EditManager sandwich) and lands as a normal new edit."""
+
+    def __init__(self, tree, commit):
+        self.tree = tree
+        self.commit = commit
+
+    def revert(self) -> None:
+        from ..tree.changeset import invert, rebase_change
+
+        if self.tree.in_transaction:
+            # The inverse is computed in main-branch coordinates; an
+            # open transaction would swallow it into its fork frame
+            # (and discard it on abort) — refuse rather than corrupt.
+            raise RuntimeError(
+                "cannot undo while a transaction is open; commit or "
+                "abort it first"
+            )
+        em = self.tree.edits
+        carried = []
+        found = False
+        for lst in (em.trunk, em.local):
+            for k in lst:
+                if found:
+                    carried.extend(k.change)
+                elif k is self.commit:
+                    found = True
+        if not found:
+            # Evicted past the MSN window: nothing left to rebase
+            # against (the reference's repair store is similarly
+            # bounded by the collab window).
+            raise RuntimeError("commit evicted beyond the undo window")
+        inverse = invert(self.commit.change)
+        rebased = rebase_change(inverse, carried, over_first=True)
+        if rebased:
+            self.tree.edit(rebased)
+
+
+class SharedTreeUndoRedoHandler:
+    """Connects a SharedTree to the undo/redo stack: every local
+    commit (a plain edit or a squashed transaction) pushes a
+    repair-data revertible. Undoing submits the rebased inverse as a
+    new commit, which itself pushes a revertible — redo falls out of
+    the stack manager's revert-capture."""
+
+    def __init__(self, stack: UndoRedoStackManager, tree):
+        self.stack = stack
+        self.tree = tree
+        self._sub = tree.on("localCommit", self._on_commit)
+
+    def _on_commit(self, commit) -> None:
+        self.stack.push(_TreeCommitRevertible(self.tree, commit))
+
+    def close(self) -> None:
+        self.tree.off("localCommit", self._on_commit)
 
 
 # ----------------------------------------------------------------- matrix

@@ -16,7 +16,10 @@ from typing import Any, List
 
 from .changeset import (
     Change,
+    compose,
     insert_op,
+    invert,
+    move_op,
     rebase_change,
     remove_op,
     set_value_op,
@@ -38,6 +41,13 @@ class SharedTreeBranch:
         self._fork_local = list(tree.edits.local)
         self.commits: List[Change] = []
         self.merged = False
+        # Transaction stack (branch.ts:95 startTransaction backed by
+        # transactionStack.ts:12): each open transaction marks the
+        # commit-list length at its start. Commit squashes the marked
+        # suffix into ONE composed commit; abort unwinds it through
+        # the repair data the forest captured at apply time (removed
+        # content / prior values / move inverses).
+        self._tx_marks: List[int] = []
 
     # ------------------------------------------------------------ editing
 
@@ -57,6 +67,46 @@ class SharedTreeBranch:
 
     def set_value(self, path, value) -> None:
         self.edit([set_value_op(path, value)])
+
+    def move_node(self, path, field, index, count, dst_path, dst_field,
+                  dst_index) -> None:
+        self.edit([
+            move_op(path, field, index, count, dst_path, dst_field,
+                    dst_index)
+        ])
+
+    # ------------------------------------------------------- transactions
+
+    @property
+    def in_transaction(self) -> bool:
+        return bool(self._tx_marks)
+
+    def start_transaction(self) -> None:
+        """Open a (nestable) transaction (branch.ts:95
+        startTransaction): subsequent edits group until commit/abort."""
+        assert not self.merged, "branch already merged"
+        self._tx_marks.append(len(self.commits))
+
+    def commit_transaction(self) -> Change:
+        """Squash the transaction's commits into ONE composed commit
+        (branch.ts commitTransaction: the transaction lands as a
+        single atomic change). Returns the squashed change."""
+        assert self._tx_marks, "no open transaction"
+        mark = self._tx_marks.pop()
+        squashed = compose(self.commits[mark:])
+        self.commits[mark:] = [squashed] if squashed else []
+        return squashed
+
+    def abort_transaction(self) -> None:
+        """Unwind the transaction via repair data (branch.ts
+        abortTransaction): every commit since the mark inverts —
+        removed content re-inserts, prior values restore, moves
+        reverse — newest first."""
+        assert self._tx_marks, "no open transaction"
+        mark = self._tx_marks.pop()
+        for change in reversed(self.commits[mark:]):
+            self.forest.apply(invert(change))
+        del self.commits[mark:]
 
     # ------------------------------------------------------------- rebase
 
@@ -86,6 +136,7 @@ class SharedTreeBranch:
         trunk commits sequenced since the fork (earlier branch commits
         carrying through, later ones rebasing over the carried base),
         then the branch view rebuilds from the tree's current forest."""
+        assert not self._tx_marks, "commit/abort open transactions first"
         evicted = getattr(self.tree.edits, "evicted_seq", 0)
         if self.base_seq < evicted:
             raise RuntimeError(
@@ -106,13 +157,17 @@ class SharedTreeBranch:
 
     # -------------------------------------------------------------- merge
 
-    def merge_into(self) -> None:
+    def merge_into(self, id_count: int = 0) -> None:
         """Land the branch on the main tree (branch.ts merge): rebase
         up to date, then submit each commit as a normal tree edit (the
-        tree's optimistic-local + op-stream path takes over)."""
+        tree's optimistic-local + op-stream path takes over).
+        `id_count`: ids allocated on behalf of this branch's commits
+        (a squashed transaction's accumulated allocation), carried by
+        the first non-empty landed commit."""
         self.rebase_onto()
         for c in self.commits:
             if c:
-                self.tree.edit(copy.deepcopy(c))
+                self.tree.edit(copy.deepcopy(c), id_count)
+                id_count = 0
         self.commits = []
         self.merged = True

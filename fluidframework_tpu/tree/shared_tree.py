@@ -16,6 +16,7 @@ the current JSON tree and `generate_id()` via the id-compressor.
 
 from __future__ import annotations
 
+import contextlib
 import copy
 import json
 from typing import Any, List, Optional
@@ -36,6 +37,8 @@ class SharedTree(SharedObject):
         self.edits = EditManager(self.forest, session=None)
         self.id_compressor = IdCompressor(session_id=f"detached-{id(self)}")
         self.schema = None  # TreeSchema; rides ops + summary
+        self._tx_branch = None  # open-transaction fork (see transaction API)
+        self._tx_id_count = 0  # ids allocated inside the open transaction
 
     def on_connected(self) -> None:
         cid = self.runtime.client_id
@@ -45,6 +48,8 @@ class SharedTree(SharedObject):
     # ------------------------------------------------------------ editing
 
     def view(self) -> dict:
+        if self._tx_branch is not None:
+            return self._tx_branch.view()  # uncommitted transaction view
         return self.forest.to_json()
 
     def use_chunked_forest(self) -> None:
@@ -61,6 +66,14 @@ class SharedTree(SharedObject):
 
     def _commit(self, change: Change, id_count: int = 0) -> None:
         """Apply locally + submit (SharedTreeCore.submitCommit)."""
+        if self._tx_branch is not None:
+            # An open transaction captures all edits; nothing rides
+            # the wire until commit_transaction squashes and lands it.
+            # id allocations accumulate so the squashed commit carries
+            # the transaction's full idCount.
+            self._tx_branch.edit(change)
+            self._tx_id_count += id_count
+            return
         self.forest.apply(change)
         if self.edits.session is None or self.services is None:
             # Detached: edits fold straight into the base forest.
@@ -74,6 +87,9 @@ class SharedTree(SharedObject):
             },
             commit,
         )
+        # The applied change carries its repair data (removed content,
+        # prior values, move inverses) — the undo stack's capture hook.
+        self.emit("localCommit", commit)
 
     def insert_node(self, path: List[list], field: str, index: int,
                     content: List[dict], id_count: int = 0) -> None:
@@ -176,6 +192,62 @@ class SharedTree(SharedObject):
         from .branch import SharedTreeBranch
 
         return SharedTreeBranch(self)
+
+    # ------------------------------------------------------- transactions
+
+    @property
+    def in_transaction(self) -> bool:
+        return self._tx_branch is not None
+
+    def start_transaction(self) -> None:
+        """Open a (nestable) transaction on the tree's main view
+        (sharedTree.ts transaction API over branch.ts:95): edits
+        accumulate on an internal fork; `commit_transaction` lands
+        them as ONE atomic squashed wire commit; `abort_transaction`
+        unwinds them via repair data. `view()` shows the in-progress
+        transaction state."""
+        if self._tx_branch is None:
+            self._tx_branch = self.branch()
+            self._tx_id_count = 0
+        self._tx_branch.start_transaction()
+
+    def commit_transaction(self) -> None:
+        assert self._tx_branch is not None, "no open transaction"
+        self._tx_branch.commit_transaction()
+        if not self._tx_branch.in_transaction:
+            branch, self._tx_branch = self._tx_branch, None
+            try:
+                # Squash left at most one commit; merge rebases it
+                # over anything integrated mid-transaction and lands
+                # it WITH the transaction's accumulated idCount.
+                branch.merge_into(self._tx_id_count)
+            except BaseException:
+                # Nothing was submitted (rebase_onto failed before
+                # any edit): keep the transaction open so the caller
+                # can retry later or abort explicitly.
+                self._tx_branch = branch
+                branch._tx_marks.append(0)
+                raise
+            self._tx_id_count = 0
+
+    def abort_transaction(self) -> None:
+        assert self._tx_branch is not None, "no open transaction"
+        self._tx_branch.abort_transaction()
+        if not self._tx_branch.in_transaction:
+            self._tx_branch = None  # view falls back to the main forest
+            self._tx_id_count = 0
+
+    @contextlib.contextmanager
+    def transaction(self):
+        """Context manager: commit on success, abort on exception."""
+        self.start_transaction()
+        try:
+            yield self
+        except BaseException:
+            self.abort_transaction()
+            raise
+        else:
+            self.commit_transaction()
 
     # ------------------------------------------------------------ inbound
 

@@ -1,0 +1,363 @@
+"""SharedTree transactions + repair-data undo/redo.
+
+Reference seams: `SharedTreeBranch` transactions
+(packages/dds/tree/src/shared-tree-core/branch.ts:95 startTransaction,
+transactionStack.ts:12) — squash-on-commit, abort-via-repair-data —
+and the undo/redo path through captured repair data rebased over
+subsequent commits.
+"""
+
+import pytest
+
+from fluidframework_tpu.framework.undo_redo import (
+    SharedTreeUndoRedoHandler,
+    UndoRedoStackManager,
+)
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.testing.mocks import MultiClientHarness
+from fluidframework_tpu.tree.shared_tree import SharedTreeFactory
+
+
+def _harness(n=2):
+    reg = ChannelRegistry([SharedTreeFactory()])
+    h = MultiClientHarness(
+        n, reg, channel_types=[("t", SharedTreeFactory.type_name)]
+    )
+    trees = [
+        rt.get_datastore("default").get_channel("t") for rt in h.runtimes
+    ]
+    return h, trees
+
+
+def _vals(tree, field="f"):
+    return [n.get("value") for n in tree.view()["fields"].get(field, [])]
+
+
+# ---------------------------------------------------------------------------
+# branch transactions
+# ---------------------------------------------------------------------------
+
+
+def test_branch_transaction_commit_squashes():
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(3)])
+    h.process_all()
+    b = t0.branch()
+    b.start_transaction()
+    b.insert_node([], "f", 3, [{"type": "n", "value": 3}])
+    b.insert_node([], "f", 4, [{"type": "n", "value": 4}])
+    b.remove_node([], "f", 0)
+    squashed = b.commit_transaction()
+    # One composed commit replaced the three.
+    assert len(b.commits) == 1
+    assert len(squashed) == 3
+    assert [n.get("value") for n in b.view()["fields"]["f"]] == [1, 2, 3, 4]
+    b.merge_into()
+    h.process_all()
+    assert _vals(t0) == [1, 2, 3, 4]
+    assert t0.view() == t1.view()
+
+
+def test_branch_transaction_abort_restores_via_repair_data():
+    h, (t0, _) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(3)])
+    h.process_all()
+    b = t0.branch()
+    b.set_value([["f", 1]], "kept")
+    b.start_transaction()
+    b.remove_node([], "f", 0, 2)          # repair data: removed subtrees
+    b.set_value([["f", 0]], "scratch")    # repair data: prior value
+    b.move_node([], "f", 0, 1, [], "g", 0)
+    b.insert_node([], "f", 0, [{"type": "n", "value": 99}])
+    b.abort_transaction()
+    # Back to the pre-transaction branch state, pre-tx edit intact.
+    assert [n.get("value") for n in b.view()["fields"]["f"]] == [0, "kept", 2]
+    assert "g" not in b.view()["fields"]
+    assert len(b.commits) == 1  # the pre-transaction set_value
+
+
+def test_branch_nested_transactions():
+    h, (t0, _) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    b = t0.branch()
+    b.start_transaction()
+    b.insert_node([], "f", 1, [{"type": "n", "value": 1}])
+    b.start_transaction()                 # nested
+    b.insert_node([], "f", 2, [{"type": "n", "value": 2}])
+    b.abort_transaction()                 # inner aborts alone
+    assert [n.get("value") for n in b.view()["fields"]["f"]] == [0, 1]
+    b.start_transaction()
+    b.insert_node([], "f", 2, [{"type": "n", "value": 22}])
+    b.commit_transaction()                # inner commits into outer
+    assert b.in_transaction
+    b.commit_transaction()                # outer: everything squashes
+    assert not b.in_transaction
+    assert len(b.commits) == 1
+    assert [n.get("value") for n in b.view()["fields"]["f"]] == [0, 1, 22]
+
+
+# ---------------------------------------------------------------------------
+# tree-level transactions
+# ---------------------------------------------------------------------------
+
+
+def test_tree_transaction_lands_one_atomic_commit():
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    sent = []
+    t0.on("localCommit", lambda c: sent.append(c))
+    t0.start_transaction()
+    t0.insert_node([], "f", 1, [{"type": "n", "value": 1}])
+    t0.insert_node([], "f", 2, [{"type": "n", "value": 2}])
+    assert t0.in_transaction
+    # Uncommitted edits visible locally, NOT on the wire.
+    assert _vals(t0) == [0, 1, 2]
+    h.process_all()
+    assert _vals(t1) == [0]
+    t0.commit_transaction()
+    assert len(sent) == 1 and len(sent[0].change) == 2  # one squashed commit
+    h.process_all()
+    assert _vals(t1) == [0, 1, 2]
+    assert t0.view() == t1.view()
+
+
+def test_tree_transaction_abort_leaves_no_trace():
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    t0.start_transaction()
+    t0.remove_node([], "f", 0)
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 9}])
+    assert _vals(t0) == [9]
+    t0.abort_transaction()
+    assert _vals(t0) == [0]
+    h.process_all()
+    assert t0.view() == t1.view()
+
+
+def test_tree_transaction_with_concurrent_remote_edits():
+    """Remote commits integrate mid-transaction; the squashed commit
+    rebases over them at land time and replicas converge."""
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(4)])
+    h.process_all()
+    t0.start_transaction()
+    t0.remove_node([], "f", 3)
+    t0.insert_node([], "f", 0, [{"type": "n", "value": "tx"}])
+    # Concurrent remote edit sequences while the transaction is open.
+    t1.insert_node([], "f", 2, [{"type": "n", "value": "remote"}])
+    h.process_all()
+    t0.commit_transaction()
+    h.process_all()
+    assert t0.view() == t1.view()
+    vals = _vals(t0)
+    assert "tx" in vals and "remote" in vals and 3 not in vals
+
+
+def test_tree_transaction_context_manager():
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    with t0.transaction():
+        t0.insert_node([], "f", 1, [{"type": "n", "value": 1}])
+    h.process_all()
+    assert _vals(t1) == [0, 1]
+    with pytest.raises(ValueError):
+        with t0.transaction():
+            t0.insert_node([], "f", 0, [{"type": "n", "value": "x"}])
+            raise ValueError("boom")
+    assert _vals(t0) == [0, 1]  # aborted
+    h.process_all()
+    assert t0.view() == t1.view()
+
+
+# ---------------------------------------------------------------------------
+# undo / redo through the repair store
+# ---------------------------------------------------------------------------
+
+
+def _with_undo(tree):
+    stack = UndoRedoStackManager()
+    SharedTreeUndoRedoHandler(stack, tree)
+    return stack
+
+
+def test_tree_undo_insert_remove_setvalue():
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(3)])
+    h.process_all()
+    stack = _with_undo(t0)
+    t0.remove_node([], "f", 1)
+    stack.close_current_operation()
+    t0.set_value([["f", 0]], "edited")
+    stack.close_current_operation()
+    h.process_all()
+    assert _vals(t0) == ["edited", 2]
+    assert stack.undo_operation()          # undo setValue
+    h.process_all()
+    assert _vals(t0) == [0, 2]
+    assert stack.undo_operation()          # undo remove: content restores
+    h.process_all()
+    assert _vals(t0) == [0, 1, 2]
+    assert t0.view() == t1.view()
+    assert stack.redo_operation()          # redo the remove
+    h.process_all()
+    assert _vals(t0) == [0, 2]
+    assert t0.view() == t1.view()
+
+
+def test_tree_undo_rebases_over_concurrent_edits():
+    """Undo an ACKED commit with remote commits sequenced after it:
+    the inverse rebases over the interleaved history and every
+    replica converges."""
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(4)])
+    h.process_all()
+    stack = _with_undo(t0)
+    t0.remove_node([], "f", 1)             # removes node 1
+    stack.close_current_operation()
+    h.process_all()                        # acked into the trunk
+    t1.insert_node([], "f", 0, [{"type": "n", "value": "r"}])
+    h.process_all()                        # remote lands after it
+    assert _vals(t0) == ["r", 0, 2, 3]
+    assert stack.undo_operation()
+    h.process_all()
+    assert t0.view() == t1.view()
+    assert _vals(t0) == ["r", 0, 1, 2, 3]  # node 1 restored, remote kept
+
+
+def test_tree_undo_move():
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(3)])
+    t0.insert_node([], "g", 0, [{"type": "n", "value": "g0"}])
+    h.process_all()
+    stack = _with_undo(t0)
+    t0.move_node([], "f", 0, 2, [], "g", 1)
+    stack.close_current_operation()
+    h.process_all()
+    assert _vals(t0) == [2] and _vals(t0, "g") == ["g0", 0, 1]
+    assert stack.undo_operation()
+    h.process_all()
+    assert _vals(t0) == [0, 1, 2] and _vals(t0, "g") == ["g0"]
+    assert t0.view() == t1.view()
+
+
+def test_tree_undo_transaction_as_one_operation():
+    """A squashed transaction undoes atomically (one revertible)."""
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    stack = _with_undo(t0)
+    with t0.transaction():
+        t0.insert_node([], "f", 1, [{"type": "n", "value": 1}])
+        t0.set_value([["f", 0]], "x")
+        t0.insert_node([], "f", 2, [{"type": "n", "value": 2}])
+    stack.close_current_operation()
+    assert stack.undo_stack_size == 1
+    h.process_all()
+    assert _vals(t0) == ["x", 1, 2]
+    assert stack.undo_operation()
+    h.process_all()
+    assert _vals(t0) == [0]
+    assert t0.view() == t1.view()
+
+
+def test_tree_transaction_carries_id_count():
+    """ids allocated inside a transaction ride the squashed commit's
+    idCount so remote compressors finalize the session range."""
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    t0.start_transaction()
+    i1 = t0.generate_id()
+    t0.insert_node([], "f", 1, [{"type": "n", "value": i1}], id_count=1)
+    i2 = t0.generate_id()
+    t0.insert_node([], "f", 2, [{"type": "n", "value": i2}], id_count=1)
+    t0.commit_transaction()
+    h.process_all()
+    assert t0.view() == t1.view()
+    # The remote compressor finalized both ids: the author's session
+    # range advanced by 2 on BOTH replicas.
+    sess = str(h.runtimes[0].client_id)
+    assert t1.id_compressor._finalized.get(sess) == 2
+    assert t0.id_compressor._finalized.get(sess) == 2
+
+
+def test_tree_undo_refused_while_transaction_open():
+    h, (t0, _) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    stack = _with_undo(t0)
+    t0.set_value([["f", 0]], "x")
+    stack.close_current_operation()
+    t0.start_transaction()
+    t0.insert_node([], "f", 1, [{"type": "n", "value": 1}])
+    with pytest.raises(RuntimeError, match="transaction is open"):
+        stack.undo_operation()
+    # The refused group went back on the undo stack intact.
+    assert stack.undo_stack_size == 1
+    t0.abort_transaction()
+    assert stack.undo_operation()
+    assert _vals(t0) == [0]
+
+
+def test_revert_group_exception_safety():
+    """A raising revertible mid-group: the unreverted prefix returns
+    to its stack; the reverted suffix's capture lands as a partial
+    inverse group."""
+    class _Boom:
+        def revert(self):
+            raise RuntimeError("boom")
+
+    class _Ok:
+        def __init__(self, stack):
+            self.stack = stack
+
+        def revert(self):
+            self.stack.push(_Ok(self.stack))  # captured inverse
+
+    stack = UndoRedoStackManager()
+    stack.push(_Boom())
+    stack.push(_Ok(stack))  # reverts first (reversed order)
+    stack.close_current_operation()
+    with pytest.raises(RuntimeError, match="boom"):
+        stack.undo_operation()
+    # Unreverted prefix (_Boom) is back on undo; partial inverse on redo.
+    assert stack.undo_stack_size == 1
+    assert len(stack._redo) == 1
+
+
+def test_tree_undo_fuzz_convergence():
+    """Randomized interleaving of edits + undos across two clients:
+    replicas stay convergent after every drain."""
+    import random
+
+    rng = random.Random(7)
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": i} for i in range(5)])
+    h.process_all()
+    stack = _with_undo(t0)
+    counter = 100
+    for step in range(40):
+        for tree, is_t0 in ((t0, True), (t1, False)):
+            r = rng.random()
+            n = len(tree.view()["fields"].get("f", []))
+            if r < 0.35:
+                tree.insert_node([], "f", rng.randint(0, n),
+                                 [{"type": "n", "value": counter}])
+                counter += 1
+            elif r < 0.6 and n > 1:
+                tree.remove_node([], "f", rng.randint(0, n - 1))
+            elif r < 0.8 and n > 0:
+                tree.set_value([["f", rng.randint(0, n - 1)]], counter)
+                counter += 1
+            elif is_t0 and stack.undo_stack_size > 0 and rng.random() < 0.5:
+                stack.undo_operation()
+            if is_t0:
+                stack.close_current_operation()
+        if rng.random() < 0.6:
+            h.process_all()
+    h.process_all()
+    assert t0.view() == t1.view()
