@@ -165,6 +165,13 @@ class SharedTreeBranch:
         (a squashed transaction's accumulated allocation), carried by
         the first non-empty landed commit."""
         self.rebase_onto()
+        self.land(id_count)
+
+    def land(self, id_count: int = 0) -> None:
+        """Submit the (already-rebased) commits and close the branch.
+        Split from merge_into so callers can scope retryable failures
+        to the rebase alone — once landing starts, commits are on the
+        wire and the branch must not be replayed."""
         for c in self.commits:
             if c:
                 self.tree.edit(copy.deepcopy(c), id_count)

@@ -2,20 +2,30 @@
 
 The reference rebases commits one at a time through the change-family
 code (core/edit-manager/editManager.ts:47 trunk rebase;
-feature-libraries/sequence-field/rebase.ts index arithmetic). For the
-bulk case — rebase a large pending branch over a trunk window, the
-BASELINE.json config-4 shape — the index arithmetic is data-parallel
-across the pending ops: each trunk op adjusts EVERY pending op's
-(index, count) with the same closed-form rules. This module runs that
-as a `lax.scan` over the trunk window with all pending ops as vector
-state (one XLA dispatch for the whole rebase).
+feature-libraries/sequence-field/rebase.ts index arithmetic +
+moveEffectTable.ts move arbitration). For the bulk case — rebase a
+large pending branch over a trunk window, the BASELINE.json config-4
+shape — the index arithmetic is data-parallel across the pending ops:
+each trunk op adjusts EVERY pending op's (index, count, dst) with the
+same closed-form rules. This module runs that as a `lax.scan` over the
+trunk window with all pending ops as vector state (one XLA dispatch
+for the whole rebase).
 
-Semantics mirror changeset._adjust_index / rebase_op for single-field
-insert/remove streams exactly (differential test:
-tests/test_tree_depth.py), including: insert-over-insert
-shifts with the sequenced-earlier tie, insert sliding to a removed
-range's start, removes clipping against base removes (overlap is
-muted), and full mutes dropping the op (count -> 0).
+Semantics mirror changeset._adjust_index / _gap_over_base / rebase_op
+for single-field insert/remove/MOVE streams exactly (differential
+test: tests/test_tree_depth.py), including: insert-over-insert shifts
+with the sequenced-earlier tie, insert sliding to a removed range's
+start, removes clipping against base removes, gap TRAVEL with a base
+move's block, attach-adjacency ties (a gap hugging a moved block keeps
+its side), move-absorb of content attached strictly inside, and full
+mutes dropping the op (count -> 0).
+
+Ops are (kind, index, count, dst): dst is a move's attach gap in the
+op's own pre-frame, ignored for insert/remove. Rare structures beyond
+the vector budget FLAG for the scalar changeset path: a second split
+of the same remove, a remove PARTIALLY overlapping a base move's block
+(pre+follow+post = 3 pieces), and two moves with competing node claims
+or mutual containment (the move-effect arbitration cases).
 """
 
 from __future__ import annotations
@@ -28,127 +38,289 @@ import numpy as np
 
 K_INSERT = 0
 K_REMOVE = 1
+K_MOVE = 2
 
 
-def _piece_over_base(kind, idx, cnt, bk, bi, bn):
-    """Adjust ONE (kind, idx, cnt) piece over one base op — the
-    _adjust_index rules, vectorized and split-free."""
-    is_ins = kind == K_INSERT
+def _attach_gap(bi, bn, bj):
+    """A base move's attach gap in its own POST-DETACH frame
+    (changeset._attach_gap, single field)."""
+    return jnp.where(
+        bj >= bi + bn, bj - bn, jnp.where(bj > bi, bi, bj)
+    )
 
-    # ---- base insert: positions at/after shift right.
-    idx_after_ins = idx + jnp.where(idx >= bi, bn, 0)
 
-    # ---- base remove [bi, bi+bn): inserts inside slide to bi;
-    # removes clip: the overlap with the base range is already gone.
+def _gap_over(g, bk, bi, bn, bg):
+    """Adjust an insertion GAP over one base op (base sequenced
+    earlier: ties shift). Mirrors changeset._gap_over_base with
+    base_first=True; `bg` is the base move's post-detach attach gap
+    (ignored unless bk == K_MOVE)."""
+    g_ins = jnp.where(bi <= g, g + bn, g)
+    g_rem = jnp.where(g < bi, g, jnp.maximum(bi, g - bn))
+    # base move: strictly-inside gaps TRAVEL with the block; boundary
+    # gaps keep their adjacency side on attach ties.
+    inside = (bi < g) & (g < bi + bn)
+    travel = bg + (g - bi)
+    before = g == bi
+    g1 = jnp.where(g < bi, g, jnp.maximum(bi, g - bn))  # detach slide
+    shift_attach = (bg < g1) | ((bg == g1) & ~before)
+    g_mv = jnp.where(inside, travel, jnp.where(shift_attach, g1 + bn, g1))
+    return jnp.where(
+        bk == K_INSERT, g_ins, jnp.where(bk == K_REMOVE, g_rem, g_mv)
+    )
+
+
+def _remove_over_rm(idx, cnt, bi, bn):
+    """Clip a range against a base REMOVE [bi, bi+bn) — the overlap is
+    already gone (changeset._range_over_base remove branch)."""
     lo = jnp.maximum(idx, bi)
     hi = jnp.minimum(idx + cnt, bi + bn)
     overlap = jnp.maximum(0, hi - lo)
-    new_cnt_rem = cnt - overlap
-    start_rem = jnp.where(
-        idx < bi, idx, jnp.where(idx < bi + bn, bi, idx - bn)
-    )
-    start_rem = jnp.where(
-        (kind == K_REMOVE) & (idx >= bi) & (idx < bi + bn),
-        bi,
-        start_rem,
-    )
-    idx_after_rem = jnp.where(
-        is_ins,
-        jnp.where(idx < bi, idx, jnp.maximum(bi, idx - bn)),
-        start_rem,
-    )
-    cnt_after_rem = jnp.where(is_ins, cnt, new_cnt_rem)
-
-    new_idx = jnp.where(bk == K_INSERT, idx_after_ins, idx_after_rem)
-    new_cnt = jnp.where(bk == K_INSERT, cnt, cnt_after_rem)
+    new_cnt = cnt - overlap
+    new_idx = jnp.where(idx < bi, idx, jnp.maximum(bi, idx - bn))
     return new_idx, new_cnt
 
 
 def _rebase_step(state, base):
     """Adjust all pending ops over ONE base op. state: (kind[N],
-    index[N], count[N], spare_idx[N], spare_cnt[N], spare_act[N],
-    flag[N]); base: (kind, index, count). Muted ops end with count 0.
+    index[N], count[N], dst[N], spare_idx[N], spare_cnt[N],
+    spare_act[N], flag[N]); base: (kind, index, count, dst_gap).
+    Muted ops end with count 0.
 
-    A base insert strictly INSIDE a pending remove's range splits that
-    remove (changeset.rebase_op "multi"): the head keeps the primary
-    slot and the tail occupies the op's PREALLOCATED SPARE slot — one
-    split per pending op is handled natively (the overwhelmingly
-    common case; config-4's 'flagged_for_scalar_path' was exactly
-    these). A SECOND split on the same op (base insert inside either
-    live piece again) exceeds the two-slot budget and FLAGS the op for
-    the scalar path."""
-    kind, idx, cnt, s_idx, s_cnt, s_act, flag = state
-    bk, bi, bn = base
+    Split budget: a base attach (insert, or a move's re-attach)
+    strictly INSIDE a pending remove's range splits that remove; the
+    head keeps the primary slot and the tail occupies the op's
+    PREALLOCATED SPARE slot — one native split per op. Anything
+    beyond the budget (second split, 3-piece move overlap, competing
+    move claims, mutual containment) FLAGS for the scalar path."""
+    kind, idx, cnt, dst, s_idx, s_cnt, s_act, flag = state
+    bk, bi, bn, bj = base
+    bg = _attach_gap(bi, bn, bj)
+    # An identity base move applies as a no-op and adjusts nothing
+    # (changeset._is_noop_move base rule).
+    base_noop = (bk == K_MOVE) & (bi <= bj) & (bj <= bi + bn)
 
-    split_p = (
-        (bk == K_INSERT) & (kind == K_REMOVE) & (cnt > 0)
-        & (bi > idx) & (bi < idx + cnt)
+    is_ins = kind == K_INSERT
+    is_rem = kind == K_REMOVE
+    is_mv = kind == K_MOVE
+    live = cnt > 0
+
+    # A pending identity move rebases to nothing (the op-side
+    # canonicalization): mute on its first live rebase step.
+    op_noop = is_mv & (idx <= dst) & (dst <= idx + cnt)
+
+    # ---------------- pending INSERT: a pure gap.
+    ins_idx = _gap_over(idx, bk, bi, bn, bg)
+
+    # ---------------- pending REMOVE range [idx, idx+cnt).
+    # base insert: shift, or split around content landing strictly
+    # inside (the attach of a base MOVE with no node overlap behaves
+    # identically — both are an insert of bn at a gap).
+    # base remove: clip.
+    # base move: relocate on full containment; flag partial overlap.
+    rm_ins_idx = jnp.where(bi <= idx, idx + bn, idx)
+    # One clip of the primary range against a base remove serves both
+    # the pending-remove and pending-move selections below.
+    clip_idx, clip_cnt = _remove_over_rm(idx, cnt, bi, bn)
+
+    ov_lo = jnp.maximum(idx, bi)
+    ov_hi = jnp.minimum(idx + cnt, bi + bn)
+    mv_overlap = jnp.maximum(0, ov_hi - ov_lo) > 0
+    full_inside = (idx >= bi) & (idx + cnt <= bi + bn)
+    # no-overlap: detach slide, then the attach handled below as an
+    # insert at bg.
+    rm_mv_idx0 = jnp.where(idx >= bi + bn, idx - bn, idx)
+    rm_mv_idx = jnp.where(full_inside, bg + (idx - bi), rm_mv_idx0)
+
+    new_idx = jnp.where(
+        bk == K_INSERT, rm_ins_idx,
+        jnp.where(bk == K_REMOVE, clip_idx, rm_mv_idx),
+    )
+    new_cnt = jnp.where(bk == K_REMOVE, clip_cnt, cnt)
+
+    # ---------------- pending MOVE: src range + dst gap.
+    # base insert strictly inside the block ABSORBS (travels with it);
+    # at/before shifts. base remove clips. base move with node overlap
+    # or mutual containment flags; otherwise detach slide + attach
+    # absorb/shift.
+    mv_ins_absorb = (bi > idx) & (bi < idx + cnt)
+    mv_ins_idx = jnp.where(bi <= idx, idx + bn, idx)
+    mv_ins_cnt = jnp.where(mv_ins_absorb, cnt + bn, cnt)
+    mv_mv_idx0 = jnp.where(idx >= bi + bn, idx - bn, idx)
+    mv_mv_absorb = (bg > mv_mv_idx0) & (bg < mv_mv_idx0 + cnt)
+    mv_mv_idx = jnp.where(bg <= mv_mv_idx0, mv_mv_idx0 + bn, mv_mv_idx0)
+    mv_mv_cnt = jnp.where(mv_mv_absorb, cnt + bn, cnt)
+
+    mv_idx = jnp.where(
+        bk == K_INSERT, mv_ins_idx,
+        jnp.where(bk == K_REMOVE, clip_idx, mv_mv_idx),
+    )
+    mv_cnt = jnp.where(
+        bk == K_INSERT, mv_ins_cnt,
+        jnp.where(bk == K_REMOVE, clip_cnt, mv_mv_cnt),
+    )
+    new_dst = _gap_over(dst, bk, bi, bn, bg)
+
+    # ---------------- flags (beyond the vector budget).
+    # remove PARTIALLY overlapping a base move's block: pre + follow +
+    # post pieces (the scalar path's parts machinery).
+    flag_rm_partial = (
+        (bk == K_MOVE) & is_rem & live & mv_overlap & ~full_inside
+    )
+    # two moves with competing node claims, or mutual containment
+    # (the per-move-id move-effect arbitration).
+    mv_src_overlap = (
+        (bk == K_MOVE) & is_mv & live
+        & (jnp.maximum(idx, bi) < jnp.minimum(idx + cnt, bi + bn))
+    )
+    mutual = (
+        (bk == K_MOVE) & is_mv & live
+        & (bi < dst) & (dst < bi + bn)
+        & (idx < bj) & (bj < idx + cnt)
+    )
+
+    # ---------------- splits of a pending remove around an attach.
+    # The attach position: a base insert's bi, or a base move's bg in
+    # the post-detach frame (only when no node overlap).
+    att = jnp.where(bk == K_INSERT, bi, bg)
+    att_base = jnp.where(bk == K_INSERT, idx, rm_mv_idx0)
+    splittable = is_rem & live & (
+        (bk == K_INSERT)
+        | ((bk == K_MOVE) & ~mv_overlap & ~base_noop)
+    )
+    split_p = splittable & (att > att_base) & (att < att_base + cnt)
+    # spare pieces are always removes; same rules, same split risk.
+    sp_att_base = jnp.where(
+        bk == K_INSERT, s_idx,
+        jnp.where(s_idx >= bi + bn, s_idx - bn, s_idx),
     )
     split_s = (
-        (bk == K_INSERT) & s_act & (s_cnt > 0)
-        & (bi > s_idx) & (bi < s_idx + s_cnt)
+        s_act & (s_cnt > 0)
+        & ((bk == K_INSERT) | ((bk == K_MOVE) & ~base_noop))
+        & (att > sp_att_base) & (att < sp_att_base + s_cnt)
     )
-    # One native split per op: a primary split uses the spare; any
-    # split beyond that (primary again, or the spare itself) flags.
+    # spare overlapping a base move's node claim at all -> flag (no
+    # second-piece machinery for relocation).
+    sp_mv_overlap = (
+        s_act & (s_cnt > 0) & (bk == K_MOVE) & ~base_noop
+        & (jnp.maximum(s_idx, bi) < jnp.minimum(s_idx + s_cnt, bi + bn))
+    )
     use_spare = split_p & ~s_act
-    flag = flag | (split_p & s_act) | split_s
+    new_flag = flag | (split_p & s_act) | split_s | sp_mv_overlap \
+        | flag_rm_partial | mv_src_overlap | mutual
+
+    # remove with no node overlap vs base MOVE: attach shift when at
+    # or before the slid range (split handled above; base-insert
+    # shifts are already in rm_ins_idx).
+    rm_att_shift = (
+        is_rem & live & (bk == K_MOVE) & ~mv_overlap & ~base_noop
+        & (att <= att_base)
+    )
+    new_idx = jnp.where(rm_att_shift, new_idx + bn, new_idx)
+
+    # ---------------- spare piece adjustment (a remove).
+    sp_clip_idx, sp_clip_cnt = _remove_over_rm(s_idx, s_cnt, bi, bn)
+    sp_idx1 = jnp.where(
+        bk == K_INSERT, jnp.where(bi <= s_idx, s_idx + bn, s_idx),
+        jnp.where(
+            bk == K_REMOVE, sp_clip_idx,
+            jnp.where(att <= sp_att_base, sp_att_base + bn, sp_att_base),
+        ),
+    )
+    sp_cnt1 = jnp.where(bk == K_REMOVE, sp_clip_cnt, s_cnt)
+
+    # ---------------- select per pending kind.
+    out_idx = jnp.where(is_ins, ins_idx, jnp.where(is_mv, mv_idx, new_idx))
+    out_cnt = jnp.where(is_ins, cnt, jnp.where(is_mv, mv_cnt, new_cnt))
+    out_dst = jnp.where(is_mv, new_dst, dst)
 
     # Tail of a fresh split, in post-base coordinates.
-    tail_idx = bi + bn
-    tail_cnt = (idx + cnt) - bi
-
-    new_idx, new_cnt = _piece_over_base(kind, idx, cnt, bk, bi, bn)
-    sp_idx, sp_cnt = _piece_over_base(kind, s_idx, s_cnt, bk, bi, bn)
-
-    # Apply the split AFTER the generic adjust: the head clips to the
-    # base insert's position, the tail starts past the inserted run.
-    new_cnt = jnp.where(use_spare, bi - idx, new_cnt)
-    new_idx = jnp.where(use_spare, idx, new_idx)
-    sp_idx = jnp.where(use_spare, tail_idx, sp_idx)
-    sp_cnt = jnp.where(use_spare, tail_cnt, sp_cnt)
+    tail_idx = att + bn
+    tail_cnt = (att_base + cnt) - att
+    out_cnt = jnp.where(use_spare, att - att_base, out_cnt)
+    out_idx = jnp.where(use_spare, att_base, out_idx)
+    sp_idx1 = jnp.where(use_spare, tail_idx, sp_idx1)
+    sp_cnt1 = jnp.where(use_spare, tail_cnt, sp_cnt1)
     s_act = s_act | use_spare
 
-    return (kind, new_idx, new_cnt, sp_idx, sp_cnt, s_act, flag), None
+    # A pending identity move rebases to nothing (mutes); an identity
+    # BASE op leaves everything untouched — and the scalar path checks
+    # the base first, so a noop base protects even a noop pending op.
+    out_cnt = jnp.where(op_noop, 0, out_cnt)
+    keep = base_noop
+    out_idx = jnp.where(keep, idx, out_idx)
+    out_cnt = jnp.where(keep, cnt, out_cnt)
+    out_dst = jnp.where(keep, dst, out_dst)
+    sp_idx1 = jnp.where(keep, s_idx, sp_idx1)
+    sp_cnt1 = jnp.where(keep, s_cnt, sp_cnt1)
+    s_act = jnp.where(keep, state[6], s_act)
+    new_flag = jnp.where(keep, flag, new_flag)
+
+    return (kind, out_idx, out_cnt, out_dst, sp_idx1, sp_cnt1, s_act,
+            new_flag), None
 
 
 @jax.jit
 def rebase_batch(kinds: jnp.ndarray, idxs: jnp.ndarray, cnts: jnp.ndarray,
+                 dsts: jnp.ndarray,
                  base_kinds: jnp.ndarray, base_idxs: jnp.ndarray,
-                 base_cnts: jnp.ndarray):
+                 base_cnts: jnp.ndarray, base_dsts: jnp.ndarray):
     """Rebase N pending ops over M base ops (applied in order) in one
     XLA computation: lax.scan over the base window, every pending op
-    adjusted in parallel per step. Returns
-    ``(kind, idx, cnt, spare_idx, spare_cnt, spare_active, flagged)``
-    — a split remove occupies its primary slot (head) plus its spare
-    slot (tail); `flagged` marks the rare double-split ops that must
-    reroute through the scalar changeset path."""
+    adjusted in parallel per step. Returns ``(kind, idx, cnt, dst,
+    spare_idx, spare_cnt, spare_active, flagged)`` — a split remove
+    occupies its primary slot (head) plus its spare slot (tail);
+    `flagged` marks ops needing the scalar changeset path (double
+    splits, 3-piece move overlaps, competing/mutual move claims)."""
     zeros = jnp.zeros(kinds.shape, jnp.int32)
-    (k, i, c, si, sc, sa, f), _ = jax.lax.scan(
+    (k, i, c, d, si, sc, sa, f), _ = jax.lax.scan(
         _rebase_step,
-        (kinds, idxs, cnts, zeros, zeros,
+        (kinds, idxs, cnts, dsts, zeros, zeros,
          jnp.zeros(kinds.shape, bool), jnp.zeros(kinds.shape, bool)),
-        (base_kinds, base_idxs, base_cnts),
+        (base_kinds, base_idxs, base_cnts, base_dsts),
     )
-    return k, i, c, si, sc, sa, f
+    return k, i, c, d, si, sc, sa, f
 
 
 def rebase_ops_columnar(ops: np.ndarray, base: np.ndarray):
-    """numpy convenience: ops/base are [N,3]/[M,3] arrays of
-    (kind, index, count). Returns (rebased [N,3], spares [N,3] with
-    count 0 for unsplit ops, flagged [N]) — flagged ops double-split
-    and must reroute through the scalar changeset path (count 0 =
-    muted). Spare pieces are SEQUENTIALIZED like the scalar path's
-    multi bundles: a split remove's tail index assumes its head
-    applied first."""
-    k, i, c, si, sc, sa, f = rebase_batch(
-        jnp.asarray(ops[:, 0]), jnp.asarray(ops[:, 1]), jnp.asarray(ops[:, 2]),
-        jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]), jnp.asarray(base[:, 2]),
+    """numpy convenience: ops/base are [N,3]/[N,4] arrays of
+    (kind, index, count[, dst]) — dst is a move's attach gap, padded 0
+    when absent. Returns (rebased [N,4], spares [N,3] with count 0 for
+    unsplit ops, flagged [N]) — flagged ops reroute through the scalar
+    changeset path (count 0 = muted). Spare pieces are SEQUENTIALIZED
+    like the scalar path's multi bundles: a split remove's tail index
+    assumes its head applied first."""
+    def _pad(a):
+        a = np.asarray(a, np.int32)
+        if a.shape[1] == 3:
+            a = np.concatenate(
+                [a, np.zeros((a.shape[0], 1), np.int32)], axis=1
+            )
+        return a
+
+    ops = _pad(ops)
+    base = _pad(base)
+    k, i, c, d, si, sc, sa, f = rebase_batch(
+        jnp.asarray(ops[:, 0]), jnp.asarray(ops[:, 1]),
+        jnp.asarray(ops[:, 2]), jnp.asarray(ops[:, 3]),
+        jnp.asarray(base[:, 0]), jnp.asarray(base[:, 1]),
+        jnp.asarray(base[:, 2]), jnp.asarray(base[:, 3]),
     )
-    out = np.stack([np.asarray(k), np.asarray(i), np.asarray(c)], axis=1)
+    out = np.stack(
+        [np.asarray(k), np.asarray(i), np.asarray(c), np.asarray(d)],
+        axis=1,
+    )
     act = np.asarray(sa)
-    sp_idx = np.where(act, np.asarray(si) - out[:, 2], 0)
+    # Sequentialize: the tail applies AFTER the head, so it shifts
+    # down by the head's count — but only while it still sits at or
+    # past the head (a later base move can relocate the head above
+    # the tail, e.g. a full-containment follow).
+    si_np = np.asarray(si)
+    sp_idx = np.where(
+        act, np.where(si_np >= out[:, 1], si_np - out[:, 2], si_np), 0
+    )
     spares = np.stack(
-        [out[:, 0], sp_idx, np.where(act, np.asarray(sc), 0)], axis=1
+        [np.full(out.shape[0], K_REMOVE, np.int32), sp_idx,
+         np.where(act, np.asarray(sc), 0)],
+        axis=1,
     )
     return out, spares, np.asarray(f)
-

@@ -89,7 +89,9 @@ class SharedTree(SharedObject):
         )
         # The applied change carries its repair data (removed content,
         # prior values, move inverses) — the undo stack's capture hook.
-        self.emit("localCommit", commit)
+        # (Empty id-carrier commits have nothing to undo.)
+        if change:
+            self.emit("localCommit", commit)
 
     def insert_node(self, path: List[list], field: str, index: int,
                     content: List[dict], id_count: int = 0) -> None:
@@ -217,17 +219,19 @@ class SharedTree(SharedObject):
         if not self._tx_branch.in_transaction:
             branch, self._tx_branch = self._tx_branch, None
             try:
-                # Squash left at most one commit; merge rebases it
-                # over anything integrated mid-transaction and lands
-                # it WITH the transaction's accumulated idCount.
-                branch.merge_into(self._tx_id_count)
+                # Squash left at most one commit; rebase it over
+                # anything integrated mid-transaction.
+                branch.rebase_onto()
             except BaseException:
-                # Nothing was submitted (rebase_onto failed before
-                # any edit): keep the transaction open so the caller
-                # can retry later or abort explicitly.
+                # Nothing was submitted yet: keep the transaction
+                # open so the caller can retry later or abort
+                # explicitly. (Only the rebase is inside the retry
+                # window — once landing starts, commits are on the
+                # wire and replaying them would double-apply.)
                 self._tx_branch = branch
                 branch._tx_marks.append(0)
                 raise
+            branch.land(self._tx_id_count)
             self._tx_id_count = 0
 
     def abort_transaction(self) -> None:
@@ -235,6 +239,13 @@ class SharedTree(SharedObject):
         self._tx_branch.abort_transaction()
         if not self._tx_branch.in_transaction:
             self._tx_branch = None  # view falls back to the main forest
+            if self._tx_id_count:
+                # ids allocated inside the aborted transaction HAVE
+                # advanced this session's local ordinal space — the
+                # allocation must still ride the wire (as an empty
+                # commit) or every replica's finalized count desyncs
+                # from the author's and all later stable ids shift.
+                self.edit([], self._tx_id_count)
             self._tx_id_count = 0
 
     @contextlib.contextmanager

@@ -285,6 +285,28 @@ def test_tree_transaction_carries_id_count():
     assert t0.id_compressor._finalized.get(sess) == 2
 
 
+def test_tree_transaction_abort_still_ships_id_allocation():
+    """ids generated inside an ABORTED transaction advanced the
+    session's local ordinal space; the allocation must still ride the
+    wire (empty commit) or every replica's stable-id mapping shifts."""
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    t0.start_transaction()
+    t0.generate_id()
+    t0.insert_node([], "f", 1, [{"type": "n", "value": 1}], id_count=1)
+    t0.abort_transaction()
+    # Post-abort: a fresh id rides a normal commit; replicas agree on
+    # the session's finalized count (2: the aborted one + this one).
+    t0.generate_id()
+    t0.insert_node([], "f", 1, [{"type": "n", "value": 2}], id_count=1)
+    h.process_all()
+    sess = str(h.runtimes[0].client_id)
+    assert t0.id_compressor._finalized.get(sess) == 2
+    assert t1.id_compressor._finalized.get(sess) == 2
+    assert t0.view() == t1.view()
+
+
 def test_tree_undo_refused_while_transaction_open():
     h, (t0, _) = _harness()
     t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])

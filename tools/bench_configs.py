@@ -124,13 +124,23 @@ def config4_tree_rebase(n_pending: int = 100_000, window: int = 64) -> dict:
 
     n_pending = int(n_pending * SCALE)
     rng = np.random.default_rng(4)
+    # Full calculus: insert/remove/MOVE marks in both streams (moves
+    # carry a destination gap; the kernel handles travel/absorb/
+    # relocate natively and flags arbitration corners to the scalar
+    # path — measured by flagged_for_scalar_path).
+    kinds = rng.integers(0, 3, n_pending)
     ops = np.stack(
-        [rng.integers(0, 2, n_pending), rng.integers(0, 100_000, n_pending),
-         rng.integers(1, 4, n_pending)], axis=1,
+        [kinds, rng.integers(0, 100_000, n_pending),
+         rng.integers(1, 4, n_pending),
+         np.where(kinds == 2, rng.integers(0, 100_000, n_pending), 0)],
+        axis=1,
     ).astype(np.int32)
+    bkinds = rng.integers(0, 3, window)
     base = np.stack(
-        [rng.integers(0, 2, window), rng.integers(0, 100_000, window),
-         rng.integers(1, 4, window)], axis=1,
+        [bkinds, rng.integers(0, 100_000, window),
+         rng.integers(1, 4, window),
+         np.where(bkinds == 2, rng.integers(0, 100_000, window), 0)],
+        axis=1,
     ).astype(np.int32)
     from fluidframework_tpu.utils.benchmark import run_benchmark
 
@@ -146,6 +156,7 @@ def config4_tree_rebase(n_pending: int = 100_000, window: int = 64) -> dict:
     rebases = n_pending * window
     return {
         "config": "tree_rebase_100k_ops_over_64_commit_window",
+        "calculus": "insert+remove+move",
         "pending_ops": n_pending, "window": window,
         "seconds": stats["mean"],
         "op_rebases_per_sec": round(rebases / stats["mean"], 1),
