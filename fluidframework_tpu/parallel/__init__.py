@@ -17,6 +17,8 @@ from .mesh import (
     sharded_pipeline_step,
     shard_tables,
 )
+from .seqshard import run_sequence_sharded, sequence_sharded_replay
+from .seqshard_ref import SeqShardedOverlay
 
 __all__ = [
     "make_docs_mesh",
@@ -25,4 +27,7 @@ __all__ = [
     "shard_tables",
     "sharded_overlay_replay",
     "sharded_pipeline_step",
+    "sequence_sharded_replay",
+    "run_sequence_sharded",
+    "SeqShardedOverlay",
 ]

@@ -1,0 +1,503 @@
+"""Sequence-sharded overlay replay compiled over a device mesh.
+
+The shard_map form of `parallel.seqshard_ref.SeqShardedOverlay`
+(which is the executable spec, differentially gated against the
+single-doc overlay engine): ONE document's settled coordinate space
+partitioned contiguously across the mesh's `seq` axis, each device
+holding one shard's settled slice + overlay rows.
+
+Per op, the only cross-device traffic is tiny all-gathers over ICI:
+
+- each shard's (visible length, delta) at the op's perspective — the
+  associative partial-lengths combine (partialLengths.ts:256) as an
+  exclusive prefix over the gathered vector;
+- insert-landing arbitration: per-shard landing bits + target
+  coordinates; the first landing shard (document order) wins, and the
+  shard owning the target coordinate stores the row.
+
+Range ops (remove/annotate) need NO arbitration: every shard applies
+its clipped local sub-range independently (splits, gap
+materialization, covered-row updates are shard-local).
+
+This build runs fold-free (rows accumulate; the window IS the whole
+replay) — fold is proven entirely shard-local by the numpy spec, and
+a window larger than one device's capacity is exactly the case
+sequence sharding exists for. States extract back into the numpy spec
+for digest comparison.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.mergetree_kernel import (
+    ERR_BAD_POS,
+    ERR_CAPACITY,
+    ERR_REMOVERS,
+    NOT_REMOVED,
+    OP_ANNOTATE,
+    OP_INSERT,
+    OP_REMOVE,
+    PROP_ABSENT,
+    PROP_DELETE,
+)
+from ..ops.overlay_ref import SETTLED_BASE
+from ..protocol.constants import NO_CLIENT
+
+
+class ShardState(NamedTuple):
+    """One sequence shard's overlay rows (capacity C) + settled len."""
+
+    anchor: jnp.ndarray   # [C] int32, local settled coordinate
+    buf: jnp.ndarray      # [C] int32, arena offset | SETTLED_BASE+coord
+    length: jnp.ndarray   # [C] int32
+    iseq: jnp.ndarray     # [C] int32
+    iclient: jnp.ndarray  # [C] int32
+    rseq: jnp.ndarray     # [C] int32
+    rcl: jnp.ndarray      # [C, KR] int32
+    props: jnp.ndarray    # [C, KK] int32
+    n: jnp.ndarray        # [] int32 live rows
+    S: jnp.ndarray        # [] int32 settled length (static: fold-free)
+    error: jnp.ndarray    # [] int32
+
+
+def make_shard_state(settled_len: int, capacity: int, n_removers: int,
+                     n_prop_keys: int) -> ShardState:
+    C = capacity
+    return ShardState(
+        anchor=jnp.zeros(C, jnp.int32),
+        buf=jnp.zeros(C, jnp.int32),
+        length=jnp.zeros(C, jnp.int32),
+        iseq=jnp.zeros(C, jnp.int32),
+        iclient=jnp.zeros(C, jnp.int32),
+        rseq=jnp.full(C, NOT_REMOVED, jnp.int32),
+        rcl=jnp.full((C, n_removers), NO_CLIENT, jnp.int32),
+        props=jnp.full((C, n_prop_keys), PROP_ABSENT, jnp.int32),
+        n=jnp.int32(0),
+        S=jnp.int32(settled_len),
+        error=jnp.int32(0),
+    )
+
+
+def _row_insert(st: ShardState, j, anchor, buf, length, iseq, iclient,
+                rseq, rcl_row, props_row, do: jnp.ndarray) -> ShardState:
+    """Insert one row at local index j (rows at/after j shift right),
+    masked by `do`. Capacity overflow raises the error bit."""
+    C = st.anchor.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    full = st.n >= C
+    overflow = do & full  # the error must observe the UNmasked intent
+    do = do & ~full
+
+    def shift(a, val):
+        rolled = jnp.roll(a, 1, axis=0)
+        keep = _expand((idx < j) | ~do, a)
+        at = _expand((idx == j) & do, a)
+        return jnp.where(keep, a, jnp.where(at, jnp.asarray(val, a.dtype),
+                                            rolled))
+    st2 = ShardState(
+        anchor=shift(st.anchor, anchor),
+        buf=shift(st.buf, buf),
+        length=shift(st.length, length),
+        iseq=shift(st.iseq, iseq),
+        iclient=shift(st.iclient, iclient),
+        rseq=shift(st.rseq, rseq),
+        rcl=shift(st.rcl, rcl_row),
+        props=shift(st.props, props_row),
+        n=st.n + jnp.where(do, 1, 0).astype(jnp.int32),
+        S=st.S,
+        error=st.error | jnp.where(
+            overflow, ERR_CAPACITY, 0
+        ).astype(jnp.int32),
+    )
+    return st2
+
+
+def _expand(mask, a):
+    return mask[:, None] if a.ndim > 1 else mask
+
+
+def _visibility(st: ShardState, ref_seq, client):
+    C = st.anchor.shape[0]
+    idx = jnp.arange(C, dtype=jnp.int32)
+    live = idx < st.n
+    is_span = live & (st.buf >= SETTLED_BASE)
+    consume = jnp.where(is_span, st.length, 0)
+    removed = live & (st.rseq != NOT_REMOVED)
+    tomb = removed & (st.rseq <= ref_seq)
+    ins_vis = (st.iclient == client) | (st.iseq <= ref_seq)
+    among = (st.rcl == client).any(axis=1)
+    skip = tomb | (removed & ~ins_vis)
+    visible = live & ~skip & ins_vis & ~(removed & among)
+    vis_len = jnp.where(visible, st.length, 0)
+    delta = jnp.where(live, vis_len - consume, 0)
+    cum = jnp.cumsum(delta) - delta
+    pre = st.anchor + cum
+    return live, is_span, skip, vis_len, delta, pre
+
+
+def _split(st: ShardState, q, ref_seq, client) -> ShardState:
+    """Boundary split at local visible position q (no-op when no row
+    strictly contains q)."""
+    live, is_span, skip, vis, _, pre = _visibility(st, ref_seq, client)
+    inside = live & ~skip & (pre < q) & (pre + vis > q)
+    do = inside.any()
+    j = jnp.argmax(inside).astype(jnp.int32)
+    off = q - pre[j]
+    span_j = is_span[j]
+    tail_anchor = st.anchor[j] + jnp.where(span_j, off, 0)
+    st2 = _row_insert(
+        st, j + 1, tail_anchor, st.buf[j] + off, st.length[j] - off,
+        st.iseq[j], st.iclient[j], st.rseq[j], st.rcl[j], st.props[j],
+        do,
+    )
+    new_len = jnp.where(
+        (jnp.arange(st.anchor.shape[0]) == j) & do, off, st2.length
+    ).astype(jnp.int32)
+    return st2._replace(length=new_len)
+
+
+def sequence_sharded_replay(mesh: Mesh, capacity: int, n_removers: int,
+                            n_prop_keys: int, axis: str = "seq"):
+    """Compile the sequence-sharded replay for `mesh`.
+
+    Returns a jitted ``replay(states, ops) -> (states', error)`` where
+    `states` is a ShardState with a leading shard axis of size
+    ``mesh.size`` laid out across the mesh, and `ops` is a dict of
+    replicated op arrays [N]: op_type, pos1, pos2, seq, ref_seq,
+    client, buf_start, ins_len, prop_key, prop_val.
+    """
+    D = mesh.size
+
+    def local_replay(st_batched, ops):
+        st = jax.tree_util.tree_map(lambda a: a[0], st_batched)
+        rank = jax.lax.axis_index(axis)
+
+        def step(st: ShardState, op):
+            (op_type, pos1, pos2, seq, ref_seq, client, buf_start,
+             ins_len, pk, pv) = op
+
+            # Gather every shard's settled length once per step (it is
+            # fold-free static, but gathering keeps the code honest
+            # for a future folding build).
+            S_all = jax.lax.all_gather(st.S, axis)
+            bases = jnp.cumsum(S_all) - S_all
+            my_base = bases[rank]
+            S_total = S_all.sum()
+
+            def partials(s):
+                _, _, _, _, delta, _ = _visibility(s, ref_seq, client)
+                ds = delta.sum()
+                return s.S + ds, ds
+
+            # ----------------------------------------------- insert
+            def do_insert(st: ShardState) -> ShardState:
+                v_loc, d_loc = partials(st)
+                v_all = jax.lax.all_gather(v_loc, axis)
+                d_all = jax.lax.all_gather(d_loc, axis)
+                off = jnp.cumsum(v_all) - v_all
+                q = pos1 - off[rank]
+                # Local split (no-op unless a row strictly contains q).
+                st = _split(st, q, ref_seq, client)
+                live, is_span, skip, vis, delta, pre = _visibility(
+                    st, ref_seq, client
+                )
+                land = live & (
+                    (pre > q)
+                    | ((pre == q) & ~skip & ((vis > 0) | (seq > st.iseq)))
+                )
+                land_any = land.any()
+                j = jnp.argmax(land).astype(jnp.int32)
+                c_cand = st.anchor[j] + my_base - (pre[j] - q)
+                land_all = jax.lax.all_gather(land_any, axis)
+                c_all = jax.lax.all_gather(c_cand, axis)
+                exists = land_all.any()
+                winner = jnp.argmax(land_all).astype(jnp.int32)
+                c_land = c_all[winner]
+                total = off[-1] + v_all[-1]
+                delta_total = d_all.sum()
+                c_append = jnp.minimum(pos1 - delta_total, S_total)
+                c_final = jnp.where(exists, c_land, c_append)
+                # Owner shard of coordinate c_final (half-open; the
+                # last shard owns its own end).
+                owner = jnp.minimum(
+                    jnp.searchsorted(
+                        bases[1:], c_final, side="right"
+                    ).astype(jnp.int32),
+                    D - 1,
+                )
+                winner_stores = exists & (c_land >= bases[winner])
+                storer = jnp.where(winner_stores, winner, owner)
+                i_store = rank == storer
+                at_j = winner_stores & (rank == winner)
+                local_pos = jnp.where(at_j, j, st.n)
+                local_anchor = jnp.clip(c_final - my_base, 0, st.S)
+                props_row = jnp.full(n_prop_keys, PROP_ABSENT, jnp.int32)
+                props_row = jnp.where(
+                    (jnp.arange(n_prop_keys) == pk) & (pk >= 0),
+                    jnp.where(pv == PROP_DELETE, PROP_ABSENT, pv),
+                    props_row,
+                )
+                st = _row_insert(
+                    st, local_pos, local_anchor, buf_start, ins_len,
+                    seq, client, NOT_REMOVED,
+                    jnp.full(n_removers, NO_CLIENT, jnp.int32),
+                    props_row, i_store,
+                )
+                err = jnp.where(
+                    ~exists & (pos1 > total), ERR_BAD_POS, 0
+                ).astype(jnp.int32)
+                return st._replace(error=st.error | err)
+
+            # ------------------------------------------------ range
+            def do_range(st: ShardState) -> ShardState:
+                v_loc, d_loc = partials(st)
+                v_all = jax.lax.all_gather(v_loc, axis)
+                off = jnp.cumsum(v_all) - v_all
+                total = off[-1] + v_all[-1]
+                lo = jnp.clip(pos1 - off[rank], 0, v_loc)
+                hi = jnp.clip(pos2 - off[rank], 0, v_loc)
+                err = jnp.where(pos2 > total, ERR_BAD_POS, 0)
+                st = st._replace(
+                    error=st.error | err.astype(jnp.int32)
+                )
+
+                def apply_local(st: ShardState) -> ShardState:
+                    st = _split(st, lo, ref_seq, client)
+                    st = _split(st, hi, ref_seq, client)
+                    C = st.anchor.shape[0]
+                    idx = jnp.arange(C, dtype=jnp.int32)
+                    live, is_span, skip, vis, delta, pre = _visibility(
+                        st, ref_seq, client
+                    )
+                    # Settled coordinates of the clipped range ends.
+                    def coord_of(p):
+                        cand = live & (pre >= p)
+                        any_c = cand.any()
+                        k = jnp.argmax(cand)
+                        return jnp.where(
+                            any_c,
+                            st.anchor[k] - (pre[k] - p),
+                            p - delta.sum(),
+                        )
+
+                    c1 = coord_of(lo)
+                    c2 = coord_of(hi)
+                    # Gap materialization: gap k sits before row k
+                    # (gap C'=n is the tail up to S). Materialized
+                    # gaps become span rows via one scatter remap.
+                    consume = jnp.where(is_span, st.length, 0)
+                    prev_end = jnp.where(
+                        idx == 0, 0,
+                        jnp.roll(st.anchor + consume, 1),
+                    )
+                    glo = jnp.where(idx < st.n, prev_end, 0)
+                    ghi = jnp.where(idx < st.n, st.anchor, 0)
+                    # tail gap (index n): [last end, S)
+                    last_end = jnp.where(
+                        st.n > 0,
+                        (st.anchor + consume)[
+                            jnp.maximum(st.n - 1, 0)
+                        ],
+                        0,
+                    )
+                    glo = jnp.where(idx == st.n, last_end, glo)
+                    ghi = jnp.where(idx == st.n, st.S, ghi)
+                    in_gap = idx <= st.n
+                    mlo = jnp.maximum(glo, c1)
+                    mhi = jnp.minimum(ghi, c2)
+                    mat = in_gap & (mlo < mhi)
+                    n_mat = mat.sum().astype(jnp.int32)
+                    # Remap: old row i -> i + (# materialized gaps <= i).
+                    mat_incl = jnp.cumsum(mat.astype(jnp.int32))
+                    row_dst = idx + mat_incl
+                    gap_dst = idx + mat_incl - 1  # gap k before row k
+
+                    def scatter(a, gap_vals):
+                        """Remap old rows to row_dst and write the
+                        materialized gap rows at gap_dst (out-of-range
+                        dummies drop)."""
+                        gv = jnp.broadcast_to(
+                            jnp.asarray(gap_vals, a.dtype), a.shape
+                        )
+                        out = jnp.zeros_like(a)
+                        out = out.at[
+                            jnp.where(idx < st.n, row_dst, C)
+                        ].set(a, mode="drop")
+                        out = out.at[
+                            jnp.where(mat, gap_dst, C)
+                        ].set(gv, mode="drop")
+                        return out
+
+                    overflow = st.n + n_mat > C
+                    st2 = ShardState(
+                        anchor=scatter(st.anchor, mlo),
+                        buf=scatter(st.buf, SETTLED_BASE + mlo),
+                        length=scatter(st.length, mhi - mlo),
+                        iseq=scatter(st.iseq, jnp.zeros(C, jnp.int32)),
+                        iclient=scatter(
+                            st.iclient, jnp.full(C, NO_CLIENT, jnp.int32)
+                        ),
+                        rseq=scatter(
+                            st.rseq, jnp.full(C, NOT_REMOVED, jnp.int32)
+                        ),
+                        rcl=scatter(
+                            st.rcl,
+                            jnp.full((C, n_removers), NO_CLIENT,
+                                     jnp.int32),
+                        ),
+                        props=scatter(
+                            st.props,
+                            jnp.full((C, n_prop_keys), PROP_ABSENT,
+                                     jnp.int32),
+                        ),
+                        n=jnp.minimum(st.n + n_mat, C),
+                        S=st.S,
+                        error=st.error | jnp.where(
+                            overflow, ERR_CAPACITY, 0
+                        ).astype(jnp.int32),
+                    )
+                    # Covered-row updates.
+                    live, is_span, skip, vis, delta, pre = _visibility(
+                        st2, ref_seq, client
+                    )
+                    covered = (
+                        live & ~skip & (vis > 0)
+                        & (pre >= lo) & (pre + vis <= hi)
+                    )
+                    is_rm = op_type == OP_REMOVE
+                    already = st2.rseq != NOT_REMOVED
+                    new_rseq = jnp.where(
+                        covered & is_rm & ~already, seq, st2.rseq
+                    ).astype(jnp.int32)
+                    free = st2.rcl == NO_CLIENT
+                    first_free = jnp.argmax(free, axis=1)
+                    no_free = ~free.any(axis=1)
+                    slot = jnp.where(already, first_free, 0)
+                    write_rcl = covered & is_rm & ~(already & no_free)
+                    kk = jnp.arange(st2.rcl.shape[1])
+                    new_rcl = jnp.where(
+                        write_rcl[:, None] & (kk[None, :] == slot[:, None]),
+                        client, st2.rcl,
+                    ).astype(jnp.int32)
+                    err2 = jnp.where(
+                        (covered & is_rm & already & no_free).any(),
+                        ERR_REMOVERS, 0,
+                    )
+                    # Annotate: last-writer per key; deletes tombstone
+                    # on spans, clear on text rows.
+                    is_an = op_type == OP_ANNOTATE
+                    pkk = jnp.arange(n_prop_keys)
+                    an_write = (
+                        covered[:, None] & is_an
+                        & (pkk[None, :] == pk) & (pk >= 0)
+                    )
+                    an_val = jnp.where(
+                        pv == PROP_DELETE,
+                        jnp.where(is_span, PROP_DELETE, PROP_ABSENT)[
+                            :, None
+                        ],
+                        pv,
+                    )
+                    new_props = jnp.where(
+                        an_write, an_val, st2.props
+                    ).astype(jnp.int32)
+                    return st2._replace(
+                        rseq=new_rseq, rcl=new_rcl, props=new_props,
+                        error=st2.error | err2.astype(jnp.int32),
+                    )
+
+                return jax.lax.cond(
+                    lo < hi, apply_local, lambda s: s, st
+                )
+
+            is_insert = op_type == OP_INSERT
+            is_range = (op_type == OP_REMOVE) | (op_type == OP_ANNOTATE)
+            st = jax.lax.cond(is_insert, do_insert,
+                              lambda s: jax.lax.cond(
+                                  is_range, do_range, lambda x: x, s),
+                              st)
+            return st, None
+
+        ops_tuple = (
+            ops["op_type"], ops["pos1"], ops["pos2"], ops["seq"],
+            ops["ref_seq"], ops["client"], ops["buf_start"],
+            ops["ins_len"], ops["prop_key"], ops["prop_val"],
+        )
+        st, _ = jax.lax.scan(step, st, ops_tuple)
+        bits = jnp.arange(31, dtype=jnp.int32)
+        err = jax.lax.pmax((st.error >> bits) & 1, axis)
+        gerr = jnp.sum(err << bits)
+        return jax.tree_util.tree_map(lambda a: a[None], st), gerr
+
+    shard_specs = ShardState(
+        anchor=P(axis), buf=P(axis), length=P(axis), iseq=P(axis),
+        iclient=P(axis), rseq=P(axis), rcl=P(axis), props=P(axis),
+        n=P(axis), S=P(axis), error=P(axis),
+    )
+    from jax import shard_map
+
+    fn = shard_map(
+        local_replay,
+        mesh=mesh,
+        in_specs=(shard_specs, P()),
+        out_specs=(shard_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def run_sequence_sharded(stream, mesh: Mesh, initial_len: int,
+                         capacity: int = 4096, n_removers: int = 10,
+                         n_prop_keys: int = 8, axis: str = "seq"):
+    """Replay `stream` sequence-sharded over `mesh`; returns a
+    `SeqShardedOverlay` (numpy spec object) rebuilt from the final
+    device states for digest/text comparison."""
+    from .seqshard_ref import SeqShardedOverlay
+
+    D = mesh.size
+    bounds = np.linspace(0, initial_len, D + 1).astype(int)
+    states = [
+        make_shard_state(
+            int(bounds[d + 1] - bounds[d]), capacity, n_removers,
+            n_prop_keys,
+        )
+        for d in range(D)
+    ]
+    batched = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs), *states
+    )
+    ops = {
+        k: jnp.asarray(getattr(stream, k), jnp.int32)
+        for k in ("op_type", "pos1", "pos2", "seq", "ref_seq", "client",
+                  "buf_start", "ins_len")
+    }
+    ops["prop_key"] = jnp.asarray(stream.prop_key, jnp.int32)
+    ops["prop_val"] = jnp.asarray(stream.prop_val, jnp.int32)
+    replay = sequence_sharded_replay(
+        mesh, capacity, n_removers, n_prop_keys, axis
+    )
+    out, gerr = replay(batched, ops)
+    out = jax.tree_util.tree_map(np.asarray, out)
+    # Rebuild the numpy spec object from the device states.
+    sharded = SeqShardedOverlay(
+        stream, D, initial_len=initial_len, n_removers=n_removers,
+        n_prop_keys=n_prop_keys,
+    )
+    for d, sh in enumerate(sharded.shards):
+        n = int(out.n[d])
+        sh.anchor = out.anchor[d, :n].copy()
+        sh.buf = out.buf[d, :n].copy()
+        sh.length = out.length[d, :n].copy()
+        sh.iseq = out.iseq[d, :n].copy()
+        sh.iclient = out.iclient[d, :n].copy()
+        sh.rseq = out.rseq[d, :n].copy()
+        sh.rcl = out.rcl[d, :n].copy()
+        sh.props = out.props[d, :n].copy()
+        sh.error = int(out.error[d])
+    return sharded, int(gerr)

@@ -61,7 +61,7 @@ def _gap_over(g, bk, bi, bn, bg):
     inside = (bi < g) & (g < bi + bn)
     travel = bg + (g - bi)
     before = g == bi
-    g1 = jnp.where(g < bi, g, jnp.maximum(bi, g - bn))  # detach slide
+    g1 = g_rem  # detach slide: same rule as a base remove
     shift_attach = (bg < g1) | ((bg == g1) & ~before)
     g_mv = jnp.where(inside, travel, jnp.where(shift_attach, g1 + bn, g1))
     return jnp.where(
@@ -282,9 +282,9 @@ def rebase_batch(kinds: jnp.ndarray, idxs: jnp.ndarray, cnts: jnp.ndarray,
 
 
 def rebase_ops_columnar(ops: np.ndarray, base: np.ndarray):
-    """numpy convenience: ops/base are [N,3]/[N,4] arrays of
-    (kind, index, count[, dst]) — dst is a move's attach gap, padded 0
-    when absent. Returns (rebased [N,4], spares [N,3] with count 0 for
+    """numpy convenience: ops is [N, 3-or-4] and base is [M, 3-or-4] —
+    rows of (kind, index, count[, dst]); dst is a move's attach gap,
+    padded 0 when the 3-column form is passed. Returns (rebased [N,4], spares [N,3] with count 0 for
     unsplit ops, flagged [N]) — flagged ops reroute through the scalar
     changeset path (count 0 = muted). Spare pieces are SEQUENTIALIZED
     like the scalar path's multi bundles: a split remove's tail index

@@ -231,7 +231,15 @@ class SharedTree(SharedObject):
                 self._tx_branch = branch
                 branch._tx_marks.append(0)
                 raise
-            branch.land(self._tx_id_count)
+            if any(branch.commits):
+                branch.land(self._tx_id_count)
+            else:
+                # Squashed to nothing: the id allocation must still
+                # ride the wire (same invariant as abort_transaction).
+                branch.commits = []
+                branch.merged = True
+                if self._tx_id_count:
+                    self.edit([], self._tx_id_count)
             self._tx_id_count = 0
 
     def abort_transaction(self) -> None:

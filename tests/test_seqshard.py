@@ -1,0 +1,183 @@
+"""Sequence-sharding one document across shards (SURVEY §2.6 row 3).
+
+Differential gates: `parallel.seqshard_ref.SeqShardedOverlay` (numpy
+spec of the cross-shard rules) must match the single-doc overlay
+engine digest-for-digest on honest lagged streams, through folds and
+rebalances; `parallel.seqshard` (the shard_map form) must match both
+on the virtual device mesh.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.ops.overlay_ref import OverlayReplica
+from fluidframework_tpu.parallel.seqshard_ref import SeqShardedOverlay
+from fluidframework_tpu.testing.digest import state_digest
+from fluidframework_tpu.testing.synthetic import generate_lagged_stream
+
+
+def _single(stream, initial_len, fold_interval=2048):
+    ref = OverlayReplica(
+        stream, initial_len=initial_len, fold_interval=fold_interval,
+        n_removers=10,
+    )
+    ref.replay()
+    ref.check_errors()
+    return ref
+
+
+@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_seqshard_ref_matches_single_doc(seed, n_shards):
+    n_ops = 300
+    initial = 40
+    stream = generate_lagged_stream(
+        n_ops, n_clients=6, seed=300 + seed, window=48,
+        initial_len=initial,
+    )
+    ref = _single(stream, initial)
+    sharded = SeqShardedOverlay(
+        stream, n_shards, initial_len=initial, n_removers=10,
+    )
+    sharded.replay()
+    sharded.check_errors()
+    sharded.verify_invariants()
+    assert state_digest(sharded.annotated_spans()) == state_digest(
+        ref.annotated_spans()
+    )
+    assert sharded.attribution_spans() == ref.attribution_spans()
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_seqshard_ref_fold_cadence_invariance(seed):
+    """Folding every 16 ops on the shards vs every 2048 on the single
+    doc: settle-merge is semantics-preserving on both sides, so
+    digests still agree — and the fold is ENTIRELY shard-local."""
+    n_ops, initial = 256, 32
+    stream = generate_lagged_stream(
+        n_ops, n_clients=5, seed=400 + seed, window=32,
+        initial_len=initial,
+    )
+    ref = _single(stream, initial)
+    sharded = SeqShardedOverlay(
+        stream, 3, initial_len=initial, fold_interval=16, n_removers=10,
+    )
+    sharded.replay()
+    sharded.check_errors()
+    assert state_digest(sharded.annotated_spans()) == state_digest(
+        ref.annotated_spans()
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_seqshard_ref_rebalance(seed):
+    """Boundary segment exchange mid-stream: rebalancing to even
+    shard sizes (splitting straddling spans) preserves the document."""
+    n_ops, initial = 240, 24
+    stream = generate_lagged_stream(
+        n_ops, n_clients=5, seed=500 + seed, window=32,
+        initial_len=initial,
+    )
+    ref = _single(stream, initial)
+    sharded = SeqShardedOverlay(
+        stream, 4, initial_len=initial, n_removers=10,
+    )
+    s = stream
+    for i in range(len(s)):
+        sharded.apply(
+            int(s.op_type[i]), int(s.pos1[i]), int(s.pos2[i]),
+            int(s.seq[i]), int(s.ref_seq[i]), int(s.client[i]),
+            int(s.buf_start[i]), int(s.ins_len[i]),
+            [int(s.prop_key[i])], [int(s.prop_val[i])],
+        )
+        if (i + 1) % 64 == 0:
+            sharded.fold(int(s.min_seq[i]))
+            sharded.rebalance()
+            sharded.verify_invariants()
+            # Rebalance actually evens the shards out.
+            sizes = [sh.S for sh in sharded.shards]
+            assert max(sizes) - min(sizes) <= 1
+    sharded.fold(int(s.min_seq[len(s) - 1]))
+    sharded.check_errors()
+    assert state_digest(sharded.annotated_spans()) == state_digest(
+        ref.annotated_spans()
+    )
+
+
+@pytest.mark.parametrize("n_dev", [2, 4])
+def test_seqshard_compiled_matches_single_doc(n_dev):
+    """The shard_map form on the virtual mesh: one document
+    sequence-sharded across devices, digest-identical to the
+    single-device overlay replay."""
+    import jax
+
+    if len(jax.devices()) < n_dev:
+        pytest.skip(f"needs {n_dev} (virtual) devices")
+    from fluidframework_tpu.parallel.mesh import make_docs_mesh
+    from fluidframework_tpu.parallel.seqshard import run_sequence_sharded
+
+    initial = 36
+    stream = generate_lagged_stream(
+        220, n_clients=6, seed=77, window=40, initial_len=initial,
+    )
+    ref = _single(stream, initial)
+    mesh = make_docs_mesh(n_dev, axis="seq")
+    sharded, gerr = run_sequence_sharded(
+        stream, mesh, initial, capacity=2048,
+    )
+    assert gerr == 0
+    assert state_digest(sharded.annotated_spans()) == state_digest(
+        ref.annotated_spans()
+    )
+
+
+def test_seqshard_window_exceeds_single_device():
+    """The live window (fold-free rows) exceeds ONE shard's capacity:
+    only the sharded engine can hold it — the case sequence sharding
+    exists for."""
+    import jax
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 (virtual) devices")
+    from fluidframework_tpu.parallel.mesh import make_docs_mesh
+    from fluidframework_tpu.parallel.seqshard import run_sequence_sharded
+
+    initial = 48
+    stream = generate_lagged_stream(
+        600, n_clients=8, seed=13, window=64, initial_len=initial,
+    )
+    ref = _single(stream, initial)
+    cap = 448  # > any one shard's occupancy, < the total window
+    mesh = make_docs_mesh(4, axis="seq")
+    sharded, gerr = run_sequence_sharded(
+        stream, mesh, initial, capacity=cap,
+    )
+    assert gerr == 0
+    total_rows = sum(sh.n for sh in sharded.shards)
+    assert total_rows > cap, (
+        f"window {total_rows} must exceed one device's capacity {cap}"
+    )
+    assert state_digest(sharded.annotated_spans()) == state_digest(
+        ref.annotated_spans()
+    )
+
+
+def test_seqshard_skewed_boundaries():
+    """All edits landing in one shard's range still converge (the
+    degenerate skew a doc-sharded mesh cannot handle at all)."""
+    n_ops, initial = 200, 100
+    stream = generate_lagged_stream(
+        n_ops, n_clients=4, seed=7, window=24, initial_len=initial,
+    )
+    ref = _single(stream, initial)
+    for n_shards in (2, 5, 8):
+        sharded = SeqShardedOverlay(
+            stream, n_shards, initial_len=initial, n_removers=10,
+        )
+        sharded.replay()
+        sharded.check_errors()
+        assert state_digest(sharded.annotated_spans()) == state_digest(
+            ref.annotated_spans()
+        ), f"n_shards={n_shards}"

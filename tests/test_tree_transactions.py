@@ -307,6 +307,25 @@ def test_tree_transaction_abort_still_ships_id_allocation():
     assert t0.view() == t1.view()
 
 
+def test_tree_empty_transaction_still_ships_id_allocation():
+    """A transaction that squashes to NOTHING but allocated ids must
+    still ship the allocation (same invariant as the abort path)."""
+    h, (t0, t1) = _harness()
+    t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
+    h.process_all()
+    t0.start_transaction()
+    t0.generate_id()
+    t0.edit([], id_count=1)
+    t0.commit_transaction()
+    t0.generate_id()
+    t0.insert_node([], "f", 1, [{"type": "n", "value": 1}], id_count=1)
+    h.process_all()
+    sess = str(h.runtimes[0].client_id)
+    assert t0.id_compressor._finalized.get(sess) == 2
+    assert t1.id_compressor._finalized.get(sess) == 2
+    assert t0.view() == t1.view()
+
+
 def test_tree_undo_refused_while_transaction_open():
     h, (t0, _) = _harness()
     t0.insert_node([], "f", 0, [{"type": "n", "value": 0}])
