@@ -75,11 +75,14 @@ def approx_wire_size(obj: Any, budget: int) -> int:
         for k, v in obj.items():
             if not isinstance(k, str):
                 return -1
-            total += 4 + 2 * len(k)
+            # Keys bound like any string (control/non-ASCII chars
+            # render as \uXXXX) + ': ' separator (2 bytes — json's
+            # default separators emit two bytes for ': ' and ', ').
+            total += approx_wire_size(k, budget - total) + 2
             s = approx_wire_size(v, budget - total)
             if s < 0:
                 return -1
-            total += s + 1
+            total += s + 2  # ', ' between items (over-counts the last)
             if total > budget:
                 return total
         return total
@@ -89,7 +92,7 @@ def approx_wire_size(obj: Any, budget: int) -> int:
             s = approx_wire_size(v, budget - total)
             if s < 0:
                 return -1
-            total += s + 1
+            total += s + 2  # ', ' between items (over-counts the last)
             if total > budget:
                 return total
         return total

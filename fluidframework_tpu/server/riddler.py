@@ -15,6 +15,7 @@ same construction from the standard library.
 from __future__ import annotations
 
 import base64
+import binascii
 import hashlib
 import hmac
 import json
@@ -116,18 +117,33 @@ class TenantManager:
         want = hmac.new(
             key.encode(), signing.encode(), hashlib.sha256
         ).digest()
-        if not hmac.compare_digest(want, _unb64(parts[2])):
+        try:
+            got = _unb64(parts[2])
+        except (ValueError, binascii.Error):
+            # Malformed base64 in the signature segment is an auth
+            # failure, not an internal error — callers catch AuthError
+            # (the documented auth-nack contract).
+            raise AuthError("malformed token") from None
+        if not hmac.compare_digest(want, got):
             raise AuthError("bad token signature")
         try:
             claims = json.loads(_unb64(parts[1]))
-        except ValueError:
+        except (ValueError, binascii.Error):
             raise AuthError("malformed token payload") from None
+        if not isinstance(claims, dict):
+            # A signed-but-malformed payload (non-object JSON) is
+            # still an auth failure, not an internal error.
+            raise AuthError("malformed token payload")
         if claims.get("tenantId") != tenant_id:
             raise AuthError("token tenant mismatch")
         if document_id is not None and claims.get("documentId") != document_id:
             raise AuthError("token document mismatch")
         now = time.time() if now is None else now
-        if now >= float(claims.get("exp", 0)):
+        try:
+            exp = float(claims.get("exp", 0))
+        except (TypeError, ValueError):
+            raise AuthError("malformed token expiry") from None
+        if now >= exp:
             raise AuthError("token expired")
         return claims
 

@@ -49,11 +49,35 @@ def session_uuid(session_id: str) -> uuid.UUID:
         return uuid.uuid5(_SESSION_NS, session_id)
 
 
+def _uuid_value(b: int) -> int:
+    """The 122 VALUE bits of a 128-bit UUID int — everything except
+    the v4 version nibble (bits 76-79) and variant bits (62-63), which
+    the reference's numericUuid.ts treats as immutable. All stable-id
+    offset arithmetic happens in this value space."""
+    low = b & ((1 << 62) - 1)                  # bits 0-61
+    mid = (b >> 64) & ((1 << 12) - 1)          # bits 64-75
+    high = b >> 80                             # bits 80-127
+    return (high << 74) | (mid << 62) | low
+
+
+def _value_to_uuid_int(v: int) -> int:
+    """Inverse of `_uuid_value`, re-inserting version 4 and the RFC
+    variant — every generated stable id is a valid v4 UUID."""
+    low = v & ((1 << 62) - 1)
+    mid = (v >> 62) & ((1 << 12) - 1)
+    high = v >> 74
+    return (high << 80) | (0x4 << 76) | (mid << 64) | (0b10 << 62) | low
+
+
 def _uuid_add(base: uuid.UUID, offset: int) -> str:
     """Numeric UUID arithmetic (the reference's
-    stableIdFromNumericUuid): stable ids within a session are the
-    session UUID plus the id's ordinal offset."""
-    return str(uuid.UUID(int=(base.int + offset) & ((1 << 128) - 1)))
+    stableIdFromNumericUuid, id-compressor numericUuid.ts): stable ids
+    within a session are the session UUID plus the id's ordinal
+    offset, carried AROUND the immutable version/variant bits — adds
+    crossing a region boundary still yield valid v4 UUIDs (raw
+    128-bit addition would corrupt the reserved bits)."""
+    v = (_uuid_value(base.int) + offset) & ((1 << 122) - 1)
+    return str(uuid.UUID(int=_value_to_uuid_int(v)))
 
 
 @dataclass
@@ -218,7 +242,9 @@ class IdCompressor:
             cache = self._base_cache = {}
         base = cache.get(session)
         if base is None:
-            base = cache[session] = session_uuid(session).int
+            base = cache[session] = _uuid_value(
+                session_uuid(session).int
+            )
         return base
 
     def _ordinal_to_final_reserved(
@@ -243,14 +269,18 @@ class IdCompressor:
         reference's recompress): reserved finals (including eager
         finals whose finalize hasn't caught up) resolve to finals,
         our own others to locals, KeyError for unknown ids."""
-        target = uuid.UUID(stable).int
+        target = _uuid_value(uuid.UUID(stable).int)
+        mask = (1 << 122) - 1
         best: Optional[Tuple[str, int]] = None
         for session in self._clusters:
-            off = target - self._session_base(session)
+            # Offsets wrap modulo the 122-bit value space (as
+            # _uuid_add does), so a session base near the top still
+            # resolves its ids.
+            off = (target - self._session_base(session)) & mask
             if 0 <= off < (1 << 64):
                 if best is None or off < best[1]:
                     best = (session, off)
-        own_off = target - self._session_base(self.session_id)
+        own_off = (target - self._session_base(self.session_id)) & mask
         if 0 <= own_off < self._local_count and (
             best is None or own_off < best[1]
         ):

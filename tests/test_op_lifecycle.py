@@ -185,3 +185,46 @@ def test_chunk_reassembler_restart_drops_stale_buffer():
     for c in chunks:
         complete, out = r.feed(9, c)
     assert complete
+
+
+def test_approx_wire_size_is_conservative_fuzz():
+    """approx_wire_size must NEVER under-estimate json.dumps' actual
+    byte count (the outbox uses it to SKIP serialization when safely
+    under the compression/chunking thresholds) — including json's
+    2-byte ', '/': ' separators on list/dict-heavy payloads."""
+    import random
+
+    from fluidframework_tpu.runtime.op_lifecycle import (
+        _dumps,
+        approx_wire_size,
+    )
+
+    rng = random.Random(11)
+
+    def gen(depth=0):
+        r = rng.random()
+        if depth > 3 or r < 0.25:
+            return rng.choice([
+                None, True, False, rng.randint(-10**9, 10**9),
+                rng.random(),
+                "".join(rng.choice("ab\x01é\\\" ") for _ in
+                        range(rng.randint(0, 8))),
+            ])
+        if r < 0.6:
+            return [gen(depth + 1) for _ in range(rng.randint(0, 6))]
+        return {
+            rng.choice([f"key{j}", f"k\x01{j}", f"clé{j}", f"键{j}"]):
+                gen(depth + 1)
+            for j in range(rng.randint(0, 5))
+        }
+
+    for _ in range(300):
+        payload = gen()
+        bound = approx_wire_size(payload, 1 << 30)
+        if bound < 0:
+            continue  # unboundable: caller serializes exactly
+        actual = len(_dumps(payload))
+        assert bound >= actual, (payload, bound, actual)
+    # The advisor's exact repros.
+    for payload in ([""] * 20, ["\x01"] * 5):
+        assert approx_wire_size(payload, 1 << 30) >= len(_dumps(payload))

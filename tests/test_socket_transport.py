@@ -300,3 +300,45 @@ def test_read_scope_cannot_write(secure_server):
         ro.connect(doc)
     with pytest.raises(RuntimeError, match="doc:write required"):
         ro.upload_blob(doc, b"x")
+
+
+def test_malformed_token_signature_raises_auth_error():
+    """A token whose signature segment is not valid base64 must raise
+    AuthError (the documented auth-nack contract), never a bare
+    binascii/ValueError."""
+    import pytest
+
+    from fluidframework_tpu.server.riddler import (
+        AuthError,
+        TenantManager,
+    )
+
+    reg = TenantManager()
+    reg.create_tenant("acme")
+    with pytest.raises(AuthError):
+        reg.validate_token("e30.e30.!!!not-base64!!!", "acme")
+    with pytest.raises(AuthError):
+        reg.validate_token("a.b", "acme")
+    # Signed-but-malformed payloads are auth failures too.
+    import base64 as _b64
+    import hashlib as _hashlib
+    import hmac as _hmac
+    import json as _json
+
+    def _signed(payload_obj):
+        key = reg.get_key("acme")
+        head = _b64.urlsafe_b64encode(b"{}").decode().rstrip("=")
+        body = _b64.urlsafe_b64encode(
+            _json.dumps(payload_obj).encode()
+        ).decode().rstrip("=")
+        sig = _b64.urlsafe_b64encode(_hmac.new(
+            key.encode(), f"{head}.{body}".encode(), _hashlib.sha256
+        ).digest()).decode().rstrip("=")
+        return f"{head}.{body}.{sig}"
+
+    with pytest.raises(AuthError):
+        reg.validate_token(_signed([1, 2]), "acme")  # non-object claims
+    with pytest.raises(AuthError):
+        reg.validate_token(
+            _signed({"tenantId": "acme", "exp": "never"}), "acme"
+        )  # non-numeric expiry

@@ -527,3 +527,29 @@ def test_id_compressor_binary_rejects_truncation():
     for cut in (3, 7, len(blob) // 2, len(blob) - 1):
         with pytest.raises(ValueError):
             IdCompressor.deserialize_binary(blob[:cut])
+
+
+def test_stable_id_arithmetic_respects_uuid_regions():
+    """Stable-id offsets carry AROUND the v4 version nibble and
+    variant bits (numericUuid.ts): adds crossing a region boundary
+    still produce valid v4 UUIDs, and recompress inverts them."""
+    import uuid as _uuid
+
+    from fluidframework_tpu.tree.id_compressor import (
+        IdCompressor,
+        _uuid_add,
+        session_uuid,
+    )
+
+    # A session UUID whose low value bits sit at the region boundary.
+    base = session_uuid("ffffffff-ffff-4fff-bfff-ffffffffffff")
+    for off in (0, 1, 5, 1 << 40):
+        u = _uuid.UUID(_uuid_add(base, off))
+        assert u.version == 4, (off, str(u))
+        assert str(u)[19] in "89ab", (off, str(u))
+    c = IdCompressor(session_id="ffffffff-ffff-4fff-bfff-ffffffffffff")
+    ids = [c.generate_compressed_id() for _ in range(4)]
+    for i in ids:
+        stable = c.stable_id_of(i)
+        assert _uuid.UUID(stable).version == 4
+        assert c.recompress(stable) == i
