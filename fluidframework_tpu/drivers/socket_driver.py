@@ -28,17 +28,16 @@ from ..protocol.messages import DocumentMessage, NackMessage, SequencedMessage
 
 
 class _Rpc:
-    """One request/response exchange over a fresh socket."""
+    """One request/response exchange over a fresh socket. Credentials
+    are the caller's business (SocketDriver._call merges them per
+    document)."""
 
-    def __init__(self, host: str, port: int, auth: Optional[dict] = None):
+    def __init__(self, host: str, port: int):
         self.host, self.port = host, port
-        self.auth = auth
 
     def call(self, **req) -> Any:
         from ..server.framing import read_frame, write_frame
 
-        if self.auth:
-            req.update(self.auth)
         with socket.create_connection((self.host, self.port)) as s:
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             f = s.makefile("rwb")
@@ -56,8 +55,12 @@ class _SocketConnection:
     """A live delta connection (long-lived socket + reader thread)."""
 
     def __init__(self, host: str, port: int, doc_id: str,
-                 client_id: Optional[int], auth: Optional[dict] = None):
-        self._auth = auth
+                 client_id: Optional[int], auth_factory=None):
+        """`auth_factory`: zero-arg callable returning the CURRENT
+        credentials dict (or None) — re-resolved on every request so a
+        token provider can rotate tokens under a long-lived connection
+        (the server re-authorizes every command)."""
+        self._auth_factory = auth_factory
         self._doc_id = doc_id
         self._sock = socket.create_connection((host, port))
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -93,8 +96,9 @@ class _SocketConnection:
     # --------------------------------------------------------- framing
 
     def _call(self, **req) -> Any:
-        if self._auth:
-            req.update(self._auth)
+        auth = self._auth_factory() if self._auth_factory else None
+        if auth:
+            req.update(auth)
             req.setdefault("docId", self._doc_id)
         with self._resp_cond:
             self._req_id += 1
@@ -294,42 +298,66 @@ class SocketDriver:
 
     def __init__(self, host: str, port: int,
                  tenant_id: Optional[str] = None,
-                 token: Optional[str] = None):
-        """`tenant_id`/`token`: riddler credentials (signed per-document
-        token; see server.riddler.sign_token) attached to every
-        request when the server runs with a TenantManager."""
+                 token: Optional[str] = None,
+                 token_provider=None):
+        """`tenant_id`/`token`: static riddler credentials (signed
+        per-document token; see server.riddler.sign_token) attached to
+        every request when the server runs with a TenantManager.
+        `token_provider`: the reference's ITokenProvider seam
+        (AzureClient.ts:51 connection config): an object with
+        ``credentials_for(doc_id) -> (tenant_id, token)`` resolving
+        FRESH per-document credentials for each request — takes
+        precedence over the static pair."""
         self.host, self.port = host, port
+        self.token_provider = token_provider
         self._auth = (
             {"tenantId": tenant_id, "token": token} if token else None
         )
-        self._rpc = _Rpc(host, port, self._auth)
+        self._rpc = _Rpc(host, port)
+
+    def _auth_for(self, doc_id: Optional[str]) -> Optional[dict]:
+        if self.token_provider is not None and doc_id is not None:
+            tenant_id, token = self.token_provider.credentials_for(doc_id)
+            return {"tenantId": tenant_id, "token": token}
+        return self._auth
+
+    def _call(self, doc_id: Optional[str], **req) -> Any:
+        auth = self._auth_for(doc_id)
+        if auth:
+            req.update(auth)
+        return self._rpc.call(**req)
 
     def create_document(self, doc_id: str, summary_wire: str) -> None:
-        self._rpc.call(cmd="create_document", docId=doc_id, summary=summary_wire)
+        self._call(doc_id, cmd="create_document", docId=doc_id,
+                   summary=summary_wire)
 
     def load_document(self, doc_id: str) -> Optional[str]:
-        return self._rpc.call(cmd="load_document", docId=doc_id)
+        return self._call(doc_id, cmd="load_document", docId=doc_id)
 
     def connect(self, doc_id: str, client_id: Optional[int] = None):
+        # The connection re-resolves credentials per request (token
+        # rotation under long-lived connections).
         return _SocketConnection(
-            self.host, self.port, doc_id, client_id, self._auth
+            self.host, self.port, doc_id, client_id,
+            lambda: self._auth_for(doc_id),
         )
 
     def ops_from(self, doc_id: str, from_seq: int,
                  to_seq: Optional[int] = None) -> List[SequencedMessage]:
         return [
             message_from_json(m)
-            for m in self._rpc.call(cmd="ops_from", docId=doc_id,
-                                    fromSeq=from_seq, toSeq=to_seq)
+            for m in self._call(doc_id, cmd="ops_from", docId=doc_id,
+                                fromSeq=from_seq, toSeq=to_seq)
         ]
 
     def upload_blob(self, doc_id: str, data: bytes) -> str:
-        return self._rpc.call(
-            cmd="upload_blob", docId=doc_id,
+        return self._call(
+            doc_id, cmd="upload_blob", docId=doc_id,
             data=base64.b64encode(data).decode(),
         )
 
     def read_blob(self, doc_id: str, blob_id: str) -> bytes:
         return base64.b64decode(
-            self._rpc.call(cmd="read_blob", docId=doc_id, blobId=blob_id)
+            self._call(doc_id, cmd="read_blob", docId=doc_id,
+                       blobId=blob_id)
         )

@@ -140,13 +140,62 @@ class FluidContainer(EventEmitter):
         self.emit("disposed")
 
 
+class InsecureTokenProvider:
+    """Signs per-document tokens locally with the tenant key — the
+    tinylicious-client `InsecureTokenProvider` role (the key lives in
+    the client, so dev/test only; a production provider fetches tokens
+    from a secure service instead, the `ITokenProvider` contract of
+    AzureClient.ts:51)."""
+
+    def __init__(self, tenant_id: str, key: str,
+                 user: Optional[dict] = None,
+                 scopes: Optional[list] = None):
+        from ..server.riddler import SCOPE_READ, SCOPE_WRITE
+
+        self.tenant_id = tenant_id
+        self.key = key
+        self.user = user or {"id": "insecure-user"}
+        self.scopes = list(scopes or [SCOPE_READ, SCOPE_WRITE])
+
+    def credentials_for(self, doc_id: str):
+        from ..server.riddler import sign_token
+
+        return self.tenant_id, sign_token(
+            self.key, self.tenant_id, doc_id, self.scopes, self.user
+        )
+
+
 class TpuClient:
     """Service client (AzureClient.ts:51 shape) over any server with
-    the LocalServer surface."""
+    the LocalServer surface, or over the TCP `SocketDriver` surface.
 
-    def __init__(self, server, registry: Optional[ChannelRegistry] = None):
+    `token_provider`: an object with ``credentials_for(doc_id) ->
+    (tenant_id, token)`` (e.g. `InsecureTokenProvider`). When given,
+    it threads through to the driver so every request carries fresh
+    per-document credentials — the AzureClient token-provider seam."""
+
+    def __init__(self, server, registry: Optional[ChannelRegistry] = None,
+                 token_provider=None):
         self.server = server
         self.registry = registry or default_registry()
+        if token_provider is not None:
+            if not hasattr(server, "token_provider"):
+                raise TypeError(
+                    "this server surface has no credential seam; "
+                    "connect a SocketDriver to use a token provider"
+                )
+            if (
+                server.token_provider is not None
+                and server.token_provider is not token_provider
+            ):
+                # Never silently overwrite another client's provider
+                # on a shared driver (last-writer-wins credentials).
+                raise ValueError(
+                    "driver already carries a different token "
+                    "provider; construct a dedicated SocketDriver "
+                    "(or pass token_provider to it directly)"
+                )
+            server.token_provider = token_provider
 
     # ------------------------------------------------------------ create
 
@@ -162,8 +211,13 @@ class TpuClient:
     def _attach(self, container: FluidContainer, doc_id: Optional[str]) -> str:
         doc_id = doc_id or uuid.uuid4().hex[:12]
         wire = container.runtime.summarize().to_json()
-        handle = self.server.upload_summary(wire)
-        self.server.storage.set_ref(doc_id, handle)
+        if hasattr(self.server, "create_document"):
+            # Driver surface (SocketDriver over TCP): the server-side
+            # historian/storage owns the summary handle.
+            self.server.create_document(doc_id, wire)
+        else:
+            handle = self.server.upload_summary(wire)
+            self.server.storage.set_ref(doc_id, handle)
         container.doc_id = doc_id
         self._connect(container)
         return doc_id
@@ -178,7 +232,10 @@ class TpuClient:
         """Load the latest summary and catch up (AzureClient
         .getContainer :144)."""
         rt = ContainerRuntime(self.registry)
-        wire = self.server.download_summary(doc_id)
+        if hasattr(self.server, "load_document"):
+            wire = self.server.load_document(doc_id)
+        else:
+            wire = self.server.download_summary(doc_id)
         if wire is None:
             raise KeyError(f"unknown document {doc_id!r}")
         rt.load(SummaryTree.from_json(wire))

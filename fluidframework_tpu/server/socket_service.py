@@ -248,14 +248,23 @@ class SocketDeltaServer:
     network door step)."""
 
     def __init__(self, local_server, host: str = "127.0.0.1", port: int = 0,
-                 tenants=None):
-        """`tenants`: an optional `server.riddler.TenantManager`. When
-        set, EVERY command must carry valid tenant credentials
-        (tenantId + signed token bound to the document, with scopes
-        covering the command) — the alfred token gate
-        (alfred/index.ts:595); failures surface as error responses
-        (the auth-nack path). When None the server is open, the
-        tinylicious-style dev mode."""
+                 tenants=None, allow_anonymous: bool = False):
+        """`tenants`: a `server.riddler.TenantManager`. When set, EVERY
+        command must carry valid tenant credentials (tenantId + signed
+        token bound to the document, with scopes covering the command)
+        — the alfred token gate (alfred/index.ts:595); failures
+        surface as error responses (the auth-nack path).
+
+        SECURE BY DEFAULT (the reference validates tokens
+        unconditionally): constructing without a TenantManager
+        requires the explicit ``allow_anonymous=True`` opt-out — the
+        tinylicious-style open dev mode cannot happen by accident."""
+        if tenants is None and not allow_anonymous:
+            raise ValueError(
+                "SocketDeltaServer is secure by default: pass a "
+                "TenantManager via tenants=, or opt out explicitly "
+                "with allow_anonymous=True"
+            )
         self.local_server = local_server
         self.tenants = tenants
         self.lock = threading.RLock()

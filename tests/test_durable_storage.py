@@ -149,7 +149,7 @@ def _spawn_server(storage_dir):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tools", "socket_server_main.py"),
-         "--storage-dir", storage_dir],
+         "--storage-dir", storage_dir, "--allow-anonymous"],
         stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
     )
     line = proc.stdout.readline().strip()

@@ -38,7 +38,8 @@ def wait_until(cond, timeout=10.0, interval=0.02):
 def server_process():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
-        [sys.executable, os.path.join(REPO, "tools", "socket_server_main.py")],
+        [sys.executable, os.path.join(REPO, "tools", "socket_server_main.py"),
+         "--allow-anonymous"],
         stdout=subprocess.PIPE, text=True, env=env, cwd=REPO,
     )
     line = proc.stdout.readline().strip()
@@ -342,3 +343,52 @@ def test_malformed_token_signature_raises_auth_error():
         reg.validate_token(
             _signed({"tenantId": "acme", "exp": "never"}), "acme"
         )  # non-numeric expiry
+
+
+def test_socket_server_secure_by_default():
+    """Constructing a TCP front door without tenants and without the
+    explicit allow_anonymous opt-out must refuse (alfred validates
+    tokens unconditionally — open mode cannot happen by accident)."""
+    import pytest as _pytest
+
+    from fluidframework_tpu.server import LocalServer
+    from fluidframework_tpu.server.socket_service import SocketDeltaServer
+
+    with _pytest.raises(ValueError, match="secure by default"):
+        SocketDeltaServer(LocalServer(), port=0)
+
+
+def test_tpu_client_token_provider_over_secure_server(secure_server):
+    """The public client path end-to-end over a SECURE server: a
+    TpuClient with an InsecureTokenProvider creates, attaches, and
+    loads containers over TCP with per-document credentials — and the
+    same client WITHOUT credentials is refused."""
+    from fluidframework_tpu.dds import MapFactory
+    from fluidframework_tpu.framework.fluid_static import (
+        ContainerSchema,
+        InsecureTokenProvider,
+        TpuClient,
+    )
+
+    host, port = secure_server
+    schema = ContainerSchema({"kv": MapFactory.type_name})
+    provider = InsecureTokenProvider(TENANT, KEY)
+    client = TpuClient(
+        SocketDriver(host, port), token_provider=provider
+    )
+    c = client.create_container(schema)
+    kv = c.initial_objects["kv"]
+    kv.set("who", "authorized")
+    doc = c.attach()
+    c.flush()
+    time.sleep(0.3)
+
+    c2 = TpuClient(
+        SocketDriver(host, port), token_provider=provider
+    ).get_container(doc, schema)
+    assert c2.initial_objects["kv"].get("who") == "authorized"
+
+    # No credentials -> refused at the front door.
+    bare = TpuClient(SocketDriver(host, port))
+    with pytest.raises(RuntimeError, match="missing tenant credentials"):
+        bare.get_container(doc, schema)

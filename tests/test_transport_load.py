@@ -51,7 +51,9 @@ def test_16_process_load_no_reordering():
     from fluidframework_tpu.server import LocalServer
     from fluidframework_tpu.server.socket_service import SocketDeltaServer
 
-    srv = SocketDeltaServer(LocalServer(), port=0).start()
+    srv = SocketDeltaServer(
+        LocalServer(), port=0, allow_anonymous=True
+    ).start()
     try:
         n_procs, n_ops, batch = 16, 1500, 500
         env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -1,6 +1,9 @@
 """Standalone ordering-service process: LocalServer behind TCP.
 
 Run: python tools/socket_server_main.py [port] [--storage-dir DIR]
+    (--tenant id:key [repeatable] | --allow-anonymous)
+Secure by default: starting without tenants requires the explicit
+--allow-anonymous opt-out.
 Prints "LISTENING <host> <port>" once ready, then serves until killed.
 Containers in other processes collaborate through it via
 drivers.socket_driver.SocketDriver (tests/test_socket_transport.py).
@@ -41,9 +44,21 @@ def main() -> None:
         del args[i: i + 2]
         tenants = tenants or TenantManager()
         tenants.create_tenant(tid, key)
+    allow_anonymous = False
+    if "--allow-anonymous" in args:
+        allow_anonymous = True
+        args.remove("--allow-anonymous")
     port = int(args[0]) if args else 0
+    if tenants is None and not allow_anonymous:
+        print(
+            "refusing to start open: pass --tenant id:key (secure) or "
+            "--allow-anonymous (explicit open dev mode)",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     srv = SocketDeltaServer(
-        LocalServer(persist_dir=storage_dir), port=port, tenants=tenants
+        LocalServer(persist_dir=storage_dir), port=port, tenants=tenants,
+        allow_anonymous=allow_anonymous,
     ).start()
     print(f"LISTENING {srv.host} {srv.port}", flush=True)
     try:
