@@ -149,20 +149,35 @@ class InsecureTokenProvider:
 
     def __init__(self, tenant_id: str, key: str,
                  user: Optional[dict] = None,
-                 scopes: Optional[list] = None):
+                 scopes: Optional[list] = None,
+                 lifetime_s: float = 3600.0):
         from ..server.riddler import SCOPE_READ, SCOPE_WRITE
 
         self.tenant_id = tenant_id
         self.key = key
         self.user = user or {"id": "insecure-user"}
         self.scopes = list(scopes or [SCOPE_READ, SCOPE_WRITE])
+        self.lifetime_s = lifetime_s
+        self._cache: dict = {}  # doc_id -> (expiry, token)
 
     def credentials_for(self, doc_id: str):
+        import time as _time
+
         from ..server.riddler import sign_token
 
-        return self.tenant_id, sign_token(
-            self.key, self.tenant_id, doc_id, self.scopes, self.user
+        # Cache per document until near expiry: signing (JSON + HMAC +
+        # base64) stays off the per-submit hot path while the rotation
+        # seam keeps long-lived connections alive past expiry.
+        now = _time.time()
+        hit = self._cache.get(doc_id)
+        if hit is not None and now < hit[0]:
+            return self.tenant_id, hit[1]
+        token = sign_token(
+            self.key, self.tenant_id, doc_id, self.scopes, self.user,
+            lifetime_s=self.lifetime_s, now=now,
         )
+        self._cache[doc_id] = (now + self.lifetime_s * 0.8, token)
+        return self.tenant_id, token
 
 
 class TpuClient:
@@ -187,13 +202,15 @@ class TpuClient:
             if (
                 server.token_provider is not None
                 and server.token_provider is not token_provider
-            ):
-                # Never silently overwrite another client's provider
-                # on a shared driver (last-writer-wins credentials).
+            ) or getattr(server, "_auth", None) is not None:
+                # Never silently change a shared driver's credentials
+                # (another provider OR static tenant credentials —
+                # other users of the driver would start acting under
+                # this client's identity).
                 raise ValueError(
-                    "driver already carries a different token "
-                    "provider; construct a dedicated SocketDriver "
-                    "(or pass token_provider to it directly)"
+                    "driver already carries credentials; construct a "
+                    "dedicated SocketDriver (or pass token_provider "
+                    "to it directly)"
                 )
             server.token_provider = token_provider
 

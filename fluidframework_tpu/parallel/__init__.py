@@ -14,6 +14,7 @@ from .mesh import (
     make_docs_mesh,
     replicate_sharding,
     sharded_overlay_replay,
+    sharded_overlay_replay_multi,
     sharded_pipeline_step,
     shard_tables,
 )
@@ -26,6 +27,7 @@ __all__ = [
     "replicate_sharding",
     "shard_tables",
     "sharded_overlay_replay",
+    "sharded_overlay_replay_multi",
     "sharded_pipeline_step",
     "sequence_sharded_replay",
     "run_sequence_sharded",

@@ -68,31 +68,27 @@ def shard_tables(tables: SegmentTable, mesh: Mesh, axis: str = "docs") -> Segmen
 def sharded_overlay_replay(
     mesh: Mesh, chunk: int, interpret: bool = False, axis: str = "docs"
 ):
-    """Compile the doc-sharded OVERLAY fused replay for `mesh`.
+    """Compile the doc-sharded OVERLAY fused replay for `mesh` — the
+    one-document-per-device form of `sharded_overlay_replay_multi`
+    (which this delegates to; pass a leading docs axis equal to
+    ``mesh.size``)."""
+    return sharded_overlay_replay_multi(mesh, chunk, interpret, axis)
 
-    The flagship engine on the mesh (the reference's per-partition
-    deli model: one sequencer/replayer per document partition,
-    server/routerlicious/packages/lambdas-driver/src/document-router/,
-    deli/lambda.ts:215): every per-document array carries a leading
-    `docs` axis laid out across the mesh (one document per device);
-    inside `shard_map` each device runs the WHOLE fused overlay replay
-    (ops/overlay_pallas.replay_fused — pallas chunk kernel + fold +
-    HBM log append, one dispatch) on its local document, then the
-    fleet reduces the global MSN (min over documents — the
-    clientSeqManager.ts:22 role, lowered by XLA to an ICI collective)
-    and or-combines the per-document error flags.
 
-    Returns a jitted
-    ``step(tables, ops, logs, counts, msn_by_chunk) ->
-    (tables', logs', counts', cursors, global_msn, error)``
-    where every input/output has a leading docs axis of size
-    ``mesh.size`` (one document per device; batch more documents by
-    calling with a docs axis that is a multiple of the mesh via an
-    outer vmap).
+def sharded_overlay_replay_multi(
+    mesh: Mesh, chunk: int, interpret: bool = False, axis: str = "docs"
+):
+    """The flagship overlay replay with MULTIPLE documents per device:
+    the leading docs axis is ``mesh.size * docs_per_device`` and
+    shards across the mesh; inside `shard_map` each device runs its
+    local documents SERIALLY through the whole fused replay
+    (`lax.map` — exactly the per-partition deli model: one sequencer/
+    replayer instance working through its partition's documents,
+    lambdas-driver/src/document-router/), then the fleet min-reduces
+    the applied MSN and or-combines error bits over ICI.
 
-    `interpret=True` runs the pallas kernel through the interpreter —
-    required on CPU backends (the virtual-device dry run); on a real
-    TPU slice the compiled kernel runs per-device unchanged.
+    Same signature/returns as `sharded_overlay_replay`; the leading
+    axis may be any multiple of ``mesh.size``.
     """
     from jax import shard_map
 
@@ -101,22 +97,19 @@ def sharded_overlay_replay(
     docs = P(axis)
 
     def local_replay(tables, ops, logs, counts, msns):
-        # Local shard views carry a docs_per_device == 1 leading axis.
-        t = jax.tree_util.tree_map(lambda a: a[0], tables)
-        o = jax.tree_util.tree_map(lambda a: a[0], ops)
-        t, log, cnt, cursor = replay_fused(
-            t, o, logs[0], counts[0], msns[0], chunk, interpret
+        def one(args):
+            t, o, log, cnt, msn = args
+            return replay_fused(t, o, log, cnt, msn, chunk, interpret)
+
+        t, log, cnt, cursor = jax.lax.map(
+            one, (tables, ops, logs, counts, msns)
         )
-        # Fleet reductions over ICI: global applied MSN and error or.
-        gmsn = jax.lax.pmin(msns[0, -1], axis)
+        gmsn = jax.lax.pmin(jnp.min(msns[:, -1]), axis)
         bits = jnp.arange(31, dtype=jnp.int32)
-        err = jax.lax.pmax((t.error >> bits) & 1, axis)
+        local_err = jnp.max((t.error[:, None] >> bits) & 1, axis=0)
+        err = jax.lax.pmax(local_err, axis)
         gerr = jnp.sum(err << bits)
-        up = lambda a: a[None]
-        return (
-            jax.tree_util.tree_map(up, t), log[None], cnt[None],
-            cursor[None], gmsn, gerr,
-        )
+        return t, log, cnt, cursor, gmsn, gerr
 
     table_specs = OverlayTable(
         n_rows=docs, anchor=docs, buf_start=docs, length=docs,
