@@ -315,6 +315,12 @@ class SocketDriver:
         )
         self._rpc = _Rpc(host, port)
 
+    def has_credentials(self) -> bool:
+        """Does this driver already carry ANY credentials (a provider
+        or a static tenant pair)? Public predicate so callers (e.g.
+        TpuClient's provider guard) never reach into private state."""
+        return self.token_provider is not None or self._auth is not None
+
     def _auth_for(self, doc_id: Optional[str]) -> Optional[dict]:
         if self.token_provider is not None and doc_id is not None:
             tenant_id, token = self.token_provider.credentials_for(doc_id)

@@ -199,20 +199,22 @@ class TpuClient:
                     "this server surface has no credential seam; "
                     "connect a SocketDriver to use a token provider"
                 )
-            if (
-                server.token_provider is not None
-                and server.token_provider is not token_provider
-            ) or getattr(server, "_auth", None) is not None:
+            if server.token_provider is not token_provider:
                 # Never silently change a shared driver's credentials
                 # (another provider OR static tenant credentials —
                 # other users of the driver would start acting under
-                # this client's identity).
-                raise ValueError(
-                    "driver already carries credentials; construct a "
-                    "dedicated SocketDriver (or pass token_provider "
-                    "to it directly)"
-                )
-            server.token_provider = token_provider
+                # this client's identity). Re-attaching the SAME
+                # provider is an idempotent no-op.
+                has = getattr(server, "has_credentials", None)
+                if has() if has is not None else (
+                    server.token_provider is not None
+                ):
+                    raise ValueError(
+                        "driver already carries credentials; "
+                        "construct a dedicated SocketDriver (or pass "
+                        "token_provider to it directly)"
+                    )
+                server.token_provider = token_provider
 
     # ------------------------------------------------------------ create
 

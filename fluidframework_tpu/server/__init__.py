@@ -15,6 +15,15 @@ kernel call) lives in fluidframework_tpu/ops/sequencer_kernel.py.
 from .sequencer import DocumentSequencer, NACK_STALE_REFSEQ
 from .local_service import LocalOrderingService
 from .castore import ContentAddressedStore
+from .queue import (
+    JournalConsumer,
+    JournalProducer,
+    LeaseManager,
+    SharedFileConsumer,
+    SharedFileProducer,
+    SharedFileTopic,
+    partition_of,
+)
 from .log import LogConsumer, LogTopic, MessageLog
 from .lambdas import (
     BroadcasterLambda,
@@ -25,6 +34,13 @@ from .lambdas import (
 )
 
 __all__ = [
+    "JournalConsumer",
+    "JournalProducer",
+    "LeaseManager",
+    "SharedFileConsumer",
+    "SharedFileProducer",
+    "SharedFileTopic",
+    "partition_of",
     "BroadcasterLambda",
     "ContentAddressedStore",
     "DeliLambda",

@@ -1,0 +1,222 @@
+"""Ordering-log seam + partition leases: multi-node coordination.
+
+Two abstractions the reference keeps in `services-core`
+(server/routerlicious/packages/services-core/src/queue.ts `IProducer`/
+`IConsumer`) and ZooKeeper (partition ownership for the Kafka
+consumers, SURVEY.md §2.5 ⚙️):
+
+- **Producer/consumer seam** — lambdas talk to topics only through
+  `Producer`/`Consumer`; the in-proc journal (`server.log.MessageLog`)
+  is one backend, and `SharedFileTopic` is a CROSS-PROCESS backend
+  (multi-writer appends under an OS file lock, consumers tail from a
+  checkpointed offset), so two server processes share one ordering
+  log the way two routerlicious pods share a Kafka cluster.
+- **Lease manager** — partition ownership with expiry-based failover
+  (the zookeeper role): a worker acquires leases over document-space
+  partitions, renews them while alive, and a peer takes over any
+  lease that expires (crashed owner), resuming from the dead worker's
+  checkpointed consumer offset.
+
+`tools/partition_worker_main.py` runs a sequencer worker over this
+seam; `tests/test_partition_leases.py` kills one of two workers and
+proves the survivor takes over its partitions exactly-once.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, List, Optional, Protocol
+
+
+class Producer(Protocol):
+    """services-core/src/queue.ts IProducer role."""
+
+    def send(self, message: Any) -> int: ...
+
+
+class Consumer(Protocol):
+    """services-core/src/queue.ts IConsumer role: an offset-owning
+    reader whose position is the caller's checkpoint state."""
+
+    offset: int
+
+    def poll(self, max_count: Optional[int] = None) -> List[Any]: ...
+
+
+class JournalProducer:
+    """Producer over an in-proc `server.log.LogTopic`."""
+
+    def __init__(self, topic):
+        self.topic = topic
+
+    def send(self, message: Any) -> int:
+        return self.topic.append(message)
+
+
+class JournalConsumer:
+    """Consumer over an in-proc `server.log.LogTopic`."""
+
+    def __init__(self, topic, offset: int = 0):
+        self.topic = topic
+        self.offset = offset
+
+    def poll(self, max_count: Optional[int] = None) -> List[Any]:
+        msgs = self.topic.read(self.offset, max_count)
+        self.offset += len(msgs)
+        return msgs
+
+
+class SharedFileTopic:
+    """A cross-process topic over one JSONL file.
+
+    Appends take an OS file lock (multi-writer safe); consumers tail
+    the file from a LINE offset, re-reading anything new on each poll
+    — the minimal faithful form of a shared Kafka partition. Entries
+    are plain JSON values.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if not os.path.exists(path):
+            with open(path, "a"):
+                pass
+
+    def append(self, message: Any) -> None:
+        import fcntl
+
+        line = json.dumps(message) + "\n"
+        with open(self.path, "a") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            try:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+            finally:
+                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    def read_from(self, offset: int) -> List[Any]:
+        out: List[Any] = []
+        with open(self.path) as f:
+            for i, line in enumerate(f):
+                if i >= offset and line.strip():
+                    out.append(json.loads(line))
+        return out
+
+
+class SharedFileProducer:
+    def __init__(self, topic: SharedFileTopic):
+        self.topic = topic
+
+    def send(self, message: Any) -> int:
+        self.topic.append(message)
+        return -1  # offsets are consumer-side for file topics
+
+
+class SharedFileConsumer:
+    def __init__(self, topic: SharedFileTopic, offset: int = 0):
+        self.topic = topic
+        self.offset = offset
+
+    def poll(self, max_count: Optional[int] = None) -> List[Any]:
+        msgs = self.topic.read_from(self.offset)
+        if max_count is not None:
+            msgs = msgs[:max_count]
+        self.offset += len(msgs)
+        return msgs
+
+
+# ---------------------------------------------------------------------------
+# Lease manager (zookeeper role)
+# ---------------------------------------------------------------------------
+
+
+class LeaseManager:
+    """Expiry-based partition leases over a shared directory.
+
+    A lease is a JSON file `<dir>/<partition>.lease` holding
+    ``{"owner", "expires", "fence"}``. Acquisition writes a temp file
+    and atomically renames it over the lease, then READS BACK to
+    confirm ownership (two racers both rename; exactly one's content
+    survives — the read-back arbitrates). `fence` increments on every
+    ownership change, the fencing token that lets downstream state
+    (checkpoints) reject a deposed owner's stale writes.
+    """
+
+    def __init__(self, directory: str, owner: str, ttl_s: float = 2.0):
+        self.dir = directory
+        self.owner = owner
+        self.ttl_s = ttl_s
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, partition: str) -> str:
+        return os.path.join(self.dir, f"{partition}.lease")
+
+    def _read(self, partition: str) -> Optional[dict]:
+        try:
+            with open(self._path(partition)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, partition: str, lease: dict) -> None:
+        tmp = self._path(partition) + f".tmp.{self.owner}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(lease, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path(partition))
+
+    def try_acquire(self, partition: str,
+                    now: Optional[float] = None) -> Optional[int]:
+        """Acquire `partition` if unowned, expired, or already ours.
+        Returns the fencing token on success, None otherwise."""
+        now = time.time() if now is None else now
+        cur = self._read(partition)
+        if cur is not None:
+            if cur.get("owner") == self.owner:
+                return int(cur.get("fence", 0))
+            if float(cur.get("expires", 0)) > now:
+                return None  # live foreign lease
+        fence = int(cur.get("fence", 0)) + 1 if cur else 1
+        self._write(partition, {
+            "owner": self.owner, "expires": now + self.ttl_s,
+            "fence": fence,
+        })
+        # Read-back arbitration: a concurrent racer may have renamed
+        # over ours between write and now.
+        got = self._read(partition)
+        if got is not None and got.get("owner") == self.owner:
+            return int(got.get("fence", fence))
+        return None
+
+    def renew(self, partition: str,
+              now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        cur = self._read(partition)
+        if cur is None or cur.get("owner") != self.owner:
+            return False  # deposed
+        self._write(partition, {**cur, "expires": now + self.ttl_s})
+        return True
+
+    def release(self, partition: str) -> None:
+        cur = self._read(partition)
+        if cur is not None and cur.get("owner") == self.owner:
+            self._write(partition, {**cur, "expires": 0})
+
+    def owner_of(self, partition: str) -> Optional[str]:
+        cur = self._read(partition)
+        if cur is None or float(cur.get("expires", 0)) <= time.time():
+            return None
+        return cur.get("owner")
+
+
+def partition_of(doc_id: str, n_partitions: int) -> int:
+    """Stable document-space partitioning (the Kafka partition-by-doc
+    role, lambdas-driver/src/document-router)."""
+    import hashlib
+
+    h = hashlib.sha256(doc_id.encode()).digest()
+    return int.from_bytes(h[:4], "big") % n_partitions

@@ -1,0 +1,249 @@
+"""Multi-node coordination: ordering-log seam + partition leases.
+
+The reference splits the document space across server pods via Kafka
+partitions, with ZooKeeper arbitrating consumer ownership (SURVEY.md
+§2.5 ⚙️). Here two OS processes coordinate only through a shared
+directory: each leases half the partitions and sequences its
+documents' submissions; killing one lets the survivor's sweep take
+the expired leases over and resume from the dead worker's checkpoint
+— every submission sequenced exactly once, per-document sequence
+numbers strictly increasing across the ownership change.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fluidframework_tpu.server.queue import (
+    LeaseManager,
+    SharedFileConsumer,
+    SharedFileProducer,
+    SharedFileTopic,
+    partition_of,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tools", "partition_worker_main.py")
+
+
+def _spawn(shared, wid, n_parts, ttl=1.0, max_parts=None):
+    cmd = [sys.executable, WORKER, shared, wid, str(n_parts),
+           "--ttl", str(ttl)]
+    if max_parts is not None:
+        cmd += ["--max-partitions", str(max_parts)]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+    )
+    line = proc.stdout.readline().strip()
+    assert line == f"READY {wid}", line
+    return proc
+
+
+def _submit_all(shared, n_parts, docs, ops_per_doc):
+    """Write submissions round-robin; returns expected (doc ->
+    set of clientSeq) map."""
+    topics = {
+        p: SharedFileTopic(os.path.join(shared, f"submissions-p{p}.jsonl"))
+        for p in range(n_parts)
+    }
+    expect = {}
+    for d, doc in enumerate(docs):
+        p = partition_of(doc, n_parts)
+        expect[doc] = set()
+        for i in range(ops_per_doc):
+            topics[p].append({
+                "docId": doc, "clientId": 1 + (i % 3),
+                "clientSeq": i // 3 + 1,
+                "refSeq": 0, "contents": {"i": i},
+            })
+            expect[doc].add((1 + (i % 3), i // 3 + 1))
+    return expect
+
+
+def _read_sequenced(shared, n_parts):
+    out = {}
+    for p in range(n_parts):
+        path = os.path.join(shared, f"sequenced-p{p}.jsonl")
+        if not os.path.exists(path):
+            continue
+        for m in SharedFileTopic(path).read_from(0):
+            out.setdefault(m["docId"], []).append(m)
+    return out
+
+
+def test_lease_manager_basics(tmp_path):
+    a = LeaseManager(str(tmp_path), "A", ttl_s=0.3)
+    b = LeaseManager(str(tmp_path), "B", ttl_s=0.3)
+    fa = a.try_acquire("p0")
+    assert fa == 1
+    assert b.try_acquire("p0") is None  # live foreign lease
+    assert a.renew("p0")
+    time.sleep(0.4)  # expire
+    fb = b.try_acquire("p0")
+    assert fb == 2  # fencing token advanced on takeover
+    assert not a.renew("p0")  # deposed
+    assert b.owner_of("p0") == "B"
+
+
+def test_two_workers_split_and_failover(tmp_path):
+    """Two worker processes split 4 partitions; killing one mid-stream
+    hands its partitions to the survivor with exactly-once sequencing
+    across the takeover."""
+    shared = str(tmp_path)
+    n_parts = 4
+    # Two documents in EVERY partition (searched by name so the split
+    # and the takeover both have real work regardless of hashing).
+    docs = []
+    per_part = {p: 0 for p in range(n_parts)}
+    i = 0
+    while any(c < 2 for c in per_part.values()):
+        name = f"doc{i}"
+        p = partition_of(name, n_parts)
+        if per_part[p] < 2:
+            docs.append(name)
+            per_part[p] += 1
+        i += 1
+    ops_per_doc = 120
+
+    # Phase 1: each worker limited to 2 partitions -> a true split.
+    wa = _spawn(shared, "A", n_parts, ttl=1.0, max_parts=2)
+    time.sleep(0.3)
+    wb = _spawn(shared, "B", n_parts, ttl=1.0, max_parts=2)
+    expect = _submit_all(shared, n_parts, docs, ops_per_doc)
+
+    try:
+        # Let both make progress, then verify the split is real.
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            seqd = _read_sequenced(shared, n_parts)
+            if sum(len(v) for v in seqd.values()) >= len(docs) * 30:
+                break
+            time.sleep(0.1)
+        leases = LeaseManager(os.path.join(shared, "leases"), "probe")
+        owners = {p: leases.owner_of(f"p{p}") for p in range(n_parts)}
+        assert set(owners.values()) == {"A", "B"}, owners
+        assert sum(1 for o in owners.values() if o == "A") == 2
+
+        # Phase 2: kill A, then submit a second wave for every doc —
+        # A's partitions now have pending work only a successor can
+        # drain. B stays capped at 2, so a replacement worker C sweeps
+        # up the expired leases.
+        wa.kill()
+        wa.wait(timeout=10)
+        topics = {
+            p: SharedFileTopic(
+                os.path.join(shared, f"submissions-p{p}.jsonl")
+            )
+            for p in range(n_parts)
+        }
+        for doc in docs:
+            p = partition_of(doc, n_parts)
+            base = ops_per_doc
+            for i in range(base, base + 30):
+                topics[p].append({
+                    "docId": doc, "clientId": 1 + (i % 3),
+                    "clientSeq": i // 3 + 1,
+                    "refSeq": 0, "contents": {"i": i},
+                })
+                expect[doc].add((1 + (i % 3), i // 3 + 1))
+        wc = _spawn(shared, "C", n_parts, ttl=1.0)
+        deadline = time.time() + 30
+        done = False
+        while time.time() < deadline:
+            seqd = _read_sequenced(shared, n_parts)
+            got = {
+                doc: {(m["clientId"], m["clientSeq"]) for m in ms
+                      if m["seq"] is not None}
+                for doc, ms in seqd.items()
+            }
+            if all(got.get(d, set()) >= expect[d] for d in docs):
+                done = True
+                break
+            time.sleep(0.2)
+        assert done, {
+            d: len(got.get(d, set())) for d in docs
+        }
+
+        seqd = _read_sequenced(shared, n_parts)
+        for doc, ms in seqd.items():
+            stamped = [m for m in ms if m["seq"] is not None]
+            # Exactly-once per (client, clientSeq): the worker appends
+            # then checkpoints, so a crash between the two may replay
+            # a batch — dedup by key, then seqs must be unique and the
+            # full expected set covered.
+            seen = {}
+            for m in stamped:
+                seen.setdefault((m["clientId"], m["clientSeq"]), m)
+            assert set(seen) == expect[doc]
+            seqs = sorted(m["seq"] for m in seen.values())
+            assert len(set(seqs)) == len(seqs), f"{doc}: dup seqs"
+            # Ownership actually changed hands for A's partitions.
+        a_docs = [
+            d for d in docs
+            if any(m["worker"] == "A" for m in seqd.get(d, []))
+        ]
+        moved = [
+            d for d in a_docs
+            if any(m["worker"] == "C" for m in seqd.get(d, []))
+        ]
+        assert moved, "no partition visibly changed hands"
+    finally:
+        for proc in (wa, wb, wc):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+def test_checkpoint_resume_exact(tmp_path):
+    """A worker killed between batches resumes from its checkpoint:
+    the successor's first stamped seq continues the dead worker's
+    numbering (no reset, no gap beyond the join stamps)."""
+    shared = str(tmp_path)
+    topic = SharedFileTopic(os.path.join(shared, "submissions-p0.jsonl"))
+    for i in range(40):
+        topic.append({
+            "docId": "solo", "clientId": 1, "clientSeq": i + 1,
+            "refSeq": 0, "contents": None,
+        })
+    wa = _spawn(shared, "A", 1, ttl=0.8)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            seqd = _read_sequenced(shared, 1).get("solo", [])
+            if len(seqd) >= 10:
+                break
+            time.sleep(0.05)
+        wa.kill()
+        wa.wait(timeout=10)
+        for i in range(40, 80):
+            topic.append({
+                "docId": "solo", "clientId": 1, "clientSeq": i + 1,
+                "refSeq": 0, "contents": None,
+            })
+        wb = _spawn(shared, "B", 1, ttl=0.8)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            ms = _read_sequenced(shared, 1).get("solo", [])
+            keys = {(m["clientId"], m["clientSeq"]) for m in ms}
+            if len(keys) >= 80:
+                break
+            time.sleep(0.1)
+        ms = _read_sequenced(shared, 1).get("solo", [])
+        seen = {}
+        for m in ms:
+            seen.setdefault((m["clientId"], m["clientSeq"]), m)
+        assert len(seen) == 80
+        seqs = sorted(m["seq"] for m in seen.values())
+        assert len(set(seqs)) == 80, "takeover reset or duplicated seqs"
+    finally:
+        for proc in (wa, wb):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
