@@ -29,6 +29,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Tuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -138,6 +140,37 @@ def reconstruct_settled(
     return settled_t, settled_p, settled_a
 
 
+@functools.lru_cache(maxsize=None)
+def _stream_step_fn(B: int, interpret: bool, n_ops_seg: int,
+                    n_chunks_seg: int, shapes: tuple):
+    """ONE cached jitted executable per segment shape: unpack the
+    packed host->device transfer + the whole fused replay — one
+    dispatch per segment rides the wire, and the big carries (table,
+    log, counts) are donated so XLA updates them in place. Cached at
+    module level so fresh replicas (bench repeats) reuse the compiled
+    executable."""
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def step(table, log, counts, dev, epoch0):
+        offs = [0]
+        for k in shapes:
+            offs.append(offs[-1] + n_ops_seg * max(k, 1))
+        fields = []
+        for k, o0, o1 in zip(shapes, offs, offs[1:]):
+            f = dev[o0:o1]
+            if k:
+                f = f.reshape(n_ops_seg, k)
+            fields.append(f)
+        batch = OpBatch(*fields)
+        msns = dev[offs[-1]: offs[-1] + n_chunks_seg]
+        return replay_fused(
+            table, batch, log, counts, msns, B, interpret,
+            epoch0=epoch0,
+        )
+
+    return step
+
+
 class OverlayDeviceReplica:
     """Device-resident overlay replica driven by columnar op arrays.
 
@@ -191,17 +224,27 @@ class OverlayDeviceReplica:
         packages/tools/replay-tool/src/replayMessages.ts)."""
         if getattr(self, "_dev", None) is not None:
             return
+        self.prepare_host()
+        self._dev = OpBatch(*(jnp.asarray(a) for a in self._host))
+        self._msn_by_chunk = jnp.asarray(self._host_msn)
+
+    def prepare_host(self) -> None:
+        """Decode the stream into padded HOST arrays only (the
+        streaming-ingress load phase: nothing touches the device; the
+        replay itself feeds segments in)."""
+        if getattr(self, "_host", None) is not None:
+            return
         s = self.stream
         n = len(s)
         B = self.chunk_size
         pad = self.n_chunks * B
 
-        def up(a: np.ndarray, fill: int = 0) -> jnp.ndarray:
+        def up(a: np.ndarray, fill: int = 0) -> np.ndarray:
             out = np.full(pad, fill, np.int32)
             out[:n] = a
-            return jnp.asarray(out)
+            return out
 
-        self._dev = OpBatch(
+        self._host = OpBatch(
             op_type=up(s.op_type, OP_NOOP),
             pos1=up(s.pos1), pos2=up(s.pos2),
             seq=up(s.seq), ref_seq=up(s.ref_seq),
@@ -212,9 +255,55 @@ class OverlayDeviceReplica:
         )
         # Applied MSN at each chunk's end (the fold perspective).
         ends = np.minimum(np.arange(1, self.n_chunks + 1) * B, n) - 1
-        self._msn_by_chunk = jnp.asarray(
-            s.min_seq[ends].astype(np.int32)
+        self._host_msn = s.min_seq[ends].astype(np.int32)
+
+    def replay_streaming(self, n_segments: int = 8) -> None:
+        """Replay with INGEST IN THE LOOP: the op stream lives on the
+        host and feeds the device segment by segment, each segment's
+        transfer (async `jax.device_put`) overlapping the previous
+        segment's fused replay — the alfred→deli→merge pipeline
+        running concurrently end-to-end (SURVEY §2.6 row 4;
+        localOrderer.ts:245 pipelines per-doc over Kafka the same
+        way) instead of the pre-staged load phase."""
+        self.prepare_host()
+        if not self.n_chunks:
+            return
+        n_segments = max(1, min(n_segments, self.n_chunks))
+        seg_chunks = -(-self.n_chunks // n_segments)
+        B = self.chunk_size
+
+        def seg_slice(si: int):
+            lo_c = si * seg_chunks
+            hi_c = min(lo_c + seg_chunks, self.n_chunks)
+            lo, hi = lo_c * B, hi_c * B
+            # ONE packed transfer per segment (a tunneled backend pays
+            # per-transfer latency; 10 small puts would serialize).
+            packed = np.concatenate(
+                [np.ascontiguousarray(a[lo:hi]).reshape(-1)
+                 for a in self._host]
+                + [self._host_msn[lo_c:hi_c]]
+            ).astype(np.int32)
+            return lo_c, hi - lo, hi_c - lo_c, jax.device_put(packed)
+
+        shapes = tuple(
+            (a.shape[1] if a.ndim > 1 else 0) for a in self._host
         )
+
+        n_live = -(-self.n_chunks // seg_chunks)
+        nxt = seg_slice(0)
+        for si in range(n_live):
+            lo_c, n_ops_seg, n_chunks_seg, dev = nxt
+            if si + 1 < n_live:
+                nxt = seg_slice(si + 1)  # async: overlaps the replay
+            step = _stream_step_fn(
+                B, self.interpret, n_ops_seg, n_chunks_seg, shapes
+            )
+            self.table, self.log, self.counts, self.cursor = step(
+                self.table, self.log, self.counts, dev,
+                jnp.int32(lo_c),
+            )
+        self.chunks_done = self.n_chunks
+        self._doc = None
 
     def replay(self, limit_chunks: Optional[int] = None) -> None:
         """Replay the stream. Full replays run as ONE fused device

@@ -793,7 +793,7 @@ def fold_device(table: OverlayTable, msn: jnp.ndarray):
 )
 def replay_fused(
     table: OverlayTable, stream_ops: OpBatch, log, counts, msn_by_chunk,
-    chunk: int, interpret: bool = False,
+    chunk: int, interpret: bool = False, epoch0=0,
 ):
     """The WHOLE replay as one dispatch: `lax.fori_loop` over chunks,
     each iteration = pallas apply + XLA fold + log append, all
@@ -804,19 +804,32 @@ def replay_fused(
     O(window), so fusing is worth ~10x wall-clock on a tunneled TPU.
 
     `msn_by_chunk[ci]` is the applied MSN at chunk ci's end (the fold
-    perspective). Returns ``(table, log, counts, cursor)``."""
+    perspective). Returns ``(table, log, counts, cursor)``.
+
+    `epoch0` (streaming ingress): this call replays a SEGMENT of a
+    larger stream whose global chunk numbering starts at `epoch0`;
+    counts index globally and the log cursor carries in/out through
+    `counts`'s prior entries (the caller threads table/log/counts
+    across segment calls while the next segment's host->device
+    transfer overlaps this one's compute)."""
     n_chunks = msn_by_chunk.shape[0]
+    epoch0 = jnp.asarray(epoch0, jnp.int32)
+    # Resume the log cursor where earlier segments left it (the mask
+    # is all-false at epoch0 == 0, so a fresh replay starts at 0).
+    cursor0 = jnp.sum(
+        counts * (jnp.arange(counts.shape[0]) < epoch0)
+    ).astype(jnp.int32)
 
     def step(ci, carry):
         table, log, counts, cursor = carry
         table, log, counts, cursor = _chunk_step_body(
             table, stream_ops, ci * chunk, chunk, msn_by_chunk[ci],
-            log, counts, cursor, ci, interpret,
+            log, counts, cursor, epoch0 + ci, interpret,
         )
         return (table, log, counts, cursor)
 
     return lax.fori_loop(
-        0, n_chunks, step, (table, log, counts, jnp.int32(0))
+        0, n_chunks, step, (table, log, counts, cursor0)
     )
 
 

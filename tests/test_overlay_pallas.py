@@ -154,3 +154,38 @@ def test_long_document_exceeds_row_model_vmem_ceiling():
     assert dev.get_text() == ref.get_text()
     # Settled document >> window table: the scale cliff is gone.
     assert int(dev.table.settled_len) > 10 * int(dev.table.n_rows)
+
+
+def test_streaming_ingress_matches_prestaged():
+    """Ingest-in-the-loop replay (segments fed host->device, transfer
+    overlapping compute) is bit-identical to the pre-staged replay —
+    table, fold log, and digests."""
+    from fluidframework_tpu.core.overlay_replay import OverlayDeviceReplica
+    from fluidframework_tpu.testing.digest import state_digest
+    from fluidframework_tpu.testing.synthetic import generate_lagged_stream
+
+    stream = generate_lagged_stream(
+        600, n_clients=6, seed=88, window=48, initial_len=16
+    )
+
+    def rep():
+        return OverlayDeviceReplica(
+            stream, initial_len=16, chunk_size=64, window=1024,
+            n_removers=10, interpret=True,
+        )
+
+    pre = rep()
+    pre.replay()
+    pre.check_errors()
+
+    for n_segments in (1, 3, 8):
+        sr = rep()
+        sr.replay_streaming(n_segments=n_segments)
+        sr.check_errors()
+        assert state_digest(sr.annotated_spans()) == state_digest(
+            pre.annotated_spans()
+        ), f"n_segments={n_segments}"
+        import numpy as np
+
+        assert int(sr.cursor) == int(pre.cursor)
+        assert (np.asarray(sr.counts) == np.asarray(pre.counts)).all()
