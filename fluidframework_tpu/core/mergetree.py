@@ -312,6 +312,7 @@ class MergeTreeEngine:
                 else:
                     tail = seg.split(remaining)
                     self.segments.insert(i + 1, tail)
+                    self.structure_version += 1
                     insert_at = i + 1
                 break
             if remaining == 0 and length == 0:
@@ -333,6 +334,7 @@ class MergeTreeEngine:
             insert_at = len(self.segments)
 
         self.segments.insert(insert_at, new_seg)
+        self.structure_version += 1
 
         if seq == UNASSIGNED_SEQ:
             grp = _PendingGroup(kind=MergeTreeDeltaType.INSERT, local_seq=local_seq)
@@ -355,6 +357,7 @@ class MergeTreeEngine:
                 if remaining > 0:
                     tail = seg.split(remaining)
                     self.segments.insert(i + 1, tail)
+                    self.structure_version += 1
                 return
             remaining -= length
 
@@ -936,6 +939,7 @@ class MergeTreeEngine:
             r.segment = None
             r.offset = 0
         self.segments = kept
+        self.structure_version += 1
 
     # ------------------------------------------------------------- output
 

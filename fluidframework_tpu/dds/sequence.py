@@ -225,10 +225,18 @@ class SharedSegmentSequence(SharedObject):
             iop = content["op"]
             kind = iop["type"]
             if kind == "add":
-                s_ref, e_ref = coll._anchor_local(iop["start"], iop["end"])
-                coll.intervals[iop["id"]] = SequenceInterval(
-                    iop["id"], s_ref, e_ref, dict(iop.get("props") or {})
+                ss = iop.get("startSide", SIDE_BEFORE)
+                es = iop.get("endSide", SIDE_BEFORE)
+                # Sides must survive rehydration: the resubmitted op
+                # carries them, so the local anchors must match what
+                # every remote replica will anchor.
+                s_ref, e_ref = coll._anchor_local(
+                    iop["start"], iop["end"], ss, es
                 )
+                coll._set_interval(iop["id"], SequenceInterval(
+                    iop["id"], s_ref, e_ref, dict(iop.get("props") or {}),
+                    start_side=ss, end_side=es,
+                ))
                 coll._pending[iop["id"]] = coll._pending.get(iop["id"], 0) + 1
                 coll._submit(dict(iop))
             elif kind == "change":
@@ -448,50 +456,122 @@ class SequenceInterval:
 
 
 class _IntervalIndex:
-    """Augmented sorted-endpoint search index (the
+    """INCREMENTAL augmented interval index (the
     findOverlappingIntervals role, intervalCollection.ts:958 backed
-    by the reference's IntervalTree): intervals sorted by resolved
-    start with a running prefix-max of ends; queries binary-search
-    the start bound and walk an implicit balanced tree with max-end
-    pruning — O(log n + k) per query, matching the columnar stance
-    (two parallel arrays, no pointer tree).
+    by the reference's IntervalTree over LocalReferencePositions).
 
-    Anchored endpoints move with every sequence edit, so the arrays
-    rebuild lazily on the first query after any mutation (an edit
-    version bump or an interval op)."""
+    The key insight the reference exploits: anchored references keep
+    a STABLE total order under every sequence edit — segments never
+    reorder, splits preserve (segment, offset) order, and slides are
+    monotone — so an index sorted by reference order NEVER needs
+    maintenance when the sequence changes. Rows sort by the start
+    reference's stable order with a prefix-max of end references (the
+    tree augment), also by stable order, so it stays a valid
+    prefix-max forever. Sequence edits cost ZERO index work; interval
+    add/change/delete costs one O(n) array splice + suffix-max
+    refresh; queries resolve only the O(log n) probed endpoints plus
+    the candidate walk — never all n (the former design re-resolved
+    and re-sorted every endpoint on each engine version bump)."""
 
     def __init__(self):
-        self.starts: List[int] = []
-        self.ends: List[int] = []
-        self.maxend: List[int] = []
-        self.ids: List[str] = []
+        self.rows: List[SequenceInterval] = []  # sorted by start ref
+        self.maxend: List[LocalReference] = []  # prefix max (stable order)
+        self._ord_cache: dict = {}
+        self._ord_version: Optional[tuple] = None
 
-    def rebuild(self, intervals, engine) -> None:
-        rows = sorted(
-            (iv.bounds(engine) + (iid,) for iid, iv in intervals.items()),
+    # ------------------------------------------------------ stable order
+
+    def _ordinals(self, engine) -> dict:
+        """id(segment) -> document ordinal, cached per engine
+        structure version (one O(S) pass amortized over a mutation
+        burst instead of an O(S) list scan PER key comparison)."""
+        ver = (
+            getattr(engine, "structure_version", None),
+            len(engine.segments),
         )
-        self.starts = [r[0] for r in rows]
-        self.ends = [r[1] for r in rows]
-        self.ids = [r[2] for r in rows]
-        self.maxend = []
-        m = -(1 << 60)
-        for e in self.ends:
-            m = max(m, e)
+        if self._ord_version != ver:
+            self._ord_cache = {
+                id(seg): i for i, seg in enumerate(engine.segments)
+            }
+            self._ord_version = ver
+        return self._ord_cache
+
+    def _stable_key(self, ref, engine):
+        """Total order on references that future edits preserve:
+        (segment document index, offset, after). End-of-document
+        references order after everything."""
+        if ref.segment is None:
+            return (1 << 60, 0, 0)
+        si = self._ordinals(engine).get(id(ref.segment), 1 << 60)
+        return (si, ref.offset, 1 if ref.after else 0)
+
+    # -------------------------------------------------------- mutation
+
+    def insert(self, iv: "SequenceInterval", engine) -> None:
+        key = self._stable_key(iv.start_ref, engine)
+        lo, hi = 0, len(self.rows)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._stable_key(self.rows[mid].start_ref, engine) <= key:
+                lo = mid + 1
+            else:
+                hi = mid
+        self.rows.insert(lo, iv)
+        self._refresh_maxend(lo, engine)
+
+    def remove(self, iid: str, engine) -> None:
+        for i, r in enumerate(self.rows):
+            if r.interval_id == iid:
+                del self.rows[i]
+                self._refresh_maxend(i, engine)
+                return
+
+    def _refresh_maxend(self, i: int, engine) -> None:
+        """Recompute the prefix-max suffix from row i (stable-order
+        comparisons, so the prefix-max stays valid under all later
+        sequence edits)."""
+        del self.maxend[i:]
+        m = self.maxend[-1] if self.maxend else None
+        m_key = (
+            self._stable_key(m, engine) if m is not None
+            else (-1, -1, -1)
+        )
+        for r in self.rows[i:]:
+            k = self._stable_key(r.end_ref, engine)
+            if k >= m_key:
+                m, m_key = r.end_ref, k
             self.maxend.append(m)
 
-    def query(self, start: int, end: int) -> List[str]:
-        """Ids of intervals [s, e] with s <= end and e >= start, in
-        start order. maxend prunes whole prefixes whose intervals all
-        end before `start`; bisect bounds the suffix whose starts
-        exceed `end`."""
-        import bisect
+    # ----------------------------------------------------------- query
 
-        hi = bisect.bisect_right(self.starts, end)
+    def query(self, start: int, end: int, engine) -> List[str]:
+        """Ids of intervals [s, e] with s <= end and e >= start, in
+        start order. Stable order implies resolved positions are
+        monotone over the arrays, so both bounds binary-search with
+        O(log n) resolutions; maxend prunes whole prefixes whose
+        intervals all end before `start`."""
+        pos = engine.resolve_reference
+        # hi: first row whose start resolves past `end`.
+        lo_, hi_ = 0, len(self.rows)
+        while lo_ < hi_:
+            mid = (lo_ + hi_) // 2
+            if pos(self.rows[mid].start_ref) <= end:
+                lo_ = mid + 1
+            else:
+                hi_ = mid
+        hi = lo_
+        # lo: first row whose prefix-max end reaches `start`.
+        lo_, hi2 = 0, hi
+        while lo_ < hi2:
+            mid = (lo_ + hi2) // 2
+            if pos(self.maxend[mid]) < start:
+                lo_ = mid + 1
+            else:
+                hi2 = mid
         out: List[str] = []
-        lo = bisect.bisect_left(self.maxend, start)  # maxend is sorted
-        for i in range(lo, hi):
-            if self.ends[i] >= start:
-                out.append(self.ids[i])
+        for r in self.rows[lo_:hi]:
+            if pos(r.end_ref) >= start:
+                out.append(r.interval_id)
         return out
 
 
@@ -512,8 +592,22 @@ class IntervalCollection:
         self._pending_props: Dict[Tuple[str, str], int] = {}
         self._next_local_id = 0
         self._index = _IntervalIndex()
-        self._index_key: Optional[tuple] = None
-        self._mutations = 0
+
+    # Every interval-set mutation flows through these two, keeping the
+    # incremental index in lock-step with the dict.
+
+    def _set_interval(self, iid: str, iv: "SequenceInterval") -> None:
+        eng = self.sequence.engine
+        if iid in self.intervals:
+            self._index.remove(iid, eng)
+        self.intervals[iid] = iv
+        self._index.insert(iv, eng)
+
+    def _drop_interval(self, iid: str):
+        iv = self.intervals.pop(iid, None)
+        if iv is not None:
+            self._index.remove(iid, self.sequence.engine)
+        return iv
 
     # ----------------------------------------------------------- local API
 
@@ -553,9 +647,8 @@ class IntervalCollection:
             iid, s_ref, e_ref, dict(props or {}),
             start_side=start_side, end_side=end_side,
         )
-        self.intervals[iid] = iv
+        self._set_interval(iid, iv)
         self._pending[iid] = self._pending.get(iid, 0) + 1
-        self._mutations += 1
         self._submit(
             {"type": "add", "id": iid, "start": start, "end": end,
              "props": props or {}, "startSide": start_side,
@@ -570,8 +663,8 @@ class IntervalCollection:
         iv.start_ref, iv.end_ref = self._anchor_local(
             start, end, iv.start_side, iv.end_side
         )
+        self._set_interval(iid, iv)  # endpoints moved: re-place in index
         self._pending[iid] = self._pending.get(iid, 0) + 1
-        self._mutations += 1
         self._submit({"type": "change", "id": iid, "start": start, "end": end})
 
     def change_properties(self, iid: str, props: Dict[str, Any]) -> None:
@@ -586,16 +679,14 @@ class IntervalCollection:
                 iv.props[k] = v
             pk = (iid, k)
             self._pending_props[pk] = self._pending_props.get(pk, 0) + 1
-        self._mutations += 1
         self._submit({"type": "props", "id": iid, "props": dict(props)})
 
     def remove_interval_by_id(self, iid: str) -> None:
-        iv = self.intervals.pop(iid, None)
+        iv = self._drop_interval(iid)
         if iv is not None:
             iv.start_ref.detach()
             iv.end_ref.detach()
         self._pending[iid] = self._pending.get(iid, 0) + 1
-        self._mutations += 1
         self._submit({"type": "delete", "id": iid})
 
     def get_interval_by_id(self, iid: str) -> Optional[SequenceInterval]:
@@ -618,17 +709,12 @@ class IntervalCollection:
         sorted-endpoint index — O(log n + candidates) per query
         between mutations, not an O(n) interval scan."""
         eng = self.sequence.engine
-        key = (
-            eng.current_seq, eng.local_seq,
-            getattr(eng, "structure_version", 0), self._mutations,
-        )
-        if self._index_key != key:
-            self._index.rebuild(self.intervals, eng)
-            self._index_key = key
+        # Every index row id is in the dict by construction
+        # (_set_interval/_drop_interval keep them in lock-step); a
+        # KeyError here means the invariant broke — surface it loudly.
         return [
             self.intervals[iid]
-            for iid in self._index.query(start, end)
-            if iid in self.intervals
+            for iid in self._index.query(start, end, eng)
         ]
 
     # -------------------------------------------------------------- apply
@@ -636,7 +722,6 @@ class IntervalCollection:
     def _process(self, op: dict, msg: SequencedMessage, local: bool) -> None:
         iid = op["id"]
         kind = op["type"]
-        self._mutations += 1
         if kind == "props":
             self._process_props(op, local)
             return
@@ -651,7 +736,7 @@ class IntervalCollection:
             return  # pending local change shadows the remote one
         eng = self.sequence.engine
         if kind == "delete":
-            iv = self.intervals.pop(iid, None)
+            iv = self._drop_interval(iid)
             if iv is not None:
                 iv.start_ref.detach()
                 iv.end_ref.detach()
@@ -670,10 +755,10 @@ class IntervalCollection:
         s_ref = self._anchor(op["start"], ss, rs, cid)
         e_ref = self._anchor(op["end"], es, rs, cid)
         if kind == "add":
-            self.intervals[iid] = SequenceInterval(
+            self._set_interval(iid, SequenceInterval(
                 iid, s_ref, e_ref, dict(op.get("props") or {}),
                 start_side=ss, end_side=es,
-            )
+            ))
         elif kind == "change":
             iv = self.intervals.get(iid)
             if iv is None:
@@ -683,6 +768,7 @@ class IntervalCollection:
             iv.start_ref.detach()
             iv.end_ref.detach()
             iv.start_ref, iv.end_ref = s_ref, e_ref
+            self._set_interval(iid, iv)  # endpoints moved: re-place
 
     def _process_props(self, op: dict, local: bool) -> None:
         """Per-key LWW with pending shadowing; sequenced remote writes
@@ -743,7 +829,7 @@ class IntervalCollection:
             e_ref = self._anchor(
                 row["end"], es, eng.current_seq, eng.local_client_id
             )
-            self.intervals[row["id"]] = SequenceInterval(
+            self._set_interval(row["id"], SequenceInterval(
                 row["id"], s_ref, e_ref, dict(row.get("props") or {}),
                 start_side=ss, end_side=es,
-            )
+            ))

@@ -207,3 +207,94 @@ def test_interval_fuzz_convergence(seed):
                     for iv in coll.find_overlapping_intervals(qs, qe)
                 )
                 assert got == naive_overlap(coll, qs, qe)
+
+
+def test_incremental_index_no_full_rebuild():
+    """Sequence edits must cost ZERO index work and queries must
+    resolve only O(log n + k) endpoints — never all n (the former
+    design re-resolved and re-sorted every endpoint per engine
+    version bump)."""
+    h, a, b = make_pair()
+    a.insert_text(0, "x" * 2000)
+    h.process_all()
+    coll = a.get_interval_collection("perf")
+    N = 300
+    for i in range(N):
+        s = (i * 6) % 1800
+        coll.add(s, s + 3)
+    h.process_all()
+
+    eng = a.engine
+    real = eng.resolve_reference
+    counter = {"n": 0}
+
+    def counting(ref):
+        counter["n"] += 1
+        return real(ref)
+
+    eng.resolve_reference = counting
+    try:
+        # A burst of edits: no index maintenance -> no resolutions.
+        for i in range(50):
+            a.insert_text((i * 13) % a.get_length(), "yy")
+        assert counter["n"] == 0, "sequence edits touched the index"
+        # One query: far fewer resolutions than N endpoints.
+        counter["n"] = 0
+        got = coll.find_overlapping_intervals(900, 930)
+        assert got, "query should find overlaps"
+        assert counter["n"] < N, (
+            f"query resolved {counter['n']} refs for {N} intervals "
+            "(full-rebuild behavior)"
+        )
+    finally:
+        eng.resolve_reference = real
+    # Correctness after the burst: index equals the O(n) scan.
+    ln = a.get_length()
+    for q0, q1 in ((0, 50), (700, 1100), (ln - 60, ln)):
+        want = sorted(
+            iv.interval_id for iv in coll
+            if iv.bounds(a.engine)[0] <= q1
+            and iv.bounds(a.engine)[1] >= q0
+        )
+        got = sorted(
+            iv.interval_id
+            for iv in coll.find_overlapping_intervals(q0, q1)
+        )
+        assert got == want
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_incremental_index_survives_zamboni(seed):
+    """Heavy removal + MSN advance (zamboni collection, reference
+    slides) must not break the index's stable reference order: the
+    indexed query equals the O(n) scan after every drain."""
+    h, a, b = make_pair()
+    a.insert_text(0, "0123456789" * 20)
+    h.process_all()
+    rng = random.Random(7000 + seed)
+    coll = a.get_interval_collection("z")
+    for i in range(40):
+        s = rng.randrange(0, 180)
+        coll.add(s, min(199, s + rng.randrange(0, 15)))
+    h.process_all()
+    for _ in range(30):
+        ln = a.get_length()
+        if ln > 30 and rng.random() < 0.6:
+            st = rng.randrange(0, ln - 10)
+            a.remove_text(st, st + rng.randint(1, 8))
+        else:
+            a.insert_text(rng.randrange(0, ln + 1), "ab")
+        h.process_all()  # sequences + advances MSN -> zamboni slides
+        ln = a.get_length()
+        q0 = rng.randrange(0, max(ln - 5, 1))
+        q1 = min(ln, q0 + rng.randrange(1, 30))
+        want = sorted(
+            iv.interval_id for iv in coll
+            if iv.bounds(a.engine)[0] <= q1
+            and iv.bounds(a.engine)[1] >= q0
+        )
+        got = sorted(
+            iv.interval_id
+            for iv in coll.find_overlapping_intervals(q0, q1)
+        )
+        assert got == want
