@@ -445,6 +445,11 @@ class MergeTreeEngine:
         refs, seg.refs = seg.refs, []
         if not refs:
             return
+        # A slide can move a reference PAST pending-local segments
+        # (excluded targets), inverting its stable order relative to
+        # references anchored on them — order-keyed consumers (the
+        # interval index) repair when this version changes.
+        self.slide_version = getattr(self, "slide_version", 0) + 1
         if hint_index is not None and (
             hint_index < len(self.segments)
             and self.segments[hint_index] is seg

@@ -478,6 +478,7 @@ class _IntervalIndex:
         self.maxend: List[LocalReference] = []  # prefix max (stable order)
         self._ord_cache: dict = {}
         self._ord_version: Optional[tuple] = None
+        self._slide_seen: int = -1
 
     # ------------------------------------------------------ stable order
 
@@ -542,6 +543,30 @@ class _IntervalIndex:
                 m, m_key = r.end_ref, k
             self.maxend.append(m)
 
+    def _repair_after_slides(self, engine) -> None:
+        """Reference slides are order-stable EXCEPT when a slide
+        skips pending-local segments (excluded slide targets),
+        carrying a reference past ones anchored on them. When the
+        engine's slide version changes, verify sortedness by stable
+        key (O(n) cached-ordinal comparisons, zero resolutions) and
+        re-sort + rebuild the prefix-max only if actually violated."""
+        ver = getattr(engine, "slide_version", 0)
+        if ver == self._slide_seen:
+            return
+        self._slide_seen = ver
+        keys = [self._stable_key(r.start_ref, engine) for r in self.rows]
+        if all(keys[i] <= keys[i + 1] for i in range(len(keys) - 1)):
+            # Order intact; the prefix-max may still be stale (an END
+            # reference slid): rebuild it (cheap, key-only).
+            self._refresh_maxend(0, engine)
+            return
+        self.rows = [
+            r for _, r in sorted(
+                zip(keys, self.rows), key=lambda t: t[0]
+            )
+        ]
+        self._refresh_maxend(0, engine)
+
     # ----------------------------------------------------------- query
 
     def query(self, start: int, end: int, engine) -> List[str]:
@@ -550,6 +575,7 @@ class _IntervalIndex:
         monotone over the arrays, so both bounds binary-search with
         O(log n) resolutions; maxend prunes whole prefixes whose
         intervals all end before `start`."""
+        self._repair_after_slides(engine)
         pos = engine.resolve_reference
         # hi: first row whose start resolves past `end`.
         lo_, hi_ = 0, len(self.rows)

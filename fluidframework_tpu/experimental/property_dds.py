@@ -7,16 +7,19 @@ fields); `property-changeset` defines the nested
 insert/modify/remove ChangeSet format with `applyChangeSet` and
 `squash` (changeset.ts, changeset_operations/); `property-dds`'s
 SharedPropertyTree synchronizes a property set by submitting
-changesets over the op stream (rebase.ts resolves concurrency —
-last-sequenced-writer-wins per leaf path here, the format's modify
-semantics).
+changesets over the op stream, resolving concurrency by CHANGESET
+REBASE (rebase.ts): incoming changesets rebase over the trunk window
+their author had not seen, and the pending local chain rebases over
+each incoming.
 
-This is the minimal faithful core of that family: typed templates
-with validation, hierarchical property sets, the nested changeset
-algebra (apply / squash with the reference's insert∘modify and
-remove-cancels-insert laws), and a DDS channel with pending-op
-rebottoming and summary round-trip. The full reference family
-(property-binder, proxies, query) remains out of scope.
+This is the faithful core of that family: typed templates with
+validation, hierarchical property sets, ARRAY properties with
+index-adjusting rebase, the nested changeset algebra (apply / squash
+/ rebase with the reference's insert∘modify, remove-cancels-insert,
+remove-over-modify, and later-writer-wins laws), and a DDS channel
+maintaining a remote-tip view plus a rebased local branch, with
+summary round-trip. The full reference family (property-binder,
+proxies, query) remains out of scope.
 """
 
 from __future__ import annotations
@@ -70,6 +73,8 @@ def _default_value(typeid: str, registry: _Registry) -> Any:
         return ""
     if typeid == "Bool":
         return False
+    if typeid == "Array" or typeid.startswith("array<"):
+        return []
     return PropertySet(typeid, registry)
 
 
@@ -130,9 +135,25 @@ class PropertySet:
         for k, v in sorted(self._children.items()):
             out["fields"][k] = (
                 v.to_json() if isinstance(v, PropertySet) else
-                {"value": v, "typeid": _typeid_of(v)}
+                # Deep-copied: mutable values (arrays) must never
+                # alias between a snapshot and the live tree — the
+                # tip/view split depends on it.
+                {"value": copy.deepcopy(v), "typeid": _typeid_of(v)}
             )
         return out
+
+    def clone(self) -> "PropertySet":
+        """Deep copy sharing the registry — half the copying of a
+        to_json/from_json round trip (the view-rebuild hot path)."""
+        ps = PropertySet.__new__(PropertySet)
+        ps.typeid = self.typeid
+        ps._registry = self._registry
+        ps._children = {
+            k: (v.clone() if isinstance(v, PropertySet)
+                else copy.deepcopy(v))
+            for k, v in self._children.items()
+        }
+        return ps
 
     @classmethod
     def from_json(cls, data: dict, registry: _Registry) -> "PropertySet":
@@ -144,7 +165,7 @@ class PropertySet:
             if "fields" in v:
                 ps._children[k] = cls.from_json(v, registry)
             else:
-                ps._children[k] = v["value"]
+                ps._children[k] = copy.deepcopy(v["value"])
         return ps
 
 
@@ -155,6 +176,8 @@ def _typeid_of(value: Any) -> str:
         return "Int32"
     if isinstance(value, float):
         return "Float64"
+    if isinstance(value, list):
+        return "Array"
     return "String"
 
 
@@ -182,80 +205,38 @@ class ChangeSet:
 
     # ----------------------------------------------------------- apply
 
-    def apply(self, ps: PropertySet,
-              shadowed: Optional[Dict[str, List[int]]] = None) -> None:
-        """`shadowed`: leaf-path -> [pending modifies, pending
-        structural ops] (the map-kernel shadowing convention, made
-        KIND-AWARE for the nested tree — the rule set below is the
-        unique convergent assignment of winners given that pending
-        local ops always sequence after currently-arriving remotes):
+    def apply(self, ps: PropertySet) -> None:
+        """Apply in place. Concurrency is resolved BEFORE apply by
+        `rebase_changeset` (the reference's rebase.ts pipeline:
+        incoming changesets rebase over the trunk window, pending
+        local changesets rebase over each incoming) — apply itself is
+        unconditional, with shape-mismatch mutes as the only guards."""
+        self._apply_node(self.data, ps)
 
-        - a remote REMOVE always applies (concurrent edits' echoes
-          mute as modifies of a removed child on every remote);
-        - a remote INSERT skips iff a pending local STRUCTURAL op
-          (insert: ours recreates at its echo; remove: ours deletes at
-          its sequencing on remotes) holds the path — a pending
-          modify CANNOT recreate a node, so it never shadows inserts;
-        - a remote MODIFY skips iff any pending local write holds the
-          path (a pending insert's payload carries the local value).
-        """
-        self._apply_node(self.data, ps, shadowed or {}, "")
-
-    @staticmethod
-    def _shadow_at(shadowed, path: str, slot: int) -> bool:
-        entry = shadowed.get(path)
-        return entry is not None and entry[slot] > 0
-
-    def _apply_node(self, cs: dict, node: PropertySet,
-                    shadowed: Dict[str, List[int]], prefix: str) -> None:
-        def path_of(name: str) -> str:
-            return f"{prefix}{name}"
-
+    def _apply_node(self, cs: dict, node: PropertySet) -> None:
         for name in cs.get("remove", []):
             node._children.pop(name, None)
         for name, payload in cs.get("insert", {}).items():
-            if self._shadow_at(shadowed, path_of(name), 1):
-                continue
             node._children[name] = (
                 PropertySet.from_json(payload, node._registry)
                 if isinstance(payload, dict) and "fields" in payload
-                else payload["value"]
+                else copy.deepcopy(payload["value"])
             )
         for name, sub in cs.get("modify", {}).items():
             child = node._children.get(name)
             if child is None:
                 continue  # modify of a concurrently removed child mutes
-            p = path_of(name)
             if isinstance(child, PropertySet):
-                if "value" in sub:
+                if "value" in sub or "array" in sub:
                     continue  # leaf write vs now-container: shape mutes
-                self._apply_node(sub, child, shadowed, p + ".")
+                self._apply_node(sub, child)
+            elif "array" in sub:
+                if isinstance(child, list):
+                    _apply_array_ops(child, sub["array"])
             elif "value" not in sub:
                 continue  # nested modify vs now-primitive: shape mutes
-            elif not (
-                self._shadow_at(shadowed, p, 0)
-                or self._shadow_at(shadowed, p, 1)
-            ):
-                node._children[name] = sub["value"]
-
-    def paths(self) -> List[tuple]:
-        """(path, slot) for every write: slot 0 = modify, slot 1 =
-        structural (insert/remove) — the shadow bookkeeping keys."""
-        out: List[tuple] = []
-
-        def walk(cs: dict, prefix: str) -> None:
-            for name in cs.get("remove", []):
-                out.append((prefix + name, 1))
-            for name in cs.get("insert", {}):
-                out.append((prefix + name, 1))
-            for name, sub in cs.get("modify", {}).items():
-                if "value" in sub:
-                    out.append((prefix + name, 0))
-                else:
-                    walk(sub, prefix + name + ".")
-
-        walk(self.data, "")
-        return out
+            else:
+                node._children[name] = copy.deepcopy(sub["value"])
 
     # ---------------------------------------------------------- squash
 
@@ -264,6 +245,20 @@ class ChangeSet:
         return ChangeSet(
             _squash_node(copy.deepcopy(self.data), later.data)
         )
+
+
+def _apply_array_ops(arr: list, ops: List[dict]) -> None:
+    """Apply array ops in order (property-changeset array semantics:
+    indexed insert/remove/set over the array's current state)."""
+    for op in ops:
+        i = min(max(int(op["idx"]), 0), len(arr))
+        if op["type"] == "ins":
+            arr[i:i] = copy.deepcopy(op["values"])
+        elif op["type"] == "rem":
+            del arr[i: i + int(op["count"])]
+        elif op["type"] == "set":
+            if i < len(arr):
+                arr[i] = copy.deepcopy(op["value"])
 
 
 def _squash_node(base: dict, later: dict) -> dict:
@@ -282,14 +277,205 @@ def _squash_node(base: dict, later: dict) -> dict:
             _fold_modify_into_insert(ins, sub)
             continue
         cur = base.setdefault("modify", {}).get(name)
-        if cur is None or "value" in sub:
+        if "array" in sub:
+            # Array ops compose sequentially: concatenation IS the
+            # squash (each op is relative to the state its
+            # predecessors produced). Array ops AFTER a whole-value
+            # write fold into that written value (the insert-fold
+            # law's modify analog).
+            if cur is not None and "array" in cur:
+                cur["array"] = cur["array"] + copy.deepcopy(sub["array"])
+            elif (
+                cur is not None and "value" in cur
+                and isinstance(cur["value"], list)
+            ):
+                _apply_array_ops(cur["value"], sub["array"])
+            else:
+                base["modify"][name] = copy.deepcopy(sub)
+        elif cur is None or "value" in sub or "array" in cur:
             base["modify"][name] = copy.deepcopy(sub)  # leaf LWW
         else:
             base["modify"][name] = _squash_node(cur, sub)
     return base
 
 
+# ---------------------------------------------------------------------------
+# rebase (changeset_operations/rebase laws)
+# ---------------------------------------------------------------------------
+
+
+def _adjust_array_op(op: dict, base: dict,
+                     op_later: bool) -> List[dict]:
+    """Transform ONE array op over one base op (shared start state);
+    returns 0..2 result pieces. `op_later`: op sequences after base
+    (gap ties: the earlier-sequenced insert's content lands first;
+    removed content wins over sets/removes)."""
+    cur = copy.deepcopy(op)
+    bi = int(base["idx"])
+    if base["type"] == "ins":
+        n = len(base["values"])
+        i = int(cur["idx"])
+        if cur["type"] == "ins":
+            if bi < i or (bi == i and not op_later):
+                cur["idx"] = i + n
+            return [cur]
+        if cur["type"] == "rem":
+            c = int(cur["count"])
+            if bi <= i:
+                cur["idx"] = i + n
+                return [cur]
+            if bi < i + c:
+                # Foreign content inside our removal: keep our span
+                # but skip over it (two sequential pieces).
+                return [
+                    {"type": "rem", "idx": i, "count": bi - i},
+                    {"type": "rem", "idx": bi + n - (bi - i),
+                     "count": c - (bi - i)},
+                ]
+            return [cur]
+        # set
+        if bi <= int(cur["idx"]):
+            cur["idx"] = int(cur["idx"]) + n
+        return [cur]
+    if base["type"] == "rem":
+        n = int(base["count"])
+        i = int(cur["idx"])
+        if cur["type"] == "ins":
+            if i >= bi + n:
+                cur["idx"] = i - n
+            elif i > bi:
+                cur["idx"] = bi  # slide to the removal start
+            return [cur]
+        if cur["type"] == "rem":
+            c = int(cur["count"])
+            lo = max(i, bi)
+            hi = min(i + c, bi + n)
+            lost = max(0, hi - lo)
+            c -= lost
+            if c <= 0:
+                return []
+            cur["count"] = c
+            cur["idx"] = i if i < bi else max(bi, i - n)
+            return [cur]
+        # set
+        if bi <= i < bi + n:
+            return []  # target removed: mute
+        if i >= bi + n:
+            cur["idx"] = i - n
+        return [cur]
+    # base set: no structural effect; concurrent sets on the same
+    # slot resolve later-wins (the earlier drops when rebased over
+    # the later).
+    if (
+        op["type"] == "set" and base["type"] == "set"
+        and int(op["idx"]) == bi and not op_later
+    ):
+        return []
+    return [cur]
+
+
+def _xform_arrays(A: List[dict], B: List[dict],
+                  a_later: bool) -> tuple:
+    """Inclusion transform of SEQUENTIAL array-op lists sharing one
+    start state (the tree changeset's _xform shape): returns
+    ``(A', B')`` with A' applying after B and B' after A — pairwise
+    recursion keeps every comparison in a shared frame."""
+    if not A or not B:
+        return list(A), list(B)
+    if len(A) == 1 and len(B) == 1:
+        a_p = _adjust_array_op(A[0], B[0], a_later)
+        b_p = _adjust_array_op(B[0], A[0], not a_later)
+        return a_p, b_p
+    if len(A) > 1:
+        A1, Bp = _xform_arrays(A[:1], B, a_later)
+        A2, Bpp = _xform_arrays(A[1:], Bp, a_later)
+        return A1 + A2, Bpp
+    Ap, B1 = _xform_arrays(A, B[:1], a_later)
+    App, B2 = _xform_arrays(Ap, B[1:], a_later)
+    return App, B1 + B2
+
+
+def _rebase_array_ops(ours: List[dict], theirs: List[dict],
+                      ours_later: bool) -> List[dict]:
+    """Rebase our SEQUENTIAL array ops over theirs (the reference's
+    array-changeset rebase) via the inclusion transform."""
+    out, _ = _xform_arrays(
+        [copy.deepcopy(o) for o in ours],
+        [copy.deepcopy(b) for b in theirs],
+        ours_later,
+    )
+    return out
+
+
+def rebase_changeset(ours: dict, theirs: dict,
+                     ours_later: bool = True) -> dict:
+    """Rebase `ours` over `theirs` (both relative to one start state;
+    the result applies after `theirs`) — the reference's
+    changeset_operations rebase laws (property-changeset
+    src/changeset_operations + property-dds src/rebase.ts):
+
+    - our modify under THEIR remove drops (removal wins over edits);
+    - same-name insert-vs-insert: the later-sequenced insert wins
+      (its payload overwrites; the earlier's survives only until the
+      later applies);
+    - leaf modify-vs-modify: the later-sequenced write wins (the
+      earlier drops when rebased over it);
+    - nested modifies recurse; array ops adjust indices
+      (`_rebase_array_ops`).
+
+    `ours_later`: True when `ours` sequences after `theirs` (the
+    normal direction for pending-local-over-incoming-remote); False
+    when carrying an earlier changeset over a later one (the dual
+    step of the chain transform).
+    """
+    out: Dict[str, Any] = {}
+    their_removed = set(theirs.get("remove", []))
+    their_inserts = theirs.get("insert", {})
+    their_modify = theirs.get("modify", {})
+    for name in ours.get("remove", []):
+        if name in their_removed:
+            continue  # already gone
+        out.setdefault("remove", []).append(name)
+    for name, payload in ours.get("insert", {}).items():
+        if name in their_inserts and not ours_later:
+            continue  # their later insert overwrites ours
+        out.setdefault("insert", {})[name] = copy.deepcopy(payload)
+    for name, sub in ours.get("modify", {}).items():
+        if name in their_removed:
+            continue  # removal wins over our edits
+        if name in their_inserts:
+            if not ours_later:
+                continue  # their later insert replaced our target
+            out.setdefault("modify", {})[name] = copy.deepcopy(sub)
+            continue
+        their_sub = their_modify.get(name)
+        if their_sub is None:
+            out.setdefault("modify", {})[name] = copy.deepcopy(sub)
+            continue
+        if "array" in sub and "array" in their_sub:
+            ops = _rebase_array_ops(
+                sub["array"], their_sub["array"], ours_later
+            )
+            if ops:
+                out.setdefault("modify", {})[name] = {"array": ops}
+            continue
+        if "value" in sub or "value" in their_sub or "array" in sub \
+                or "array" in their_sub:
+            # Leaf (or shape-conflicting) writes: later wins.
+            if ours_later:
+                out.setdefault("modify", {})[name] = copy.deepcopy(sub)
+            continue
+        r = rebase_changeset(sub, their_sub, ours_later)
+        if r:
+            out.setdefault("modify", {})[name] = r
+    return out
+
+
 def _fold_modify_into_insert(ins: dict, sub: dict) -> None:
+    if "array" in sub:
+        if isinstance(ins.get("value"), list):
+            _apply_array_ops(ins["value"], sub["array"])
+        return
     if "value" in sub:
         ins["value"] = sub["value"]
         return
@@ -306,17 +492,35 @@ def _fold_modify_into_insert(ins: dict, sub: dict) -> None:
 class SharedPropertyTree(SharedObject):
     """The DDS channel (property-dds SharedPropertyTree): local edits
     accumulate into a pending changeset submitted on commit();
-    sequenced changesets apply in total order on every replica
-    (rebase.ts's effective policy for non-conflicting paths; leaf
-    conflicts resolve last-sequenced-wins via modify semantics)."""
+    concurrency resolves by CHANGESET REBASE (rebase.ts), not
+    apply-time shadowing:
+
+    - `tip` is the sequenced-only state; `root` is the VIEW (tip plus
+      the pending local chain re-applied) — the reference's
+      remoteTipView / local-branch split;
+    - an incoming remote changeset first rebases over the trunk
+      window the sender had not seen (its `ref` field names the
+      sequence number it was authored against), applies to the tip,
+      then the pending local chain rebases over it (the chain
+      transform with the carried remote advancing over each local)
+      and the view rebuilds;
+    - our own echo applies its (chain-maintained, tip-coordinate)
+      form to the tip and pops the chain.
+    """
 
     ROOT_TYPEID = NODE
 
     def initialize_local_core(self) -> None:
         self.registry = _Registry()
+        self.tip = PropertySet(self.ROOT_TYPEID, self.registry)
         self.root = PropertySet(self.ROOT_TYPEID, self.registry)
         self._pending = ChangeSet()
-        self._shadow: Dict[str, List[int]] = {}
+        self._local: List[dict] = []  # committed, unacked (tip coords)
+        self._local_orig: List[dict] = []  # same, as-submitted forms
+        # Trunk window entries: {seq, session, cs (tip coords),
+        # orig (as submitted)} — `orig` feeds the author-chain replay.
+        self._trunk: List[dict] = []
+        self._trunk_seq = 0
 
     def register_template(self, template: PropertyTemplate) -> None:
         self.registry.register(template)
@@ -332,6 +536,8 @@ class SharedPropertyTree(SharedObject):
             leaf: Dict[str, Any] = {"modify": {name: {"value": payload}}}
         elif kind == "insert":
             leaf = {"insert": {name: payload}}
+        elif kind == "array":
+            leaf = {"modify": {name: {"array": [payload]}}}
         else:
             leaf = {"remove": [name]}
         for part in reversed(head):
@@ -360,45 +566,153 @@ class SharedPropertyTree(SharedObject):
         self.root.remove(path)
         self._fold("remove", path)
 
+    # Array properties (the reference's ArrayProperty + array
+    # changesets): indexed ops whose rebase adjusts indices.
+
+    def _fold_array(self, path: str, op: dict) -> None:
+        self._fold("array", path, op)
+
+    def array_insert(self, path: str, idx: int, values: List[Any]) -> None:
+        arr = self.root.get(path)
+        if not isinstance(arr, list):
+            raise TypeError(f"{path} is not an array")
+        arr[idx:idx] = list(values)
+        self._fold_array(path, {"type": "ins", "idx": idx,
+                                "values": list(values)})
+
+    def array_remove(self, path: str, idx: int, count: int = 1) -> None:
+        arr = self.root.get(path)
+        if not isinstance(arr, list):
+            raise TypeError(f"{path} is not an array")
+        del arr[idx: idx + count]
+        self._fold_array(path, {"type": "rem", "idx": idx,
+                                "count": count})
+
+    def array_set(self, path: str, idx: int, value: Any) -> None:
+        arr = self.root.get(path)
+        if not isinstance(arr, list):
+            raise TypeError(f"{path} is not an array")
+        arr[idx] = value
+        self._fold_array(path, {"type": "set", "idx": idx,
+                                "value": value})
+
     def commit(self) -> None:
         """Submit the accumulated pending changeset as ONE op (the
-        reference's commit granularity). Written paths shadow remote
-        writes until this op's own echo sequences (then the sequenced
-        order is authoritative)."""
+        reference's commit granularity), stamped with the trunk
+        sequence number it was authored against (rebase.ts's
+        referenceGuid role)."""
         if not self._pending.data:
             return
         cs, self._pending = self._pending, ChangeSet()
-        for p, slot in cs.paths():
-            entry = self._shadow.setdefault(p, [0, 0])
-            entry[slot] += 1
-        self.submit_local_message({"cs": cs.data}, None)
+        self._local.append(cs.data)
+        self._local_orig.append(copy.deepcopy(cs.data))
+        self.submit_local_message(
+            {"cs": copy.deepcopy(cs.data), "ref": self._trunk_seq}, None
+        )
 
     # ----------------------------------------------------------- apply
 
+    def _rebuild_view(self) -> None:
+        """view = tip + the pending chain (incl. uncommitted edits)."""
+        self.root = self.tip.clone()
+        for cs in self._local:
+            ChangeSet(cs).apply(self.root)
+        if self._pending.data:
+            self._pending.apply(self.root)
+
     def process_core(self, msg: SequencedMessage, local: bool,
                      local_metadata: Any) -> None:
-        cs = ChangeSet(msg.contents["cs"])
         if local:
-            # Applied optimistically at edit time; release the shadows.
-            for p, slot in cs.paths():
-                entry = self._shadow.get(p)
-                if entry is not None:
-                    entry[slot] = max(0, entry[slot] - 1)
-                    if entry == [0, 0]:
-                        self._shadow.pop(p, None)
-            # The echo is the authoritative sequenced point for THIS
-            # op: re-applying it (over the shadows that remain for
-            # later still-pending local commits) converges the
-            # optimistic state with what every remote just computed —
-            # corrective when concurrent earlier-sequenced ops
-            # perturbed our optimistic values (e.g. a racing
-            # remove+reinsert), idempotent otherwise.
-            cs.apply(self.root, self._shadow)
-            return
-        cs.apply(self.root, self._shadow)
+            # Our echo: the chain's head is already maintained in tip
+            # coordinates by the per-remote rebases below.
+            assert self._local, "ack with empty local chain"
+            cs = self._local.pop(0)
+            orig = self._local_orig.pop(0)
+            ChangeSet(copy.deepcopy(cs)).apply(self.tip)
+            self._trunk.append({
+                "seq": msg.sequence_number,
+                "session": msg.client_id,
+                "cs": cs,
+                "orig": orig,
+            })
+            self._trunk_seq = msg.sequence_number
+        else:
+            # Rebase the incoming into tip coordinates by REPLAYING
+            # THE AUTHOR'S CHAIN through the trunk since its `ref`:
+            # the incoming was authored on trunk@ref plus the
+            # author's own then-unacked commits (ORIGINAL forms, kept
+            # in the trunk entries). Walking the trunk in sequence
+            # order: an own entry pops the chain head (it sequenced),
+            # a foreign entry chain-transforms (each chain element
+            # rebases over the carried foreign; the carried foreign
+            # advances over the element) — a flat fold over foreign
+            # entries alone diverges when a foreign interleaves
+            # between two of the author's in-flight commits (its
+            # trunk form does not reflect the first one).
+            incoming_orig = copy.deepcopy(msg.contents["cs"])
+            ref = msg.contents.get("ref", 0)
+            chain = [
+                copy.deepcopy(e["orig"]) for e in self._trunk
+                if e["seq"] > ref and e["session"] == msg.client_id
+            ]
+            chain.append(copy.deepcopy(incoming_orig))
+            for e in self._trunk:
+                if e["seq"] <= ref:
+                    continue
+                if e["session"] == msg.client_id:
+                    chain.pop(0)  # own commit sequenced: left the chain
+                else:
+                    carried = e["cs"]
+                    new_chain = []
+                    for l_cs in chain:
+                        new_chain.append(rebase_changeset(
+                            l_cs, carried, ours_later=True
+                        ))
+                        carried = rebase_changeset(
+                            carried, l_cs, ours_later=False
+                        )
+                    chain = new_chain
+            incoming = chain[-1]
+            ChangeSet(copy.deepcopy(incoming)).apply(self.tip)
+            self._trunk.append({
+                "seq": msg.sequence_number,
+                "session": msg.client_id,
+                "cs": incoming,
+                "orig": incoming_orig,
+            })
+            self._trunk_seq = msg.sequence_number
+            # Chain transform: each pending local rebases over the
+            # incoming; the carried incoming advances over the local's
+            # ORIGINAL form (the dual direction).
+            carried = incoming
+            new_local: List[dict] = []
+            for l_cs in self._local:
+                new_local.append(
+                    rebase_changeset(l_cs, carried, ours_later=True)
+                )
+                carried = rebase_changeset(
+                    carried, l_cs, ours_later=False
+                )
+            self._local = new_local
+            if self._pending.data:
+                self._pending = ChangeSet(rebase_changeset(
+                    self._pending.data, carried, ours_later=True
+                ))
+            self._rebuild_view()
+            self.emit("changesetApplied", False)
+        # Trunk eviction below the MSN (no future ref can precede it).
+        msn = msg.minimum_sequence_number
+        self._trunk = [t for t in self._trunk if t["seq"] > msn]
 
     def apply_stashed_op(self, content: Any) -> Any:
-        ChangeSet(content["cs"]).apply(self.root)
+        cs = ChangeSet(copy.deepcopy(content["cs"]))
+        cs.apply(self.root)
+        self._local.append(copy.deepcopy(content["cs"]))
+        self._local_orig.append(copy.deepcopy(content["cs"]))
+        self.submit_local_message(
+            {"cs": copy.deepcopy(content["cs"]), "ref": self._trunk_seq},
+            None,
+        )
         return None
 
     # --------------------------------------------------------- summary
@@ -406,15 +720,24 @@ class SharedPropertyTree(SharedObject):
     def summarize_core(self):
         return (
             SummaryTreeBuilder()
-            .add_json_blob("root", self.root.to_json())
+            .add_json_blob("root", self.tip.to_json())
+            .add_json_blob(
+                "trunk",
+                {"seq": self._trunk_seq, "window": list(self._trunk)},
+            )
             .summary
         )
 
     def load_core(self, storage: ChannelStorage) -> None:
         self.initialize_local_core()
-        self.root = PropertySet.from_json(
+        self.tip = PropertySet.from_json(
             json.loads(storage.read("root")), self.registry
         )
+        if storage.contains("trunk"):
+            t = json.loads(storage.read("trunk"))
+            self._trunk_seq = t["seq"]
+            self._trunk = list(t["window"])
+        self._rebuild_view()
 
 
 class SharedPropertyTreeFactory(ChannelFactory):

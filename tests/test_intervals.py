@@ -298,3 +298,37 @@ def test_incremental_index_survives_zamboni(seed):
             for iv in coll.find_overlapping_intervals(q0, q1)
         )
         assert got == want
+
+
+def test_index_repairs_after_slide_past_pending_insert():
+    """The review's order-inversion repro: a sequenced remote removal
+    slides an interval's start reference past a pending-LOCAL insert
+    (excluded slide target) carrying it past an interval anchored on
+    that insert — the index must repair its order, not miss/false-
+    positive forever."""
+    h, a, b = make_pair()
+    a.insert_text(0, "abcdef")
+    h.process_all()
+    coll = a.get_interval_collection("s")
+    i1 = coll.add(2, 3)  # on 'c'
+    h.process_all()
+    # Pending local insert (NOT flushed) + an interval inside it.
+    a.insert_text(3, "ZZ")
+    i2 = coll.add(3, 4)  # inside the pending 'ZZ'
+    # Remote removal of 'c' sequences: i1's ref slides past 'ZZ'.
+    b.remove_text(2, 3)
+    h.process_all()
+
+    def brute(q0, q1):
+        return sorted(
+            iv.interval_id for iv in coll
+            if iv.bounds(a.engine)[0] <= q1
+            and iv.bounds(a.engine)[1] >= q0
+        )
+
+    for q0, q1 in ((4, 6), (0, 2), (0, 6), (2, 4)):
+        got = sorted(
+            iv.interval_id
+            for iv in coll.find_overlapping_intervals(q0, q1)
+        )
+        assert got == brute(q0, q1), (q0, q1, got, brute(q0, q1))

@@ -235,3 +235,151 @@ def test_echo_respects_later_pending_commits():
     assert a.root.get("k") == 2  # optimistic value survives the echo
     h.process_all()
     assert a.root.get("k") == b.root.get("k") == 2
+
+
+# ---------------------------------------------------------------------------
+# rebase semantics (round 5: changeset rebase replaces apply-time LWW)
+# ---------------------------------------------------------------------------
+
+
+def _pair():
+    from fluidframework_tpu.experimental.property_dds import (
+        SharedPropertyTreeFactory,
+    )
+    from fluidframework_tpu.runtime import ChannelRegistry
+    from fluidframework_tpu.testing.mocks import MultiClientHarness
+
+    reg = ChannelRegistry([SharedPropertyTreeFactory()])
+    h = MultiClientHarness(
+        2, reg, channel_types=[("p", SharedPropertyTreeFactory.type_name)]
+    )
+    a = h.runtimes[0].get_datastore("default").get_channel("p")
+    b = h.runtimes[1].get_datastore("default").get_channel("p")
+    return h, a, b
+
+
+def test_rebase_remove_wins_over_modify():
+    """The reference's remove-over-modify law: a concurrent modify of
+    a removed subtree drops on every replica."""
+    h, a, b = _pair()
+    a.insert_property("cfg", "NodeProperty")
+    a.insert_property("cfg.n", "Int32")
+    a.commit()
+    h.process_all()
+    a.remove_property("cfg")
+    b.set_value("cfg.n", 42)
+    a.commit()
+    b.commit()
+    h.process_all()
+    assert a.root.to_json() == b.root.to_json()
+    assert "cfg" not in a.root._children
+
+
+def test_rebase_concurrent_structural_inserts():
+    """Concurrent sibling inserts both survive; same-name concurrent
+    inserts resolve later-sequenced-wins — identically everywhere."""
+    h, a, b = _pair()
+    a.insert_property("left", "Int32")
+    b.insert_property("right", "Int32")
+    a.insert_property("both", "Int32")
+    a.set_value("both", 1)
+    b.insert_property("both", "Int32")
+    b.set_value("both", 2)
+    a.commit()
+    b.commit()
+    h.process_all()
+    assert a.root.to_json() == b.root.to_json()
+    assert "left" in a.root._children and "right" in a.root._children
+    # b sequenced after a: its insert payload won.
+    assert a.root.get("both") == 2
+
+
+def test_array_concurrent_inserts_adjust_indices():
+    """Index-adjusting array rebase: concurrent inserts at different
+    positions both land, earlier-sequenced content first on ties."""
+    h, a, b = _pair()
+    a.insert_property("arr", "Array")
+    a.array_insert("arr", 0, [10, 20, 30, 40])
+    a.commit()
+    h.process_all()
+    a.array_insert("arr", 1, ["a1", "a2"])   # sequences first
+    b.array_insert("arr", 3, ["b1"])
+    a.commit()
+    b.commit()
+    h.process_all()
+    assert a.root.get("arr") == b.root.get("arr")
+    assert a.root.get("arr") == [10, "a1", "a2", 20, 30, "b1", 40]
+
+
+def test_array_remove_vs_set_and_overlapping_removes():
+    h, a, b = _pair()
+    a.insert_property("arr", "Array")
+    a.array_insert("arr", 0, list(range(8)))
+    a.commit()
+    h.process_all()
+    # a removes [2, 6); b sets index 3 (inside) and 7 (outside).
+    a.array_remove("arr", 2, 4)
+    b.array_set("arr", 3, 99)
+    b.array_set("arr", 7, 77)
+    a.commit()
+    b.commit()
+    h.process_all()
+    assert a.root.get("arr") == b.root.get("arr")
+    # Removal wins over the inside set; the outside set slid left.
+    assert a.root.get("arr") == [0, 1, 6, 77]
+    # Overlapping removes clip, never double-remove.
+    a.array_remove("arr", 1, 2)
+    b.array_remove("arr", 2, 2)
+    a.commit()
+    b.commit()
+    h.process_all()
+    assert a.root.get("arr") == b.root.get("arr")
+    assert a.root.get("arr") == [0]
+
+
+def test_rebase_fuzz_concurrent_structural_edits():
+    """Randomized concurrent structural + leaf + array edits across
+    two clients with batched commits: replicas converge after every
+    drain (the rebase-semantics convergence bar)."""
+    import random
+
+    rng = random.Random(99)
+    h, a, b = _pair()
+    a.insert_property("arr", "Array")
+    a.insert_property("m", "NodeProperty")
+    a.commit()
+    h.process_all()
+    names = [f"k{i}" for i in range(6)]
+    for rnd in range(30):
+        for t in (a, b):
+            for _ in range(3):
+                r = rng.random()
+                arr = t.root.get("arr")
+                if r < 0.25:
+                    n = rng.choice(names)
+                    if n not in t.root.get("m")._children:
+                        t.insert_property(f"m.{n}", "Int32")
+                    else:
+                        t.set_value(f"m.{n}", rng.randint(0, 99))
+                elif r < 0.4:
+                    n = rng.choice(names)
+                    if n in t.root.get("m")._children:
+                        t.remove_property(f"m.{n}")
+                elif r < 0.65:
+                    t.array_insert(
+                        "arr", rng.randint(0, len(arr)),
+                        [rng.randint(100, 999)],
+                    )
+                elif r < 0.8 and arr:
+                    i = rng.randrange(len(arr))
+                    t.array_remove(
+                        "arr", i, min(len(arr) - i, rng.randint(1, 3))
+                    )
+                elif arr:
+                    t.array_set(
+                        "arr", rng.randrange(len(arr)),
+                        rng.randint(1000, 1999),
+                    )
+            t.commit()
+        h.process_all()
+        assert a.root.to_json() == b.root.to_json(), f"round {rnd}"
