@@ -237,11 +237,14 @@ class SharedMatrix(SharedObject):
                 if self._pending_cells.get(key, 0) == 0:
                     self._cells[key] = op["value"]
                     # Event positions are RECEIVER-local (the sender's
-                    # row/col indices mean nothing at this replica).
-                    r = self.rows.position_of_handle(key[0])
-                    c = self.cols.position_of_handle(key[1])
-                    if r is not None and c is not None:
-                        self.emit("cellChanged", r, c, False)
+                    # row/col indices mean nothing at this replica) —
+                    # and resolving them costs two engine walks, so
+                    # only do it when someone is listening.
+                    if self._listeners.get("cellChanged"):
+                        r = self.rows.position_of_handle(key[0])
+                        c = self.cols.position_of_handle(key[1])
+                        if r is not None and c is not None:
+                            self.emit("cellChanged", r, c, False)
         else:
             pv = self.rows if "Rows" in kind else self.cols
             eng = pv.engine
@@ -257,12 +260,15 @@ class SharedMatrix(SharedObject):
                     op["pos"], op["pos"] + op["count"], msg.ref_seq,
                     msg.client_id, msg.sequence_number,
                 )
-        # Advance both axes' collaboration windows.
+        # Advance both axes' collaboration windows (the MSN advance —
+        # which runs zamboni — only when it actually moved).
+        seq = msg.sequence_number
+        msn = msg.minimum_sequence_number
         for pv in (self.rows, self.cols):
-            pv.engine.current_seq = msg.sequence_number
-            pv.engine.update_min_seq(
-                max(pv.engine.min_seq, msg.minimum_sequence_number)
-            )
+            eng = pv.engine
+            eng.current_seq = seq
+            if msn > eng.min_seq:
+                eng.update_min_seq(msn)
 
     def resubmit(self, content: Any, local_metadata: Any) -> None:
         """Reconnect replay with rebase: structural ops regenerate

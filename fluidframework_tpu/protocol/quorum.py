@@ -101,6 +101,16 @@ class ProtocolOpHandler:
         self.current_seq = current_seq
         self.min_seq = min_seq
 
+    def process_data_op(self, seq: int, msn: int) -> None:
+        """The plain-data-op tail of `process_message` (the dominant
+        message type): advance seq/MSN, re-check proposal commitment
+        only when the MSN moved. ONE owner of this invariant — the
+        container runtime's hot path calls this instead of inlining."""
+        self.current_seq = seq
+        if msn > self.min_seq:
+            self.min_seq = msn
+            self.proposals.update_msn(msn)
+
     def process_message(self, msg: SequencedMessage) -> None:
         """protocol.ts:109 processMessage."""
         if msg.type == MessageType.CLIENT_JOIN:

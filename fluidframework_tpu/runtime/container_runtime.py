@@ -87,7 +87,7 @@ class ContainerRuntime(EventEmitter):
         self,
         registry: ChannelRegistry,
         flush_mode: FlushMode = FlushMode.TURN_BASED,
-        compression_threshold: Optional[int] = 16 * 1024,
+        compression_threshold: Optional[int] = 614400,
         max_op_bytes: int = 700 * 1024,
     ):
         super().__init__()
@@ -489,11 +489,19 @@ class ContainerRuntime(EventEmitter):
 
     def _process_one(self, msg: SequencedMessage) -> None:
         self.current_seq = msg.sequence_number
-        self.min_seq = max(self.min_seq, msg.minimum_sequence_number)
+        if msg.minimum_sequence_number > self.min_seq:
+            self.min_seq = msg.minimum_sequence_number
         # Every message advances protocol state: join/leave/propose
         # mutate the quorum, and any MSN advance can commit proposals
         # (the reference routes all messages through ProtocolOpHandler).
-        self.protocol.process_message(msg)
+        # Plain data ops — the hot path — only move seq/MSN
+        # (ProtocolOpHandler.process_data_op owns that invariant).
+        if msg.type == MessageType.OP:
+            self.protocol.process_data_op(
+                msg.sequence_number, msg.minimum_sequence_number
+            )
+        else:
+            self.protocol.process_message(msg)
         if msg.type != MessageType.OP or not isinstance(msg.contents, dict):
             if msg.type in (MessageType.CLIENT_JOIN, MessageType.CLIENT_LEAVE):
                 # A departed client's partial chunk stream can never

@@ -50,17 +50,11 @@ def approx_wire_size(obj: Any, budget: int) -> int:
     serialization). Lets the outbox skip per-op json for the common
     small-batch case — the sizes only gate compression/chunking, and
     both thresholds are orders of magnitude above typical ops."""
-    if obj is None or isinstance(obj, bool):
-        return 5
-    if isinstance(obj, int):
-        # json renders arbitrary-precision ints in full; only bound
-        # the machine-word range.
-        if -(1 << 53) < obj < (1 << 53):
-            return 24
-        return -1
-    if isinstance(obj, float):
-        return 32
-    if isinstance(obj, str):
+    # Exact-type dispatch (hot path: called per value per flush);
+    # subclasses fall through to -1 = exact serialization, which is
+    # always safe.
+    t = type(obj)
+    if t is str:
         if obj.isascii():
             if obj.isprintable():
                 # Printable ASCII escapes only \ and " (2 bytes each).
@@ -70,10 +64,20 @@ def approx_wire_size(obj: Any, budget: int) -> int:
         # ensure_ascii renders non-ASCII as \uXXXX (6 bytes/char;
         # surrogate pairs 12, still <= 12*len).
         return 2 + 12 * len(obj)
-    if isinstance(obj, dict):
+    if t is int:
+        # json renders arbitrary-precision ints in full; only bound
+        # the machine-word range.
+        if -(1 << 53) < obj < (1 << 53):
+            return 24
+        return -1
+    if obj is None or t is bool:
+        return 5
+    if t is float:
+        return 32
+    if t is dict:
         total = 2
         for k, v in obj.items():
-            if not isinstance(k, str):
+            if type(k) is not str:
                 return -1
             # Keys bound like any string (control/non-ASCII chars
             # render as \uXXXX) + ': ' separator (2 bytes — json's
@@ -86,7 +90,7 @@ def approx_wire_size(obj: Any, budget: int) -> int:
             if total > budget:
                 return total
         return total
-    if isinstance(obj, (list, tuple)):
+    if t is list or t is tuple:
         total = 2
         for v in obj:
             s = approx_wire_size(v, budget - total)
@@ -117,7 +121,7 @@ def compress_batch_serialized(dumped: List[str]) -> List[Any]:
     hot path serializes once and reuses the strings for sizing,
     compression, and the chunking test)."""
     payload = base64.b64encode(
-        zlib.compress(("[" + ",".join(dumped) + "]").encode())
+        zlib.compress(("[" + ",".join(dumped) + "]").encode(), 1)
     ).decode()
     packed: List[Any] = [
         {"packedContents": payload, "compression": COMPRESSION_ALGO}

@@ -64,5 +64,8 @@ class EventEmitter:
         return self.on(event, wrapper)
 
     def emit(self, event: str, *args) -> None:
-        for fn in list(self._listeners.get(event, [])):
+        fns = self._listeners.get(event)
+        if not fns:
+            return  # no-listener fast path: zero allocations
+        for fn in list(fns):
             fn(*args)

@@ -82,7 +82,10 @@ def config3_matrix(size: int = 256, n_ops: int = 10_000) -> dict:
 
     n_ops = int(n_ops * SCALE)
 
+    last = {}
+
     def workload():
+        last.clear()  # don't hold the previous run's harness alive
         registry = ChannelRegistry([MatrixFactory()])
         h = MultiClientHarness(
             2, registry, channel_types=[("mx", MatrixFactory.type_name)]
@@ -105,10 +108,15 @@ def config3_matrix(size: int = 256, n_ops: int = 10_000) -> dict:
             if done % 512 == 0:
                 h.process_all()
         h.process_all()
-        b = h.runtimes[1].get_datastore("default").get_channel("mx")
-        assert a.to_dense() == b.to_dense(), "matrix replicas diverged"
+        last["h"] = h  # convergence gate runs OUTSIDE the timed region
 
     stats = run_benchmark(workload, repeats=REPEATS, warmups=1, memory=True)
+    # Correctness gate on the final run's state (the reference's perf
+    # harness likewise keeps verification out of timed sections).
+    h = last["h"]
+    a = h.runtimes[0].get_datastore("default").get_channel("mx")
+    b = h.runtimes[1].get_datastore("default").get_channel("mx")
+    assert a.to_dense() == b.to_dense(), "matrix replicas diverged"
     return {
         "config": "matrix_256x256_setcell_insert_mix",
         "ops": n_ops, "seconds": stats["mean"],
