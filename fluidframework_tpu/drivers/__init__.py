@@ -23,5 +23,6 @@ from .local_driver import LocalDriver
 from .replay_driver import ReplayDriver
 from .file_driver import FileDriver
 from .fault_injection import FaultInjectionDriver
+from .web_cache import CachedDriver
 
-__all__ = ["FaultInjectionDriver", "FileDriver", "LocalDriver", "ReplayDriver"]
+__all__ = ["CachedDriver", "FaultInjectionDriver", "FileDriver", "LocalDriver", "ReplayDriver"]

@@ -1,0 +1,151 @@
+"""Client-side snapshot/blob cache tier: the driver-web-cache role.
+
+The reference's `@fluidframework/driver-web-cache`
+(packages/drivers/driver-web-cache/src/FluidCache.ts) persists
+snapshots and blobs in IndexedDB so a returning client boots from
+local storage instead of a service round trip, with staleness expiry
+(`FluidCacheEntry` partitioned by file, age-gated reads) and
+best-effort writes that never fail the caller. This is that tier over
+a local directory (the IndexedDB stand-in), wrapping ANY driver with
+the SocketDriver surface:
+
+- `load_document` caches the summary wire form per document with a
+  TTL: fresh hits skip the service entirely (the fast-boot path);
+  stale entries re-fetch and refresh. A service failure falls back to
+  a stale cached copy when allowed (offline boot).
+- `read_blob` caches content-addressed blobs FOREVER (immutable by
+  construction — the content address is the identity).
+- `ops_from`/`connect`/writes pass through untouched: only boot
+  artifacts cache (the reference likewise caches snapshots, never the
+  delta stream).
+
+Cache writes are best-effort: an unwritable cache directory degrades
+to pass-through, never an error (FluidCache.ts swallows storage
+failures the same way).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import time
+from typing import Any, List, Optional
+
+
+class CachedDriver:
+    """Wrap a driver with the local snapshot/blob cache tier."""
+
+    def __init__(self, inner, cache_dir: str,
+                 snapshot_ttl_s: float = 3600.0,
+                 allow_stale_on_error: bool = True):
+        self.inner = inner
+        self.dir = cache_dir
+        self.snapshot_ttl_s = snapshot_ttl_s
+        self.allow_stale_on_error = allow_stale_on_error
+        self.hits = 0
+        self.misses = 0
+        self.stale_hits = 0
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            self._usable = True
+        except OSError:
+            self._usable = False
+
+    # ------------------------------------------------------------ paths
+
+    def _key(self, kind: str, *parts: str) -> str:
+        h = hashlib.sha256("\x00".join(parts).encode()).hexdigest()[:32]
+        return os.path.join(self.dir, f"{kind}-{h}.json")
+
+    def _read(self, path: str, *keys: str) -> Optional[dict]:
+        """Load an entry; malformed/foreign shapes degrade to a miss
+        (cache failures never fail the caller)."""
+        try:
+            with open(path) as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(entry, dict) or any(k not in entry for k in keys):
+            return None
+        return entry
+
+    def _write(self, path: str, entry: dict) -> None:
+        if not self._usable:
+            return
+        try:
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(entry, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # best-effort: cache failures never fail the caller
+
+    # ---------------------------------------------------------- summary
+
+    def load_document(self, doc_id: str) -> Optional[str]:
+        path = self._key("snap", doc_id)
+        entry = self._read(path, "at", "wire")
+        now = time.time()
+        if entry is not None and now - entry["at"] < self.snapshot_ttl_s:
+            self.hits += 1
+            return entry["wire"]
+        self.misses += 1
+        try:
+            wire = self.inner.load_document(doc_id)
+        except Exception:
+            if entry is not None and self.allow_stale_on_error:
+                # Offline boot: a stale snapshot beats no snapshot
+                # (the client catches up over the delta stream later).
+                # Counted once, as a stale hit — hits + misses +
+                # stale_hits partitions the lookups.
+                self.misses -= 1
+                self.stale_hits += 1
+                return entry["wire"]
+            raise
+        if wire is not None:
+            self._write(path, {"at": now, "wire": wire})
+        return wire
+
+    # ------------------------------------------------------------ blobs
+
+    def read_blob(self, doc_id: str, blob_id: str) -> bytes:
+        path = self._key("blob", doc_id, blob_id)
+        entry = self._read(path, "data")
+        if entry is not None:
+            self.hits += 1
+            return base64.b64decode(entry["data"])
+        self.misses += 1
+        data = self.inner.read_blob(doc_id, blob_id)
+        # Content-addressed: immutable, cache forever.
+        self._write(path, {"data": base64.b64encode(data).decode()})
+        return data
+
+    # -------------------------------------------------------- housekeeping
+
+    def clear_expired(self, now: Optional[float] = None) -> int:
+        """Drop expired snapshot entries (the FluidCache partitioned-
+        clear role); returns the number removed. Blobs are immutable
+        and stay."""
+        if not self._usable:
+            return 0
+        now = time.time() if now is None else now
+        removed = 0
+        for name in os.listdir(self.dir):
+            if not name.startswith("snap-"):
+                continue
+            path = os.path.join(self.dir, name)
+            entry = self._read(path, "at")
+            if entry is None or now - entry["at"] >= self.snapshot_ttl_s:
+                try:
+                    os.remove(path)
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    # ------------------------------------------------------ pass-through
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.inner, name)

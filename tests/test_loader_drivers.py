@@ -272,3 +272,133 @@ def test_parallel_fetch_contiguous():
     # Partial window.
     ops = fetch_ops_parallel(loader.driver, doc, 10, 25, chunk=4)
     assert [m.sequence_number for m in ops] == list(range(11, 26))
+
+
+# ------------------------------------------------------ driver-web-cache
+
+
+def test_cached_driver_snapshot_and_blob_tiers(tmp_path):
+    """The driver-web-cache role (FluidCache.ts): snapshots cache with
+    TTL (fresh hits skip the service; stale refetch; service failure
+    falls back to stale), blobs cache forever (content-addressed)."""
+    from fluidframework_tpu.drivers.web_cache import CachedDriver
+
+    calls = {"load": 0, "blob": 0}
+
+    class FakeDriver:
+        def load_document(self, doc_id):
+            calls["load"] += 1
+            if calls.get("fail"):
+                raise ConnectionError("service down")
+            return f"wire-{doc_id}-v{calls['load']}"
+
+        def read_blob(self, doc_id, blob_id):
+            calls["blob"] += 1
+            return f"{doc_id}:{blob_id}".encode()
+
+        def ops_from(self, doc_id, a, b=None):
+            return ["passthrough"]
+
+    d = CachedDriver(FakeDriver(), str(tmp_path), snapshot_ttl_s=100.0)
+    assert d.load_document("doc") == "wire-doc-v1"
+    assert d.load_document("doc") == "wire-doc-v1"  # fresh hit
+    assert calls["load"] == 1 and d.hits == 1
+
+    # A SECOND CachedDriver over the same dir (a new session) also
+    # boots from cache — the returning-client fast boot.
+    d2 = CachedDriver(FakeDriver(), str(tmp_path), snapshot_ttl_s=100.0)
+    assert d2.load_document("doc") == "wire-doc-v1"
+    assert d2.hits == 1 and calls["load"] == 1
+
+    # Blob: cached forever; second read never touches the service.
+    assert d.read_blob("doc", "b1") == b"doc:b1"
+    assert d.read_blob("doc", "b1") == b"doc:b1"
+    assert calls["blob"] == 1
+
+    # TTL expiry refetches.
+    d3 = CachedDriver(FakeDriver(), str(tmp_path), snapshot_ttl_s=0.0)
+    assert d3.load_document("doc") == "wire-doc-v2"
+    assert calls["load"] == 2
+
+    # Service failure: stale fallback (offline boot).
+    calls["fail"] = True
+    d4 = CachedDriver(FakeDriver(), str(tmp_path), snapshot_ttl_s=0.0)
+    assert d4.load_document("doc") == "wire-doc-v2"
+    # ...and strict mode raises instead.
+    d5 = CachedDriver(FakeDriver(), str(tmp_path), snapshot_ttl_s=0.0,
+                      allow_stale_on_error=False)
+    with pytest.raises(ConnectionError):
+        d5.load_document("doc")
+    del calls["fail"]
+
+    # Pass-through surface + expiry sweep.
+    assert d.ops_from("doc", 0) == ["passthrough"]
+    assert d3.clear_expired() >= 1
+
+
+def test_cached_driver_over_socket_boot(tmp_path):
+    """End-to-end: a TpuClient boots the SAME document twice through a
+    CachedDriver over TCP — the second boot's summary load is a cache
+    hit (zero service summary fetches)."""
+    import subprocess
+    import sys
+    import time as _time
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    from fluidframework_tpu.dds import MapFactory
+    from fluidframework_tpu.drivers.socket_driver import SocketDriver
+    from fluidframework_tpu.drivers.web_cache import CachedDriver
+    from fluidframework_tpu.framework.fluid_static import (
+        ContainerSchema,
+        TpuClient,
+    )
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "socket_server_main.py"),
+         "--allow-anonymous"],
+        stdout=subprocess.PIPE, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+    )
+    try:
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING"), line
+        _, host, port = line.split()
+        port = int(port)
+        schema = ContainerSchema({"kv": MapFactory.type_name})
+        c = TpuClient(SocketDriver(host, port)).create_container(schema)
+        c.initial_objects["kv"].set("k", "v")
+        doc = c.attach()
+        c.flush()
+        _time.sleep(0.3)
+
+        cached = CachedDriver(SocketDriver(host, port), str(tmp_path))
+        c1 = TpuClient(cached).get_container(doc, schema)
+        assert c1.initial_objects["kv"].get("k") == "v"
+        assert cached.misses >= 1
+        cached2 = CachedDriver(SocketDriver(host, port), str(tmp_path))
+        c2 = TpuClient(cached2).get_container(doc, schema)
+        assert c2.initial_objects["kv"].get("k") == "v"
+        assert cached2.hits >= 1 and cached2.misses == 0
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_cached_driver_malformed_entries_degrade(tmp_path):
+    """Corrupt-but-parseable cache files are a MISS, never a crash."""
+    from fluidframework_tpu.drivers.web_cache import CachedDriver
+
+    class FakeDriver:
+        def load_document(self, doc_id):
+            return "fresh"
+
+    d = CachedDriver(FakeDriver(), str(tmp_path))
+    path = d._key("snap", "doc")
+    with open(path, "w") as f:
+        f.write("[1, 2, 3]")  # valid JSON, wrong shape
+    assert d.load_document("doc") == "fresh"
+    assert d.misses == 1
+    with open(path, "w") as f:
+        f.write('{"unrelated": true}')
+    assert d.clear_expired() >= 1  # malformed entries sweep away
