@@ -100,7 +100,13 @@ class FaultInjectionDriver:
         self.connections.append(conn)
         return conn
 
-    def ops_from(self, doc_id: str, from_seq: int):
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None):
+        if to_seq is not None:
+            try:
+                return self.inner.ops_from(doc_id, from_seq, to_seq=to_seq)
+            except TypeError:
+                pass  # wrapped driver predates ranged reads
         return self.inner.ops_from(doc_id, from_seq)
 
     def upload_blob(self, doc_id: str, data: bytes) -> str:
@@ -110,6 +116,31 @@ class FaultInjectionDriver:
 
     def read_blob(self, doc_id: str, blob_id: str) -> bytes:
         return self.inner.read_blob(doc_id, blob_id)
+
+    # -------------------------------------------------- credential seam
+
+    @property
+    def token_provider(self) -> Any:
+        """Delegated to the wrapped driver in BOTH directions (the
+        CachedDriver lesson, ADVICE.md round 5): an assignment landing
+        on the wrapper would leave the inner driver unauthenticated.
+        Raises AttributeError when the inner driver has no credential
+        seam so `hasattr` checks stay truthful."""
+        return self.inner.token_provider
+
+    @token_provider.setter
+    def token_provider(self, value: Any) -> None:
+        if not hasattr(self.inner, "token_provider"):
+            raise AttributeError(
+                "wrapped driver has no token_provider seam"
+            )
+        self.inner.token_provider = value
+
+    def __getattr__(self, name: str) -> Any:
+        # Forward anything else (has_credentials, driver extensions) so
+        # fault injection composes as a first-class resilience layer,
+        # not just a test prop.
+        return getattr(self.inner, name)
 
     # ------------------------------------------------------ fault controls
 

@@ -124,8 +124,9 @@ class FileDriver:
     def connect(self, doc_id: str, client_id: Optional[int] = None):
         return self._ensure_replay().connect(doc_id, client_id)
 
-    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
-        return self._ensure_replay().ops_from(doc_id, from_seq)
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        return self._ensure_replay().ops_from(doc_id, from_seq, to_seq=to_seq)
 
     # --------------------------------------------------------- controller
 

@@ -62,8 +62,11 @@ class ReplayDriver:
         self._connections.setdefault(doc_id, []).append(conn)
         return conn
 
-    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> List[SequencedMessage]:
         mark = self._watermark.get(doc_id, 0)
+        if to_seq is not None:
+            mark = min(mark, to_seq)
         return [
             m for m in self.streams.get(doc_id, [])
             if from_seq < m.sequence_number <= mark

@@ -91,6 +91,15 @@ class _SocketConnection:
         info = self._call(cmd="connect", docId=doc_id, clientId=client_id)
         self.client_id = info["clientId"]
         self.join_seq = info["joinSeq"]
+        # Live-stream continuity guard (the resilience layer for a
+        # flaky fan-out edge): the last sequence number delivered to
+        # the listener. A pushed op at/below it is a duplicate and is
+        # dropped; one that jumps past last+1 reveals a gap, closed
+        # with a ranged refetch before delivery (ops_from(from, to) —
+        # the reference driver's deltaStorage catch-up read).
+        self.last_seq = self.join_seq
+        self.gap_refetches = 0
+        self.dup_drops = 0
         self.connected = True
 
     # --------------------------------------------------------- framing
@@ -214,7 +223,7 @@ class _SocketConnection:
                 if listener is None:
                     self._early.append(frame["msg"])
                     return
-            listener(message_from_json(frame["msg"]))
+            self._deliver(frame["msg"], listener)
         elif frame["event"] == "nack":
             m = frame["msg"]
             if self.nack_listener is not None:
@@ -244,9 +253,46 @@ class _SocketConnection:
                         import json as _json
 
                         for w in _json.loads(m)["msgs"]:
-                            fn(message_from_json(w))
+                            self._deliver(w, fn)
                     else:
-                        fn(message_from_json(m))
+                        self._deliver(m, fn)
+
+    def _deliver(self, wire: dict, fn) -> None:
+        """Continuity-guarded delivery: drop duplicates, close gaps
+        with a ranged refetch, and if a gap cannot be closed, tear the
+        transport down rather than corrupt the replica."""
+        seq = wire.get("sequenceNumber")
+        if seq is None:
+            fn(message_from_json(wire))
+            return
+        if seq <= self.last_seq:
+            self.dup_drops += 1  # duplicated delivery: already applied
+            return
+        if seq > self.last_seq + 1:
+            self.gap_refetches += 1
+            try:
+                missing = self._call(
+                    cmd="ops_from", docId=self._doc_id,
+                    fromSeq=self.last_seq, toSeq=seq - 1,
+                )
+            except Exception:
+                missing = []
+            for w in missing:
+                if w["sequenceNumber"] > self.last_seq:
+                    self.last_seq = w["sequenceNumber"]
+                    fn(message_from_json(w))
+            if self.last_seq < seq - 1:
+                # The hole is not servable (mid-restart server):
+                # applying this op out of order would silently diverge
+                # the replica. Drop the connection; the container's
+                # reconnect path catches up from durable storage.
+                try:
+                    self.disconnect()
+                except Exception:
+                    pass
+                return
+        self.last_seq = seq
+        fn(message_from_json(wire))
 
     def submit(self, msg: DocumentMessage) -> None:
         from ..server.socket_service import document_message_to_json

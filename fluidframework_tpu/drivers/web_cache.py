@@ -147,5 +147,25 @@ class CachedDriver:
 
     # ------------------------------------------------------ pass-through
 
+    @property
+    def token_provider(self) -> Any:
+        """The credential seam delegates to the wrapped driver in BOTH
+        directions. `__getattr__` already forwarded reads, but an
+        ASSIGNMENT used to land on the wrapper instance, leaving the
+        inner SocketDriver with token_provider=None — a cached client
+        silently went out unauthenticated against a secure server
+        (round-5 ADVICE.md low). Raises AttributeError when the inner
+        driver has no credential seam, so `hasattr` checks stay
+        truthful."""
+        return self.inner.token_provider
+
+    @token_provider.setter
+    def token_provider(self, value: Any) -> None:
+        if not hasattr(self.inner, "token_provider"):
+            raise AttributeError(
+                "wrapped driver has no token_provider seam"
+            )
+        self.inner.token_provider = value
+
     def __getattr__(self, name: str) -> Any:
         return getattr(self.inner, name)

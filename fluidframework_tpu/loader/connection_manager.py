@@ -12,6 +12,7 @@ asserting the delay schedule.
 
 from __future__ import annotations
 
+import random
 import time
 from typing import Callable, List, Optional
 
@@ -25,6 +26,13 @@ class ConnectionManager:
     (connectionManager.ts reconnect + driver-supplied retryAfter).
     `sleep` is injectable for tests; `delays` records the schedule
     actually used.
+
+    `jitter` spreads the ladder by up to ±jitter·delay so a fleet of
+    clients dropped by one server restart does not reconnect in
+    lockstep (the thundering-herd guard). The jitter stream is seeded
+    (`seed`) and private to this manager, so a given (seed, disconnect
+    history) always reproduces the exact same schedule — chaos runs
+    stay replayable.
     """
 
     def __init__(
@@ -34,19 +42,28 @@ class ConnectionManager:
         base_delay: float = 0.05,
         max_delay: float = 5.0,
         sleep: Callable[[float], None] = time.sleep,
+        jitter: float = 0.0,
+        seed: Optional[int] = None,
     ):
         self.container = container
         self.max_attempts = max_attempts
         self.base_delay = base_delay
         self.max_delay = max_delay
         self.sleep = sleep
+        self.jitter = jitter
+        self._rng = random.Random(seed)
         self.delays: List[float] = []
         self.enabled = True
         self._reconnecting = False
         container.on("disconnected", self._on_disconnected)
 
     def delay_for(self, attempt: int) -> float:
-        return min(self.base_delay * (2 ** attempt), self.max_delay)
+        delay = min(self.base_delay * (2 ** attempt), self.max_delay)
+        if self.jitter:
+            delay *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        # The cap binds AFTER jitter: the ladder never exceeds
+        # max_delay no matter the draw.
+        return min(delay, self.max_delay)
 
     def _on_disconnected(self) -> None:
         if not self.enabled or self._reconnecting:

@@ -191,7 +191,18 @@ class Loader:
             # docstring); skipping this tail replay would re-apply the
             # stash at the summary perspective — the stale-position
             # bug — so its absence must fail loudly, not silently.
-            for msg in self.driver.ops_from(doc_id, rt.current_seq):
+            # Ranged refetch where the driver supports it (every
+            # in-tree driver does): only the (current, base] window is
+            # fetched instead of the whole tail past the stash point —
+            # a long-offline resume no longer pulls ops it will
+            # immediately discard.
+            try:
+                tail = self.driver.ops_from(
+                    doc_id, rt.current_seq, to_seq=base
+                )
+            except TypeError:  # minimal foreign driver: full tail
+                tail = self.driver.ops_from(doc_id, rt.current_seq)
+            for msg in tail:
                 if msg.sequence_number > base:
                     break
                 rt.process(msg)

@@ -16,6 +16,8 @@ from .sequencer import DocumentSequencer, NACK_STALE_REFSEQ
 from .local_service import LocalOrderingService
 from .castore import ContentAddressedStore
 from .queue import (
+    FencedCheckpointStore,
+    FencedError,
     JournalConsumer,
     JournalProducer,
     LeaseManager,
@@ -24,6 +26,7 @@ from .queue import (
     SharedFileTopic,
     partition_of,
 )
+from .supervisor import ServiceSupervisor
 from .log import LogConsumer, LogTopic, MessageLog
 from .lambdas import (
     BroadcasterLambda,
@@ -34,6 +37,8 @@ from .lambdas import (
 )
 
 __all__ = [
+    "FencedCheckpointStore",
+    "FencedError",
     "JournalConsumer",
     "JournalProducer",
     "LeaseManager",
@@ -53,4 +58,5 @@ __all__ = [
     "NACK_STALE_REFSEQ",
     "ScribeLambda",
     "ScriptoriumLambda",
+    "ServiceSupervisor",
 ]

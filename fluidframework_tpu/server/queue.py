@@ -24,10 +24,33 @@ proves the survivor takes over its partitions exactly-once.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import time
-from typing import Any, List, Optional, Protocol
+from typing import Any, Iterator, List, Optional, Protocol, Tuple
+
+
+class FencedError(RuntimeError):
+    """A write carried a fencing token older than (or tied with but not
+    bound to) the one the target has already accepted: the writer is a
+    deposed owner and its write must not land (the zookeeper/Kafka
+    fencing contract — exactly-once rests on deposed owners being
+    REJECTED at the write path, not merely asked to stand down)."""
+
+
+def _check_fence(cur_fence: int, cur_owner: Optional[str],
+                 fence: int, owner: Optional[str], what: str) -> None:
+    """THE fence-gate rule, shared by every fenced write path: reject a
+    fence below the highest accepted, or an equal fence from a
+    different owner than the one it first bound to (ties broken by
+    first binder — the guard for the pathological same-fence split)."""
+    if fence < cur_fence or (fence == cur_fence and cur_owner is not None
+                             and owner != cur_owner):
+        raise FencedError(
+            f"{what}: fence {fence} ({owner}) rejected; already bound "
+            f"to {cur_fence} ({cur_owner})"
+        )
 
 
 class Producer(Protocol):
@@ -75,6 +98,23 @@ class SharedFileTopic:
     the file from a LINE offset, re-reading anything new on each poll
     — the minimal faithful form of a shared Kafka partition. Entries
     are plain JSON values.
+
+    Robustness contract (the chaos-harness substrate):
+
+    - **Torn tail** — a reader never consumes a final line lacking its
+      trailing newline (an append in progress, or a writer that died
+      mid-write); the line is re-read complete on the next poll. The
+      next append SEALS a crash-torn tail with a newline first, so the
+      junk remnant becomes one unparseable line instead of corrupting
+      the following record; readers skip (but still count) lines that
+      fail to parse.
+    - **Fencing** — appends may carry a ``fence`` token (+ owner). The
+      topic remembers the highest accepted (fence, owner) in a sidecar
+      file, updated under the same append lock; a lower fence — or an
+      equal fence from a different owner than the one it first bound
+      to — raises :class:`FencedError` and writes nothing. This is
+      what makes a deposed lease holder's post-takeover writes
+      *demonstrably rejected* rather than merely discouraged.
     """
 
     def __init__(self, path: str):
@@ -84,26 +124,127 @@ class SharedFileTopic:
             with open(path, "a"):
                 pass
 
-    def append(self, message: Any) -> None:
+    # ------------------------------------------------------------ fence
+
+    def _fence_path(self) -> str:
+        return self.path + ".fence"
+
+    def latest_fence(self) -> Tuple[int, Optional[str]]:
+        """The highest (fence, owner) this topic has accepted."""
+        try:
+            with open(self._fence_path()) as f:
+                d = json.load(f)
+            return int(d.get("fence", 0)), d.get("owner")
+        except (OSError, ValueError):
+            return 0, None
+
+    def _gate_fence(self, fence: Optional[int],
+                    owner: Optional[str]) -> None:
+        """Check-and-advance the fence sidecar. Caller holds the
+        append lock, so read-modify-write is race-free."""
+        if fence is None:
+            return
+        cur, cur_owner = self.latest_fence()
+        _check_fence(cur, cur_owner, fence, owner,
+                     os.path.basename(self.path))
+        if fence > cur or cur_owner is None:
+            tmp = self._fence_path() + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"fence": fence, "owner": owner}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._fence_path())
+
+    # ----------------------------------------------------------- append
+
+    def append(self, message: Any, fence: Optional[int] = None,
+               owner: Optional[str] = None) -> None:
+        self.append_many([message], fence=fence, owner=owner)
+
+    def append_many(self, messages: List[Any],
+                    fence: Optional[int] = None,
+                    owner: Optional[str] = None,
+                    lock_timeout_s: Optional[float] = None) -> None:
         import fcntl
 
-        line = json.dumps(message) + "\n"
-        with open(self.path, "a") as f:
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        # An empty batch still gates: a deposed owner must learn it is
+        # deposed even when it has nothing to write.
+        payload = b"".join(
+            json.dumps(m).encode() + b"\n" for m in messages
+        )
+        with open(self.path, "r+b") as f:
+            if lock_timeout_s is None:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            else:
+                # Bounded acquisition for callers that must not wedge
+                # behind a stalled (e.g. SIGSTOPped) writer's lock — a
+                # takeover successor times out, has the zombie killed
+                # (the supervisor's stale-heartbeat role), and retries.
+                deadline = time.time() + lock_timeout_s
+                while True:
+                    try:
+                        fcntl.flock(
+                            f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB
+                        )
+                        break
+                    except OSError:
+                        if time.time() > deadline:
+                            raise TimeoutError(
+                                f"append lock on {self.path} held past "
+                                f"{lock_timeout_s}s"
+                            )
+                        time.sleep(0.005)
             try:
-                f.write(line)
+                self._gate_fence(fence, owner)
+                f.seek(0, os.SEEK_END)
+                pos = f.tell()
+                if pos > 0:
+                    f.seek(pos - 1)
+                    if f.read(1) != b"\n":
+                        # A writer died mid-append: seal its torn line
+                        # so our record starts on a fresh line and the
+                        # remnant parses (and is skipped) as one junk
+                        # line.
+                        f.write(b"\n")
+                f.write(payload)
                 f.flush()
                 os.fsync(f.fileno())
             finally:
                 fcntl.flock(f.fileno(), fcntl.LOCK_UN)
 
+    # ------------------------------------------------------------- read
+
+    def read_entries(self, offset: int) -> Tuple[List[Tuple[int, Any]],
+                                                 int]:
+        """Parse lines from line-index `offset`. Returns
+        ``([(line_index, value), ...], next_offset)``.
+
+        A final line without a trailing newline is NOT consumed (it is
+        an append in progress — complete on the next poll); a complete
+        line that fails to parse (sealed torn remnant) is skipped but
+        still counted, so offsets stay stable across all readers."""
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if not data:
+            return [], offset
+        lines = data.split(b"\n")
+        # Drop the final element either way: it is the '' split
+        # artifact of a newline-terminated file, or a torn
+        # (in-progress) line that must be re-read complete next poll.
+        lines.pop()
+        out: List[Tuple[int, Any]] = []
+        for i in range(offset, len(lines)):
+            line = lines[i].strip()
+            if not line:
+                continue
+            try:
+                out.append((i, json.loads(line)))
+            except ValueError:
+                continue  # sealed junk from a crashed writer
+        return out, max(offset, len(lines))
+
     def read_from(self, offset: int) -> List[Any]:
-        out: List[Any] = []
-        with open(self.path) as f:
-            for i, line in enumerate(f):
-                if i >= offset and line.strip():
-                    out.append(json.loads(line))
-        return out
+        return [v for _, v in self.read_entries(offset)[0]]
 
 
 class SharedFileProducer:
@@ -121,11 +262,15 @@ class SharedFileConsumer:
         self.offset = offset
 
     def poll(self, max_count: Optional[int] = None) -> List[Any]:
-        msgs = self.topic.read_from(self.offset)
-        if max_count is not None:
-            msgs = msgs[:max_count]
-        self.offset += len(msgs)
-        return msgs
+        entries, next_offset = self.topic.read_entries(self.offset)
+        if max_count is not None and len(entries) > max_count:
+            entries = entries[:max_count]
+            # Resume right after the last entry taken (skipped junk
+            # lines between entries stay counted); max_count=0 takes
+            # nothing and leaves the offset alone.
+            next_offset = entries[-1][0] + 1 if entries else self.offset
+        self.offset = next_offset
+        return [v for _, v in entries]
 
 
 # ---------------------------------------------------------------------------
@@ -133,22 +278,43 @@ class SharedFileConsumer:
 # ---------------------------------------------------------------------------
 
 
+class _ClaimBusy(Exception):
+    """Another worker holds the arbitration claim right now."""
+
+
 class LeaseManager:
     """Expiry-based partition leases over a shared directory.
 
     A lease is a JSON file `<dir>/<partition>.lease` holding
-    ``{"owner", "expires", "fence"}``. Acquisition writes a temp file
-    and atomically renames it over the lease, then READS BACK to
-    confirm ownership (two racers both rename; exactly one's content
-    survives — the read-back arbitrates). `fence` increments on every
-    ownership change, the fencing token that lets downstream state
-    (checkpoints) reject a deposed owner's stale writes.
+    ``{"owner", "expires", "fence"}``. All mutations — acquire, renew,
+    release — are arbitrated under an ``O_CREAT|O_EXCL`` claim file
+    (`<partition>.lease.claim`): exactly one worker can create it, so
+    the read-decide-write sequence is a critical section and two
+    workers racing for an expired lease can no longer both "win" with
+    the same fence (the round-5 ADVICE.md medium race — the old
+    read-back arbitration let racer A read back before racer B renamed
+    over it).
+
+    Fences are allocated from a monotonic counter file
+    (`<partition>.lease.fencecounter`) updated only inside the claim,
+    so every ownership change gets a strictly larger token even if the
+    lease file itself is deleted. Claimant liveness is carried by a
+    kernel flock held on the claim fd for the whole critical section:
+    a crashed claimant's claim is broken immediately (its lock died
+    with it), while a live-but-stalled claimant's claim is never
+    broken — so two arbitrators can't coexist and fences can't split.
+    Belt-and-braces, the WRITE path still enforces tokens: fenced
+    topics/checkpoints bind each fence value to the first owner that
+    uses it and reject any other (`SharedFileTopic` appends /
+    `FencedCheckpointStore.save` raise `FencedError`).
     """
 
-    def __init__(self, directory: str, owner: str, ttl_s: float = 2.0):
+    def __init__(self, directory: str, owner: str, ttl_s: float = 2.0,
+                 claim_ttl_s: float = 1.0):
         self.dir = directory
         self.owner = owner
         self.ttl_s = ttl_s
+        self.claim_ttl_s = claim_ttl_s
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, partition: str) -> str:
@@ -169,48 +335,242 @@ class LeaseManager:
             os.fsync(f.fileno())
         os.replace(tmp, self._path(partition))
 
+    # -------------------------------------------------------- the claim
+
+    @contextlib.contextmanager
+    def _claim(self, partition: str,
+               timeout_s: Optional[float] = None) -> Iterator[None]:
+        """O_CREAT|O_EXCL mutual exclusion for lease arbitration.
+        Raises `_ClaimBusy` if the claim stays foreign past
+        `timeout_s` (default: claim_ttl_s).
+
+        Holder liveness is probed through a kernel flock the claimant
+        holds on its claim fd for the whole critical section: a dead
+        claimant's lock vanishes with the process (so its claim is
+        safely broken by whichever single breaker wins the lock), a
+        live-but-stopped claimant's lock persists (so its claim is
+        NEVER broken and the two-winners split cannot happen, unlike
+        mtime-staleness breaking). It also makes release trivially
+        safe: our claim can only have been broken if this process
+        died, so the final unlink is always our own file."""
+        import fcntl
+
+        path = self._path(partition) + ".claim"
+        deadline = time.time() + (
+            self.claim_ttl_s if timeout_s is None else timeout_s
+        )
+        fd: Optional[int] = None
+        while True:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR)
+            except FileExistsError:
+                try:
+                    probe = os.open(path, os.O_RDWR)
+                except OSError:
+                    continue  # released between EEXIST and open; retry
+                try:
+                    try:
+                        fcntl.flock(probe, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    except OSError:
+                        # A live holder (possibly stopped) — wait.
+                        if time.time() > deadline:
+                            raise _ClaimBusy(partition)
+                        time.sleep(0.002)
+                        continue
+                    # Lock acquired: the holder died before releasing.
+                    # Racing breakers serialize on this lock, and while
+                    # we hold it no new claimant can unlink the path,
+                    # so break it only if it still names our inode.
+                    try:
+                        if os.stat(path).st_ino == os.fstat(probe).st_ino:
+                            os.unlink(path)
+                    except OSError:
+                        pass
+                finally:
+                    os.close(probe)
+                continue
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                # A prober grabbed the lock on our just-created claim
+                # before we could (and will break it as holderless).
+                # Stand down and retry.
+                os.close(fd)
+                fd = None
+                if time.time() > deadline:
+                    raise _ClaimBusy(partition)
+                continue
+            # Close the create→flock window: a breaker that saw our
+            # claim unlocked may have unlinked it; if the path no
+            # longer names our inode, stand down and retry.
+            try:
+                same = os.stat(path).st_ino == os.fstat(fd).st_ino
+            except OSError:
+                same = False
+            if not same:
+                os.close(fd)
+                fd = None
+                continue
+            break
+        try:
+            os.write(fd, f"{self.owner} {os.getpid()}".encode())
+            yield
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            os.close(fd)  # releases the liveness lock
+
+    def _next_fence(self, partition: str, cur: Optional[dict]) -> int:
+        """Allocate the next fencing token from the monotonic counter
+        (called only inside the claim). max() with the lease's own
+        fence heals a lost/stale counter file."""
+        cpath = self._path(partition) + ".fencecounter"
+        try:
+            with open(cpath) as f:
+                counter = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            counter = 0
+        fence = max(counter, int(cur.get("fence", 0)) if cur else 0) + 1
+        tmp = cpath + f".tmp.{self.owner}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(fence))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, cpath)
+        return fence
+
+    # ------------------------------------------------------- operations
+
     def try_acquire(self, partition: str,
                     now: Optional[float] = None) -> Optional[int]:
         """Acquire `partition` if unowned, expired, or already ours.
         Returns the fencing token on success, None otherwise."""
         now = time.time() if now is None else now
-        cur = self._read(partition)
-        if cur is not None:
-            if cur.get("owner") == self.owner:
-                return int(cur.get("fence", 0))
-            if float(cur.get("expires", 0)) > now:
-                return None  # live foreign lease
-        fence = int(cur.get("fence", 0)) + 1 if cur else 1
-        self._write(partition, {
-            "owner": self.owner, "expires": now + self.ttl_s,
-            "fence": fence,
-        })
-        # Read-back arbitration: a concurrent racer may have renamed
-        # over ours between write and now.
-        got = self._read(partition)
-        if got is not None and got.get("owner") == self.owner:
-            return int(got.get("fence", fence))
-        return None
+        try:
+            with self._claim(partition):
+                cur = self._read(partition)
+                if cur is not None:
+                    if cur.get("owner") == self.owner:
+                        return int(cur.get("fence", 0))
+                    if float(cur.get("expires", 0)) > now:
+                        return None  # live foreign lease
+                fence = self._next_fence(partition, cur)
+                self._write(partition, {
+                    "owner": self.owner, "expires": now + self.ttl_s,
+                    "fence": fence,
+                })
+                return fence
+        except _ClaimBusy:
+            return None  # a peer is arbitrating; try again next sweep
 
     def renew(self, partition: str,
               now: Optional[float] = None) -> bool:
         now = time.time() if now is None else now
-        cur = self._read(partition)
-        if cur is None or cur.get("owner") != self.owner:
-            return False  # deposed
-        self._write(partition, {**cur, "expires": now + self.ttl_s})
-        return True
+        try:
+            with self._claim(partition):
+                cur = self._read(partition)
+                if cur is None or cur.get("owner") != self.owner:
+                    return False  # deposed
+                self._write(partition, {**cur, "expires": now + self.ttl_s})
+                return True
+        except _ClaimBusy:
+            # Can't prove ownership right now; claiming failure is the
+            # safe answer (the worker stands down, fencing protects
+            # anything it had in flight).
+            return False
 
     def release(self, partition: str) -> None:
-        cur = self._read(partition)
-        if cur is not None and cur.get("owner") == self.owner:
-            self._write(partition, {**cur, "expires": 0})
+        try:
+            with self._claim(partition):
+                cur = self._read(partition)
+                if cur is not None and cur.get("owner") == self.owner:
+                    self._write(partition, {**cur, "expires": 0})
+        except _ClaimBusy:
+            pass  # lease will expire on its own
 
-    def owner_of(self, partition: str) -> Optional[str]:
+    def owner_of(self, partition: str,
+                 now: Optional[float] = None) -> Optional[str]:
+        now = time.time() if now is None else now
         cur = self._read(partition)
-        if cur is None or float(cur.get("expires", 0)) <= time.time():
+        if cur is None or float(cur.get("expires", 0)) <= now:
             return None
         return cur.get("owner")
+
+
+class FencedCheckpointStore:
+    """Durable lambda checkpoints whose writes REJECT deposed owners.
+
+    The reference's deli checkpoints to Mongo with the partition
+    epoch as the fencing token; here each key is a JSON file
+    ``{"fence", "owner", "state"}`` and `save` is a read-gate-write
+    critical section under an OS file lock. A writer carrying a fence
+    lower than the stored one — or an equal fence under a different
+    owner than the one that first bound it — gets `FencedError`, so a
+    deposed lease holder can never roll a successor's checkpoint back
+    (the exactly-once recovery contract of ISSUE round 1).
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.ckpt.json")
+
+    def load(self, key: str) -> Optional[dict]:
+        """The checkpoint envelope ({"fence", "owner", "state"}) or
+        None."""
+        try:
+            with open(self._path(key)) as f:
+                d = json.load(f)
+            return d if isinstance(d, dict) and "state" in d else None
+        except (OSError, ValueError):
+            return None
+
+    def save(self, key: str, state: Any, fence: int,
+             owner: Optional[str] = None,
+             lock_timeout_s: Optional[float] = None) -> None:
+        import fcntl
+
+        lock_path = self._path(key) + ".lock"
+        with open(lock_path, "a+") as lk:
+            if lock_timeout_s is None:
+                fcntl.flock(lk.fileno(), fcntl.LOCK_EX)
+            else:
+                deadline = time.time() + lock_timeout_s
+                while True:
+                    try:
+                        fcntl.flock(
+                            lk.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB
+                        )
+                        break
+                    except OSError:
+                        if time.time() > deadline:
+                            raise TimeoutError(
+                                f"checkpoint lock {key!r} held past "
+                                f"{lock_timeout_s}s"
+                            )
+                        time.sleep(0.005)
+            try:
+                cur = self.load(key)
+                if cur is not None:
+                    _check_fence(
+                        int(cur.get("fence", 0)), cur.get("owner"),
+                        fence, owner, f"checkpoint {key!r}",
+                    )
+                tmp = self._path(key) + f".tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(
+                        {"fence": fence, "owner": owner, "state": state},
+                        f,
+                    )
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._path(key))
+            finally:
+                fcntl.flock(lk.fileno(), fcntl.LOCK_UN)
 
 
 def partition_of(doc_id: str, n_partitions: int) -> int:

@@ -9,5 +9,19 @@ converge to identical state.
 """
 
 from .farm import FarmConfig, run_sharedstring_farm, random_op_for
+from .chaos import (
+    ChaosConfig,
+    ChaosResult,
+    run_chaos,
+    stream_digest,
+)
 
-__all__ = ["FarmConfig", "run_sharedstring_farm", "random_op_for"]
+__all__ = [
+    "ChaosConfig",
+    "ChaosResult",
+    "FarmConfig",
+    "random_op_for",
+    "run_chaos",
+    "run_sharedstring_farm",
+    "stream_digest",
+]

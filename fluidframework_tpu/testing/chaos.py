@@ -1,0 +1,510 @@
+"""Chaos-engineering harness for the supervised ordering farm.
+
+The convergence claim ("identical deterministic replay of one totally
+ordered stream", PAPER.md) is only worth what it survives. This module
+composes the supervised multi-process pipeline
+(`server.supervisor.ServiceSupervisor`) with seeded fault injection and
+asserts the farm converges **bit-identical to the no-fault GOLDEN
+digest with zero duplicate and zero skipped sequence numbers**.
+
+Fault classes (all seeded — a failing run reproduces from its seed):
+
+- ``kill``   — SIGKILL of each lambda role at randomized-but-seeded
+  points in the stream; the supervisor restarts it and exactly-once
+  recovery (fenced checkpoint + inOff output scan) must hold.
+- ``torn``   — partial, newline-less junk appended to the shared
+  topics under the append lock (a writer dying mid-write); consumers
+  must neither crash nor mis-parse, and the next append seals the
+  remnant.
+- ``lease``  — expired-lease takeover: the sequencer is SIGSTOPped
+  past its TTL, a usurper acquires its lease and binds the next fence,
+  and the deposed owner's post-takeover writes (and a forged
+  stale-fence write) are **demonstrably rejected** with `FencedError`.
+- ``net``    — duplicated + delayed delivery on the broadcast edge: a
+  flaky consumer re-delivers past records and defers others; the
+  client-side gap/dedup guard (drop `seq <= last`, ranged refetch
+  across a gap) must reconstruct the exact stream.
+- ``client`` — client disconnect mid-batch: the feeder loses its ack
+  and re-appends whole submission batches (at-least-once ingress);
+  deli's resubmission dedup must keep the total order identical.
+
+The GOLDEN digest is produced by running the SAME production role code
+(`DeliRole.process` / `ScribeRole.process`) in-process with no faults —
+not a parallel reimplementation — so golden and chaotic runs can only
+differ if a fault actually corrupted the pipeline.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import signal
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..server.queue import (
+    FencedCheckpointStore,
+    FencedError,
+    LeaseManager,
+    SharedFileTopic,
+)
+from ..server.supervisor import (
+    DeliRole,
+    ScribeRole,
+    ServiceSupervisor,
+    canonical_record,
+)
+
+FAULT_CLASSES = ("kill", "torn", "lease", "net", "client")
+
+
+@dataclass
+class ChaosConfig:
+    seed: int = 0
+    faults: Tuple[str, ...] = FAULT_CLASSES
+    n_docs: int = 2
+    n_clients: int = 3
+    ops_per_client: int = 40
+    ttl_s: float = 0.5
+    heartbeat_timeout_s: float = 3.0
+    batch: int = 16
+    kills_per_role: int = 1
+    timeout_s: float = 120.0
+    shared_dir: Optional[str] = None
+
+
+@dataclass
+class ChaosResult:
+    converged: bool
+    digest: str
+    golden_digest: str
+    client_digest: Optional[str]
+    scribe_ok: bool
+    duplicate_seqs: int
+    skipped_seqs: int
+    fence_rejections: int
+    restarts: Dict[str, int]
+    events: List[str] = field(default_factory=list)
+    detail: str = ""
+
+
+# ---------------------------------------------------------------------------
+# workload + golden
+# ---------------------------------------------------------------------------
+
+
+def build_workload(cfg: ChaosConfig) -> List[dict]:
+    """Deterministic ingress stream: per-doc joins, then a seeded
+    interleaving of each client's in-order op queue (per-client order
+    preserved — deli enforces clientSeq contiguity)."""
+    rng = random.Random(cfg.seed)
+    docs = [f"doc{d}" for d in range(cfg.n_docs)]
+    recs: List[dict] = []
+    queues: Dict[Tuple[str, int], List[dict]] = {}
+    for doc in docs:
+        for c in range(1, cfg.n_clients + 1):
+            recs.append({"kind": "join", "doc": doc, "client": c})
+            queues[(doc, c)] = [
+                {
+                    "kind": "op", "doc": doc, "client": c,
+                    "clientSeq": i + 1, "refSeq": 0,
+                    "contents": {"v": rng.randint(0, 999), "i": i},
+                }
+                for i in range(cfg.ops_per_client)
+            ]
+    keys = list(queues)
+    while keys:
+        k = rng.choice(keys)
+        recs.append(queues[k].pop(0))
+        if not queues[k]:
+            keys.remove(k)
+    return recs
+
+
+def golden_stream(workload: List[dict], scratch_dir: str) -> List[dict]:
+    """The no-fault sequenced stream, produced by the PRODUCTION deli
+    code path run in-process (not a reimplementation)."""
+    role = DeliRole(scratch_dir, owner="golden", ttl_s=3600.0)
+    out: List[dict] = []
+    for i, rec in enumerate(workload):
+        role.process(i, rec, out)
+    return [canonical_record(r) for r in out]
+
+
+def golden_scribe_digests(stream: List[dict],
+                          scratch_dir: str) -> Dict[str, str]:
+    """Per-doc rolling digests from the PRODUCTION scribe fold."""
+    role = ScribeRole(scratch_dir, owner="golden-scribe", ttl_s=3600.0)
+    for i, rec in enumerate(stream):
+        role.process(i, rec, [])
+    return {d: st["digest"] for d, st in role.docs.items()}
+
+
+def stream_digest(records: List[dict]) -> str:
+    """SHA-256 over the per-doc, seq-sorted canonical stream — the
+    bit-identity form two runs are compared in."""
+    per_doc: Dict[str, List[dict]] = {}
+    for r in records:
+        per_doc.setdefault(r["doc"], []).append(canonical_record(r))
+    for v in per_doc.values():
+        v.sort(key=lambda r: r["seq"])
+    payload = json.dumps(per_doc, sort_keys=True, ensure_ascii=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
+
+
+def sequence_integrity(records: List[dict]) -> Tuple[int, int]:
+    """(duplicate_seqs, skipped_seqs) across all docs: every doc's
+    sequence numbers must be exactly 1..N."""
+    dups = skips = 0
+    per_doc: Dict[str, List[int]] = {}
+    for r in records:
+        per_doc.setdefault(r["doc"], []).append(int(r["seq"]))
+    for seqs in per_doc.values():
+        dups += len(seqs) - len(set(seqs))
+        uniq = sorted(set(seqs))
+        # Seqs start at 1: a complete stream is exactly 1..max.
+        skips += (uniq[-1] - len(uniq)) if uniq else 0
+    return dups, skips
+
+
+# ---------------------------------------------------------------------------
+# fault injection pieces
+# ---------------------------------------------------------------------------
+
+TORN_FRAGMENT = b'{"torn": tru'  # can never parse; no trailing newline
+
+
+def inject_torn_append(path: str) -> None:
+    """Simulate a writer dying mid-append: raw partial line, no
+    newline, written under the same append lock real writers use."""
+    import fcntl
+
+    with open(path, "ab") as f:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+        try:
+            f.write(TORN_FRAGMENT)
+            f.flush()
+        finally:
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+
+def consume_with_net_faults(topic: SharedFileTopic, rng: random.Random,
+                            dup_rate: float = 0.1,
+                            delay_rate: float = 0.1) -> List[dict]:
+    """A flaky delivery edge over the broadcast feed: re-delivers past
+    records (duplication) and defers others (delay → a visible gap at
+    delivery time). The client applies the same guard the socket
+    driver uses: drop ``seq <= last``, and close a gap with a ranged
+    refetch from the feed (the ops_from(from, to) role)."""
+    entries, _ = topic.read_entries(0)
+    feed = [r for _, r in entries
+            if isinstance(r, dict) and r.get("kind") == "op"]
+    delivery: List[dict] = []
+    deferred: List[Tuple[int, dict]] = []
+    for i, rec in enumerate(feed):
+        # Release any deferred record whose time has come.
+        while deferred and deferred[0][0] <= i:
+            delivery.append(deferred.pop(0)[1])
+        r = rng.random()
+        if r < delay_rate:
+            deferred.append((i + rng.randint(2, 6), rec))
+            continue
+        delivery.append(rec)
+        if r < delay_rate + dup_rate and delivery:
+            delivery.append(rng.choice(delivery))  # re-delivery
+    delivery.extend(rec for _, rec in deferred)
+
+    by_key = {(r["doc"], int(r["seq"])): r for r in feed}
+    view: Dict[str, List[dict]] = {}
+    last: Dict[str, int] = {}
+    for rec in delivery:
+        doc, seq = rec["doc"], int(rec["seq"])
+        cur = last.get(doc, 0)
+        if seq <= cur:
+            continue  # duplicate delivery
+        if seq > cur + 1:
+            # Gap: ranged refetch [cur+1, seq-1] from the feed (the
+            # driver's ops_from(from_seq, to_seq) catch-up).
+            for missing in range(cur + 1, seq):
+                hit = by_key.get((doc, missing))
+                if hit is not None:
+                    view.setdefault(doc, []).append(hit)
+            last[doc] = seq - 1
+        view.setdefault(doc, []).append(rec)
+        last[doc] = seq
+    return [r for doc in sorted(view) for r in view[doc]]
+
+
+# ---------------------------------------------------------------------------
+# the run
+# ---------------------------------------------------------------------------
+
+
+def run_chaos(cfg: ChaosConfig) -> ChaosResult:
+    """Run the chaos suite. With no `cfg.shared_dir`, a throwaway temp
+    dir is used and removed on convergence (kept for post-mortem on
+    divergence, named in `detail`); pass `shared_dir` to keep it."""
+    shared = cfg.shared_dir or tempfile.mkdtemp(prefix="chaos-")
+    res = _run_chaos_in(cfg, shared)
+    if cfg.shared_dir is None:
+        if res.converged:
+            import shutil
+
+            shutil.rmtree(shared, ignore_errors=True)
+        else:
+            res.detail += f" [state kept for post-mortem: {shared}]"
+    return res
+
+
+def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
+    rng = random.Random(cfg.seed ^ 0x5EED)
+    workload = build_workload(cfg)
+    golden = golden_stream(workload, os.path.join(shared, "golden"))
+    gdigest = stream_digest(golden)
+    gscribe = golden_scribe_digests(golden, os.path.join(shared, "golden"))
+    expected = len(golden)
+
+    # Feed plan: seeded submission batches; with the `client` fault,
+    # some batches are re-appended later in full (a client that lost
+    # its ack mid-batch resubmits everything — at-least-once ingress).
+    chunks: List[List[dict]] = []
+    i = 0
+    while i < len(workload):
+        n = rng.randint(1, 12)
+        chunks.append(workload[i:i + n])
+        i += n
+    dup_after: Dict[int, int] = {}
+    if "client" in cfg.faults:
+        for idx in rng.sample(
+            range(len(chunks)), max(1, len(chunks) // 10)
+        ):
+            dup_after[idx] = idx + rng.randint(1, 5)
+
+    # Kill plan: each role killed `kills_per_role` times at seeded
+    # chunk indices.
+    kill_at: Dict[int, List[str]] = {}
+    if "kill" in cfg.faults:
+        for role in ("deli", "scriptorium", "scribe", "broadcaster"):
+            for _ in range(cfg.kills_per_role):
+                idx = rng.randint(len(chunks) // 5,
+                                  max(1, len(chunks) - 2))
+                kill_at.setdefault(idx, []).append(role)
+    torn_at = (
+        sorted(rng.sample(range(len(chunks)), min(3, len(chunks))))
+        if "torn" in cfg.faults else []
+    )
+    lease_at = (
+        rng.randint(len(chunks) // 3, max(1, 2 * len(chunks) // 3))
+        if "lease" in cfg.faults else None
+    )
+
+    sup = ServiceSupervisor(
+        shared, ttl_s=cfg.ttl_s,
+        heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
+    ).start()
+    raw = SharedFileTopic(os.path.join(shared, "topics", "rawdeltas.jsonl"))
+    deltas_path = os.path.join(shared, "topics", "deltas.jsonl")
+    durable = SharedFileTopic(os.path.join(shared, "topics", "durable.jsonl"))
+    broadcast = SharedFileTopic(
+        os.path.join(shared, "topics", "broadcast.jsonl")
+    )
+    fence_rejections = 0
+    events: List[str] = []
+    try:
+        fed_idx = 0
+        pending_dups: Dict[int, List[dict]] = {}
+        deadline = time.time() + cfg.timeout_s
+        while time.time() < deadline:
+            sup.poll_once()
+            if fed_idx < len(chunks):
+                raw.append_many(chunks[fed_idx])
+                if fed_idx in dup_after:
+                    pending_dups.setdefault(
+                        dup_after[fed_idx], []
+                    ).extend(chunks[fed_idx])
+                for rec in pending_dups.pop(fed_idx, []):
+                    raw.append(rec)  # the lost-ack resubmission
+                for role in kill_at.pop(fed_idx, []):
+                    proc = sup.procs.get(role)
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()
+                        events.append(f"chaos: SIGKILL {role}")
+                if torn_at and torn_at[0] == fed_idx:
+                    torn_at.pop(0)
+                    inject_torn_append(raw.path)
+                    inject_torn_append(deltas_path)
+                    events.append("chaos: torn append")
+                if lease_at == fed_idx:
+                    fence_rejections += _lease_takeover(
+                        shared, sup, cfg, events
+                    )
+                fed_idx += 1
+            # Drain any resubmissions scheduled past the last chunk.
+            if fed_idx >= len(chunks) and pending_dups:
+                for idx in sorted(pending_dups):
+                    for rec in pending_dups.pop(idx, []):
+                        raw.append(rec)
+            ops = [r for r in durable.read_from(0)
+                   if isinstance(r, dict) and r.get("kind") == "op"]
+            bops = [r for r in broadcast.read_from(0)
+                    if isinstance(r, dict) and r.get("kind") == "op"]
+            if (fed_idx >= len(chunks) and not pending_dups
+                    and len(ops) >= expected and len(bops) >= expected):
+                scr = FencedCheckpointStore(
+                    os.path.join(shared, "checkpoints")
+                ).load("scribe")
+                total = sum(
+                    int(st["count"]) for st in
+                    ((scr or {}).get("state", {}).get("state", {}) or {})
+                    .values()
+                )
+                if total >= expected:
+                    break
+            time.sleep(0.02)
+    finally:
+        sup.stop()
+
+    ops = [r for r in durable.read_from(0)
+           if isinstance(r, dict) and r.get("kind") == "op"]
+    digest = stream_digest(ops)
+    dups, skips = sequence_integrity(ops)
+    client_digest = None
+    if "net" in cfg.faults:
+        client_view = consume_with_net_faults(
+            broadcast, random.Random(cfg.seed ^ 0xDE1)
+        )
+        client_digest = stream_digest(client_view)
+    scr = FencedCheckpointStore(
+        os.path.join(shared, "checkpoints")
+    ).load("scribe")
+    live_scribe = {
+        d: st["digest"] for d, st in
+        ((scr or {}).get("state", {}).get("state", {}) or {}).items()
+    }
+    scribe_ok = live_scribe == gscribe
+    converged = (
+        digest == gdigest and dups == 0 and skips == 0 and scribe_ok
+        and (client_digest in (None, gdigest))
+        and ("lease" not in cfg.faults or fence_rejections > 0)
+    )
+    detail = (
+        f"ops={len(ops)}/{expected} restarts={sup.restarts} "
+        f"events={events + sup.events}"
+    )
+    return ChaosResult(
+        converged=converged, digest=digest, golden_digest=gdigest,
+        client_digest=client_digest, scribe_ok=scribe_ok,
+        duplicate_seqs=dups, skipped_seqs=skips,
+        fence_rejections=fence_rejections, restarts=dict(sup.restarts),
+        events=events + list(sup.events), detail=detail,
+    )
+
+
+def _lease_takeover(shared: str, sup: ServiceSupervisor,
+                    cfg: ChaosConfig, events: List[str]) -> int:
+    """The expired-lease fault: SIGSTOP the sequencer past its TTL, a
+    usurper takes its lease and binds the next fence on the write
+    paths, and the deposed owner's writes must be REJECTED. Returns
+    the number of demonstrated fence rejections.
+
+    The stopped zombie may be holding an append/checkpoint/claim flock
+    at the moment it is stopped; the usurper therefore uses BOUNDED
+    lock acquisition and, on timeout, has the zombie killed — exactly
+    what the supervisor's stale-heartbeat detection does in production
+    (kernel lock release on death then unblocks the successor)."""
+    rejections = 0
+    deli = sup.procs.get("deli")
+    if deli is None or deli.poll() is not None:
+        return 0
+    deltas = SharedFileTopic(os.path.join(shared, "topics", "deltas.jsonl"))
+    old_fence, old_owner = deltas.latest_fence()
+    os.kill(deli.pid, signal.SIGSTOP)
+    events.append("chaos: SIGSTOP deli (stale lease)")
+    zombie_alive = True
+
+    def kill_zombie(why: str) -> None:
+        nonlocal zombie_alive
+        if not zombie_alive:
+            return
+        try:
+            deli.kill()
+            deli.wait(timeout=10)
+        except OSError:
+            pass
+        zombie_alive = False
+        events.append(f"chaos: zombie deli killed ({why})")
+
+    try:
+        usurper = LeaseManager(
+            os.path.join(shared, "leases"), "chaos-usurper",
+            ttl_s=cfg.ttl_s, claim_ttl_s=max(0.25, cfg.ttl_s / 2),
+        )
+
+        def acquire(deadline_s: float) -> Optional[int]:
+            deadline = time.time() + deadline_s
+            while time.time() < deadline:
+                f = usurper.try_acquire("deli")
+                if f is not None:
+                    return f
+                time.sleep(cfg.ttl_s / 5)
+            return None
+
+        fence = acquire(6 * cfg.ttl_s)
+        if fence is None:
+            # The zombie was stopped inside its arbitration claim; its
+            # flock outlives SIGSTOP, so depose it the way the
+            # supervisor would.
+            kill_zombie("holding the lease claim")
+            fence = acquire(6 * cfg.ttl_s)
+        if fence is None:
+            return 0
+        events.append(f"chaos: usurper took deli lease (fence {fence})")
+        # Bind the new fence on the write paths (an empty fenced append
+        # gates without writing), exactly what a real successor's first
+        # batch does — bounded, in case the zombie holds the lock.
+        ckpt = FencedCheckpointStore(os.path.join(shared, "checkpoints"))
+        env = ckpt.load("deli")
+        try:
+            deltas.append_many([], fence=fence, owner="chaos-usurper",
+                               lock_timeout_s=2 * cfg.ttl_s)
+            if env is not None:
+                ckpt.save("deli", env["state"], fence=fence,
+                          owner="chaos-usurper",
+                          lock_timeout_s=2 * cfg.ttl_s)
+        except TimeoutError:
+            kill_zombie("holding a write lock")
+            deltas.append_many([], fence=fence, owner="chaos-usurper")
+            if env is not None:
+                ckpt.save("deli", env["state"], fence=fence,
+                          owner="chaos-usurper")
+        # The deposed owner's write attempts — the exact calls the
+        # stopped deli would make on resume — must be rejected.
+        if old_fence:
+            try:
+                deltas.append_many(
+                    [{"kind": "op", "doc": "zombie", "seq": -1}],
+                    fence=old_fence, owner=old_owner,
+                )
+            except FencedError:
+                rejections += 1
+                events.append("chaos: deposed topic write REJECTED")
+            if env is not None:
+                try:
+                    ckpt.save("deli", env["state"], fence=old_fence,
+                              owner=old_owner)
+                except FencedError:
+                    rejections += 1
+                    events.append("chaos: deposed checkpoint REJECTED")
+        usurper.release("deli")
+    finally:
+        if zombie_alive:
+            try:
+                os.kill(deli.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            events.append("chaos: SIGCONT deli")
+    return rejections

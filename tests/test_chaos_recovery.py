@@ -1,0 +1,284 @@
+"""Chaos harness + self-healing supervisor: fenced, exactly-once
+lambda recovery.
+
+The convergence claim (identical deterministic replay of one totally
+ordered stream) exercised OFF the happy path: the lambda pipeline runs
+as supervised child processes (`server.supervisor`), faults are
+injected at seeded points (`testing.chaos`), and the farm must
+converge bit-identical to the no-fault GOLDEN digest with zero
+duplicate and zero skipped sequence numbers — while a deposed lease
+holder's writes are demonstrably REJECTED by the fence.
+
+Quick single-fault runs stay in tier-1; the full five-fault suite is
+`slow` + `chaos` (tools/chaos_run.py is its CLI twin).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from fluidframework_tpu.server.queue import SharedFileTopic
+from fluidframework_tpu.server.supervisor import ServiceSupervisor
+from fluidframework_tpu.testing.chaos import (
+    ChaosConfig,
+    build_workload,
+    golden_stream,
+    run_chaos,
+    sequence_integrity,
+    stream_digest,
+)
+
+
+def _assert_converged(res):
+    assert res.duplicate_seqs == 0, res.detail
+    assert res.skipped_seqs == 0, res.detail
+    assert res.digest == res.golden_digest, res.detail
+    assert res.scribe_ok, res.detail
+    assert res.converged, res.detail
+
+
+def test_supervised_farm_no_fault_matches_golden(tmp_path):
+    """The multi-process farm with NO faults reproduces the in-proc
+    golden stream bit-identically — the baseline every fault class is
+    measured against."""
+    res = run_chaos(ChaosConfig(
+        seed=11, faults=(), n_docs=1, n_clients=2, ops_per_client=15,
+        timeout_s=60, shared_dir=str(tmp_path),
+    ))
+    _assert_converged(res)
+    assert res.restarts == {
+        "deli": 0, "scriptorium": 0, "scribe": 0, "broadcaster": 0
+    }
+
+
+def test_chaos_kill_every_role_exactly_once(tmp_path):
+    """SIGKILL of each lambda role at seeded points: the supervisor
+    restarts it, recovery replays deterministically from the fenced
+    checkpoint, and the stream carries no duplicate or skipped seq."""
+    res = run_chaos(ChaosConfig(
+        seed=1, faults=("kill",), n_docs=1, n_clients=2,
+        ops_per_client=25, timeout_s=90, shared_dir=str(tmp_path),
+    ))
+    _assert_converged(res)
+    assert sum(res.restarts.values()) >= 4  # every role died once
+
+
+def test_chaos_lease_takeover_rejects_deposed_writer(tmp_path):
+    """Expired-lease takeover: the sequencer is stalled past its TTL,
+    a usurper binds the next fence, and the deposed owner's topic AND
+    checkpoint writes are rejected — convergence must still hold."""
+    res = run_chaos(ChaosConfig(
+        seed=2, faults=("lease",), n_docs=1, n_clients=2,
+        ops_per_client=20, timeout_s=90, shared_dir=str(tmp_path),
+    ))
+    _assert_converged(res)
+    assert res.fence_rejections >= 2  # topic + checkpoint both rejected
+
+
+def test_chaos_torn_appends_and_resubmit_dedup(tmp_path):
+    """Torn topic appends plus client mid-batch resubmissions: readers
+    skip sealed junk without crashing and deli dedups duplicates, so
+    the total order is byte-for-byte the no-fault one."""
+    res = run_chaos(ChaosConfig(
+        seed=4, faults=("torn", "client"), n_docs=1, n_clients=2,
+        ops_per_client=20, timeout_s=90, shared_dir=str(tmp_path),
+    ))
+    _assert_converged(res)
+
+
+def test_chaos_net_duplicated_delayed_delivery(tmp_path):
+    """Duplicated/delayed delivery on the broadcast edge: the client
+    gap/dedup guard reconstructs the exact stream."""
+    res = run_chaos(ChaosConfig(
+        seed=6, faults=("net",), n_docs=1, n_clients=2,
+        ops_per_client=20, timeout_s=60, shared_dir=str(tmp_path),
+    ))
+    _assert_converged(res)
+    assert res.client_digest == res.golden_digest
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_full_chaos_suite_converges(seed, tmp_path):
+    """The acceptance gate: all five fault classes composed (SIGKILL of
+    every role, torn appends, expired-lease takeover with fence
+    rejection, duplicated/delayed delivery, client disconnect
+    mid-batch) at full workload size, per seed."""
+    res = run_chaos(ChaosConfig(
+        seed=seed, timeout_s=180, shared_dir=str(tmp_path),
+    ))
+    _assert_converged(res)
+    assert res.fence_rejections > 0
+    assert res.client_digest == res.golden_digest
+    assert sum(res.restarts.values()) >= 4
+
+
+def test_workload_and_golden_deterministic(tmp_path):
+    """Same seed → byte-identical workload and golden digest; a
+    different seed diverges (the suite is genuinely seeded)."""
+    cfg = ChaosConfig(seed=9, n_docs=2, n_clients=2, ops_per_client=10)
+    w1 = build_workload(cfg)
+    w2 = build_workload(ChaosConfig(
+        seed=9, n_docs=2, n_clients=2, ops_per_client=10
+    ))
+    assert w1 == w2
+    g1 = golden_stream(w1, str(tmp_path / "a"))
+    g2 = golden_stream(w2, str(tmp_path / "b"))
+    assert stream_digest(g1) == stream_digest(g2)
+    w3 = build_workload(ChaosConfig(
+        seed=10, n_docs=2, n_clients=2, ops_per_client=10
+    ))
+    assert w3 != w1
+    assert sequence_integrity(g1) == (0, 0)
+
+
+def test_client_farm_survives_server_sigkill_live_reconnect(tmp_path):
+    """Client-side chaos composed with a REAL process kill: containers
+    stay live through `kill -9` of the ordering service. The
+    FaultInjectionDriver wraps the socket driver (the test-service-load
+    composition), the jittered ConnectionManager rides the restart on
+    the same port, pending ops made while the service was DOWN
+    resubmit exactly once, and the replicas converge."""
+    import signal
+    import subprocess
+    import sys
+
+    from fluidframework_tpu.dds import MapFactory, StringFactory
+    from fluidframework_tpu.drivers import FaultInjectionDriver
+    from fluidframework_tpu.drivers.socket_driver import SocketDriver
+    from fluidframework_tpu.loader import ConnectionManager, Loader
+    from fluidframework_tpu.runtime import ChannelRegistry
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    storage = str(tmp_path / "srv")
+
+    def spawn(port=0):
+        proc = subprocess.Popen(
+            [sys.executable,
+             os.path.join(repo, "tools", "socket_server_main.py"),
+             str(port), "--storage-dir", storage, "--allow-anonymous"],
+            stdout=subprocess.PIPE, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=repo,
+        )
+        line = proc.stdout.readline().strip()
+        assert line.startswith("LISTENING"), line
+        _, host, p = line.split()
+        return proc, host, int(p)
+
+    proc, host, port = spawn()
+    proc2 = None
+    registry = ChannelRegistry([MapFactory(), StringFactory()])
+    try:
+        driver = FaultInjectionDriver(SocketDriver(host, port))
+        loader = Loader(driver, registry)
+        c1 = loader.create_detached()
+        c1.runtime.create_datastore("default").create_channel(
+            "s", StringFactory.type_name
+        )
+        doc = c1.attach()
+        cm = ConnectionManager(
+            c1, max_attempts=12, base_delay=0.05, max_delay=0.5,
+            jitter=0.2, seed=13,
+        )
+        s1 = c1.runtime.get_datastore("default").get_channel("s")
+        s1.insert_text(0, "before")
+        c1.flush()
+        time.sleep(0.4)  # let the durable journal absorb the op
+
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        s1.insert_text(0, "down:")  # pending while the service is dead
+        proc2, _, _ = spawn(port)  # same port: clients reconnect blind
+
+        deadline = time.time() + 20
+        while not c1.connected and time.time() < deadline:
+            time.sleep(0.05)
+        assert c1.connected, f"reconnect failed (delays={cm.delays})"
+        assert cm.delays, "the ladder must actually have backed off"
+        c1.flush()
+        time.sleep(0.4)
+
+        c2 = Loader(SocketDriver(host, port), registry).resolve(doc)
+        s2 = c2.runtime.get_datastore("default").get_channel("s")
+        assert s2.get_text() == "down:before"
+        assert s1.get_text() == "down:before"
+        assert not c1.runtime.is_dirty
+    finally:
+        for p in (proc, proc2):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+def test_supervisor_restarts_stalled_child(tmp_path):
+    """A live-but-wedged child (stale heartbeat) is killed and
+    restarted — the second failure-detection signal next to process
+    exit."""
+    import signal
+
+    sup = ServiceSupervisor(
+        str(tmp_path), roles=("scribe",), ttl_s=0.4,
+        heartbeat_timeout_s=1.0,
+    ).start()
+    try:
+        proc = sup.procs["scribe"]
+        deadline = time.time() + 5
+        while sup._heartbeat_age("scribe") > 0.5 and time.time() < deadline:
+            time.sleep(0.05)
+        os.kill(proc.pid, signal.SIGSTOP)
+        deadline = time.time() + 15
+        while not sup.poll_once() and time.time() < deadline:
+            time.sleep(0.1)
+        assert sup.restarts["scribe"] == 1
+        assert any("stale-heartbeat" in e for e in sup.events)
+        assert sup.procs["scribe"].pid != proc.pid
+    finally:
+        sup.stop()
+
+
+def test_supervised_farm_processes_after_restart(tmp_path):
+    """End-to-end continuity: kill the sequencer AFTER it has
+    checkpointed some work, feed more, and the restarted child resumes
+    from the checkpoint (no reset, no gap, no dup)."""
+    shared = str(tmp_path)
+    sup = ServiceSupervisor(shared, ttl_s=0.4, batch=8).start()
+    raw = SharedFileTopic(os.path.join(shared, "topics", "rawdeltas.jsonl"))
+    durable = SharedFileTopic(os.path.join(shared, "topics", "durable.jsonl"))
+
+    def wait_ops(n, timeout=30):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            sup.poll_once()
+            ops = [r for r in durable.read_from(0)
+                   if isinstance(r, dict) and r.get("kind") == "op"]
+            if len(ops) >= n:
+                return ops
+            time.sleep(0.05)
+        raise AssertionError(
+            f"timed out waiting for {n} durable ops: {sup.events}"
+        )
+
+    try:
+        raw.append_many(
+            [{"kind": "join", "doc": "d", "client": 1}]
+            + [{"kind": "op", "doc": "d", "client": 1,
+                "clientSeq": i + 1, "refSeq": 0, "contents": i}
+               for i in range(10)]
+        )
+        wait_ops(11)
+        sup.procs["deli"].kill()
+        raw.append_many(
+            [{"kind": "op", "doc": "d", "client": 1,
+              "clientSeq": i + 1, "refSeq": 0, "contents": i}
+             for i in range(10, 20)]
+        )
+        ops = wait_ops(21)
+        seqs = sorted(r["seq"] for r in ops)
+        assert seqs == list(range(1, 22)), seqs
+        assert sup.restarts["deli"] >= 1
+    finally:
+        sup.stop()

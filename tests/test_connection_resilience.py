@@ -136,6 +136,109 @@ def test_connection_manager_backoff_ladder():
     assert chan(c2).get_text() == "x"
 
 
+def test_backoff_jitter_capped_seeded_deterministic():
+    """Satellite: the reconnect ladder with jitter is (a) still capped
+    at max_delay, (b) actually jittered away from the bare exponential,
+    and (c) bit-reproducible given a seed — chaos runs replay."""
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    c1.attach()
+
+    def ladder(jitter, seed):
+        cm = ConnectionManager(
+            c1, base_delay=0.05, max_delay=1.0,
+            sleep=lambda _: None, jitter=jitter, seed=seed,
+        )
+        cm.enabled = False  # schedule probing only
+        return [cm.delay_for(i) for i in range(10)]
+
+    bare = ladder(0.0, 0)
+    assert bare == [min(0.05 * 2 ** i, 1.0) for i in range(10)]
+    j1 = ladder(0.25, 42)
+    j2 = ladder(0.25, 42)
+    j3 = ladder(0.25, 43)
+    assert j1 == j2, "same seed must reproduce the exact schedule"
+    assert j1 != j3, "different seeds must diverge"
+    assert j1 != bare, "jitter must actually perturb the ladder"
+    assert all(d <= 1.0 for d in j1), "cap must bind AFTER jitter"
+    assert all(
+        abs(d - b) <= 0.25 * b + 1e-12 for d, b in zip(j1, bare)
+    ), "jitter bounded by ±jitter·delay"
+
+
+def test_jittered_reconnect_ladder_still_reconnects():
+    """The jittered ladder drives a real reconnect to completion and
+    records the schedule it used."""
+    loader, fdriver, server = make_fault_stack()
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    slept = []
+    cm = ConnectionManager(
+        c1, base_delay=0.01, max_delay=0.04,
+        sleep=slept.append, jitter=0.2, seed=7,
+    )
+    chan(c1).insert_text(0, "x")
+    fdriver.connects_fail_remaining = 3
+    fdriver.disconnect_all()
+    assert c1.connected
+    assert len(slept) == 3 and slept == cm.delays
+    assert all(d <= 0.04 for d in slept)
+    c1.flush()
+    assert chan(loader.resolve(doc)).get_text() == "x"
+
+
+def test_midbatch_disconnect_resubmission_deduped_exactly_once():
+    """Satellite: a batch that DID reach the server but whose acks were
+    lost to a mid-batch disconnect must not be double-sequenced — the
+    reconnect catch-up acks the pending ops under the old identity, so
+    nothing is resubmitted and the server-side op log carries each op
+    exactly once."""
+    from fluidframework_tpu.drivers import FaultInjectionDriver, LocalDriver
+    from fluidframework_tpu.loader import Loader
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.server import LocalServer
+
+    server = LocalServer(deferred=True)
+    fdriver = FaultInjectionDriver(LocalDriver(server))
+    loader = Loader(fdriver, REGISTRY)
+    c1 = seed_container(loader)
+    doc = c1.attach()
+    server.process_all()
+
+    baseline = sum(
+        1 for m in server.scriptorium.store.get(doc, [])
+        if m.type == MessageType.OP
+    )
+    # The batch reaches the server; the connection dies BEFORE the
+    # pump runs, so no acks ever come back (the lost-ack window).
+    chan(c1).insert_text(0, "abc")
+    chan(c1, "m").set("k", 1)
+    c1.flush()
+    fdriver.disconnect_all()
+    server.process_all()  # sequenced under the old identity
+
+    assert c1.runtime.is_dirty  # client still believes ops are unacked
+    c1.connect()  # catch-up acks them; nothing resubmits
+    server.process_all()
+    c1.flush()
+    server.process_all()
+
+    ops = [
+        m for m in server.scriptorium.store.get(doc, [])
+        if m.type == MessageType.OP
+    ]
+    assert len(ops) == baseline + 2, (
+        f"expected exactly-once sequencing, got {len(ops) - baseline} "
+        f"copies of the batch"
+    )
+    assert not c1.runtime.is_dirty
+    c2 = loader.resolve(doc)
+    assert chan(c2).get_text() == "abc"
+    assert chan(c2, "m").get("k") == 1
+    seqs = [m.sequence_number for m in server.scriptorium.store[doc]]
+    assert len(seqs) == len(set(seqs))
+
+
 def test_connection_manager_gives_up_and_reports():
     loader, fdriver, server = make_fault_stack()
     c1 = seed_container(loader)

@@ -21,6 +21,8 @@ import time
 import pytest
 
 from fluidframework_tpu.server.queue import (
+    FencedCheckpointStore,
+    FencedError,
     LeaseManager,
     SharedFileConsumer,
     SharedFileProducer,
@@ -79,17 +81,184 @@ def _read_sequenced(shared, n_parts):
 
 
 def test_lease_manager_basics(tmp_path):
+    # Logical clock throughout: the expiry semantics are tested
+    # without wall-clock sleeps, so a loaded machine cannot expire a
+    # "live" lease mid-assertion.
+    t0 = 1000.0
     a = LeaseManager(str(tmp_path), "A", ttl_s=0.3)
     b = LeaseManager(str(tmp_path), "B", ttl_s=0.3)
+    fa = a.try_acquire("p0", now=t0)
+    assert fa == 1
+    assert b.try_acquire("p0", now=t0 + 0.1) is None  # live foreign lease
+    assert a.renew("p0", now=t0 + 0.2)
+    fb = b.try_acquire("p0", now=t0 + 0.6)  # expired: takeover
+    assert fb == 2  # fencing token advanced on takeover
+    assert not a.renew("p0", now=t0 + 0.7)  # deposed
+    assert b.owner_of("p0", now=t0 + 0.7) == "B"
+
+
+def _race_acquire(shared, name, barrier, q):
+    lm = LeaseManager(shared, name, ttl_s=10.0)
+    barrier.wait()
+    q.put((name, lm.try_acquire("p0")))
+
+
+def test_expired_lease_race_single_winner(tmp_path):
+    """The ADVICE.md medium race, closed: N workers racing for the
+    SAME expired lease at the same instant — exactly one may win, and
+    the fence must advance past the dead owner's (the old read-back
+    arbitration let two winners share one fence)."""
+    import multiprocessing as mp
+
+    shared = str(tmp_path)
+    dead = LeaseManager(shared, "dead", ttl_s=0.01)
+    assert dead.try_acquire("p0") == 1
+    time.sleep(0.05)  # expire
+
+    q = mp.Queue()
+    barrier = mp.Barrier(6)
+    procs = [
+        mp.Process(target=_race_acquire,
+                   args=(shared, f"w{i}", barrier, q))
+        for i in range(6)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join(timeout=30)
+    results = [q.get(timeout=10) for _ in procs]
+    winners = [(n, f) for n, f in results if f is not None]
+    assert len(winners) == 1, f"multiple lease winners: {winners}"
+    assert winners[0][1] == 2  # fence strictly advanced, exactly once
+
+
+def test_deposed_owner_checkpoint_write_rejected(tmp_path):
+    """Two workers across a takeover: the successor's fence binds the
+    checkpoint store, and the deposed owner's write RAISES — the
+    exactly-once guarantee no longer rests on consumer-side dedup."""
+    a = LeaseManager(str(tmp_path), "A", ttl_s=0.05)
+    b = LeaseManager(str(tmp_path), "B", ttl_s=10.0)
     fa = a.try_acquire("p0")
     assert fa == 1
-    assert b.try_acquire("p0") is None  # live foreign lease
-    assert a.renew("p0")
-    time.sleep(0.4)  # expire
+    time.sleep(0.1)  # A's lease expires (A crashed / stalled)
     fb = b.try_acquire("p0")
-    assert fb == 2  # fencing token advanced on takeover
-    assert not a.renew("p0")  # deposed
-    assert b.owner_of("p0") == "B"
+    assert fb == 2
+
+    ckpt = FencedCheckpointStore(str(tmp_path))
+    ckpt.save("p0", {"offset": 7}, fence=fb, owner="B")
+    # The deposed owner wakes up and tries to roll the state back.
+    with pytest.raises(FencedError):
+        ckpt.save("p0", {"offset": 3}, fence=fa, owner="A")
+    assert ckpt.load("p0")["state"] == {"offset": 7}
+
+    # The topic write path rejects the zombie too — including the
+    # pathological equal-fence case (fence binds to its first owner).
+    topic = SharedFileTopic(os.path.join(str(tmp_path), "t.jsonl"))
+    topic.append({"x": 1}, fence=fb, owner="B")
+    with pytest.raises(FencedError):
+        topic.append({"x": 2}, fence=fa, owner="A")
+    with pytest.raises(FencedError):
+        topic.append({"x": 3}, fence=fb, owner="A")
+    assert topic.read_from(0) == [{"x": 1}]
+
+
+def test_fence_monotonic_across_lease_file_loss(tmp_path):
+    """The monotonic counter survives lease-file deletion: a takeover
+    after the lease file vanished still advances the fence (no token
+    reuse)."""
+    a = LeaseManager(str(tmp_path), "A", ttl_s=0.05)
+    assert a.try_acquire("p0") == 1
+    os.remove(os.path.join(str(tmp_path), "p0.lease"))
+    b = LeaseManager(str(tmp_path), "B", ttl_s=0.05)
+    assert b.try_acquire("p0") == 2
+
+
+def test_torn_line_never_crashes_concurrent_reader(tmp_path):
+    """Satellite: a consumer polling concurrently with an in-progress
+    append must never crash and never mis-parse. A writer thread
+    appends; the main thread polls throughout; torn fragments injected
+    between appends are sealed by the next append and skipped."""
+    import threading
+
+    path = os.path.join(str(tmp_path), "t.jsonl")
+    topic = SharedFileTopic(path)
+    N = 300
+    stop = threading.Event()
+
+    def writer():
+        import fcntl
+
+        for i in range(N):
+            if i % 50 == 25:
+                # A crashed writer's torn remnant (no newline), under
+                # the same lock real writers take.
+                with open(path, "ab") as f:
+                    fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+                    f.write(b'{"torn": ')
+                    fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+            topic.append({"i": i})
+        stop.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    seen = []
+    consumer = SharedFileConsumer(topic)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        seen.extend(consumer.poll())  # must never raise
+        if stop.is_set() and len(seen) >= N:
+            break
+    t.join(timeout=10)
+    seen.extend(consumer.poll())
+    assert [m["i"] for m in seen] == list(range(N))
+
+
+def test_append_lock_timeout_instead_of_wedging(tmp_path):
+    """A stalled (e.g. SIGSTOPped) writer holding the append lock must
+    not wedge a bounded caller forever: `lock_timeout_s` raises
+    TimeoutError so a takeover successor can have the zombie killed
+    (the supervisor's stale-heartbeat role) and retry."""
+    import fcntl
+    import threading
+
+    topic = SharedFileTopic(os.path.join(str(tmp_path), "t.jsonl"))
+    held = threading.Event()
+    release = threading.Event()
+
+    def stalled_writer():
+        with open(topic.path, "r+b") as f:
+            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+            held.set()
+            release.wait(10)
+            fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+    t = threading.Thread(target=stalled_writer)
+    t.start()
+    assert held.wait(10)
+    try:
+        with pytest.raises(TimeoutError):
+            topic.append_many([{"x": 1}], fence=1, owner="B",
+                              lock_timeout_s=0.2)
+    finally:
+        release.set()
+        t.join(timeout=10)
+    topic.append_many([{"x": 1}], fence=1, owner="B")  # lock free again
+    assert topic.read_from(0) == [{"x": 1}]
+
+
+def test_torn_final_line_reread_complete_next_poll(tmp_path):
+    """A final line lacking its newline is NOT consumed; once the
+    writer finishes it, the next poll reads it complete."""
+    topic = SharedFileTopic(os.path.join(str(tmp_path), "t.jsonl"))
+    topic.append({"i": 0})
+    consumer = SharedFileConsumer(topic)
+    with open(topic.path, "ab") as f:
+        f.write(b'{"i": 1')  # append in progress
+    assert consumer.poll() == [{"i": 0}]
+    assert consumer.poll() == []  # torn tail invisible
+    with open(topic.path, "ab") as f:
+        f.write(b'}\n')  # the writer completes
+    assert consumer.poll() == [{"i": 1}]
 
 
 def test_two_workers_split_and_failover(tmp_path):

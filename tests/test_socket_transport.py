@@ -392,3 +392,119 @@ def test_tpu_client_token_provider_over_secure_server(secure_server):
     bare = TpuClient(SocketDriver(host, port))
     with pytest.raises(RuntimeError, match="missing tenant credentials"):
         bare.get_container(doc, schema)
+
+
+def test_socket_connection_gap_refetch_and_dup_drop(server_process):
+    """The live-stream continuity guard on the delta connection: a
+    duplicated push is dropped, and a push that jumps past a hole is
+    preceded by a ranged refetch (ops_from(from, to) over the same
+    socket) so the listener always sees a contiguous stream."""
+    from fluidframework_tpu.drivers.file_driver import message_to_json
+
+    host, port = server_process
+    loader, c1 = make_container(host, port)
+    chan(c1).insert_text(0, "base")
+    doc = c1.attach()
+    c1.flush()
+
+    drv = SocketDriver(host, port)
+    conn = drv.connect(doc)
+    got = []
+    conn.listener = got.append
+    for ch_ in "xyz":
+        chan(c1).insert_text(0, ch_)
+        c1.flush()
+    assert wait_until(lambda: len(got) >= 3)
+    delivered = [m.sequence_number for m in got]
+    assert delivered == sorted(delivered)
+    base_seq = conn.last_seq
+
+    # Duplicated delivery: re-pushing the last op must be dropped.
+    dup_wire = message_to_json(got[-1])
+    before = len(got)
+    conn._deliver(dup_wire, got.append)
+    assert len(got) == before and conn.dup_drops >= 1
+
+    # Delayed/lost frames: roll the guard back to simulate pushes the
+    # edge never delivered, then push the HEAD op — the guard must
+    # refetch the hole from the server before delivering it.
+    hole_from = delivered[0] - 1  # everything after the first live op
+    conn.last_seq = hole_from
+    conn.gap_refetches = 0
+    head_wire = message_to_json(got[-1])
+    replay = []
+    conn._deliver(head_wire, replay.append)
+    assert conn.gap_refetches == 1
+    seqs = [m.sequence_number for m in replay]
+    assert seqs == list(range(hole_from + 1, base_seq + 1)), seqs
+    conn.disconnect()
+
+
+def test_cached_driver_token_provider_over_secure_server(
+    secure_server, tmp_path
+):
+    """Satellite (ADVICE.md low): a CachedDriver-wrapped SocketDriver
+    must DELEGATE token_provider assignment to the wrapped driver —
+    before the fix the assignment landed on the wrapper and every
+    request went out unauthenticated against a secure server. E2E:
+    create + reload through the cache tier with per-document
+    credentials, and verify the provider reached the inner driver."""
+    from fluidframework_tpu.dds import MapFactory
+    from fluidframework_tpu.drivers.web_cache import CachedDriver
+    from fluidframework_tpu.framework.fluid_static import (
+        ContainerSchema,
+        InsecureTokenProvider,
+        TpuClient,
+    )
+
+    host, port = secure_server
+    schema = ContainerSchema({"kv": MapFactory.type_name})
+    provider = InsecureTokenProvider(TENANT, KEY)
+
+    cached = CachedDriver(SocketDriver(host, port), str(tmp_path))
+    client = TpuClient(cached, token_provider=provider)
+    # The provider must live on the INNER driver, not the wrapper.
+    assert cached.inner.token_provider is provider
+    assert "token_provider" not in vars(cached)
+    c = client.create_container(schema)
+    c.initial_objects["kv"].set("who", "cached+authorized")
+    doc = c.attach()
+    c.flush()
+    time.sleep(0.3)
+
+    # Second boot through a fresh cache-wrapped driver: summary load is
+    # authenticated, then cached; the cached reload still works.
+    cached2 = CachedDriver(SocketDriver(host, port), str(tmp_path))
+    c2 = TpuClient(cached2, token_provider=provider).get_container(
+        doc, schema
+    )
+    assert c2.initial_objects["kv"].get("who") == "cached+authorized"
+    assert cached2.misses >= 1  # first load: authenticated fetch, cached
+
+    # Third boot from the same cache dir: snapshot load is a local hit
+    # (no service summary fetch), yet the live connection still
+    # authenticates per document through the delegated provider.
+    cached3 = CachedDriver(SocketDriver(host, port), str(tmp_path))
+    c3 = TpuClient(cached3, token_provider=provider).get_container(
+        doc, schema
+    )
+    assert c3.initial_objects["kv"].get("who") == "cached+authorized"
+    assert cached3.hits >= 1
+
+    # A cache-wrapped driver WITHOUT credentials is still refused —
+    # the wrapper must not mask the auth failure either.
+    bare = TpuClient(CachedDriver(SocketDriver(host, port),
+                                  str(tmp_path / "bare")))
+    with pytest.raises(RuntimeError, match="missing tenant credentials"):
+        bare.create_container(schema).attach()
+
+    # The fault-injection wrapper delegates the seam the same way — a
+    # doubly-wrapped Cached(FaultInjection(Socket)) stack still lands
+    # the provider on the innermost driver.
+    from fluidframework_tpu.drivers import FaultInjectionDriver
+
+    fi = FaultInjectionDriver(SocketDriver(host, port))
+    stack = CachedDriver(fi, str(tmp_path / "stacked"))
+    TpuClient(stack, token_provider=provider)
+    assert fi.inner.token_provider is provider
+    assert "token_provider" not in vars(fi)

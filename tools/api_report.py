@@ -43,6 +43,7 @@ PACKAGES = [
     "fluidframework_tpu.drivers",
     "fluidframework_tpu.server",
     "fluidframework_tpu.server.riddler",
+    "fluidframework_tpu.server.supervisor",
     "fluidframework_tpu.framework",
     "fluidframework_tpu.parallel",
     "fluidframework_tpu.protocol",

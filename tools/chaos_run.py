@@ -1,0 +1,83 @@
+"""Reproducible chaos-suite entry point.
+
+Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
+        [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
+
+Builds the seeded workload, computes the no-fault GOLDEN digest with
+the production deli/scribe code in-process, launches the supervised
+multi-process lambda farm (`server.supervisor.ServiceSupervisor`),
+injects the selected fault classes at seeded points, and reports
+whether the farm converged bit-identical to golden with zero duplicate
+and zero skipped sequence numbers. Exit code 0 iff converged — the CI
+gate form of tests/test_chaos_recovery.py.
+
+`--keep DIR` runs in DIR and leaves the topics/checkpoints/lease files
+behind for post-mortem (default: a throwaway temp dir).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.testing.chaos import (  # noqa: E402
+    FAULT_CLASSES,
+    ChaosConfig,
+    run_chaos,
+)
+
+
+def main() -> int:
+    args = list(sys.argv[1:])
+
+    def _take(flag: str, default):
+        if flag in args:
+            i = args.index(flag)
+            val = args[i + 1]
+            del args[i:i + 2]
+            return val
+        return default
+
+    seed = int(_take("--seed", "0"))
+    faults = tuple(
+        f for f in _take("--faults", ",".join(FAULT_CLASSES)).split(",") if f
+    )
+    cfg = ChaosConfig(
+        seed=seed,
+        faults=faults,
+        n_docs=int(_take("--docs", "2")),
+        n_clients=int(_take("--clients", "3")),
+        ops_per_client=int(_take("--ops", "40")),
+        timeout_s=float(_take("--timeout", "120")),
+        shared_dir=_take("--keep", None),
+    )
+    unknown = set(faults) - set(FAULT_CLASSES)
+    if unknown or args:
+        print(
+            f"unknown faults {sorted(unknown)} / leftover args {args}; "
+            f"faults are chosen from {','.join(FAULT_CLASSES)}",
+            file=sys.stderr,
+        )
+        return 2
+    print(f"chaos run: seed={seed} faults={','.join(faults)} "
+          f"docs={cfg.n_docs} clients={cfg.n_clients} "
+          f"ops/client={cfg.ops_per_client}", flush=True)
+    res = run_chaos(cfg)
+    print(f"golden digest : {res.golden_digest}")
+    print(f"farm digest   : {res.digest}")
+    if res.client_digest is not None:
+        print(f"client digest : {res.client_digest}  (flaky delivery edge)")
+    print(f"scribe fold   : {'match' if res.scribe_ok else 'MISMATCH'}")
+    print(f"dup seqs={res.duplicate_seqs} skipped seqs={res.skipped_seqs} "
+          f"fence rejections={res.fence_rejections}")
+    print(f"restarts: {res.restarts}")
+    for e in res.events:
+        print(f"  {e}")
+    print("CONVERGED" if res.converged else f"DIVERGED ({res.detail})")
+    return 0 if res.converged else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
