@@ -37,6 +37,13 @@ SUB_OP = 0  # ordinary client message (op/noop/...): validate + stamp
 SUB_JOIN = 1  # client join: admit into the MSN set, stamp a join message
 SUB_LEAVE = 2  # client leave: evict, stamp a leave message
 SUB_PAD = 3  # padding: no effect, no stamp
+SUB_SYSTEM = 4  # server-originated control: stamp unconditionally,
+#                 bypassing client validation (deli's system-message
+#                 path — summary ack/nack from scribe)
+
+# Boxcar group sentinel (the `groups` batch column): submissions with
+# group >= 0 belong to an atomic boxcar; -1 means standalone.
+NO_GROUP = -1
 
 # Nack codes (0 = accepted). Values match server/sequencer.py.
 ACCEPT = 0
@@ -75,6 +82,11 @@ class SeqResult(NamedTuple):
     seq: jnp.ndarray  # int32: assigned sequence number (0 if not stamped)
     min_seq: jnp.ndarray  # int32: MSN as of this submission
     nack: jnp.ndarray  # int32: ACCEPT or NACK_* code
+    # bool: submission was masked out with no stamp AND no nack — the
+    # tail of an aborted boxcar (scalar `_handle` breaks out of the
+    # batch after a nack) or a deduped resubmission (DeliRole's
+    # at-least-once ingress dedup drops it silently).
+    skipped: jnp.ndarray
 
 
 def make_state(n_docs: int, max_clients: int) -> SequencerState:
@@ -87,22 +99,59 @@ def make_state(n_docs: int, max_clients: int) -> SequencerState:
     )
 
 
-def _step_one_doc(state: SequencerState, kind, client, client_seq, ref_seq):
+def grow_state(state: SequencerState, n_docs: int = None,
+               n_clients: int = None) -> SequencerState:
+    """Zero-pad the packed state to [n_docs, n_clients] (dynamic
+    doc-slot / client-slot growth; new rows are empty documents)."""
+    d, c = state.connected.shape
+    nd = d if n_docs is None else max(d, n_docs)
+    nc = c if n_clients is None else max(c, n_clients)
+    if (nd, nc) == (d, c):
+        return state
+    pad1 = ((0, nd - d),)
+    pad2 = ((0, nd - d), (0, nc - c))
+    return SequencerState(
+        seq=jnp.pad(state.seq, pad1),
+        min_seq=jnp.pad(state.min_seq, pad1),
+        connected=jnp.pad(state.connected, pad2),
+        ref_seq=jnp.pad(state.ref_seq, pad2),
+        client_seq=jnp.pad(state.client_seq, pad2),
+    )
+
+
+def _step_one_doc(state: SequencerState, aborted, kind, client, client_seq,
+                  ref_seq, group, *, dedup: bool = False):
     """Process one submission for one document (vmapped over docs).
 
     All fields here are per-document scalars / [C] rows; straight-line
     masked code (no control flow) mirroring DocumentSequencer.sequence
-    and deli ticket() (lambda.ts:818).
+    and deli ticket() (lambda.ts:818). `aborted` is the batch-local
+    boxcar-abort tracker: the group id whose remaining submissions are
+    masked out (a nack aborts the REST of its boxcar, the `_handle`
+    break semantics).
     """
     n_clients = state.connected.shape[0]
     slot = jnp.clip(client, 0, n_clients - 1)
     onehot = jnp.arange(n_clients, dtype=jnp.int32) == slot
 
-    is_op = kind == SUB_OP
     is_join = kind == SUB_JOIN
     is_leave = kind == SUB_LEAVE
+    is_sys = kind == SUB_SYSTEM
 
     known = state.connected[slot]
+    in_box = group >= 0
+    box_dead = in_box & (group == aborted)
+    if dedup:
+        # Resubmission dedup (DeliRole's idempotent-producer role): a
+        # clientSeq at or below the last accepted one is dropped
+        # silently — checked BEFORE the nack ladder, so a stale
+        # resubmission never pollutes the stream with spurious nacks.
+        dup = (kind == SUB_OP) & known & (client_seq <= state.client_seq[slot])
+    else:
+        dup = jnp.zeros((), jnp.bool_)
+    skipped = box_dead | dup
+    is_op = (kind == SUB_OP) & ~skipped
+
     # Validation ladder (first failing rule wins), reference order in
     # DocumentSequencer.sequence: unknown -> stale -> future -> gap.
     nack = jnp.where(
@@ -124,22 +173,25 @@ def _step_one_doc(state: SequencerState, kind, client, client_seq, ref_seq):
     ).astype(jnp.int32)
 
     ok_op = is_op & (nack == ACCEPT)
+    live = ~box_dead
+    do_join = is_join & live
     # leave of an unknown client stamps nothing (oracle returns None).
-    ok_leave = is_leave & known
-    stamped = ok_op | is_join | ok_leave
+    ok_leave = is_leave & known & live
+    do_sys = is_sys & live
+    stamped = ok_op | do_join | ok_leave | do_sys
 
     new_seq = state.seq + stamped.astype(jnp.int32)
 
-    # Client-table updates.
+    # Client-table updates (system stamps bypass the table entirely).
     connected = jnp.where(
-        onehot & is_join, True, jnp.where(onehot & ok_leave, False, state.connected)
+        onehot & do_join, True, jnp.where(onehot & ok_leave, False, state.connected)
     )
     # join admits at ref_seq = head seq *before* its own stamp
     # (oracle join(): ref_seq=self.seq then _stamp increments).
-    new_ref = jnp.where(is_join, state.seq, ref_seq)
-    ref_row = jnp.where(onehot & (ok_op | is_join), new_ref, state.ref_seq)
+    new_ref = jnp.where(do_join, state.seq, ref_seq)
+    ref_row = jnp.where(onehot & (ok_op | do_join), new_ref, state.ref_seq)
     cseq_row = jnp.where(
-        onehot & is_join,
+        onehot & do_join,
         0,
         jnp.where(onehot & ok_op, client_seq, state.client_seq),
     )
@@ -152,10 +204,15 @@ def _step_one_doc(state: SequencerState, kind, client, client_seq, ref_seq):
     candidate = jnp.where(any_conn, jnp.min(masked), new_seq)
     new_min = jnp.where(stamped, jnp.maximum(state.min_seq, candidate), state.min_seq)
 
+    # A nack aborts the rest of its boxcar (nack is only ever nonzero
+    # for live ops, so this can't retrigger inside a dead group).
+    new_aborted = jnp.where(in_box & (nack != ACCEPT), group, aborted)
+
     out = SeqResult(
         seq=jnp.where(stamped, new_seq, 0).astype(jnp.int32),
         min_seq=new_min.astype(jnp.int32),
         nack=nack,
+        skipped=skipped,
     )
     return (
         SequencerState(
@@ -165,31 +222,78 @@ def _step_one_doc(state: SequencerState, kind, client, client_seq, ref_seq):
             ref_seq=ref_row.astype(jnp.int32),
             client_seq=cseq_row.astype(jnp.int32),
         ),
+        new_aborted.astype(jnp.int32),
         out,
     )
 
 
-def sequence_batch(state: SequencerState, batch: SeqBatch):
-    """Sequence a [D, B] submission batch: scan over B, vmap over D.
+def _sequence_batch_impl(state: SequencerState, aborted, batch: SeqBatch,
+                         groups, dedup: bool):
+    step = jax.vmap(functools.partial(_step_one_doc, dedup=dedup))
 
-    Returns (new_state, SeqResult[D, B])."""
-    step = jax.vmap(_step_one_doc)
-
-    def body(st, col):
-        kind, client, client_seq, ref_seq = col
-        return step(st, kind, client, client_seq, ref_seq)
+    def body(carry, col):
+        st, ab = carry
+        kind, client, client_seq, ref_seq, group = col
+        st2, ab2, out = step(st, ab, kind, client, client_seq,
+                             ref_seq, group)
+        return (st2, ab2), out
 
     cols = (
         jnp.swapaxes(batch.kind, 0, 1),
         jnp.swapaxes(batch.client, 0, 1),
         jnp.swapaxes(batch.client_seq, 0, 1),
         jnp.swapaxes(batch.ref_seq, 0, 1),
+        jnp.swapaxes(groups, 0, 1),
     )
-    new_state, out = lax.scan(body, state, cols)
+    (new_state, new_aborted), out = lax.scan(body, (state, aborted), cols)
     # out fields are [B, D] -> [D, B]
-    return new_state, SeqResult(*(jnp.swapaxes(a, 0, 1) for a in out))
+    return new_state, new_aborted, SeqResult(
+        *(jnp.swapaxes(a, 0, 1) for a in out)
+    )
+
+
+def no_aborts(n_docs: int):
+    """A fresh boxcar-abort tracker ([D], no group aborted)."""
+    return jnp.full((n_docs,), -2, jnp.int32)
+
+
+def sequence_batch(state: SequencerState, batch: SeqBatch, groups=None,
+                   dedup: bool = False):
+    """Sequence a [D, B] submission batch: scan over B, vmap over D.
+
+    `groups` (int32[D, B], optional) assigns submissions to atomic
+    boxcars: a nack masks out the rest of that group (NO_GROUP = -1 =
+    standalone). `dedup` enables silent resubmission dedup (the
+    at-least-once-ingress DeliRole semantics).
+
+    Returns (new_state, SeqResult[D, B])."""
+    if groups is None:
+        groups = jnp.full(batch.kind.shape, NO_GROUP, jnp.int32)
+    new_state, _, out = _sequence_batch_impl(
+        state, no_aborts(state.seq.shape[0]), batch, groups, dedup
+    )
+    return new_state, out
 
 
 @functools.partial(jax.jit, donate_argnums=0)
 def sequence_batch_jit(state: SequencerState, batch: SeqBatch):
     return sequence_batch(state, batch)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=4)
+def _sequence_batch_grouped_jit(state, aborted, batch, groups, dedup):
+    return _sequence_batch_impl(state, aborted, batch, groups, dedup)
+
+
+def sequence_batch_grouped(state: SequencerState, batch: SeqBatch, groups,
+                           dedup: bool = False, aborted=None):
+    """Jitted entry for the live deli pipeline: boxcar groups + optional
+    resubmission dedup. `aborted` (from `no_aborts` or a previous
+    chunk's return) threads the abort tracker across the chunks of one
+    pump, so boxcars MAY span chunk boundaries (group ids must be
+    unique per doc per pump). Donates (consumes) the input state and
+    tracker; returns (new_state, new_aborted, SeqResult)."""
+    if aborted is None:
+        aborted = no_aborts(state.seq.shape[0])
+    return _sequence_batch_grouped_jit(state, aborted, batch, groups,
+                                       bool(dedup))

@@ -27,6 +27,16 @@ from .queue import (
     partition_of,
 )
 from .supervisor import ServiceSupervisor
+
+
+def __getattr__(name):
+    # Lazy: the kernel deli pulls in jax; scalar-only users (e.g. the
+    # supervised farm's non-deli children) must not pay that import.
+    if name in ("KernelDeliLambda", "KernelDeliRole"):
+        from . import deli_kernel
+
+        return getattr(deli_kernel, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from .log import LogConsumer, LogTopic, MessageLog
 from .lambdas import (
     BroadcasterLambda,
@@ -49,6 +59,8 @@ __all__ = [
     "BroadcasterLambda",
     "ContentAddressedStore",
     "DeliLambda",
+    "KernelDeliLambda",
+    "KernelDeliRole",
     "DocumentSequencer",
     "LocalOrderingService",
     "LocalServer",

@@ -55,11 +55,20 @@ MAX_OP_BYTES = 768 * 1024  # alfred's op-size nack threshold
 
 
 class DeliLambda:
-    """Sequences the rawdeltas stream into the deltas stream."""
+    """Sequences the rawdeltas stream into the deltas stream.
 
-    def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None):
+    Scalar reference implementation; the device-batched drop-in is
+    `deli_kernel.KernelDeliLambda` (LocalServer `deli_impl="kernel"` /
+    env ``FLUID_DELI=kernel``), for which this class is the oracle and
+    fallback. Output is buffered per pump and flushed with ONE
+    `append_many` (one journal write) instead of a locked/flushed
+    append per record."""
+
+    def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None,
+                 max_pump: int = 8192):
         self.log = log
         self.sequencers: Dict[str, DocumentSequencer] = {}
+        self.max_pump = max_pump
         offset = 0
         if checkpoint:
             offset = checkpoint["offset"]
@@ -73,23 +82,29 @@ class DeliLambda:
             self.sequencers[doc_id] = DocumentSequencer(doc_id)
         return self.sequencers[doc_id]
 
-    def pump(self) -> int:
-        n = 0
-        for raw in self.consumer.poll():
-            self._handle(raw)
-            n += 1
-        return n
+    def pump(self, max_count: Optional[int] = None) -> int:
+        """Drain up to `max_count` raw records (micro-batch cap: a deep
+        backlog yields to the caller between pumps — supervisor
+        heartbeats stay live, process_all loops until quiescent)."""
+        cap = self.max_pump if max_count is None else max_count
+        raws = self.consumer.poll(cap)
+        out: List[dict] = []
+        for raw in raws:
+            self._handle(raw, out)
+        if out:
+            self.deltas.append_many(out)
+        return len(raws)
 
-    def _handle(self, raw: dict) -> None:
+    def _handle(self, raw: dict, out: List[dict]) -> None:
         doc = self._doc(raw["doc"])
         kind = raw["kind"]
         if kind == "join":
             msg = doc.join(raw["client"])
-            self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+            out.append({"doc": raw["doc"], "kind": "op", "msg": msg})
         elif kind == "leave":
             msg = doc.leave(raw["client"])
             if msg is not None:
-                self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+                out.append({"doc": raw["doc"], "kind": "op", "msg": msg})
         elif kind == "control":
             # Server-side control (summary ack/nack from scribe): stamp
             # bypassing client validation (deli's system-message path).
@@ -100,7 +115,7 @@ class DeliLambda:
                 type_=raw["type"],
                 contents=raw["contents"],
             )
-            self.deltas.append({"doc": raw["doc"], "kind": "op", "msg": msg})
+            out.append({"doc": raw["doc"], "kind": "op", "msg": msg})
         elif kind == "boxcar":
             # Boxcarred submission (services-core pendingBoxcar.ts):
             # one log record carrying several client ops, ticketed
@@ -109,20 +124,20 @@ class DeliLambda:
             # "atomic" batch would both break batch atomicity for
             # receivers and desync the sender's pending FIFO.
             for msg in raw["msgs"]:
-                if not self._ticket(raw["doc"], doc, raw["client"], msg):
+                if not self._ticket(raw["doc"], doc, raw["client"], msg, out):
                     break
         else:  # client op
-            self._ticket(raw["doc"], doc, raw["client"], raw["msg"])
+            self._ticket(raw["doc"], doc, raw["client"], raw["msg"], out)
 
     def _ticket(self, doc_id: str, doc: DocumentSequencer, client: int,
-                msg: DocumentMessage) -> bool:
-        out = doc.sequence(client, msg)
-        if isinstance(out, NackMessage):
-            self.deltas.append(
-                {"doc": doc_id, "kind": "nack", "client": client, "msg": out}
+                msg: DocumentMessage, out: List[dict]) -> bool:
+        res = doc.sequence(client, msg)
+        if isinstance(res, NackMessage):
+            out.append(
+                {"doc": doc_id, "kind": "nack", "client": client, "msg": res}
             )
             return False
-        self.deltas.append({"doc": doc_id, "kind": "op", "msg": out})
+        out.append({"doc": doc_id, "kind": "op", "msg": res})
         return True
 
     def checkpoint(self) -> dict:
@@ -160,9 +175,9 @@ class ScriptoriumLambda:
         if entry["kind"] == "op":
             self.store.setdefault(entry["doc"], []).append(entry["msg"])
 
-    def pump(self) -> int:
+    def pump(self, max_count: Optional[int] = None) -> int:
         n = 0
-        for entry in self.consumer.poll():
+        for entry in self.consumer.poll(max_count):
             self._apply(entry)
             n += 1
         return n
@@ -197,7 +212,7 @@ class BroadcasterLambda:
         if socket in self.rooms.get(doc_id, []):
             self.rooms[doc_id].remove(socket)
 
-    def pump(self) -> int:
+    def pump(self, max_count: Optional[int] = None) -> int:
         n = 0
         failed = []
         pending: Dict[str, List[Any]] = {}
@@ -212,7 +227,7 @@ class BroadcasterLambda:
                     doc, sock, "deliver_batch", (msgs, memo), failed
                 )
 
-        for entry in self.consumer.poll():
+        for entry in self.consumer.poll(max_count):
             doc = entry["doc"]
             if entry["kind"] == "op":
                 # Batch per doc per pump (broadcaster/lambda.ts:49's
@@ -303,9 +318,10 @@ class ScribeLambda:
             self.protocol[doc_id] = ProtocolOpHandler()
         return self.protocol[doc_id]
 
-    def pump(self) -> int:
+    def pump(self, max_count: Optional[int] = None) -> int:
         n = 0
-        for entry in self.consumer.poll():
+        controls: List[dict] = []
+        for entry in self.consumer.poll(max_count):
             if entry["kind"] != "op":
                 n += 1
                 continue
@@ -314,17 +330,22 @@ class ScribeLambda:
             handler = self._doc(doc_id)
             handler.process_message(msg)
             if msg.type == MessageType.SUMMARIZE:
-                self._handle_summarize(doc_id, msg)
+                self._handle_summarize(doc_id, msg, controls)
             n += 1
+        if controls:
+            # One flush per pump for the ack/nack control records
+            # (same per-pump batching as the deli output path).
+            self.rawdeltas.append_many(controls)
         return n
 
-    def _handle_summarize(self, doc_id: str, msg: SequencedMessage) -> None:
+    def _handle_summarize(self, doc_id: str, msg: SequencedMessage,
+                          controls: List[dict]) -> None:
         """Validate the client summary and ack/nack it through deli
         (scribe/lambda.ts:252-266)."""
         handle = (msg.contents or {}).get("handle")
         if handle and self.storage.contains(handle):
             self.storage.set_ref(doc_id, handle)
-            self.rawdeltas.append(
+            controls.append(
                 {
                     "doc": doc_id,
                     "kind": "control",
@@ -336,7 +357,7 @@ class ScribeLambda:
                 }
             )
         else:
-            self.rawdeltas.append(
+            controls.append(
                 {
                     "doc": doc_id,
                     "kind": "control",
@@ -474,6 +495,7 @@ class LocalServer:
         log: Optional[MessageLog] = None,
         persist_dir: Optional[str] = None,
         historian_budget: Optional[int] = None,
+        deli_impl: Optional[str] = None,
     ):
         """Restart contract: pass the previous instance's `log` (the
         durable substrate, as Kafka retains topics across lambda
@@ -484,7 +506,13 @@ class LocalServer:
         (the gitrest+Kafka durability, SURVEY.md §2.5): blob store and
         topic journals live on disk there, lambda checkpoints write to
         <dir>/checkpoints.json after every pump, and a fresh
-        LocalServer(persist_dir=same) resumes the documents."""
+        LocalServer(persist_dir=same) resumes the documents.
+
+        `deli_impl` picks the sequencer: "scalar" (default) or
+        "kernel" (the vmap'd batch sequencer,
+        `deli_kernel.KernelDeliLambda`); env ``FLUID_DELI`` sets the
+        default. Checkpoints are interchangeable across impls, so a
+        restart may switch."""
         self.persist_dir = persist_dir
         if persist_dir is not None:
             import os
@@ -517,7 +545,23 @@ class LocalServer:
                     self.storage, blob_budget_bytes=historian_budget
                 )
         cp = checkpoints or {}
-        self.deli = DeliLambda(self.log, cp.get("deli"))
+        import os as _os
+
+        self.deli_impl = deli_impl or _os.environ.get("FLUID_DELI", "scalar")
+        from .supervisor import DELI_IMPLS
+
+        if self.deli_impl not in DELI_IMPLS:
+            # Loud, like the supervisor: a typo'd impl silently running
+            # the scalar path would invalidate benches/chaos runs.
+            raise ValueError(
+                f"deli_impl {self.deli_impl!r} not in {DELI_IMPLS}"
+            )
+        if self.deli_impl == "kernel":
+            from .deli_kernel import KernelDeliLambda
+
+            self.deli = KernelDeliLambda(self.log, cp.get("deli"))
+        else:
+            self.deli = DeliLambda(self.log, cp.get("deli"))
         self.scriptorium = ScriptoriumLambda(self.log, cp.get("scriptorium"))
         self.broadcaster = BroadcasterLambda(self.log)
         if cp:

@@ -119,17 +119,31 @@ class LogTopic:
 
     def append(self, message: Any) -> int:
         """Append; returns the message's offset."""
+        return self.append_many([message])
+
+    def append_many(self, messages: List[Any]) -> int:
+        """Append a batch: ONE journal write + flush for the whole
+        batch instead of one per record (the lambdas' per-pump output
+        flush — the per-record encode/write/flush was the scalar
+        pipeline's hidden hot path). Returns the first offset."""
         off = len(self._messages)
-        self._messages.append(message)
+        if not messages:
+            return off
+        self._messages.extend(messages)
         if self._path is not None:
             import json
 
             if self._file is None:
                 self._file = open(self._path, "a")
-            self._file.write(json.dumps(_encode_entry(message)) + "\n")
+            self._file.write(
+                "".join(
+                    json.dumps(_encode_entry(m)) + "\n" for m in messages
+                )
+            )
             self._file.flush()
-        for fn in list(self._subscribers):
-            fn(off, message)
+        for i, m in enumerate(messages):
+            for fn in list(self._subscribers):
+                fn(off + i, m)
         return off
 
     def sync(self) -> None:

@@ -214,15 +214,21 @@ class SharedFileTopic:
 
     # ------------------------------------------------------------- read
 
-    def read_entries(self, offset: int) -> Tuple[List[Tuple[int, Any]],
-                                                 int]:
+    def read_entries(self, offset: int,
+                     max_count: Optional[int] = None
+                     ) -> Tuple[List[Tuple[int, Any]], int]:
         """Parse lines from line-index `offset`. Returns
         ``([(line_index, value), ...], next_offset)``.
 
         A final line without a trailing newline is NOT consumed (it is
         an append in progress — complete on the next poll); a complete
         line that fails to parse (sealed torn remnant) is skipped but
-        still counted, so offsets stay stable across all readers."""
+        still counted, so offsets stay stable across all readers.
+
+        `max_count` caps the PARSED entries taken (micro-batch bound:
+        consumers yield between batches instead of swallowing a whole
+        backlog); next_offset then resumes right after the last entry
+        taken, with skipped junk lines staying counted."""
         with open(self.path, "rb") as f:
             data = f.read()
         if not data:
@@ -234,6 +240,8 @@ class SharedFileTopic:
         lines.pop()
         out: List[Tuple[int, Any]] = []
         for i in range(offset, len(lines)):
+            if max_count is not None and len(out) >= max_count:
+                return out, (out[-1][0] + 1 if out else offset)
             line = lines[i].strip()
             if not line:
                 continue
@@ -245,6 +253,79 @@ class SharedFileTopic:
 
     def read_from(self, offset: int) -> List[Any]:
         return [v for _, v in self.read_entries(offset)[0]]
+
+
+class TailReader:
+    """Incremental reader over a `SharedFileTopic`: remembers the byte
+    position of the last fully-consumed line, so each poll reads only
+    NEW bytes instead of re-reading (and re-splitting) the whole file —
+    `read_entries` is O(file) per call, which makes a long-lived
+    consumer O(file²) over its lifetime; the lambda roles and the
+    pipeline bench tail topics through this instead.
+
+    Same robustness contract as `read_entries`: a final line without
+    its trailing newline is not consumed (byte position stays before
+    it), junk lines are skipped but still counted, and line indices
+    (`next_line`) stay identical to `read_entries` offsets — so
+    checkpointed line offsets and `inOff` bookkeeping are unchanged."""
+
+    def __init__(self, topic: SharedFileTopic, line_offset: int = 0):
+        self.topic = topic
+        self.next_line = line_offset
+        self._pos = 0
+        # Lines the caller's offset is AHEAD of the file (a checkpoint
+        # taken against a longer topic): consumed silently as they
+        # appear, never delivered — matching read_entries(offset),
+        # which returns nothing below the requested offset.
+        self._behind = 0
+        if line_offset > 0:
+            # One O(file) skip to translate the line offset into a byte
+            # position; everything after is incremental.
+            with open(topic.path, "rb") as f:
+                data = f.read()
+            lines = data.split(b"\n")
+            lines.pop()
+            take = min(line_offset, len(lines))
+            self._pos = sum(len(l) + 1 for l in lines[:take])
+            self._behind = line_offset - take
+
+    def poll(self, max_count: Optional[int] = None
+             ) -> List[Tuple[int, Any]]:
+        """Parse up to `max_count` new complete entries; returns
+        [(line_index, value), ...] and advances past them."""
+        with open(self.topic.path, "rb") as f:
+            f.seek(self._pos)
+            data = f.read()
+        if not data:
+            return []
+        end = data.rfind(b"\n")
+        if end < 0:
+            return []  # torn tail only: re-read complete next poll
+        lines = data[:end].split(b"\n")
+        out: List[Tuple[int, Any]] = []
+        pos = self._pos
+        line_no = self.next_line
+        loads = json.loads
+        for raw in lines:
+            if self._behind:
+                # Below the requested offset: swallow without delivery
+                # (next_line already accounts for these lines).
+                self._behind -= 1
+                pos += len(raw) + 1
+                continue
+            if max_count is not None and len(out) >= max_count:
+                break
+            pos += len(raw) + 1
+            line = raw.strip()
+            if line:
+                try:
+                    out.append((line_no, loads(line)))
+                except ValueError:
+                    pass  # sealed junk from a crashed writer
+            line_no += 1
+        self._pos = pos
+        self.next_line = line_no
+        return out
 
 
 class SharedFileProducer:
@@ -262,13 +343,9 @@ class SharedFileConsumer:
         self.offset = offset
 
     def poll(self, max_count: Optional[int] = None) -> List[Any]:
-        entries, next_offset = self.topic.read_entries(self.offset)
-        if max_count is not None and len(entries) > max_count:
-            entries = entries[:max_count]
-            # Resume right after the last entry taken (skipped junk
-            # lines between entries stay counted); max_count=0 takes
-            # nothing and leaves the offset alone.
-            next_offset = entries[-1][0] + 1 if entries else self.offset
+        # The cap threads into the read itself (micro-batch bound);
+        # max_count=0 takes nothing and leaves the offset alone.
+        entries, next_offset = self.topic.read_entries(self.offset, max_count)
         self.offset = next_offset
         return [v for _, v in entries]
 

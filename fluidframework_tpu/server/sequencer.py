@@ -32,6 +32,23 @@ NACK_OUT_OF_ORDER = 422
 NACK_FUTURE_REFSEQ = 416
 
 
+# Nack reason wording, shared with the batched kernel deli
+# (server/deli_kernel.py) so both impls emit identical text where the
+# kernel's host mirror has the inputs (codes are the wire contract;
+# reasons are for humans and logs).
+
+def stale_refseq_reason(ref_seq: int, min_seq: int) -> str:
+    return f"refSeq {ref_seq} below MSN {min_seq}"
+
+
+def future_refseq_reason(ref_seq: int, head_seq: int) -> str:
+    return f"refSeq {ref_seq} ahead of head {head_seq}"
+
+
+def out_of_order_reason(client_seq: int, expected: int) -> str:
+    return f"clientSeq {client_seq}, expected {expected}"
+
+
 @dataclass
 class _ClientState:
     ref_seq: int
@@ -103,7 +120,7 @@ class DocumentSequencer:
                 client_id,
                 msg.client_seq,
                 NACK_STALE_REFSEQ,
-                f"refSeq {msg.ref_seq} below MSN {self.min_seq}",
+                stale_refseq_reason(msg.ref_seq, self.min_seq),
             )
         if msg.ref_seq > self.seq:
             # A refSeq ahead of the head would drive the MSN above the
@@ -114,14 +131,14 @@ class DocumentSequencer:
                 client_id,
                 msg.client_seq,
                 NACK_FUTURE_REFSEQ,
-                f"refSeq {msg.ref_seq} ahead of head {self.seq}",
+                future_refseq_reason(msg.ref_seq, self.seq),
             )
         if msg.client_seq != state.client_seq + 1:
             return NackMessage(
                 client_id,
                 msg.client_seq,
                 NACK_OUT_OF_ORDER,
-                f"clientSeq {msg.client_seq}, expected {state.client_seq + 1}",
+                out_of_order_reason(msg.client_seq, state.client_seq + 1),
             )
         state.client_seq = msg.client_seq
         if msg.ref_seq != state.ref_seq:

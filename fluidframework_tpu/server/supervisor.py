@@ -56,17 +56,20 @@ from .queue import (
     FencedError,
     LeaseManager,
     SharedFileTopic,
+    TailReader,
 )
 from .sequencer import DocumentSequencer
 
 __all__ = [
     "BroadcasterRole",
+    "DELI_IMPLS",
     "DeliRole",
     "ROLES",
     "ScribeRole",
     "ScriptoriumRole",
     "ServiceSupervisor",
     "canonical_record",
+    "resolve_role_class",
     "serve_role",
 ]
 
@@ -125,6 +128,7 @@ class _Role:
         )
         self.fence: Optional[int] = None
         self.offset = 0
+        self._reader: Optional[TailReader] = None
         self._last_renew = 0.0
         self._hb_path = os.path.join(shared_dir, "hb", f"{self.name}.json")
         os.makedirs(os.path.dirname(self._hb_path), exist_ok=True)
@@ -140,6 +144,10 @@ class _Role:
     def process(self, line_idx: int, rec: Any,
                 out: List[dict]) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
+
+    def flush_batch(self, out: List[dict]) -> None:
+        """End-of-batch hook: batching roles (the kernel deli) buffer
+        in `process` and emit here; scalar roles emit per record."""
 
     # -------------------------------------------------------- lifecycle
 
@@ -187,7 +195,9 @@ class _Role:
             self.process(line_idx, rec, sink)  # silent: already durable
         else:
             next_off = max(self.offset, max_done + 1, next_off)
+        self.flush_batch(sink)  # batching roles rebuild state here
         self.offset = next_off
+        self._reader = None  # re-anchor the tail at the new offset
         # The replayed records MUST match what is already on disk —
         # that is the determinism claim this service rests on.
         # (Checked cheaply: counts; the chaos harness checks digests.)
@@ -218,17 +228,24 @@ class _Role:
                 print(f"DEPOSED {self.name} {self.owner}", flush=True)
                 raise SystemExit(EXIT_DEPOSED)
             self._last_renew = now
-        entries, next_off = self.in_topic.read_entries(self.offset)
-        if len(entries) > self.batch:
-            entries = entries[:self.batch]
-            next_off = entries[-1][0] + 1
+        # Micro-batch cap (threaded into the read): a deep input
+        # backlog yields between steps, so lease renewal + heartbeat
+        # stay live no matter how far behind the role is. The tail is
+        # read incrementally (TailReader) — re-reading the whole topic
+        # per step is O(topic²) over a role's lifetime.
+        if self._reader is None or self._reader.next_line != self.offset:
+            self._reader = TailReader(self.in_topic, self.offset)
+        entries = self._reader.poll(self.batch)
+        next_off = self._reader.next_line
         if not entries:
+            self.offset = next_off  # junk-only progress still counts
             self.heartbeat()
             time.sleep(idle_sleep)
             return 0
         out: List[dict] = []
         for line_idx, rec in entries:
             self.process(line_idx, rec, out)
+        self.flush_batch(out)
         try:
             if self.out_topic is not None:
                 # Append THEN checkpoint; the recovery scan makes the
@@ -404,11 +421,27 @@ ROLE_CLASSES = {
     for cls in (DeliRole, ScriptoriumRole, ScribeRole, BroadcasterRole)
 }
 
+DELI_IMPLS = ("scalar", "kernel")
+
+
+def resolve_role_class(role: str, deli_impl: str = "scalar"):
+    """Role name -> class; `deli_impl="kernel"` swaps the sequencer for
+    the device-batched `deli_kernel.KernelDeliRole` (imported lazily so
+    scalar farms never pay the jax import)."""
+    if role == "deli" and deli_impl == "kernel":
+        from .deli_kernel import KernelDeliRole
+
+        return KernelDeliRole
+    return ROLE_CLASSES[role]
+
 
 def serve_role(shared_dir: str, role: str, owner: str,
-               ttl_s: float = 1.0, batch: int = 512) -> None:
+               ttl_s: float = 1.0, batch: int = 512,
+               deli_impl: str = "scalar") -> None:
     """Child-process entry: run one role until killed/deposed/fenced."""
-    r = ROLE_CLASSES[role](shared_dir, owner, ttl_s=ttl_s, batch=batch)
+    r = resolve_role_class(role, deli_impl)(
+        shared_dir, owner, ttl_s=ttl_s, batch=batch
+    )
     print(f"READY {role} {owner}", flush=True)
     while True:
         try:
@@ -439,12 +472,18 @@ class ServiceSupervisor:
     def __init__(self, shared_dir: str, roles: Tuple[str, ...] = ROLES,
                  ttl_s: float = 0.75, heartbeat_timeout_s: float = 2.0,
                  batch: int = 512, python: Optional[str] = None,
-                 spawn_ready_timeout_s: float = 30.0):
+                 spawn_ready_timeout_s: float = 30.0,
+                 deli_impl: Optional[str] = None):
         self.shared_dir = shared_dir
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.batch = batch
+        self.deli_impl = deli_impl or os.environ.get("FLUID_DELI", "scalar")
+        if self.deli_impl not in DELI_IMPLS:
+            raise ValueError(
+                f"deli_impl {self.deli_impl!r} not in {DELI_IMPLS}"
+            )
         self.python = python or sys.executable
         self.spawn_ready_timeout_s = spawn_ready_timeout_s
         self.procs: Dict[str, subprocess.Popen] = {}
@@ -481,7 +520,8 @@ class ServiceSupervisor:
                  "main()",
                  "--role", role, "--dir", self.shared_dir,
                  "--owner", owner, "--ttl", str(self.ttl_s),
-                 "--batch", str(self.batch)],
+                 "--batch", str(self.batch),
+                 "--impl", self.deli_impl],
                 stdout=subprocess.PIPE, text=True,
                 cwd=self._repo_root(),
                 env=dict(os.environ, JAX_PLATFORMS="cpu"),
@@ -627,15 +667,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     owner = _take("--owner") or f"{role}-pid{os.getpid()}"
     ttl = float(_take("--ttl", "1.0"))
     batch = int(_take("--batch", "512"))
-    if role not in ROLE_CLASSES or shared_dir is None:
+    impl = _take("--impl") or os.environ.get("FLUID_DELI", "scalar")
+    if (role not in ROLE_CLASSES or shared_dir is None
+            or impl not in DELI_IMPLS):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster} --dir D "
-            "[--owner O] [--ttl S] [--batch N]",
+            "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel]",
             file=sys.stderr,
         )
         raise SystemExit(2)
-    serve_role(shared_dir, role, owner, ttl_s=ttl, batch=batch)
+    serve_role(shared_dir, role, owner, ttl_s=ttl, batch=batch,
+               deli_impl=impl)
 
 
 if __name__ == "__main__":

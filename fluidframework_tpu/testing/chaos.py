@@ -75,6 +75,11 @@ class ChaosConfig:
     kills_per_role: int = 1
     timeout_s: float = 120.0
     shared_dir: Optional[str] = None
+    # Sequencer implementation under test: "scalar" or "kernel" (the
+    # batched deli, server.deli_kernel). Golden always comes from the
+    # scalar production path, so a kernel run converging proves the
+    # batched pipeline bit-identical under faults.
+    deli_impl: str = "scalar"
 
 
 @dataclass
@@ -305,6 +310,7 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     sup = ServiceSupervisor(
         shared, ttl_s=cfg.ttl_s,
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
+        deli_impl=cfg.deli_impl,
     ).start()
     raw = SharedFileTopic(os.path.join(shared, "topics", "rawdeltas.jsonl"))
     deltas_path = os.path.join(shared, "topics", "deltas.jsonl")

@@ -1,0 +1,203 @@
+"""End-to-end deli pipeline bench: raw topic in → stamped deltas out.
+
+Measures the LIVE ordering pipeline (BASELINE config 5's 10k docs x 64
+clients shape), not the naked kernel: records are read from a durable
+`SharedFileTopic` raw topic (JSON parse included), ticketed, and the
+stamped/nacked records written to a durable deltas topic — the exact
+datapath the supervised farm's deli role runs (`server.supervisor`),
+minus lease upkeep and checkpoint cadence (policy, not datapath).
+
+Three variants over the identical pre-built workload:
+
+- ``kernel``        — `deli_kernel.KernelDeliRole`: columnar pack →
+  vmap'd device kernel → one `append_many` per pump.
+- ``scalar``        — `supervisor.DeliRole` with the per-pump
+  `append_many` flush (this PR's batched-scalar fix).
+- ``scalar_seed``   — `supervisor.DeliRole` with the seed pipeline's
+  per-record `SharedFileTopic.append` (one lock + fsync per record).
+  This is the baseline `vs_baseline` is computed against; since one
+  fsync per record makes full-workload runs take hours by design, it
+  is measured on a bounded prefix of the same stream
+  (`seed_records`), processed identically.
+
+A correctness gate runs first: kernel and batched-scalar deltas topics
+must carry bit-identical stamps, nack codes, and MSNs (reason text
+exempt) before any number is reported.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+def build_pipeline_workload(n_docs: int, n_clients: int,
+                            ops_per_client: int, seed: int = 5) -> List[dict]:
+    """Deterministic raw-topic stream, round-robin across docs (every
+    pump carries many documents — the data-parallel axis the kernel
+    batches over). Each client's join rides immediately before its
+    first op, so ANY prefix of the stream carries the same join:op mix
+    as the whole — the bounded seed-baseline measurement then rates
+    the same workload shape the full runs do."""
+    import random
+
+    rng = random.Random(seed)
+    recs: List[dict] = []
+    for i in range(ops_per_client):
+        for c in range(1, n_clients + 1):
+            for d in range(n_docs):
+                doc = f"doc{d}"
+                if i == 0:
+                    recs.append({"kind": "join", "doc": doc, "client": c})
+                recs.append({
+                    "kind": "op", "doc": doc, "client": c,
+                    "clientSeq": i + 1, "refSeq": 0,
+                    "contents": {"v": rng.randint(0, 999), "i": i},
+                })
+    return recs
+
+
+def _make_role(impl: str, scratch: str):
+    if impl == "kernel":
+        from ..server.deli_kernel import KernelDeliRole
+
+        return KernelDeliRole(scratch, owner=f"bench-{impl}", ttl_s=3600.0)
+    from ..server.supervisor import DeliRole
+
+    return DeliRole(scratch, owner=f"bench-{impl}", ttl_s=3600.0)
+
+
+def run_pipeline(impl: str, raw_path: str, out_dir: str,
+                 batch: int = 8192, per_record_append: bool = False,
+                 max_records: Optional[int] = None) -> dict:
+    """Drive one deli variant raw-topic-in → deltas-topic-out.
+    Returns {"seconds", "records", "outputs", "out_path"}."""
+    from ..server.queue import SharedFileTopic, TailReader
+
+    raw = SharedFileTopic(raw_path)
+    out_path = os.path.join(out_dir, f"deltas-{impl}"
+                            + ("-seed" if per_record_append else "") + ".jsonl")
+    if os.path.exists(out_path):
+        os.remove(out_path)
+    deltas = SharedFileTopic(out_path)
+    role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"))
+    reader = TailReader(raw)
+    n_records = 0
+    n_out = 0
+    t0 = time.perf_counter()
+    while True:
+        cap = batch
+        if max_records is not None:
+            cap = min(cap, max_records - n_records)
+            if cap <= 0:
+                break
+        entries = reader.poll(cap)
+        if not entries:
+            break
+        out: List[dict] = []
+        for line_idx, rec in entries:
+            role.process(line_idx, rec, out)
+        role.flush_batch(out)
+        if per_record_append:
+            for r in out:  # the seed pipeline: one lock+fsync each
+                deltas.append(r)
+        else:
+            deltas.append_many(out)
+        n_records += len(entries)
+        n_out += len(out)
+    seconds = time.perf_counter() - t0
+    return {"seconds": seconds, "records": n_records, "outputs": n_out,
+            "out_path": out_path}
+
+
+def _read_canonical(path: str) -> List[dict]:
+    from ..server.queue import SharedFileTopic
+
+    return [
+        {k: v for k, v in r.items() if k != "reason"}
+        for r in SharedFileTopic(path).read_from(0)
+    ]
+
+
+def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
+                       ops_per_client: int = 1, seed_records: int = 400,
+                       batch: int = 16384, work_dir: Optional[str] = None,
+                       keep: bool = False) -> dict:
+    """The full comparison: build the workload once, gate kernel vs
+    batched-scalar for bit-identity, time all three variants, and
+    report the standard one-line JSON fields."""
+    from ..server.queue import SharedFileTopic
+
+    scratch = work_dir or tempfile.mkdtemp(prefix="deli-bench-")
+    os.makedirs(scratch, exist_ok=True)
+    try:
+        workload = build_pipeline_workload(n_docs, n_clients, ops_per_client)
+        raw_path = os.path.join(scratch, "rawdeltas.jsonl")
+        if os.path.exists(raw_path):
+            os.remove(raw_path)
+        raw = SharedFileTopic(raw_path)
+        raw.append_many(workload)
+
+        # Kernel warm-up (the standard bench contract: the timed region
+        # never compiles — one untimed full run compiles every jit
+        # shape the real run uses; the scalar path has nothing to
+        # compile and gets no warm-up).
+        run_pipeline("kernel", raw_path, scratch, batch=batch)
+        kern = run_pipeline("kernel", raw_path, scratch, batch=batch)
+        scal = run_pipeline("scalar", raw_path, scratch, batch=batch)
+
+        # Correctness gate: bit-identical stamps/nacks/MSNs.
+        a = _read_canonical(kern["out_path"])
+        b = _read_canonical(scal["out_path"])
+        if a != b:
+            n = sum(1 for x, y in zip(a, b) if x != y) + abs(len(a) - len(b))
+            raise AssertionError(
+                f"kernel deltas diverge from scalar oracle "
+                f"({n} records differ; {len(a)} vs {len(b)})"
+            )
+
+        seed_run = run_pipeline(
+            "scalar", raw_path, scratch, batch=batch,
+            per_record_append=True,
+            max_records=min(seed_records, len(workload)),
+        )
+
+        kernel_ops = kern["records"] / kern["seconds"]
+        scalar_ops = scal["records"] / scal["seconds"]
+        seed_ops = seed_run["records"] / seed_run["seconds"]
+        return {
+            "metric": "deli_pipeline_raw_to_deltas",
+            "docs": n_docs, "clients_per_doc": n_clients,
+            "records": len(workload), "stamped": kern["outputs"],
+            "ops_per_sec": round(kernel_ops, 1),
+            "scalar_batched_ops_per_sec": round(scalar_ops, 1),
+            "scalar_seed_ops_per_sec": round(seed_ops, 1),
+            "seed_records_measured": seed_run["records"],
+            "vs_baseline": round(kernel_ops / seed_ops, 2),
+            "vs_scalar_batched": round(kernel_ops / scalar_ops, 2),
+            "gate": "bit-identical",
+            "unit": "records/s",
+        }
+    finally:
+        if not keep and work_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+def main() -> None:  # CLI twin: tools/bench_deli.py
+    scale = float(os.environ.get("BD_SCALE", "1.0"))
+    res = run_pipeline_bench(
+        n_docs=max(8, int(int(os.environ.get("BD_DOCS", "10000")) * scale)),
+        n_clients=int(os.environ.get("BD_CLIENTS", "64")),
+        ops_per_client=int(os.environ.get("BD_OPS", "1")),
+        seed_records=int(os.environ.get("BD_SEED_RECORDS", "400")),
+        batch=int(os.environ.get("BD_BATCH", "16384")),
+    )
+    print(json.dumps(res))
+
+
+if __name__ == "__main__":
+    main()

@@ -18,11 +18,16 @@ import pytest
 
 from fluidframework_tpu.ops.sequencer_kernel import (
     ACCEPT,
+    NACK_OUT_OF_ORDER,
+    NACK_UNKNOWN_CLIENT,
+    NO_GROUP,
     SUB_JOIN,
     SUB_LEAVE,
     SUB_OP,
     SUB_PAD,
+    SUB_SYSTEM,
     SeqBatch,
+    grow_state,
     make_state,
     sequence_batch,
 )
@@ -141,6 +146,77 @@ def test_kernel_matches_oracle(seed):
         np.testing.assert_array_equal(
             np.asarray(res.min_seq[d]), np.asarray(msns, np.int32), err_msg=f"doc {d} msn"
         )
+
+
+def test_boxcar_group_nack_masks_tail():
+    """A nack inside a boxcar group masks the group's remaining
+    submissions (no stamp, no nack — `skipped`); later groups and
+    standalone ops are unaffected."""
+    state = make_state(1, 4)
+    kinds = [SUB_JOIN, SUB_OP, SUB_OP, SUB_OP, SUB_OP]
+    #         join      ok     gap!   masked  next group: ok
+    batch = SeqBatch(
+        kind=jnp.asarray([kinds], jnp.int32),
+        client=jnp.asarray([[1, 1, 1, 1, 1]], jnp.int32),
+        client_seq=jnp.asarray([[0, 1, 5, 2, 2]], jnp.int32),
+        ref_seq=jnp.asarray([[0, 0, 0, 0, 0]], jnp.int32),
+    )
+    groups = jnp.asarray([[NO_GROUP, 0, 0, 0, 1]], jnp.int32)
+    state, res = sequence_batch(state, batch, groups)
+    assert res.nack[0].tolist() == [0, 0, NACK_OUT_OF_ORDER, 0, 0]
+    assert res.skipped[0].tolist() == [False, False, False, True, False]
+    assert res.seq[0].tolist() == [1, 2, 0, 0, 3]
+    assert int(state.seq[0]) == 3
+
+
+def test_dedup_mode_drops_resubmissions_silently():
+    state = make_state(1, 4)
+    batch = SeqBatch(
+        kind=jnp.asarray([[SUB_JOIN, SUB_OP, SUB_OP, SUB_OP, SUB_OP]], jnp.int32),
+        client=jnp.asarray([[1, 1, 1, 1, 2]], jnp.int32),
+        client_seq=jnp.asarray([[0, 1, 1, 2, 1]], jnp.int32),  # dup cseq 1
+        ref_seq=jnp.asarray([[0, 0, 0, 0, 0]], jnp.int32),
+    )
+    state, res = sequence_batch(state, batch, dedup=True)
+    # dup is skipped silently; unknown client still nacks (dedup needs
+    # a known client).
+    assert res.skipped[0].tolist() == [False, False, True, False, False]
+    assert res.nack[0].tolist() == [0, 0, 0, 0, NACK_UNKNOWN_CLIENT]
+    assert res.seq[0].tolist() == [1, 2, 0, 3, 0]
+
+
+def test_system_stamp_bypasses_validation():
+    """SUB_SYSTEM stamps unconditionally (deli's control path) without
+    touching the client table; MSN follows the oracle's rules."""
+    state = make_state(1, 4)
+    batch = SeqBatch(
+        kind=jnp.asarray([[SUB_SYSTEM, SUB_JOIN, SUB_SYSTEM]], jnp.int32),
+        client=jnp.asarray([[0, 2, 0]], jnp.int32),
+        client_seq=jnp.asarray([[0, 0, 0]], jnp.int32),
+        ref_seq=jnp.asarray([[0, 0, 0]], jnp.int32),
+    )
+    state, res = sequence_batch(state, batch)
+    assert res.seq[0].tolist() == [1, 2, 3]
+    # no clients yet -> MSN trails head; after the join, MSN = join ref.
+    assert res.min_seq[0].tolist() == [1, 1, 1]
+    assert not bool(state.connected[0, 0])  # system never joins
+
+
+def test_grow_state_preserves_and_pads():
+    state = make_state(2, 2)
+    batch = SeqBatch(
+        kind=jnp.asarray([[SUB_JOIN], [SUB_JOIN]], jnp.int32),
+        client=jnp.asarray([[1], [0]], jnp.int32),
+        client_seq=jnp.asarray([[0], [0]], jnp.int32),
+        ref_seq=jnp.asarray([[0], [0]], jnp.int32),
+    )
+    state, _ = sequence_batch(state, batch)
+    grown = grow_state(state, 4, 8)
+    assert grown.connected.shape == (4, 8)
+    assert grown.seq.tolist()[:2] == state.seq.tolist()
+    assert grown.seq.tolist()[2:] == [0, 0]
+    assert bool(grown.connected[0, 1]) and bool(grown.connected[1, 0])
+    assert not bool(grown.connected[2, 0])
 
 
 def test_empty_doc_msn_trails_head():

@@ -42,6 +42,7 @@ PACKAGES = [
     "fluidframework_tpu.loader",
     "fluidframework_tpu.drivers",
     "fluidframework_tpu.server",
+    "fluidframework_tpu.server.deli_kernel",
     "fluidframework_tpu.server.riddler",
     "fluidframework_tpu.server.supervisor",
     "fluidframework_tpu.framework",

@@ -18,7 +18,10 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   scale — the batched rebase kernel (one XLA dispatch for the whole
   pending range; editManager.ts:47 / config-4 shape).
 - config 5: deli batch sequencing, 10k docs x 64 clients — the
-  vectorized sequencer kernel (deli/lambda.ts:818 ticket loop).
+  vectorized sequencer kernel (deli/lambda.ts:818 ticket loop), plus
+  its LIVE-pipeline twin (raw topic → stamped deltas through the
+  supervised deli datapath, kernel vs scalar pump, bit-identity
+  gated — tools/bench_deli.py at full scale).
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -224,6 +227,25 @@ def config5_deli(n_docs: int = 10_000, n_clients: int = 64,
     }
 
 
+def config5_deli_pipeline(n_docs: int = 4_000, n_clients: int = 32) -> dict:
+    """Config 5's LIVE-pipeline twin: the same batched sequencer, but
+    measured raw-topic-in → deltas-topic-out through the supervised
+    deli datapath (tools/bench_deli.py / testing.deli_bench) — JSON
+    parse, doc-slot mapping, pack, kernel, scatter, durable batched
+    append — against the scalar pump, with a bit-identity gate."""
+    from fluidframework_tpu.testing.deli_bench import run_pipeline_bench
+
+    return {
+        "config": "deli_pipeline_raw_to_deltas",
+        **run_pipeline_bench(
+            n_docs=max(8, int(n_docs * SCALE)),
+            n_clients=n_clients,
+            ops_per_client=1,
+            seed_records=200,
+        ),
+    }
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -300,7 +322,7 @@ def config_streaming_ingress(n_ops: int = 100_000,
 def main() -> None:
     results = []
     for fn in (config1_sharedstring_2client, config3_matrix,
-               config4_tree_rebase, config5_deli,
+               config4_tree_rebase, config5_deli, config5_deli_pipeline,
                config_streaming_ingress):
         r = fn()
         results.append(r)

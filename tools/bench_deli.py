@@ -1,0 +1,44 @@
+"""End-to-end deli pipeline bench CLI (raw topic → stamped deltas).
+
+Runs the live ordering pipeline (the supervised deli datapath over
+durable `SharedFileTopic`s) with three sequencer variants on the same
+10k-doc x 64-client workload and prints ONE JSON line:
+
+    {"metric": "deli_pipeline_raw_to_deltas", "ops_per_sec": ...,
+     "vs_baseline": ..., "vs_scalar_batched": ..., "gate": "bit-identical"}
+
+- `ops_per_sec` / `vs_baseline` — the kernel deli
+  (`server.deli_kernel.KernelDeliRole`, vmap'd sequencer kernel, one
+  `append_many` per pump) against the SEED scalar pump (per-record
+  locked+fsync'd appends, the pre-batching pipeline this PR replaces;
+  measured on a bounded prefix — one fsync per record makes full runs
+  take hours by design).
+- `vs_scalar_batched` — the honest same-batching comparison against
+  the scalar deli with the per-pump `append_many` flush.
+
+A correctness gate asserts kernel and scalar deltas topics are
+bit-identical (stamps, nack codes, MSNs) before reporting.
+
+Env knobs: BD_DOCS (10000), BD_CLIENTS (64), BD_OPS (ops/client, 1),
+BD_SEED_RECORDS (400), BD_BATCH (8192), BD_SCALE (workload shrink).
+
+Usage: python tools/bench_deli.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+
+from fluidframework_tpu.testing.deli_bench import main  # noqa: E402
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,12 @@
 
 Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
+        [--deli scalar|kernel]
+
+`--deli kernel` runs the farm with the batched TPU sequencer
+(server.deli_kernel.KernelDeliRole) in place of the scalar deli; the
+golden digest still comes from the scalar production path, so
+convergence proves the batched pipeline exactly-once under faults.
 
 Builds the seeded workload, computes the no-fault GOLDEN digest with
 the production deli/scribe code in-process, launches the supervised
@@ -22,6 +28,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from fluidframework_tpu.server.supervisor import DELI_IMPLS  # noqa: E402
 from fluidframework_tpu.testing.chaos import (  # noqa: E402
     FAULT_CLASSES,
     ChaosConfig,
@@ -52,18 +59,21 @@ def main() -> int:
         ops_per_client=int(_take("--ops", "40")),
         timeout_s=float(_take("--timeout", "120")),
         shared_dir=_take("--keep", None),
+        deli_impl=_take("--deli", "scalar"),
     )
     unknown = set(faults) - set(FAULT_CLASSES)
-    if unknown or args:
+    if unknown or args or cfg.deli_impl not in DELI_IMPLS:
         print(
             f"unknown faults {sorted(unknown)} / leftover args {args}; "
-            f"faults are chosen from {','.join(FAULT_CLASSES)}",
+            f"faults are chosen from {','.join(FAULT_CLASSES)}; "
+            f"--deli is one of {'|'.join(DELI_IMPLS)}",
             file=sys.stderr,
         )
         return 2
     print(f"chaos run: seed={seed} faults={','.join(faults)} "
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
-          f"ops/client={cfg.ops_per_client}", flush=True)
+          f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl}",
+          flush=True)
     res = run_chaos(cfg)
     print(f"golden digest : {res.golden_digest}")
     print(f"farm digest   : {res.digest}")

@@ -155,8 +155,10 @@ class PartitionWorker:
             # Append THEN checkpoint (at-least-once on crash between
             # the two; the test dedups by (doc, clientId, clientSeq) —
             # the same replay-side idempotence Kafka consumers use).
-            for s in stamped:
-                out.append(s)
+            # One batched append per pump: a per-record append is one
+            # lock+fsync EACH (the scalar-pipeline hot-path bug the
+            # deli lambdas also had).
+            out.append_many(stamped)
             self._save_checkpoint(p, fence, consumer.offset, seqs)
             done += len(msgs)
         return done
