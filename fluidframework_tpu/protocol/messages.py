@@ -62,6 +62,39 @@ class SequencedMessage:
     traces: list = field(default_factory=list)
 
 
+def trace_submit_ts(metadata: Any) -> Optional[float]:
+    """The client-driver submit timestamp riding op metadata under
+    "tr_sub" (stamped by the runtime's flush; foreign producers simply
+    omit it). Lives here, next to the metadata/traces wire contract,
+    so both deli implementations share one definition."""
+    if isinstance(metadata, dict):
+        ts = metadata.get("tr_sub")
+        if isinstance(ts, (int, float)):
+            return float(ts)
+    return None
+
+
+def trace_stage_once(traces: list, stage: str, now: float) -> Optional[float]:
+    """Record `stage` in an op's lifecycle trace exactly once.
+
+    No-op returning None when the stage is already present (a restarted
+    consumer re-polling shared log objects must not re-stamp or
+    re-observe); otherwise appends ``(stage, now)`` and returns the
+    op's "stamp" timestamp, if any, so the caller can observe the
+    stamp→stage latency. One definition for every post-stamp consumer
+    (scriptorium, broadcaster, ...)."""
+    for s, _ in traces:
+        if s == stage:
+            return None
+    stamp = None
+    for s, ts in traces:
+        if s == "stamp":
+            stamp = ts
+            break
+    traces.append((stage, now))
+    return stamp
+
+
 @dataclass
 class NackMessage:
     """Rejection from the sequencing service (stale refSeq, throttle...).

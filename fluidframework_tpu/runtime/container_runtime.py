@@ -20,6 +20,7 @@ Mirrors the reference `ContainerRuntime`
 from __future__ import annotations
 
 import enum
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
@@ -114,6 +115,9 @@ class ContainerRuntime(EventEmitter):
         self._in_batch = False
         self._rollback_log: Optional[List[_PendingMessage]] = None
         self._ever_connected = False
+        # Apply-side op-lifecycle stage histograms, bound lazily on the
+        # first traced message (utils.metrics registry).
+        self._stage_hists: Optional[Dict[str, Any]] = None
         # Protocol state: quorum membership + MSN-committed proposals
         # (the loader's initializeProtocolState role, container.ts:1697).
         self.protocol = ProtocolOpHandler()
@@ -399,13 +403,19 @@ class ContainerRuntime(EventEmitter):
         # resubmit/rebase path and splitting batch atomicity.
         n = len(expanded)
         wire: List[DocumentMessage] = []
+        # Op-lifecycle trace origin: the client-driver submit timestamp
+        # rides the metadata (key "tr_sub"). Readers that ignore the
+        # key see unchanged wire semantics (batch markers still work by
+        # key lookup); the deli folds it into the client→stamp latency
+        # histogram and the sequenced echo's `traces`.
+        sub_ts = time.time()
         for i, (pm, c) in enumerate(expanded):
-            meta = None
+            meta = {"tr_sub": sub_ts}
             if n > 1:
                 if i == 0:
-                    meta = {"batch": True}
+                    meta["batch"] = True
                 elif i == n - 1:
-                    meta = {"batch": False}
+                    meta["batch"] = False
             self._client_seq += 1
             pm.client_seq = self._client_seq
             pm.client_id = self.client_id
@@ -487,7 +497,36 @@ class ContainerRuntime(EventEmitter):
             return
         self._process_one(msg)
 
+    def _observe_trace(self, msg: SequencedMessage) -> None:
+        """Fold the op-lifecycle trace the ordering pipeline stamped
+        (`SequencedMessage.traces`: [(stage, ts), ...]) into the
+        apply-side stage histograms. Observational only — the message
+        is never mutated, and messages without traces (mock harness,
+        journal-decoded replay) cost one falsy check."""
+        if self._stage_hists is None:
+            from ..utils.metrics import get_registry
+
+            reg = get_registry()
+            self._stage_hists = {
+                s: reg.histogram("op_stage_ms", stage=s)
+                for s in ("broadcast_to_apply", "submit_to_apply")
+            }
+        tr: Dict[str, float] = {}
+        for stage, ts in msg.traces:
+            tr.setdefault(stage, ts)
+        now = time.time()
+        b = tr.get("broadcast")
+        if b is not None:
+            self._stage_hists["broadcast_to_apply"].observe(
+                (now - b) * 1000.0
+            )
+        s = tr.get("submit")
+        if s is not None:
+            self._stage_hists["submit_to_apply"].observe((now - s) * 1000.0)
+
     def _process_one(self, msg: SequencedMessage) -> None:
+        if msg.traces:
+            self._observe_trace(msg)
         self.current_seq = msg.sequence_number
         if msg.minimum_sequence_number > self.min_seq:
             self.min_seq = msg.minimum_sequence_number

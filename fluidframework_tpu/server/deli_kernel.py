@@ -65,7 +65,13 @@ from ..ops.sequencer_kernel import (
     SUB_PAD,
     SUB_SYSTEM,
 )
-from ..protocol.messages import MessageType, NackMessage, SequencedMessage
+from ..protocol.messages import (
+    MessageType,
+    NackMessage,
+    SequencedMessage,
+    trace_submit_ts,
+)
+from ..utils.metrics import get_registry
 from .log import LogConsumer, MessageLog
 from .sequencer import (
     NACK_FUTURE_REFSEQ,
@@ -137,6 +143,11 @@ class SeqPool:
         self._need_clients = self.n_clients
         self._clock = 0
         self._active: set = set()
+        # Pool instrumentation (per-event counters here; occupancy
+        # gauges are refreshed once per kernel pump by the core).
+        m = get_registry()
+        self._m_grows = m.counter("deli_pool_grows_total")
+        self._m_evicts = m.counter("deli_pool_evictions_total")
 
     # ------------------------------------------------------------ slots
 
@@ -194,6 +205,7 @@ class SeqPool:
             old = self.n_docs
             self.n_docs = max(8, old * 2)
             self.free.extend(range(self.n_docs - 1, old - 1, -1))
+            self._m_grows.inc()
         return self.free.pop()
 
     def park(self, doc_id: str) -> None:
@@ -207,6 +219,7 @@ class SeqPool:
         h["slot"] = None
         self.slot_owner.pop(slot, None)
         self.free.append(slot)
+        self._m_evicts.inc()
 
     def resident_docs(self) -> int:
         return len(self.slot_owner)
@@ -376,6 +389,22 @@ class PackedDeliCore:
         self.dedup = dedup
         self._subs: List[tuple] = []
         self._gctr: Dict[int, int] = {}
+        # Kernel-path instrumentation: one histogram observation + a
+        # handful of gauge/counter updates PER PUMP (never per record —
+        # the config-5 overhead guard in tools/bench_configs.py holds
+        # the cost under 5%).
+        m = get_registry()
+        self._m_pump = m.histogram(
+            "deli_pump_records",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
+            impl="kernel",
+        )
+        self._m_nacks = m.counter("deli_nacks_total", impl="kernel")
+        self._m_skips = m.counter("deli_dedup_skips_total", impl="kernel")
+        self._m_resident = m.gauge("deli_pool_resident_docs")
+        self._m_slots = m.gauge("deli_pool_doc_slots")
+        self._m_fill = m.gauge("deli_pool_fill_ratio")
+        self._m_cols = m.gauge("deli_pool_client_cols")
 
     def begin(self) -> None:
         self.pool.begin()
@@ -469,6 +498,18 @@ class PackedDeliCore:
             msn_o[sel] = res.min_seq[sl, ic]
             nack_o[sel] = res.nack[sl, ic]
             skip_o[sel] = res.skipped[sl, ic]
+        self._m_pump.observe(n)
+        nacks = int(np.count_nonzero(nack_o))
+        if nacks:
+            self._m_nacks.inc(nacks)
+        skips = int(np.count_nonzero(skip_o))
+        if skips:
+            self._m_skips.inc(skips)
+        resident = pool.resident_docs()
+        self._m_resident.set(resident)
+        self._m_slots.set(pool.n_docs)
+        self._m_fill.set(resident / pool.n_docs if pool.n_docs else 0.0)
+        self._m_cols.set(pool.n_clients)
         return _FlatResults(
             seq_o.tolist(), msn_o.tolist(), nack_o.tolist(), skip_o.tolist()
         )
@@ -500,6 +541,9 @@ class KernelDeliLambda:
         self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
         self.deltas = log.topic("deltas")
         self.max_pump = max_pump
+        self._m_stage = get_registry().histogram(
+            "op_stage_ms", stage="submit_to_stamp"
+        )
 
     def pump(self, max_count: Optional[int] = None) -> int:
         """Drain up to `max_count` raw records (micro-batch cap: a deep
@@ -564,6 +608,7 @@ class KernelDeliLambda:
         seqs, msns, nacks, skips = res.seq, res.msn, res.nack, res.skipped
         apply_op = pool.apply_op
         ts = time.time()
+        observe_stage = self._m_stage.observe
         for doc_id, handle, tag, a, b in plan:
             if tag == "op":
                 if skips[handle]:
@@ -578,17 +623,27 @@ class KernelDeliLambda:
                           "msg": NackMessage(a, b.client_seq, nack, reason)})
                     continue
                 apply_op(doc_id, a, seq, msn, b.client_seq, b.ref_seq)
+                # Same op-lifecycle trace contract as the scalar deli
+                # (traces are observability-only: excluded from journal
+                # encoding and every digest form).
+                tr = [("stamp", ts)]
+                sub = trace_submit_ts(b.metadata)
+                if sub is not None:
+                    tr.insert(0, ("submit", sub))
+                    observe_stage((ts - sub) * 1000.0)
                 emit({"doc": doc_id, "kind": "op",
                       "msg": SequencedMessage(
                           seq, msn, a, b.client_seq, b.ref_seq,
-                          b.type, b.contents, b.metadata, b.address, ts)})
+                          b.type, b.contents, b.metadata, b.address, ts,
+                          tr)})
             elif tag == "join":
                 seq, msn = seqs[handle], msns[handle]
                 pool.apply_join(doc_id, a, seq, msn)
                 emit({"doc": doc_id, "kind": "op",
                       "msg": SequencedMessage(
                           seq, msn, a, 0, seq - 1,
-                          MessageType.CLIENT_JOIN, a, None, None, ts)})
+                          MessageType.CLIENT_JOIN, a, None, None, ts,
+                          [("stamp", ts)])})
             elif tag == "leave":
                 seq, msn = seqs[handle], msns[handle]
                 if seq == 0:
@@ -597,14 +652,15 @@ class KernelDeliLambda:
                 emit({"doc": doc_id, "kind": "op",
                       "msg": SequencedMessage(
                           seq, msn, a, 0, seq - 1,
-                          MessageType.CLIENT_LEAVE, a, None, None, ts)})
+                          MessageType.CLIENT_LEAVE, a, None, None, ts,
+                          [("stamp", ts)])})
             else:  # sys
                 seq, msn = seqs[handle], msns[handle]
                 pool.apply_stamp(doc_id, seq, msn)
                 emit({"doc": doc_id, "kind": "op",
                       "msg": SequencedMessage(
                           seq, msn, SYSTEM_CLIENT, 0, seq - 1,
-                          a, b, None, None, ts)})
+                          a, b, None, None, ts, [("stamp", ts)])})
         return out
 
     def checkpoint(self) -> dict:

@@ -30,6 +30,7 @@ reference's LocalOrderer runs the real lambda classes over LocalKafka
 from __future__ import annotations
 
 import json
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -38,9 +39,12 @@ from ..protocol.messages import (
     MessageType,
     NackMessage,
     SequencedMessage,
+    trace_stage_once,
+    trace_submit_ts,
 )
 from ..protocol.quorum import ProtocolOpHandler
 from ..utils.events import BufferedListener
+from ..utils.metrics import get_registry
 from .castore import ContentAddressedStore
 from .log import LogConsumer, MessageLog
 from .sequencer import DocumentSequencer
@@ -76,6 +80,14 @@ class DeliLambda:
                 self.sequencers[doc_id] = DocumentSequencer.restore(state)
         self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
         self.deltas = log.topic("deltas")
+        m = get_registry()
+        self._m_pump = m.histogram(
+            "deli_pump_records",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
+            impl="scalar",
+        )
+        self._m_nacks = m.counter("deli_nacks_total", impl="scalar")
+        self._m_stage = m.histogram("op_stage_ms", stage="submit_to_stamp")
 
     def _doc(self, doc_id: str) -> DocumentSequencer:
         if doc_id not in self.sequencers:
@@ -93,6 +105,8 @@ class DeliLambda:
             self._handle(raw, out)
         if out:
             self.deltas.append_many(out)
+        if raws:
+            self._m_pump.observe(len(raws))
         return len(raws)
 
     def _handle(self, raw: dict, out: List[dict]) -> None:
@@ -100,10 +114,12 @@ class DeliLambda:
         kind = raw["kind"]
         if kind == "join":
             msg = doc.join(raw["client"])
+            msg.traces.append(("stamp", time.time()))
             out.append({"doc": raw["doc"], "kind": "op", "msg": msg})
         elif kind == "leave":
             msg = doc.leave(raw["client"])
             if msg is not None:
+                msg.traces.append(("stamp", time.time()))
                 out.append({"doc": raw["doc"], "kind": "op", "msg": msg})
         elif kind == "control":
             # Server-side control (summary ack/nack from scribe): stamp
@@ -115,6 +131,7 @@ class DeliLambda:
                 type_=raw["type"],
                 contents=raw["contents"],
             )
+            msg.traces.append(("stamp", time.time()))
             out.append({"doc": raw["doc"], "kind": "op", "msg": msg})
         elif kind == "boxcar":
             # Boxcarred submission (services-core pendingBoxcar.ts):
@@ -133,10 +150,21 @@ class DeliLambda:
                 msg: DocumentMessage, out: List[dict]) -> bool:
         res = doc.sequence(client, msg)
         if isinstance(res, NackMessage):
+            self._m_nacks.inc()
             out.append(
                 {"doc": doc_id, "kind": "nack", "client": client, "msg": res}
             )
             return False
+        # Op-lifecycle trace: carry the client submit stamp forward and
+        # add the deli stamp instant (ISequencedDocumentMessage.traces
+        # role). Traces are in-memory observability only — excluded
+        # from journal encoding and every digest/bit-identity form.
+        now = time.time()
+        sub = trace_submit_ts(msg.metadata)
+        if sub is not None:
+            res.traces.append(("submit", sub))
+            self._m_stage.observe((now - sub) * 1000.0)
+        res.traces.append(("stamp", now))
         out.append({"doc": doc_id, "kind": "op", "msg": res})
         return True
 
@@ -162,6 +190,9 @@ class ScriptoriumLambda:
         if checkpoint:
             offset = checkpoint["offset"]
         self.consumer = LogConsumer(log.topic("deltas"), offset)
+        self._m_stage = get_registry().histogram(
+            "op_stage_ms", stage="stamp_to_durable"
+        )
         if checkpoint is None:
             self.store = {}
         # On restore, replay the log from 0 to rebuild the store (the
@@ -173,7 +204,17 @@ class ScriptoriumLambda:
 
     def _apply(self, entry: dict) -> None:
         if entry["kind"] == "op":
-            self.store.setdefault(entry["doc"], []).append(entry["msg"])
+            msg = entry["msg"]
+            # Trace the durable-append instant once per message: a
+            # restart replays history through _apply, and those
+            # messages already carry their original "durable" stamp
+            # (trace_stage_once's no-op path).
+            if msg.traces:
+                now = time.time()
+                stamp = trace_stage_once(msg.traces, "durable", now)
+                if stamp is not None:
+                    self._m_stage.observe((now - stamp) * 1000.0)
+            self.store.setdefault(entry["doc"], []).append(msg)
 
     def pump(self, max_count: Optional[int] = None) -> int:
         n = 0
@@ -204,6 +245,9 @@ class BroadcasterLambda:
         self.consumer = LogConsumer(log.topic("deltas"))
         # doc -> list of (socket) where socket has deliver(msg)/nack(msg)
         self.rooms: Dict[str, List[Any]] = {}
+        self._m_stage = get_registry().histogram(
+            "op_stage_ms", stage="stamp_to_broadcast"
+        )
 
     def join_room(self, doc_id: str, socket: Any) -> None:
         self.rooms.setdefault(doc_id, []).append(socket)
@@ -227,13 +271,23 @@ class BroadcasterLambda:
                     doc, sock, "deliver_batch", (msgs, memo), failed
                 )
 
+        now = time.time()  # one clock read per pump, not per record
         for entry in self.consumer.poll(max_count):
             doc = entry["doc"]
             if entry["kind"] == "op":
                 # Batch per doc per pump (broadcaster/lambda.ts:49's
                 # per-tick batching); flushed before any nack so
                 # per-client ordering holds.
-                pending.setdefault(doc, []).append(entry["msg"])
+                msg = entry["msg"]
+                # Trace the broadcast instant once per message: a
+                # restarted server's fresh broadcaster re-polls shared
+                # log objects that already carry their original stamp
+                # (trace_stage_once's no-op path).
+                if msg.traces:
+                    stamp = trace_stage_once(msg.traces, "broadcast", now)
+                    if stamp is not None:
+                        self._m_stage.observe((now - stamp) * 1000.0)
+                pending.setdefault(doc, []).append(msg)
             elif entry["kind"] == "nack":
                 flush(doc)
                 for sock in list(self.rooms.get(doc, [])):
@@ -545,6 +599,9 @@ class LocalServer:
                     self.storage, blob_budget_bytes=historian_budget
                 )
         cp = checkpoints or {}
+        self.metrics = get_registry()
+        self._m_ingress_nacks = self.metrics.counter("ingress_nacks_total")
+        self._monitor = None
         import os as _os
 
         self.deli_impl = deli_impl or _os.environ.get("FLUID_DELI", "scalar")
@@ -583,6 +640,32 @@ class LocalServer:
                     )
         # Broadcaster must lag scriptorium so catch_up is complete by
         # the time a live op arrives; pump order below guarantees it.
+
+    # ---------------------------------------------------- observability
+
+    def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the live ops endpoint (`/metrics` Prometheus text,
+        `/metrics.json` snapshot, `/healthz`) over this process's
+        registry; returns the `monitor.MetricsServer` (its `.url` has
+        the bound port). Idempotent per server instance."""
+        if self._monitor is None:
+            from .monitor import MetricsServer
+
+            self._monitor = MetricsServer(
+                registry=self.metrics,
+                health=lambda: {
+                    "status": "ok",
+                    "deli_impl": self.deli_impl,
+                    "docs": len(self.scriptorium.store),
+                },
+                host=host, port=port,
+            ).start()
+        return self._monitor
+
+    def stop_metrics(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
 
     # ------------------------------------------------------------- pump
 
@@ -649,6 +732,7 @@ class LocalServer:
         except Exception:
             size = 0
         if size > MAX_OP_BYTES:
+            self._m_ingress_nacks.inc()
             self.log.topic("deltas").append(
                 {
                     "doc": doc_id,
@@ -674,6 +758,7 @@ class LocalServer:
             except Exception:
                 size = 0
             if size > MAX_OP_BYTES:
+                self._m_ingress_nacks.inc()
                 self.log.topic("deltas").append(
                     {
                         "doc": doc_id,

@@ -1,0 +1,154 @@
+"""Live ops endpoint: `/metrics` + `/healthz` over stdlib HTTP.
+
+The reference service exposes per-pod health and metrics endpoints the
+orchestrator and dashboards scrape; this module is that surface for
+the in-proc `LocalServer` and the supervised farm
+(`server.supervisor.ServiceSupervisor`):
+
+- ``GET /metrics``       — Prometheus text exposition of the bound
+  registry (per-stage op-latency histograms, kernel occupancy gauges,
+  checkpoint/restart counters).
+- ``GET /metrics.json``  — the same state as a JSON snapshot
+  (`MetricsRegistry.snapshot()` form, consumable by
+  tools/metrics_report.py).
+- ``GET /healthz``       — liveness JSON from the bound health
+  callback; HTTP 200 iff ``status == "ok"``, 503 otherwise.
+
+The registry may be passed as an instance or a zero-arg callable
+returning one — the supervisor rebuilds its registry per scrape by
+merging the children's heartbeat snapshots.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Union
+
+from ..utils.metrics import MetricsRegistry, get_registry
+
+__all__ = ["MetricsServer"]
+
+
+class MetricsServer:
+    """Threaded HTTP server for `/metrics`, `/metrics.json`, `/healthz`.
+
+    `registry`: a `MetricsRegistry`, or a callable returning one per
+    scrape; defaults to the process registry. `health`: zero-arg
+    callable returning a JSON-able dict; a ``"status"`` key of
+    ``"ok"`` maps to HTTP 200, anything else to 503."""
+
+    def __init__(
+        self,
+        registry: Union[MetricsRegistry, Callable[[], MetricsRegistry],
+                        None] = None,
+        health: Optional[Callable[[], Dict[str, Any]]] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._registry = registry
+        self._health = health
+        self.host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def port(self) -> int:
+        if self._httpd is None:
+            return self._requested_port
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def _resolve_registry(self) -> MetricsRegistry:
+        reg = self._registry
+        if reg is None:
+            return get_registry()
+        if callable(reg) and not hasattr(reg, "to_prometheus"):
+            return reg()
+        return reg
+
+    def _resolve_health(self) -> Dict[str, Any]:
+        if self._health is None:
+            return {"status": "ok"}
+        out = self._health()
+        if "status" not in out:
+            out = {"status": "ok", **out}
+        return out
+
+    # -------------------------------------------------------- lifecycle
+
+    def start(self) -> "MetricsServer":
+        assert self._httpd is None, "already started"
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:  # quiet scrapes
+                pass
+
+            def _reply(self, code: int, body: str,
+                       ctype: str) -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:  # noqa: N802 (stdlib contract)
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._reply(
+                            200,
+                            server._resolve_registry().to_prometheus(),
+                            "text/plain; version=0.0.4",
+                        )
+                    elif path == "/metrics.json":
+                        self._reply(
+                            200,
+                            json.dumps(
+                                server._resolve_registry().snapshot()
+                            ),
+                            "application/json",
+                        )
+                    elif path == "/healthz":
+                        health = server._resolve_health()
+                        code = 200 if health.get("status") == "ok" else 503
+                        self._reply(code, json.dumps(health),
+                                    "application/json")
+                    else:
+                        self._reply(404, "not found\n", "text/plain")
+                except (BrokenPipeError, ConnectionError):
+                    return  # scraper went away mid-response: nothing to tell it
+                except Exception as exc:  # scrape must never kill serving
+                    try:
+                        self._reply(500, f"{exc!r}\n", "text/plain")
+                    except (BrokenPipeError, ConnectionError, OSError):
+                        pass
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), _Handler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fluid-metrics-server",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

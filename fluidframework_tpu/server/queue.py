@@ -158,13 +158,15 @@ class SharedFileTopic:
     # ----------------------------------------------------------- append
 
     def append(self, message: Any, fence: Optional[int] = None,
-               owner: Optional[str] = None) -> None:
-        self.append_many([message], fence=fence, owner=owner)
+               owner: Optional[str] = None) -> int:
+        return self.append_many([message], fence=fence, owner=owner)
 
     def append_many(self, messages: List[Any],
                     fence: Optional[int] = None,
                     owner: Optional[str] = None,
-                    lock_timeout_s: Optional[float] = None) -> None:
+                    lock_timeout_s: Optional[float] = None) -> int:
+        """Append a batch under the OS lock; returns the payload bytes
+        written (the byte-based checkpoint-cadence signal)."""
         import fcntl
 
         # An empty batch still gates: a deposed owner must learn it is
@@ -211,6 +213,7 @@ class SharedFileTopic:
                 os.fsync(f.fileno())
             finally:
                 fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+        return len(payload)
 
     # ------------------------------------------------------------- read
 
@@ -608,7 +611,9 @@ class FencedCheckpointStore:
 
     def save(self, key: str, state: Any, fence: int,
              owner: Optional[str] = None,
-             lock_timeout_s: Optional[float] = None) -> None:
+             lock_timeout_s: Optional[float] = None) -> int:
+        """Fenced write; returns the serialized envelope size in bytes
+        (the checkpoint-bytes metric's source)."""
         import fcntl
 
         lock_path = self._path(key) + ".lock"
@@ -638,16 +643,17 @@ class FencedCheckpointStore:
                         fence, owner, f"checkpoint {key!r}",
                     )
                 tmp = self._path(key) + f".tmp.{os.getpid()}"
+                payload = json.dumps(
+                    {"fence": fence, "owner": owner, "state": state}
+                )
                 with open(tmp, "w") as f:
-                    json.dump(
-                        {"fence": fence, "owner": owner, "state": state},
-                        f,
-                    )
+                    f.write(payload)
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, self._path(key))
             finally:
                 fcntl.flock(lk.fileno(), fcntl.LOCK_UN)
+        return len(payload)
 
 
 def partition_of(doc_id: str, n_partitions: int) -> int:

@@ -108,10 +108,21 @@ class _Role:
     out_topic_name: Optional[str] = None
 
     def __init__(self, shared_dir: str, owner: str, ttl_s: float = 1.0,
-                 batch: int = 512):
+                 batch: int = 512, ckpt_interval_s: float = 0.25,
+                 ckpt_bytes: int = 256 * 1024):
+        """`ckpt_interval_s` / `ckpt_bytes`: checkpoint cadence —
+        a checkpoint is written when EITHER bound is crossed since the
+        last one (ROADMAP item (b): the seed checkpointed every step,
+        and at 10k-doc scale the per-step JSON snapshot dwarfs the
+        batch). Correctness is cadence-independent: exactly-once
+        recovery scans the output topic for the durable `inOff` prefix
+        and silently replays the checkpoint→prefix gap, however wide.
+        `ckpt_interval_s=0` restores every-step checkpointing."""
         self.shared_dir = shared_dir
         self.owner = owner
         self.batch = batch
+        self.ckpt_interval_s = ckpt_interval_s
+        self.ckpt_bytes = ckpt_bytes
         self.leases = LeaseManager(
             os.path.join(shared_dir, "leases"), owner, ttl_s,
             claim_ttl_s=max(0.25, ttl_s / 2),
@@ -132,6 +143,30 @@ class _Role:
         self._last_renew = 0.0
         self._hb_path = os.path.join(shared_dir, "hb", f"{self.name}.json")
         os.makedirs(os.path.dirname(self._hb_path), exist_ok=True)
+        # Checkpoint-cadence state + role metrics. The registry is
+        # per-process; `heartbeat()` snapshots it into the hb file so
+        # the supervisor can merge children's metrics for /metrics.
+        self._ckpt_dirty = False
+        self._ckpt_last_t = time.time()
+        self._ckpt_pending_bytes = 0
+        from ..utils.metrics import get_registry
+
+        self.metrics = get_registry()
+        m = self.metrics
+        self._m_pump = m.histogram(
+            "role_pump_records",
+            buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
+            role=self.name,
+        )
+        self._m_records = m.counter("role_records_total", role=self.name)
+        self._m_ckpt_writes = m.counter(
+            "checkpoint_writes_total", role=self.name
+        )
+        self._m_ckpt_bytes = m.counter(
+            "checkpoint_bytes_total", role=self.name
+        )
+        self._m_ckpt_ms = m.histogram("checkpoint_ms", role=self.name)
+        self._m_fenced = m.counter("fence_rejections_total", role=self.name)
 
     # ------------------------------------------------------------ state
 
@@ -157,6 +192,11 @@ class _Role:
             json.dump({
                 "pid": os.getpid(), "owner": self.owner, "t": time.time(),
                 "fence": self.fence, "offset": self.offset,
+                # Metrics report UP through the existing heartbeat
+                # channel: the supervisor merges these snapshots into
+                # its /metrics registry (per-process registries, one
+                # explicit merge point).
+                "metrics": self.metrics.snapshot(),
             }, f)
         os.replace(tmp, self._hb_path)
 
@@ -204,11 +244,29 @@ class _Role:
         self.checkpoint()
 
     def checkpoint(self) -> None:
-        self.ckpt.save(
+        t0 = time.perf_counter()
+        n_bytes = self.ckpt.save(
             self.name,
             {"offset": self.offset, "state": self.snapshot_state()},
             fence=self.fence, owner=self.owner,
         )
+        self._m_ckpt_writes.inc()
+        self._m_ckpt_bytes.inc(n_bytes)
+        self._m_ckpt_ms.observe((time.perf_counter() - t0) * 1000.0)
+        self._ckpt_dirty = False
+        self._ckpt_pending_bytes = 0
+        self._ckpt_last_t = time.time()
+
+    def maybe_checkpoint(self) -> bool:
+        """Write a checkpoint iff the cadence says so (dirty AND the
+        time or byte bound crossed). Returns whether one was written."""
+        if not self._ckpt_dirty:
+            return False
+        if (self._ckpt_pending_bytes < self.ckpt_bytes
+                and time.time() - self._ckpt_last_t < self.ckpt_interval_s):
+            return False
+        self.checkpoint()
+        return True
 
     def step(self, idle_sleep: float = 0.01) -> int:
         """One supervision quantum: lease upkeep, one input batch,
@@ -238,7 +296,19 @@ class _Role:
         entries = self._reader.poll(self.batch)
         next_off = self._reader.next_line
         if not entries:
-            self.offset = next_off  # junk-only progress still counts
+            if next_off != self.offset:
+                self.offset = next_off  # junk-only progress still counts
+                self._ckpt_dirty = True
+            try:
+                # Idle flush: progress folded since the last
+                # checkpoint goes durable once the interval elapses
+                # (a quiescent stream must not pin state in memory).
+                self.maybe_checkpoint()
+            except FencedError as exc:
+                self._m_fenced.inc()
+                self.heartbeat()  # export the rejection before dying
+                print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
+                raise SystemExit(EXIT_FENCED)
             self.heartbeat()
             time.sleep(idle_sleep)
             return 0
@@ -249,15 +319,21 @@ class _Role:
         try:
             if self.out_topic is not None:
                 # Append THEN checkpoint; the recovery scan makes the
-                # crash window between them exactly-once.
-                self.out_topic.append_many(
+                # crash window between them exactly-once, whatever the
+                # checkpoint cadence.
+                self._ckpt_pending_bytes += self.out_topic.append_many(
                     out, fence=self.fence, owner=self.owner
                 )
             self.offset = next_off
-            self.checkpoint()
+            self._ckpt_dirty = True
+            self.maybe_checkpoint()
         except FencedError as exc:
+            self._m_fenced.inc()
+            self.heartbeat()  # export the rejection before dying
             print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
             raise SystemExit(EXIT_FENCED)
+        self._m_pump.observe(len(entries))
+        self._m_records.inc(len(entries))
         self.heartbeat()
         return len(entries)
 
@@ -437,10 +513,13 @@ def resolve_role_class(role: str, deli_impl: str = "scalar"):
 
 def serve_role(shared_dir: str, role: str, owner: str,
                ttl_s: float = 1.0, batch: int = 512,
-               deli_impl: str = "scalar") -> None:
+               deli_impl: str = "scalar",
+               ckpt_interval_s: float = 0.25,
+               ckpt_bytes: int = 256 * 1024) -> None:
     """Child-process entry: run one role until killed/deposed/fenced."""
     r = resolve_role_class(role, deli_impl)(
-        shared_dir, owner, ttl_s=ttl_s, batch=batch
+        shared_dir, owner, ttl_s=ttl_s, batch=batch,
+        ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
     )
     print(f"READY {role} {owner}", flush=True)
     while True:
@@ -449,6 +528,8 @@ def serve_role(shared_dir: str, role: str, owner: str,
         except FencedError as exc:
             # Recovery-path rejection (step() handles its own): we are
             # a zombie; a successor owns the fence. Stand down loudly.
+            r._m_fenced.inc()
+            r.heartbeat()  # export the rejection before dying
             print(f"FENCED {role} {owner}: {exc}", flush=True)
             raise SystemExit(EXIT_FENCED)
 
@@ -473,12 +554,16 @@ class ServiceSupervisor:
                  ttl_s: float = 0.75, heartbeat_timeout_s: float = 2.0,
                  batch: int = 512, python: Optional[str] = None,
                  spawn_ready_timeout_s: float = 30.0,
-                 deli_impl: Optional[str] = None):
+                 deli_impl: Optional[str] = None,
+                 ckpt_interval_s: float = 0.25,
+                 ckpt_bytes: int = 256 * 1024):
         self.shared_dir = shared_dir
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.batch = batch
+        self.ckpt_interval_s = ckpt_interval_s
+        self.ckpt_bytes = ckpt_bytes
         self.deli_impl = deli_impl or os.environ.get("FLUID_DELI", "scalar")
         if self.deli_impl not in DELI_IMPLS:
             raise ValueError(
@@ -491,7 +576,15 @@ class ServiceSupervisor:
         self.generation: Dict[str, int] = {r: 0 for r in self.roles}
         self.restarts: Dict[str, int] = {r: 0 for r in self.roles}
         self.events: List[str] = []
+        # Timestamped twin of `events` (the fault/recovery timeline
+        # chaos_run renders; events stays the stable string API).
+        self.timeline: List[Tuple[float, str]] = []
+        self._monitor = None
         os.makedirs(os.path.join(shared_dir, "hb"), exist_ok=True)
+
+    def _event(self, text: str) -> None:
+        self.events.append(text)
+        self.timeline.append((time.time(), text))
 
     # ------------------------------------------------------------ spawn
 
@@ -521,14 +614,16 @@ class ServiceSupervisor:
                  "--role", role, "--dir", self.shared_dir,
                  "--owner", owner, "--ttl", str(self.ttl_s),
                  "--batch", str(self.batch),
-                 "--impl", self.deli_impl],
+                 "--impl", self.deli_impl,
+                 "--ckpt-interval", str(self.ckpt_interval_s),
+                 "--ckpt-bytes", str(self.ckpt_bytes)],
                 stdout=subprocess.PIPE, text=True,
                 cwd=self._repo_root(),
                 env=dict(os.environ, JAX_PLATFORMS="cpu"),
             )
         except OSError as exc:
             self.procs[role] = None
-            self.events.append(f"spawn {owner} FAILED ({exc!r})")
+            self._event(f"spawn {owner} FAILED ({exc!r})")
             return None
         # Bounded READY wait: a child wedged before its banner must
         # not freeze the whole monitor loop.
@@ -543,10 +638,10 @@ class ServiceSupervisor:
             except OSError:
                 pass
             self.procs[role] = None
-            self.events.append(f"spawn {owner} FAILED ({line!r})")
+            self._event(f"spawn {owner} FAILED ({line!r})")
             return None
         self.procs[role] = proc
-        self.events.append(f"spawn {owner}")
+        self._event(f"spawn {owner}")
         return proc
 
     def start(self) -> "ServiceSupervisor":
@@ -619,7 +714,7 @@ class ServiceSupervisor:
                 f" [{tail.splitlines()[-1]}]" if tail else ""
             )
             self.restarts[role] += 1
-            self.events.append(event)
+            self._event(event)
             acted.append(event)
             self._spawn(role)
         return acted
@@ -633,7 +728,82 @@ class ServiceSupervisor:
             self.poll_once()
             time.sleep(poll_interval_s)
 
+    # ---------------------------------------------------- observability
+
+    def child_metrics(self) -> Dict[str, dict]:
+        """Each role's last heartbeat metrics snapshot (children report
+        up through the heartbeat channel; absent/torn files skip)."""
+        out: Dict[str, dict] = {}
+        for role in self.roles:
+            try:
+                with open(os.path.join(
+                    self.shared_dir, "hb", f"{role}.json"
+                )) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                continue
+            snap = hb.get("metrics")
+            if isinstance(snap, dict):
+                out[role] = snap
+        return out
+
+    def collect_metrics(self):
+        """A fresh registry merging every child's heartbeat snapshot
+        with the supervisor's own gauges — rebuilt per call, so a
+        /metrics scrape always reflects the latest heartbeats without
+        double counting."""
+        from ..utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        for snap in self.child_metrics().values():
+            reg.merge(snap)
+        for role in self.roles:
+            reg.gauge("supervisor_restarts", role=role).set(
+                self.restarts[role]
+            )
+            proc = self.procs.get(role)
+            alive = proc is not None and proc.poll() is None
+            reg.gauge("supervisor_child_alive", role=role).set(
+                1.0 if alive else 0.0
+            )
+            reg.gauge("supervisor_heartbeat_age_s", role=role).set(
+                round(self._heartbeat_age(role), 3)
+            )
+        return reg
+
+    def health(self) -> Dict[str, Any]:
+        roles: Dict[str, Any] = {}
+        ok = True
+        for role in self.roles:
+            proc = self.procs.get(role)
+            alive = proc is not None and proc.poll() is None
+            age = self._heartbeat_age(role)
+            stale = age > self.heartbeat_timeout_s
+            roles[role] = {
+                "alive": alive, "heartbeat_age_s": round(age, 3),
+                "restarts": self.restarts[role],
+            }
+            ok = ok and alive and not stale
+        return {"status": "ok" if ok else "degraded", "roles": roles,
+                "deli_impl": self.deli_impl}
+
+    def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
+        """The farm's live ops endpoint: `/metrics` merges the
+        children's heartbeat-reported registries per scrape; `/healthz`
+        reports per-role liveness. Returns the `monitor.MetricsServer`."""
+        if self._monitor is None:
+            from .monitor import MetricsServer
+
+            self._monitor = MetricsServer(
+                registry=self.collect_metrics, health=self.health,
+                host=host, port=port,
+            ).start()
+        return self._monitor
+
     def stop(self) -> None:
+        if self._monitor is not None:
+            self._monitor.stop()
+            self._monitor = None
         for role, proc in list(self.procs.items()):
             if proc is None:
                 continue
@@ -668,17 +838,21 @@ def main(argv: Optional[List[str]] = None) -> None:
     ttl = float(_take("--ttl", "1.0"))
     batch = int(_take("--batch", "512"))
     impl = _take("--impl") or os.environ.get("FLUID_DELI", "scalar")
+    ckpt_interval = float(_take("--ckpt-interval", "0.25"))
+    ckpt_bytes = int(_take("--ckpt-bytes", str(256 * 1024)))
     if (role not in ROLE_CLASSES or shared_dir is None
             or impl not in DELI_IMPLS):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster} --dir D "
-            "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel]",
+            "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
+            "[--ckpt-interval S] [--ckpt-bytes N]",
             file=sys.stderr,
         )
         raise SystemExit(2)
     serve_role(shared_dir, role, owner, ttl_s=ttl, batch=batch,
-               deli_impl=impl)
+               deli_impl=impl, ckpt_interval_s=ckpt_interval,
+               ckpt_bytes=ckpt_bytes)
 
 
 if __name__ == "__main__":

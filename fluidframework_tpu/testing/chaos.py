@@ -95,6 +95,13 @@ class ChaosResult:
     restarts: Dict[str, int]
     events: List[str] = field(default_factory=list)
     detail: str = ""
+    # Fault/recovery timeline: (unix_ts, event) across harness faults
+    # and supervisor actions, time-ordered (chaos_run renders it).
+    timeline: List[Tuple[float, str]] = field(default_factory=list)
+    # Merged utils.metrics snapshot from every role's final heartbeat
+    # (per-stage pump sizes, checkpoint bytes/durations, fence
+    # rejections...) — `utils.metrics.format_report([metrics])` prints.
+    metrics: Dict[str, Any] = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------
@@ -320,6 +327,12 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     )
     fence_rejections = 0
     events: List[str] = []
+    timeline: List[Tuple[float, str]] = []
+
+    def note(ev: str) -> None:
+        events.append(ev)
+        timeline.append((time.time(), ev))
+
     try:
         fed_idx = 0
         pending_dups: Dict[int, List[dict]] = {}
@@ -338,15 +351,15 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
                     proc = sup.procs.get(role)
                     if proc is not None and proc.poll() is None:
                         proc.kill()
-                        events.append(f"chaos: SIGKILL {role}")
+                        note(f"chaos: SIGKILL {role}")
                 if torn_at and torn_at[0] == fed_idx:
                     torn_at.pop(0)
                     inject_torn_append(raw.path)
                     inject_torn_append(deltas_path)
-                    events.append("chaos: torn append")
+                    note("chaos: torn append")
                 if lease_at == fed_idx:
                     fence_rejections += _lease_takeover(
-                        shared, sup, cfg, events
+                        shared, sup, cfg, note
                     )
                 fed_idx += 1
             # Drain any resubmissions scheduled past the last chunk.
@@ -401,17 +414,32 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         f"ops={len(ops)}/{expected} restarts={sup.restarts} "
         f"events={events + sup.events}"
     )
+    # Observability artifacts: merge every role's final
+    # heartbeat-reported metrics snapshot (the same channel the
+    # supervisor's /metrics scrape uses) and time-sort the fault +
+    # supervisor timeline. With a kept shared_dir, the per-role
+    # snapshots also land in <dir>/metrics.jsonl for
+    # tools/metrics_report.py.
+    from ..utils.metrics import dump_snapshot_line, merge_snapshots
+
+    role_snaps = sup.child_metrics()
+    metrics = merge_snapshots(role_snaps.values()).snapshot()
+    if cfg.shared_dir is not None:
+        mpath = os.path.join(shared, "metrics.jsonl")
+        for role, snap in role_snaps.items():
+            dump_snapshot_line(mpath, snap, source=f"chaos-{role}")
     return ChaosResult(
         converged=converged, digest=digest, golden_digest=gdigest,
         client_digest=client_digest, scribe_ok=scribe_ok,
         duplicate_seqs=dups, skipped_seqs=skips,
         fence_rejections=fence_rejections, restarts=dict(sup.restarts),
         events=events + list(sup.events), detail=detail,
+        timeline=sorted(timeline + sup.timeline), metrics=metrics,
     )
 
 
 def _lease_takeover(shared: str, sup: ServiceSupervisor,
-                    cfg: ChaosConfig, events: List[str]) -> int:
+                    cfg: ChaosConfig, note) -> int:
     """The expired-lease fault: SIGSTOP the sequencer past its TTL, a
     usurper takes its lease and binds the next fence on the write
     paths, and the deposed owner's writes must be REJECTED. Returns
@@ -429,7 +457,7 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
     deltas = SharedFileTopic(os.path.join(shared, "topics", "deltas.jsonl"))
     old_fence, old_owner = deltas.latest_fence()
     os.kill(deli.pid, signal.SIGSTOP)
-    events.append("chaos: SIGSTOP deli (stale lease)")
+    note("chaos: SIGSTOP deli (stale lease)")
     zombie_alive = True
 
     def kill_zombie(why: str) -> None:
@@ -442,7 +470,7 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
         except OSError:
             pass
         zombie_alive = False
-        events.append(f"chaos: zombie deli killed ({why})")
+        note(f"chaos: zombie deli killed ({why})")
 
     try:
         usurper = LeaseManager(
@@ -468,7 +496,7 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
             fence = acquire(6 * cfg.ttl_s)
         if fence is None:
             return 0
-        events.append(f"chaos: usurper took deli lease (fence {fence})")
+        note(f"chaos: usurper took deli lease (fence {fence})")
         # Bind the new fence on the write paths (an empty fenced append
         # gates without writing), exactly what a real successor's first
         # batch does — bounded, in case the zombie holds the lock.
@@ -497,14 +525,14 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
                 )
             except FencedError:
                 rejections += 1
-                events.append("chaos: deposed topic write REJECTED")
+                note("chaos: deposed topic write REJECTED")
             if env is not None:
                 try:
                     ckpt.save("deli", env["state"], fence=old_fence,
                               owner=old_owner)
                 except FencedError:
                     rejections += 1
-                    events.append("chaos: deposed checkpoint REJECTED")
+                    note("chaos: deposed checkpoint REJECTED")
         usurper.release("deli")
     finally:
         if zombie_alive:
@@ -512,5 +540,5 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
                 os.kill(deli.pid, signal.SIGCONT)
             except OSError:
                 pass
-            events.append("chaos: SIGCONT deli")
+            note("chaos: SIGCONT deli")
     return rejections

@@ -5,7 +5,11 @@ clients shape), not the naked kernel: records are read from a durable
 `SharedFileTopic` raw topic (JSON parse included), ticketed, and the
 stamped/nacked records written to a durable deltas topic — the exact
 datapath the supervised farm's deli role runs (`server.supervisor`),
-minus lease upkeep and checkpoint cadence (policy, not datapath).
+including its checkpoint policy (time/byte cadence by default; the
+seed's every-step policy is measured alongside as the ROADMAP item (b)
+comparison), minus lease upkeep only. The report attaches a per-stage
+wall-time breakdown (poll/parse, process+kernel, append, checkpoint)
+and the run's checkpoint write/byte counters from `utils.metrics`.
 
 Three variants over the identical pre-built workload:
 
@@ -73,10 +77,21 @@ def _make_role(impl: str, scratch: str):
 
 def run_pipeline(impl: str, raw_path: str, out_dir: str,
                  batch: int = 8192, per_record_append: bool = False,
-                 max_records: Optional[int] = None) -> dict:
+                 max_records: Optional[int] = None,
+                 checkpoint_mode: Optional[str] = "cadence") -> dict:
     """Drive one deli variant raw-topic-in → deltas-topic-out.
-    Returns {"seconds", "records", "outputs", "out_path"}."""
+
+    `checkpoint_mode` selects the farm's checkpoint policy inside the
+    timed region: "cadence" (time/byte-based, `_Role.maybe_checkpoint`
+    — the production default), "pump" (one fenced checkpoint per pump,
+    the seed's every-step behavior), or None (no checkpoints).
+
+    Returns {"seconds", "records", "outputs", "out_path", "stages",
+    "metrics"} — `stages` is the per-stage wall-time breakdown (poll/
+    parse, process+kernel, append, checkpoint) and `metrics` the run's
+    checkpoint counters from an isolated registry."""
     from ..server.queue import SharedFileTopic, TailReader
+    from ..utils import metrics as _metrics
 
     raw = SharedFileTopic(raw_path)
     out_path = os.path.join(out_dir, f"deltas-{impl}"
@@ -84,10 +99,21 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
     if os.path.exists(out_path):
         os.remove(out_path)
     deltas = SharedFileTopic(out_path)
-    role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"))
+    # Isolated registry: this run's checkpoint/pump counters are not
+    # polluted by (and do not pollute) other runs in the process.
+    reg = _metrics.MetricsRegistry()
+    prev_reg = _metrics.set_registry(reg)
+    try:
+        role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"))
+    finally:
+        _metrics.set_registry(prev_reg)
+    # The bench drives the role datapath directly (no lease loop);
+    # bind a fence so fenced checkpoint writes work.
+    role.fence = 1
     reader = TailReader(raw)
     n_records = 0
     n_out = 0
+    t_poll = t_proc = t_append = t_ckpt = 0.0
     t0 = time.perf_counter()
     while True:
         cap = batch
@@ -95,23 +121,53 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
             cap = min(cap, max_records - n_records)
             if cap <= 0:
                 break
+        t1 = time.perf_counter()
         entries = reader.poll(cap)
+        t2 = time.perf_counter()
+        t_poll += t2 - t1
         if not entries:
             break
         out: List[dict] = []
         for line_idx, rec in entries:
             role.process(line_idx, rec, out)
         role.flush_batch(out)
+        t3 = time.perf_counter()
+        t_proc += t3 - t2
         if per_record_append:
             for r in out:  # the seed pipeline: one lock+fsync each
-                deltas.append(r)
+                role._ckpt_pending_bytes += deltas.append(r)
         else:
-            deltas.append_many(out)
+            role._ckpt_pending_bytes += deltas.append_many(out)
+        t4 = time.perf_counter()
+        t_append += t4 - t3
+        role.offset = reader.next_line
+        if checkpoint_mode is not None:
+            role._ckpt_dirty = True
+            if checkpoint_mode == "pump":
+                role.checkpoint()
+            else:
+                role.maybe_checkpoint()
+            t_ckpt += time.perf_counter() - t4
         n_records += len(entries)
         n_out += len(out)
     seconds = time.perf_counter() - t0
+    ckpt = {
+        "writes": int(reg.counter(
+            "checkpoint_writes_total", role="deli").value),
+        "bytes": int(reg.counter(
+            "checkpoint_bytes_total", role="deli").value),
+        "seconds": round(t_ckpt, 4),
+        "mode": checkpoint_mode,
+    }
     return {"seconds": seconds, "records": n_records, "outputs": n_out,
-            "out_path": out_path}
+            "out_path": out_path,
+            "stages": {
+                "poll_parse_s": round(t_poll, 4),
+                "process_kernel_s": round(t_proc, 4),
+                "append_s": round(t_append, 4),
+                "checkpoint_s": round(t_ckpt, 4),
+            },
+            "metrics": {"checkpoint": ckpt}}
 
 
 def _read_canonical(path: str) -> List[dict]:
@@ -160,15 +216,22 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
                 f"({n} records differ; {len(a)} vs {len(b)})"
             )
 
+        # ROADMAP item (b) evidence: the same kernel run with the
+        # seed's every-step checkpoint policy — the checkpoint
+        # counters show the cadence win (writes/bytes collapse).
+        kern_every = run_pipeline("kernel", raw_path, scratch,
+                                  batch=batch, checkpoint_mode="pump")
+
         seed_run = run_pipeline(
             "scalar", raw_path, scratch, batch=batch,
-            per_record_append=True,
+            per_record_append=True, checkpoint_mode="pump",
             max_records=min(seed_records, len(workload)),
         )
 
         kernel_ops = kern["records"] / kern["seconds"]
         scalar_ops = scal["records"] / scal["seconds"]
         seed_ops = seed_run["records"] / seed_run["seconds"]
+        every_ops = kern_every["records"] / kern_every["seconds"]
         return {
             "metric": "deli_pipeline_raw_to_deltas",
             "docs": n_docs, "clients_per_doc": n_clients,
@@ -179,6 +242,15 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
             "seed_records_measured": seed_run["records"],
             "vs_baseline": round(kernel_ops / seed_ops, 2),
             "vs_scalar_batched": round(kernel_ops / scalar_ops, 2),
+            # Per-stage wall-time breakdown of the timed kernel run
+            # (where a sequenced record's time goes inside the pump).
+            "stage_breakdown": kern["stages"],
+            # Checkpoint cadence (ROADMAP (b)): time/byte-based vs the
+            # seed's every-step policy, counters from utils.metrics.
+            "ckpt_cadence": kern["metrics"]["checkpoint"],
+            "ckpt_every_pump": kern_every["metrics"]["checkpoint"],
+            "ckpt_every_pump_ops_per_sec": round(every_ops, 1),
+            "vs_ckpt_every_pump": round(kernel_ops / every_ops, 2),
             "gate": "bit-identical",
             "unit": "records/s",
         }

@@ -1,0 +1,457 @@
+"""Process-wide metrics: counters, gauges, fixed-bucket histograms.
+
+The reference service emits structured per-operation metrics through
+Lumberjack (server/routerlicious/packages/services-telemetry) and the
+client stamps `ISequencedDocumentMessage.traces` for per-stage
+latency. `utils.telemetry` mirrors the *event* side of that; this
+module is the *aggregation* side: a lock-safe `MetricsRegistry` of
+counters, gauges, and fixed-bucket histograms, labeled by
+(role, doc, stage, ...), with Prometheus-text and JSON snapshot
+encoders.
+
+Design constraints (the observability contract of ISSUE 3):
+
+- **Cheap** — instruments are plain attribute bumps under one lock;
+  hot paths cache instrument objects at construction and record
+  per-pump aggregates, never per-record work on the kernel path. A
+  `set_enabled(False)` switch swaps the default registry for a no-op
+  `NullRegistry` (the bench overhead guard measures against it).
+- **Deterministic-safe** — metrics are observational only: nothing
+  here feeds back into sequencing, so stamped output and chaos golden
+  digests are unchanged with instrumentation on.
+- **Per-process with explicit merge** — registries do NOT share state
+  across processes; supervised children snapshot their registry into
+  their heartbeat file and the supervisor folds the snapshots with
+  `MetricsRegistry.merge` (counters/histograms add, gauges last-write).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from bisect import bisect_left
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS_MS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "NullRegistry",
+    "dump_snapshot_line",
+    "format_report",
+    "get_registry",
+    "histogram_quantile",
+    "merge_snapshots",
+    "set_enabled",
+    "set_registry",
+]
+
+# Fixed latency buckets (ms): sub-millisecond ticks through 10s tails.
+DEFAULT_LATENCY_BUCKETS_MS = (
+    0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+
+def _label_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotone counter."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str], lock):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self._lock = lock
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """Point-in-time value."""
+
+    __slots__ = ("name", "labels", "value", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str], lock):
+        self.name = name
+        self.labels = labels
+        self.value = 0.0
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus `le`-inclusive upper bounds
+    plus an implicit +Inf overflow bucket)."""
+
+    __slots__ = ("name", "labels", "bounds", "counts", "sum", "count",
+                 "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str],
+                 bounds: Tuple[float, ...], lock):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(float(b) for b in bounds)
+        if list(self.bounds) != sorted(set(self.bounds)):
+            raise ValueError(f"histogram bounds must be sorted unique: {bounds}")
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+        self._lock = lock
+
+    def observe(self, v: float) -> None:
+        # bisect_left: v == bound lands IN that bucket (le-inclusive).
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+
+class _NullInstrument:
+    """Shared no-op instrument (disabled-registry mode)."""
+
+    __slots__ = ()
+    value = 0.0
+    sum = 0.0
+    count = 0
+
+    def inc(self, n: float = 1.0) -> None:
+        pass
+
+    def dec(self, n: float = 1.0) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+
+_NULL_INSTRUMENT = _NullInstrument()
+
+
+class NullRegistry:
+    """Registry whose instruments do nothing — `set_enabled(False)`
+    makes `get_registry()` return one, so instrumented components pay a
+    single no-op call per record/pump."""
+
+    namespace = "fluid"
+
+    def counter(self, name: str, **labels) -> _NullInstrument:
+        return _NULL_INSTRUMENT
+
+    def gauge(self, name: str, **labels) -> _NullInstrument:
+        return _NULL_INSTRUMENT
+
+    def histogram(self, name: str, buckets=None, **labels) -> _NullInstrument:
+        return _NULL_INSTRUMENT
+
+    def snapshot(self) -> dict:
+        return {"counters": [], "gauges": [], "histograms": []}
+
+    def merge(self, snap: dict) -> None:
+        pass
+
+    def to_prometheus(self) -> str:
+        return ""
+
+    def reset(self) -> None:
+        pass
+
+
+class MetricsRegistry:
+    """Lock-safe instrument registry with deterministic snapshots.
+
+    One instance per process; instruments are create-or-return by
+    (kind, name, labels) so call sites can either cache the instrument
+    (hot paths) or re-look it up (cold paths)."""
+
+    def __init__(self, namespace: str = "fluid"):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._instruments: Dict[tuple, Any] = {}
+
+    # ------------------------------------------------------ instruments
+
+    def _get(self, kind: str, cls, name: str, labels: Dict[str, Any],
+             *args):
+        key = (kind, name, _label_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                for other_kind in ("counter", "gauge", "histogram"):
+                    if other_kind != kind and any(
+                        k[0] == other_kind and k[1] == name
+                        for k in self._instruments
+                    ):
+                        raise ValueError(
+                            f"metric {name!r} already registered as "
+                            f"{other_kind}, not {kind}"
+                        )
+                inst = cls(name, dict(key[2]), *args, self._lock)
+                self._instruments[key] = inst
+            return inst
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get("counter", Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get("gauge", Gauge, name, labels)
+
+    def histogram(self, name: str,
+                  buckets: Optional[Iterable[float]] = None,
+                  **labels) -> Histogram:
+        bounds = tuple(buckets) if buckets else DEFAULT_LATENCY_BUCKETS_MS
+        h = self._get("histogram", Histogram, name, labels, bounds)
+        if h.bounds != tuple(float(b) for b in bounds):
+            raise ValueError(
+                f"histogram {name!r} re-registered with different buckets"
+            )
+        return h
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+    # --------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """JSON-able, deterministic (sorted) state of every instrument."""
+        counters, gauges, histograms = [], [], []
+        with self._lock:
+            items = sorted(self._instruments.items())
+        for (kind, name, labels), inst in items:
+            entry = {"name": name, "labels": dict(labels)}
+            if kind == "counter":
+                counters.append({**entry, "value": inst.value})
+            elif kind == "gauge":
+                gauges.append({**entry, "value": inst.value})
+            else:
+                histograms.append({
+                    **entry, "buckets": list(inst.bounds),
+                    "counts": list(inst.counts), "sum": inst.sum,
+                    "count": inst.count,
+                })
+        return {"counters": counters, "gauges": gauges,
+                "histograms": histograms}
+
+    def merge(self, snap: dict) -> None:
+        """Fold a child's `snapshot()` in: counters and histogram
+        buckets ADD, gauges take the snapshot's value (children report
+        disjoint label sets — e.g. role=... — so last-write is safe)."""
+        for c in snap.get("counters", ()):
+            self.counter(c["name"], **c["labels"]).inc(c["value"])
+        for g in snap.get("gauges", ()):
+            self.gauge(g["name"], **g["labels"]).set(g["value"])
+        for h in snap.get("histograms", ()):
+            inst = self.histogram(h["name"], buckets=h["buckets"],
+                                  **h["labels"])
+            with inst._lock:
+                for i, n in enumerate(h["counts"]):
+                    inst.counts[i] += n
+                inst.sum += h["sum"]
+                inst.count += h["count"]
+
+    # ------------------------------------------------------- exposition
+
+    @staticmethod
+    def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+        parts = []
+        for k, v in sorted(labels.items()):
+            esc = str(v).replace("\\", "\\\\").replace('"', '\\"')
+            parts.append('%s="%s"' % (k, esc))
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    @staticmethod
+    def _fmt_num(v: float) -> str:
+        return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (one scrape body)."""
+        snap = self.snapshot()
+        out: List[str] = []
+        seen_type: set = set()
+
+        def full(name: str) -> str:
+            return f"{self.namespace}_{name}" if self.namespace else name
+
+        for kind in ("counters", "gauges"):
+            ptype = "counter" if kind == "counters" else "gauge"
+            for m in snap[kind]:
+                fname = full(m["name"])
+                if fname not in seen_type:
+                    out.append(f"# TYPE {fname} {ptype}")
+                    seen_type.add(fname)
+                out.append(
+                    f"{fname}{self._fmt_labels(m['labels'])} "
+                    f"{self._fmt_num(m['value'])}"
+                )
+        for m in snap["histograms"]:
+            fname = full(m["name"])
+            if fname not in seen_type:
+                out.append(f"# TYPE {fname} histogram")
+                seen_type.add(fname)
+            cum = 0
+            for bound, n in zip(
+                list(m["buckets"]) + ["+Inf"], m["counts"]
+            ):
+                cum += n
+                le = bound if bound == "+Inf" else self._fmt_num(bound)
+                le_label = 'le="%s"' % le
+                out.append(
+                    f"{fname}_bucket"
+                    f"{self._fmt_labels(m['labels'], le_label)} {cum}"
+                )
+            out.append(
+                f"{fname}_sum{self._fmt_labels(m['labels'])} "
+                f"{self._fmt_num(m['sum'])}"
+            )
+            out.append(
+                f"{fname}_count{self._fmt_labels(m['labels'])} {m['count']}"
+            )
+        return "\n".join(out) + ("\n" if out else "")
+
+
+# ---------------------------------------------------------------------------
+# default registry + enable switch
+# ---------------------------------------------------------------------------
+
+_default_registry: Any = MetricsRegistry()
+_null_registry = NullRegistry()
+_enabled = True
+
+
+def get_registry():
+    """The process's default registry (a `NullRegistry` while
+    `set_enabled(False)` is in effect)."""
+    return _default_registry if _enabled else _null_registry
+
+
+def set_registry(registry) -> Any:
+    """Swap the default registry; returns the previous one (bench
+    isolation: fresh registry per measured run)."""
+    global _default_registry
+    old = _default_registry
+    _default_registry = registry
+    return old
+
+
+def set_enabled(flag: bool) -> bool:
+    """Toggle instrumentation process-wide. Components that cached
+    instruments keep them; components constructed while disabled get
+    no-ops. Returns the previous setting."""
+    global _enabled
+    old = _enabled
+    _enabled = bool(flag)
+    return old
+
+
+# ---------------------------------------------------------------------------
+# snapshot files + reporting (tools/metrics_report.py backend)
+# ---------------------------------------------------------------------------
+
+
+def dump_snapshot_line(path: str, snapshot: dict, **meta) -> None:
+    """Append one JSONL line `{"t": ..., **meta, "snapshot": ...}` —
+    the run-artifact form `tools/metrics_report.py` renders."""
+    with open(path, "a") as f:
+        f.write(json.dumps({"t": time.time(), **meta,
+                            "snapshot": snapshot}) + "\n")
+
+
+def merge_snapshots(snapshots: Iterable[dict]) -> MetricsRegistry:
+    """Fold snapshots (or metrics.jsonl line dicts) into one registry."""
+    reg = MetricsRegistry()
+    for snap in snapshots:
+        reg.merge(snap.get("snapshot", snap))
+    return reg
+
+
+def histogram_quantile(h: dict, q: float) -> float:
+    """Estimate quantile `q` from a snapshot histogram entry by linear
+    interpolation within its bucket; `inf` if it lands in overflow."""
+    total = h["count"]
+    if total <= 0:
+        return 0.0
+    target = q * total
+    bounds = h["buckets"]
+    cum = 0
+    for i, n in enumerate(h["counts"]):
+        if n == 0:
+            continue
+        if cum + n >= target:
+            if i >= len(bounds):
+                return float("inf")
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * max(0.0, target - cum) / n
+        cum += n
+    return float("inf")
+
+
+def _fmt_ms(v: float) -> str:
+    if v == float("inf"):
+        return ">max"
+    if v >= 100:
+        return f"{v:.0f}"
+    return f"{v:.2f}"
+
+
+def format_report(snapshots: Iterable[dict]) -> str:
+    """Human table over merged snapshots: per-stage latency histograms
+    (count/mean/p50/p90/p99), then counters and gauges."""
+    snap = merge_snapshots(snapshots).snapshot()
+    lines: List[str] = []
+
+    def label_str(labels: dict) -> str:
+        return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+    hists = [h for h in snap["histograms"] if h["count"] > 0]
+    if hists:
+        lines.append(
+            f"{'histogram':<26} {'labels':<34} {'count':>9} "
+            f"{'mean':>9} {'p50':>9} {'p90':>9} {'p99':>9}"
+        )
+        for h in hists:
+            lines.append(
+                f"{h['name']:<26} {label_str(h['labels']):<34} "
+                f"{h['count']:>9} {_fmt_ms(h['sum'] / h['count']):>9} "
+                f"{_fmt_ms(histogram_quantile(h, 0.5)):>9} "
+                f"{_fmt_ms(histogram_quantile(h, 0.9)):>9} "
+                f"{_fmt_ms(histogram_quantile(h, 0.99)):>9}"
+            )
+    rows = [("counter", c) for c in snap["counters"] if c["value"]]
+    rows += [("gauge", g) for g in snap["gauges"]]
+    if rows:
+        if hists:
+            lines.append("")
+        lines.append(f"{'kind':<8} {'metric':<30} {'labels':<34} {'value':>12}")
+        for kind, m in rows:
+            lines.append(
+                f"{kind:<8} {m['name']:<30} {label_str(m['labels']):<34} "
+                f"{MetricsRegistry._fmt_num(m['value']):>12}"
+            )
+    return "\n".join(lines) if lines else "(no metrics recorded)"

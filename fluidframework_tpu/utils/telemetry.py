@@ -113,13 +113,37 @@ class MockLogger(TelemetryLogger):
 
 class Lumberjack:
     """Structured server metrics (services-telemetry): named metrics
-    with properties + success/failure terminal states."""
+    with properties + success/failure terminal states.
+
+    `_sinks` is deliberately class-level (the reference Lumberjack is a
+    process-global singleton), which makes sink hygiene the caller's
+    job: a test that `add_sink`s and never removes leaks its sink into
+    every later metric in the process. `remove_sink`/`reset` exist so
+    callers can clean up; both mutate the SHARED list in place, so
+    in-flight `LumberMetric`s (which hold a reference to it) see the
+    change too."""
 
     _sinks: List[Callable[[dict], None]] = []
 
     @classmethod
     def add_sink(cls, fn: Callable[[dict], None]) -> None:
         cls._sinks.append(fn)
+
+    @classmethod
+    def remove_sink(cls, fn: Callable[[dict], None]) -> None:
+        """Detach one sink; unknown sinks are a no-op (idempotent
+        teardown)."""
+        try:
+            cls._sinks.remove(fn)
+        except ValueError:
+            pass
+
+    @classmethod
+    def reset(cls) -> None:
+        """Drop every sink (test-suite teardown). In place: metrics
+        created before the reset stop emitting rather than holding a
+        stale sink list."""
+        cls._sinks.clear()
 
     @classmethod
     def new_metric(cls, name: str, **props) -> "LumberMetric":

@@ -234,13 +234,31 @@ def test_telemetry_hierarchy_and_perf():
 
 def test_lumberjack_metrics():
     events = []
+    # Lumberjack sinks are process-global; detach in teardown or this
+    # test's sink would observe every later test's metrics.
     Lumberjack.add_sink(events.append)
-    m = Lumberjack.new_metric("DeliProcessBatch", doc="d1")
-    m.set_property("ops", 42)
-    m.success("done")
-    assert events[-1]["metric"] == "DeliProcessBatch"
-    assert events[-1]["status"] == "success"
-    assert events[-1]["ops"] == 42
+    try:
+        m = Lumberjack.new_metric("DeliProcessBatch", doc="d1")
+        m.set_property("ops", 42)
+        m.success("done")
+        assert events[-1]["metric"] == "DeliProcessBatch"
+        assert events[-1]["status"] == "success"
+        assert events[-1]["ops"] == 42
+    finally:
+        Lumberjack.remove_sink(events.append)
+    # The detached sink no longer observes anything.
+    n = len(events)
+    Lumberjack.new_metric("AfterDetach").success()
+    assert len(events) == n
+    # remove_sink is idempotent; reset clears in place so in-flight
+    # metrics (holding the shared list) stop emitting too.
+    Lumberjack.remove_sink(events.append)
+    other = []
+    Lumberjack.add_sink(other.append)
+    inflight = Lumberjack.new_metric("InFlight")
+    Lumberjack.reset()
+    inflight.success()
+    assert other == [] and Lumberjack._sinks == []
 
 
 def test_config_provider_layering():

@@ -43,6 +43,7 @@ PACKAGES = [
     "fluidframework_tpu.drivers",
     "fluidframework_tpu.server",
     "fluidframework_tpu.server.deli_kernel",
+    "fluidframework_tpu.server.monitor",
     "fluidframework_tpu.server.riddler",
     "fluidframework_tpu.server.supervisor",
     "fluidframework_tpu.framework",
@@ -50,6 +51,7 @@ PACKAGES = [
     "fluidframework_tpu.protocol",
     "fluidframework_tpu.testing",
     "fluidframework_tpu.utils",
+    "fluidframework_tpu.utils.metrics",
 ]
 
 REPORT_DIR = os.path.join(

@@ -22,6 +22,9 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   its LIVE-pipeline twin (raw topic → stamped deltas through the
   supervised deli datapath, kernel vs scalar pump, bit-identity
   gated — tools/bench_deli.py at full scale).
+- metrics-overhead guard: the instrumented config-5 pipeline
+  (utils.metrics on, the default) vs the same run with the no-op
+  registry; FAILS LOUDLY if instrumentation costs more than 5%.
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -246,6 +249,65 @@ def config5_deli_pipeline(n_docs: int = 4_000, n_clients: int = 32) -> dict:
     }
 
 
+def config5_metrics_overhead(n_docs: int = 2_000, n_clients: int = 32,
+                             max_pct: float = 5.0,
+                             attempts: int = 3) -> dict:
+    """Observability overhead guard: the instrumented config-5 deli
+    pipeline (utils.metrics ON, the default) must stay within
+    `max_pct` percent of the uninstrumented run (`set_enabled(False)`
+    swaps in the no-op NullRegistry). Best-of-N per mode to damp I/O
+    jitter; FAILS LOUDLY (AssertionError) on regression, so the bench
+    harness catches an instrumentation hot-path leak the moment it
+    lands."""
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.server.queue import SharedFileTopic
+    from fluidframework_tpu.testing.deli_bench import (
+        build_pipeline_workload,
+        run_pipeline,
+    )
+    from fluidframework_tpu.utils import metrics as M
+
+    n_docs = max(8, int(n_docs * SCALE))
+    scratch = tempfile.mkdtemp(prefix="metrics-overhead-")
+    try:
+        workload = build_pipeline_workload(n_docs, n_clients, 1)
+        raw_path = os.path.join(scratch, "rawdeltas.jsonl")
+        SharedFileTopic(raw_path).append_many(workload)
+        run_pipeline("kernel", raw_path, scratch)  # jit warm-up
+
+        def best(enabled: bool) -> float:
+            prev = M.set_enabled(enabled)
+            try:
+                return min(
+                    run_pipeline("kernel", raw_path, scratch)["seconds"]
+                    for _ in range(attempts)
+                )
+            finally:
+                M.set_enabled(prev)
+
+        with_metrics = best(True)
+        without = best(False)
+        overhead_pct = (with_metrics / without - 1.0) * 100.0
+        result = {
+            "config": "deli_pipeline_metrics_overhead_guard",
+            "records": len(workload),
+            "instrumented_s": round(with_metrics, 4),
+            "uninstrumented_s": round(without, 4),
+            "overhead_pct": round(overhead_pct, 2),
+            "max_pct": max_pct,
+            "ops_per_sec": round(len(workload) / with_metrics, 1),
+        }
+        assert overhead_pct <= max_pct, (
+            f"instrumentation overhead {overhead_pct:.2f}% exceeds the "
+            f"{max_pct}% budget on the config-5 deli pipeline: {result}"
+        )
+        return result
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -323,7 +385,7 @@ def main() -> None:
     results = []
     for fn in (config1_sharedstring_2client, config3_matrix,
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
-               config_streaming_ingress):
+               config5_metrics_overhead, config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

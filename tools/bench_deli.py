@@ -19,6 +19,12 @@ durable `SharedFileTopic`s) with three sequencer variants on the same
 A correctness gate asserts kernel and scalar deltas topics are
 bit-identical (stamps, nack codes, MSNs) before reporting.
 
+Observability riders (ISSUE 3): `stage_breakdown` (per-stage wall time
+— poll/parse, process+kernel, append, checkpoint), and the checkpoint
+cadence comparison `ckpt_cadence` vs `ckpt_every_pump` (time/byte
+cadence vs the seed's every-step policy, counters from utils.metrics —
+ROADMAP item (b)).
+
 Env knobs: BD_DOCS (10000), BD_CLIENTS (64), BD_OPS (ops/client, 1),
 BD_SEED_RECORDS (400), BD_BATCH (8192), BD_SCALE (workload shrink).
 

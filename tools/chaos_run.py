@@ -2,7 +2,7 @@
 
 Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
-        [--deli scalar|kernel]
+        [--deli scalar|kernel] [--metrics-out PATH]
 
 `--deli kernel` runs the farm with the batched TPU sequencer
 (server.deli_kernel.KernelDeliRole) in place of the scalar deli; the
@@ -18,7 +18,15 @@ and zero skipped sequence numbers. Exit code 0 iff converged — the CI
 gate form of tests/test_chaos_recovery.py.
 
 `--keep DIR` runs in DIR and leaves the topics/checkpoints/lease files
-behind for post-mortem (default: a throwaway temp dir).
+(plus `metrics.jsonl` role snapshots) behind for post-mortem (default:
+a throwaway temp dir).
+
+Observability: the report includes the fault/recovery TIMELINE
+(timestamped chaos faults + supervisor restarts) and a metrics table
+(role pump sizes, checkpoint writes/bytes/durations, fence rejections)
+merged from every role's final heartbeat snapshot. `--metrics-out
+PATH` appends the merged snapshot as one JSONL line for
+tools/metrics_report.py.
 """
 
 from __future__ import annotations
@@ -48,6 +56,7 @@ def main() -> int:
         return default
 
     seed = int(_take("--seed", "0"))
+    metrics_out = _take("--metrics-out", None)
     faults = tuple(
         f for f in _take("--faults", ",".join(FAULT_CLASSES)).split(",") if f
     )
@@ -83,8 +92,29 @@ def main() -> int:
     print(f"dup seqs={res.duplicate_seqs} skipped seqs={res.skipped_seqs} "
           f"fence rejections={res.fence_rejections}")
     print(f"restarts: {res.restarts}")
-    for e in res.events:
-        print(f"  {e}")
+    if res.timeline:
+        t0 = res.timeline[0][0]
+        print("fault/recovery timeline:")
+        for ts, ev in res.timeline:
+            print(f"  +{ts - t0:7.3f}s  {ev}")
+    else:
+        for e in res.events:
+            print(f"  {e}")
+    if res.metrics:
+        from fluidframework_tpu.utils.metrics import (
+            dump_snapshot_line,
+            format_report,
+        )
+
+        print("farm metrics (merged from role heartbeats):")
+        for line in format_report([res.metrics]).splitlines():
+            print(f"  {line}")
+        if metrics_out:
+            dump_snapshot_line(
+                metrics_out, res.metrics, source="chaos_run", seed=seed,
+                faults=",".join(faults), deli=cfg.deli_impl,
+            )
+            print(f"metrics snapshot appended to {metrics_out}")
     print("CONVERGED" if res.converged else f"DIVERGED ({res.detail})")
     return 0 if res.converged else 1
 

@@ -1,0 +1,79 @@
+"""Render run metrics snapshots into a per-stage latency table.
+
+Input: one or more files, each either a metrics JSONL (one
+`{"t": ..., "source": ..., "snapshot": {...}}` line per registry dump
+— `utils.metrics.dump_snapshot_line`, as written by
+`tools/chaos_run.py --metrics-out` and the chaos harness's
+`<shared_dir>/metrics.jsonl`) or a bare JSON snapshot
+(`MetricsRegistry.snapshot()` / a `/metrics.json` scrape body).
+
+All snapshots are merged (counters/histograms add, gauges last-write)
+and printed as:
+
+- the per-stage latency table — every histogram with observations:
+  count, mean, p50/p90/p99 (bucket-interpolated);
+- counters and gauges, one row each.
+
+Usage: python tools/metrics_report.py FILE [FILE...]
+       python tools/metrics_report.py --json FILE...   (merged snapshot
+       as JSON instead of the table)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.utils.metrics import (  # noqa: E402
+    format_report,
+    merge_snapshots,
+)
+
+
+def load_snapshots(path: str) -> list:
+    """Snapshot dicts from a metrics JSONL or a bare-snapshot JSON
+    (compact or pretty-printed — e.g. this tool's own --json output)."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.strip()
+    if not stripped:
+        return []
+    try:
+        one = json.loads(stripped)
+        return [one] if isinstance(one, dict) else list(one)
+    except ValueError:
+        pass  # not a single document: treat as JSONL
+    return [
+        json.loads(line)
+        for line in stripped.splitlines()
+        if line.strip()
+    ]
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:]]
+    as_json = "--json" in args
+    if as_json:
+        args.remove("--json")
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    snaps = []
+    for path in args:
+        snaps.extend(load_snapshots(path))
+    if not snaps:
+        print("no snapshots found", file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps(merge_snapshots(snaps).snapshot(), indent=1))
+    else:
+        print(f"merged {len(snaps)} snapshot(s) from {len(args)} file(s)")
+        print(format_report(snaps))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
