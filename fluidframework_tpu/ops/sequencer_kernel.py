@@ -28,6 +28,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..protocol.constants import INT32_MAX
@@ -250,6 +251,68 @@ def _sequence_batch_impl(state: SequencerState, aborted, batch: SeqBatch,
     return new_state, new_aborted, SeqResult(
         *(jnp.swapaxes(a, 0, 1) for a in out)
     )
+
+
+def pack_submissions(slot, kind, client, client_seq, ref_seq, groups,
+                     n_docs: int, max_cols: int):
+    """Pack PRE-COLUMNIZED 1-D submission arrays into dense ``[D, B]``
+    kernel chunks (host-side, vectorized numpy).
+
+    Inputs are six equal-length 1-D arrays — one entry per submission,
+    in stream order — exactly the shape the columnar op-log codec
+    (`protocol.record_batch`) hands over, so the live pipeline feeds
+    the kernel without ever materializing per-record Python tuples.
+    Per-doc column index = the submission's rank within its document
+    (stable argsort + cumulative count keeps per-doc order == record
+    order); documents whose rank exceeds `max_cols` spill into further
+    chunks (the boxcar-abort tracker threads across them).
+
+    Yields ``(sel, sl, ic, kind2, client2, cseq2, ref2, grp2)`` per
+    chunk: `sel` indexes the original arrays (slice or bool mask),
+    ``[sl, ic]`` gathers that chunk's verdicts out of the kernel's
+    ``[D, B]`` result, and the five dense int32 arrays are the
+    `SeqBatch` + groups input."""
+    slot = np.asarray(slot, np.int64)
+    n = slot.shape[0]
+    if n == 0:
+        return
+    kind = np.asarray(kind)
+    client = np.asarray(client)
+    client_seq = np.asarray(client_seq)
+    ref_seq = np.asarray(ref_seq)
+    groups = np.asarray(groups)
+    ar = np.arange(n)
+    order = np.argsort(slot, kind="stable")
+    ss = slot[order]
+    first = np.empty(n, bool)
+    first[0] = True
+    first[1:] = ss[1:] != ss[:-1]
+    col_sorted = ar - np.maximum.accumulate(np.where(first, ar, 0))
+    col = np.empty(n, np.int64)
+    col[order] = col_sorted
+    n_chunks = int(col.max()) // max_cols + 1
+    for k in range(n_chunks):
+        if n_chunks == 1:
+            sel = slice(None)
+            sl, ic = slot, col
+        else:
+            sel = (col // max_cols) == k
+            sl, ic = slot[sel], col[sel] - k * max_cols
+        b = 8
+        top = int(ic.max()) + 1
+        while b < top:
+            b <<= 1
+        kind2 = np.full((n_docs, b), SUB_PAD, np.int32)
+        client2 = np.zeros((n_docs, b), np.int32)
+        cseq2 = np.zeros((n_docs, b), np.int32)
+        ref2 = np.zeros((n_docs, b), np.int32)
+        grp2 = np.full((n_docs, b), NO_GROUP, np.int32)
+        kind2[sl, ic] = kind[sel]
+        client2[sl, ic] = client[sel]
+        cseq2[sl, ic] = client_seq[sel]
+        ref2[sl, ic] = ref_seq[sel]
+        grp2[sl, ic] = groups[sel]
+        yield sel, sl, ic, kind2, client2, cseq2, ref2, grp2
 
 
 def no_aborts(n_docs: int):

@@ -1,0 +1,538 @@
+"""Versioned columnar record-batch codec (the binary op-log wire form).
+
+The pipeline's topics carried one `json.dumps` line per record, so the
+batched deli kernel's device win drowned in per-record JSON encode/
+decode (ROADMAP item (a)). This module is the storage-side fix: a
+record BATCH is one length-prefixed, CRC-guarded, fence-stamped binary
+frame whose raw-op fields (doc id, client id, client seq, ref seq, op
+kind) are stored as columnar arrays with the payload blobs side by
+side — so `server.deli_kernel` ingests a batch as numpy arrays with
+zero per-record JSON decode, while legacy consumers decode records
+lazily one batch at a time and see plain Python values.
+
+Frame layout (version 1, little-endian):
+
+    magic "FRB1" | u8 version | u8 flags | u32 n_records
+    | u32 payload_len | u32 crc32(payload) | i64 fence
+    payload:
+      u16 owner_len + owner utf-8           (fence stamp's owner)
+      u32 n_docs + (u16 len + utf-8) * n    (batch-local doc dictionary)
+      u8  kind[n]        (K_* codes below)
+      u8  type_code[n]   (MessageType table index; 255 = n/a)
+      i32 doc_idx[n]
+      i64 client[n] | client_seq[n] | ref_seq[n] | seq[n] | msn[n]
+      i64 in_off[n]      (-1 = absent)
+      u32 blob_off[n+1] + blob heap          (JSON bytes per record)
+
+Schema per kind (records that don't fit a kind exactly ride
+``K_GENERIC`` with the whole record as one JSON blob, so the codec is
+lossless over arbitrary JSON values):
+
+    K_RAW_OP     {"kind":"op","doc","client","clientSeq","refSeq",
+                  "contents"}                blob = contents
+    K_RAW_JOIN   {"kind":"join","doc","client"}
+    K_RAW_LEAVE  {"kind":"leave","doc","client"}
+    K_RAW_BOXCAR {"kind":"boxcar","doc","client","ops":[...]}
+                  blob = [[clientSeq, refSeq, contents], ...]
+    K_SEQ_OP     {"kind":"op","doc","seq","msn","client","clientSeq",
+                  "refSeq","type","contents","inOff"} blob = contents
+    K_NACK       {"kind":"nack","doc","client","clientSeq","code",
+                  "reason","inOff"}  code rides the seq column,
+                  blob = reason
+    K_GENERIC    anything else        blob = full record
+
+The codec is pure (no I/O, no fencing): `server.columnar_log` owns the
+topic semantics (torn-tail safety, fence gating, offsets). Codec
+throughput metrics (`codec_encode_*` / `codec_decode_*`) report through
+`utils.metrics`; `tools/metrics_report.py` renders them.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .messages import MessageType
+
+__all__ = [
+    "HEADER",
+    "JsonBlob",
+    "K_GENERIC",
+    "K_NACK",
+    "K_RAW_BOXCAR",
+    "K_RAW_JOIN",
+    "K_RAW_LEAVE",
+    "K_RAW_OP",
+    "K_SEQ_OP",
+    "MAGIC",
+    "MAX_BATCH_BYTES",
+    "RecordBatch",
+    "SCHEMA_VERSION",
+    "decode_batch",
+    "encode_batch",
+    "iter_units",
+]
+
+MAGIC = b"FRB1"
+SCHEMA_VERSION = 1
+HEADER = struct.Struct("<4sBBIIIq")  # magic, ver, flags, n, plen, crc, fence
+MAX_BATCH_BYTES = 256 << 20  # sanity cap: junk that fakes the magic must
+#                              not trigger a multi-GB allocation
+
+# Record kinds (the `kind` column).
+K_RAW_OP = 0
+K_RAW_JOIN = 1
+K_RAW_LEAVE = 2
+K_RAW_BOXCAR = 3
+K_SEQ_OP = 4
+K_NACK = 5
+K_GENERIC = 255
+
+# Wire `type` strings <-> u8 codes (closed MessageType table; custom
+# type strings fall back to K_GENERIC).
+_TYPES: Tuple[str, ...] = tuple(t.value for t in MessageType)
+_TYPE_CODE: Dict[str, int] = {t: i for i, t in enumerate(_TYPES)}
+_NO_TYPE = 255
+
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+# Exact key sets the columnar kinds require (anything else -> generic).
+_RAW_OP_KEYS = frozenset(("kind", "doc", "client", "clientSeq", "refSeq",
+                          "contents"))
+_RAW_MEMBER_KEYS = frozenset(("kind", "doc", "client"))
+_RAW_BOXCAR_KEYS = frozenset(("kind", "doc", "client", "ops"))
+_SEQ_OP_KEYS = frozenset(("kind", "doc", "seq", "msn", "client",
+                          "clientSeq", "refSeq", "type", "contents",
+                          "inOff"))
+_NACK_KEYS = frozenset(("kind", "doc", "client", "clientSeq", "code",
+                        "reason", "inOff"))
+
+
+class JsonBlob:
+    """Pre-encoded JSON bytes that decode lazily.
+
+    The zero-copy pass-through handle: a consumer that re-emits a
+    record's `contents` into another columnar topic hands the raw blob
+    straight back to the encoder — no decode, no re-encode. Compares
+    (and reprs) by VALUE, so differential/digest comparisons treat it
+    as the plain value it encodes."""
+
+    __slots__ = ("raw", "_val", "_decoded")
+
+    def __init__(self, raw: bytes):
+        self.raw = bytes(raw)
+        self._val = None
+        self._decoded = False
+
+    @property
+    def value(self) -> Any:
+        if not self._decoded:
+            self._val = json.loads(self.raw)
+            self._decoded = True
+        return self._val
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, JsonBlob):
+            return self.value == other.value
+        return self.value == other
+
+    def __hash__(self):
+        return hash(self.raw)
+
+    def __repr__(self) -> str:
+        return repr(self.value)
+
+
+def _dumps(v: Any) -> bytes:
+    """JSON-encode one blob value; a JsonBlob passes through raw."""
+    if isinstance(v, JsonBlob):
+        return v.raw
+    return json.dumps(v, separators=(",", ":")).encode()
+
+
+def _is_i64(v: Any) -> bool:
+    return type(v) is int and _I64_MIN <= v <= _I64_MAX
+
+
+def _metrics(kind: str, records: int, nbytes: int, seconds: float) -> None:
+    from ..utils.metrics import get_registry
+
+    m = get_registry()
+    m.counter(f"codec_{kind}_records_total", codec="columnar").inc(records)
+    m.counter(f"codec_{kind}_bytes_total", codec="columnar").inc(nbytes)
+    m.histogram(f"codec_{kind}_ms", codec="columnar").observe(
+        seconds * 1000.0
+    )
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+
+_BOXCAR_OP_KEYS = frozenset(("clientSeq", "refSeq", "contents"))
+
+
+def _classify(rec: Any) -> int:
+    """The columnar kind for one record (K_GENERIC when it doesn't fit
+    a schema exactly — the codec must round-trip arbitrary values)."""
+    if not isinstance(rec, dict):
+        return K_GENERIC
+    kind = rec.get("kind")
+    if not isinstance(rec.get("doc"), str):
+        return K_GENERIC
+    keys = rec.keys()  # dict_keys == set compares C-side, no new set
+    if kind == "op":
+        if keys == _RAW_OP_KEYS and _is_i64(rec["client"]) \
+                and _is_i64(rec["clientSeq"]) and _is_i64(rec["refSeq"]):
+            return K_RAW_OP
+        if keys == _SEQ_OP_KEYS and _is_i64(rec["client"]) \
+                and _is_i64(rec["clientSeq"]) and _is_i64(rec["refSeq"]) \
+                and _is_i64(rec["seq"]) and _is_i64(rec["msn"]) \
+                and _is_i64(rec["inOff"]) \
+                and rec["type"] in _TYPE_CODE:
+            return K_SEQ_OP
+        return K_GENERIC
+    if kind == "join" and keys == _RAW_MEMBER_KEYS \
+            and _is_i64(rec["client"]):
+        return K_RAW_JOIN
+    if kind == "leave" and keys == _RAW_MEMBER_KEYS \
+            and _is_i64(rec["client"]):
+        return K_RAW_LEAVE
+    if kind == "boxcar" and keys == _RAW_BOXCAR_KEYS \
+            and _is_i64(rec["client"]) and isinstance(rec["ops"], list):
+        ok = all(
+            isinstance(op, dict) and op.keys() == _BOXCAR_OP_KEYS
+            and _is_i64(op["clientSeq"]) and _is_i64(op["refSeq"])
+            for op in rec["ops"]
+        )
+        return K_RAW_BOXCAR if ok else K_GENERIC
+    if kind == "nack" and keys == _NACK_KEYS and _is_i64(rec["client"]) \
+            and _is_i64(rec["clientSeq"]) and _is_i64(rec["code"]) \
+            and _is_i64(rec["inOff"]) and isinstance(rec["reason"], str):
+        return K_NACK
+    return K_GENERIC
+
+
+def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
+                 owner: Optional[str] = None) -> bytes:
+    """One binary frame for `records` (arbitrary JSON values), stamped
+    with the accepted (fence, owner)."""
+    t0 = time.perf_counter()
+    n = len(records)
+    doc_ids: List[str] = []
+    doc_of: Dict[str, int] = {}
+    # Hot path: plain list appends per record, ONE numpy conversion per
+    # column at the end (scalar ndarray stores cost ~10x a list append).
+    kinds: List[int] = []
+    type_codes: List[int] = []
+    doc_idx: List[int] = []
+    clients: List[int] = []
+    cseqs: List[int] = []
+    refs: List[int] = []
+    seqs: List[int] = []
+    msns: List[int] = []
+    inoffs: List[int] = []
+    blobs: List[bytes] = []
+    blob_lens: List[int] = []
+
+    # One fused pass: the key-set comparison routes each record AND the
+    # same lookups fill the columns (classification re-reads nothing).
+    ka, ta, da, ca = (kinds.append, type_codes.append, doc_idx.append,
+                      clients.append)
+    qa, ra, sa, ma = (cseqs.append, refs.append, seqs.append,
+                      msns.append)
+    ia, ba, la = inoffs.append, blobs.append, blob_lens.append
+
+    def generic(rec):
+        ka(K_GENERIC)
+        ta(_NO_TYPE)
+        da(0)
+        ca(0)
+        qa(0)
+        ra(0)
+        sa(0)
+        ma(0)
+        ia(-1)
+        blob = _dumps(rec)
+        ba(blob)
+        la(len(blob))
+
+    for rec in records:
+        k = _classify(rec)
+        if k == K_GENERIC:
+            generic(rec)
+            continue
+        doc = rec["doc"]
+        di = doc_of.get(doc)
+        if di is None:
+            di = doc_of[doc] = len(doc_ids)
+            doc_ids.append(doc)
+        ka(k)
+        da(di)
+        ca(rec["client"])
+        if k == K_RAW_OP:
+            qa(rec["clientSeq"])
+            ra(rec["refSeq"])
+            sa(0)
+            ma(0)
+            ia(-1)
+            ta(_NO_TYPE)
+            blob = _dumps(rec["contents"])
+        elif k == K_SEQ_OP:
+            qa(rec["clientSeq"])
+            ra(rec["refSeq"])
+            sa(rec["seq"])
+            ma(rec["msn"])
+            ia(rec["inOff"])
+            ta(_TYPE_CODE[rec["type"]])
+            blob = _dumps(rec["contents"])
+        elif k == K_NACK:
+            qa(rec["clientSeq"])
+            ra(0)
+            sa(rec["code"])
+            ma(0)
+            ia(rec["inOff"])
+            ta(_NO_TYPE)
+            blob = _dumps(rec["reason"])
+        else:
+            qa(0)
+            ra(0)
+            sa(0)
+            ma(0)
+            ia(-1)
+            ta(_NO_TYPE)
+            blob = b"" if k != K_RAW_BOXCAR else _dumps([
+                [op["clientSeq"], op["refSeq"], op["contents"]]
+                for op in rec["ops"]
+            ])
+        ba(blob)
+        la(len(blob))
+
+    heap = b"".join(blobs)
+    offs = np.zeros(n + 1, np.uint32)
+    if n:
+        offs[1:] = np.cumsum(blob_lens)
+    i64 = np.array([clients, cseqs, refs, seqs, msns, inoffs],
+                   np.int64) if n else np.zeros((6, 0), np.int64)
+    owner_b = (owner or "").encode()
+    doc_parts = [struct.pack("<I", len(doc_ids))]
+    for d in doc_ids:
+        db = d.encode()
+        doc_parts.append(struct.pack("<H", len(db)) + db)
+    payload = b"".join([
+        struct.pack("<H", len(owner_b)), owner_b,
+        *doc_parts,
+        np.array(kinds, np.uint8).tobytes(),
+        np.array(type_codes, np.uint8).tobytes(),
+        np.array(doc_idx, np.int32).tobytes(),
+        i64.tobytes(), offs.tobytes(), heap,
+    ])
+    if len(payload) > MAX_BATCH_BYTES:
+        raise ValueError(f"record batch too large: {len(payload)} bytes")
+    # The CRC covers the HEADER FIELDS (with the crc slot zeroed) as
+    # well as the payload: a flipped record count or length would
+    # otherwise mis-frame a payload whose own CRC still matches.
+    fence_i = int(fence or 0)
+    hdr0 = HEADER.pack(MAGIC, SCHEMA_VERSION, 0, n, len(payload), 0,
+                       fence_i)
+    crc = zlib.crc32(payload, zlib.crc32(hdr0))
+    frame = HEADER.pack(
+        MAGIC, SCHEMA_VERSION, 0, n, len(payload), crc, fence_i,
+    ) + payload
+    _metrics("encode", n, len(frame), time.perf_counter() - t0)
+    return frame
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+
+class RecordBatch:
+    """One decoded frame: columns up front, blobs/records lazily.
+
+    `kind`/`type_code`/`doc_idx`/`client`/`client_seq`/`ref_seq`/
+    `seq`/`msn`/`in_off` are numpy views over the payload — the
+    zero-JSON ingest surface for the kernel deli. `records()` is the
+    legacy path: full per-record decode into plain Python values."""
+
+    __slots__ = ("n", "fence", "owner", "docs", "kind", "type_code",
+                 "doc_idx", "client", "client_seq", "ref_seq", "seq",
+                 "msn", "in_off", "_blob_off", "_heap", "_records",
+                 "_frame_bytes")
+
+    def __init__(self, n: int, fence: int, payload: memoryview):
+        self.n = n
+        self.fence = fence
+        self._frame_bytes = HEADER.size + len(payload)
+        pos = 0
+        (olen,) = struct.unpack_from("<H", payload, pos)
+        pos += 2
+        self.owner = bytes(payload[pos:pos + olen]).decode() or None
+        pos += olen
+        (ndocs,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        docs: List[str] = []
+        for _ in range(ndocs):
+            (dlen,) = struct.unpack_from("<H", payload, pos)
+            pos += 2
+            docs.append(bytes(payload[pos:pos + dlen]).decode())
+            pos += dlen
+        self.docs = docs
+        self.kind = np.frombuffer(payload, np.uint8, n, pos)
+        pos += n
+        self.type_code = np.frombuffer(payload, np.uint8, n, pos)
+        pos += n
+        self.doc_idx = np.frombuffer(payload, "<i4", n, pos)
+        pos += 4 * n
+        i64 = np.frombuffer(payload, "<i8", 6 * n, pos).reshape(6, n)
+        pos += 48 * n
+        (self.client, self.client_seq, self.ref_seq,
+         self.seq, self.msn, self.in_off) = i64
+        self._blob_off = np.frombuffer(payload, "<u4", n + 1, pos)
+        pos += 4 * (n + 1)
+        self._heap = payload[pos:]
+        self._records: Optional[List[Any]] = None
+
+    def blob(self, i: int) -> bytes:
+        """Record `i`'s raw JSON blob bytes (contents / boxcar ops /
+        reason / whole generic record, per kind)."""
+        return bytes(self._heap[self._blob_off[i]:self._blob_off[i + 1]])
+
+    def record(self, i: int) -> Any:
+        """Record `i` as a plain Python value (lazy, uncached)."""
+        k = int(self.kind[i])
+        if k == K_GENERIC:
+            return json.loads(self.blob(i))
+        doc = self.docs[int(self.doc_idx[i])]
+        client = int(self.client[i])
+        if k == K_RAW_OP:
+            return {"kind": "op", "doc": doc, "client": client,
+                    "clientSeq": int(self.client_seq[i]),
+                    "refSeq": int(self.ref_seq[i]),
+                    "contents": json.loads(self.blob(i))}
+        if k == K_RAW_JOIN:
+            return {"kind": "join", "doc": doc, "client": client}
+        if k == K_RAW_LEAVE:
+            return {"kind": "leave", "doc": doc, "client": client}
+        if k == K_RAW_BOXCAR:
+            return {"kind": "boxcar", "doc": doc, "client": client,
+                    "ops": [
+                        {"clientSeq": cs, "refSeq": rf, "contents": c}
+                        for cs, rf, c in json.loads(self.blob(i))
+                    ]}
+        if k == K_SEQ_OP:
+            return {"kind": "op", "doc": doc,
+                    "seq": int(self.seq[i]), "msn": int(self.msn[i]),
+                    "client": client,
+                    "clientSeq": int(self.client_seq[i]),
+                    "refSeq": int(self.ref_seq[i]),
+                    "type": _TYPES[int(self.type_code[i])],
+                    "contents": json.loads(self.blob(i)),
+                    "inOff": int(self.in_off[i])}
+        return {"kind": "nack", "doc": doc, "client": client,
+                "clientSeq": int(self.client_seq[i]),
+                "code": int(self.seq[i]),
+                "reason": json.loads(self.blob(i)),
+                "inOff": int(self.in_off[i])}
+
+    def records(self) -> List[Any]:
+        """All records, decoded once and cached (the legacy-consumer
+        path: one batch at a time, plain values)."""
+        if self._records is None:
+            t0 = time.perf_counter()
+            self._records = [self.record(i) for i in range(self.n)]
+            _metrics("decode", self.n, self._frame_bytes,
+                     time.perf_counter() - t0)
+        return self._records
+
+
+def decode_batch(buf, pos: int = 0,
+                 verify_crc: bool = True) -> Tuple[Optional[RecordBatch],
+                                                   int, int]:
+    """Parse one frame at `pos`. Returns ``(batch, end, n_records)``:
+
+    - complete + CRC ok  → ``(RecordBatch, frame_end, n)``
+    - complete + CRC bad → ``(None, frame_end, n)`` — the batch is
+      skipped but its records stay COUNTED, so offsets are stable
+      across every reader (the sealed-junk-line rule, batch-sized)
+    - incomplete (torn tail) → ``(None, pos, -1)`` — not consumed;
+      re-read complete on a later poll
+
+    Raises ValueError when the bytes at `pos` are not a frame header
+    at all (callers fall back to line-oriented parsing)."""
+    view = memoryview(buf)
+    if len(view) - pos < HEADER.size:
+        if view[pos:pos + 4] == MAGIC:
+            return None, pos, -1  # header itself still in flight
+        raise ValueError("not a record-batch frame")
+    magic, ver, _flags, n, plen, crc, fence = HEADER.unpack_from(view, pos)
+    if magic != MAGIC:
+        raise ValueError("not a record-batch frame")
+    if ver != SCHEMA_VERSION or plen > MAX_BATCH_BYTES:
+        # Unknown version / insane length: treat as a corrupt frame of
+        # unknowable extent — callers skip the rest of the file region
+        # the same way a junk JSON line is skipped.
+        raise ValueError(f"bad record-batch header (ver={ver}, len={plen})")
+    end = pos + HEADER.size + plen
+    if end > len(view):
+        return None, pos, -1  # torn frame: an append in progress
+    payload = view[pos + HEADER.size:end]
+    hdr0 = HEADER.pack(MAGIC, SCHEMA_VERSION, 0, n, plen, 0, fence)
+    if zlib.crc32(payload, zlib.crc32(hdr0)) != crc:
+        # Corrupt in place: skip, keep the count. (If the corruption
+        # hit the header's count/length fields themselves, the skip
+        # may land mid-junk — the walker then stops at the first
+        # unparseable unit, the documented header-corruption floor.)
+        return None, end, n
+    return RecordBatch(n, fence, payload), end, n
+
+
+def iter_units(data, start_index: int = 0) -> Iterator[Tuple]:
+    """Walk a mixed log region: binary record-batch frames AND JSONL
+    lines in one byte string — THE shared scanner every reader of the
+    columnar op-log family uses (topic reads, tail readers, journal
+    replay, clean-length scans), so the torn-tail / CRC-skip /
+    junk-line counting rules exist exactly once.
+
+    Yields ``(kind, index, count, payload, end)`` per COMPLETE unit:
+
+    - ``("batch", index, n_records, RecordBatch | None, end)`` —
+      `None` payload means the frame's CRC failed; its records are
+      skipped but still COUNT `n_records` toward offsets.
+    - ``("line", index, 1, raw_line_bytes, end)`` — one newline-
+      terminated line (possibly junk; callers parse/skip, the count
+      always holds).
+
+    `index` is the record offset of the unit's first record (starting
+    at `start_index`); `end` is the byte offset just past the unit
+    within `data`. Iteration stops at the first torn unit (incomplete
+    frame, unterminated line, undecodable header) — an append in
+    progress, re-read complete on a later poll."""
+    pos = 0
+    idx = start_index
+    n = len(data)
+    while pos < n:
+        if data[pos:pos + 4] == MAGIC:
+            try:
+                batch, end, cnt = decode_batch(data, pos)
+            except ValueError:
+                return  # undecodable header: unsealed junk region
+            if cnt < 0:
+                return  # torn frame
+            yield "batch", idx, cnt, batch, end
+            idx += cnt
+            pos = end
+        else:
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                return  # torn line
+            yield "line", idx, 1, data[pos:nl], nl + 1
+            idx += 1
+            pos = nl + 1

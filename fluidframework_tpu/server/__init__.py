@@ -15,6 +15,13 @@ kernel call) lives in fluidframework_tpu/ops/sequencer_kernel.py.
 from .sequencer import DocumentSequencer, NACK_STALE_REFSEQ
 from .local_service import LocalOrderingService
 from .castore import ContentAddressedStore
+from .columnar_log import (
+    ColumnarFileTopic,
+    ColumnarTailReader,
+    LOG_FORMATS,
+    make_tail_reader,
+    make_topic,
+)
 from .queue import (
     FencedCheckpointStore,
     FencedError,
@@ -47,8 +54,13 @@ from .lambdas import (
 )
 
 __all__ = [
+    "ColumnarFileTopic",
+    "ColumnarTailReader",
     "FencedCheckpointStore",
     "FencedError",
+    "LOG_FORMATS",
+    "make_tail_reader",
+    "make_topic",
     "JournalConsumer",
     "JournalProducer",
     "LeaseManager",

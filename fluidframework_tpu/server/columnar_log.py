@@ -1,0 +1,337 @@
+"""Columnar binary op-log topics: `SharedFileTopic`'s batch-framed twin.
+
+One `ColumnarFileTopic` append writes ONE fence-gated, CRC-guarded
+record-batch frame (`protocol.record_batch`) instead of one JSON line
+per record — the storage-side half of the reference's outbound
+boxcarring, riding the same payload-agnostic framing philosophy as
+`server.framing`. The robustness contract matches `SharedFileTopic`
+exactly, lifted from lines to batches:
+
+- **Torn tail** — a frame whose bytes are not fully on disk is never
+  consumed; it is invisible until complete (the same rule covers an
+  append in flight). The next append SEALS a crash-torn tail by
+  truncating it away (the partial frame was never acknowledged — the
+  JSON topic's "junk line" outcome, minus the junk); complete units
+  are NEVER truncated, so nothing a reader consumed can disappear. A
+  committed-length sidecar (`<path>.clen`, updated under the append
+  lock after fsync) bounds the seal scan; it is a hint, not an
+  authority — the sealer re-extends it over complete units, so a
+  json⇄columnar format round-trip (which leaves the sidecar dormant)
+  cannot truncate acknowledged records.
+- **Corruption** — a frame whose CRC no longer matches is skipped but
+  its records stay COUNTED (the header's record count survives payload
+  corruption), so line/record offsets remain stable across all
+  readers — the sealed-junk-line rule, batch-sized. Known limitation:
+  corruption of a frame HEADER itself (magic intact, version/length
+  bytes hit) is indistinguishable from a torn tail, and readers stop
+  there rather than guess a resync point.
+- **Fencing** — identical to `SharedFileTopic` (same sidecar, same
+  `FencedError` gate under the same lock); accepted (fence, owner) is
+  additionally stamped into each frame header for audit.
+- **Mixed history** — readers parse JSON lines AND binary frames in
+  one file, so a topic written as JSONL can continue columnar after a
+  restart (`FLUID_LOG_FORMAT=columnar`) mid-stream: offsets count
+  JSON lines as one record each, exactly like `SharedFileTopic`.
+  The UPGRADE direction only: `SharedFileTopic` readers cannot parse
+  frames, so a farm downgrade (columnar → json) needs drained topics
+  (LocalServer journals replay both ways — `log._replay_journal`
+  sniffs per unit — so persist_dir restarts may switch freely).
+
+`ColumnarTailReader` mirrors `queue.TailReader` (incremental byte
+position, identical record offsets) and adds `poll_batches()`: raw
+`RecordBatch` objects whose columns feed `server.deli_kernel` with
+zero per-record JSON decode.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, List, Optional, Tuple
+
+from ..protocol.record_batch import (
+    RecordBatch,
+    encode_batch,
+    iter_units,
+)
+from .queue import SharedFileTopic, TailReader
+
+__all__ = [
+    "ColumnarFileTopic",
+    "ColumnarTailReader",
+    "LOG_FORMATS",
+    "default_log_format",
+    "make_tail_reader",
+    "make_topic",
+]
+
+LOG_FORMATS = ("json", "columnar")
+
+def default_log_format(explicit: Optional[str] = None) -> str:
+    """Resolve a log format: explicit arg > ``FLUID_LOG_FORMAT`` env >
+    "json". Loud on typos — a silently-misrouted format would
+    invalidate benches and chaos runs."""
+    fmt = explicit or os.environ.get("FLUID_LOG_FORMAT", "json")
+    if fmt not in LOG_FORMATS:
+        raise ValueError(f"log_format {fmt!r} not in {LOG_FORMATS}")
+    return fmt
+
+
+def make_topic(path: str, log_format: Optional[str] = None):
+    """Topic factory for the supervised farm / benches: "json" →
+    `SharedFileTopic`, "columnar" → `ColumnarFileTopic`."""
+    fmt = default_log_format(log_format)
+    return ColumnarFileTopic(path) if fmt == "columnar" else \
+        SharedFileTopic(path)
+
+
+def make_tail_reader(topic, line_offset: int = 0):
+    """The matching incremental reader for either topic flavor."""
+    if isinstance(topic, ColumnarFileTopic):
+        return ColumnarTailReader(topic, line_offset)
+    return TailReader(topic, line_offset)
+
+
+class ColumnarFileTopic(SharedFileTopic):
+    """A cross-process topic over one record-batch log file.
+
+    Drop-in `SharedFileTopic` sibling: same constructor, same
+    `append_many(...) -> bytes-written` contract, same
+    `read_entries`/`read_from` record-offset semantics (JSON lines in
+    the same file count one record each — the migration path), same
+    fence sidecar and `FencedError` gate."""
+
+    log_format = "columnar"
+
+    # -------------------------------------------------- committed length
+
+    def _clen_path(self) -> str:
+        return self.path + ".clen"
+
+    def _read_committed(self) -> Optional[int]:
+        try:
+            with open(self._clen_path()) as f:
+                return int(json.load(f)["len"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _write_committed(self, n: int) -> None:
+        tmp = self._clen_path() + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"len": int(n)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._clen_path())
+
+    @staticmethod
+    def _scan_clean_len(data: bytes) -> int:
+        """Byte length of the longest prefix made of complete units
+        (frames or newline-terminated lines) — the committed length of
+        a topic that predates its sidecar (a migrated JSONL file)."""
+        pos = 0
+        for _kind, _idx, _cnt, _payload, end in iter_units(data):
+            pos = end
+        return pos
+
+    # ----------------------------------------------------------- append
+
+    def append_many(self, messages: List[Any],
+                    fence: Optional[int] = None,
+                    owner: Optional[str] = None,
+                    lock_timeout_s: Optional[float] = None) -> int:
+        """Append `messages` as ONE binary record-batch frame under the
+        OS lock; returns the frame bytes written (0 for an empty batch,
+        which still gates the fence — a deposed owner must learn it is
+        deposed even with nothing to write)."""
+        from .queue import flock_exclusive
+
+        with open(self.path, "r+b") as f:
+            with flock_exclusive(f, lock_timeout_s, self.path):
+                self._gate_fence(fence, owner)
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                committed = self._read_committed()
+                # The sidecar is a HINT that bounds the seal scan, not
+                # an authority over the data: EXTEND it over any
+                # complete units past it (JSON-era lines appended while
+                # the farm ran the other format, frames whose sidecar
+                # update was lost to a crash) so a format round-trip
+                # can never truncate acknowledged records; only the
+                # genuinely torn suffix (partial frame, unterminated
+                # line) is sealed away — it was never acknowledged.
+                start = 0 if committed is None else min(committed, size)
+                f.seek(start)
+                clean = start + self._scan_clean_len(f.read())
+                if size > clean:
+                    f.truncate(clean)
+                if not messages:
+                    if committed != clean:
+                        self._write_committed(clean)
+                    return 0
+                cur_fence, cur_owner = self.latest_fence()
+                frame = encode_batch(messages, fence=cur_fence,
+                                     owner=cur_owner)
+                f.seek(clean)
+                f.write(frame)
+                f.flush()
+                os.fsync(f.fileno())
+                # Data is durable BEFORE the committed length names it.
+                self._write_committed(clean + len(frame))
+        return len(frame)
+
+    # ------------------------------------------------------------- read
+
+    def _read_data(self) -> bytes:
+        """The whole file; readers rely on the torn-unit rules (an
+        incomplete frame or unterminated line is never consumed), so
+        an in-flight append is naturally invisible and a stale sidecar
+        can never hide acknowledged records. Complete units are never
+        truncated by the seal path, so what a reader consumed stays
+        consumed."""
+        with open(self.path, "rb") as f:
+            return f.read()
+
+    def read_entries(self, offset: int,
+                     max_count: Optional[int] = None
+                     ) -> Tuple[List[Tuple[int, Any]], int]:
+        """Same contract as `SharedFileTopic.read_entries`, over mixed
+        frames + JSON lines: record offsets are stable (CRC-skipped
+        batches and junk lines stay counted), torn units are never
+        consumed, `max_count` caps the parsed entries taken."""
+        data = self._read_data()
+        if not data:
+            return [], offset
+
+        def capped():
+            return max_count is not None and len(out) >= max_count
+
+        out: List[Tuple[int, Any]] = []
+        idx = 0
+        for kind, idx0, cnt, payload, _end in iter_units(data):
+            if capped():
+                break
+            idx = idx0 + cnt
+            if kind == "batch":
+                if payload is None or idx <= offset:
+                    continue  # CRC-skipped or entirely below the offset
+                recs = payload.records()
+                for i in range(max(0, offset - idx0), cnt):
+                    if capped():
+                        break
+                    out.append((idx0 + i, recs[i]))
+            elif idx0 >= offset:
+                line = payload.strip()
+                if line:
+                    try:
+                        out.append((idx0, json.loads(line)))
+                    except ValueError:
+                        pass  # sealed junk from a crashed writer
+        if capped():
+            return out, (out[-1][0] + 1 if out else offset)
+        return out, max(offset, idx)
+
+
+class ColumnarTailReader:
+    """Incremental reader over a `ColumnarFileTopic` (the `TailReader`
+    role): remembers the byte position after the last fully-consumed
+    unit, so each poll reads only NEW committed bytes — `read_entries`
+    is O(file) per call, which would make a long-lived consumer
+    O(file²) over its lifetime. Record offsets (`next_line`) are
+    identical to `read_entries` offsets, and — like `TailReader` — a
+    `line_offset` AHEAD of the file keeps `next_line == line_offset`
+    (records below it are swallowed silently as they appear, never
+    delivered).
+
+    `poll()` yields decoded records for legacy consumers;
+    `poll_batches()` yields raw `RecordBatch` objects (plus decoded
+    stray JSON records from a migrated history) for the kernel deli's
+    zero-JSON ingest. `max_count` is a batch-granular bound: a batch is
+    always consumed whole, and no new batch starts once the cap is
+    reached."""
+
+    def __init__(self, topic: ColumnarFileTopic, line_offset: int = 0):
+        self.topic = topic
+        self.next_line = line_offset
+        self._pos = 0  # byte position after the last consumed unit
+        self._abs = 0  # record index of the unit at _pos
+        if line_offset > 0:
+            # One O(file) scan translates the record offset into a byte
+            # position; everything after is incremental. Stops before
+            # the unit CONTAINING the offset (mid-batch delivery is
+            # handled record-wise in _poll_units).
+            data = topic._read_data()
+            for _kind, idx, cnt, _payload, end in iter_units(data):
+                if idx + cnt > line_offset:
+                    break
+                self._pos = end
+                self._abs = idx + cnt
+
+    def _read_new(self) -> bytes:
+        """Only the bytes past `_pos` (incremental tail); the torn-unit
+        rules bound what of them is consumable."""
+        try:
+            with open(self.topic.path, "rb") as f:
+                f.seek(self._pos)
+                return f.read()
+        except OSError:
+            return b""
+
+    def _poll_units(self, max_count: Optional[int]):
+        data = self._read_new()
+        if not data:
+            return []
+        units: List[tuple] = []  # ("batch", start_line, RecordBatch)
+        #                        | ("rec", line, value)
+        taken = 0
+        consumed_bytes = 0
+        for kind, rel_idx, cnt, payload, end in iter_units(
+                data, self._abs):
+            if max_count is not None and taken >= max_count:
+                break
+            consumed_bytes = end
+            self._abs = rel_idx + cnt
+            if kind == "batch":
+                # Records below next_line (a checkpoint taken against a
+                # longer topic) are swallowed without delivery.
+                skip = max(0, min(cnt, self.next_line - rel_idx))
+                if payload is not None and skip < cnt:
+                    if skip == 0:
+                        units.append(("batch", rel_idx, payload))
+                    else:  # offset lands mid-batch: deliver the tail
+                        recs = payload.records()
+                        units.extend(
+                            ("rec", rel_idx + i, recs[i])
+                            for i in range(skip, cnt)
+                        )
+                    taken += cnt - skip
+            elif rel_idx >= self.next_line:
+                line = payload.strip()
+                if line:
+                    try:
+                        units.append(("rec", rel_idx, json.loads(line)))
+                        taken += 1
+                    except ValueError:
+                        pass  # sealed junk
+            self.next_line = max(self.next_line, self._abs)
+        self._pos += consumed_bytes
+        return units
+
+    def poll_batches(self, max_count: Optional[int] = None) -> List[tuple]:
+        """New committed units as ``("batch", start_line, RecordBatch)``
+        / ``("rec", line, value)`` tuples, in stream order."""
+        return self._poll_units(max_count)
+
+    def poll(self, max_count: Optional[int] = None
+             ) -> List[Tuple[int, Any]]:
+        """Decoded-records view (the `TailReader.poll` contract, with
+        batch-granular `max_count`)."""
+        out: List[Tuple[int, Any]] = []
+        for unit in self._poll_units(max_count):
+            if unit[0] == "batch":
+                _, start, batch = unit
+                recs = batch.records()
+                out.extend((start + i, recs[i]) for i in range(batch.n))
+            else:
+                out.append((unit[1], unit[2]))
+        return out

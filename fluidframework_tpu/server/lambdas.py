@@ -110,6 +110,8 @@ class DeliLambda:
         return len(raws)
 
     def _handle(self, raw: dict, out: List[dict]) -> None:
+        if not isinstance(raw, dict) or not raw.get("doc"):
+            return  # journal LOST_RECORD placeholder / foreign junk
         doc = self._doc(raw["doc"])
         kind = raw["kind"]
         if kind == "join":
@@ -550,6 +552,7 @@ class LocalServer:
         persist_dir: Optional[str] = None,
         historian_budget: Optional[int] = None,
         deli_impl: Optional[str] = None,
+        log_format: Optional[str] = None,
     ):
         """Restart contract: pass the previous instance's `log` (the
         durable substrate, as Kafka retains topics across lambda
@@ -566,14 +569,24 @@ class LocalServer:
         "kernel" (the vmap'd batch sequencer,
         `deli_kernel.KernelDeliLambda`); env ``FLUID_DELI`` sets the
         default. Checkpoints are interchangeable across impls, so a
-        restart may switch."""
+        restart may switch.
+
+        `log_format` picks the persisted journal wire form: "json"
+        (JSONL lines) or "columnar" (binary record-batch frames,
+        `protocol.record_batch`); env ``FLUID_LOG_FORMAT`` sets the
+        default. Replay reads both, so a restart may switch formats
+        over the same persist_dir mid-journal."""
+        from .columnar_log import default_log_format
+
+        self.log_format = default_log_format(log_format)
         self.persist_dir = persist_dir
         if persist_dir is not None:
             import os
 
             os.makedirs(persist_dir, exist_ok=True)
             if log is None:
-                log = MessageLog(os.path.join(persist_dir, "topics"))
+                log = MessageLog(os.path.join(persist_dir, "topics"),
+                                 log_format=self.log_format)
             if storage is None:
                 storage = ContentAddressedStore(
                     directory=os.path.join(persist_dir, "store")

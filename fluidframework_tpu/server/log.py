@@ -93,29 +93,79 @@ def _decode_entry(data: Any) -> Any:
     return data
 
 
+# Stand-in for a journal record lost to in-place corruption (a
+# CRC-failed frame, a junk line): replay keeps the SLOT so every later
+# record keeps its offset — persisted lambda checkpoints cite absolute
+# offsets, and dropping a corrupt unit would silently shift every
+# consumer past it (the columnar readers' skip-but-COUNT rule, applied
+# to the in-proc journal). Consumers treat it as a no-op record.
+LOST_RECORD = {"kind": "__lost__", "doc": None}
+
+
+def _replay_journal(path: str):
+    """Replay a topic journal that may mix JSONL lines and columnar
+    record-batch frames (`protocol.record_batch`) — the cross-format
+    migration path: a journal written as JSONL keeps replaying after
+    the server restarts with ``log_format="columnar"`` and vice versa.
+    Corrupt units replay as `LOST_RECORD` placeholders (offsets stay
+    stable). Returns ``(values, clean_len)``; bytes past `clean_len`
+    are a torn tail (a writer died mid-append) the caller truncates
+    before appending again."""
+    import json
+
+    from ..protocol.record_batch import iter_units
+
+    with open(path, "rb") as f:
+        data = f.read()
+    vals: List[Any] = []
+    clean_len = 0
+    for kind, _idx, cnt, payload, end in iter_units(data):
+        clean_len = end
+        if kind == "batch":
+            if payload is None:  # CRC failure: hold the slots
+                vals.extend(LOST_RECORD for _ in range(cnt))
+            else:
+                vals.extend(payload.records())
+        else:
+            line = payload.strip()
+            if not line:
+                vals.append(LOST_RECORD)
+                continue
+            try:
+                vals.append(json.loads(line))
+            except ValueError:
+                vals.append(LOST_RECORD)  # sealed junk: hold the slot
+    return vals, clean_len
+
+
 class LogTopic:
     """One append-only, offset-addressed message log. With a backing
-    `path`, every append also journals to disk (JSONL, flushed) and
-    the topic replays from the journal on open — the Kafka topic
-    retention that makes lambda restart/catch-up real across PROCESS
-    restarts."""
+    `path`, every append also journals to disk (flushed) and the topic
+    replays from the journal on open — the Kafka topic retention that
+    makes lambda restart/catch-up real across PROCESS restarts.
 
-    def __init__(self, name: str, path: Optional[str] = None):
+    `log_format` picks the journal wire form: "json" (one JSONL line
+    per record) or "columnar" (one `protocol.record_batch` frame per
+    append — the batched binary op-log). Replay reads BOTH, so a
+    restart may switch formats mid-journal."""
+
+    def __init__(self, name: str, path: Optional[str] = None,
+                 log_format: str = "json"):
         self.name = name
+        self.log_format = log_format
         self._messages: List[Any] = []
         self._subscribers: List[Callable[[int, Any], None]] = []
         self._path = path
         self._file = None
         if path and os.path.exists(path):
-            import json
-
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        self._messages.append(
-                            _decode_entry(json.loads(line))
-                        )
+            vals, clean_len = _replay_journal(path)
+            self._messages.extend(_decode_entry(v) for v in vals)
+            if clean_len < os.path.getsize(path):
+                # Seal the torn tail NOW (the crashed writer's partial
+                # record was never acknowledged) so new appends start
+                # on a clean unit boundary.
+                with open(path, "r+b") as f:
+                    f.truncate(clean_len)
 
     def append(self, message: Any) -> int:
         """Append; returns the message's offset."""
@@ -131,15 +181,23 @@ class LogTopic:
             return off
         self._messages.extend(messages)
         if self._path is not None:
-            import json
-
             if self._file is None:
-                self._file = open(self._path, "a")
-            self._file.write(
-                "".join(
-                    json.dumps(_encode_entry(m)) + "\n" for m in messages
+                self._file = open(self._path, "ab")
+            if self.log_format == "columnar":
+                from ..protocol.record_batch import encode_batch
+
+                self._file.write(
+                    encode_batch([_encode_entry(m) for m in messages])
                 )
-            )
+            else:
+                import json
+
+                self._file.write(
+                    "".join(
+                        json.dumps(_encode_entry(m)) + "\n"
+                        for m in messages
+                    ).encode()
+                )
             self._file.flush()
         for i, m in enumerate(messages):
             for fn in list(self._subscribers):
@@ -169,11 +227,16 @@ class LogTopic:
 
 class MessageLog:
     """Named topics (the broker). With `directory`, topics journal to
-    <directory>/<topic>.jsonl and replay on open."""
+    <directory>/<topic>.jsonl and replay on open (`log_format` picks
+    JSONL lines vs columnar record-batch frames; the file name stays
+    `.jsonl` either way so a restart can switch formats over the same
+    journal — replay reads both)."""
 
-    def __init__(self, directory: Optional[str] = None):
+    def __init__(self, directory: Optional[str] = None,
+                 log_format: str = "json"):
         self.topics: Dict[str, LogTopic] = {}
         self.directory = directory
+        self.log_format = log_format
         if directory:
             os.makedirs(directory, exist_ok=True)
 
@@ -183,7 +246,7 @@ class MessageLog:
                 os.path.join(self.directory, f"{name}.jsonl")
                 if self.directory else None
             )
-            self.topics[name] = LogTopic(name, path)
+            self.topics[name] = LogTopic(name, path, self.log_format)
         return self.topics[name]
 
     def sync(self) -> None:

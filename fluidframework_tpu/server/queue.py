@@ -53,6 +53,38 @@ def _check_fence(cur_fence: int, cur_owner: Optional[str],
         )
 
 
+@contextlib.contextmanager
+def flock_exclusive(f, lock_timeout_s: Optional[float],
+                    path: str) -> Iterator[None]:
+    """Exclusive flock on `f` for one append critical section. With a
+    timeout, acquisition is bounded (LOCK_NB polling) so a takeover
+    successor never wedges behind a stalled — e.g. SIGSTOPped —
+    writer's lock: it times out, has the zombie killed (the
+    supervisor's stale-heartbeat role), and retries. Shared by every
+    topic flavor so the takeover protocol cannot fork."""
+    import fcntl
+
+    if lock_timeout_s is None:
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+    else:
+        deadline = time.time() + lock_timeout_s
+        while True:
+            try:
+                fcntl.flock(f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"append lock on {path} held past "
+                        f"{lock_timeout_s}s"
+                    )
+                time.sleep(0.005)
+    try:
+        yield
+    finally:
+        fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+
 class Producer(Protocol):
     """services-core/src/queue.ts IProducer role."""
 
@@ -167,36 +199,13 @@ class SharedFileTopic:
                     lock_timeout_s: Optional[float] = None) -> int:
         """Append a batch under the OS lock; returns the payload bytes
         written (the byte-based checkpoint-cadence signal)."""
-        import fcntl
-
         # An empty batch still gates: a deposed owner must learn it is
         # deposed even when it has nothing to write.
         payload = b"".join(
             json.dumps(m).encode() + b"\n" for m in messages
         )
         with open(self.path, "r+b") as f:
-            if lock_timeout_s is None:
-                fcntl.flock(f.fileno(), fcntl.LOCK_EX)
-            else:
-                # Bounded acquisition for callers that must not wedge
-                # behind a stalled (e.g. SIGSTOPped) writer's lock — a
-                # takeover successor times out, has the zombie killed
-                # (the supervisor's stale-heartbeat role), and retries.
-                deadline = time.time() + lock_timeout_s
-                while True:
-                    try:
-                        fcntl.flock(
-                            f.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB
-                        )
-                        break
-                    except OSError:
-                        if time.time() > deadline:
-                            raise TimeoutError(
-                                f"append lock on {self.path} held past "
-                                f"{lock_timeout_s}s"
-                            )
-                        time.sleep(0.005)
-            try:
+            with flock_exclusive(f, lock_timeout_s, self.path):
                 self._gate_fence(fence, owner)
                 f.seek(0, os.SEEK_END)
                 pos = f.tell()
@@ -211,8 +220,6 @@ class SharedFileTopic:
                 f.write(payload)
                 f.flush()
                 os.fsync(f.fileno())
-            finally:
-                fcntl.flock(f.fileno(), fcntl.LOCK_UN)
         return len(payload)
 
     # ------------------------------------------------------------- read

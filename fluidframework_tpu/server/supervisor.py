@@ -51,6 +51,12 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from .columnar_log import (
+    LOG_FORMATS,
+    default_log_format,
+    make_tail_reader,
+    make_topic,
+)
 from .queue import (
     FencedCheckpointStore,
     FencedError,
@@ -64,6 +70,7 @@ __all__ = [
     "BroadcasterRole",
     "DELI_IMPLS",
     "DeliRole",
+    "LOG_FORMATS",
     "ROLES",
     "ScribeRole",
     "ScriptoriumRole",
@@ -106,10 +113,15 @@ class _Role:
     name: str = ""
     in_topic_name: str = ""
     out_topic_name: Optional[str] = None
+    # Roles that ingest columnar `RecordBatch` frames whole (the kernel
+    # deli) set this; everyone else reads decoded records.
+    ingest_batches: bool = False
 
     def __init__(self, shared_dir: str, owner: str, ttl_s: float = 1.0,
                  batch: int = 512, ckpt_interval_s: float = 0.25,
-                 ckpt_bytes: int = 256 * 1024):
+                 ckpt_bytes: int = 256 * 1024,
+                 log_format: Optional[str] = None,
+                 ckpt_duty: float = 0.2):
         """`ckpt_interval_s` / `ckpt_bytes`: checkpoint cadence —
         a checkpoint is written when EITHER bound is crossed since the
         last one (ROADMAP item (b): the seed checkpointed every step,
@@ -117,12 +129,33 @@ class _Role:
         batch). Correctness is cadence-independent: exactly-once
         recovery scans the output topic for the durable `inOff` prefix
         and silently replays the checkpoint→prefix gap, however wide.
-        `ckpt_interval_s=0` restores every-step checkpointing."""
+        `ckpt_interval_s=0` restores every-step checkpointing.
+
+        `log_format` ("json" | "columnar", default env
+        ``FLUID_LOG_FORMAT``) picks the topic wire form: JSONL lines or
+        binary record batches (`server.columnar_log`). Columnar
+        readers parse both, so a JSONL farm may UPGRADE to columnar
+        across a restart and resume the same topics mid-stream (the
+        reverse needs drained topics — JSON readers cannot parse
+        frames).
+
+        `ckpt_duty` is the checkpoint-STORM guard: once state grows to
+        where one snapshot costs S seconds (a 10k-doc deli checkpoint
+        runs to tens of MB), a cadence that fires every pump would
+        spend most of the wall clock checkpointing — so a snapshot
+        costing S runs at most every ``S / ckpt_duty`` seconds,
+        bounding checkpoint work to that fraction of wall time however
+        large the state gets. Recovery granularity widens with it;
+        correctness does not (the inOff scan replays any gap).
+        Explicit every-step mode (``ckpt_interval_s=0``) bypasses the
+        guard."""
         self.shared_dir = shared_dir
         self.owner = owner
         self.batch = batch
         self.ckpt_interval_s = ckpt_interval_s
         self.ckpt_bytes = ckpt_bytes
+        self.ckpt_duty = ckpt_duty
+        self.log_format = default_log_format(log_format)
         self.leases = LeaseManager(
             os.path.join(shared_dir, "leases"), owner, ttl_s,
             claim_ttl_s=max(0.25, ttl_s / 2),
@@ -130,11 +163,12 @@ class _Role:
         self.ckpt = FencedCheckpointStore(
             os.path.join(shared_dir, "checkpoints")
         )
-        self.in_topic = SharedFileTopic(
-            _topic_path(shared_dir, self.in_topic_name)
+        self.in_topic = make_topic(
+            _topic_path(shared_dir, self.in_topic_name), self.log_format
         )
         self.out_topic = (
-            SharedFileTopic(_topic_path(shared_dir, self.out_topic_name))
+            make_topic(_topic_path(shared_dir, self.out_topic_name),
+                       self.log_format)
             if self.out_topic_name else None
         )
         self.fence: Optional[int] = None
@@ -148,6 +182,7 @@ class _Role:
         # the supervisor can merge children's metrics for /metrics.
         self._ckpt_dirty = False
         self._ckpt_last_t = time.time()
+        self._ckpt_last_s = 0.0
         self._ckpt_pending_bytes = 0
         from ..utils.metrics import get_registry
 
@@ -221,11 +256,19 @@ class _Role:
         # write-path half of the takeover contract.
         self.out_topic.append_many([], fence=self.fence, owner=self.owner)
         entries, _ = self.out_topic.read_entries(0)
-        done = [r.get("inOff", -1) for _, r in entries
-                if isinstance(r, dict) and r.get("inOff", -1) >= self.offset]
-        if not done:
+        # Durable outputs per input offset: one input may emit SEVERAL
+        # outputs (a wire boxcar), and a crash mid-append can leave a
+        # durable PREFIX of them — outputs land in input order, so only
+        # the LAST durable input (max_done) can be partial; everything
+        # below it is complete.
+        done_counts: Dict[int, int] = {}
+        for _, r in entries:
+            if isinstance(r, dict) and r.get("inOff", -1) >= self.offset:
+                off = r["inOff"]
+                done_counts[off] = done_counts.get(off, 0) + 1
+        if not done_counts:
             return
-        max_done = max(done)
+        max_done = max(done_counts)
         gap, next_off = self.in_topic.read_entries(self.offset)
         sink: List[dict] = []
         for line_idx, rec in gap:
@@ -236,6 +279,15 @@ class _Role:
         else:
             next_off = max(self.offset, max_done + 1, next_off)
         self.flush_batch(sink)  # batching roles rebuild state here
+        # Re-emit the missing tail of max_done's outputs, if the crash
+        # clipped them: deterministic replay regenerates the exact
+        # records, so emitting from the durable count onward completes
+        # the input without duplicating its prefix.
+        tail = [r for r in sink if r.get("inOff") == max_done]
+        tail = tail[done_counts[max_done]:]
+        if tail:
+            self.out_topic.append_many(tail, fence=self.fence,
+                                       owner=self.owner)
         self.offset = next_off
         self._reader = None  # re-anchor the tail at the new offset
         # The replayed records MUST match what is already on disk —
@@ -252,18 +304,32 @@ class _Role:
         )
         self._m_ckpt_writes.inc()
         self._m_ckpt_bytes.inc(n_bytes)
-        self._m_ckpt_ms.observe((time.perf_counter() - t0) * 1000.0)
+        self._ckpt_last_s = time.perf_counter() - t0
+        self._m_ckpt_ms.observe(self._ckpt_last_s * 1000.0)
         self._ckpt_dirty = False
         self._ckpt_pending_bytes = 0
         self._ckpt_last_t = time.time()
 
     def maybe_checkpoint(self) -> bool:
         """Write a checkpoint iff the cadence says so (dirty AND the
-        time or byte bound crossed). Returns whether one was written."""
+        time or byte bound crossed), subject to the checkpoint-storm
+        guard: a snapshot whose last write cost S seconds runs at most
+        every ``S / ckpt_duty`` seconds, so huge states cannot turn
+        the cadence into a wall-clock sink (the 10k-doc deli snapshot
+        is tens of MB — every-pump writes would dominate the pipeline
+        end-to-end). Returns whether one was written."""
         if not self._ckpt_dirty:
             return False
+        now = time.time()
         if (self._ckpt_pending_bytes < self.ckpt_bytes
-                and time.time() - self._ckpt_last_t < self.ckpt_interval_s):
+                and now - self._ckpt_last_t < self.ckpt_interval_s):
+            return False
+        if (self.ckpt_interval_s > 0 and self.ckpt_duty > 0
+                and self._ckpt_last_s > 0
+                and now - self._ckpt_last_t
+                < self._ckpt_last_s / self.ckpt_duty):
+            # Storm guard (ckpt_interval_s=0 — every-step mode — and
+            # ckpt_duty=0 — guard disabled — both bypass it).
             return False
         self.checkpoint()
         return True
@@ -292,10 +358,27 @@ class _Role:
         # read incrementally (TailReader) — re-reading the whole topic
         # per step is O(topic²) over a role's lifetime.
         if self._reader is None or self._reader.next_line != self.offset:
-            self._reader = TailReader(self.in_topic, self.offset)
-        entries = self._reader.poll(self.batch)
+            self._reader = make_tail_reader(self.in_topic, self.offset)
+        out: List[dict] = []
+        moved = 0
+        if self.ingest_batches and hasattr(self._reader, "poll_batches"):
+            # Columnar zero-decode path: whole RecordBatch frames go to
+            # process_batch; stray decoded records (a migrated JSONL
+            # history) take the per-record path.
+            for unit in self._reader.poll_batches(self.batch):
+                if unit[0] == "batch":
+                    moved += unit[2].n
+                    self.process_batch(unit[1], unit[2], out)
+                else:
+                    moved += 1
+                    self.process(unit[1], unit[2], out)
+        else:
+            entries = self._reader.poll(self.batch)
+            moved = len(entries)
+            for line_idx, rec in entries:
+                self.process(line_idx, rec, out)
         next_off = self._reader.next_line
-        if not entries:
+        if not moved:
             if next_off != self.offset:
                 self.offset = next_off  # junk-only progress still counts
                 self._ckpt_dirty = True
@@ -312,9 +395,6 @@ class _Role:
             self.heartbeat()
             time.sleep(idle_sleep)
             return 0
-        out: List[dict] = []
-        for line_idx, rec in entries:
-            self.process(line_idx, rec, out)
         self.flush_batch(out)
         try:
             if self.out_topic is not None:
@@ -332,10 +412,10 @@ class _Role:
             self.heartbeat()  # export the rejection before dying
             print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
             raise SystemExit(EXIT_FENCED)
-        self._m_pump.observe(len(entries))
-        self._m_records.inc(len(entries))
+        self._m_pump.observe(moved)
+        self._m_records.inc(moved)
         self.heartbeat()
-        return len(entries)
+        return moved
 
 
 class DeliRole(_Role):
@@ -379,32 +459,58 @@ class DeliRole(_Role):
             if msg is not None:
                 out.append(self._wire(rec["doc"], msg, line_idx))
             return
+        if kind == "boxcar":
+            # Wire schema rev (ROADMAP (d)): one ingress record carries
+            # a whole client batch, ticketed back-to-back so it
+            # sequences ATOMICALLY — a nack aborts the rest of the
+            # boxcar (matching the in-proc `lambdas` semantics and the
+            # kernel's group-abort machinery), while resubmission dedup
+            # stays per-op and silent (a re-appended boxcar vanishes
+            # without polluting the order).
+            client = int(rec["client"])
+            for op in rec.get("ops") or []:
+                if not self._ticket_wire(
+                    doc, rec["doc"], client, int(op["clientSeq"]),
+                    int(op.get("refSeq", 0)), op.get("contents"),
+                    line_idx, out,
+                ):
+                    break
+            return
         if kind != "op":
             return
-        client = int(rec["client"])
+        self._ticket_wire(
+            doc, rec["doc"], int(rec["client"]), int(rec["clientSeq"]),
+            int(rec.get("refSeq", 0)), rec.get("contents"), line_idx, out,
+        )
+
+    def _ticket_wire(self, doc: DocumentSequencer, doc_id: str,
+                     client: int, client_seq: int, ref_seq: int,
+                     contents: Any, line_idx: int,
+                     out: List[dict]) -> bool:
+        """Ticket one wire op; returns False on a nack (the boxcar
+        abort signal). Deduped resubmissions return True silently."""
         state = doc.clients.get(client)
-        if state is not None and int(rec["clientSeq"]) <= state.client_seq:
+        if state is not None and client_seq <= state.client_seq:
             # Resubmission dedup (the idempotent-producer role): a
             # client that lost its ack mid-batch re-appends the whole
             # batch; everything already sequenced is dropped HERE, so
             # the deltas stream carries each op exactly once and no
             # out-of-order nacks pollute the total order.
-            return
+            return True
         from ..protocol.messages import DocumentMessage, NackMessage
 
         res = doc.sequence(client, DocumentMessage(
-            client_seq=int(rec["clientSeq"]),
-            ref_seq=int(rec.get("refSeq", 0)),
-            contents=rec.get("contents"),
+            client_seq=client_seq, ref_seq=ref_seq, contents=contents,
         ))
         if isinstance(res, NackMessage):
             out.append({
-                "kind": "nack", "doc": rec["doc"], "client": client,
+                "kind": "nack", "doc": doc_id, "client": client,
                 "clientSeq": res.client_seq, "code": res.code,
                 "reason": res.reason, "inOff": line_idx,
             })
-        else:
-            out.append(self._wire(rec["doc"], res, line_idx))
+            return False
+        out.append(self._wire(doc_id, res, line_idx))
+        return True
 
     @staticmethod
     def _wire(doc_id: str, msg, line_idx: int) -> dict:
@@ -515,11 +621,14 @@ def serve_role(shared_dir: str, role: str, owner: str,
                ttl_s: float = 1.0, batch: int = 512,
                deli_impl: str = "scalar",
                ckpt_interval_s: float = 0.25,
-               ckpt_bytes: int = 256 * 1024) -> None:
+               ckpt_bytes: int = 256 * 1024,
+               log_format: Optional[str] = None,
+               ckpt_duty: float = 0.2) -> None:
     """Child-process entry: run one role until killed/deposed/fenced."""
     r = resolve_role_class(role, deli_impl)(
         shared_dir, owner, ttl_s=ttl_s, batch=batch,
         ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
+        log_format=log_format, ckpt_duty=ckpt_duty,
     )
     print(f"READY {role} {owner}", flush=True)
     while True:
@@ -556,7 +665,9 @@ class ServiceSupervisor:
                  spawn_ready_timeout_s: float = 30.0,
                  deli_impl: Optional[str] = None,
                  ckpt_interval_s: float = 0.25,
-                 ckpt_bytes: int = 256 * 1024):
+                 ckpt_bytes: int = 256 * 1024,
+                 log_format: Optional[str] = None,
+                 ckpt_duty: float = 0.2):
         self.shared_dir = shared_dir
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
@@ -564,6 +675,8 @@ class ServiceSupervisor:
         self.batch = batch
         self.ckpt_interval_s = ckpt_interval_s
         self.ckpt_bytes = ckpt_bytes
+        self.ckpt_duty = ckpt_duty
+        self.log_format = default_log_format(log_format)
         self.deli_impl = deli_impl or os.environ.get("FLUID_DELI", "scalar")
         if self.deli_impl not in DELI_IMPLS:
             raise ValueError(
@@ -615,8 +728,10 @@ class ServiceSupervisor:
                  "--owner", owner, "--ttl", str(self.ttl_s),
                  "--batch", str(self.batch),
                  "--impl", self.deli_impl,
+                 "--log-format", self.log_format,
                  "--ckpt-interval", str(self.ckpt_interval_s),
-                 "--ckpt-bytes", str(self.ckpt_bytes)],
+                 "--ckpt-bytes", str(self.ckpt_bytes),
+                 "--ckpt-duty", str(self.ckpt_duty)],
                 stdout=subprocess.PIPE, text=True,
                 cwd=self._repo_root(),
                 env=dict(os.environ, JAX_PLATFORMS="cpu"),
@@ -785,7 +900,8 @@ class ServiceSupervisor:
             }
             ok = ok and alive and not stale
         return {"status": "ok" if ok else "degraded", "roles": roles,
-                "deli_impl": self.deli_impl}
+                "deli_impl": self.deli_impl,
+                "log_format": self.log_format}
 
     def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
         """The farm's live ops endpoint: `/metrics` merges the
@@ -838,21 +954,26 @@ def main(argv: Optional[List[str]] = None) -> None:
     ttl = float(_take("--ttl", "1.0"))
     batch = int(_take("--batch", "512"))
     impl = _take("--impl") or os.environ.get("FLUID_DELI", "scalar")
+    log_format = _take("--log-format")
     ckpt_interval = float(_take("--ckpt-interval", "0.25"))
     ckpt_bytes = int(_take("--ckpt-bytes", str(256 * 1024)))
+    ckpt_duty = float(_take("--ckpt-duty", "0.2"))
     if (role not in ROLE_CLASSES or shared_dir is None
-            or impl not in DELI_IMPLS):
+            or impl not in DELI_IMPLS
+            or (log_format is not None and log_format not in LOG_FORMATS)):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster} --dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
-            "[--ckpt-interval S] [--ckpt-bytes N]",
+            "[--log-format json|columnar] "
+            "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
         raise SystemExit(2)
     serve_role(shared_dir, role, owner, ttl_s=ttl, batch=batch,
                deli_impl=impl, ckpt_interval_s=ckpt_interval,
-               ckpt_bytes=ckpt_bytes)
+               ckpt_bytes=ckpt_bytes, log_format=log_format,
+               ckpt_duty=ckpt_duty)
 
 
 if __name__ == "__main__":

@@ -46,6 +46,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..server.columnar_log import make_topic
 from ..server.queue import (
     FencedCheckpointStore,
     FencedError,
@@ -80,6 +81,16 @@ class ChaosConfig:
     # scalar production path, so a kernel run converging proves the
     # batched pipeline bit-identical under faults.
     deli_impl: str = "scalar"
+    # Topic wire form under test: "json" (JSONL lines) or "columnar"
+    # (binary record-batch frames, server.columnar_log). Golden always
+    # folds in-process, so a columnar run converging proves the binary
+    # op-log bit-identical under the same faults.
+    log_format: str = "json"
+    # Fraction of interleave picks that ride a wire BOXCAR record
+    # (several of one client's ops in one ingress record, sequenced
+    # atomically — the ROADMAP (d) schema rev). 0 keeps the historical
+    # per-op stream.
+    boxcar_rate: float = 0.0
 
 
 @dataclass
@@ -131,8 +142,21 @@ def build_workload(cfg: ChaosConfig) -> List[dict]:
     keys = list(queues)
     while keys:
         k = rng.choice(keys)
-        recs.append(queues[k].pop(0))
-        if not queues[k]:
+        q = queues[k]
+        if cfg.boxcar_rate and len(q) >= 2 and rng.random() < cfg.boxcar_rate:
+            n = min(len(q), rng.randint(2, 4))
+            ops = [q.pop(0) for _ in range(n)]
+            recs.append({
+                "kind": "boxcar", "doc": k[0], "client": k[1],
+                "ops": [
+                    {"clientSeq": o["clientSeq"], "refSeq": o["refSeq"],
+                     "contents": o["contents"]}
+                    for o in ops
+                ],
+            })
+        else:
+            recs.append(q.pop(0))
+        if not q:
             keys.remove(k)
     return recs
 
@@ -317,13 +341,15 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     sup = ServiceSupervisor(
         shared, ttl_s=cfg.ttl_s,
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
-        deli_impl=cfg.deli_impl,
+        deli_impl=cfg.deli_impl, log_format=cfg.log_format,
     ).start()
-    raw = SharedFileTopic(os.path.join(shared, "topics", "rawdeltas.jsonl"))
+    raw = make_topic(os.path.join(shared, "topics", "rawdeltas.jsonl"),
+                     cfg.log_format)
     deltas_path = os.path.join(shared, "topics", "deltas.jsonl")
-    durable = SharedFileTopic(os.path.join(shared, "topics", "durable.jsonl"))
-    broadcast = SharedFileTopic(
-        os.path.join(shared, "topics", "broadcast.jsonl")
+    durable = make_topic(os.path.join(shared, "topics", "durable.jsonl"),
+                         cfg.log_format)
+    broadcast = make_topic(
+        os.path.join(shared, "topics", "broadcast.jsonl"), cfg.log_format
     )
     fence_rejections = 0
     events: List[str] = []
@@ -454,7 +480,8 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
     deli = sup.procs.get("deli")
     if deli is None or deli.poll() is not None:
         return 0
-    deltas = SharedFileTopic(os.path.join(shared, "topics", "deltas.jsonl"))
+    deltas = make_topic(os.path.join(shared, "topics", "deltas.jsonl"),
+                        cfg.log_format)
     old_fence, old_owner = deltas.latest_fence()
     os.kill(deli.pid, signal.SIGSTOP)
     note("chaos: SIGSTOP deli (stale lease)")

@@ -65,20 +65,23 @@ def build_pipeline_workload(n_docs: int, n_clients: int,
     return recs
 
 
-def _make_role(impl: str, scratch: str):
+def _make_role(impl: str, scratch: str, log_format: str = "json"):
     if impl == "kernel":
         from ..server.deli_kernel import KernelDeliRole
 
-        return KernelDeliRole(scratch, owner=f"bench-{impl}", ttl_s=3600.0)
+        return KernelDeliRole(scratch, owner=f"bench-{impl}",
+                              ttl_s=3600.0, log_format=log_format)
     from ..server.supervisor import DeliRole
 
-    return DeliRole(scratch, owner=f"bench-{impl}", ttl_s=3600.0)
+    return DeliRole(scratch, owner=f"bench-{impl}", ttl_s=3600.0,
+                    log_format=log_format)
 
 
 def run_pipeline(impl: str, raw_path: str, out_dir: str,
                  batch: int = 8192, per_record_append: bool = False,
                  max_records: Optional[int] = None,
-                 checkpoint_mode: Optional[str] = "cadence") -> dict:
+                 checkpoint_mode: Optional[str] = "cadence",
+                 log_format: str = "json") -> dict:
     """Drive one deli variant raw-topic-in → deltas-topic-out.
 
     `checkpoint_mode` selects the farm's checkpoint policy inside the
@@ -86,31 +89,44 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
     — the production default), "pump" (one fenced checkpoint per pump,
     the seed's every-step behavior), or None (no checkpoints).
 
+    `log_format` selects the topic wire form for BOTH ends: "json"
+    (JSONL lines) or "columnar" (binary record batches — the kernel
+    role then ingests raw `RecordBatch` frames and passes op contents
+    through as pre-encoded blobs, zero per-record JSON on the wire).
+
     Returns {"seconds", "records", "outputs", "out_path", "stages",
     "metrics"} — `stages` is the per-stage wall-time breakdown (poll/
     parse, process+kernel, append, checkpoint) and `metrics` the run's
     checkpoint counters from an isolated registry."""
-    from ..server.queue import SharedFileTopic, TailReader
+    from ..server.columnar_log import make_tail_reader, make_topic
     from ..utils import metrics as _metrics
 
-    raw = SharedFileTopic(raw_path)
-    out_path = os.path.join(out_dir, f"deltas-{impl}"
+    raw = make_topic(raw_path, log_format)
+    out_path = os.path.join(out_dir, f"deltas-{impl}-{log_format}"
                             + ("-seed" if per_record_append else "") + ".jsonl")
     if os.path.exists(out_path):
         os.remove(out_path)
-    deltas = SharedFileTopic(out_path)
+        for side in (".fence", ".clen"):
+            if os.path.exists(out_path + side):
+                os.remove(out_path + side)
+    deltas = make_topic(out_path, log_format)
     # Isolated registry: this run's checkpoint/pump counters are not
     # polluted by (and do not pollute) other runs in the process.
     reg = _metrics.MetricsRegistry()
     prev_reg = _metrics.set_registry(reg)
     try:
-        role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"))
+        role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"),
+                          log_format)
     finally:
         _metrics.set_registry(prev_reg)
     # The bench drives the role datapath directly (no lease loop);
     # bind a fence so fenced checkpoint writes work.
     role.fence = 1
-    reader = TailReader(raw)
+    reader = make_tail_reader(raw)
+    # The kernel role's columnar fast path: whole RecordBatch frames
+    # (max_records runs keep the exact per-record cap instead).
+    use_batches = (role.ingest_batches and max_records is None
+                   and hasattr(reader, "poll_batches"))
     n_records = 0
     n_out = 0
     t_poll = t_proc = t_append = t_ckpt = 0.0
@@ -122,14 +138,27 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
             if cap <= 0:
                 break
         t1 = time.perf_counter()
-        entries = reader.poll(cap)
+        if use_batches:
+            units = reader.poll_batches(cap)
+            entries = None
+            moved = sum(u[2].n if u[0] == "batch" else 1 for u in units)
+        else:
+            entries = reader.poll(cap)
+            moved = len(entries)
         t2 = time.perf_counter()
         t_poll += t2 - t1
-        if not entries:
+        if not moved:
             break
         out: List[dict] = []
-        for line_idx, rec in entries:
-            role.process(line_idx, rec, out)
+        if use_batches:
+            for u in units:
+                if u[0] == "batch":
+                    role.process_batch(u[1], u[2], out)
+                else:
+                    role.process(u[1], u[2], out)
+        else:
+            for line_idx, rec in entries:
+                role.process(line_idx, rec, out)
         role.flush_batch(out)
         t3 = time.perf_counter()
         t_proc += t3 - t2
@@ -148,7 +177,7 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
             else:
                 role.maybe_checkpoint()
             t_ckpt += time.perf_counter() - t4
-        n_records += len(entries)
+        n_records += moved
         n_out += len(out)
     seconds = time.perf_counter() - t0
     ckpt = {
@@ -171,11 +200,13 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
 
 
 def _read_canonical(path: str) -> List[dict]:
-    from ..server.queue import SharedFileTopic
+    # ColumnarFileTopic reads BOTH wire forms (JSON lines and binary
+    # frames), so one reader canonicalizes every variant's output.
+    from ..server.columnar_log import ColumnarFileTopic
 
     return [
         {k: v for k, v in r.items() if k != "reason"}
-        for r in SharedFileTopic(path).read_from(0)
+        for r in ColumnarFileTopic(path).read_from(0)
     ]
 
 
@@ -197,6 +228,18 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
             os.remove(raw_path)
         raw = SharedFileTopic(raw_path)
         raw.append_many(workload)
+        # The SAME workload as a columnar record-batch log, framed in
+        # pump-sized batches (the boxcarred ingress shape).
+        from ..server.columnar_log import make_topic
+
+        raw_col_path = os.path.join(scratch, "rawdeltas-col.jsonl")
+        for stale in (raw_col_path, raw_col_path + ".clen",
+                      raw_col_path + ".fence"):
+            if os.path.exists(stale):
+                os.remove(stale)
+        raw_col = make_topic(raw_col_path, "columnar")
+        for lo in range(0, len(workload), batch):
+            raw_col.append_many(workload[lo:lo + batch])
 
         # Kernel warm-up (the standard bench contract: the timed region
         # never compiles — one untimed full run compiles every jit
@@ -205,16 +248,25 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
         run_pipeline("kernel", raw_path, scratch, batch=batch)
         kern = run_pipeline("kernel", raw_path, scratch, batch=batch)
         scal = run_pipeline("scalar", raw_path, scratch, batch=batch)
+        # The columnar op-log twins (ROADMAP (a)): identical records,
+        # binary record-batch topics on both ends.
+        kern_col = run_pipeline("kernel", raw_col_path, scratch,
+                                batch=batch, log_format="columnar")
+        scal_col = run_pipeline("scalar", raw_col_path, scratch,
+                                batch=batch, log_format="columnar")
 
-        # Correctness gate: bit-identical stamps/nacks/MSNs.
+        # Correctness gate: bit-identical stamps/nacks/MSNs across
+        # every (impl x log_format) variant.
         a = _read_canonical(kern["out_path"])
-        b = _read_canonical(scal["out_path"])
-        if a != b:
-            n = sum(1 for x, y in zip(a, b) if x != y) + abs(len(a) - len(b))
-            raise AssertionError(
-                f"kernel deltas diverge from scalar oracle "
-                f"({n} records differ; {len(a)} vs {len(b)})"
-            )
+        for other in (scal, kern_col, scal_col):
+            b = _read_canonical(other["out_path"])
+            if a != b:
+                n = sum(1 for x, y in zip(a, b) if x != y)                     + abs(len(a) - len(b))
+                raise AssertionError(
+                    f"deltas diverge across variants at "
+                    f"{other['out_path']} ({n} records differ; "
+                    f"{len(a)} vs {len(b)})"
+                )
 
         # ROADMAP item (b) evidence: the same kernel run with the
         # seed's every-step checkpoint policy — the checkpoint
@@ -230,6 +282,8 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
 
         kernel_ops = kern["records"] / kern["seconds"]
         scalar_ops = scal["records"] / scal["seconds"]
+        col_ops = kern_col["records"] / kern_col["seconds"]
+        col_scalar_ops = scal_col["records"] / scal_col["seconds"]
         seed_ops = seed_run["records"] / seed_run["seconds"]
         every_ops = kern_every["records"] / kern_every["seconds"]
         return {
@@ -242,6 +296,16 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
             "seed_records_measured": seed_run["records"],
             "vs_baseline": round(kernel_ops / seed_ops, 2),
             "vs_scalar_batched": round(kernel_ops / scalar_ops, 2),
+            # Columnar op-log (ROADMAP (a)/(d)): the SAME pipeline over
+            # binary record-batch topics — the end-to-end number where
+            # the kernel win finally survives the wire.
+            "columnar_ops_per_sec": round(col_ops, 1),
+            "columnar_scalar_ops_per_sec": round(col_scalar_ops, 1),
+            "columnar_vs_json_log": round(col_ops / kernel_ops, 2),
+            "columnar_vs_scalar_batched_json": round(
+                col_ops / scalar_ops, 2
+            ),
+            "columnar_stage_breakdown": kern_col["stages"],
             # Per-stage wall-time breakdown of the timed kernel run
             # (where a sequenced record's time goes inside the pump).
             "stage_breakdown": kern["stages"],

@@ -44,14 +44,48 @@ def test_supervised_farm_no_fault_matches_golden(tmp_path):
     """The multi-process farm with NO faults reproduces the in-proc
     golden stream bit-identically — the baseline every fault class is
     measured against."""
+    # timeout is a deadline for a CONDITION poll inside run_chaos, not
+    # a sleep: generous bounds deflake slow boxes without slowing the
+    # happy path.
     res = run_chaos(ChaosConfig(
         seed=11, faults=(), n_docs=1, n_clients=2, ops_per_client=15,
-        timeout_s=60, shared_dir=str(tmp_path),
+        timeout_s=120, shared_dir=str(tmp_path),
     ))
     _assert_converged(res)
     assert res.restarts == {
         "deli": 0, "scriptorium": 0, "scribe": 0, "broadcaster": 0
     }
+
+
+def test_supervised_farm_no_fault_columnar_matches_golden(tmp_path):
+    """The farm over the COLUMNAR binary op-log (every topic a
+    record-batch log, ingress riding wire boxcars — the ROADMAP
+    (a)/(d) storage path) reproduces the in-proc golden stream
+    bit-identically: the wire form must never change the order."""
+    res = run_chaos(ChaosConfig(
+        seed=11, faults=(), n_docs=1, n_clients=2, ops_per_client=15,
+        timeout_s=120, shared_dir=str(tmp_path),
+        log_format="columnar", boxcar_rate=0.3,
+    ))
+    _assert_converged(res)
+    assert res.restarts == {
+        "deli": 0, "scriptorium": 0, "scribe": 0, "broadcaster": 0
+    }
+
+
+@pytest.mark.chaos
+def test_chaos_kill_torn_columnar_kernel_converges(tmp_path):
+    """Kill + torn faults against the KERNEL deli over COLUMNAR topics
+    (boxcarred ingress): exactly-once recovery, torn-tail sealing, and
+    CRC-guarded framing must keep the binary log bit-identical to the
+    scalar JSON golden."""
+    res = run_chaos(ChaosConfig(
+        seed=3, faults=("kill", "torn"), n_docs=2, n_clients=2,
+        ops_per_client=12, timeout_s=150, shared_dir=str(tmp_path),
+        deli_impl="kernel", log_format="columnar", boxcar_rate=0.25,
+    ))
+    _assert_converged(res)
+    assert sum(res.restarts.values()) >= 4
 
 
 def test_chaos_kill_every_role_exactly_once(tmp_path):
@@ -94,7 +128,7 @@ def test_chaos_net_duplicated_delayed_delivery(tmp_path):
     gap/dedup guard reconstructs the exact stream."""
     res = run_chaos(ChaosConfig(
         seed=6, faults=("net",), n_docs=1, n_clients=2,
-        ops_per_client=20, timeout_s=60, shared_dir=str(tmp_path),
+        ops_per_client=20, timeout_s=120, shared_dir=str(tmp_path),
     ))
     _assert_converged(res)
     assert res.client_digest == res.golden_digest
@@ -187,7 +221,17 @@ def test_client_farm_survives_server_sigkill_live_reconnect(tmp_path):
         s1 = c1.runtime.get_datastore("default").get_channel("s")
         s1.insert_text(0, "before")
         c1.flush()
-        time.sleep(0.4)  # let the durable journal absorb the op
+
+        def wait_clean(deadline_s=10.0):
+            # Bounded condition poll (not a wall-clock sleep): the op
+            # is ack'd round-trip once the runtime is no longer dirty,
+            # which is exactly when the durable journal has it.
+            deadline = time.time() + deadline_s
+            while c1.runtime.is_dirty and time.time() < deadline:
+                time.sleep(0.02)
+            assert not c1.runtime.is_dirty, "op never became durable"
+
+        wait_clean()
 
         proc.send_signal(signal.SIGKILL)
         proc.wait(timeout=10)
@@ -200,7 +244,7 @@ def test_client_farm_survives_server_sigkill_live_reconnect(tmp_path):
         assert c1.connected, f"reconnect failed (delays={cm.delays})"
         assert cm.delays, "the ladder must actually have backed off"
         c1.flush()
-        time.sleep(0.4)
+        wait_clean(20.0)
 
         c2 = Loader(SocketDriver(host, port), registry).resolve(doc)
         s2 = c2.runtime.get_datastore("default").get_channel("s")

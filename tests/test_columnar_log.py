@@ -1,0 +1,469 @@
+"""Columnar binary op-log: codec round-trip, corruption recovery,
+fencing, and cross-format migration.
+
+The record-batch codec (`protocol.record_batch`) and its topic
+(`server.columnar_log.ColumnarFileTopic`) must honor the exact
+`SharedFileTopic` contract — torn tails never consumed, corrupt units
+skipped but counted, fenced appends rejected with `FencedError`,
+record offsets identical across every reader — while carrying the
+raw-op fields as columns the kernel deli ingests with zero per-record
+JSON decode. Mixed JSONL + binary histories replay in one file, so a
+farm can switch formats across a restart mid-stream."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import pytest
+
+from fluidframework_tpu.protocol.record_batch import (
+    JsonBlob,
+    K_GENERIC,
+    K_RAW_OP,
+    RecordBatch,
+    decode_batch,
+    encode_batch,
+)
+from fluidframework_tpu.server.columnar_log import (
+    ColumnarFileTopic,
+    ColumnarTailReader,
+    make_topic,
+)
+from fluidframework_tpu.server.queue import FencedError, SharedFileTopic
+from fluidframework_tpu.server.supervisor import DeliRole
+
+
+# ---------------------------------------------------------------------------
+# codec round-trip
+# ---------------------------------------------------------------------------
+
+
+def gen_records(seed: int, n: int = 400):
+    """Random wire records across every columnar kind, plus generic
+    odds-and-ends the codec must round-trip losslessly."""
+    rng = random.Random(seed)
+    recs = []
+    for i in range(n):
+        doc = f"doc{rng.randrange(7)}"
+        r = rng.random()
+        if r < 0.25:
+            recs.append({"kind": "op", "doc": doc,
+                         "client": rng.randint(-5, 10**7),
+                         "clientSeq": rng.randrange(100),
+                         "refSeq": rng.randrange(50),
+                         "contents": rng.choice([
+                             None, 0, "x", {"v": i}, [1, {"a": None}],
+                         ])})
+        elif r < 0.4:
+            recs.append({"kind": rng.choice(["join", "leave"]),
+                         "doc": doc, "client": rng.randint(-3, 99)})
+        elif r < 0.5:
+            recs.append({"kind": "boxcar", "doc": doc, "client": i,
+                         "ops": [
+                             {"clientSeq": j + 1, "refSeq": 0,
+                              "contents": {"j": j}}
+                             for j in range(rng.randrange(4))
+                         ]})
+        elif r < 0.7:
+            recs.append({"kind": "op", "doc": doc, "seq": i + 1,
+                         "msn": rng.randrange(i + 1),
+                         "client": rng.randrange(64),
+                         "clientSeq": rng.randrange(100),
+                         "refSeq": 0,
+                         "type": rng.choice(["op", "join", "leave"]),
+                         "contents": {"v": rng.randrange(999)},
+                         "inOff": i})
+        elif r < 0.8:
+            recs.append({"kind": "nack", "doc": doc,
+                         "client": rng.randrange(64),
+                         "clientSeq": rng.randrange(100), "code": 422,
+                         "reason": "clientSeq 9, expected 2",
+                         "inOff": i})
+        else:
+            # Generic: wrong key sets, non-dicts, nested values, floats
+            recs.append(rng.choice([
+                {"kind": "op", "doc": doc, "client": 1.5,  # float id
+                 "clientSeq": 1, "refSeq": 0, "contents": None},
+                {"weird": True, "deep": {"a": [i, None, "s"]}},
+                ["bare", "list", i],
+                "just a string",
+                {"kind": "op", "doc": doc, "extra": 1, "client": 2,
+                 "clientSeq": 1, "refSeq": 0, "contents": 0},
+            ]))
+    return recs
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_codec_roundtrip_property(seed):
+    recs = gen_records(seed)
+    frame = encode_batch(recs, fence=7, owner="w-1")
+    batch, end, n = decode_batch(frame)
+    assert end == len(frame) and n == len(recs)
+    assert batch.fence == 7 and batch.owner == "w-1"
+    assert batch.records() == recs
+    # Per-record access matches bulk decode.
+    assert [batch.record(i) for i in range(n)] == recs
+
+
+def test_codec_columns_expose_raw_op_fields():
+    recs = [
+        {"kind": "op", "doc": "a", "client": 3, "clientSeq": 5,
+         "refSeq": 2, "contents": {"v": 1}},
+        {"kind": "join", "doc": "b", "client": -9},
+        {"weird": 1},
+    ]
+    batch, _, _ = decode_batch(encode_batch(recs))
+    assert batch.kind[0] == K_RAW_OP
+    assert batch.kind[2] == K_GENERIC
+    assert batch.docs[batch.doc_idx[0]] == "a"
+    assert batch.docs[batch.doc_idx[1]] == "b"
+    assert int(batch.client[0]) == 3
+    assert int(batch.client_seq[0]) == 5
+    assert int(batch.ref_seq[0]) == 2
+    assert int(batch.client[1]) == -9
+    # Blob side-by-side: contents bytes are directly reusable.
+    assert json.loads(batch.blob(0)) == {"v": 1}
+
+
+def test_jsonblob_passthrough_and_equality():
+    blob = JsonBlob(b'{"v": 3}')
+    assert blob == {"v": 3}
+    assert blob == JsonBlob(b'{"v":3}')
+    assert repr(blob) == repr({"v": 3})
+    # A record carrying a JsonBlob encodes from the raw bytes (no
+    # re-encode) and decodes to the plain value.
+    rec = {"kind": "op", "doc": "d", "client": 1, "clientSeq": 1,
+           "refSeq": 0, "contents": blob}
+    batch, _, _ = decode_batch(encode_batch([rec]))
+    assert batch.records()[0]["contents"] == {"v": 3}
+
+
+def test_torn_frame_not_consumed_then_resumed():
+    frame = encode_batch([{"k": i} for i in range(3)])
+    for cut in (4, 10, len(frame) - 1):
+        batch, end, n = decode_batch(frame[:cut])
+        assert batch is None and n == -1 and end == 0
+    batch, end, n = decode_batch(frame)
+    assert batch is not None and n == 3
+
+
+# ---------------------------------------------------------------------------
+# topic semantics
+# ---------------------------------------------------------------------------
+
+
+def test_topic_offsets_and_tailreader_parity(tmp_path):
+    topic = ColumnarFileTopic(str(tmp_path / "t.jsonl"))
+    recs = gen_records(3, 120)
+    for lo in range(0, len(recs), 17):
+        topic.append_many(recs[lo:lo + 17])
+    entries, nxt = topic.read_entries(0)
+    assert [v for _, v in entries] == recs
+    assert nxt == len(recs)
+    # Arbitrary offsets + caps behave like SharedFileTopic.
+    entries, nxt = topic.read_entries(40, max_count=10)
+    assert [i for i, _ in entries] == list(range(40, 50)) and nxt == 50
+    # TailReader offset translation lands mid-batch correctly.
+    r = ColumnarTailReader(topic, 40)
+    got = r.poll()
+    assert [i for i, _ in got] == list(range(40, len(recs)))
+    assert r.next_line == len(recs)
+    # Beyond-EOF offsets never re-deliver earlier records.
+    r2 = ColumnarTailReader(topic, len(recs) + 5)
+    assert r2.poll() == []
+    topic.append_many(recs[:8])  # 8 more records
+    got = r2.poll()
+    assert [i for i, _ in got] == [len(recs) + 5, len(recs) + 6,
+                                   len(recs) + 7]
+
+
+def test_crc_corruption_skips_batch_but_keeps_count(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    topic = ColumnarFileTopic(path)
+    topic.append_many([{"k": i} for i in range(5)])
+    topic.append_many([{"k": i} for i in range(5, 8)])
+    data = bytearray(open(path, "rb").read())
+    data[40] ^= 0xFF  # flip a byte inside the first frame's payload
+    open(path, "wb").write(bytes(data))
+    entries, nxt = topic.read_entries(0)
+    # First batch skipped, its 5 records still counted; second intact.
+    assert nxt == 8
+    assert [(i, v["k"]) for i, v in entries] == [(5, 5), (6, 6), (7, 7)]
+
+
+def test_torn_tail_invisible_and_sealed_by_next_append(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    topic = ColumnarFileTopic(path)
+    topic.append_many([{"k": 0}])
+    reader = ColumnarTailReader(topic)
+    assert len(reader.poll()) == 1
+    # A writer dies mid-append: raw junk past the committed length.
+    with open(path, "ab") as f:
+        f.write(b'\x00garbage{"torn": tru')
+    assert topic.read_entries(0)[1] == 1  # invisible to offset readers
+    assert reader.poll() == []  # and to tail readers
+    topic.append_many([{"k": 1}])  # seals (truncates) the junk
+    got = reader.poll()
+    assert [(i, v["k"]) for i, v in got] == [(1, 1)]
+    entries, nxt = topic.read_entries(0)
+    assert nxt == 2 and [v["k"] for _, v in entries] == [0, 1]
+
+
+def test_fenced_append_rejected_and_stamped(tmp_path):
+    topic = ColumnarFileTopic(str(tmp_path / "t.jsonl"))
+    assert topic.append_many([{"k": 1}], fence=5, owner="a") > 0
+    with pytest.raises(FencedError):
+        topic.append_many([{"k": 2}], fence=4, owner="b")
+    with pytest.raises(FencedError):
+        topic.append_many([], fence=5, owner="b")  # empty still gates
+    # The accepted fence is stamped into the frame header for audit.
+    data = open(topic.path, "rb").read()
+    batch, _, _ = decode_batch(data)
+    assert batch.fence == 5 and batch.owner == "a"
+    assert topic.latest_fence() == (5, "a")
+
+
+def test_mixed_history_json_then_columnar(tmp_path):
+    """A topic written as JSONL continues as a columnar log in the
+    SAME file: offsets count straight through both regions."""
+    path = str(tmp_path / "t.jsonl")
+    SharedFileTopic(path).append_many([{"j": i} for i in range(4)])
+    topic = make_topic(path, "columnar")
+    topic.append_many([{"c": i} for i in range(3)])
+    entries, nxt = topic.read_entries(0)
+    assert nxt == 7
+    assert [v for _, v in entries] == \
+        [{"j": i} for i in range(4)] + [{"c": i} for i in range(3)]
+    # Incremental reader sees the same stream.
+    assert [v for _, v in ColumnarTailReader(topic).poll()] == \
+        [v for _, v in entries]
+
+
+def test_codec_metrics_reported():
+    from fluidframework_tpu.utils import metrics as M
+
+    reg = M.MetricsRegistry()
+    prev = M.set_registry(reg)
+    try:
+        frame = encode_batch([{"k": 1}, {"k": 2}])
+        batch, _, _ = decode_batch(frame)
+        batch.records()
+    finally:
+        M.set_registry(prev)
+    assert reg.counter("codec_encode_records_total",
+                       codec="columnar").value == 2
+    assert reg.counter("codec_encode_bytes_total",
+                       codec="columnar").value == len(frame)
+    assert reg.counter("codec_decode_records_total",
+                       codec="columnar").value == 2
+    # And the report tool renders them.
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    from metrics_report import codec_report
+
+    text = codec_report(reg.snapshot())
+    assert "encode" in text and "decode" in text
+
+
+# ---------------------------------------------------------------------------
+# farm-level migration (JSON log -> columnar log mid-stream)
+# ---------------------------------------------------------------------------
+
+
+def _wire_workload(n_docs=2, n_clients=2, ops=6):
+    recs = []
+    for d in range(n_docs):
+        doc = f"doc{d}"
+        for c in range(1, n_clients + 1):
+            recs.append({"kind": "join", "doc": doc, "client": c})
+        for i in range(ops):
+            for c in range(1, n_clients + 1):
+                recs.append({"kind": "op", "doc": doc, "client": c,
+                             "clientSeq": i + 1, "refSeq": 0,
+                             "contents": {"i": i, "c": c}})
+    return recs
+
+
+def _oracle(recs, scratch):
+    role = DeliRole(str(scratch), owner="oracle", ttl_s=3600.0)
+    out = []
+    for i, r in enumerate(recs):
+        role.process(i, r, out)
+    role.flush_batch(out)
+    return [{k: v for k, v in r.items() if k != "reason"} for r in out]
+
+
+@pytest.mark.parametrize("impl", ["scalar", "kernel"])
+def test_cross_format_migration_via_checkpoint_restore(impl, tmp_path):
+    """Run half the stream over JSONL topics, checkpoint, then restart
+    the role with log_format="columnar" over the SAME topic files and
+    finish: offsets and the output stream must be seamless (zero dup,
+    zero skip, oracle-identical)."""
+    if impl == "kernel":
+        from fluidframework_tpu.server.deli_kernel import KernelDeliRole
+        role_cls = KernelDeliRole
+    else:
+        role_cls = DeliRole
+
+    shared = str(tmp_path / "farm")
+    recs = _wire_workload()
+    half = len(recs) // 2
+    raw_path = os.path.join(shared, "topics", "rawdeltas.jsonl")
+    SharedFileTopic(raw_path).append_many(recs[:half])
+
+    r1 = role_cls(shared, owner="g1", ttl_s=3600.0, batch=16,
+                  log_format="json")
+    while r1.step():
+        pass
+    r1.checkpoint()
+    r1.leases.release("deli")
+
+    # The columnar era: same topic files, binary appends from here on.
+    make_topic(raw_path, "columnar").append_many(recs[half:])
+    r2 = role_cls(shared, owner="g2", ttl_s=3600.0, batch=16,
+                  log_format="columnar")
+    while r2.step():
+        pass
+
+    deltas = make_topic(
+        os.path.join(shared, "topics", "deltas.jsonl"), "columnar"
+    )
+    got = [{k: v for k, v in r.items() if k not in ("reason", "inOff")}
+           for r in deltas.read_from(0)]
+    want = [{k: v for k, v in r.items() if k != "inOff"}
+            for r in _oracle(recs, tmp_path / "oracle")]
+    assert got == want
+
+
+def test_localserver_columnar_persist_and_format_switch(tmp_path):
+    """LocalServer(log_format="columnar") persists journals as record
+    batches; a restart — including a restart that SWITCHES formats —
+    resumes the documents (checkpoint/restore interop)."""
+    from fluidframework_tpu.dds import StringFactory
+    from fluidframework_tpu.runtime import ChannelRegistry, ContainerRuntime
+    from fluidframework_tpu.server import LocalServer
+
+    registry = ChannelRegistry([StringFactory()])
+    persist = str(tmp_path / "srv")
+
+    def connect(server, cid):
+        rt = ContainerRuntime(registry)
+        rt.create_datastore("default").create_channel(
+            "s", StringFactory.type_name
+        )
+        rt.connect(server.connect("doc", cid))
+        return rt
+
+    srv = LocalServer(persist_dir=persist, log_format="json")
+    rt1 = connect(srv, 1)
+    s1 = rt1.get_datastore("default").get_channel("s")
+    s1.insert_text(0, "json era")
+    rt1.flush()
+
+    # Restart columnar over the same persist_dir (mid-journal switch).
+    srv2 = LocalServer(persist_dir=persist, log_format="columnar")
+    rt2 = connect(srv2, 5)
+    s2 = rt2.get_datastore("default").get_channel("s")
+    assert s2.get_text() == "json era"
+    s2.insert_text(0, "col era>")
+    rt2.flush()
+
+    # And once more, proving the columnar journal replays too.
+    srv3 = LocalServer(persist_dir=persist, log_format="columnar")
+    rt3 = connect(srv3, 9)
+    assert rt3.get_datastore("default").get_channel("s").get_text() == \
+        "col era>json era"
+
+
+def test_localserver_rejects_unknown_log_format():
+    from fluidframework_tpu.server import LocalServer
+
+    with pytest.raises(ValueError):
+        LocalServer(log_format="colmnar")
+
+
+def test_tailreader_next_line_holds_at_beyond_eof_offset(tmp_path):
+    """A checkpointed offset ahead of the topic must KEEP
+    next_line == offset while idle (the TailReader contract) — a
+    consumer's staleness check (`reader.next_line != offset`) must not
+    rebuild the reader in a loop."""
+    topic = ColumnarFileTopic(str(tmp_path / "t.jsonl"))
+    topic.append_many([{"k": i} for i in range(3)])
+    r = ColumnarTailReader(topic, 7)
+    assert r.next_line == 7
+    assert r.poll() == []
+    assert r.next_line == 7  # unchanged: nothing below 7 delivered
+    topic.append_many([{"k": i} for i in range(3, 9)])  # records 3..8
+    got = r.poll()
+    assert [(i, v["k"]) for i, v in got] == [(7, 7), (8, 8)]
+    assert r.next_line == 9
+
+
+def test_read_entries_max_count_zero_matches_sharedfiletopic(tmp_path):
+    """max_count=0 takes nothing and leaves the offset alone — the
+    SharedFileTopic drop-in contract."""
+    topic = ColumnarFileTopic(str(tmp_path / "t.jsonl"))
+    topic.append_many([{"k": 1}, {"k": 2}])
+    assert topic.read_entries(0, max_count=0) == ([], 0)
+    assert topic.read_entries(1, max_count=0) == ([], 1)
+
+
+def test_journal_corruption_keeps_offsets_stable(tmp_path):
+    """LocalServer journal replay holds a LOST_RECORD slot for a
+    CRC-corrupt frame instead of dropping it, so lambda checkpoints
+    citing absolute offsets stay aligned after restart (the columnar
+    skip-but-COUNT rule applied to the in-proc journal)."""
+    from fluidframework_tpu.server.log import LOST_RECORD, LogTopic
+
+    path = str(tmp_path / "topic.jsonl")
+    t = LogTopic("t", path, log_format="columnar")
+    t.append_many([{"k": i} for i in range(4)])
+    t.append_many([{"k": 9}])
+    t._file.close()
+    data = bytearray(open(path, "rb").read())
+    data[40] ^= 0xFF  # corrupt the first frame's payload in place
+    open(path, "wb").write(bytes(data))
+    t2 = LogTopic("t", path, log_format="columnar")
+    # 4 lost slots + the intact second frame, offsets unchanged.
+    assert t2.head == 5
+    assert t2.read(0, 4) == [LOST_RECORD] * 4
+    assert t2.read(4) == [{"k": 9}]
+    # The deli frontends treat the placeholder as a no-op record.
+    from fluidframework_tpu.server.lambdas import DeliLambda
+    from fluidframework_tpu.server.log import MessageLog
+
+    log = MessageLog()
+    log.topic("rawdeltas").append_many(
+        [LOST_RECORD, {"doc": "d", "kind": "join", "client": 1},
+         LOST_RECORD]
+    )
+    deli = DeliLambda(log)
+    assert deli.pump() == 3
+    assert len(log.topic("deltas").read(0)) == 1  # only the join stamped
+
+
+def test_format_round_trip_never_truncates_acknowledged_records(tmp_path):
+    """columnar -> json -> columnar over one topic file: the dormant
+    committed-length sidecar from the first columnar era must NOT hide
+    or truncate the JSON era's acknowledged records — the sealer
+    extends over complete units and only a torn suffix is removed."""
+    path = str(tmp_path / "t.jsonl")
+    ColumnarFileTopic(path).append_many([{"era": "col", "k": i}
+                                         for i in range(3)])
+    # JSON era: SharedFileTopic appends lines, sidecar goes stale.
+    SharedFileTopic(path).append_many([{"era": "json", "k": i}
+                                       for i in range(4)])
+    # Columnar again: reads see everything, appends lose nothing.
+    # (The JSON appender sealed the binary tail with a newline — one
+    # counted blank-line unit between the eras, delivered to no one.)
+    topic = ColumnarFileTopic(path)
+    entries, nxt = topic.read_entries(0)
+    assert nxt == 8 and len(entries) == 7
+    topic.append_many([{"era": "col2", "k": 0}])
+    entries, nxt = topic.read_entries(0)
+    assert nxt == 9
+    assert [v["era"] for _, v in entries] == \
+        ["col"] * 3 + ["json"] * 4 + ["col2"]

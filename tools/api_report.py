@@ -42,6 +42,7 @@ PACKAGES = [
     "fluidframework_tpu.loader",
     "fluidframework_tpu.drivers",
     "fluidframework_tpu.server",
+    "fluidframework_tpu.server.columnar_log",
     "fluidframework_tpu.server.deli_kernel",
     "fluidframework_tpu.server.monitor",
     "fluidframework_tpu.server.riddler",
@@ -49,6 +50,7 @@ PACKAGES = [
     "fluidframework_tpu.framework",
     "fluidframework_tpu.parallel",
     "fluidframework_tpu.protocol",
+    "fluidframework_tpu.protocol.record_batch",
     "fluidframework_tpu.testing",
     "fluidframework_tpu.utils",
     "fluidframework_tpu.utils.metrics",

@@ -25,6 +25,10 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
 - metrics-overhead guard: the instrumented config-5 pipeline
   (utils.metrics on, the default) vs the same run with the no-op
   registry; FAILS LOUDLY if instrumentation costs more than 5%.
+- log-format guard: the config-5 pipeline over the columnar binary
+  op-log (`log_format="columnar"`, server.columnar_log) vs the same
+  run over JSONL topics; FAILS LOUDLY if columnar ever drops below
+  1x JSON (the codec must never lose to per-record json.dumps).
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -308,6 +312,67 @@ def config5_metrics_overhead(n_docs: int = 2_000, n_clients: int = 32,
         shutil.rmtree(scratch, ignore_errors=True)
 
 
+def config5_log_format(n_docs: int = 10_000, n_clients: int = 16,
+                       ops_per_client: int = 4, attempts: int = 3,
+                       min_ratio: float = 1.0) -> dict:
+    """Columnar-op-log regression guard (ROADMAP (a)): the config-5
+    pipeline (kernel deli, 10k docs) over `log_format="columnar"`
+    topics vs the same run over JSONL topics. Paired best-of-N per
+    format damps I/O jitter; FAILS LOUDLY (AssertionError) if the
+    binary record-batch log ever drops below `min_ratio` x the JSON
+    log — the moment a codec hot-path regression lands, the bench
+    harness says so."""
+    import shutil
+    import tempfile
+
+    from fluidframework_tpu.server.columnar_log import make_topic
+    from fluidframework_tpu.server.queue import SharedFileTopic
+    from fluidframework_tpu.testing.deli_bench import (
+        build_pipeline_workload,
+        run_pipeline,
+    )
+
+    n_docs = max(8, int(n_docs * SCALE))
+    scratch = tempfile.mkdtemp(prefix="log-format-bench-")
+    try:
+        workload = build_pipeline_workload(n_docs, n_clients,
+                                           ops_per_client)
+        raw_json = os.path.join(scratch, "raw.jsonl")
+        SharedFileTopic(raw_json).append_many(workload)
+        raw_col = os.path.join(scratch, "raw-col.jsonl")
+        col = make_topic(raw_col, "columnar")
+        for lo in range(0, len(workload), 16384):
+            col.append_many(workload[lo:lo + 16384])
+        run_pipeline("kernel", raw_json, scratch)  # jit warm-up
+
+        def best(fmt: str, path: str) -> float:
+            return min(
+                run_pipeline("kernel", path, scratch,
+                             log_format=fmt)["seconds"]
+                for _ in range(attempts)
+            )
+
+        t_json = best("json", raw_json)
+        t_col = best("columnar", raw_col)
+        ratio = t_json / t_col
+        result = {
+            "config": "deli_pipeline_log_format_guard",
+            "records": len(workload),
+            "json_ops_per_sec": round(len(workload) / t_json, 1),
+            "columnar_ops_per_sec": round(len(workload) / t_col, 1),
+            "columnar_vs_json": round(ratio, 2),
+            "min_ratio": min_ratio,
+        }
+        assert ratio >= min_ratio, (
+            f"columnar op-log regressed to {ratio:.2f}x the JSON log "
+            f"(must stay >= {min_ratio}x) on the config-5 pipeline: "
+            f"{result}"
+        )
+        return result
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -385,7 +450,8 @@ def main() -> None:
     results = []
     for fn in (config1_sharedstring_2client, config3_matrix,
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
-               config5_metrics_overhead, config_streaming_ingress):
+               config5_metrics_overhead, config5_log_format,
+               config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

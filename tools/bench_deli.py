@@ -15,9 +15,15 @@ durable `SharedFileTopic`s) with three sequencer variants on the same
   take hours by design).
 - `vs_scalar_batched` — the honest same-batching comparison against
   the scalar deli with the per-pump `append_many` flush.
+- `columnar_ops_per_sec` / `columnar_vs_json_log` /
+  `columnar_vs_scalar_batched_json` — the same pipeline over the
+  COLUMNAR binary op-log (`server.columnar_log` record-batch topics:
+  zero per-record JSON decode into the kernel, blob pass-through on
+  emit) — the end-to-end numbers where the kernel win survives the
+  wire (ROADMAP (a)).
 
-A correctness gate asserts kernel and scalar deltas topics are
-bit-identical (stamps, nack codes, MSNs) before reporting.
+A correctness gate asserts all four (impl x log_format) deltas topics
+are bit-identical (stamps, nack codes, MSNs) before reporting.
 
 Observability riders (ISSUE 3): `stage_breakdown` (per-stage wall time
 — poll/parse, process+kernel, append, checkpoint), and the checkpoint

@@ -2,7 +2,15 @@
 
 Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
-        [--deli scalar|kernel] [--metrics-out PATH]
+        [--deli scalar|kernel] [--log-format json|columnar]
+        [--boxcar-rate R] [--metrics-out PATH]
+
+`--log-format columnar` runs every farm topic as a binary record-batch
+log (server.columnar_log) instead of JSONL; the golden digest still
+folds in-process, so convergence proves the columnar op-log carries
+the identical stream under faults. `--boxcar-rate R` makes a fraction
+of the ingress stream ride wire boxcar records (atomic multi-op
+ingress, the ROADMAP (d) schema rev).
 
 `--deli kernel` runs the farm with the batched TPU sequencer
 (server.deli_kernel.KernelDeliRole) in place of the scalar deli; the
@@ -36,7 +44,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from fluidframework_tpu.server.supervisor import DELI_IMPLS  # noqa: E402
+from fluidframework_tpu.server.supervisor import (  # noqa: E402
+    DELI_IMPLS,
+    LOG_FORMATS,
+)
 from fluidframework_tpu.testing.chaos import (  # noqa: E402
     FAULT_CLASSES,
     ChaosConfig,
@@ -69,19 +80,24 @@ def main() -> int:
         timeout_s=float(_take("--timeout", "120")),
         shared_dir=_take("--keep", None),
         deli_impl=_take("--deli", "scalar"),
+        log_format=_take("--log-format", "json"),
+        boxcar_rate=float(_take("--boxcar-rate", "0")),
     )
     unknown = set(faults) - set(FAULT_CLASSES)
-    if unknown or args or cfg.deli_impl not in DELI_IMPLS:
+    if (unknown or args or cfg.deli_impl not in DELI_IMPLS
+            or cfg.log_format not in LOG_FORMATS):
         print(
             f"unknown faults {sorted(unknown)} / leftover args {args}; "
             f"faults are chosen from {','.join(FAULT_CLASSES)}; "
-            f"--deli is one of {'|'.join(DELI_IMPLS)}",
+            f"--deli is one of {'|'.join(DELI_IMPLS)}; "
+            f"--log-format is one of {'|'.join(LOG_FORMATS)}",
             file=sys.stderr,
         )
         return 2
     print(f"chaos run: seed={seed} faults={','.join(faults)} "
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
-          f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl}",
+          f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl} "
+          f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}",
           flush=True)
     res = run_chaos(cfg)
     print(f"golden digest : {res.golden_digest}")
@@ -113,6 +129,7 @@ def main() -> int:
             dump_snapshot_line(
                 metrics_out, res.metrics, source="chaos_run", seed=seed,
                 faults=",".join(faults), deli=cfg.deli_impl,
+                log_format=cfg.log_format,
             )
             print(f"metrics snapshot appended to {metrics_out}")
     print("CONVERGED" if res.converged else f"DIVERGED ({res.detail})")

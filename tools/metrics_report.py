@@ -12,7 +12,10 @@ and printed as:
 
 - the per-stage latency table — every histogram with observations:
   count, mean, p50/p90/p99 (bucket-interpolated);
-- counters and gauges, one row each.
+- counters and gauges, one row each;
+- a codec summary — the columnar op-log's encode/decode throughput
+  (records, bytes, wall time, MB/s) from the `codec_*` metrics
+  `protocol.record_batch` reports.
 
 Usage: python tools/metrics_report.py FILE [FILE...]
        python tools/metrics_report.py --json FILE...   (merged snapshot
@@ -53,6 +56,42 @@ def load_snapshots(path: str) -> list:
     ]
 
 
+def codec_report(merged: dict) -> str:
+    """The columnar-codec summary: encode/decode records, bytes, wall
+    time, and derived MB/s from the `codec_*` metrics
+    `protocol.record_batch` reports (empty string when no codec metric
+    is present — JSON-log runs)."""
+    counters = {
+        (c["name"], tuple(sorted(c["labels"].items()))): c["value"]
+        for c in merged.get("counters", [])
+    }
+    hists = {
+        h["name"]: h for h in merged.get("histograms", [])
+        if h["name"].startswith("codec_")
+    }
+    lines = []
+    for side in ("encode", "decode"):
+        recs = bytes_ = None
+        for (name, labels), value in counters.items():
+            if name == f"codec_{side}_records_total":
+                recs = (recs or 0) + value
+            elif name == f"codec_{side}_bytes_total":
+                bytes_ = (bytes_ or 0) + value
+        if recs is None and bytes_ is None:
+            continue
+        h = hists.get(f"codec_{side}_ms")
+        ms = h["sum"] if h else 0.0
+        rate = (bytes_ or 0) / (ms / 1000.0) / 1e6 if ms else 0.0
+        lines.append(
+            f"  {side:6s}  records={int(recs or 0):>10d}  "
+            f"bytes={int(bytes_ or 0):>12d}  wall={ms / 1000.0:8.3f}s  "
+            f"{rate:8.1f} MB/s"
+        )
+    if not lines:
+        return ""
+    return "columnar codec (protocol.record_batch):\n" + "\n".join(lines)
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:]]
     as_json = "--json" in args
@@ -67,11 +106,15 @@ def main() -> int:
     if not snaps:
         print("no snapshots found", file=sys.stderr)
         return 1
+    merged = merge_snapshots(snaps).snapshot()
     if as_json:
-        print(json.dumps(merge_snapshots(snaps).snapshot(), indent=1))
+        print(json.dumps(merged, indent=1))
     else:
         print(f"merged {len(snaps)} snapshot(s) from {len(args)} file(s)")
         print(format_report(snaps))
+        codec = codec_report(merged)
+        if codec:
+            print(codec)
     return 0
 
 
