@@ -71,6 +71,7 @@ __all__ = [
     "K_SEQ_OP",
     "MAGIC",
     "MAX_BATCH_BYTES",
+    "MAX_RESYNC_CANDIDATES",
     "RecordBatch",
     "SCHEMA_VERSION",
     "decode_batch",
@@ -453,6 +454,13 @@ class RecordBatch:
         return self._records
 
 
+# Header-corruption resync probe budget: how many MAGIC candidates one
+# `_resync_scan` call validates before sealing the probed region as
+# junk and letting the scan continue from its far edge (pathological
+# corruption only — e.g. payload bytes stuffed with false MAGICs).
+MAX_RESYNC_CANDIDATES = 4096
+
+
 def decode_batch(buf, pos: int = 0,
                  verify_crc: bool = True) -> Tuple[Optional[RecordBatch],
                                                    int, int]:
@@ -494,6 +502,97 @@ def decode_batch(buf, pos: int = 0,
     return RecordBatch(n, fence, payload), end, n
 
 
+def _resync_scan(data, pos: int) -> Optional[int]:
+    """Find a trustworthy unit boundary past a poisoned region (a
+    frame whose HEADER bytes were corrupted in place — version/length
+    fields garbled, so the frame's extent is unknowable).
+
+    Two boundary kinds are trustworthy: a MAGIC candidate whose header
+    decodes AND whose frame is complete, and a newline-delimited,
+    parseable JSON line (the mixed-history case: JSONL records after
+    the poisoned frame). The scan probes every MAGIC occurrence within
+    the longest extent any legitimate frame could have had
+    (``HEADER + MAX_BATCH_BYTES`` — the true boundary, if one exists,
+    must lie inside that window), then takes the EARLIEST confirmed
+    boundary of either kind. Earliest-wins is what keeps the result a
+    function of file content alone, never poll timing: an early reader
+    that sees the next frame still torn and a late reader that sees it
+    complete both resolve to the same earlier line boundary if one
+    exists, so every reader computes the same record slotting (the
+    cross-reader offset parity the exactly-once ``inOff`` scan rests
+    on). A torn-but-plausible candidate may be an append IN PROGRESS:
+    nothing at or past it is decided — return None (wait) unless an
+    earlier confirmed boundary already exists.
+
+    The scan always makes deterministic progress past settled bytes:
+    when the probe budget (pathological false-MAGIC density) or the
+    window is exhausted with more data beyond it, the probed region is
+    itself sealed as junk and the scan continues from its far edge on
+    the next unit, rather than stalling at the poison forever.
+
+    Returns the resync byte offset, or None (wait for more data)."""
+    window_end = pos + HEADER.size + MAX_BATCH_BYTES + 1
+    i = data.find(MAGIC, pos + 1)
+    probed = 0
+    frame_at = None  # earliest confirmed complete-frame boundary
+    torn_at = None  # first torn-but-plausible candidate (undecided)
+    budget_at = None  # first unprobed candidate after budget exhaustion
+    while 0 <= i < window_end:
+        if probed >= MAX_RESYNC_CANDIDATES:
+            budget_at = i
+            break
+        probed += 1
+        try:
+            _batch, _end, cnt = decode_batch(data, i)
+        except ValueError:
+            i = data.find(MAGIC, i + 1)
+            continue
+        if cnt < 0:
+            torn_at = i
+        else:
+            frame_at = i
+        break
+    # Line scan: only bytes BEFORE the first undecided/confirmed point
+    # are settled enough to search (everything earlier is fixed content
+    # — data is append-only — so the earliest line there is final).
+    stops = [min(len(data), window_end)]
+    stops += [s for s in (frame_at, torn_at, budget_at) if s is not None]
+    stop = min(stops)
+    line_at = None
+    j = data.find(b"\n", pos)
+    while 0 <= j < stop:
+        start = j + 1
+        k = data.find(b"\n", start)
+        if k < 0 or k >= stop:
+            break
+        line = data[start:k].strip()
+        if line:
+            try:
+                json.loads(line)
+                line_at = start
+                break
+            except ValueError:
+                pass
+        j = k
+    if line_at is not None:
+        return line_at
+    if frame_at is not None:
+        return frame_at
+    if torn_at is not None:
+        return None  # possibly the live append: wait for more bytes
+    if budget_at is not None:
+        # Probe budget exhausted with nothing confirmed: seal the
+        # probed region and resume at the first unprobed candidate —
+        # content-deterministic, and progress.
+        return budget_at
+    if len(data) > window_end:
+        # Nothing parseable within the longest extent any legitimate
+        # frame could have had, and the file continues past it: seal
+        # the window as junk and keep scanning from its far edge.
+        return window_end
+    return None  # nothing confirmed: wait for more bytes
+
+
 def iter_units(data, start_index: int = 0) -> Iterator[Tuple]:
     """Walk a mixed log region: binary record-batch frames AND JSONL
     lines in one byte string — THE shared scanner every reader of the
@@ -508,12 +607,18 @@ def iter_units(data, start_index: int = 0) -> Iterator[Tuple]:
       skipped but still COUNT `n_records` toward offsets.
     - ``("line", index, 1, raw_line_bytes, end)`` — one newline-
       terminated line (possibly junk; callers parse/skip, the count
-      always holds).
+      always holds). A POISONED region (frame header corrupted in
+      place — extent unknowable) is yielded in this form too, once a
+      bounded magic-scan (`_resync_scan`) confirms the next unit
+      boundary: the region is skipped but counts ONE record slot, so
+      readers resume instead of stalling forever (the pre-resync
+      behavior), at the cost of the poisoned frame's true record
+      count (unknowable — its header is gone).
 
     `index` is the record offset of the unit's first record (starting
     at `start_index`); `end` is the byte offset just past the unit
     within `data`. Iteration stops at the first torn unit (incomplete
-    frame, unterminated line, undecodable header) — an append in
+    frame, unterminated line, unconfirmed resync) — an append in
     progress, re-read complete on a later poll."""
     pos = 0
     idx = start_index
@@ -523,7 +628,16 @@ def iter_units(data, start_index: int = 0) -> Iterator[Tuple]:
             try:
                 batch, end, cnt = decode_batch(data, pos)
             except ValueError:
-                return  # undecodable header: unsealed junk region
+                # Poisoned header: skip-but-count the region up to a
+                # CONFIRMED resync boundary; without one, stop here
+                # (the bytes may still be arriving).
+                resync = _resync_scan(data, pos)
+                if resync is None:
+                    return
+                yield "line", idx, 1, data[pos:resync], resync
+                idx += 1
+                pos = resync
+                continue
             if cnt < 0:
                 return  # torn frame
             yield "batch", idx, cnt, batch, end

@@ -34,6 +34,7 @@ from .queue import (
     partition_of,
 )
 from .supervisor import ServiceSupervisor
+from .shard_fabric import ShardFabricSupervisor, ShardRouter, ShardWorker
 
 
 def __getattr__(name):
@@ -83,4 +84,7 @@ __all__ = [
     "ScribeLambda",
     "ScriptoriumLambda",
     "ServiceSupervisor",
+    "ShardFabricSupervisor",
+    "ShardRouter",
+    "ShardWorker",
 ]

@@ -26,31 +26,62 @@ from typing import Any, Callable, Dict, List, Optional
 from ..protocol.messages import MessageType
 from .castore import ContentAddressedStore
 from .log import LogConsumer, MessageLog, _encode_entry
+from .queue import partition_suffix
 
 
 class CopierLambda:
-    """Raw-op archiver: every rawdeltas record lands in the content
-    store under a per-doc archive ref chain."""
+    """Raw-op archiver: every rawdeltas record — including the
+    partitioned ``rawdeltas-p{k}`` ingress of a sharded server — lands
+    in the content store under a per-doc archive ref chain. Per-doc
+    archive order is safe across partitions because a doc lives in
+    exactly one partition."""
 
     def __init__(self, log: MessageLog, storage: ContentAddressedStore,
                  checkpoint: Optional[dict] = None,
                  batch_size: int = 256):
+        self.log = log
         self.storage = storage
         self.batch_size = batch_size
-        offset = checkpoint["offset"] if checkpoint else 0
-        self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
+        if checkpoint and "offsets" in checkpoint:
+            self._offsets: Dict[str, int] = dict(checkpoint["offsets"])
+        elif checkpoint:  # pre-shard checkpoint: the one flat topic
+            self._offsets = {"rawdeltas": checkpoint["offset"]}
+        else:
+            self._offsets = {}
+        self.consumers: Dict[str, LogConsumer] = {
+            "rawdeltas": LogConsumer(log.topic("rawdeltas"),
+                                     self._offsets.get("rawdeltas", 0))
+        }
         self._pending: List[Any] = []
         self._chunks: Dict[str, int] = (
             dict(checkpoint["chunks"]) if checkpoint else {}
         )
 
+    # Single-partition face (and pre-shard API): "the" raw consumer.
+    @property
+    def consumer(self) -> LogConsumer:
+        return self.consumers["rawdeltas"]
+
+    def _discover(self) -> None:
+        """A sharded `LocalServer` creates its ``rawdeltas-p{k}``
+        ingress topics lazily, so re-scan the broker each pump — the
+        archive contract is every raw record, whatever the topology."""
+        prefix = partition_suffix("rawdeltas", 0)[:-1]  # "rawdeltas-p"
+        for name, topic in list(self.log.topics.items()):
+            if name.startswith(prefix) and name not in self.consumers:
+                self.consumers[name] = LogConsumer(
+                    topic, self._offsets.get(name, 0)
+                )
+
     def pump(self) -> int:
+        self._discover()
         n = 0
-        for entry in self.consumer.poll():
-            self._pending.append(entry)
-            n += 1
-            if len(self._pending) >= self.batch_size:
-                self._flush()
+        for consumer in self.consumers.values():
+            for entry in consumer.poll():
+                self._pending.append(entry)
+                n += 1
+                if len(self._pending) >= self.batch_size:
+                    self._flush()
         if self._pending:
             self._flush()
         return n
@@ -84,7 +115,9 @@ class CopierLambda:
         return out
 
     def checkpoint(self) -> dict:
-        return {"offset": self.consumer.checkpoint(),
+        offsets = {name: c.checkpoint()
+                   for name, c in self.consumers.items()}
+        return {"offset": offsets["rawdeltas"], "offsets": offsets,
                 "chunks": dict(self._chunks)}
 
 

@@ -21,10 +21,15 @@ exactly, lifted from lines to batches:
 - **Corruption** — a frame whose CRC no longer matches is skipped but
   its records stay COUNTED (the header's record count survives payload
   corruption), so line/record offsets remain stable across all
-  readers — the sealed-junk-line rule, batch-sized. Known limitation:
-  corruption of a frame HEADER itself (magic intact, version/length
-  bytes hit) is indistinguishable from a torn tail, and readers stop
-  there rather than guess a resync point.
+  readers — the sealed-junk-line rule, batch-sized. A frame whose
+  HEADER itself is hit (version/length bytes garbled — the frame's
+  extent unknowable) is recovered by a bounded magic-resync scan
+  (`record_batch.iter_units`): the poisoned region is skipped but
+  counts ONE record slot, and reading resumes at the next CONFIRMED
+  unit boundary (a decodable complete frame, or a parseable JSON
+  line) instead of stalling forever. The poisoned frame's true record
+  count is unknowable, so offsets past it are heuristic — exactly-once
+  consumers treat the slot like a sealed junk line.
 - **Fencing** — identical to `SharedFileTopic` (same sidecar, same
   `FencedError` gate under the same lock); accepted (fence, owner) is
   additionally stamped into each frame header for audit.

@@ -624,7 +624,10 @@ class KernelDeliLambda:
 
     def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None,
                  max_pump: int = 8192, n_docs: int = 8, n_clients: int = 8,
-                 max_resident: Optional[int] = None, max_cols: int = 256):
+                 max_resident: Optional[int] = None, max_cols: int = 256,
+                 raw_topic: str = "rawdeltas"):
+        """`raw_topic` names the ingress topic (the sharded
+        LocalServer's per-partition ``rawdeltas-p{k}`` form)."""
         self.core = PackedDeliCore(
             n_docs, n_clients, max_resident, max_cols, dedup=False
         )
@@ -632,7 +635,7 @@ class KernelDeliLambda:
         if checkpoint:
             offset = checkpoint["offset"]
             self.core.pool.restore_docs(checkpoint["docs"])
-        self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
+        self.consumer = LogConsumer(log.topic(raw_topic), offset)
         self.deltas = log.topic("deltas")
         self.max_pump = max_pump
         self._m_stage = get_registry().histogram(

@@ -47,6 +47,7 @@ from ..utils.events import BufferedListener
 from ..utils.metrics import get_registry
 from .castore import ContentAddressedStore
 from .log import LogConsumer, MessageLog
+from .queue import partition_of, partition_suffix, split_by_partition
 from .sequencer import DocumentSequencer
 
 SYSTEM_CLIENT = -1  # server-originated control messages (scribe acks)
@@ -69,7 +70,11 @@ class DeliLambda:
     append per record."""
 
     def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None,
-                 max_pump: int = 8192):
+                 max_pump: int = 8192, raw_topic: str = "rawdeltas"):
+        """`raw_topic` names the ingress topic: the sharded LocalServer
+        (``n_partitions>1``) runs one deli per ``rawdeltas-p{k}``
+        partition topic, all emitting into the one deltas stream (a
+        doc lives in exactly one partition, so per-doc order holds)."""
         self.log = log
         self.sequencers: Dict[str, DocumentSequencer] = {}
         self.max_pump = max_pump
@@ -78,7 +83,7 @@ class DeliLambda:
             offset = checkpoint["offset"]
             for doc_id, state in checkpoint["docs"].items():
                 self.sequencers[doc_id] = DocumentSequencer.restore(state)
-        self.consumer = LogConsumer(log.topic("rawdeltas"), offset)
+        self.consumer = LogConsumer(log.topic(raw_topic), offset)
         self.deltas = log.topic("deltas")
         m = get_registry()
         self._m_pump = m.histogram(
@@ -357,7 +362,12 @@ class ScribeLambda:
         log: MessageLog,
         storage: ContentAddressedStore,
         checkpoint: Optional[dict] = None,
+        raw_router: Optional[Callable[[List[dict]], None]] = None,
     ):
+        """`raw_router` is the control-record sink (summary ack/nack
+        back through deli): default is the single `rawdeltas` topic;
+        the sharded LocalServer passes its partition router so each
+        control lands in its doc's partition."""
         self.log = log
         self.storage = storage
         self.protocol: Dict[str, ProtocolOpHandler] = {}
@@ -367,7 +377,7 @@ class ScribeLambda:
             for doc_id, snap in checkpoint["protocol"].items():
                 self.protocol[doc_id] = ProtocolOpHandler.from_snapshot(snap)
         self.consumer = LogConsumer(log.topic("deltas"), offset)
-        self.rawdeltas = log.topic("rawdeltas")
+        self._route_raw = raw_router or log.topic("rawdeltas").append_many
 
     def _doc(self, doc_id: str) -> ProtocolOpHandler:
         if doc_id not in self.protocol:
@@ -391,7 +401,7 @@ class ScribeLambda:
         if controls:
             # One flush per pump for the ack/nack control records
             # (same per-pump batching as the deli output path).
-            self.rawdeltas.append_many(controls)
+            self._route_raw(controls)
         return n
 
     def _handle_summarize(self, doc_id: str, msg: SequencedMessage,
@@ -553,6 +563,7 @@ class LocalServer:
         historian_budget: Optional[int] = None,
         deli_impl: Optional[str] = None,
         log_format: Optional[str] = None,
+        n_partitions: int = 1,
     ):
         """Restart contract: pass the previous instance's `log` (the
         durable substrate, as Kafka retains topics across lambda
@@ -575,7 +586,17 @@ class LocalServer:
         (JSONL lines) or "columnar" (binary record-batch frames,
         `protocol.record_batch`); env ``FLUID_LOG_FORMAT`` sets the
         default. Replay reads both, so a restart may switch formats
-        over the same persist_dir mid-journal."""
+        over the same persist_dir mid-journal.
+
+        `n_partitions` shards the ordering stage in-proc (the
+        `server.shard_fabric` slicing, LocalOrderer-sized): ingress
+        routes each doc to its consistent-hash partition topic
+        (``rawdeltas-p{k}``, `queue.partition_of`), one deli per
+        partition sequences it, and all partitions emit into the one
+        deltas stream — per-doc total order is untouched because a doc
+        lives in exactly one partition. Checkpoints key per partition
+        (``deli-p{k}``), so a restart must keep `n_partitions` (change
+        it only across a drained server)."""
         from .columnar_log import default_log_format
 
         self.log_format = default_log_format(log_format)
@@ -626,33 +647,77 @@ class LocalServer:
             raise ValueError(
                 f"deli_impl {self.deli_impl!r} not in {DELI_IMPLS}"
             )
+        self.n_partitions = int(n_partitions)
+        if self.n_partitions < 1:
+            raise ValueError(f"n_partitions must be >= 1: {n_partitions}")
         if self.deli_impl == "kernel":
-            from .deli_kernel import KernelDeliLambda
-
-            self.deli = KernelDeliLambda(self.log, cp.get("deli"))
+            from .deli_kernel import KernelDeliLambda as _deli_cls
         else:
-            self.deli = DeliLambda(self.log, cp.get("deli"))
+            _deli_cls = DeliLambda
+        if self.n_partitions == 1:
+            self.delis = [_deli_cls(self.log, cp.get("deli"))]
+        else:
+            self.delis = [
+                _deli_cls(self.log,
+                          cp.get(partition_suffix("deli", k)),
+                          raw_topic=partition_suffix("rawdeltas", k))
+                for k in range(self.n_partitions)
+            ]
+        # Back-compat alias: single-partition callers (and tests) keep
+        # addressing "the" deli; partition 0 is as good a face as any.
+        self.deli = self.delis[0]
         self.scriptorium = ScriptoriumLambda(self.log, cp.get("scriptorium"))
         self.broadcaster = BroadcasterLambda(self.log)
         if cp:
             # Fresh broadcaster on restart: no sockets exist yet, so
             # skip history (reconnecting sockets catch up via storage).
             self.broadcaster.consumer.offset = self.log.topic("deltas").head
-        self.scribe = ScribeLambda(self.log, self.storage, cp.get("scribe"))
+        self.scribe = ScribeLambda(self.log, self.storage, cp.get("scribe"),
+                                   raw_router=self._route_raw)
         self.deferred = deferred
         self._next_client: Dict[str, int] = {}
         if persist_dir is not None:
             # Never re-issue a client id from a previous life: replay
             # the journaled joins (stale ids would collide with the
             # dead clients' ops during catch-up).
-            for entry in self.log.topic("rawdeltas").read(0):
-                if isinstance(entry, dict) and entry.get("kind") == "join":
-                    doc = entry["doc"]
-                    self._next_client[doc] = max(
-                        self._next_client.get(doc, 1), entry["client"] + 1
-                    )
+            for name in self._raw_topic_names():
+                for entry in self.log.topic(name).read(0):
+                    if isinstance(entry, dict) and entry.get("kind") == "join":
+                        doc = entry["doc"]
+                        self._next_client[doc] = max(
+                            self._next_client.get(doc, 1), entry["client"] + 1
+                        )
         # Broadcaster must lag scriptorium so catch_up is complete by
         # the time a live op arrives; pump order below guarantees it.
+
+    # ----------------------------------------------------- shard routing
+
+    def _raw_topic_names(self) -> List[str]:
+        if self.n_partitions == 1:
+            return ["rawdeltas"]
+        return [partition_suffix("rawdeltas", k)
+                for k in range(self.n_partitions)]
+
+    def _raw_topic(self, doc_id: str):
+        """The ingress topic `doc_id`'s records belong to (the
+        `ShardRouter` rule, in-proc)."""
+        if self.n_partitions == 1:
+            return self.log.topic("rawdeltas")
+        return self.log.topic(partition_suffix(
+            "rawdeltas", partition_of(doc_id, self.n_partitions)
+        ))
+
+    def _route_raw(self, records: List[dict]) -> None:
+        """Batch-append raw records to their partitions (scribe's
+        control sink; order preserved within each partition)."""
+        if self.n_partitions == 1:
+            self.log.topic("rawdeltas").append_many(records)
+            return
+        for p, recs in split_by_partition(records,
+                                          self.n_partitions).items():
+            self.log.topic(
+                partition_suffix("rawdeltas", p)
+            ).append_many(recs)
 
     # ---------------------------------------------------- observability
 
@@ -686,7 +751,7 @@ class LocalServer:
         """Drain the whole pipeline to quiescence."""
         n = 0
         while True:
-            moved = self.deli.pump()
+            moved = sum(d.pump() for d in self.delis)
             moved += self.scriptorium.pump()
             moved += self.scribe.pump()
             moved += self.broadcaster.pump()
@@ -729,7 +794,7 @@ class LocalServer:
             raise ValueError(f"client {client_id} already connected to {doc_id}")
         sock = _Socket(self, doc_id, client_id)
         self.broadcaster.join_room(doc_id, sock)
-        self.log.topic("rawdeltas").append(
+        self._raw_topic(doc_id).append(
             {"doc": doc_id, "kind": "join", "client": client_id}
         )
         # The join must be sequenced before the socket is usable (the
@@ -755,7 +820,7 @@ class LocalServer:
                 }
             )
         else:
-            self.log.topic("rawdeltas").append(
+            self._raw_topic(doc_id).append(
                 {"doc": doc_id, "kind": "op", "client": client_id, "msg": msg}
             )
         self._auto_pump()
@@ -784,7 +849,7 @@ class LocalServer:
                 )
                 self._auto_pump()
                 return
-        self.log.topic("rawdeltas").append(
+        self._raw_topic(doc_id).append(
             {"doc": doc_id, "kind": "boxcar", "client": client_id,
              "msgs": list(msgs)}
         )
@@ -792,7 +857,7 @@ class LocalServer:
 
     def alfred_disconnect(self, sock: _Socket) -> None:
         self.broadcaster.leave_room(sock.doc_id, sock)
-        self.log.topic("rawdeltas").append(
+        self._raw_topic(sock.doc_id).append(
             {"doc": sock.doc_id, "kind": "leave", "client": sock.client_id}
         )
         self._auto_pump()
@@ -847,8 +912,13 @@ class LocalServer:
     def checkpoints(self) -> dict:
         """All lambdas' resumable state (crash/restart contract,
         SURVEY.md §5 failure detection)."""
-        return {
-            "deli": self.deli.checkpoint(),
+        cp: Dict[str, Any] = {
             "scriptorium": self.scriptorium.checkpoint(),
             "scribe": self.scribe.checkpoint(),
         }
+        if self.n_partitions == 1:
+            cp["deli"] = self.deli.checkpoint()
+        else:
+            for k, d in enumerate(self.delis):
+                cp[partition_suffix("deli", k)] = d.checkpoint()
+        return cp

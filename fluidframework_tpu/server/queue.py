@@ -28,7 +28,7 @@ import contextlib
 import json
 import os
 import time
-from typing import Any, Iterator, List, Optional, Protocol, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Protocol, Tuple
 
 
 class FencedError(RuntimeError):
@@ -670,3 +670,56 @@ def partition_of(doc_id: str, n_partitions: int) -> int:
 
     h = hashlib.sha256(doc_id.encode()).digest()
     return int.from_bytes(h[:4], "big") % n_partitions
+
+
+def partition_suffix(name: str, partition: int) -> str:
+    """THE partition naming rule: `name` sliced to partition `k` is
+    ``{name}-p{k}`` — topics (``rawdeltas-p3`` → ``deltas-p3``), lease
+    keys, checkpoint keys and role names all derive from this one
+    function, so the fabric's identities can never drift apart."""
+    return f"{name}-p{int(partition)}"
+
+
+def record_partition(rec: Any, n_partitions: int) -> int:
+    """The partition one INGRESS record routes to: by its doc id (a
+    boxcar carries exactly one doc, so it rides whole). Doc-less junk
+    pins to partition 0 — any single consistent home keeps offsets
+    deterministic."""
+    if n_partitions <= 1:
+        return 0
+    doc = rec.get("doc") if isinstance(rec, dict) else None
+    return partition_of(doc, n_partitions) if isinstance(doc, str) else 0
+
+
+def split_by_partition(records: List[Any],
+                       n_partitions: int) -> Dict[int, List[Any]]:
+    """Ingress records grouped by `record_partition`, input order
+    preserved within each group — the one grouping rule every router
+    edge (`shard_fabric.ShardRouter`, `LocalServer._route_raw`) shares,
+    so a record can never route differently on different edges."""
+    out: Dict[int, List[Any]] = {}
+    for rec in records:
+        out.setdefault(record_partition(rec, n_partitions), []).append(rec)
+    return out
+
+
+def lease_table(directory: str,
+                now: Optional[float] = None) -> Dict[str, str]:
+    """Live leases in `directory` as {partition_name: owner} — the
+    operator's (and chaos harness's) view of who owns what right now.
+    Read-only: no claim taken, so the snapshot may be an instant
+    stale, which is all a monitoring surface needs. Liveness semantics
+    are `LeaseManager.owner_of`'s — one place owns the expiry rule."""
+    out: Dict[str, str] = {}
+    if not os.path.isdir(directory):
+        return out
+    probe = LeaseManager(directory, owner="__observer__")
+    now = time.time() if now is None else now
+    for fn in os.listdir(directory):
+        if not fn.endswith(".lease"):
+            continue
+        name = fn[:-len(".lease")]
+        owner = probe.owner_of(name, now)
+        if owner is not None:
+            out[name] = owner
+    return out

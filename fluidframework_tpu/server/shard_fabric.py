@@ -1,0 +1,570 @@
+"""Sharded ordering fabric: a lease-balanced, multi-partition kernel-
+deli farm with fenced partition handoff.
+
+The reference scales routerlicious horizontally by splitting the
+document space across Kafka partitions with ZooKeeper arbitrating
+consumer ownership (SURVEY.md §2.5). This module is that topology over
+the repo's own primitives — partitioning as a first-class subsystem
+instead of the single-partition pipeline PRs 1–4 grew:
+
+- **Document-space slicing** — `queue.partition_of` (consistent hash)
+  maps every doc to one of N partitions; `ShardRouter` is the ingress
+  edge (the lambdas-driver document-router role): one raw/sequenced
+  topic pair PER partition (``rawdeltas-p{k}`` → ``deltas-p{k}``),
+  boxcar records riding whole with their doc.
+- **Lease-balanced ownership** — `ShardWorker` (one OS process) sweeps
+  the partition leases (`queue.LeaseManager`, the zookeeper role) and
+  runs ONE supervised deli role per owned partition
+  (`supervisor.partitioned_role_class` over the scalar `DeliRole` or
+  the device-batched `deli_kernel.KernelDeliRole`, either log format).
+  Workers announce liveness in ``<dir>/workers/<slot>.json``; each
+  targets ``ceil(N / alive_workers)`` partitions, so membership change
+  IS the rebalance trigger: a joining worker makes peers shed surplus
+  partitions (graceful release → immediate takeover), a dead worker's
+  stale heartbeat raises the survivors' target and its expired leases
+  are swept up.
+- **Fenced handoff, exactly-once** — a partition changes hands through
+  the PR-1 machinery unchanged: the new owner's lease carries a higher
+  fence, its first output append binds that fence on ``deltas-p{k}``
+  (a deposed owner's in-flight batch is REJECTED with `FencedError`),
+  the loser's fenced checkpoint — per-doc sequencer state in
+  `DocumentSequencer.checkpoint()` format, i.e. a `SeqPool` slice when
+  the kernel deli wrote it — is restored by `_Role._recover`, and the
+  exactly-once ``inOff`` scan replays the checkpoint→durable gap
+  silently. A kill or rebalance mid-boxcar never dups or skips a
+  sequence number (tests/test_chaos_recovery.py drives this with
+  ``--faults kill,lease`` over the kernel+columnar fabric).
+- **Supervision + observability** — `ShardFabricSupervisor` runs W
+  workers as monitored children through the `ServiceSupervisor`
+  machinery (heartbeat staleness, crash restart, fresh owner identity
+  per generation); worker heartbeats carry per-partition-labeled
+  metrics (``role="deli", partition="3"``) that the supervisor scrape
+  merges into one registry.
+
+`tools/shard_run.py` is the CLI; `testing.deli_bench.run_shard_bench`
+proves the aggregate-throughput scaling (bench_configs
+``config6_shard_scaling`` guards ≥1.5x at 4 partitions on ≥4-core
+hosts); `tools/partition_worker_main.py` is now a thin wrapper over
+`ShardWorker`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+from .columnar_log import LOG_FORMATS, default_log_format, make_topic
+from .queue import (
+    FencedError,
+    LeaseManager,
+    lease_table,
+    partition_suffix,
+    record_partition,
+    split_by_partition,
+)
+from .supervisor import (
+    DELI_IMPLS,
+    ServiceSupervisor,
+    _topic_path,
+    partitioned_role_class,
+    resolve_role_class,
+)
+
+__all__ = [
+    "ShardFabricSupervisor",
+    "ShardRouter",
+    "ShardWorker",
+    "partition_lease_name",
+    "raw_topic_name",
+    "deltas_topic_name",
+    "serve_shard_worker",
+    "spread_doc_names",
+]
+
+
+def raw_topic_name(partition: int) -> str:
+    return partition_suffix("rawdeltas", partition)
+
+
+def deltas_topic_name(partition: int) -> str:
+    return partition_suffix("deltas", partition)
+
+
+def partition_lease_name(partition: int) -> str:
+    """The lease key partition ownership is arbitrated under — the
+    partitioned deli role's name (`partitioned_role_class`), so the
+    lease, heartbeat, checkpoint and fence all share one identity."""
+    return partition_suffix("deli", partition)
+
+
+def spread_doc_names(n_docs: int, n_partitions: int,
+                     prefix: str = "doc") -> List[str]:
+    """`n_docs` deterministic doc names that cover the partitions as
+    evenly as the hash allows (scan names, round-robin the partition
+    quota — the workload builders' answer to small-N hash clumping;
+    real traffic gets the same balance from volume)."""
+    from .queue import partition_of
+
+    if n_partitions <= 1:
+        return [f"{prefix}{i}" for i in range(n_docs)]
+    per = {p: 0 for p in range(n_partitions)}
+    quota = math.ceil(n_docs / n_partitions)
+    out: List[str] = []
+    i = 0
+    while len(out) < n_docs and i < 10_000 * max(1, n_docs):
+        name = f"{prefix}{i}"
+        i += 1
+        p = partition_of(name, n_partitions)
+        if per[p] < quota:
+            per[p] += 1
+            out.append(name)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ingress router
+# ---------------------------------------------------------------------------
+
+
+class ShardRouter:
+    """The fabric's ingress edge: appends each raw record to its doc's
+    partition topic (the document-router role). Boxcar-aware — a wire
+    boxcar names one doc and rides whole, so its atomicity survives
+    routing. Appends are grouped per partition per call (one fenced
+    frame/lock per partition, not per record), and arrival order is
+    preserved WITHIN each partition — the only order the per-document
+    sequencing contract needs, since a doc lives in exactly one
+    partition."""
+
+    def __init__(self, shared_dir: str, n_partitions: int,
+                 log_format: Optional[str] = None):
+        if n_partitions < 1:
+            raise ValueError(f"n_partitions must be >= 1: {n_partitions}")
+        self.shared_dir = shared_dir
+        self.n_partitions = n_partitions
+        self.log_format = default_log_format(log_format)
+        self.topics = [
+            make_topic(_topic_path(shared_dir, raw_topic_name(p)),
+                       self.log_format)
+            for p in range(n_partitions)
+        ]
+
+    def partition(self, rec: Any) -> int:
+        return record_partition(rec, self.n_partitions)
+
+    def split(self, records: List[Any]) -> Dict[int, List[Any]]:
+        """Records grouped by partition, input order preserved within
+        each group (pure routing — no I/O)."""
+        return split_by_partition(records, self.n_partitions)
+
+    def append(self, records: List[Any]) -> Dict[int, int]:
+        """Route + append one ingress batch; returns records appended
+        per partition."""
+        counts: Dict[int, int] = {}
+        for p, recs in self.split(records).items():
+            self.topics[p].append_many(recs)
+            counts[p] = len(recs)
+        return counts
+
+    def deltas_topics(self) -> List[Any]:
+        """Every partition's sequenced-output topic (the merged read
+        surface convergence checks and catch-up readers use)."""
+        return [
+            make_topic(_topic_path(self.shared_dir, deltas_topic_name(p)),
+                       self.log_format)
+            for p in range(self.n_partitions)
+        ]
+
+
+# ---------------------------------------------------------------------------
+# the worker
+# ---------------------------------------------------------------------------
+
+
+class ShardWorker:
+    """One fabric node: sweeps partition leases toward its fair share
+    and pumps a supervised deli role per owned partition.
+
+    Balance is emergent, not orchestrated: each worker computes
+    ``target = ceil(n_partitions / alive_workers)`` from the worker
+    heartbeat directory and (a) gracefully RELEASES surplus partitions
+    — final fenced checkpoint, then lease release with expires=0 so the
+    successor takes over without waiting out the TTL — and (b) acquires
+    free/expired partitions up to target. Ownership changes always run
+    through the fence: the successor's recovery (`_Role._recover`)
+    binds its higher fence on the output topic FIRST, so anything the
+    deposed owner still has in flight is rejected, then restores the
+    fenced checkpoint and closes the append-vs-checkpoint window with
+    the exactly-once ``inOff`` scan."""
+
+    def __init__(self, shared_dir: str, slot: str,
+                 owner: Optional[str] = None, n_partitions: int = 4,
+                 deli_impl: Optional[str] = None,
+                 log_format: Optional[str] = None, ttl_s: float = 1.0,
+                 batch: int = 512, max_partitions: Optional[int] = None,
+                 ckpt_interval_s: float = 0.25,
+                 ckpt_bytes: int = 256 * 1024, ckpt_duty: float = 0.2,
+                 worker_ttl_s: Optional[float] = None):
+        self.shared_dir = shared_dir
+        self.slot = slot
+        self.owner = owner or slot
+        self.n_partitions = int(n_partitions)
+        self.deli_impl = deli_impl or os.environ.get("FLUID_DELI", "scalar")
+        if self.deli_impl not in DELI_IMPLS:
+            raise ValueError(
+                f"deli_impl {self.deli_impl!r} not in {DELI_IMPLS}"
+            )
+        self.log_format = default_log_format(log_format)
+        self.ttl_s = ttl_s
+        self.batch = batch
+        self.max_partitions = max_partitions
+        self.ckpt_interval_s = ckpt_interval_s
+        self.ckpt_bytes = ckpt_bytes
+        self.ckpt_duty = ckpt_duty
+        # A worker is presumed dead once its heartbeat is older than
+        # this (decoupled from the per-partition lease TTL: membership
+        # flaps should be rarer than lease renewals).
+        self.worker_ttl_s = worker_ttl_s or 3.0 * ttl_s
+        self.workers_dir = os.path.join(shared_dir, "workers")
+        self.leases_dir = os.path.join(shared_dir, "leases")
+        os.makedirs(self.workers_dir, exist_ok=True)
+        # Read-only ownership probe (owner_of takes no claim).
+        self._probe = LeaseManager(self.leases_dir, self.owner, ttl_s)
+        self.roles: Dict[int, Any] = {}
+        self.events: List[str] = []
+        self._hb_t = 0.0
+        self._sweep_t = 0.0
+        from ..utils.metrics import get_registry
+
+        self.metrics = get_registry()
+        self._m_owned = self.metrics.gauge(
+            "shard_partitions_owned", worker=self.slot
+        )
+        self._m_handoffs = self.metrics.counter(
+            "shard_partition_releases_total", worker=self.slot
+        )
+        self._m_drops = self.metrics.counter(
+            "shard_partition_deposed_total", worker=self.slot
+        )
+
+    # -------------------------------------------------------- membership
+
+    def _event(self, text: str) -> None:
+        self.events.append(text)
+
+    def _hb_path(self) -> str:
+        return os.path.join(self.workers_dir, f"{self.slot}.json")
+
+    def heartbeat(self) -> None:
+        """Worker-level liveness + the fabric's metrics channel: ONE
+        snapshot of this process's registry (per-partition labels keep
+        every owned partition's series distinct), so the supervisor
+        scrape merges one file per worker with no double counting."""
+        tmp = self._hb_path() + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({
+                "t": time.time(), "slot": self.slot, "owner": self.owner,
+                "pid": os.getpid(),
+                "partitions": sorted(
+                    p for p, r in self.roles.items() if r.fence is not None
+                ),
+                "metrics": self.metrics.snapshot(),
+            }, f)
+        os.replace(tmp, self._hb_path())
+        self._hb_t = time.time()
+
+    def alive_workers(self, now: Optional[float] = None) -> int:
+        """Workers with a fresh heartbeat (self always counts)."""
+        now = time.time() if now is None else now
+        alive = 0
+        saw_self = False
+        try:
+            names = os.listdir(self.workers_dir)
+        except OSError:
+            names = []
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.workers_dir, fn)) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if now - float(hb.get("t", 0)) <= self.worker_ttl_s:
+                alive += 1
+                if fn == f"{self.slot}.json":
+                    saw_self = True
+        return alive if saw_self else alive + 1
+
+    def target_partitions(self) -> int:
+        """This worker's fair share of the partition space."""
+        t = math.ceil(self.n_partitions / max(1, self.alive_workers()))
+        if self.max_partitions is not None:
+            t = min(t, self.max_partitions)
+        return t
+
+    # ------------------------------------------------------- role plumbing
+
+    def _make_role(self, partition: int):
+        cls = partitioned_role_class(
+            resolve_role_class("deli", self.deli_impl), partition
+        )
+        role = cls(
+            self.shared_dir, self.owner, ttl_s=self.ttl_s,
+            batch=self.batch, ckpt_interval_s=self.ckpt_interval_s,
+            ckpt_bytes=self.ckpt_bytes, log_format=self.log_format,
+            ckpt_duty=self.ckpt_duty,
+        )
+        # The WORKER heartbeat (whole-registry snapshot, throttled) is
+        # the fabric's liveness/metrics channel; per-partition role
+        # heartbeats are debugging surface only, so throttle their
+        # per-step registry-snapshot writes to the same cadence.
+        role.hb_interval_s = self.ttl_s / 3
+        return role
+
+    def _release(self, partition: int, why: str) -> None:
+        """Graceful fenced handoff: final checkpoint under our (still
+        valid) fence, then release with expires=0 — the successor's
+        next sweep takes over immediately, restores this checkpoint,
+        and its recovery scan replays any durable gap silently."""
+        role = self.roles.pop(partition, None)
+        if role is None:
+            return
+        if role.fence is not None:
+            try:
+                role.checkpoint()
+            except (FencedError, OSError):
+                pass  # a successor already holds the fence: its state wins
+            role.leases.release(role.name)
+            # Count only REAL handoffs: dropping a role instance that
+            # never acquired its lease released nothing.
+            self._m_handoffs.inc()
+        self._event(f"released p{partition} ({why})")
+
+    def sweep(self) -> None:
+        """One balance pass: shed surplus, prune lost races, acquire
+        toward target."""
+        target = self.target_partitions()
+        # Shed surplus (highest partition first: deterministic, so two
+        # overfull workers don't thrash the same partition).
+        while len(self.roles) > target:
+            self._release(max(self.roles), "rebalance")
+        # Prune instances that never acquired while a live foreign
+        # owner holds the lease (we lost the race).
+        for p, role in list(self.roles.items()):
+            if role.fence is None:
+                owner = self._probe.owner_of(partition_lease_name(p))
+                if owner is not None and owner != self.owner:
+                    self.roles.pop(p)
+        # Acquire free/expired partitions up to target, scanning from a
+        # slot-dependent start so peers spread instead of colliding.
+        if len(self.roles) < target:
+            # crc32, not hash(): per-process salt would make the scan
+            # start differ between a worker and its restarted self.
+            start = zlib.crc32(self.slot.encode()) % self.n_partitions
+            for i in range(self.n_partitions):
+                if len(self.roles) >= target:
+                    break
+                p = (start + i) % self.n_partitions
+                if p in self.roles:
+                    continue
+                owner = self._probe.owner_of(partition_lease_name(p))
+                if owner is None or owner == self.owner:
+                    self.roles[p] = self._make_role(p)
+        self._m_owned.set(len(self.roles))
+        self._sweep_t = time.time()
+
+    # -------------------------------------------------------------- pump
+
+    def step(self) -> int:
+        """One fabric quantum: pump every owned partition once, then
+        (throttled) heartbeat + rebalance sweep. Returns records
+        moved. A deposed/fenced partition drops OUT of this worker —
+        never the worker itself: the other partitions it owns must
+        keep sequencing (contrast `serve_role`, where the process IS
+        the partition)."""
+        moved = 0
+        for p, role in list(self.roles.items()):
+            try:
+                moved += role.step(idle_sleep=0)
+            except SystemExit as exc:
+                self.roles.pop(p, None)
+                self._m_drops.inc()
+                self._event(f"dropped p{p} (exit={exc.code})")
+            except FencedError as exc:
+                self.roles.pop(p, None)
+                self._m_drops.inc()
+                self._event(f"dropped p{p} (fenced: {exc})")
+        now = time.time()
+        if now - self._sweep_t > self.ttl_s / 2:
+            self.sweep()
+        if now - self._hb_t > self.ttl_s / 3:
+            self.heartbeat()
+        return moved
+
+    def stop(self) -> None:
+        """Graceful exit: hand every partition off now instead of
+        making successors wait out the lease TTL."""
+        for p in sorted(self.roles):
+            self._release(p, "shutdown")
+        try:
+            os.remove(self._hb_path())
+        except OSError:
+            pass
+
+
+def serve_shard_worker(shared_dir: str, slot: str,
+                       owner: Optional[str] = None,
+                       idle_sleep: float = 0.01, **kw) -> None:
+    """Child-process entry: run one shard worker until killed."""
+    w = ShardWorker(shared_dir, slot, owner=owner, **kw)
+    w.heartbeat()
+    w.sweep()
+    # Bare "READY <slot>" when slot IS the owner (the standalone CLI
+    # contract tools/partition_worker_main.py keeps); supervised
+    # children append their generation owner for the event log.
+    banner = f"READY {slot}" + (
+        f" {w.owner}" if w.owner != slot else ""
+    )
+    print(banner, flush=True)
+    while True:
+        if w.step() == 0:
+            time.sleep(idle_sleep)
+
+
+# ---------------------------------------------------------------------------
+# the fabric supervisor
+# ---------------------------------------------------------------------------
+
+
+class ShardFabricSupervisor(ServiceSupervisor):
+    """W shard workers as supervised children over N partitions.
+
+    Reuses the `ServiceSupervisor` monitor machinery wholesale (process
+    exit + heartbeat staleness, paced respawn, fresh owner identity per
+    generation) — a "role" here is a worker SLOT (``shard-w0``…), its
+    heartbeat the worker file `ShardWorker.heartbeat` writes. A
+    restarted worker re-enters the lease sweep and the fabric
+    rebalances around it; per-partition metrics ride the worker
+    heartbeats and merge at `collect_metrics` exactly like the classic
+    farm's role metrics."""
+
+    def __init__(self, shared_dir: str, n_workers: int = 2,
+                 n_partitions: int = 4,
+                 max_partitions: Optional[int] = None,
+                 worker_ttl_s: Optional[float] = None, **kw):
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1: {n_workers}")
+        self.n_partitions = int(n_partitions)
+        self.max_partitions = max_partitions
+        self.worker_ttl_s = worker_ttl_s
+        roles = tuple(f"shard-w{i}" for i in range(n_workers))
+        super().__init__(shared_dir, roles=roles, **kw)
+        os.makedirs(os.path.join(shared_dir, "workers"), exist_ok=True)
+
+    def _child_cmd(self, role: str, owner: str) -> List[str]:
+        cmd = [self.python, "-c",
+               "from fluidframework_tpu.server.shard_fabric import main; "
+               "main()",
+               "--dir", self.shared_dir, "--slot", role,
+               "--owner", owner,
+               "--partitions", str(self.n_partitions),
+               "--ttl", str(self.ttl_s), "--batch", str(self.batch),
+               "--impl", self.deli_impl,
+               "--log-format", self.log_format,
+               "--ckpt-interval", str(self.ckpt_interval_s),
+               "--ckpt-bytes", str(self.ckpt_bytes),
+               "--ckpt-duty", str(self.ckpt_duty)]
+        if self.max_partitions is not None:
+            cmd += ["--max-partitions", str(self.max_partitions)]
+        if self.worker_ttl_s is not None:
+            cmd += ["--worker-ttl", str(self.worker_ttl_s)]
+        return cmd
+
+    def _hb_file(self, role: str) -> str:
+        return os.path.join(self.shared_dir, "workers", f"{role}.json")
+
+    def partition_owners(self) -> Dict[str, str]:
+        """Live {``deli-p{k}``: owner} — the operator's ownership view
+        (`queue.lease_table` over the fabric's lease directory)."""
+        return lease_table(os.path.join(self.shared_dir, "leases"))
+
+    def health(self) -> Dict[str, Any]:
+        h = super().health()
+        owners = self.partition_owners()
+        h["n_partitions"] = self.n_partitions
+        h["partition_owners"] = owners
+        # Degraded until every partition has a live owner (boot,
+        # takeover windows): unowned partitions buffer, not lose, but
+        # an operator should see the gap.
+        if len(owners) < self.n_partitions:
+            h["status"] = "degraded"
+        return h
+
+    def collect_metrics(self):
+        reg = super().collect_metrics()
+        owners = self.partition_owners()
+        reg.gauge("shard_partitions_total").set(self.n_partitions)
+        reg.gauge("shard_partitions_owned_live").set(len(owners))
+        return reg
+
+
+# ---------------------------------------------------------------------------
+# child entry
+# ---------------------------------------------------------------------------
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = list(sys.argv[1:] if argv is None else argv)
+
+    def _take(flag: str, default: Optional[str] = None) -> Optional[str]:
+        if flag in args:
+            i = args.index(flag)
+            val = args[i + 1]
+            del args[i:i + 2]
+            return val
+        return default
+
+    shared_dir = _take("--dir")
+    slot = _take("--slot")
+    owner = _take("--owner")
+    n_partitions = int(_take("--partitions", "4"))
+    ttl = float(_take("--ttl", "1.0"))
+    batch = int(_take("--batch", "512"))
+    impl = _take("--impl") or os.environ.get("FLUID_DELI", "scalar")
+    log_format = _take("--log-format")
+    ckpt_interval = float(_take("--ckpt-interval", "0.25"))
+    ckpt_bytes = int(_take("--ckpt-bytes", str(256 * 1024)))
+    ckpt_duty = float(_take("--ckpt-duty", "0.2"))
+    max_p = _take("--max-partitions")
+    worker_ttl = _take("--worker-ttl")
+    if (shared_dir is None or slot is None or args
+            or impl not in DELI_IMPLS
+            or (log_format is not None and log_format not in LOG_FORMATS)):
+        print(
+            "usage: python -m fluidframework_tpu.server.shard_fabric "
+            "--dir D --slot S [--owner O] [--partitions N] [--ttl S] "
+            "[--batch N] [--impl scalar|kernel] "
+            "[--log-format json|columnar] [--max-partitions K] "
+            "[--worker-ttl S] [--ckpt-interval S] [--ckpt-bytes N] "
+            "[--ckpt-duty F]",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    serve_shard_worker(
+        shared_dir, slot, owner=owner, n_partitions=n_partitions,
+        deli_impl=impl, log_format=log_format, ttl_s=ttl, batch=batch,
+        max_partitions=int(max_p) if max_p else None,
+        ckpt_interval_s=ckpt_interval, ckpt_bytes=ckpt_bytes,
+        ckpt_duty=ckpt_duty,
+        worker_ttl_s=float(worker_ttl) if worker_ttl else None,
+    )
+
+
+if __name__ == "__main__":
+    main()

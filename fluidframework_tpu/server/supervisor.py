@@ -63,6 +63,7 @@ from .queue import (
     LeaseManager,
     SharedFileTopic,
     TailReader,
+    partition_suffix,
 )
 from .sequencer import DocumentSequencer
 
@@ -76,6 +77,7 @@ __all__ = [
     "ScriptoriumRole",
     "ServiceSupervisor",
     "canonical_record",
+    "partitioned_role_class",
     "resolve_role_class",
     "serve_role",
 ]
@@ -113,9 +115,24 @@ class _Role:
     name: str = ""
     in_topic_name: str = ""
     out_topic_name: Optional[str] = None
-    # Roles that ingest columnar `RecordBatch` frames whole (the kernel
-    # deli) set this; everyone else reads decoded records.
+    # Roles that ingest columnar `RecordBatch` frames whole (the deli
+    # family) set this; everyone else reads decoded records.
     ingest_batches: bool = False
+    # Sharded-fabric identity (`partitioned_role_class`): the partition
+    # this role instance owns, and the base role name its metrics are
+    # labeled with. None = the classic single-partition farm.
+    partition: Optional[int] = None
+    role_base: Optional[str] = None
+
+    def _metric_labels(self) -> Dict[str, str]:
+        """Metric label set: single-partition roles keep the historic
+        {role: name}; partitioned roles label {role: base, partition: k}
+        so the supervisor scrape can aggregate across the fabric while
+        per-partition series stay distinguishable."""
+        if self.partition is None:
+            return {"role": self.name}
+        return {"role": self.role_base or self.name,
+                "partition": str(self.partition)}
 
     def __init__(self, shared_dir: str, owner: str, ttl_s: float = 1.0,
                  batch: int = 512, ckpt_interval_s: float = 0.25,
@@ -184,24 +201,26 @@ class _Role:
         self._ckpt_last_t = time.time()
         self._ckpt_last_s = 0.0
         self._ckpt_pending_bytes = 0
+        self._hb_t = 0.0
         from ..utils.metrics import get_registry
 
         self.metrics = get_registry()
         m = self.metrics
+        labels = self._metric_labels()
         self._m_pump = m.histogram(
             "role_pump_records",
             buckets=(1, 4, 16, 64, 256, 1024, 4096, 16384),
-            role=self.name,
+            **labels,
         )
-        self._m_records = m.counter("role_records_total", role=self.name)
+        self._m_records = m.counter("role_records_total", **labels)
         self._m_ckpt_writes = m.counter(
-            "checkpoint_writes_total", role=self.name
+            "checkpoint_writes_total", **labels
         )
         self._m_ckpt_bytes = m.counter(
-            "checkpoint_bytes_total", role=self.name
+            "checkpoint_bytes_total", **labels
         )
-        self._m_ckpt_ms = m.histogram("checkpoint_ms", role=self.name)
-        self._m_fenced = m.counter("fence_rejections_total", role=self.name)
+        self._m_ckpt_ms = m.histogram("checkpoint_ms", **labels)
+        self._m_fenced = m.counter("fence_rejections_total", **labels)
 
     # ------------------------------------------------------------ state
 
@@ -221,7 +240,21 @@ class _Role:
 
     # -------------------------------------------------------- lifecycle
 
-    def heartbeat(self) -> None:
+    # Minimum seconds between heartbeat file writes (0 = every call —
+    # the classic farm's liveness contract, where THIS file is what the
+    # supervisor watches). The shard fabric raises it on its embedded
+    # roles: worker-level heartbeats are the fabric's liveness/metrics
+    # channel, so per-partition role heartbeats would otherwise be
+    # O(partitions) registry-snapshot writes per pump that nothing
+    # reads.
+    hb_interval_s: float = 0.0
+
+    def heartbeat(self, force: bool = False) -> None:
+        now = time.time()
+        if (not force and self.hb_interval_s > 0
+                and now - self._hb_t < self.hb_interval_s):
+            return
+        self._hb_t = now
         tmp = self._hb_path + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({
@@ -389,7 +422,7 @@ class _Role:
                 self.maybe_checkpoint()
             except FencedError as exc:
                 self._m_fenced.inc()
-                self.heartbeat()  # export the rejection before dying
+                self.heartbeat(force=True)  # export the rejection before dying
                 print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
                 raise SystemExit(EXIT_FENCED)
             self.heartbeat()
@@ -409,7 +442,7 @@ class _Role:
             self.maybe_checkpoint()
         except FencedError as exc:
             self._m_fenced.inc()
-            self.heartbeat()  # export the rejection before dying
+            self.heartbeat(force=True)  # export the rejection before dying
             print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
             raise SystemExit(EXIT_FENCED)
         self._m_pump.observe(moved)
@@ -420,15 +453,30 @@ class _Role:
 
 class DeliRole(_Role):
     """The sequencer lambda: rawdeltas → deltas, one DocumentSequencer
-    per document, resubmission dedup by (client, clientSeq)."""
+    per document, resubmission dedup by (client, clientSeq).
+
+    Over a columnar op-log the role ingests whole `RecordBatch` frames
+    (`process_batch`): int fields come straight off the codec columns,
+    doc ids from the batch dictionary, and standalone ops' `contents`
+    stay pre-encoded JSON blobs end to end when the out topic is
+    columnar too — the scalar twin of `KernelDeliRole`'s zero-JSON
+    ingest (ROADMAP PR-4 follow-up: the per-record lazy `record(i)`
+    decode was the last JSON cost on the scalar-on-columnar path)."""
 
     name = "deli"
     in_topic_name = "rawdeltas"
     out_topic_name = "deltas"
+    ingest_batches = True  # _Role.step feeds RecordBatch frames whole
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
         self.sequencers: Dict[str, DocumentSequencer] = {}
+        # Blob pass-through is only legal when the output topic can
+        # carry raw JSON bytes (a columnar sibling); a JSON out topic
+        # needs decoded values for its json.dumps.
+        from .columnar_log import ColumnarFileTopic
+
+        self.out_columnar = isinstance(self.out_topic, ColumnarFileTopic)
 
     def snapshot_state(self) -> Any:
         return {d: s.checkpoint() for d, s in self.sequencers.items()}
@@ -482,6 +530,63 @@ class DeliRole(_Role):
             doc, rec["doc"], int(rec["client"]), int(rec["clientSeq"]),
             int(rec.get("refSeq", 0)), rec.get("contents"), line_idx, out,
         )
+
+    def process_batch(self, start_line: int, batch: Any,
+                      out: List[dict]) -> None:
+        """Columnar ingest: ticket one `RecordBatch` (records numbered
+        start_line..start_line+n-1) reusing the already-decoded codec
+        columns — no per-record dict build, no lazy full-record JSON
+        decode; op contents ride as raw blobs when the out topic is
+        columnar (the kernel role's pass-through rule)."""
+        import json as _json
+
+        from ..protocol import record_batch as _rb
+
+        rb = batch
+        kinds = rb.kind.tolist()
+        doci = rb.doc_idx.tolist()
+        clients = rb.client.tolist()
+        cseqs = rb.client_seq.tolist()
+        refs = rb.ref_seq.tolist()
+        docs = rb.docs
+        passthrough = self.out_columnar
+        for i in range(rb.n):
+            k = kinds[i]
+            if k == _rb.K_RAW_OP:
+                doc_id = docs[doci[i]]
+                contents: Any = _rb.JsonBlob(rb.blob(i))
+                if not passthrough:
+                    contents = contents.value
+                self._ticket_wire(
+                    self._doc(doc_id), doc_id, clients[i], cseqs[i],
+                    refs[i], contents, start_line + i, out,
+                )
+            elif k == _rb.K_RAW_JOIN:
+                doc = self._doc(docs[doci[i]])
+                if clients[i] in doc.clients:
+                    continue  # duplicate join (at-least-once ingress)
+                out.append(self._wire(
+                    docs[doci[i]], doc.join(clients[i]), start_line + i
+                ))
+            elif k == _rb.K_RAW_LEAVE:
+                msg = self._doc(docs[doci[i]]).leave(clients[i])
+                if msg is not None:
+                    out.append(self._wire(
+                        docs[doci[i]], msg, start_line + i
+                    ))
+            elif k == _rb.K_RAW_BOXCAR:
+                doc_id = docs[doci[i]]
+                doc = self._doc(doc_id)
+                for cseq, ref, contents in _json.loads(rb.blob(i)):
+                    if not self._ticket_wire(
+                        doc, doc_id, clients[i], cseq, ref, contents,
+                        start_line + i, out,
+                    ):
+                        break  # nack aborts the rest of the boxcar
+            else:
+                # Generic / foreign record inside the frame: decode
+                # this one record and route it the legacy way.
+                self.process(start_line + i, rb.record(i), out)
 
     def _ticket_wire(self, doc: DocumentSequencer, doc_id: str,
                      client: int, client_seq: int, ref_seq: int,
@@ -617,20 +722,52 @@ def resolve_role_class(role: str, deli_impl: str = "scalar"):
     return ROLE_CLASSES[role]
 
 
+def partitioned_role_class(base: type, partition: int) -> type:
+    """The sharded-fabric form of a role class: same code, partition-
+    suffixed identity. Lease key, heartbeat file, checkpoint key and
+    topic pair all become per-partition (`deli-p3` over
+    `rawdeltas-p3` → `deltas-p3`), so N partitions of one role are N
+    independent fenced exactly-once pipelines over disjoint slices of
+    the document space (`server.shard_fabric` owns the slicing)."""
+    p = int(partition)
+    if p < 0:
+        raise ValueError(f"partition must be >= 0, got {partition}")
+    return type(
+        f"{base.__name__}P{p}", (base,), {
+            "name": partition_suffix(base.name, p),
+            "in_topic_name": partition_suffix(base.in_topic_name, p),
+            "out_topic_name": (
+                partition_suffix(base.out_topic_name, p)
+                if base.out_topic_name else None
+            ),
+            "partition": p,
+            "role_base": base.name,
+        },
+    )
+
+
 def serve_role(shared_dir: str, role: str, owner: str,
                ttl_s: float = 1.0, batch: int = 512,
                deli_impl: str = "scalar",
                ckpt_interval_s: float = 0.25,
                ckpt_bytes: int = 256 * 1024,
                log_format: Optional[str] = None,
-               ckpt_duty: float = 0.2) -> None:
-    """Child-process entry: run one role until killed/deposed/fenced."""
-    r = resolve_role_class(role, deli_impl)(
+               ckpt_duty: float = 0.2,
+               partition: Optional[int] = None) -> None:
+    """Child-process entry: run one role until killed/deposed/fenced.
+    With `partition`, the role serves that partition's topic pair under
+    its partition-suffixed lease (one pinned shard of the fabric —
+    `shard_fabric.ShardWorker` is the lease-balanced multi-partition
+    form)."""
+    cls = resolve_role_class(role, deli_impl)
+    if partition is not None:
+        cls = partitioned_role_class(cls, partition)
+    r = cls(
         shared_dir, owner, ttl_s=ttl_s, batch=batch,
         ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
         log_format=log_format, ckpt_duty=ckpt_duty,
     )
-    print(f"READY {role} {owner}", flush=True)
+    print(f"READY {r.name} {owner}", flush=True)
     while True:
         try:
             r.step()
@@ -686,6 +823,7 @@ class ServiceSupervisor:
         self.spawn_ready_timeout_s = spawn_ready_timeout_s
         self.procs: Dict[str, subprocess.Popen] = {}
         self.spawned_at: Dict[str, float] = {}
+        self._stdout_tails: Dict[str, str] = {}
         self.generation: Dict[str, int] = {r: 0 for r in self.roles}
         self.restarts: Dict[str, int] = {r: 0 for r in self.roles}
         self.events: List[str] = []
@@ -706,6 +844,30 @@ class ServiceSupervisor:
             os.path.abspath(__file__)
         )))
 
+    def _child_cmd(self, role: str, owner: str) -> List[str]:
+        """The child process's argv (the spawn seam subclasses override:
+        `shard_fabric.ShardFabricSupervisor` launches lease-balanced
+        shard workers through the same monitor/restart machinery).
+        -c instead of -m: `-m pkg.mod` would import the package first
+        and runpy then re-executes the module as __main__
+        (RuntimeWarning + double module state)."""
+        return [self.python, "-c",
+                "from fluidframework_tpu.server.supervisor import main; "
+                "main()",
+                "--role", role, "--dir", self.shared_dir,
+                "--owner", owner, "--ttl", str(self.ttl_s),
+                "--batch", str(self.batch),
+                "--impl", self.deli_impl,
+                "--log-format", self.log_format,
+                "--ckpt-interval", str(self.ckpt_interval_s),
+                "--ckpt-bytes", str(self.ckpt_bytes),
+                "--ckpt-duty", str(self.ckpt_duty)]
+
+    def _hb_file(self, role: str) -> str:
+        """Where `role`'s liveness heartbeat lives (subclass seam: the
+        shard fabric heartbeats per WORKER, not per role)."""
+        return os.path.join(self.shared_dir, "hb", f"{role}.json")
+
     def _spawn(self, role: str) -> Optional[subprocess.Popen]:
         """Spawn one role child; returns None (and records the event)
         on failure rather than raising — a failed spawn must not kill
@@ -716,22 +878,9 @@ class ServiceSupervisor:
         self.generation[role] += 1
         self.spawned_at[role] = time.time()  # paces respawn retries too
         owner = f"{role}-g{self.generation[role]}"
-        # -c instead of -m: `-m pkg.mod` would import the package
-        # first and runpy then re-executes the module as __main__
-        # (RuntimeWarning + double module state).
         try:
             proc = subprocess.Popen(
-                [self.python, "-c",
-                 "from fluidframework_tpu.server.supervisor import main; "
-                 "main()",
-                 "--role", role, "--dir", self.shared_dir,
-                 "--owner", owner, "--ttl", str(self.ttl_s),
-                 "--batch", str(self.batch),
-                 "--impl", self.deli_impl,
-                 "--log-format", self.log_format,
-                 "--ckpt-interval", str(self.ckpt_interval_s),
-                 "--ckpt-bytes", str(self.ckpt_bytes),
-                 "--ckpt-duty", str(self.ckpt_duty)],
+                self._child_cmd(role, owner),
                 stdout=subprocess.PIPE, text=True,
                 cwd=self._repo_root(),
                 env=dict(os.environ, JAX_PLATFORMS="cpu"),
@@ -741,11 +890,26 @@ class ServiceSupervisor:
             self._event(f"spawn {owner} FAILED ({exc!r})")
             return None
         # Bounded READY wait: a child wedged before its banner must
-        # not freeze the whole monitor loop.
-        ready, _, _ = select.select(
-            [proc.stdout], [], [], self.spawn_ready_timeout_s
-        )
-        line = (proc.stdout.readline() or "").strip() if ready else ""
+        # not freeze the whole monitor loop. Raw-fd reads, not the
+        # buffered text wrapper — bytes the child flushed in the same
+        # write as its banner must reach the drain buffer below, not
+        # die in a wrapper buffer the fd-level drain never sees.
+        fd = proc.stdout.fileno()
+        deadline = time.time() + self.spawn_ready_timeout_s
+        buf = b""
+        while b"\n" not in buf:
+            left = deadline - time.time()
+            if left <= 0:
+                break
+            ready, _, _ = select.select([fd], [], [], left)
+            if not ready:
+                break
+            chunk = os.read(fd, 4096)
+            if not chunk:
+                break
+            buf += chunk
+        banner, _, rest = buf.partition(b"\n")
+        line = banner.decode("utf-8", "replace").strip()
         if not line.startswith("READY"):
             try:
                 proc.kill()
@@ -755,6 +919,13 @@ class ServiceSupervisor:
             self.procs[role] = None
             self._event(f"spawn {owner} FAILED ({line!r})")
             return None
+        # Post-banner output is drained non-blockingly by poll_once: a
+        # long-lived child (shard worker) prints a line per deposed or
+        # fenced partition, and an undrained 64KB pipe would eventually
+        # block the child's print() — a whole-worker stall with no
+        # real fault.
+        os.set_blocking(fd, False)
+        self._stdout_tails[role] = rest.decode("utf-8", "replace")[-2048:]
         self.procs[role] = proc
         self._event(f"spawn {owner}")
         return proc
@@ -780,13 +951,33 @@ class ServiceSupervisor:
         instant spurious restart."""
         since_spawn = time.time() - self.spawned_at.get(role, 0.0)
         try:
-            with open(os.path.join(
-                self.shared_dir, "hb", f"{role}.json"
-            )) as f:
+            with open(self._hb_file(role)) as f:
                 hb = json.load(f)
             return min(time.time() - float(hb.get("t", 0)), since_spawn)
         except (OSError, ValueError):
             return since_spawn
+
+    def _drain_stdout(self, role: str) -> None:
+        """Pull whatever `role`'s child printed since the last pass into
+        a bounded tail buffer (the fd is non-blocking after the banner).
+        Only the tail is kept — poll_once quotes the last line when it
+        restarts the child."""
+        proc = self.procs.get(role)
+        if proc is None or proc.stdout is None:
+            return
+        # os.read, not proc.stdout.read(): buffered text reads over a
+        # non-blocking fd raise mid-stream (bpo-13322) instead of
+        # returning the partial data, which would leave the pipe full.
+        try:
+            while True:
+                chunk = os.read(proc.stdout.fileno(), 65536)
+                if not chunk:
+                    break
+                tail = (self._stdout_tails.get(role, "")
+                        + chunk.decode("utf-8", "replace"))
+                self._stdout_tails[role] = tail[-2048:]
+        except (OSError, ValueError):
+            pass  # no data yet (EAGAIN) or fd already closed
 
     def poll_once(self) -> List[str]:
         """One supervision pass; returns the events it acted on."""
@@ -806,6 +997,7 @@ class ServiceSupervisor:
             age = self._heartbeat_age(role)
             stale = not dead and age > self.heartbeat_timeout_s
             if not dead and not stale:
+                self._drain_stdout(role)
                 continue
             if stale:
                 # Wedged (or stopped) but alive: kill before restart.
@@ -815,12 +1007,8 @@ class ServiceSupervisor:
                 except OSError:
                     pass
                 proc.wait(timeout=10)
-            tail = ""
-            if proc.stdout is not None:
-                try:
-                    tail = (proc.stdout.read() or "").strip()
-                except (OSError, ValueError):
-                    tail = ""
+            self._drain_stdout(role)
+            tail = self._stdout_tails.pop(role, "").strip()
             why = (
                 f"stale-heartbeat age={age:.2f}s" if stale
                 else f"exit={proc.returncode}"
@@ -851,9 +1039,7 @@ class ServiceSupervisor:
         out: Dict[str, dict] = {}
         for role in self.roles:
             try:
-                with open(os.path.join(
-                    self.shared_dir, "hb", f"{role}.json"
-                )) as f:
+                with open(self._hb_file(role)) as f:
                     hb = json.load(f)
             except (OSError, ValueError):
                 continue
@@ -958,14 +1144,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     ckpt_interval = float(_take("--ckpt-interval", "0.25"))
     ckpt_bytes = int(_take("--ckpt-bytes", str(256 * 1024)))
     ckpt_duty = float(_take("--ckpt-duty", "0.2"))
+    partition_s = _take("--partition")
     if (role not in ROLE_CLASSES or shared_dir is None
             or impl not in DELI_IMPLS
-            or (log_format is not None and log_format not in LOG_FORMATS)):
+            or (log_format is not None and log_format not in LOG_FORMATS)
+            or (partition_s is not None and not partition_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster} --dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
-            "[--log-format json|columnar] "
+            "[--log-format json|columnar] [--partition K] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -973,7 +1161,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     serve_role(shared_dir, role, owner, ttl_s=ttl, batch=batch,
                deli_impl=impl, ckpt_interval_s=ckpt_interval,
                ckpt_bytes=ckpt_bytes, log_format=log_format,
-               ckpt_duty=ckpt_duty)
+               ckpt_duty=ckpt_duty,
+               partition=int(partition_s) if partition_s else None)
 
 
 if __name__ == "__main__":

@@ -91,6 +91,16 @@ class ChaosConfig:
     # atomically — the ROADMAP (d) schema rev). 0 keeps the historical
     # per-op stream.
     boxcar_rate: float = 0.0
+    # Sharded ordering fabric (server.shard_fabric): >1 runs the run
+    # against `n_workers` lease-balanced shard workers over
+    # `n_partitions` partition topic pairs instead of the classic
+    # four-role farm. Faults then target WORKERS (kill) and PARTITION
+    # leases (lease); "net" is rejected (no socket consumer to
+    # dup/delay in the fabric runner); convergence still compares the
+    # merged sequenced stream against the same single-partition
+    # in-proc golden.
+    n_partitions: int = 1
+    n_workers: int = 2
 
 
 @dataclass
@@ -125,7 +135,15 @@ def build_workload(cfg: ChaosConfig) -> List[dict]:
     interleaving of each client's in-order op queue (per-client order
     preserved — deli enforces clientSeq contiguity)."""
     rng = random.Random(cfg.seed)
-    docs = [f"doc{d}" for d in range(cfg.n_docs)]
+    if cfg.n_partitions > 1:
+        # Partition-balanced doc names: small doc counts clump under
+        # the consistent hash, and a one-partition "sharded" run would
+        # prove nothing about cross-partition convergence.
+        from ..server.shard_fabric import spread_doc_names
+
+        docs = spread_doc_names(cfg.n_docs, cfg.n_partitions)
+    else:
+        docs = [f"doc{d}" for d in range(cfg.n_docs)]
     recs: List[dict] = []
     queues: Dict[Tuple[str, int], List[dict]] = {}
     for doc in docs:
@@ -284,8 +302,19 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
     """Run the chaos suite. With no `cfg.shared_dir`, a throwaway temp
     dir is used and removed on convergence (kept for post-mortem on
     divergence, named in `detail`); pass `shared_dir` to keep it."""
+    if cfg.n_partitions > 1 and "net" in cfg.faults:
+        # The sharded runner reads the merged partition topics directly
+        # — there is no socket consumer to dup/delay, so accepting
+        # "net" would print a convergence verdict for a fault that was
+        # never exercised. Reject loudly instead of lying.
+        raise ValueError(
+            "fault class 'net' is not supported with n_partitions > 1 "
+            "(no socket consumer in the fabric runner); drop it from "
+            "faults or run single-partition"
+        )
     shared = cfg.shared_dir or tempfile.mkdtemp(prefix="chaos-")
-    res = _run_chaos_in(cfg, shared)
+    runner = _run_chaos_sharded if cfg.n_partitions > 1 else _run_chaos_in
+    res = runner(cfg, shared)
     if cfg.shared_dir is None:
         if res.converged:
             import shutil
@@ -296,17 +325,21 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
     return res
 
 
-def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
-    rng = random.Random(cfg.seed ^ 0x5EED)
-    workload = build_workload(cfg)
-    golden = golden_stream(workload, os.path.join(shared, "golden"))
-    gdigest = stream_digest(golden)
-    gscribe = golden_scribe_digests(golden, os.path.join(shared, "golden"))
-    expected = len(golden)
+def _feed_plan(cfg: ChaosConfig, rng: random.Random,
+               workload: List[dict], kill_targets: Tuple[str, ...]):
+    """The seeded feed/fault plan BOTH runners share (classic farm and
+    sharded fabric — only the kill targets differ: role names vs
+    worker slots). Returns ``(chunks, dup_after, kill_at, torn_at,
+    lease_at)``:
 
-    # Feed plan: seeded submission batches; with the `client` fault,
-    # some batches are re-appended later in full (a client that lost
-    # its ack mid-batch resubmits everything — at-least-once ingress).
+    - `chunks`: seeded submission batches of the workload;
+    - `dup_after` (`client` fault): chunk idx → later idx at which the
+      chunk is re-appended in full (a client that lost its ack
+      mid-batch resubmits everything — at-least-once ingress);
+    - `kill_at` (`kill` fault): chunk idx → targets SIGKILLed there,
+      each target `cfg.kills_per_role` times;
+    - `torn_at` (`torn` fault): chunk indices for torn appends;
+    - `lease_at` (`lease` fault): the takeover chunk index, or None."""
     chunks: List[List[dict]] = []
     i = 0
     while i < len(workload):
@@ -319,16 +352,13 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
             range(len(chunks)), max(1, len(chunks) // 10)
         ):
             dup_after[idx] = idx + rng.randint(1, 5)
-
-    # Kill plan: each role killed `kills_per_role` times at seeded
-    # chunk indices.
     kill_at: Dict[int, List[str]] = {}
     if "kill" in cfg.faults:
-        for role in ("deli", "scriptorium", "scribe", "broadcaster"):
+        for target in kill_targets:
             for _ in range(cfg.kills_per_role):
                 idx = rng.randint(len(chunks) // 5,
                                   max(1, len(chunks) - 2))
-                kill_at.setdefault(idx, []).append(role)
+                kill_at.setdefault(idx, []).append(target)
     torn_at = (
         sorted(rng.sample(range(len(chunks)), min(3, len(chunks))))
         if "torn" in cfg.faults else []
@@ -336,6 +366,21 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     lease_at = (
         rng.randint(len(chunks) // 3, max(1, 2 * len(chunks) // 3))
         if "lease" in cfg.faults else None
+    )
+    return chunks, dup_after, kill_at, torn_at, lease_at
+
+
+def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
+    rng = random.Random(cfg.seed ^ 0x5EED)
+    workload = build_workload(cfg)
+    golden = golden_stream(workload, os.path.join(shared, "golden"))
+    gdigest = stream_digest(golden)
+    gscribe = golden_scribe_digests(golden, os.path.join(shared, "golden"))
+    expected = len(golden)
+
+    chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
+        cfg, rng, workload,
+        ("deli", "scriptorium", "scribe", "broadcaster"),
     )
 
     sup = ServiceSupervisor(
@@ -464,6 +509,297 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     )
 
 
+def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
+    """The sharded-fabric twin of `_run_chaos_in`: the same seeded
+    workload and in-proc single-partition golden, fed through the
+    `ShardRouter` into `cfg.n_partitions` partition topic pairs served
+    by `cfg.n_workers` supervised lease-balanced shard workers
+    (`server.shard_fabric`). Faults target the fabric's own failure
+    axes — SIGKILL of a worker mid-stream (its partitions' leases
+    expire and peers/restarts take them over), torn appends on
+    partition topics, and an expired-lease PARTITION takeover whose
+    deposed owner is demonstrably fence-rejected. Convergence: the
+    merged sequenced stream across every ``deltas-p{k}`` must be
+    bit-identical to the golden with zero duplicate/skipped seqs —
+    a rebalance mid-boxcar must be invisible in the order."""
+    from ..server.shard_fabric import ShardFabricSupervisor, ShardRouter
+
+    rng = random.Random(cfg.seed ^ 0x5EED)
+    workload = build_workload(cfg)
+    golden = golden_stream(workload, os.path.join(shared, "golden"))
+    gdigest = stream_digest(golden)
+    expected = len(golden)
+
+    chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
+        cfg, rng, workload,
+        tuple(f"shard-w{w}" for w in range(cfg.n_workers)),
+    )
+
+    sup = ShardFabricSupervisor(
+        shared, n_workers=cfg.n_workers, n_partitions=cfg.n_partitions,
+        ttl_s=cfg.ttl_s, heartbeat_timeout_s=cfg.heartbeat_timeout_s,
+        batch=cfg.batch, deli_impl=cfg.deli_impl,
+        log_format=cfg.log_format,
+    ).start()
+    router = ShardRouter(shared, cfg.n_partitions, cfg.log_format)
+    fence_rejections = 0
+    events: List[str] = []
+    timeline: List[Tuple[float, str]] = []
+
+    def note(ev: str) -> None:
+        events.append(ev)
+        timeline.append((time.time(), ev))
+
+    def merged_ops() -> List[dict]:
+        out: List[dict] = []
+        for t in router.deltas_topics():
+            out.extend(
+                r for r in t.read_from(0)
+                if isinstance(r, dict) and r.get("kind") == "op"
+            )
+        return out
+
+    try:
+        fed_idx = 0
+        pending_dups: Dict[int, List[dict]] = {}
+        deadline = time.time() + cfg.timeout_s
+        while time.time() < deadline:
+            sup.poll_once()
+            if fed_idx < len(chunks):
+                router.append(chunks[fed_idx])
+                if fed_idx in dup_after:
+                    pending_dups.setdefault(
+                        dup_after[fed_idx], []
+                    ).extend(chunks[fed_idx])
+                for rec in pending_dups.pop(fed_idx, []):
+                    router.append([rec])  # the lost-ack resubmission
+                for slot in kill_at.pop(fed_idx, []):
+                    proc = sup.procs.get(slot)
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()
+                        note(f"chaos: SIGKILL {slot}")
+                if torn_at and torn_at[0] == fed_idx:
+                    torn_at.pop(0)
+                    inject_torn_append(router.topics[0].path)
+                    inject_torn_append(router.deltas_topics()[0].path)
+                    note("chaos: torn append (p0)")
+                if lease_at == fed_idx:
+                    fence_rejections += _shard_lease_takeover(
+                        shared, sup, cfg, note
+                    )
+                fed_idx += 1
+            if fed_idx >= len(chunks) and pending_dups:
+                for idx in sorted(pending_dups):
+                    for rec in pending_dups.pop(idx, []):
+                        router.append([rec])
+            if (fed_idx >= len(chunks) and not pending_dups
+                    and len(merged_ops()) >= expected):
+                break
+            time.sleep(0.02)
+    finally:
+        sup.stop()
+
+    ops = merged_ops()
+    digest = stream_digest(ops)
+    dups, skips = sequence_integrity(ops)
+    converged = (
+        digest == gdigest and dups == 0 and skips == 0
+        and ("lease" not in cfg.faults or fence_rejections > 0)
+    )
+    detail = (
+        f"ops={len(ops)}/{expected} partitions={cfg.n_partitions} "
+        f"workers={cfg.n_workers} restarts={sup.restarts} "
+        f"owners={sup.partition_owners()} events={events + sup.events}"
+    )
+    from ..utils.metrics import dump_snapshot_line, merge_snapshots
+
+    worker_snaps = sup.child_metrics()
+    metrics = merge_snapshots(worker_snaps.values()).snapshot()
+    if cfg.shared_dir is not None:
+        mpath = os.path.join(shared, "metrics.jsonl")
+        for slot, snap in worker_snaps.items():
+            dump_snapshot_line(mpath, snap, source=f"chaos-{slot}")
+    return ChaosResult(
+        converged=converged, digest=digest, golden_digest=gdigest,
+        client_digest=None, scribe_ok=True,
+        duplicate_seqs=dups, skipped_seqs=skips,
+        fence_rejections=fence_rejections, restarts=dict(sup.restarts),
+        events=events + list(sup.events), detail=detail,
+        timeline=sorted(timeline + sup.timeline), metrics=metrics,
+    )
+
+
+def _shard_lease_takeover(shared: str, sup, cfg: ChaosConfig,
+                          note) -> int:
+    """The fabric's expired-lease fault: SIGSTOP one shard worker past
+    the lease TTL, usurp ONE of its partitions, bind the next fence on
+    that partition's deltas topic + checkpoint, and prove the deposed
+    owner's writes are REJECTED. The stopped worker's other partitions
+    meanwhile expire and rebalance onto peers — the membership-change
+    path under fault. Returns demonstrated fence rejections."""
+    from ..server.shard_fabric import (
+        deltas_topic_name,
+        partition_lease_name,
+    )
+
+    # A worker may transiently own nothing (mid-rebalance, just
+    # restarted): poll for a live worker that demonstrably holds a
+    # partition lease before staging the takeover. Generous window —
+    # a deadline for a condition poll, not a sleep: under suite
+    # contention a starved worker can take seconds to first sweep,
+    # and an expired probe would retire the fault (rejections=0 fails
+    # the run's lease gate).
+    slot = proc = None
+    victims: List[str] = []
+    probe_deadline = time.time() + 24 * cfg.ttl_s
+    while time.time() < probe_deadline and proc is None:
+        owners = sup.partition_owners()
+        for s in sup.roles:
+            p = sup.procs.get(s)
+            if p is None or p.poll() is not None:
+                continue
+            owner_id = f"{s}-g{sup.generation[s]}"
+            victims = [name for name, o in owners.items()
+                       if o == owner_id]
+            if victims:
+                slot, proc = s, p
+                break
+        if proc is None:
+            sup.poll_once()
+            time.sleep(cfg.ttl_s / 5)
+    if proc is None or not victims:
+        return 0
+    target = victims[0]  # partition_lease_name(k)
+    part = next(p for p in range(cfg.n_partitions)
+                if partition_lease_name(p) == target)
+    deltas = make_topic(
+        os.path.join(shared, "topics", f"{deltas_topic_name(part)}.jsonl"),
+        cfg.log_format,
+    )
+    old_fence, old_owner = deltas.latest_fence()
+    rejections = 0
+    os.kill(proc.pid, signal.SIGSTOP)
+    note(f"chaos: SIGSTOP {slot} (stale partition lease on {target})")
+    zombie_alive = True
+
+    def kill_zombie(why: str) -> None:
+        nonlocal zombie_alive
+        if not zombie_alive:
+            return
+        try:
+            proc.kill()
+            proc.wait(timeout=10)
+        except OSError:
+            pass
+        zombie_alive = False
+        note(f"chaos: zombie {slot} killed ({why})")
+
+    try:
+        usurper = LeaseManager(
+            os.path.join(shared, "leases"), "chaos-usurper",
+            ttl_s=cfg.ttl_s, claim_ttl_s=max(0.25, cfg.ttl_s / 2),
+        )
+
+        def acquire(deadline_s: float):
+            deadline = time.time() + deadline_s
+            while time.time() < deadline:
+                f = usurper.try_acquire(target)
+                if f is not None:
+                    return f
+                time.sleep(cfg.ttl_s / 5)
+            return None
+
+        fence = acquire(6 * cfg.ttl_s)
+        if fence is None:
+            kill_zombie("holding the lease claim")
+            fence = acquire(6 * cfg.ttl_s)
+        if fence is None:
+            # Lost the takeover race: a live peer swept the expired
+            # lease first (it polls its sweep as fast as we do). A
+            # successor owner therefore EXISTS — the deposed owner's
+            # rejection is still demonstrable once the successor's
+            # higher fence is bound on the output topic.
+            if not old_fence:
+                return 0
+            cur = 0
+            bind_deadline = time.time() + 8 * cfg.ttl_s
+            while time.time() < bind_deadline:
+                cur, _ = deltas.latest_fence()
+                if cur and cur > old_fence:
+                    break
+                time.sleep(cfg.ttl_s / 5)
+            if not cur or cur <= old_fence:
+                return 0
+            note(f"chaos: takeover race lost to a live peer (fence "
+                 f"{cur} bound); demonstrating deposed rejection")
+            try:
+                deltas.append_many(
+                    [{"kind": "op", "doc": "zombie", "seq": -1}],
+                    fence=old_fence, owner=old_owner,
+                )
+            except FencedError:
+                rejections += 1
+                note("chaos: deposed partition topic write REJECTED")
+            return rejections
+        note(f"chaos: usurper took {target} (fence {fence})")
+        ckpt = FencedCheckpointStore(os.path.join(shared, "checkpoints"))
+        env = ckpt.load(target)
+        # The usurper can itself lose the partition mid-fault: blocking
+        # on the zombie's write lock outlasts its own short lease, a
+        # live worker retakes the partition with a higher fence, and
+        # the usurper's bind is REJECTED — which demonstrates the very
+        # write-path fencing this fault exists to prove, so count it
+        # rather than crash the run.
+        try:
+            try:
+                deltas.append_many([], fence=fence, owner="chaos-usurper",
+                                   lock_timeout_s=2 * cfg.ttl_s)
+                if env is not None:
+                    ckpt.save(target, env["state"], fence=fence,
+                              owner="chaos-usurper",
+                              lock_timeout_s=2 * cfg.ttl_s)
+            except TimeoutError:
+                kill_zombie("holding a write lock")
+                # Our lease may have expired while we were blocked;
+                # refresh the fence before retrying the bind.
+                refreshed = acquire(2 * cfg.ttl_s)
+                if refreshed is not None:
+                    fence = refreshed
+                deltas.append_many([], fence=fence, owner="chaos-usurper")
+                if env is not None:
+                    ckpt.save(target, env["state"], fence=fence,
+                              owner="chaos-usurper")
+        except FencedError:
+            rejections += 1
+            note("chaos: usurper itself fence-REJECTED "
+                 "(partition retaken mid-fault)")
+        if old_fence:
+            try:
+                deltas.append_many(
+                    [{"kind": "op", "doc": "zombie", "seq": -1}],
+                    fence=old_fence, owner=old_owner,
+                )
+            except FencedError:
+                rejections += 1
+                note("chaos: deposed partition topic write REJECTED")
+            if env is not None:
+                try:
+                    ckpt.save(target, env["state"], fence=old_fence,
+                              owner=old_owner)
+                except FencedError:
+                    rejections += 1
+                    note("chaos: deposed partition checkpoint REJECTED")
+        usurper.release(target)
+    finally:
+        if zombie_alive:
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+            note(f"chaos: SIGCONT {slot}")
+    return rejections
+
+
 def _lease_takeover(shared: str, sup: ServiceSupervisor,
                     cfg: ChaosConfig, note) -> int:
     """The expired-lease fault: SIGSTOP the sequencer past its TTL, a
@@ -529,19 +865,31 @@ def _lease_takeover(shared: str, sup: ServiceSupervisor,
         # batch does — bounded, in case the zombie holds the lock.
         ckpt = FencedCheckpointStore(os.path.join(shared, "checkpoints"))
         env = ckpt.load("deli")
+        # As in `_shard_lease_takeover`: killing the zombie lets the
+        # supervisor restart it, and the fresh generation can rebind a
+        # higher fence before our retry — the usurper being REJECTED
+        # demonstrates the same write-path fencing, so count it.
         try:
-            deltas.append_many([], fence=fence, owner="chaos-usurper",
-                               lock_timeout_s=2 * cfg.ttl_s)
-            if env is not None:
-                ckpt.save("deli", env["state"], fence=fence,
-                          owner="chaos-usurper",
-                          lock_timeout_s=2 * cfg.ttl_s)
-        except TimeoutError:
-            kill_zombie("holding a write lock")
-            deltas.append_many([], fence=fence, owner="chaos-usurper")
-            if env is not None:
-                ckpt.save("deli", env["state"], fence=fence,
-                          owner="chaos-usurper")
+            try:
+                deltas.append_many([], fence=fence, owner="chaos-usurper",
+                                   lock_timeout_s=2 * cfg.ttl_s)
+                if env is not None:
+                    ckpt.save("deli", env["state"], fence=fence,
+                              owner="chaos-usurper",
+                              lock_timeout_s=2 * cfg.ttl_s)
+            except TimeoutError:
+                kill_zombie("holding a write lock")
+                refreshed = acquire(2 * cfg.ttl_s)
+                if refreshed is not None:
+                    fence = refreshed
+                deltas.append_many([], fence=fence, owner="chaos-usurper")
+                if env is not None:
+                    ckpt.save("deli", env["state"], fence=fence,
+                              owner="chaos-usurper")
+        except FencedError:
+            rejections += 1
+            note("chaos: usurper itself fence-REJECTED "
+                 "(lease retaken mid-fault)")
         # The deposed owner's write attempts — the exact calls the
         # stopped deli would make on resume — must be rejected.
         if old_fence:

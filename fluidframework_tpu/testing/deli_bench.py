@@ -40,21 +40,27 @@ from typing import Dict, List, Optional, Tuple
 
 
 def build_pipeline_workload(n_docs: int, n_clients: int,
-                            ops_per_client: int, seed: int = 5) -> List[dict]:
+                            ops_per_client: int, seed: int = 5,
+                            doc_names: Optional[List[str]] = None
+                            ) -> List[dict]:
     """Deterministic raw-topic stream, round-robin across docs (every
     pump carries many documents — the data-parallel axis the kernel
     batches over). Each client's join rides immediately before its
     first op, so ANY prefix of the stream carries the same join:op mix
     as the whole — the bounded seed-baseline measurement then rates
-    the same workload shape the full runs do."""
+    the same workload shape the full runs do. `doc_names` overrides
+    the default ``doc{d}`` naming (the shard bench passes
+    partition-balanced names)."""
     import random
 
     rng = random.Random(seed)
+    docs = doc_names if doc_names is not None else [
+        f"doc{d}" for d in range(n_docs)
+    ]
     recs: List[dict] = []
     for i in range(ops_per_client):
         for c in range(1, n_clients + 1):
-            for d in range(n_docs):
-                doc = f"doc{d}"
+            for doc in docs:
                 if i == 0:
                     recs.append({"kind": "join", "doc": doc, "client": c})
                 recs.append({
@@ -323,8 +329,201 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
             shutil.rmtree(scratch, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# sharded-fabric scaling bench (config6_shard_scaling's engine)
+# ---------------------------------------------------------------------------
+
+
+def _shard_child_main() -> None:
+    """Subprocess entry for one bench shard: warm up untimed (imports +
+    jit compile), announce READY, wait for the go-file barrier, then
+    run the timed partition drain and report one DONE json line."""
+    import sys
+
+    raw_path, out_dir, impl, log_format, batch_s, go_path = sys.argv[1:7]
+    warm_dir = os.path.join(out_dir, "warm")
+    os.makedirs(warm_dir, exist_ok=True)
+    run_pipeline(impl, raw_path, warm_dir, batch=int(batch_s),
+                 log_format=log_format)
+    print("READY", flush=True)
+    while not os.path.exists(go_path):
+        time.sleep(0.005)
+    res = run_pipeline(impl, raw_path, out_dir, batch=int(batch_s),
+                       log_format=log_format)
+    print("DONE " + json.dumps({
+        "seconds": res["seconds"], "records": res["records"],
+        "outputs": res["outputs"], "out_path": res["out_path"],
+    }), flush=True)
+
+
+def _canonical_by_doc(paths: List[str]) -> Dict[str, List[dict]]:
+    """Merged per-doc, seq-sorted canonical streams across partition
+    output topics — the form sharded and single-partition runs are
+    compared in (a doc lives in exactly one partition, so per-doc
+    streams merge without interleaving questions)."""
+    per_doc: Dict[str, List[dict]] = {}
+    for path in paths:
+        for rec in _read_canonical(path):
+            if rec.get("kind") == "op":
+                # inOff is per-partition transport bookkeeping (input
+                # line offsets differ across shardings by design) —
+                # the same exclusion canonical_record applies.
+                per_doc.setdefault(rec["doc"], []).append(
+                    {k: v for k, v in rec.items() if k != "inOff"}
+                )
+    for v in per_doc.values():
+        v.sort(key=lambda r: r["seq"])
+    return per_doc
+
+
+def run_shard_bench(n_docs: int = 2048, n_clients: int = 8,
+                    ops_per_client: int = 2,
+                    partitions: Tuple[int, ...] = (1, 4),
+                    batch: int = 8192, deli_impl: str = "kernel",
+                    log_format: str = "columnar",
+                    work_dir: Optional[str] = None,
+                    keep: bool = False) -> dict:
+    """Aggregate-throughput scaling of the sharded ordering fabric:
+    the SAME workload (partition-balanced doc names) drained through P
+    parallel partition pipelines — one OS process per partition, the
+    exact `run_pipeline` datapath the single-partition bench times —
+    for each P in `partitions`. Children warm up untimed (imports, jit)
+    behind a READY/go barrier, so the timed window is pure drain.
+
+    Aggregate ops/s per P = total records / slowest partition's drain
+    (the fabric is only as done as its last shard). The bit-identity
+    gate extends the four-way single-partition gate ACROSS partitions:
+    every P's merged per-doc canonical stream must equal the first
+    P's, record for record."""
+    import subprocess
+    import sys
+
+    scratch = work_dir or tempfile.mkdtemp(prefix="shard-bench-")
+    os.makedirs(scratch, exist_ok=True)
+    try:
+        from ..server.columnar_log import make_topic
+        from ..server.queue import record_partition
+        from ..server.shard_fabric import spread_doc_names
+
+        max_p = max(partitions)
+        docs = spread_doc_names(n_docs, max_p)
+        workload = build_pipeline_workload(
+            n_docs, n_clients, ops_per_client, doc_names=docs
+        )
+        runs: Dict[int, dict] = {}
+        reference: Optional[Dict[str, List[dict]]] = None
+        for P in partitions:
+            pdir = os.path.join(scratch, f"P{P}")
+            os.makedirs(pdir, exist_ok=True)
+            shards: List[List[dict]] = [[] for _ in range(P)]
+            for rec in workload:
+                shards[record_partition(rec, P)].append(rec)
+            raw_paths = []
+            for p in range(P):
+                raw_path = os.path.join(pdir, f"raw-p{p}.jsonl")
+                for stale in (raw_path, raw_path + ".clen",
+                              raw_path + ".fence"):
+                    if os.path.exists(stale):
+                        os.remove(stale)
+                topic = make_topic(raw_path, log_format)
+                for lo in range(0, len(shards[p]), batch):
+                    topic.append_many(shards[p][lo:lo + batch])
+                raw_paths.append(raw_path)
+            go_path = os.path.join(pdir, "go")
+            procs = []
+            children = []
+            try:
+                for p in range(P):
+                    out_dir = os.path.join(pdir, f"out-p{p}")
+                    os.makedirs(out_dir, exist_ok=True)
+                    procs.append(subprocess.Popen(
+                        [sys.executable, "-c",
+                         "from fluidframework_tpu.testing.deli_bench "
+                         "import _shard_child_main; _shard_child_main()",
+                         raw_paths[p], out_dir, deli_impl, log_format,
+                         str(batch), go_path],
+                        stdout=subprocess.PIPE, text=True,
+                        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                    ))
+                for proc in procs:
+                    line = (proc.stdout.readline() or "").strip()
+                    assert line == "READY", f"shard child failed: {line!r}"
+                with open(go_path, "w") as f:
+                    f.write("go")
+                for proc in procs:
+                    out, _ = proc.communicate(timeout=600)
+                    assert proc.returncode == 0, out[-800:]
+                    done = [l for l in out.splitlines()
+                            if l.startswith("DONE ")]
+                    assert done, out[-800:]
+                    children.append(json.loads(done[0][5:]))
+            finally:
+                # A failure above (bad READY, crash, timeout) must not
+                # orphan siblings spinning on the go-file poll forever.
+                for proc in procs:
+                    if proc.poll() is None:
+                        proc.kill()
+                        proc.wait(timeout=10)
+            total = sum(c["records"] for c in children)
+            wall = max(c["seconds"] for c in children)
+            merged = _canonical_by_doc([c["out_path"] for c in children])
+            if reference is None:
+                reference = merged
+            else:
+                assert merged == reference, (
+                    f"sharded deltas diverge from the "
+                    f"{partitions[0]}-partition reference at P={P}"
+                )
+            runs[P] = {
+                "partitions": P, "records": total,
+                "aggregate_ops_per_sec": round(total / wall, 1),
+                "slowest_partition_s": round(wall, 4),
+                "per_partition_records": [c["records"] for c in children],
+            }
+        base = min(partitions)
+        peak = max(partitions)
+        ratio = (runs[peak]["aggregate_ops_per_sec"]
+                 / runs[base]["aggregate_ops_per_sec"])
+        return {
+            "metric": "shard_fabric_scaling",
+            "deli_impl": deli_impl, "log_format": log_format,
+            "docs": n_docs, "clients_per_doc": n_clients,
+            "records": len(workload),
+            "runs": [runs[p] for p in partitions],
+            "speedup": round(ratio, 2),
+            "speedup_axis": f"{peak}_vs_{base}_partitions",
+            "cores": os.cpu_count(),
+            "gate": "bit-identical across partitions",
+            "unit": "records/s",
+        }
+    finally:
+        if not keep and work_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def main() -> None:  # CLI twin: tools/bench_deli.py
     scale = float(os.environ.get("BD_SCALE", "1.0"))
+    if os.environ.get("BD_SHARD"):
+        # Shard-scaling mode (tools/bench_deli.py --shard): aggregate
+        # ops/s of the P-partition fabric vs single-partition, gated
+        # bit-identical across partitions. BD_PARTITIONS is a comma
+        # list of partition counts (default "1,4").
+        parts = tuple(
+            int(p) for p in
+            os.environ.get("BD_PARTITIONS", "1,4").split(",") if p
+        )
+        res = run_shard_bench(
+            n_docs=max(8, int(int(os.environ.get("BD_DOCS", "2048"))
+                              * scale)),
+            n_clients=int(os.environ.get("BD_CLIENTS", "8")),
+            ops_per_client=int(os.environ.get("BD_OPS", "2")),
+            partitions=parts,
+            batch=int(os.environ.get("BD_BATCH", "8192")),
+            deli_impl=os.environ.get("BD_IMPL", "kernel"),
+            log_format=os.environ.get("BD_LOG_FORMAT", "columnar"),
+        )
+        print(json.dumps(res))
+        return
     res = run_pipeline_bench(
         n_docs=max(8, int(int(os.environ.get("BD_DOCS", "10000")) * scale)),
         n_clients=int(os.environ.get("BD_CLIENTS", "64")),

@@ -262,6 +262,41 @@ def test_copier_foreman_moira():
     assert copier2.pump() == 0  # nothing new
 
 
+def test_copier_archives_sharded_ingress():
+    """The archive contract is EVERY raw record: with a sharded server
+    the ingress lands on ``rawdeltas-p{k}``, and the copier must find
+    those topics too (it used to watch only the flat topic and silently
+    archive nothing)."""
+    from fluidframework_tpu.core import CollabClient
+    from fluidframework_tpu.server import LocalServer
+    from fluidframework_tpu.server.aux_lambdas import CopierLambda
+    from fluidframework_tpu.server.queue import partition_of
+    from fluidframework_tpu.server.shard_fabric import spread_doc_names
+
+    srv = LocalServer(n_partitions=4)
+    copier = CopierLambda(srv.log, srv.storage)
+    docs = spread_doc_names(4, 1)  # one doc homed in each partition
+    for i, doc in enumerate(docs):
+        sock = srv.connect(doc, client_id=1)
+        client = CollabClient(1, initial="")
+        sock.listener = client.apply_msg
+        srv.process_all()
+        deli = srv.delis[partition_of(doc, 4)]
+        client.engine.current_seq = deli.sequencers[doc].seq
+        sock.submit(client.insert_local(0, f"hi{i}"))
+    srv.process_all()
+    assert copier.pump() > 0
+    for doc in docs:
+        archived = copier.read_archive(doc)
+        assert any(e.get("kind") == "join" for e in archived), doc
+        assert any(e.get("kind") == "op" for e in archived), doc
+    # Checkpoint carries per-partition offsets; resume sees nothing new.
+    cp = copier.checkpoint()
+    assert set(cp["offsets"]) > {"rawdeltas"}
+    copier2 = CopierLambda(srv.log, srv.storage, cp)
+    assert copier2.pump() == 0
+
+
 # ------------------------------------------------------------ layer check
 
 

@@ -29,6 +29,7 @@ from fluidframework_tpu.protocol.record_batch import (
 from fluidframework_tpu.server.columnar_log import (
     ColumnarFileTopic,
     ColumnarTailReader,
+    make_tail_reader,
     make_topic,
 )
 from fluidframework_tpu.server.queue import FencedError, SharedFileTopic
@@ -467,3 +468,196 @@ def test_format_round_trip_never_truncates_acknowledged_records(tmp_path):
     assert nxt == 9
     assert [v["era"] for _, v in entries] == \
         ["col"] * 3 + ["json"] * 4 + ["col2"]
+
+
+# ---------------------------------------------------------------------------
+# frame-header corruption: bounded magic-resync
+# ---------------------------------------------------------------------------
+
+
+def _poison_header(path, frame_start):
+    """Garble a frame's version byte in place (extent unknowable)."""
+    data = bytearray(open(path, "rb").read())
+    data[frame_start + 4] = 0x63
+    open(path, "wb").write(bytes(data))
+
+
+def test_header_corruption_resyncs_instead_of_stalling(tmp_path):
+    """A corrupted frame HEADER used to read as a torn tail and stall
+    readers forever; the bounded magic-scan now skips-but-counts the
+    poisoned region (ONE record slot) and resumes at the next valid
+    frame."""
+    path = str(tmp_path / "t.jsonl")
+    topic = ColumnarFileTopic(path)
+    topic.append_many([{"k": i} for i in range(3)])
+    first_len = os.path.getsize(path)
+    topic.append_many([{"k": 3}, {"k": 4}])
+    topic.append_many([{"k": 5}])
+    _poison_header(path, first_len)  # second frame's header
+
+    entries, nxt = topic.read_entries(0)
+    # Frame 1 (3 records) + poison slot (1) + frame 3 (1 record).
+    assert [(i, v["k"]) for i, v in entries] == \
+        [(0, 0), (1, 1), (2, 2), (4, 5)]
+    assert nxt == 5
+    # The incremental reader agrees (offset parity across readers).
+    r = ColumnarTailReader(topic)
+    got = r.poll()
+    assert [(i, v["k"]) for i, v in got] == \
+        [(0, 0), (1, 1), (2, 2), (4, 5)]
+    assert r.next_line == 5
+    # And the stream keeps flowing past the poison.
+    topic.append_many([{"k": 6}])
+    assert [(i, v["k"]) for i, v in r.poll()] == [(5, 6)]
+
+
+def test_header_corruption_resyncs_to_json_lines(tmp_path):
+    """Mixed history: a poisoned frame followed by JSONL records
+    resyncs at the first complete parseable line. The JSON appender's
+    torn-tail SEAL newline delimits the junk, so even the first line
+    after the poison survives."""
+    path = str(tmp_path / "t.jsonl")
+    topic = ColumnarFileTopic(path)
+    topic.append_many([{"k": 0}])
+    first_len = os.path.getsize(path)
+    topic.append_many([{"k": 1}])
+    SharedFileTopic(path).append_many([{"j": 0}, {"j": 1}, {"j": 2}])
+    _poison_header(path, first_len)
+
+    entries, nxt = topic.read_entries(0)
+    # Frame 1 + poison slot (the garbled frame 2, sealed by the JSON
+    # appender's newline) + every json line.
+    assert [v for _, v in entries] == \
+        [{"k": 0}, {"j": 0}, {"j": 1}, {"j": 2}]
+    assert [i for i, _ in entries] == [0, 2, 3, 4]
+    assert nxt == 5
+
+
+def test_header_corruption_waits_for_unconfirmed_resync(tmp_path):
+    """Poison followed by a TORN frame (an append that may still be in
+    flight) must not be consumed yet — the scan resumes on a later
+    poll once the frame completes."""
+    from fluidframework_tpu.protocol.record_batch import encode_batch
+
+    path = str(tmp_path / "t.jsonl")
+    topic = ColumnarFileTopic(path)
+    topic.append_many([{"k": 0}])
+    first_len = os.path.getsize(path)
+    topic.append_many([{"k": 1}])
+    _poison_header(path, first_len)
+    tail_frame = encode_batch([{"k": 2}])
+    with open(path, "ab") as f:
+        f.write(tail_frame[:len(tail_frame) - 3])  # torn candidate
+    entries, nxt = topic.read_entries(0)
+    assert [v for _, v in entries] == [{"k": 0}] and nxt == 1
+    with open(path, "ab") as f:
+        f.write(tail_frame[len(tail_frame) - 3:])  # append completes
+    entries, nxt = topic.read_entries(0)
+    assert [v for _, v in entries] == [{"k": 0}, {"k": 2}]
+    assert nxt == 3
+
+
+def test_journal_replay_counts_poisoned_region_one_slot(tmp_path):
+    """LocalServer journal replay holds ONE LOST_RECORD slot for a
+    header-poisoned region (the resync rule applied to the in-proc
+    journal), so later records keep their offsets."""
+    from fluidframework_tpu.server.log import LOST_RECORD, LogTopic
+
+    path = str(tmp_path / "topic.jsonl")
+    t = LogTopic("t", path, log_format="columnar")
+    t.append_many([{"k": 0}, {"k": 1}])
+    t._file.flush()
+    first_len = os.path.getsize(path)
+    t.append_many([{"k": 2}])
+    t.append_many([{"k": 3}])
+    t._file.close()
+    _poison_header(path, first_len)
+    t2 = LogTopic("t", path, log_format="columnar")
+    assert t2.head == 4  # 2 + 1 poison slot + 1
+    assert t2.read(0) == [{"k": 0}, {"k": 1}, LOST_RECORD, {"k": 3}]
+
+
+# ---------------------------------------------------------------------------
+# scalar DeliRole columnar ingest (batch columns, no lazy JSON)
+# ---------------------------------------------------------------------------
+
+
+def test_scalar_role_columnar_ingest_matches_json(tmp_path):
+    """`DeliRole.process_batch` (columnar batch-column ingest) must
+    produce the byte-identical stream the same role produces over a
+    JSONL topic — including boxcar atomicity, duplicate-join drops,
+    resubmission dedup and nacks."""
+    recs = _wire_workload(n_docs=2, n_clients=3, ops=10)
+    # Adversarial riders: resubmission (dup op), duplicate join, a
+    # boxcar, an unknown-client nack, a foreign record.
+    recs += [
+        recs[len(recs) // 2],                      # resubmission
+        {"kind": "join", "doc": "doc0", "client": 1},   # dup join
+        {"kind": "boxcar", "doc": "doc1", "client": 2, "ops": [
+            {"clientSeq": 11, "refSeq": 0, "contents": {"b": 0}},
+            {"clientSeq": 12, "refSeq": 0, "contents": {"b": 1}},
+        ]},
+        {"kind": "op", "doc": "doc0", "client": 99, "clientSeq": 1,
+         "refSeq": 0, "contents": None},           # unknown client
+        {"weird": True},                           # foreign junk
+    ]
+
+    json_shared = str(tmp_path / "json")
+    SharedFileTopic(
+        os.path.join(json_shared, "topics", "rawdeltas.jsonl")
+    ).append_many(recs)
+    rj = DeliRole(json_shared, owner="j", ttl_s=3600.0, batch=32,
+                  log_format="json")
+    while rj.step():
+        pass
+
+    col_shared = str(tmp_path / "col")
+    col_raw = make_topic(
+        os.path.join(col_shared, "topics", "rawdeltas.jsonl"), "columnar"
+    )
+    for lo in range(0, len(recs), 16):
+        col_raw.append_many(recs[lo:lo + 16])
+    rc = DeliRole(col_shared, owner="c", ttl_s=3600.0, batch=32,
+                  log_format="columnar")
+    assert rc.ingest_batches and rc.out_columnar
+    while rc.step():
+        pass
+
+    def canon(shared):
+        deltas = make_topic(
+            os.path.join(shared, "topics", "deltas.jsonl"), "columnar"
+        )
+        return [{k: v for k, v in r.items()
+                 if k not in ("reason", "inOff")}
+                for r in deltas.read_from(0)]
+
+    got_json, got_col = canon(json_shared), canon(col_shared)
+    assert got_col == got_json
+    assert any(r["kind"] == "nack" for r in got_json)  # riders fired
+
+
+def test_scalar_role_columnar_blob_passthrough(tmp_path):
+    """Over a columnar out topic, standalone op contents must ride as
+    raw pre-encoded blobs (JsonBlob) end to end — the kernel role's
+    zero-JSON rule, now on the scalar path too."""
+    from fluidframework_tpu.protocol.record_batch import JsonBlob
+
+    shared = str(tmp_path / "farm")
+    raw = make_topic(
+        os.path.join(shared, "topics", "rawdeltas.jsonl"), "columnar"
+    )
+    raw.append_many([
+        {"kind": "join", "doc": "d", "client": 1},
+        {"kind": "op", "doc": "d", "client": 1, "clientSeq": 1,
+         "refSeq": 0, "contents": {"v": 42}},
+    ])
+    role = DeliRole(shared, owner="w", ttl_s=3600.0,
+                    log_format="columnar")
+    role.fence = 1
+    out = []
+    reader = make_tail_reader(role.in_topic)
+    for unit in reader.poll_batches(64):
+        role.process_batch(unit[1], unit[2], out)
+    ops = [r for r in out if r.get("type") == "op"]
+    assert ops and isinstance(ops[0]["contents"], JsonBlob)
+    assert ops[0]["contents"] == {"v": 42}

@@ -348,9 +348,13 @@ def test_checkpoint_cadence_idle_flush(tmp_path, fresh_registry):
     """Progress folded before quiescence goes durable from the IDLE
     step once the interval elapses — a quiet stream cannot pin dirty
     state in memory forever."""
+    # ckpt_duty=0 disables the storm guard: this test is about the
+    # idle-flush contract alone, and an fsync stall on a loaded box
+    # (last write cost S -> next gated for 5*S with the default duty)
+    # would otherwise outlast the 60ms sleep below and flake.
     role, raw = _mk_deli_role(
         tmp_path, fresh_registry,
-        ckpt_interval_s=0.05, ckpt_bytes=1 << 40,
+        ckpt_interval_s=0.05, ckpt_bytes=1 << 40, ckpt_duty=0.0,
     )
     writes = fresh_registry.counter("checkpoint_writes_total", role="deli")
     raw.append({"kind": "join", "doc": "d", "client": 1})

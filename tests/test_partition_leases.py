@@ -2,12 +2,16 @@
 
 The reference splits the document space across server pods via Kafka
 partitions, with ZooKeeper arbitrating consumer ownership (SURVEY.md
-§2.5 ⚙️). Here two OS processes coordinate only through a shared
-directory: each leases half the partitions and sequences its
-documents' submissions; killing one lets the survivor's sweep take
-the expired leases over and resume from the dead worker's checkpoint
-— every submission sequenced exactly once, per-document sequence
-numbers strictly increasing across the ownership change.
+§2.5 ⚙️). Here two OS processes — `server.shard_fabric.ShardWorker`
+nodes via the tools/partition_worker_main.py wrapper — coordinate only
+through a shared directory: each leases its fair share of partitions
+and runs the production deli role per owned partition
+(``rawdeltas-p{k}`` → ``deltas-p{k}``); killing one lets the
+survivor's sweep take the expired leases over, restore the fenced
+checkpoint, and resume EXACTLY once — per-document sequence numbers
+contiguous across the ownership change, no duplicate (client,
+clientSeq) ever sequenced twice (the fabric's inOff recovery scan —
+stronger than the consumer-side dedup the pre-fabric worker needed).
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ from fluidframework_tpu.server.queue import (
     SharedFileConsumer,
     SharedFileProducer,
     SharedFileTopic,
+    lease_table,
     partition_of,
 )
 
@@ -48,35 +53,46 @@ def _spawn(shared, wid, n_parts, ttl=1.0, max_parts=None):
     return proc
 
 
-def _submit_all(shared, n_parts, docs, ops_per_doc):
-    """Write submissions round-robin; returns expected (doc ->
-    set of clientSeq) map."""
-    topics = {
-        p: SharedFileTopic(os.path.join(shared, f"submissions-p{p}.jsonl"))
-        for p in range(n_parts)
-    }
+def _raw_topic(shared, p):
+    return SharedFileTopic(
+        os.path.join(shared, "topics", f"rawdeltas-p{p}.jsonl")
+    )
+
+
+def _submit_all(shared, n_parts, docs, ops_per_doc, base=0):
+    """Joins (first wave only) + ops round-robin across 3 clients;
+    returns expected (doc -> set of (client, clientSeq)) map."""
     expect = {}
-    for d, doc in enumerate(docs):
-        p = partition_of(doc, n_parts)
+    for doc in docs:
+        topic = _raw_topic(shared, partition_of(doc, n_parts))
+        recs = []
+        if base == 0:
+            recs.extend(
+                {"kind": "join", "doc": doc, "client": c}
+                for c in (1, 2, 3)
+            )
         expect[doc] = set()
-        for i in range(ops_per_doc):
-            topics[p].append({
-                "docId": doc, "clientId": 1 + (i % 3),
-                "clientSeq": i // 3 + 1,
-                "refSeq": 0, "contents": {"i": i},
+        for i in range(base, base + ops_per_doc):
+            client, cseq = 1 + (i % 3), i // 3 + 1
+            recs.append({
+                "kind": "op", "doc": doc, "client": client,
+                "clientSeq": cseq, "refSeq": 0, "contents": {"i": i},
             })
-            expect[doc].add((1 + (i % 3), i // 3 + 1))
+            expect[doc].add((client, cseq))
+        topic.append_many(recs)
     return expect
 
 
 def _read_sequenced(shared, n_parts):
+    """Merged per-doc op records across every deltas-p{k} topic."""
     out = {}
     for p in range(n_parts):
-        path = os.path.join(shared, f"sequenced-p{p}.jsonl")
+        path = os.path.join(shared, "topics", f"deltas-p{p}.jsonl")
         if not os.path.exists(path):
             continue
         for m in SharedFileTopic(path).read_from(0):
-            out.setdefault(m["docId"], []).append(m)
+            if isinstance(m, dict) and m.get("kind") == "op":
+                out.setdefault(m["doc"], []).append(m)
     return out
 
 
@@ -262,9 +278,11 @@ def test_torn_final_line_reread_complete_next_poll(tmp_path):
 
 
 def test_two_workers_split_and_failover(tmp_path):
-    """Two worker processes split 4 partitions; killing one mid-stream
-    hands its partitions to the survivor with exactly-once sequencing
-    across the takeover."""
+    """Two fabric worker processes split 4 partitions; killing one
+    mid-stream hands its partitions to a replacement with EXACTLY-once
+    sequencing across the takeover (contiguous per-doc seqs, no
+    duplicate (client, clientSeq) — the fabric's fenced inOff
+    recovery, not consumer-side dedup)."""
     shared = str(tmp_path)
     n_parts = 4
     # Two documents in EVERY partition (searched by name so the split
@@ -281,24 +299,32 @@ def test_two_workers_split_and_failover(tmp_path):
         i += 1
     ops_per_doc = 120
 
-    # Phase 1: each worker limited to 2 partitions -> a true split.
+    # Phase 1: each worker capped at 2 partitions -> a true split.
     wa = _spawn(shared, "A", n_parts, ttl=1.0, max_parts=2)
     time.sleep(0.3)
     wb = _spawn(shared, "B", n_parts, ttl=1.0, max_parts=2)
     expect = _submit_all(shared, n_parts, docs, ops_per_doc)
 
+    wc = None
     try:
-        # Let both make progress, then verify the split is real.
+        # Let both make progress, then verify the split is real.  Wait
+        # for the ownership split too: A alone (capped at 2 parts, but
+        # holding half the docs) can hit the progress bar before B has
+        # swept up its leases.
         deadline = time.time() + 20
+        owners = {}
         while time.time() < deadline:
             seqd = _read_sequenced(shared, n_parts)
-            if sum(len(v) for v in seqd.values()) >= len(docs) * 30:
+            owners = lease_table(os.path.join(shared, "leases"))
+            owners = {k: v for k, v in owners.items()
+                      if k.startswith("deli-p")}
+            if (sum(len(v) for v in seqd.values()) >= len(docs) * 30
+                    and set(owners.values()) == {"A", "B"}):
                 break
             time.sleep(0.1)
-        leases = LeaseManager(os.path.join(shared, "leases"), "probe")
-        owners = {p: leases.owner_of(f"p{p}") for p in range(n_parts)}
         assert set(owners.values()) == {"A", "B"}, owners
         assert sum(1 for o in owners.values() if o == "A") == 2
+        a_partitions = {k for k, o in owners.items() if o == "A"}
 
         # Phase 2: kill A, then submit a second wave for every doc —
         # A's partitions now have pending work only a successor can
@@ -306,30 +332,18 @@ def test_two_workers_split_and_failover(tmp_path):
         # up the expired leases.
         wa.kill()
         wa.wait(timeout=10)
-        topics = {
-            p: SharedFileTopic(
-                os.path.join(shared, f"submissions-p{p}.jsonl")
-            )
-            for p in range(n_parts)
-        }
+        second = _submit_all(shared, n_parts, docs, 30, base=ops_per_doc)
         for doc in docs:
-            p = partition_of(doc, n_parts)
-            base = ops_per_doc
-            for i in range(base, base + 30):
-                topics[p].append({
-                    "docId": doc, "clientId": 1 + (i % 3),
-                    "clientSeq": i // 3 + 1,
-                    "refSeq": 0, "contents": {"i": i},
-                })
-                expect[doc].add((1 + (i % 3), i // 3 + 1))
+            expect[doc] |= second[doc]
         wc = _spawn(shared, "C", n_parts, ttl=1.0)
         deadline = time.time() + 30
         done = False
+        got = {}
         while time.time() < deadline:
             seqd = _read_sequenced(shared, n_parts)
             got = {
-                doc: {(m["clientId"], m["clientSeq"]) for m in ms
-                      if m["seq"] is not None}
+                doc: {(m["client"], m["clientSeq"]) for m in ms
+                      if m.get("clientSeq")}
                 for doc, ms in seqd.items()
             }
             if all(got.get(d, set()) >= expect[d] for d in docs):
@@ -342,45 +356,39 @@ def test_two_workers_split_and_failover(tmp_path):
 
         seqd = _read_sequenced(shared, n_parts)
         for doc, ms in seqd.items():
-            stamped = [m for m in ms if m["seq"] is not None]
-            # Exactly-once per (client, clientSeq): the worker appends
-            # then checkpoints, so a crash between the two may replay
-            # a batch — dedup by key, then seqs must be unique and the
-            # full expected set covered.
-            seen = {}
-            for m in stamped:
-                seen.setdefault((m["clientId"], m["clientSeq"]), m)
-            assert set(seen) == expect[doc]
-            seqs = sorted(m["seq"] for m in seen.values())
-            assert len(set(seqs)) == len(seqs), f"{doc}: dup seqs"
-            # Ownership actually changed hands for A's partitions.
-        a_docs = [
-            d for d in docs
-            if any(m["worker"] == "A" for m in seqd.get(d, []))
-        ]
-        moved = [
-            d for d in a_docs
-            if any(m["worker"] == "C" for m in seqd.get(d, []))
-        ]
-        assert moved, "no partition visibly changed hands"
+            # EXACTLY-once: no (client, clientSeq) sequenced twice,
+            # and seqs contiguous 1..N (3 join stamps + every op)
+            # straight across the ownership change.
+            keys = [(m["client"], m["clientSeq"]) for m in ms
+                    if m.get("clientSeq")]
+            assert len(keys) == len(set(keys)), f"{doc}: replayed ops"
+            assert set(keys) == expect[doc]
+            seqs = sorted(m["seq"] for m in ms)
+            assert seqs == list(range(1, len(seqs) + 1)), (
+                f"{doc}: seqs not contiguous across takeover"
+            )
+        # Ownership of A's partitions actually changed hands.
+        owners = lease_table(os.path.join(shared, "leases"))
+        moved = [p for p in a_partitions if owners.get(p) == "C"]
+        assert moved, f"no partition visibly changed hands: {owners}"
     finally:
         for proc in (wa, wb, wc):
-            if proc.poll() is None:
+            if proc is not None and proc.poll() is None:
                 proc.kill()
                 proc.wait(timeout=10)
 
 
 def test_checkpoint_resume_exact(tmp_path):
-    """A worker killed between batches resumes from its checkpoint:
-    the successor's first stamped seq continues the dead worker's
-    numbering (no reset, no gap beyond the join stamps)."""
+    """A worker killed between batches resumes from its fenced
+    checkpoint: the successor continues the dead worker's numbering
+    exactly (no reset, no gap, no replayed op)."""
     shared = str(tmp_path)
-    topic = SharedFileTopic(os.path.join(shared, "submissions-p0.jsonl"))
-    for i in range(40):
-        topic.append({
-            "docId": "solo", "clientId": 1, "clientSeq": i + 1,
-            "refSeq": 0, "contents": None,
-        })
+    topic = _raw_topic(shared, 0)
+    topic.append_many(
+        [{"kind": "join", "doc": "solo", "client": 1}]
+        + [{"kind": "op", "doc": "solo", "client": 1, "clientSeq": i + 1,
+            "refSeq": 0, "contents": None} for i in range(40)]
+    )
     wa = _spawn(shared, "A", 1, ttl=0.8)
     try:
         deadline = time.time() + 15
@@ -391,26 +399,27 @@ def test_checkpoint_resume_exact(tmp_path):
             time.sleep(0.05)
         wa.kill()
         wa.wait(timeout=10)
-        for i in range(40, 80):
-            topic.append({
-                "docId": "solo", "clientId": 1, "clientSeq": i + 1,
-                "refSeq": 0, "contents": None,
-            })
+        topic.append_many(
+            [{"kind": "op", "doc": "solo", "client": 1, "clientSeq": i + 1,
+              "refSeq": 0, "contents": None} for i in range(40, 80)]
+        )
         wb = _spawn(shared, "B", 1, ttl=0.8)
+        expected = 81  # 1 join + 80 ops
         deadline = time.time() + 20
         while time.time() < deadline:
             ms = _read_sequenced(shared, 1).get("solo", [])
-            keys = {(m["clientId"], m["clientSeq"]) for m in ms}
-            if len(keys) >= 80:
+            if len(ms) >= expected:
                 break
             time.sleep(0.1)
         ms = _read_sequenced(shared, 1).get("solo", [])
-        seen = {}
-        for m in ms:
-            seen.setdefault((m["clientId"], m["clientSeq"]), m)
-        assert len(seen) == 80
-        seqs = sorted(m["seq"] for m in seen.values())
-        assert len(set(seqs)) == 80, "takeover reset or duplicated seqs"
+        assert len(ms) == expected, len(ms)
+        keys = [(m["client"], m["clientSeq"]) for m in ms
+                if m.get("clientSeq")]
+        assert len(keys) == len(set(keys)), "op replayed across takeover"
+        seqs = sorted(m["seq"] for m in ms)
+        assert seqs == list(range(1, expected + 1)), (
+            "takeover reset, duplicated or skipped seqs"
+        )
     finally:
         for proc in (wa, wb):
             if proc.poll() is None:

@@ -128,13 +128,16 @@ def test_16_process_load_no_reordering():
     # scheduler adds heavy run-to-run variance (measured 4.5-10k ops/s
     # on one core), so the bar scales down rather than encoding one
     # machine's timing. Ordering/completeness asserts are UNGATED
-    # either way. One retry absorbs scheduler outliers — a genuine
-    # throughput regression fails both runs.
+    # either way. Up to TWO retries absorb scheduler outliers (one
+    # retry still tripped ~1/30 runs on a contended 2-core CI box) —
+    # a genuine throughput regression fails all three runs.
     cores = os.cpu_count() or 1
     bar = 10_000 if cores >= 4 else (4_000 if cores >= 2 else 3_000)
     rate = _run_load_once("loaddoc")
-    if rate < bar:
-        print(f"below the {bar} bar at {rate:,.0f} ops/s; retrying "
-              f"once to rule out a scheduler outlier")
-        rate = max(rate, _run_load_once("loaddoc2"))
-    assert rate >= bar, f"{rate:,.0f} ops/s below the {bar} bar (twice)"
+    for attempt in (2, 3):
+        if rate >= bar:
+            break
+        print(f"below the {bar} bar at {rate:,.0f} ops/s; retry "
+              f"{attempt - 1} to rule out a scheduler outlier")
+        rate = max(rate, _run_load_once(f"loaddoc{attempt}"))
+    assert rate >= bar, f"{rate:,.0f} ops/s below the {bar} bar (x3)"

@@ -46,6 +46,7 @@ PACKAGES = [
     "fluidframework_tpu.server.deli_kernel",
     "fluidframework_tpu.server.monitor",
     "fluidframework_tpu.server.riddler",
+    "fluidframework_tpu.server.shard_fabric",
     "fluidframework_tpu.server.supervisor",
     "fluidframework_tpu.framework",
     "fluidframework_tpu.parallel",

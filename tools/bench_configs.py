@@ -29,6 +29,11 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   op-log (`log_format="columnar"`, server.columnar_log) vs the same
   run over JSONL topics; FAILS LOUDLY if columnar ever drops below
   1x JSON (the codec must never lose to per-record json.dumps).
+- config 6: shard-fabric scaling guard — the same pipeline drained
+  through 4 parallel partition processes (server.shard_fabric
+  slicing, kernel deli over columnar topics) must reach >= 1.5x the
+  single-partition aggregate ops/s, bit-identity gated across
+  partitions; SKIPS LOUDLY on hosts with < 4 cores.
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -373,6 +378,52 @@ def config5_log_format(n_docs: int = 10_000, n_clients: int = 16,
         shutil.rmtree(scratch, ignore_errors=True)
 
 
+def config6_shard_scaling(n_docs: int = 2_048, n_clients: int = 8,
+                          ops_per_client: int = 2,
+                          min_ratio: float = 1.5,
+                          min_cores: int = 4) -> dict:
+    """Sharded-fabric scaling guard (server.shard_fabric): the config-5
+    pipeline drained through 4 parallel partition pipelines (one OS
+    process each, kernel deli over columnar topics) must reach at
+    least `min_ratio` x the single-partition aggregate ops/s,
+    bit-identity gated across partitions. FAILS LOUDLY on regression.
+
+    SKIPS LOUDLY on hosts with fewer than `min_cores` cores: four
+    partitions time-slicing two cores measures the scheduler, not the
+    fabric — the skip is explicit in the result so a CI host downgrade
+    can't silently retire the guard."""
+    from fluidframework_tpu.testing.deli_bench import run_shard_bench
+
+    cores = os.cpu_count() or 1
+    if cores < min_cores:
+        result = {
+            "config": "shard_fabric_scaling_guard",
+            "skipped": (
+                f"host has {cores} cores < {min_cores}: 4-partition "
+                f"scaling cannot be measured honestly here"
+            ),
+            "cores": cores, "min_ratio": min_ratio,
+        }
+        print(
+            f"SKIP config6_shard_scaling: {result['skipped']}",
+            file=sys.stderr,
+        )
+        return result
+    res = run_shard_bench(
+        n_docs=max(8, int(n_docs * SCALE)), n_clients=n_clients,
+        ops_per_client=ops_per_client, partitions=(1, 4),
+        deli_impl="kernel", log_format="columnar",
+    )
+    result = {"config": "shard_fabric_scaling_guard",
+              "min_ratio": min_ratio, **res}
+    assert res["speedup"] >= min_ratio, (
+        f"4-partition fabric reached only {res['speedup']:.2f}x the "
+        f"single-partition aggregate (must be >= {min_ratio}x) on a "
+        f"{cores}-core host: {result}"
+    )
+    return result
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -451,7 +502,7 @@ def main() -> None:
     for fn in (config1_sharedstring_2client, config3_matrix,
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
                config5_metrics_overhead, config5_log_format,
-               config_streaming_ingress):
+               config6_shard_scaling, config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

@@ -31,10 +31,20 @@ cadence comparison `ckpt_cadence` vs `ckpt_every_pump` (time/byte
 cadence vs the seed's every-step policy, counters from utils.metrics —
 ROADMAP item (b)).
 
-Env knobs: BD_DOCS (10000), BD_CLIENTS (64), BD_OPS (ops/client, 1),
-BD_SEED_RECORDS (400), BD_BATCH (8192), BD_SCALE (workload shrink).
+`--shard` switches to the SHARD-SCALING mode
+(`testing.deli_bench.run_shard_bench`, bench_configs
+`config6_shard_scaling`'s engine): the same workload drained through P
+parallel partition pipelines — one OS process per partition
+(`server.shard_fabric` slicing) — reporting aggregate ops/s per P and
+the P-vs-1 `speedup`, bit-identity gated across partitions. Shard env
+knobs: BD_PARTITIONS ("1,4"), BD_IMPL (kernel), BD_LOG_FORMAT
+(columnar).
 
-Usage: python tools/bench_deli.py
+Env knobs: BD_DOCS (10000; 2048 in shard mode), BD_CLIENTS (64; 8),
+BD_OPS (ops/client, 1; 2), BD_SEED_RECORDS (400), BD_BATCH (8192),
+BD_SCALE (workload shrink).
+
+Usage: python tools/bench_deli.py [--shard]
 """
 
 from __future__ import annotations
@@ -49,6 +59,9 @@ os.environ.setdefault(
     os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                  ".jax_cache"),
 )
+
+if "--shard" in sys.argv:
+    os.environ["BD_SHARD"] = "1"
 
 from fluidframework_tpu.testing.deli_bench import main  # noqa: E402
 

@@ -4,6 +4,17 @@ Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH]
+        [--partitions N] [--workers W]
+
+`--partitions N` (>1) runs the run against the SHARDED ordering fabric
+(server.shard_fabric): `--workers W` lease-balanced shard workers over
+N partition topic pairs; faults then target workers (kill) and
+partition leases (lease), and convergence compares the merged
+sequenced stream across every deltas-p{k} with the single-partition
+in-proc golden. The "net" fault class is single-partition only (the
+fabric runner has no socket consumer to dup/delay) — it drops out of
+the default fault set with --partitions >1 and is rejected loudly if
+named explicitly.
 
 `--log-format columnar` runs every farm topic as a binary record-batch
 log (server.columnar_log) instead of JSONL; the golden digest still
@@ -68,9 +79,17 @@ def main() -> int:
 
     seed = int(_take("--seed", "0"))
     metrics_out = _take("--metrics-out", None)
-    faults = tuple(
-        f for f in _take("--faults", ",".join(FAULT_CLASSES)).split(",") if f
-    )
+    faults_arg = _take("--faults", None)
+    n_partitions = int(_take("--partitions", "1"))
+    if faults_arg is None:
+        # Default fault set: everything the chosen runner supports.
+        # The sharded runner has no socket consumer, so "net" is only
+        # meaningful (and only accepted) single-partition; asking for
+        # it explicitly with --partitions >1 fails loudly in run_chaos.
+        default_faults = [f for f in FAULT_CLASSES
+                          if n_partitions == 1 or f != "net"]
+        faults_arg = ",".join(default_faults)
+    faults = tuple(f for f in faults_arg.split(",") if f)
     cfg = ChaosConfig(
         seed=seed,
         faults=faults,
@@ -82,6 +101,8 @@ def main() -> int:
         deli_impl=_take("--deli", "scalar"),
         log_format=_take("--log-format", "json"),
         boxcar_rate=float(_take("--boxcar-rate", "0")),
+        n_partitions=n_partitions,
+        n_workers=int(_take("--workers", "2")),
     )
     unknown = set(faults) - set(FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
@@ -94,10 +115,12 @@ def main() -> int:
             file=sys.stderr,
         )
         return 2
+    shard = (f" partitions={cfg.n_partitions} workers={cfg.n_workers}"
+             if cfg.n_partitions > 1 else "")
     print(f"chaos run: seed={seed} faults={','.join(faults)} "
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
           f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl} "
-          f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}",
+          f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}{shard}",
           flush=True)
     res = run_chaos(cfg)
     print(f"golden digest : {res.golden_digest}")

@@ -1,192 +1,71 @@
-"""Partitioned sequencer worker: one node of a multi-node ordering
-service.
+"""Partitioned sequencer worker: one node of the sharded ordering
+fabric (thin wrapper over `server.shard_fabric.ShardWorker`).
 
 Run: python tools/partition_worker_main.py <shared_dir> <worker_id>
         <n_partitions> [--ttl SECONDS] [--max-partitions K]
+        [--impl scalar|kernel] [--log-format json|columnar]
 
 Workers coordinate ONLY through the shared directory (the role Kafka +
 ZooKeeper play for routerlicious pods): each sweeps the partition
-leases (`server.queue.LeaseManager`), sequences submissions for the
-documents of every partition it owns (`server.sequencer
-.DocumentSequencer`, the deli role), appends the stamped messages to
-the partition's shared `sequenced` topic, and checkpoints
-(consumer offset + sequencer state, fenced against deposed owners)
-after every batch. Kill a worker mid-stream and a peer's next sweep
-takes its expired leases over, restores the checkpoint, and resumes
-exactly where the dead worker stopped — no message lost or
-double-sequenced (tests/test_partition_leases.py).
+leases toward its fair share (``ceil(N / alive_workers)``), runs one
+supervised deli role per owned partition (`rawdeltas-p{k}` →
+`deltas-p{k}`, fenced exactly-once recovery via the ``inOff`` scan),
+and heartbeats in ``<dir>/workers/``. Kill a worker mid-stream and a
+peer's next sweep takes its expired leases over, restores the fenced
+checkpoint, and resumes exactly where the dead worker stopped — no
+message lost or double-sequenced, and the deposed owner's in-flight
+writes are REJECTED at the write path (tests/test_partition_leases.py).
+
+Historical note: before the fabric existed this tool carried its own
+one-off worker (scalar `DocumentSequencer` over a bespoke
+``submissions-p{k}``/``sequenced-p{k}`` wire with consumer-side
+dedup); it now runs the production subsystem — kernel deli and
+columnar topics included — via ``--impl`` / ``--log-format``.
 
 Prints "READY <worker_id>" once leases are first swept.
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from fluidframework_tpu.server.queue import (  # noqa: E402
-    LeaseManager,
-    SharedFileConsumer,
-    SharedFileTopic,
+from fluidframework_tpu.server.shard_fabric import (  # noqa: E402
+    serve_shard_worker,
 )
-from fluidframework_tpu.protocol.messages import (  # noqa: E402
-    DocumentMessage,
-    NackMessage,
-)
-from fluidframework_tpu.server.sequencer import DocumentSequencer  # noqa: E402
-
-
-class PartitionWorker:
-    def __init__(self, shared_dir: str, worker_id: str,
-                 n_partitions: int, ttl_s: float = 2.0,
-                 max_partitions: int | None = None):
-        self.dir = shared_dir
-        self.worker_id = worker_id
-        self.n_partitions = n_partitions
-        self.max_partitions = max_partitions
-        self.leases = LeaseManager(
-            os.path.join(shared_dir, "leases"), worker_id, ttl_s
-        )
-        # partition -> (fence, consumer, {doc: DocumentSequencer})
-        self.owned: dict = {}
-
-    # ----------------------------------------------------- checkpoints
-
-    def _ckpt_path(self, p: int) -> str:
-        return os.path.join(self.dir, f"ckpt-p{p}.json")
-
-    def _load_checkpoint(self, p: int) -> dict:
-        try:
-            with open(self._ckpt_path(p)) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return {"offset": 0, "sequencers": {}, "fence": 0}
-
-    def _save_checkpoint(self, p: int, fence: int, offset: int,
-                         sequencers: dict) -> None:
-        cur = self._load_checkpoint(p)
-        if int(cur.get("fence", 0)) > fence:
-            raise RuntimeError("deposed: newer fence checkpointed")
-        tmp = self._ckpt_path(p) + f".tmp.{self.worker_id}"
-        with open(tmp, "w") as f:
-            json.dump({
-                "offset": offset, "fence": fence,
-                "sequencers": {
-                    d: s.checkpoint() for d, s in sequencers.items()
-                },
-            }, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._ckpt_path(p))
-
-    # ---------------------------------------------------------- sweep
-
-    def sweep_leases(self) -> None:
-        """Acquire unowned/expired partitions (bounded by
-        max_partitions), renew owned ones, drop deposed ones."""
-        for p in list(self.owned):
-            if not self.leases.renew(f"p{p}"):
-                del self.owned[p]  # deposed
-        for p in range(self.n_partitions):
-            if p in self.owned:
-                continue
-            if (self.max_partitions is not None
-                    and len(self.owned) >= self.max_partitions):
-                break
-            fence = self.leases.try_acquire(f"p{p}")
-            if fence is None:
-                continue
-            ck = self._load_checkpoint(p)
-            if int(ck.get("fence", 0)) > fence:
-                continue  # a newer owner exists; stand down
-            topic = SharedFileTopic(
-                os.path.join(self.dir, f"submissions-p{p}.jsonl")
-            )
-            consumer = SharedFileConsumer(topic, int(ck["offset"]))
-            seqs = {
-                d: DocumentSequencer.restore(s)
-                for d, s in ck.get("sequencers", {}).items()
-            }
-            self.owned[p] = (fence, consumer, seqs)
-
-    # ----------------------------------------------------------- work
-
-    def process_once(self, batch: int = 64) -> int:
-        """One pump over every owned partition; returns messages
-        processed."""
-        done = 0
-        for p, (fence, consumer, seqs) in list(self.owned.items()):
-            msgs = consumer.poll(batch)
-            if not msgs:
-                continue
-            out = SharedFileTopic(
-                os.path.join(self.dir, f"sequenced-p{p}.jsonl")
-            )
-            stamped = []
-            for m in msgs:
-                doc = m["docId"]
-                seq = seqs.get(doc)
-                if seq is None:
-                    seq = seqs[doc] = DocumentSequencer(doc)
-                if int(m["clientId"]) not in seq.clients:
-                    seq.join(int(m["clientId"]))
-                res = seq.sequence(
-                    int(m["clientId"]),
-                    DocumentMessage(
-                        client_seq=int(m["clientSeq"]),
-                        ref_seq=int(m["refSeq"]),
-                        contents=m.get("contents"),
-                    ),
-                )
-                nacked = isinstance(res, NackMessage)
-                stamped.append({
-                    "docId": doc, "worker": self.worker_id,
-                    "seq": None if nacked else res.sequence_number,
-                    "msn": None if nacked
-                    else res.minimum_sequence_number,
-                    "clientSeq": int(m["clientSeq"]),
-                    "clientId": int(m["clientId"]),
-                    "nack": res.code if nacked else None,
-                })
-            # Append THEN checkpoint (at-least-once on crash between
-            # the two; the test dedups by (doc, clientId, clientSeq) —
-            # the same replay-side idempotence Kafka consumers use).
-            # One batched append per pump: a per-record append is one
-            # lock+fsync EACH (the scalar-pipeline hot-path bug the
-            # deli lambdas also had).
-            out.append_many(stamped)
-            self._save_checkpoint(p, fence, consumer.offset, seqs)
-            done += len(msgs)
-        return done
 
 
 def main() -> None:
     args = [a for a in sys.argv[1:]]
-    ttl = 2.0
-    max_p = None
-    if "--ttl" in args:
-        i = args.index("--ttl")
-        ttl = float(args[i + 1])
-        del args[i:i + 2]
-    if "--max-partitions" in args:
-        i = args.index("--max-partitions")
-        max_p = int(args[i + 1])
-        del args[i:i + 2]
+
+    def _take(flag: str, default=None):
+        if flag in args:
+            i = args.index(flag)
+            val = args[i + 1]
+            del args[i:i + 2]
+            return val
+        return default
+
+    ttl = float(_take("--ttl", "2.0"))
+    max_p = _take("--max-partitions")
+    impl = _take("--impl") or os.environ.get("FLUID_DELI", "scalar")
+    log_format = _take("--log-format")
+    if len(args) != 3:
+        print(
+            "usage: python tools/partition_worker_main.py <shared_dir> "
+            "<worker_id> <n_partitions> [--ttl S] [--max-partitions K] "
+            "[--impl scalar|kernel] [--log-format json|columnar]",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
     shared_dir, worker_id, n_partitions = args[0], args[1], int(args[2])
-    w = PartitionWorker(shared_dir, worker_id, n_partitions, ttl, max_p)
-    w.sweep_leases()
-    print(f"READY {worker_id}", flush=True)
-    last_sweep = time.time()
-    while True:
-        if time.time() - last_sweep > ttl / 3:
-            w.sweep_leases()
-            last_sweep = time.time()
-        if w.process_once() == 0:
-            time.sleep(0.02)
+    serve_shard_worker(
+        shared_dir, worker_id, n_partitions=n_partitions, ttl_s=ttl,
+        max_partitions=int(max_p) if max_p else None, deli_impl=impl,
+        log_format=log_format,
+    )
 
 
 if __name__ == "__main__":

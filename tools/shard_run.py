@@ -1,0 +1,165 @@
+"""Sharded ordering fabric runner: the multi-partition kernel-deli
+farm end to end, from one command.
+
+Run: python tools/shard_run.py [--partitions N] [--workers W]
+        [--docs D] [--clients C] [--ops K] [--deli scalar|kernel]
+        [--log-format json|columnar] [--boxcar-rate R] [--ttl S]
+        [--timeout S] [--keep DIR] [--kill-worker I]
+
+Builds a seeded workload over partition-balanced doc names, starts
+`server.shard_fabric.ShardFabricSupervisor` (W supervised shard
+workers lease-balancing N partitions), routes the stream through
+`ShardRouter`, waits for the merged ``deltas-p{k}`` streams to drain,
+and reports aggregate throughput, final partition ownership, worker
+restarts, and a convergence verdict against the in-proc
+single-partition golden (exit 0 iff bit-identical with zero
+duplicate/skipped seqs).
+
+`--kill-worker I` SIGKILLs worker slot I once mid-stream — a live
+demonstration of fenced partition handoff (the supervisor restarts
+it; its partitions rebalance; the order must not notice).
+
+`--keep DIR` runs in DIR and leaves topics/leases/checkpoints/worker
+heartbeats behind for inspection (default: throwaway temp dir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fluidframework_tpu.server.shard_fabric import (  # noqa: E402
+    ShardFabricSupervisor,
+    ShardRouter,
+    spread_doc_names,
+)
+from fluidframework_tpu.server.supervisor import (  # noqa: E402
+    DELI_IMPLS,
+    LOG_FORMATS,
+)
+from fluidframework_tpu.testing.chaos import (  # noqa: E402
+    ChaosConfig,
+    build_workload,
+    golden_stream,
+    sequence_integrity,
+    stream_digest,
+)
+
+
+def main() -> int:
+    args = list(sys.argv[1:])
+
+    def _take(flag: str, default):
+        if flag in args:
+            i = args.index(flag)
+            val = args[i + 1]
+            del args[i:i + 2]
+            return val
+        return default
+
+    n_partitions = int(_take("--partitions", "4"))
+    n_workers = int(_take("--workers", "2"))
+    cfg = ChaosConfig(
+        seed=int(_take("--seed", "0")),
+        faults=(),
+        n_docs=int(_take("--docs", "8")),
+        n_clients=int(_take("--clients", "3")),
+        ops_per_client=int(_take("--ops", "40")),
+        boxcar_rate=float(_take("--boxcar-rate", "0")),
+        n_partitions=n_partitions,
+    )
+    deli = _take("--deli", "scalar")
+    log_format = _take("--log-format", "json")
+    ttl = float(_take("--ttl", "0.75"))
+    timeout = float(_take("--timeout", "120"))
+    keep = _take("--keep", None)
+    kill_worker = _take("--kill-worker", None)
+    if args or deli not in DELI_IMPLS or log_format not in LOG_FORMATS:
+        print(
+            f"leftover args {args}; --deli is one of "
+            f"{'|'.join(DELI_IMPLS)}; --log-format is one of "
+            f"{'|'.join(LOG_FORMATS)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    shared = keep or tempfile.mkdtemp(prefix="shard-run-")
+    workload = build_workload(cfg)
+    golden = golden_stream(workload, os.path.join(shared, "golden"))
+    gdigest = stream_digest(golden)
+    print(
+        f"shard run: partitions={n_partitions} workers={n_workers} "
+        f"deli={deli} log={log_format} docs={cfg.n_docs} "
+        f"records={len(workload)} dir={shared}", flush=True,
+    )
+    assert set(spread_doc_names(cfg.n_docs, n_partitions)) == {
+        r["doc"] for r in workload if isinstance(r, dict) and "doc" in r
+    }
+
+    router = ShardRouter(shared, n_partitions, log_format)
+    sup = ShardFabricSupervisor(
+        shared, n_workers=n_workers, n_partitions=n_partitions,
+        ttl_s=ttl, deli_impl=deli, log_format=log_format,
+    ).start()
+    killed = False
+    t0 = time.time()
+    try:
+        fed = 0
+        deadline = time.time() + timeout
+        ops = []
+        while time.time() < deadline:
+            sup.poll_once()
+            if fed < len(workload):
+                router.append(workload[fed:fed + 64])
+                fed += 64
+                if (kill_worker is not None and not killed
+                        and fed >= len(workload) // 2):
+                    slot = f"shard-w{int(kill_worker)}"
+                    proc = sup.procs.get(slot)
+                    if proc is not None and proc.poll() is None:
+                        proc.kill()
+                        killed = True
+                        print(f"SIGKILL {slot} mid-stream", flush=True)
+            ops = []
+            for t in router.deltas_topics():
+                ops += [r for r in t.read_from(0)
+                        if isinstance(r, dict) and r.get("kind") == "op"]
+            if fed >= len(workload) and len(ops) >= len(golden):
+                break
+            time.sleep(0.02)
+        elapsed = time.time() - t0
+    finally:
+        sup.stop()
+
+    digest = stream_digest(ops)
+    dups, skips = sequence_integrity(ops)
+    converged = digest == gdigest and dups == 0 and skips == 0
+    print(f"golden digest : {gdigest}")
+    print(f"fabric digest : {digest}")
+    print(f"ops           : {len(ops)}/{len(golden)} in {elapsed:.2f}s "
+          f"({len(ops) / max(elapsed, 1e-9):,.0f} ops/s aggregate)")
+    print(f"dup seqs={dups} skipped seqs={skips}")
+    print(f"partition owners: {sup.partition_owners()}")
+    print(f"worker restarts : {sup.restarts}")
+    print(json.dumps({
+        "metric": "shard_run", "partitions": n_partitions,
+        "workers": n_workers, "deli": deli, "log_format": log_format,
+        "records": len(workload), "ops": len(ops),
+        "seconds": round(elapsed, 3), "converged": converged,
+        "restarts": sup.restarts,
+    }))
+    print("CONVERGED" if converged else "DIVERGED")
+    if keep is None and converged:
+        import shutil
+
+        shutil.rmtree(shared, ignore_errors=True)
+    return 0 if converged else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
