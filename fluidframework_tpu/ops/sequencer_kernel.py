@@ -348,6 +348,61 @@ def _sequence_batch_grouped_jit(state, aborted, batch, groups, dedup):
     return _sequence_batch_impl(state, aborted, batch, groups, dedup)
 
 
+_SHARDED_FN_CACHE: dict = {}
+
+
+def sharded_sequence_fn(mesh, dedup: bool = False, axis: str = "docs"):
+    """Compile the grouped sequencer scan data-parallel over `mesh`.
+
+    Documents are embarrassingly parallel here — verdicts, boxcar
+    aborts, and resubmission dedup are all per-doc state — so the
+    whole `[D, C]` pool shards over a 1-D device mesh with
+    ``PartitionSpec(axis)`` on every per-doc array (state rows, the
+    `[D, B]` batch columns, the groups plane, the abort tracker) and
+    ZERO cross-device collectives inside the scan: each device runs
+    the identical vmap-over-local-docs / scan-over-B body on its slice
+    of the doc axis. `D` must be a multiple of ``mesh.size`` (the pool
+    keeps it so). Returns a jitted
+    ``fn(state, aborted, batch, groups) -> (state', aborted', SeqResult)``
+    with the same donation contract as `sequence_batch_grouped`; the
+    caller threads `aborted'` across a pump's chunks exactly as in the
+    single-device path, so boxcar groups may still span chunks.
+
+    Compiled callables cache process-wide per (mesh, dedup, axis) —
+    paired with `parallel.mesh.shared_docs_mesh`, every pool/bench in
+    a process shares one jit cache instead of re-tracing per instance.
+    """
+    key = (mesh, bool(dedup), axis)
+    cached = _SHARDED_FN_CACHE.get(key)
+    if cached is not None:
+        return cached
+    from ..utils.jax_compat import shard_map_compat
+
+    docs = jax.sharding.PartitionSpec(axis)
+    state_specs = SequencerState(
+        seq=docs, min_seq=docs, connected=docs, ref_seq=docs,
+        client_seq=docs,
+    )
+    batch_specs = SeqBatch(
+        kind=docs, client=docs, client_seq=docs, ref_seq=docs,
+    )
+    res_specs = SeqResult(seq=docs, min_seq=docs, nack=docs, skipped=docs)
+
+    def local(state, aborted, batch, groups):
+        return _sequence_batch_impl(state, aborted, batch, groups, dedup)
+
+    fn = shard_map_compat(
+        local,
+        mesh=mesh,
+        in_specs=(state_specs, docs, batch_specs, docs),
+        out_specs=(state_specs, docs, res_specs),
+        check=False,
+    )
+    jitted = jax.jit(fn, donate_argnums=(0, 1))
+    _SHARDED_FN_CACHE[key] = jitted
+    return jitted
+
+
 def sequence_batch_grouped(state: SequencerState, batch: SeqBatch, groups,
                            dedup: bool = False, aborted=None):
     """Jitted entry for the live deli pipeline: boxcar groups + optional

@@ -13,6 +13,7 @@ from .mesh import (
     docs_sharding,
     make_docs_mesh,
     replicate_sharding,
+    shared_docs_mesh,
     sharded_overlay_replay,
     sharded_overlay_replay_multi,
     sharded_pipeline_step,
@@ -23,6 +24,7 @@ from .seqshard_ref import SeqShardedOverlay
 
 __all__ = [
     "make_docs_mesh",
+    "shared_docs_mesh",
     "docs_sharding",
     "replicate_sharding",
     "shard_tables",

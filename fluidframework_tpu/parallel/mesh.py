@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.mergetree_kernel import OpBatch, SegmentTable, apply_op_batch
+from ..utils.jax_compat import shard_map_compat
 
 
 def make_docs_mesh(n_devices: Optional[int] = None, axis: str = "docs") -> Mesh:
@@ -48,6 +49,23 @@ def make_docs_mesh(n_devices: Optional[int] = None, axis: str = "docs") -> Mesh:
                 )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (axis,))
+
+
+_MESH_CACHE: dict = {}
+
+
+def shared_docs_mesh(n_devices: Optional[int] = None,
+                     axis: str = "docs") -> Mesh:
+    """The process-wide cached form of `make_docs_mesh`: every caller
+    asking for the same (n_devices, axis) shares ONE Mesh object, so
+    jit caches keyed on the mesh hit across pools/benches instead of
+    re-tracing per instance (and repeated bench runs in one process
+    pay compilation once)."""
+    key = (n_devices, axis)
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = _MESH_CACHE[key] = make_docs_mesh(n_devices, axis)
+    return mesh
 
 
 def docs_sharding(mesh: Mesh, axis: str = "docs") -> NamedSharding:
@@ -90,8 +108,6 @@ def sharded_overlay_replay_multi(
     Same signature/returns as `sharded_overlay_replay`; the leading
     axis may be any multiple of ``mesh.size``.
     """
-    from jax import shard_map
-
     from ..ops.overlay_pallas import OverlayTable, replay_fused
 
     docs = P(axis)
@@ -121,12 +137,12 @@ def sharded_overlay_replay_multi(
         client=docs, buf_start=docs, ins_len=docs, prop_keys=docs,
         prop_vals=docs,
     )
-    step = shard_map(
+    step = shard_map_compat(
         local_replay,
         mesh=mesh,
         in_specs=(table_specs, op_specs, docs, docs, docs),
         out_specs=(table_specs, docs, docs, docs, P(), P()),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(step)
 

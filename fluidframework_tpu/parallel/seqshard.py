@@ -48,6 +48,7 @@ from ..ops.mergetree_kernel import (
 )
 from ..ops.overlay_ref import SETTLED_BASE
 from ..protocol.constants import NO_CLIENT
+from ..utils.jax_compat import shard_map_compat
 
 
 class ShardState(NamedTuple):
@@ -440,14 +441,12 @@ def sequence_sharded_replay(mesh: Mesh, capacity: int, n_removers: int,
         iclient=P(axis), rseq=P(axis), rcl=P(axis), props=P(axis),
         n=P(axis), S=P(axis), error=P(axis),
     )
-    from jax import shard_map
-
-    fn = shard_map(
+    fn = shard_map_compat(
         local_replay,
         mesh=mesh,
         in_specs=(shard_specs, P()),
         out_specs=(shard_specs, P()),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(fn)
 

@@ -100,6 +100,25 @@ def _pow2(n: int, lo: int = 8) -> int:
     return p
 
 
+def _mul_of(n: int, m: int) -> int:
+    """n rounded UP to a multiple of m (the doc-axis shard constraint:
+    every device owns the same number of slot rows)."""
+    return n if m <= 1 else ((n + m - 1) // m) * m
+
+
+def mesh_for_devices(deli_devices: Optional[int]):
+    """The device mesh a `deli_devices=N` seam resolves to: None for
+    the single-device pool (N absent / 1), else the process-wide
+    shared 1-D docs mesh over N devices (falling back to forced-host
+    virtual CPU devices exactly as `parallel.mesh.make_docs_mesh`
+    does, and raising loudly when N devices simply do not exist)."""
+    if deli_devices is None or int(deli_devices) <= 1:
+        return None
+    from ..parallel.mesh import shared_docs_mesh
+
+    return shared_docs_mesh(int(deli_devices))
+
+
 def _nack_reason(code: int, ref: int, msn: int, head: int, cseq: int,
                  expected: Optional[int]) -> str:
     """The scalar sequencer's nack wording (shared helpers in
@@ -129,10 +148,23 @@ class SeqPool:
     """
 
     def __init__(self, n_docs: int = 8, n_clients: int = 8,
-                 max_resident: Optional[int] = None):
-        self.n_docs = max(1, n_docs)
+                 max_resident: Optional[int] = None, mesh=None):
+        """`mesh` (a 1-D `jax.sharding.Mesh` over a ``docs`` axis, see
+        `parallel.mesh.make_docs_mesh`/`shared_docs_mesh`) shards the
+        `[D, C]` pool across its devices: `n_docs` is kept a multiple
+        of ``mesh.size`` (every device owns an equal slab of slot
+        rows), the kernel call is the shard_map'd
+        `ops.sequencer_kernel.sharded_sequence_fn`, and verdicts
+        gather once per chunk. The host mirror, slot allocation,
+        grow/evict/park, and checkpoint format are IDENTICAL to the
+        single-device pool — sharding only changes where slot rows
+        live."""
+        self.mesh = mesh
+        self._n_shards = int(mesh.size) if mesh is not None else 1
+        self.n_docs = _mul_of(max(1, n_docs), self._n_shards)
         self.n_clients = _pow2(max(2, n_clients), lo=2)
         self.state = _sk.make_state(self.n_docs, self.n_clients)
+        self._placed = False  # host-side state edits re-place lazily
         self.max_resident = max_resident
         # doc_id -> {"slot": int|None, "seq", "min_seq",
         #            "clients": {cid: [ref_seq, client_seq]}, "t": lru}
@@ -239,7 +271,7 @@ class SeqPool:
                 )
         if not self.free:
             old = self.n_docs
-            self.n_docs = max(8, old * 2)
+            self.n_docs = _mul_of(max(8, old * 2), self._n_shards)
             self.free.extend(range(self.n_docs - 1, old - 1, -1))
             self._m_grows.inc()
         return self.free.pop()
@@ -308,9 +340,22 @@ class SeqPool:
 
     # -------------------------------------------------------- device ops
 
+    def _place(self, state):
+        """Lay every per-doc array out across the mesh (leading docs
+        axis sharded, everything else replicated per row)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        sh = NamedSharding(self.mesh, PartitionSpec("docs"))
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, sh), state
+        )
+
     def prepare(self) -> None:
-        """Grow the packed state to the logical (D, C) and flush queued
-        doc-row loads in one batched scatter."""
+        """Grow the packed state to the logical (D, C), flush queued
+        doc-row loads in one batched scatter, and (sharded pools)
+        re-place the result across the mesh — the kernel's in/out
+        specs then keep it sharded between pumps for free."""
         import jax.numpy as jnp
 
         need_c = _pow2(self._need_clients, self.n_clients)
@@ -318,7 +363,11 @@ class SeqPool:
         if self.n_docs != d or need_c != c:
             self.state = _sk.grow_state(self.state, self.n_docs, need_c)
             self.n_clients = need_c
+            self._placed = False
         if not self._loads:
+            if self.mesh is not None and not self._placed:
+                self.state = self._place(self.state)
+                self._placed = True
             return
         n, C = len(self._loads), self.n_clients
         idx = np.empty(n, np.int32)
@@ -346,11 +395,19 @@ class SeqPool:
             ref_seq=self.state.ref_seq.at[jidx].set(jnp.asarray(ref)),
             client_seq=self.state.client_seq.at[jidx].set(jnp.asarray(cseq)),
         )
+        if self.mesh is not None:
+            # The host-side scatter loses the docs layout; re-place
+            # before the next kernel call (one batched transfer).
+            self.state = self._place(self.state)
+            self._placed = True
 
     def run_chunk(self, kind, client, cseq, ref, groups, dedup: bool,
                   aborted=None):
         """One device call; `aborted` threads the boxcar-abort tracker
-        across a pump's chunks. Returns (SeqResult as numpy, tracker)."""
+        across a pump's chunks. Returns (SeqResult as numpy, tracker).
+        Sharded pools run the shard_map'd kernel — same abort/dedup
+        semantics, doc rows resident on their owning device — and the
+        verdict gather is the single device_get below."""
         import jax
         import jax.numpy as jnp
 
@@ -360,9 +417,15 @@ class SeqPool:
             kind=jnp.asarray(kind), client=jnp.asarray(client),
             client_seq=jnp.asarray(cseq), ref_seq=jnp.asarray(ref),
         )
-        self.state, aborted, res = _sk.sequence_batch_grouped(
-            self.state, batch, jnp.asarray(groups), dedup, aborted
-        )
+        if self.mesh is not None:
+            fn = _sk.sharded_sequence_fn(self.mesh, dedup=bool(dedup))
+            self.state, aborted, res = fn(
+                self.state, aborted, batch, jnp.asarray(groups)
+            )
+        else:
+            self.state, aborted, res = _sk.sequence_batch_grouped(
+                self.state, batch, jnp.asarray(groups), dedup, aborted
+            )
         return jax.device_get(res), aborted
 
     # ---------------------------------------------------- verdict mirror
@@ -461,8 +524,8 @@ class PackedDeliCore:
 
     def __init__(self, n_docs: int = 8, n_clients: int = 8,
                  max_resident: Optional[int] = None, max_cols: int = 256,
-                 dedup: bool = False):
-        self.pool = SeqPool(n_docs, n_clients, max_resident)
+                 dedup: bool = False, mesh=None):
+        self.pool = SeqPool(n_docs, n_clients, max_resident, mesh=mesh)
         self.max_cols = max(8, max_cols)
         self.dedup = dedup
         # Submissions accumulate as ORDERED segments: lists of
@@ -492,6 +555,7 @@ class PackedDeliCore:
         self._m_slots = m.gauge("deli_pool_doc_slots")
         self._m_fill = m.gauge("deli_pool_fill_ratio")
         self._m_cols = m.gauge("deli_pool_client_cols")
+        self._m_devices = m.gauge("deli_pool_devices")
 
     def begin(self) -> None:
         self.pool.begin()
@@ -604,6 +668,7 @@ class PackedDeliCore:
         self._m_slots.set(pool.n_docs)
         self._m_fill.set(resident / pool.n_docs if pool.n_docs else 0.0)
         self._m_cols.set(pool.n_clients)
+        self._m_devices.set(pool._n_shards)
         return _FlatResults(
             seq_o.tolist(), msn_o.tolist(), nack_o.tolist(), skip_o.tolist()
         )
@@ -625,11 +690,17 @@ class KernelDeliLambda:
     def __init__(self, log: MessageLog, checkpoint: Optional[dict] = None,
                  max_pump: int = 8192, n_docs: int = 8, n_clients: int = 8,
                  max_resident: Optional[int] = None, max_cols: int = 256,
-                 raw_topic: str = "rawdeltas"):
+                 raw_topic: str = "rawdeltas",
+                 deli_devices: Optional[int] = None):
         """`raw_topic` names the ingress topic (the sharded
-        LocalServer's per-partition ``rawdeltas-p{k}`` form)."""
+        LocalServer's per-partition ``rawdeltas-p{k}`` form).
+        `deli_devices=N` shards the doc-slot pool across an N-device
+        mesh (`LocalServer(deli_devices=N)` passes it through); the
+        checkpoint shape is topology-free, so restores interop across
+        scalar ⇄ single-device ⇄ sharded freely."""
         self.core = PackedDeliCore(
-            n_docs, n_clients, max_resident, max_cols, dedup=False
+            n_docs, n_clients, max_resident, max_cols, dedup=False,
+            mesh=mesh_for_devices(deli_devices),
         )
         offset = 0
         if checkpoint:
@@ -807,9 +878,19 @@ class KernelDeliRole(_Role):
     out_topic_name = "deltas"
     ingest_batches = True  # _Role.step feeds RecordBatch frames whole
 
-    def __init__(self, *a, **kw):
+    def __init__(self, *a, mesh=None, deli_devices: Optional[int] = None,
+                 **kw):
+        """`mesh` (a ready 1-D docs mesh) or `deli_devices=N` (resolved
+        via the process-wide shared mesh) shards the pool across
+        devices; the wire records, `inOff` recovery contract and
+        checkpoint format are identical either way, so the fenced
+        exactly-once machinery and the shard fabric compose unchanged
+        — a fabric partition worker may run each partition's deli over
+        its own device slice."""
         super().__init__(*a, **kw)
-        self.core = PackedDeliCore(dedup=True)
+        self.mesh = mesh if mesh is not None else \
+            mesh_for_devices(deli_devices)
+        self.core = PackedDeliCore(dedup=True, mesh=self.mesh)
         self._pending: List[tuple] = []  # ("rec", off, dict) |
         #                                 ("cols", start_off, RecordBatch)
         # Blob pass-through is only legal when the output topic can
@@ -828,7 +909,7 @@ class KernelDeliRole(_Role):
         return self.core.pool.checkpoint_docs()
 
     def restore_state(self, state: Any) -> None:
-        core = PackedDeliCore(dedup=True)
+        core = PackedDeliCore(dedup=True, mesh=self.mesh)
         core.pool.restore_docs(state)
         self.core = core
 

@@ -564,6 +564,7 @@ class LocalServer:
         deli_impl: Optional[str] = None,
         log_format: Optional[str] = None,
         n_partitions: int = 1,
+        deli_devices: Optional[int] = None,
     ):
         """Restart contract: pass the previous instance's `log` (the
         durable substrate, as Kafka retains topics across lambda
@@ -587,6 +588,13 @@ class LocalServer:
         `protocol.record_batch`); env ``FLUID_LOG_FORMAT`` sets the
         default. Replay reads both, so a restart may switch formats
         over the same persist_dir mid-journal.
+
+        `deli_devices` (kernel impl only) shards the kernel deli's
+        `[D, C]` doc-slot pool across an N-device mesh
+        (`server.deli_kernel` over `parallel.mesh` — one doc slab per
+        device inside a single compiled sequencer call). Checkpoints
+        stay in the `DocumentSequencer` shape, so scalar ⇄ kernel ⇄
+        sharded restores interop; a restart may change N freely.
 
         `n_partitions` shards the ordering stage in-proc (the
         `server.shard_fabric` slicing, LocalOrderer-sized): ingress
@@ -650,17 +658,32 @@ class LocalServer:
         self.n_partitions = int(n_partitions)
         if self.n_partitions < 1:
             raise ValueError(f"n_partitions must be >= 1: {n_partitions}")
+        self.deli_devices = (
+            int(deli_devices) if deli_devices is not None else None
+        )
+        deli_kw = {}
+        if self.deli_devices is not None and self.deli_devices > 1:
+            if self.deli_impl != "kernel":
+                # Loud: a scalar server silently ignoring the device
+                # axis would invalidate any scaling claim made of it.
+                raise ValueError(
+                    f"deli_devices={self.deli_devices} needs "
+                    f"deli_impl='kernel' (the scalar deli has no "
+                    f"device axis); got {self.deli_impl!r}"
+                )
+            deli_kw["deli_devices"] = self.deli_devices
         if self.deli_impl == "kernel":
             from .deli_kernel import KernelDeliLambda as _deli_cls
         else:
             _deli_cls = DeliLambda
         if self.n_partitions == 1:
-            self.delis = [_deli_cls(self.log, cp.get("deli"))]
+            self.delis = [_deli_cls(self.log, cp.get("deli"), **deli_kw)]
         else:
             self.delis = [
                 _deli_cls(self.log,
                           cp.get(partition_suffix("deli", k)),
-                          raw_topic=partition_suffix("rawdeltas", k))
+                          raw_topic=partition_suffix("rawdeltas", k),
+                          **deli_kw)
                 for k in range(self.n_partitions)
             ]
         # Back-compat alias: single-partition callers (and tests) keep

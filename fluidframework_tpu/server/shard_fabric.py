@@ -209,7 +209,8 @@ class ShardWorker:
                  batch: int = 512, max_partitions: Optional[int] = None,
                  ckpt_interval_s: float = 0.25,
                  ckpt_bytes: int = 256 * 1024, ckpt_duty: float = 0.2,
-                 worker_ttl_s: Optional[float] = None):
+                 worker_ttl_s: Optional[float] = None,
+                 deli_devices: Optional[int] = None):
         self.shared_dir = shared_dir
         self.slot = slot
         self.owner = owner or slot
@@ -218,6 +219,19 @@ class ShardWorker:
         if self.deli_impl not in DELI_IMPLS:
             raise ValueError(
                 f"deli_impl {self.deli_impl!r} not in {DELI_IMPLS}"
+            )
+        # Multi-device deli per partition: every owned partition's
+        # kernel role shards its pool over the same process-wide
+        # N-device mesh — "one partition = one worker process" and
+        # "one doc slab = one device" compose, they don't compete.
+        self.deli_devices = (
+            int(deli_devices) if deli_devices is not None else None
+        )
+        if self.deli_devices is not None and self.deli_devices > 1 \
+                and self.deli_impl != "kernel":
+            raise ValueError(
+                f"deli_devices={self.deli_devices} needs "
+                f"deli_impl='kernel'; got {self.deli_impl!r}"
             )
         self.log_format = default_log_format(log_format)
         self.ttl_s = ttl_s
@@ -314,11 +328,14 @@ class ShardWorker:
         cls = partitioned_role_class(
             resolve_role_class("deli", self.deli_impl), partition
         )
+        kw = {}
+        if self.deli_devices is not None and self.deli_devices > 1:
+            kw["deli_devices"] = self.deli_devices
         role = cls(
             self.shared_dir, self.owner, ttl_s=self.ttl_s,
             batch=self.batch, ckpt_interval_s=self.ckpt_interval_s,
             ckpt_bytes=self.ckpt_bytes, log_format=self.log_format,
-            ckpt_duty=self.ckpt_duty,
+            ckpt_duty=self.ckpt_duty, **kw,
         )
         # The WORKER heartbeat (whole-registry snapshot, throttled) is
         # the fabric's liveness/metrics channel; per-partition role
@@ -484,6 +501,8 @@ class ShardFabricSupervisor(ServiceSupervisor):
             cmd += ["--max-partitions", str(self.max_partitions)]
         if self.worker_ttl_s is not None:
             cmd += ["--worker-ttl", str(self.worker_ttl_s)]
+        if self.deli_devices is not None:
+            cmd += ["--deli-devices", str(self.deli_devices)]
         return cmd
 
     def _hb_file(self, role: str) -> str:
@@ -543,16 +562,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     ckpt_duty = float(_take("--ckpt-duty", "0.2"))
     max_p = _take("--max-partitions")
     worker_ttl = _take("--worker-ttl")
+    devices_s = _take("--deli-devices")
     if (shared_dir is None or slot is None or args
             or impl not in DELI_IMPLS
-            or (log_format is not None and log_format not in LOG_FORMATS)):
+            or (log_format is not None and log_format not in LOG_FORMATS)
+            or (devices_s is not None and not devices_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.shard_fabric "
             "--dir D --slot S [--owner O] [--partitions N] [--ttl S] "
             "[--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--max-partitions K] "
-            "[--worker-ttl S] [--ckpt-interval S] [--ckpt-bytes N] "
-            "[--ckpt-duty F]",
+            "[--worker-ttl S] [--deli-devices N] [--ckpt-interval S] "
+            "[--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
         raise SystemExit(2)
@@ -563,6 +584,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         ckpt_interval_s=ckpt_interval, ckpt_bytes=ckpt_bytes,
         ckpt_duty=ckpt_duty,
         worker_ttl_s=float(worker_ttl) if worker_ttl else None,
+        deli_devices=int(devices_s) if devices_s else None,
     )
 
 

@@ -753,19 +753,31 @@ def serve_role(shared_dir: str, role: str, owner: str,
                ckpt_bytes: int = 256 * 1024,
                log_format: Optional[str] = None,
                ckpt_duty: float = 0.2,
-               partition: Optional[int] = None) -> None:
+               partition: Optional[int] = None,
+               deli_devices: Optional[int] = None) -> None:
     """Child-process entry: run one role until killed/deposed/fenced.
     With `partition`, the role serves that partition's topic pair under
     its partition-suffixed lease (one pinned shard of the fabric —
     `shard_fabric.ShardWorker` is the lease-balanced multi-partition
-    form)."""
+    form). `deli_devices=N` shards the kernel deli's doc-slot pool
+    across an N-device mesh (`--deli-devices`; kernel impl only —
+    the scalar deli has no device axis, so asking is a config error)."""
+    if deli_devices is not None and deli_devices > 1 and (
+            role != "deli" or deli_impl != "kernel"):
+        raise ValueError(
+            f"deli_devices={deli_devices} needs role=deli with "
+            f"deli_impl='kernel' (got role={role!r}, impl={deli_impl!r})"
+        )
     cls = resolve_role_class(role, deli_impl)
     if partition is not None:
         cls = partitioned_role_class(cls, partition)
+    kw = {}
+    if deli_devices is not None and deli_devices > 1:
+        kw["deli_devices"] = deli_devices
     r = cls(
         shared_dir, owner, ttl_s=ttl_s, batch=batch,
         ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
-        log_format=log_format, ckpt_duty=ckpt_duty,
+        log_format=log_format, ckpt_duty=ckpt_duty, **kw,
     )
     print(f"READY {r.name} {owner}", flush=True)
     while True:
@@ -804,7 +816,8 @@ class ServiceSupervisor:
                  ckpt_interval_s: float = 0.25,
                  ckpt_bytes: int = 256 * 1024,
                  log_format: Optional[str] = None,
-                 ckpt_duty: float = 0.2):
+                 ckpt_duty: float = 0.2,
+                 deli_devices: Optional[int] = None):
         self.shared_dir = shared_dir
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
@@ -818,6 +831,20 @@ class ServiceSupervisor:
         if self.deli_impl not in DELI_IMPLS:
             raise ValueError(
                 f"deli_impl {self.deli_impl!r} not in {DELI_IMPLS}"
+            )
+        # Multi-device deli: shard the kernel deli's [D, C] pool over
+        # N devices. Children run under JAX_PLATFORMS=cpu, so the
+        # spawn env also forces N virtual host devices — the CPU-CI
+        # emulation of a real N-chip slice (utils.devices).
+        self.deli_devices = (
+            int(deli_devices) if deli_devices is not None else None
+        )
+        if self.deli_devices is not None and self.deli_devices > 1 \
+                and self.deli_impl != "kernel":
+            raise ValueError(
+                f"deli_devices={self.deli_devices} needs "
+                f"deli_impl='kernel' (the scalar deli has no device "
+                f"axis); got {self.deli_impl!r}"
             )
         self.python = python or sys.executable
         self.spawn_ready_timeout_s = spawn_ready_timeout_s
@@ -851,22 +878,37 @@ class ServiceSupervisor:
         -c instead of -m: `-m pkg.mod` would import the package first
         and runpy then re-executes the module as __main__
         (RuntimeWarning + double module state)."""
-        return [self.python, "-c",
-                "from fluidframework_tpu.server.supervisor import main; "
-                "main()",
-                "--role", role, "--dir", self.shared_dir,
-                "--owner", owner, "--ttl", str(self.ttl_s),
-                "--batch", str(self.batch),
-                "--impl", self.deli_impl,
-                "--log-format", self.log_format,
-                "--ckpt-interval", str(self.ckpt_interval_s),
-                "--ckpt-bytes", str(self.ckpt_bytes),
-                "--ckpt-duty", str(self.ckpt_duty)]
+        cmd = [self.python, "-c",
+               "from fluidframework_tpu.server.supervisor import main; "
+               "main()",
+               "--role", role, "--dir", self.shared_dir,
+               "--owner", owner, "--ttl", str(self.ttl_s),
+               "--batch", str(self.batch),
+               "--impl", self.deli_impl,
+               "--log-format", self.log_format,
+               "--ckpt-interval", str(self.ckpt_interval_s),
+               "--ckpt-bytes", str(self.ckpt_bytes),
+               "--ckpt-duty", str(self.ckpt_duty)]
+        if self.deli_devices is not None and role == "deli":
+            cmd += ["--deli-devices", str(self.deli_devices)]
+        return cmd
 
     def _hb_file(self, role: str) -> str:
         """Where `role`'s liveness heartbeat lives (subclass seam: the
         shard fabric heartbeats per WORKER, not per role)."""
         return os.path.join(self.shared_dir, "hb", f"{role}.json")
+
+    def _child_env(self) -> Dict[str, str]:
+        """Child spawn environment. Children always run JAX on cpu;
+        with a multi-device deli, the CPU backend is split into
+        `deli_devices` virtual host devices so the sharded pool has a
+        mesh to land on (the XLA flag only acts before the first jax
+        import — exactly why it rides the spawn env)."""
+        if self.deli_devices is not None and self.deli_devices > 1:
+            from ..utils.devices import forced_host_device_env
+
+            return forced_host_device_env(self.deli_devices)
+        return dict(os.environ, JAX_PLATFORMS="cpu")
 
     def _spawn(self, role: str) -> Optional[subprocess.Popen]:
         """Spawn one role child; returns None (and records the event)
@@ -883,7 +925,7 @@ class ServiceSupervisor:
                 self._child_cmd(role, owner),
                 stdout=subprocess.PIPE, text=True,
                 cwd=self._repo_root(),
-                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                env=self._child_env(),
             )
         except OSError as exc:
             self.procs[role] = None
@@ -1145,15 +1187,18 @@ def main(argv: Optional[List[str]] = None) -> None:
     ckpt_bytes = int(_take("--ckpt-bytes", str(256 * 1024)))
     ckpt_duty = float(_take("--ckpt-duty", "0.2"))
     partition_s = _take("--partition")
+    devices_s = _take("--deli-devices")
     if (role not in ROLE_CLASSES or shared_dir is None
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
-            or (partition_s is not None and not partition_s.isdigit())):
+            or (partition_s is not None and not partition_s.isdigit())
+            or (devices_s is not None and not devices_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster} --dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "
+            "[--deli-devices N] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -1162,7 +1207,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                deli_impl=impl, ckpt_interval_s=ckpt_interval,
                ckpt_bytes=ckpt_bytes, log_format=log_format,
                ckpt_duty=ckpt_duty,
-               partition=int(partition_s) if partition_s else None)
+               partition=int(partition_s) if partition_s else None,
+               deli_devices=int(devices_s) if devices_s else None)
 
 
 if __name__ == "__main__":

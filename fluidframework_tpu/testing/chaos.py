@@ -101,6 +101,13 @@ class ChaosConfig:
     # in-proc golden.
     n_partitions: int = 1
     n_workers: int = 2
+    # Multi-device deli (kernel impl only): shard the kernel deli's
+    # [D, C] doc-slot pool across N devices (forced virtual host
+    # devices in the child processes — the CPU-CI emulation of an
+    # N-chip slice). Golden still folds single-device in-proc, so a
+    # converging run proves the SHARDED kernel bit-identical to the
+    # single-device stream under the same faults.
+    deli_devices: Optional[int] = None
 
 
 @dataclass
@@ -312,6 +319,15 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
             "(no socket consumer in the fabric runner); drop it from "
             "faults or run single-partition"
         )
+    if cfg.deli_devices is not None and cfg.deli_devices > 1 \
+            and cfg.deli_impl != "kernel":
+        # Loud, before any scratch state exists: a scalar farm has no
+        # device axis, and silently running it would print a sharded
+        # convergence verdict that exercised nothing.
+        raise ValueError(
+            f"deli_devices={cfg.deli_devices} needs deli_impl='kernel'"
+            f"; got {cfg.deli_impl!r}"
+        )
     shared = cfg.shared_dir or tempfile.mkdtemp(prefix="chaos-")
     runner = _run_chaos_sharded if cfg.n_partitions > 1 else _run_chaos_in
     res = runner(cfg, shared)
@@ -387,6 +403,7 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         shared, ttl_s=cfg.ttl_s,
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
         deli_impl=cfg.deli_impl, log_format=cfg.log_format,
+        deli_devices=cfg.deli_devices,
     ).start()
     raw = make_topic(os.path.join(shared, "topics", "rawdeltas.jsonl"),
                      cfg.log_format)
@@ -539,7 +556,7 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         shared, n_workers=cfg.n_workers, n_partitions=cfg.n_partitions,
         ttl_s=cfg.ttl_s, heartbeat_timeout_s=cfg.heartbeat_timeout_s,
         batch=cfg.batch, deli_impl=cfg.deli_impl,
-        log_format=cfg.log_format,
+        log_format=cfg.log_format, deli_devices=cfg.deli_devices,
     ).start()
     router = ShardRouter(shared, cfg.n_partitions, cfg.log_format)
     fence_rejections = 0

@@ -71,12 +71,14 @@ def build_pipeline_workload(n_docs: int, n_clients: int,
     return recs
 
 
-def _make_role(impl: str, scratch: str, log_format: str = "json"):
+def _make_role(impl: str, scratch: str, log_format: str = "json",
+               deli_devices: Optional[int] = None):
     if impl == "kernel":
         from ..server.deli_kernel import KernelDeliRole
 
         return KernelDeliRole(scratch, owner=f"bench-{impl}",
-                              ttl_s=3600.0, log_format=log_format)
+                              ttl_s=3600.0, log_format=log_format,
+                              deli_devices=deli_devices)
     from ..server.supervisor import DeliRole
 
     return DeliRole(scratch, owner=f"bench-{impl}", ttl_s=3600.0,
@@ -87,7 +89,8 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
                  batch: int = 8192, per_record_append: bool = False,
                  max_records: Optional[int] = None,
                  checkpoint_mode: Optional[str] = "cadence",
-                 log_format: str = "json") -> dict:
+                 log_format: str = "json",
+                 deli_devices: Optional[int] = None) -> dict:
     """Drive one deli variant raw-topic-in → deltas-topic-out.
 
     `checkpoint_mode` selects the farm's checkpoint policy inside the
@@ -122,7 +125,7 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
     prev_reg = _metrics.set_registry(reg)
     try:
         role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"),
-                          log_format)
+                          log_format, deli_devices)
     finally:
         _metrics.set_registry(prev_reg)
     # The bench drives the role datapath directly (no lease loop);
@@ -219,7 +222,8 @@ def _read_canonical(path: str) -> List[dict]:
 def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
                        ops_per_client: int = 1, seed_records: int = 400,
                        batch: int = 16384, work_dir: Optional[str] = None,
-                       keep: bool = False) -> dict:
+                       keep: bool = False,
+                       deli_devices: Optional[int] = None) -> dict:
     """The full comparison: build the workload once, gate kernel vs
     batched-scalar for bit-identity, time all three variants, and
     report the standard one-line JSON fields."""
@@ -250,14 +254,18 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
         # Kernel warm-up (the standard bench contract: the timed region
         # never compiles — one untimed full run compiles every jit
         # shape the real run uses; the scalar path has nothing to
-        # compile and gets no warm-up).
-        run_pipeline("kernel", raw_path, scratch, batch=batch)
-        kern = run_pipeline("kernel", raw_path, scratch, batch=batch)
+        # compile and gets no warm-up). `deli_devices` shards the
+        # kernel runs' doc pool across a device mesh.
+        run_pipeline("kernel", raw_path, scratch, batch=batch,
+                     deli_devices=deli_devices)
+        kern = run_pipeline("kernel", raw_path, scratch, batch=batch,
+                            deli_devices=deli_devices)
         scal = run_pipeline("scalar", raw_path, scratch, batch=batch)
         # The columnar op-log twins (ROADMAP (a)): identical records,
         # binary record-batch topics on both ends.
         kern_col = run_pipeline("kernel", raw_col_path, scratch,
-                                batch=batch, log_format="columnar")
+                                batch=batch, log_format="columnar",
+                                deli_devices=deli_devices)
         scal_col = run_pipeline("scalar", raw_col_path, scratch,
                                 batch=batch, log_format="columnar")
 
@@ -278,7 +286,8 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
         # seed's every-step checkpoint policy — the checkpoint
         # counters show the cadence win (writes/bytes collapse).
         kern_every = run_pipeline("kernel", raw_path, scratch,
-                                  batch=batch, checkpoint_mode="pump")
+                                  batch=batch, checkpoint_mode="pump",
+                                  deli_devices=deli_devices)
 
         seed_run = run_pipeline(
             "scalar", raw_path, scratch, batch=batch,
@@ -295,6 +304,7 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
         return {
             "metric": "deli_pipeline_raw_to_deltas",
             "docs": n_docs, "clients_per_doc": n_clients,
+            "n_devices": int(deli_devices or 1),
             "records": len(workload), "stamped": kern["outputs"],
             "ops_per_sec": round(kernel_ops, 1),
             "scalar_batched_ops_per_sec": round(scalar_ops, 1),
@@ -330,21 +340,229 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
 
 
 # ---------------------------------------------------------------------------
+# multi-device scaling bench (config7_multichip's engine)
+# ---------------------------------------------------------------------------
+
+
+def _multichip_workload(n_docs: int, ops_per_doc: int, n_clients: int):
+    """Deterministic [D, B] kernel submissions, identical for every
+    device count (the bit-identity gate compares verdict digests
+    across topologies, so the workload must not depend on N): clients
+    1..C pre-admitted, per-client FIFO clientSeq, a sprinkle of
+    unknown-client ops (client 0 = never admitted) so the nack path is
+    inside the digest too."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    client = rng.integers(1, n_clients + 1,
+                          (n_docs, ops_per_doc)).astype(np.int32)
+    # ~2% unknown-client submissions -> deterministic nacks.
+    client[rng.random((n_docs, ops_per_doc)) < 0.02] = 0
+    kind = np.full((n_docs, ops_per_doc), 0, np.int32)  # SUB_OP
+    cseq = np.zeros((n_docs, ops_per_doc), np.int32)
+    counts = np.zeros((n_docs, n_clients + 1), np.int32)
+    rows = np.arange(n_docs)
+    for j in range(ops_per_doc):
+        c = client[:, j]
+        counts[rows, c] += 1
+        cseq[:, j] = counts[rows, c]
+    ref = np.zeros((n_docs, ops_per_doc), np.int32)
+    return kind, client, cseq, ref
+
+
+def _multichip_child_main() -> None:
+    """Subprocess entry for one device count (the XLA forced-host flag
+    only acts before the first jax import — hence one process per N):
+    compile untimed (warm-up cost reported as `warmup_s`), then run
+    `repeats` timed passes of the full [D, B] sequencer batch over the
+    N-device mesh and report one DONE json line with the verdict
+    digest the parent gates bit-identity on. The mesh and compiled
+    kernel are the PROCESS-WIDE shared objects
+    (`parallel.mesh.shared_docs_mesh` +
+    `sequencer_kernel.sharded_sequence_fn`'s cache), so every repeat
+    reuses one mesh/device set."""
+    import sys
+
+    n_devices = int(sys.argv[1])
+    n_docs, ops_per_doc, n_clients, repeats = (
+        int(a) for a in sys.argv[2:6]
+    )
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops import sequencer_kernel as _sk
+
+    kind, client, cseq, ref = _multichip_workload(
+        n_docs, ops_per_doc, n_clients
+    )
+    from ..server.deli_kernel import _pow2
+
+    groups = np.full((n_docs, ops_per_doc), _sk.NO_GROUP, np.int32)
+    admitted = np.zeros((n_docs, _pow2(n_clients + 1, lo=2)), bool)
+    admitted[:, 1:n_clients + 1] = True
+
+    if n_devices > 1:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..parallel.mesh import shared_docs_mesh
+
+        mesh = shared_docs_mesh(n_devices)
+        sh = NamedSharding(mesh, PartitionSpec("docs"))
+        fn = _sk.sharded_sequence_fn(mesh)
+
+        def place(state):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, sh), state
+            )
+    else:
+        fn = None
+
+        def place(state):
+            return state
+
+    batch = _sk.SeqBatch(
+        kind=jnp.asarray(kind), client=jnp.asarray(client),
+        client_seq=jnp.asarray(cseq), ref_seq=jnp.asarray(ref),
+    )
+    jgroups = jnp.asarray(groups)
+
+    def one_pass():
+        state = place(_sk.make_state(
+            n_docs, admitted.shape[1]
+        )._replace(connected=jnp.asarray(admitted)))
+        if fn is not None:
+            state, _, res = fn(
+                state, _sk.no_aborts(n_docs), batch, jgroups
+            )
+        else:
+            state, _, res = _sk.sequence_batch_grouped(
+                state, batch, jgroups
+            )
+        jax.block_until_ready(res.seq)
+        return res
+
+    t0 = time.perf_counter()
+    res = one_pass()  # compile + first run, untimed
+    warmup_s = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        res = one_pass()
+        best = min(best, time.perf_counter() - t0)
+    h = hashlib.sha256()
+    for a in (res.seq, res.min_seq, res.nack):
+        h.update(np.ascontiguousarray(jax.device_get(a)).tobytes())
+    ops = n_docs * ops_per_doc
+    print("DONE " + json.dumps({
+        "n_devices": n_devices,
+        "platform": jax.devices()[0].platform,
+        "visible_devices": len(jax.devices()),
+        "seconds": round(best, 6),
+        "warmup_s": round(warmup_s, 4),
+        "ops": ops,
+        "ops_per_sec": round(ops / best, 1),
+        "digest": h.hexdigest(),
+    }), flush=True)
+
+
+def run_multichip_bench(devices: Tuple[int, ...] = (1, 4, 8),
+                        n_docs: int = 4096, ops_per_doc: int = 64,
+                        n_clients: int = 8, repeats: int = 3) -> dict:
+    """Aggregate sequencer ops/s across device counts, bit-identity
+    gated: the SAME [D, B] workload is sequenced under every N in
+    `devices` (one subprocess per N — real accelerator devices when
+    the host has them, otherwise N forced virtual host CPU devices,
+    `utils.devices`), and every topology's verdict digest must equal
+    the single-device one before any number is reported.
+
+    The report carries per-N `warmup_s` (compile + first pass — the
+    cost each fresh process pays before the mesh/kernel caches make
+    repeats free) and `forced_host` so a reader can tell real-chip
+    scaling from the CPU-CI correctness fallback. Scaling judgment
+    lives in `tools/bench_configs.config7_multichip`, which skips the
+    ratio assert LOUDLY when `utils.devices.parity_skip_reason` says
+    the host cannot measure it honestly."""
+    import math
+
+    from ..server.deli_kernel import _mul_of
+    from ..utils.devices import run_forced_host_subprocess, \
+        visible_devices
+
+    # Every child shards the doc axis over its own device count, and
+    # the digest gate compares verdicts across ALL of them — so round
+    # the doc count ONCE to a multiple of every requested N (the lcm),
+    # not per child, or a non-divisible count crashes the device_put
+    # and a per-N round would un-compare the workloads.
+    n_docs = _mul_of(n_docs, math.lcm(*(int(n) for n in devices)))
+    platform, available = visible_devices()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    code = ("from fluidframework_tpu.testing.deli_bench import "
+            "_multichip_child_main; _multichip_child_main()")
+    runs: List[dict] = []
+    for n in devices:
+        forced = platform in ("cpu", "none") or available < n
+        res = run_forced_host_subprocess(
+            code, n, cwd=repo,
+            argv=[str(n), str(n_docs), str(ops_per_doc),
+                  str(n_clients), str(repeats)],
+            env=None if forced else dict(os.environ),
+        )
+        done = [l for l in res.stdout.splitlines()
+                if l.startswith("DONE ")]
+        assert done, res.stdout[-800:]
+        child = json.loads(done[0][5:])
+        child["forced_host"] = forced
+        runs.append(child)
+    # Correctness gate: every topology computed the identical stream.
+    digests = {r["digest"] for r in runs}
+    assert len(digests) == 1, (
+        f"sequencer verdicts diverge across device counts: "
+        f"{[(r['n_devices'], r['digest'][:16]) for r in runs]}"
+    )
+    by_n = {r["n_devices"]: r for r in runs}
+    base = min(by_n)
+    peak = max(by_n)
+    return {
+        "metric": "deli_multichip_scaling",
+        "docs": n_docs, "ops_per_doc": ops_per_doc,
+        "clients_per_doc": n_clients,
+        "n_devices": peak,
+        "runs": runs,
+        "speedup": round(
+            by_n[peak]["ops_per_sec"] / by_n[base]["ops_per_sec"], 2
+        ),
+        "speedup_axis": f"{peak}_vs_{base}_devices",
+        "cores": os.cpu_count(),
+        "gate": "bit-identical across device counts",
+        "unit": "submissions/s",
+    }
+
+
+# ---------------------------------------------------------------------------
 # sharded-fabric scaling bench (config6_shard_scaling's engine)
 # ---------------------------------------------------------------------------
 
 
 def _shard_child_main() -> None:
     """Subprocess entry for one bench shard: warm up untimed (imports +
-    jit compile), announce READY, wait for the go-file barrier, then
+    jit compile — the cost reported as `warmup_s`, what a fresh
+    process pays before the process-wide mesh/jit caches make further
+    runs free), announce READY, wait for the go-file barrier, then
     run the timed partition drain and report one DONE json line."""
     import sys
 
     raw_path, out_dir, impl, log_format, batch_s, go_path = sys.argv[1:7]
     warm_dir = os.path.join(out_dir, "warm")
     os.makedirs(warm_dir, exist_ok=True)
+    t0 = time.perf_counter()
     run_pipeline(impl, raw_path, warm_dir, batch=int(batch_s),
                  log_format=log_format)
+    warmup_s = time.perf_counter() - t0
     print("READY", flush=True)
     while not os.path.exists(go_path):
         time.sleep(0.005)
@@ -353,6 +571,7 @@ def _shard_child_main() -> None:
     print("DONE " + json.dumps({
         "seconds": res["seconds"], "records": res["records"],
         "outputs": res["outputs"], "out_path": res["out_path"],
+        "warmup_s": round(warmup_s, 4),
     }), flush=True)
 
 
@@ -479,6 +698,15 @@ def run_shard_bench(n_docs: int = 2048, n_clients: int = 8,
                 "aggregate_ops_per_sec": round(total / wall, 1),
                 "slowest_partition_s": round(wall, 4),
                 "per_partition_records": [c["records"] for c in children],
+                # Warm-up cost per shard child (imports + jit compile,
+                # untimed behind the READY barrier): each subprocess
+                # re-initializes JAX — one process-wide mesh/jit-cache
+                # reuse only helps WITHIN a child (warm run + timed run
+                # share it); this notes what the per-process split
+                # still costs.
+                "warmup_s_per_partition": [
+                    c.get("warmup_s") for c in children
+                ],
             }
         base = min(partitions)
         peak = max(partitions)
@@ -503,6 +731,24 @@ def run_shard_bench(n_docs: int = 2048, n_clients: int = 8,
 
 def main() -> None:  # CLI twin: tools/bench_deli.py
     scale = float(os.environ.get("BD_SCALE", "1.0"))
+    if os.environ.get("BD_DEVICES"):
+        # Multi-device scaling mode (tools/bench_deli.py --devices):
+        # aggregate sequencer ops/s per device count, bit-identity
+        # gated across topologies. BD_DEVICES is a comma list of
+        # device counts (default "1,4,8").
+        devs = tuple(
+            int(d) for d in os.environ["BD_DEVICES"].split(",") if d
+        )
+        res = run_multichip_bench(
+            devices=devs or (1, 4, 8),
+            n_docs=max(8, int(int(os.environ.get("BD_DOCS", "4096"))
+                              * scale)),
+            ops_per_doc=int(os.environ.get("BD_OPS_PER_DOC", "64")),
+            n_clients=int(os.environ.get("BD_CLIENTS", "8")),
+            repeats=int(os.environ.get("BD_REPEATS", "3")),
+        )
+        print(json.dumps(res))
+        return
     if os.environ.get("BD_SHARD"):
         # Shard-scaling mode (tools/bench_deli.py --shard): aggregate
         # ops/s of the P-partition fabric vs single-partition, gated

@@ -1,0 +1,112 @@
+"""CPU-CI device emulation: run multi-device code on hosts without a
+multi-chip accelerator.
+
+XLA's ``--xla_force_host_platform_device_count=N`` splits the host CPU
+backend into N virtual devices — the project's standard way to compile
+and CORRECTNESS-check mesh-sharded code (conftest.py forces 8 for the
+test process; `__graft_entry__.dryrun_multichip` re-execs itself with
+the flag). The flag only takes effect before the first jax import, so
+anything that needs a specific count mid-process must subprocess: the
+helpers here build that environment and spawn the child.
+
+Emulation is honest about what it can measure: virtual devices
+time-slicing fewer physical cores exercise correctness (bit-identity
+across topologies) but NOT aggregate throughput scaling —
+`parity_skip_reason` renders the loud-skip text benches and tests must
+surface instead of printing a scheduler benchmark as a scaling number.
+
+No jax import at module level: scalar processes pay nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "forced_host_device_env",
+    "parity_skip_reason",
+    "run_forced_host_subprocess",
+    "visible_devices",
+]
+
+
+def forced_host_device_env(n_devices: int,
+                           base: Optional[Dict[str, str]] = None
+                           ) -> Dict[str, str]:
+    """A child-process environment with N virtual host CPU devices:
+    os.environ (or `base`) with any previous force flag replaced and
+    the platform pinned to cpu (the forced count exists only there)."""
+    env = dict(os.environ if base is None else base)
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(
+        f"--xla_force_host_platform_device_count={int(n_devices)}"
+    )
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def visible_devices() -> Tuple[str, int]:
+    """(platform, count) of the default jax backend in THIS process.
+    Imports jax (and initializes the backend) — call only where that
+    is already paid for."""
+    import jax
+
+    devs = jax.devices()
+    return (devs[0].platform if devs else "none", len(devs))
+
+
+def parity_skip_reason(n_devices: int) -> Optional[str]:
+    """None when aggregate throughput scaling at `n_devices` can be
+    measured honestly on this host; else the loud-skip reason.
+
+    Honest means the devices are real accelerator chips, or virtual
+    host devices with at least one physical core each — N virtual
+    devices time-slicing fewer cores measure the OS scheduler, not
+    the sharding."""
+    platform, count = visible_devices()
+    if platform not in ("cpu", "none") and count >= n_devices:
+        return None
+    cores = os.cpu_count() or 1
+    if cores >= n_devices:
+        return None
+    return (
+        f"host has {cores} cores and no {n_devices}-device "
+        f"accelerator ({count} {platform} visible): {n_devices} "
+        f"forced-host devices would time-slice the cores and measure "
+        f"the scheduler, not multi-device scaling"
+    )
+
+
+def run_forced_host_subprocess(
+    code: str, n_devices: int, timeout_s: float = 900.0,
+    cwd: Optional[str] = None, argv: Optional[List[str]] = None,
+    env: Optional[Dict[str, str]] = None,
+) -> subprocess.CompletedProcess:
+    """Run ``python -c code [argv...]`` under N forced virtual host
+    devices (the flag must precede the first jax import, hence the
+    subprocess). Raises RuntimeError with both streams on a non-zero
+    exit — a silently failed emulation child must not look like an
+    empty result.
+
+    `env` overrides the spawn environment verbatim (a caller on a
+    real N-chip host wants the child un-forced but the same
+    spawn/loud-failure contract); default is the forced-host env."""
+    res = subprocess.run(
+        [sys.executable, "-c", code] + list(argv or []),
+        env=forced_host_device_env(n_devices) if env is None else env,
+        capture_output=True, text=True, timeout=timeout_s, cwd=cwd,
+    )
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"forced-host-device subprocess failed "
+            f"(rc={res.returncode}, n_devices={n_devices})\n"
+            f"stdout:\n{res.stdout}\nstderr:\n{res.stderr}"
+        )
+    return res

@@ -1,0 +1,54 @@
+"""Version-compat seams for the narrow slice of jax API this repo
+uses across jax releases.
+
+`shard_map` moved twice upstream: old releases expose it only as
+`jax.experimental.shard_map.shard_map` (replication-check kwarg
+`check_rep`), newer ones promote it to `jax.shard_map` and rename the
+kwarg to `check_vma`. The seed imported the promoted name on an older
+runtime and every multi-chip path died on the ImportError
+(tests/test_seqshard.py / tests/test_multichip.py — the one seed
+capability never reproduced). `shard_map_compat` resolves whichever
+spelling the installed jax provides, once, and maps the check kwarg to
+the name that version understands.
+
+jax is imported lazily so importing this module stays free for
+scalar-only processes (the supervisor's rule for server modules).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional
+
+_SHARD_MAP = None  # resolved once per process
+_CHECK_KWARG: Optional[str] = None
+
+
+def resolve_shard_map():
+    """The installed jax's `shard_map` callable plus the name of its
+    replication/vma check kwarg (None when the version has neither).
+    Raises ImportError only if NO known spelling exists."""
+    global _SHARD_MAP, _CHECK_KWARG
+    if _SHARD_MAP is None:
+        try:
+            from jax import shard_map as sm  # jax >= 0.6 promoted name
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+        _SHARD_MAP = sm
+        params = inspect.signature(sm).parameters
+        for name in ("check_vma", "check_rep"):
+            if name in params:
+                _CHECK_KWARG = name
+                break
+    return _SHARD_MAP, _CHECK_KWARG
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check: bool = False,
+                     **kw: Any):
+    """`shard_map(f, mesh, in_specs, out_specs)` under any supported
+    jax: `check` feeds `check_vma` (new) or `check_rep` (old),
+    whichever the installed version accepts."""
+    sm, check_kwarg = resolve_shard_map()
+    if check_kwarg is not None:
+        kw.setdefault(check_kwarg, check)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)

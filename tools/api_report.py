@@ -54,6 +54,7 @@ PACKAGES = [
     "fluidframework_tpu.protocol.record_batch",
     "fluidframework_tpu.testing",
     "fluidframework_tpu.utils",
+    "fluidframework_tpu.utils.devices",
     "fluidframework_tpu.utils.metrics",
 ]
 

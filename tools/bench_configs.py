@@ -34,6 +34,13 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   slicing, kernel deli over columnar topics) must reach >= 1.5x the
   single-partition aggregate ops/s, bit-identity gated across
   partitions; SKIPS LOUDLY on hosts with < 4 cores.
+- config 7: multi-device deli scaling guard — the sharded sequencer
+  kernel (shard_map over a docs mesh, server.deli_kernel seam) must
+  reach >= 2x single-device aggregate submissions/s at 4 devices
+  with a near-linear trend to 8, bit-identity gated across every
+  device count; the SCALING assert skips loudly where only
+  forced-host virtual devices over fewer cores are available (the
+  correctness gate still runs there).
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -424,6 +431,63 @@ def config6_shard_scaling(n_docs: int = 2_048, n_clients: int = 8,
     return result
 
 
+def config7_multichip(min_ratio: float = 2.0,
+                      min_trend_8v4: float = 1.5,
+                      devices: tuple = (1, 4, 8)) -> dict:
+    """Multi-device deli scaling guard (ROADMAP open item 1): the
+    sharded sequencer kernel (`ops.sequencer_kernel.sharded_sequence_fn`
+    over a `parallel.mesh` docs mesh) must reach >= `min_ratio` x the
+    single-device aggregate submissions/s at 4 devices and keep a
+    near-linear trend to 8 (8-device >= `min_trend_8v4` x 4-device).
+    FAILS LOUDLY on regression.
+
+    The CORRECTNESS gate always runs: every device count sequences the
+    identical workload and the verdict digests must match bit for bit
+    (run_multichip_bench asserts it even on the forced-host fallback).
+    The SCALING assert skips LOUDLY when the host cannot measure it
+    honestly (utils.devices.parity_skip_reason: no 4-device
+    accelerator and fewer than 4 cores — forced virtual host devices
+    time-slicing 2 cores measure the scheduler); the skip is explicit
+    in the result so a CI host downgrade can't silently retire the
+    guard."""
+    from fluidframework_tpu.testing.deli_bench import run_multichip_bench
+    from fluidframework_tpu.utils.devices import parity_skip_reason
+
+    res = run_multichip_bench(
+        devices=devices,
+        n_docs=max(8, int(4096 * SCALE)),
+        ops_per_doc=64, n_clients=8, repeats=REPEATS,
+    )
+    result = {"config": "deli_multichip_scaling_guard",
+              "min_ratio": min_ratio,
+              "min_trend_8v4": min_trend_8v4, **res}
+    reason = parity_skip_reason(4)
+    if reason is not None:
+        result["skipped"] = (
+            f"scaling assert skipped ({reason}); correctness gate ran: "
+            f"{res['gate']}"
+        )
+        print(f"SKIP config7_multichip scaling assert: {reason}",
+              file=sys.stderr)
+        return result
+    by_n = {r["n_devices"]: r for r in res["runs"]}
+    r4 = by_n[4]["ops_per_sec"] / by_n[1]["ops_per_sec"]
+    result["speedup_4_vs_1"] = round(r4, 2)
+    assert r4 >= min_ratio, (
+        f"4-device sharded sequencer reached only {r4:.2f}x the "
+        f"single-device aggregate (must be >= {min_ratio}x): {result}"
+    )
+    if 8 in by_n:
+        r8v4 = by_n[8]["ops_per_sec"] / by_n[4]["ops_per_sec"]
+        result["speedup_8_vs_4"] = round(r8v4, 2)
+        assert r8v4 >= min_trend_8v4, (
+            f"8-device trend broke near-linear: {r8v4:.2f}x the "
+            f"4-device aggregate (must be >= {min_trend_8v4}x): "
+            f"{result}"
+        )
+    return result
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -502,7 +566,8 @@ def main() -> None:
     for fn in (config1_sharedstring_2client, config3_matrix,
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
                config5_metrics_overhead, config5_log_format,
-               config6_shard_scaling, config_streaming_ingress):
+               config6_shard_scaling, config7_multichip,
+               config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

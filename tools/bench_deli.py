@@ -40,11 +40,21 @@ the P-vs-1 `speedup`, bit-identity gated across partitions. Shard env
 knobs: BD_PARTITIONS ("1,4"), BD_IMPL (kernel), BD_LOG_FORMAT
 (columnar).
 
-Env knobs: BD_DOCS (10000; 2048 in shard mode), BD_CLIENTS (64; 8),
-BD_OPS (ops/client, 1; 2), BD_SEED_RECORDS (400), BD_BATCH (8192),
-BD_SCALE (workload shrink).
+`--devices [1,4,8]` switches to the MULTI-DEVICE scaling mode
+(`testing.deli_bench.run_multichip_bench`, bench_configs
+`config7_multichip`'s engine): the same [D, B] submission workload is
+sequenced by the sharded kernel under each device count (one
+subprocess per N so the forced-host-device flag can act; real chips
+are used when the host has them), reporting aggregate submissions/s,
+per-N `warmup_s`/`forced_host`, `n_devices`, and the peak-vs-base
+`speedup` — gated bit-identical across every topology. Env knobs:
+BD_DEVICES ("1,4,8"), BD_OPS_PER_DOC (64), BD_REPEATS (3).
 
-Usage: python tools/bench_deli.py [--shard]
+Env knobs: BD_DOCS (10000; 2048 in shard mode; 4096 in devices mode),
+BD_CLIENTS (64; 8), BD_OPS (ops/client, 1; 2), BD_SEED_RECORDS (400),
+BD_BATCH (8192), BD_SCALE (workload shrink).
+
+Usage: python tools/bench_deli.py [--shard | --devices [LIST]]
 """
 
 from __future__ import annotations
@@ -62,6 +72,19 @@ os.environ.setdefault(
 
 if "--shard" in sys.argv:
     os.environ["BD_SHARD"] = "1"
+
+if "--devices" in sys.argv:
+    # Multi-device scaling mode: `--devices [1,4,8]` measures the
+    # SHARDED sequencer kernel's aggregate ops/s per device count
+    # (one subprocess per N — real chips when present, forced virtual
+    # host CPU devices otherwise), bit-identity gated across
+    # topologies, reporting per-N warmup_s and the peak-vs-base
+    # speedup. See testing.deli_bench.run_multichip_bench.
+    i = sys.argv.index("--devices")
+    arg = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
+    os.environ["BD_DEVICES"] = (
+        arg if arg and not arg.startswith("-") else "1,4,8"
+    )
 
 from fluidframework_tpu.testing.deli_bench import main  # noqa: E402
 

@@ -4,7 +4,14 @@ Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH]
-        [--partitions N] [--workers W]
+        [--partitions N] [--workers W] [--devices N]
+
+`--devices N` (with `--deli kernel`) shards the kernel deli's doc-slot
+pool across an N-device mesh inside the deli child (forced virtual
+host CPU devices — the CPU-CI emulation of an N-chip slice). The
+golden digest still folds single-device in-proc, so a converging run
+proves the SHARDED sequencer carries the bit-identical stream under
+the same faults.
 
 `--partitions N` (>1) runs the run against the SHARDED ordering fabric
 (server.shard_fabric): `--workers W` lease-balanced shard workers over
@@ -103,6 +110,9 @@ def main() -> int:
         boxcar_rate=float(_take("--boxcar-rate", "0")),
         n_partitions=n_partitions,
         n_workers=int(_take("--workers", "2")),
+        deli_devices=(lambda v: int(v) if v else None)(
+            _take("--devices", None)
+        ),
     )
     unknown = set(faults) - set(FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
@@ -117,10 +127,13 @@ def main() -> int:
         return 2
     shard = (f" partitions={cfg.n_partitions} workers={cfg.n_workers}"
              if cfg.n_partitions > 1 else "")
+    dev = (f" devices={cfg.deli_devices}"
+           if cfg.deli_devices and cfg.deli_devices > 1 else "")
     print(f"chaos run: seed={seed} faults={','.join(faults)} "
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
           f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl} "
-          f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}{shard}",
+          f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}"
+          f"{shard}{dev}",
           flush=True)
     res = run_chaos(cfg)
     print(f"golden digest : {res.golden_digest}")
