@@ -60,7 +60,7 @@ from ..protocol.record_batch import (
     encode_batch,
     iter_units,
 )
-from .queue import SharedFileTopic, TailReader
+from .queue import SharedFileTopic, TailReader, check_disk_fault
 
 __all__ = [
     "ColumnarFileTopic",
@@ -177,6 +177,7 @@ class ColumnarFileTopic(SharedFileTopic):
                 cur_fence, cur_owner = self.latest_fence()
                 frame = encode_batch(messages, fence=cur_fence,
                                      owner=cur_owner)
+                check_disk_fault("topic")
                 f.seek(clean)
                 f.write(frame)
                 f.flush()

@@ -351,11 +351,58 @@ class SeqPool:
             lambda a: jax.device_put(a, sh), state
         )
 
+    def _scatter_rows_placed(self, idx, updates) -> bool:
+        """Scoped re-place (PR-6 follow-up (b)): scatter the loaded
+        rows into an ALREADY-PLACED pool per shard, rebuilding only
+        the device slabs that own a touched row and reusing every
+        other shard's buffer as-is (`make_array_from_single_device_
+        arrays` keeps untouched buffers by identity — nothing is
+        re-transferred). Returns False when the layout doesn't allow
+        it (not placed yet, or a shard's rows aren't host-addressable)
+        and the caller falls back to the full `_place`."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        rows = self.n_docs // self._n_shards
+        by_shard: Dict[int, List[int]] = {}
+        for i, slot in enumerate(idx):
+            by_shard.setdefault(int(slot) // rows, []).append(i)
+        sh = NamedSharding(self.mesh, PartitionSpec("docs"))
+        new_fields = {}
+        for name, vals in updates.items():
+            arr = getattr(self.state, name)
+            try:
+                shards = list(arr.addressable_shards)
+            except AttributeError:
+                return False  # host array: not placed yet
+            if len(shards) != self._n_shards:
+                return False  # partial addressability: full re-place
+            parts = []
+            for s in shards:
+                row0 = (s.index[0].start or 0) if s.index else 0
+                sel = by_shard.get(row0 // rows)
+                if not sel:
+                    parts.append(s.data)  # reused by identity
+                    continue
+                local = np.array(s.data)  # pull ONE shard, not the pool
+                for i in sel:
+                    local[int(idx[i]) - row0] = vals[i]
+                parts.append(jax.device_put(local, s.device))
+            new_fields[name] = jax.make_array_from_single_device_arrays(
+                arr.shape, sh, parts
+            )
+        self.state = self.state._replace(**new_fields)
+        return True
+
     def prepare(self) -> None:
         """Grow the packed state to the logical (D, C), flush queued
         doc-row loads in one batched scatter, and (sharded pools)
-        re-place the result across the mesh — the kernel's in/out
-        specs then keep it sharded between pumps for free."""
+        place the result across the mesh — the kernel's in/out specs
+        then keep it sharded between pumps for free. An already-placed
+        pool takes the SCOPED scatter path: only the shards owning a
+        grown/restored row are rebuilt, the rest keep their buffers
+        (growth still re-places everything — a new shape means new
+        buffers no matter what)."""
         import jax.numpy as jnp
 
         need_c = _pow2(self._need_clients, self.n_clients)
@@ -387,6 +434,11 @@ class SeqPool:
                 ref[i, col] = r
                 cseq[i, col] = cs
         self._loads = []
+        updates = {"seq": seqv, "min_seq": minv, "connected": conn,
+                   "ref_seq": ref, "client_seq": cseq}
+        if (self.mesh is not None and self._placed
+                and self._scatter_rows_placed(idx, updates)):
+            return
         jidx = jnp.asarray(idx)
         self.state = self.state._replace(
             seq=self.state.seq.at[jidx].set(jnp.asarray(seqv)),
@@ -704,8 +756,12 @@ class KernelDeliLambda:
         )
         offset = 0
         if checkpoint:
+            from .supervisor import unwrap_ranged_state
+
             offset = checkpoint["offset"]
-            self.core.pool.restore_docs(checkpoint["docs"])
+            self.core.pool.restore_docs(
+                unwrap_ranged_state(checkpoint["docs"])
+            )
         self.consumer = LogConsumer(log.topic(raw_topic), offset)
         self.deltas = log.topic("deltas")
         self.max_pump = max_pump
@@ -909,8 +965,10 @@ class KernelDeliRole(_Role):
         return self.core.pool.checkpoint_docs()
 
     def restore_state(self, state: Any) -> None:
+        from .supervisor import unwrap_ranged_state
+
         core = PackedDeliCore(dedup=True, mesh=self.mesh)
-        core.pool.restore_docs(state)
+        core.pool.restore_docs(unwrap_ranged_state(state))
         self.core = core
 
     # ------------------------------------------------------------- pump

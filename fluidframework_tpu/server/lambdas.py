@@ -80,8 +80,14 @@ class DeliLambda:
         self.max_pump = max_pump
         offset = 0
         if checkpoint:
+            from .supervisor import unwrap_ranged_state
+
             offset = checkpoint["offset"]
-            for doc_id, state in checkpoint["docs"].items():
+            # Tolerate the elastic fabric's ranged checkpoint envelope
+            # (doc map + predecessor cursors): the doc states restore
+            # identically on every frontend.
+            docs = unwrap_ranged_state(checkpoint["docs"])
+            for doc_id, state in (docs or {}).items():
                 self.sequencers[doc_id] = DocumentSequencer.restore(state)
         self.consumer = LogConsumer(log.topic(raw_topic), offset)
         self.deltas = log.topic("deltas")

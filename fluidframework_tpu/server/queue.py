@@ -25,10 +25,13 @@ proves the survivor takes over its partitions exactly-once.
 from __future__ import annotations
 
 import contextlib
+import errno
 import json
 import os
+import random
 import time
-from typing import Any, Dict, Iterator, List, Optional, Protocol, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, \
+    Protocol, Tuple
 
 
 class FencedError(RuntimeError):
@@ -83,6 +86,73 @@ def flock_exclusive(f, lock_timeout_s: Optional[float],
         yield
     finally:
         fcntl.flock(f.fileno(), fcntl.LOCK_UN)
+
+
+# ---------------------------------------------------------------------------
+# storage-fault seam (the chaos harness's disk fault class)
+# ---------------------------------------------------------------------------
+
+# Path of a JSON fault-spec file; when set, every durable write path
+# (topic append, checkpoint save) consults it right before fsync. The
+# chaos harness points CHILD processes at a spec it toggles mid-run:
+#   {"mode": "enospc"}                -> the write raises OSError(ENOSPC)
+#   {"mode": "stall", "stall_s": S}   -> the fsync stalls S seconds
+#   optional "kinds": ["topic", ...]  -> restrict to those write paths
+# Unset (production) the check is a single dict lookup.
+DISK_FAULT_ENV = "FLUID_DISK_FAULT"
+
+
+def check_disk_fault(kind: str) -> None:
+    """Injection point for the storage failure classes a real deli farm
+    meets (volume full, device write stall): called with the write
+    about to go durable, so an injected ENOSPC aborts BEFORE bytes land
+    — exactly where the real error surfaces — and a stall sits where a
+    slow fsync would."""
+    spec_path = os.environ.get(DISK_FAULT_ENV)
+    if not spec_path:
+        return
+    try:
+        with open(spec_path) as f:
+            spec = json.load(f)
+    except (OSError, ValueError):
+        return  # no/garbled spec: no fault
+    if not isinstance(spec, dict):
+        return
+    kinds = spec.get("kinds")
+    if kinds and kind not in kinds:
+        return
+    mode = spec.get("mode")
+    if mode == "enospc":
+        raise OSError(errno.ENOSPC, f"injected ENOSPC on {kind} write")
+    if mode == "stall":
+        time.sleep(float(spec.get("stall_s", 0.25)))
+
+
+def retry_durable(fn: Callable[[], Any], attempts: int = 8,
+                  base_s: float = 0.02, cap_s: float = 0.5,
+                  on_retry: Optional[Callable[[int, BaseException, float],
+                                              None]] = None) -> Any:
+    """Bounded-retry jittered backoff for DURABLE writes (topic append,
+    checkpoint save) under transient storage failure — ENOSPC, EIO, a
+    stalled volume. Graceful degradation, not masking: `on_retry` fires
+    per attempt so the caller can flag itself degraded (heartbeat,
+    metrics) while it waits, and once the budget is spent the error
+    surfaces (hard-fail — the supervisor's restart is the next line of
+    defense). `FencedError` is a RuntimeError, not an OSError, so a
+    deposed writer is never retried back to life."""
+    k = 0
+    while True:
+        try:
+            return fn()
+        except OSError as exc:
+            if k >= attempts - 1:
+                raise
+            delay = min(cap_s, base_s * (1 << k))
+            delay *= 0.5 + random.random() * 0.5  # jitter: desync peers
+            if on_retry is not None:
+                on_retry(k, exc, delay)
+            time.sleep(delay)
+            k += 1
 
 
 class Producer(Protocol):
@@ -217,6 +287,7 @@ class SharedFileTopic:
                         # remnant parses (and is skipped) as one junk
                         # line.
                         f.write(b"\n")
+                check_disk_fault("topic")
                 f.write(payload)
                 f.flush()
                 os.fsync(f.fileno())
@@ -397,11 +468,21 @@ class LeaseManager:
     """
 
     def __init__(self, directory: str, owner: str, ttl_s: float = 2.0,
-                 claim_ttl_s: float = 1.0):
+                 claim_ttl_s: float = 1.0,
+                 fence_scope: Optional[str] = None):
+        """`fence_scope` names a SHARED monotonic fence counter all of
+        this manager's partitions allocate from (file
+        ``<dir>/<scope>.fencecounter``) instead of the default
+        per-partition counter. The elastic fabric needs it: after a
+        range split/merge the successor binds its fence on the
+        PREDECESSOR's topics, so fences must be comparable across
+        lease keys — one fabric-wide counter makes every ownership
+        change anywhere strictly newer than everything before it."""
         self.dir = directory
         self.owner = owner
         self.ttl_s = ttl_s
         self.claim_ttl_s = claim_ttl_s
+        self.fence_scope = fence_scope
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, partition: str) -> str:
@@ -512,8 +593,32 @@ class LeaseManager:
     def _next_fence(self, partition: str, cur: Optional[dict]) -> int:
         """Allocate the next fencing token from the monotonic counter
         (called only inside the claim). max() with the lease's own
-        fence heals a lost/stale counter file."""
-        cpath = self._path(partition) + ".fencecounter"
+        fence heals a lost/stale counter file.
+
+        A scoped (shared) counter is serialized by its own flock: the
+        per-partition claim no longer covers it, and two DIFFERENT
+        keys' claims racing the read-modify-write could mint TIED
+        fences — which the write-path tie rule would then reject for
+        whichever owner binds second, livelocking a legitimate
+        successor (the split-children race)."""
+        if self.fence_scope is not None:
+            cpath = os.path.join(
+                self.dir, f"{self.fence_scope}.fencecounter"
+            )
+            lock = open(cpath + ".lock", "a+")
+        else:
+            cpath = self._path(partition) + ".fencecounter"
+            lock = None
+        try:
+            if lock is not None:
+                with flock_exclusive(lock, None, cpath):
+                    return self._bump_fence(cpath, cur)
+            return self._bump_fence(cpath, cur)
+        finally:
+            if lock is not None:
+                lock.close()
+
+    def _bump_fence(self, cpath: str, cur: Optional[dict]) -> int:
         try:
             with open(cpath) as f:
                 counter = int(f.read().strip() or 0)
@@ -579,11 +684,23 @@ class LeaseManager:
 
     def owner_of(self, partition: str,
                  now: Optional[float] = None) -> Optional[str]:
+        info = self.lease_info(partition, now)
+        return info["owner"] if info is not None else None
+
+    def lease_info(self, partition: str,
+                   now: Optional[float] = None) -> Optional[dict]:
+        """The live lease as ``{"owner", "fence", "expires"}`` (None if
+        unowned/expired). The fence is what lets a READER tell a stale
+        pre-takeover (or pre-split) owner from the live one — owner
+        strings alone cannot, since a restarted worker reuses its
+        slot name while the fence strictly advances."""
         now = time.time() if now is None else now
         cur = self._read(partition)
         if cur is None or float(cur.get("expires", 0)) <= now:
             return None
-        return cur.get("owner")
+        return {"owner": cur.get("owner"),
+                "fence": int(cur.get("fence", 0)),
+                "expires": float(cur.get("expires", 0))}
 
 
 class FencedCheckpointStore:
@@ -653,6 +770,7 @@ class FencedCheckpointStore:
                 payload = json.dumps(
                     {"fence": fence, "owner": owner, "state": state}
                 )
+                check_disk_fault("checkpoint")
                 with open(tmp, "w") as f:
                     f.write(payload)
                     f.flush()
@@ -663,13 +781,237 @@ class FencedCheckpointStore:
         return len(payload)
 
 
-def partition_of(doc_id: str, n_partitions: int) -> int:
-    """Stable document-space partitioning (the Kafka partition-by-doc
-    role, lambdas-driver/src/document-router)."""
+HASH_SPACE = 1 << 32  # the document hash ring [0, 2^32)
+
+
+def doc_hash(doc_id: str) -> int:
+    """A document's stable position on the hash ring — the single
+    hashing rule both placement schemes derive from (modulo-N
+    `partition_of`, and the elastic hash-range leases)."""
     import hashlib
 
     h = hashlib.sha256(doc_id.encode()).digest()
-    return int.from_bytes(h[:4], "big") % n_partitions
+    return int.from_bytes(h[:4], "big")
+
+
+def partition_of(doc_id: str, n_partitions: int) -> int:
+    """Stable document-space partitioning (the Kafka partition-by-doc
+    role, lambdas-driver/src/document-router)."""
+    return doc_hash(doc_id) % n_partitions
+
+
+def range_id(lo: int, hi: int, epoch: Optional[int] = None) -> str:
+    """THE range naming rule: the half-open hash range ``[lo, hi)``
+    born at `epoch` is ``r{lo:08x}-{hi:08x}[-e{epoch}]`` — lease keys,
+    topic names and topology entries all derive from this one function
+    (the elastic twin of `partition_suffix`), so a range's identities
+    can never drift. The epoch tag (absent only for the bootstrap
+    topology) makes every INCARNATION of a range a fresh identity: a
+    merge that recreates an ancestor's exact bounds must NOT inherit
+    the ancestor's topics or checkpoint key — its state comes from its
+    immediate predecessors, not from a dead ancestor's stale
+    checkpoint."""
+    base = f"r{lo:08x}-{hi:08x}"
+    return base if epoch is None else f"{base}-e{int(epoch)}"
+
+
+class RangeLeaseStore:
+    """Hash-range (virtual-partition) leases + the fenced topology
+    epoch record — the coordination substrate of the ELASTIC fabric.
+
+    Two pieces, both arbitrated by the same ``O_CREAT|O_EXCL`` claim
+    machinery as the classic partition leases:
+
+    - **Range leases** — a `LeaseManager` whose keys are range lease
+      names (``deli-r{lo:08x}-{hi:08x}``) and whose fencing tokens
+      come from ONE fabric-wide monotonic counter (`fence_scope`), so
+      a successor's fence is comparable on any predecessor's topics —
+      the property a split/merge handoff rests on.
+    - **Topology epochs** — ``<shared>/topology.json`` maps the live
+      ranges to their topic names. Commits are fenced like checkpoints:
+      a writer proposes against the epoch it READ, under the claim, and
+      a concurrent commit wins the CAS — the loser re-reads and
+      retries or stands down. Epochs only ever advance; every range id
+      ever live stays in ``history`` so records written under epoch E
+      remain readable (merged catch-up) after E+1.
+
+    The topology shape (pure JSON, operator-readable):
+
+    ``{"epoch": E, "ranges": [{"lo", "hi", "rid", "raw", "deltas",
+    "preds": [rid, ...]}, ...], "history": [rid, ...]}``
+
+    ``preds`` names the range(s) an entry replaced (one parent for a
+    split child, two parents for a merge survivor): successors restore
+    the predecessors' final fenced checkpoints sliced to their range
+    and close the durable gap with the exactly-once ``inOff`` scan.
+    """
+
+    TOPOLOGY_CLAIM = "__topology__"
+
+    def __init__(self, shared_dir: str, owner: str, ttl_s: float = 1.0,
+                 claim_ttl_s: Optional[float] = None):
+        self.shared_dir = shared_dir
+        self.leases = LeaseManager(
+            os.path.join(shared_dir, "leases"), owner, ttl_s,
+            claim_ttl_s=claim_ttl_s
+            if claim_ttl_s is not None else max(0.25, ttl_s / 2),
+            fence_scope="__fabric__",
+        )
+        self.topology_path = os.path.join(shared_dir, "topology.json")
+
+    # -------------------------------------------------------- topology
+
+    def read_topology(self) -> Optional[dict]:
+        try:
+            with open(self.topology_path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (isinstance(d, dict) and isinstance(d.get("ranges"), list)
+                and isinstance(d.get("epoch"), int)):
+            return d
+        return None
+
+    def _write_topology(self, topo: dict) -> None:
+        tmp = self.topology_path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(topo, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.topology_path)
+
+    def ensure_topology(self, n_ranges: int) -> dict:
+        """Bootstrap epoch 1 with `n_ranges` equal hash slices (claim-
+        arbitrated, idempotent — whoever loses the race adopts the
+        winner's record)."""
+        topo = self.read_topology()
+        if topo is not None:
+            return topo
+        try:
+            with self.leases._claim(self.TOPOLOGY_CLAIM):
+                topo = self.read_topology()
+                if topo is None:
+                    topo = initial_topology(n_ranges)
+                    self._write_topology(topo)
+                return topo
+        except _ClaimBusy:
+            # A peer is bootstrapping right now; wait it out.
+            deadline = time.time() + 10 * self.leases.ttl_s
+            while time.time() < deadline:
+                topo = self.read_topology()
+                if topo is not None:
+                    return topo
+                time.sleep(0.01)
+            raise RuntimeError("topology bootstrap claim never resolved")
+
+    def commit_topology(self, topo: dict, expect_epoch: int) -> bool:
+        """Fenced CAS: commit `topo` as epoch ``expect_epoch + 1`` iff
+        the record still reads `expect_epoch`. Returns False on a lost
+        race (the caller re-reads and reconsiders — a topology change
+        is an ownership change, and two may not interleave)."""
+        try:
+            with self.leases._claim(self.TOPOLOGY_CLAIM):
+                cur = self.read_topology()
+                if cur is not None and cur["epoch"] != expect_epoch:
+                    return False
+                self._write_topology({**topo, "epoch": expect_epoch + 1})
+                return True
+        except _ClaimBusy:
+            return False
+
+
+def _range_entry(lo: int, hi: int, preds: Tuple[str, ...] = (),
+                 epoch: Optional[int] = None) -> dict:
+    """One topology entry: the range, its id, and the topic names it
+    maps to (the epoch record IS the ranges→topics map)."""
+    rid = range_id(lo, hi, epoch)
+    return {"lo": int(lo), "hi": int(hi), "rid": rid,
+            "raw": f"rawdeltas-{rid}", "deltas": f"deltas-{rid}",
+            "preds": list(preds)}
+
+
+def initial_topology(n_ranges: int) -> dict:
+    """Epoch-1 topology: `n_ranges` equal slices of the hash ring."""
+    n = int(n_ranges)
+    if n < 1:
+        raise ValueError(f"n_ranges must be >= 1: {n_ranges}")
+    bounds = [HASH_SPACE * i // n for i in range(n)] + [HASH_SPACE]
+    ranges = [_range_entry(bounds[i], bounds[i + 1]) for i in range(n)]
+    return {"epoch": 1, "ranges": ranges,
+            "history": [e["rid"] for e in ranges]}
+
+
+def split_ranges(topo: dict, rid: str, at: Optional[int] = None) -> dict:
+    """`topo` with range `rid` split into two children at hash `at`
+    (default: the midpoint). Pure function — the caller commits the
+    result through `RangeLeaseStore.commit_topology` (which bumps the
+    epoch) AFTER writing the parent's final fenced checkpoint."""
+    entry = next((e for e in topo["ranges"] if e["rid"] == rid), None)
+    if entry is None:
+        raise ValueError(f"range {rid!r} not in topology")
+    lo, hi = entry["lo"], entry["hi"]
+    at = (lo + hi) // 2 if at is None else int(at)
+    if not lo < at < hi:
+        raise ValueError(f"split point {at} outside ({lo}, {hi})")
+    # Children are tagged with the epoch the commit will install
+    # (commit CAS is against topo["epoch"], so the successor epoch is
+    # known here): a fresh incarnation never collides with an
+    # ancestor's topics or checkpoint key.
+    born = topo["epoch"] + 1
+    children = [_range_entry(lo, at, preds=(rid,), epoch=born),
+                _range_entry(at, hi, preds=(rid,), epoch=born)]
+    ranges = sorted(
+        [e for e in topo["ranges"] if e["rid"] != rid] + children,
+        key=lambda e: e["lo"],
+    )
+    history = list(topo.get("history", []))
+    history += [c["rid"] for c in children if c["rid"] not in history]
+    return {"epoch": topo["epoch"], "ranges": ranges, "history": history}
+
+
+def merge_ranges(topo: dict, rid_a: str, rid_b: str) -> dict:
+    """`topo` with ADJACENT ranges `rid_a`/`rid_b` merged into one
+    (order-insensitive). The survivor's `preds` names both parents —
+    its successor restores both final checkpoints and closes both
+    durable gaps."""
+    a = next((e for e in topo["ranges"] if e["rid"] == rid_a), None)
+    b = next((e for e in topo["ranges"] if e["rid"] == rid_b), None)
+    if a is None or b is None:
+        raise ValueError(f"range {rid_a!r}/{rid_b!r} not in topology")
+    if a["lo"] > b["lo"]:
+        a, b = b, a
+    if a["hi"] != b["lo"]:
+        raise ValueError(
+            f"ranges {a['rid']}/{b['rid']} are not adjacent"
+        )
+    merged = _range_entry(a["lo"], b["hi"],
+                          preds=(a["rid"], b["rid"]),
+                          epoch=topo["epoch"] + 1)
+    ranges = sorted(
+        [e for e in topo["ranges"]
+         if e["rid"] not in (a["rid"], b["rid"])] + [merged],
+        key=lambda e: e["lo"],
+    )
+    history = list(topo.get("history", []))
+    if merged["rid"] not in history:
+        history.append(merged["rid"])
+    return {"epoch": topo["epoch"], "ranges": ranges, "history": history}
+
+
+def range_containing(topo: dict, h: int) -> dict:
+    """The topology entry whose ``[lo, hi)`` contains hash `h` (the
+    ranges are contiguous and sorted, so this cannot miss)."""
+    import bisect
+
+    ranges = topo["ranges"]
+    i = bisect.bisect_right([e["lo"] for e in ranges], h) - 1
+    return ranges[max(0, i)]
+
+
+def range_for_doc(topo: dict, doc_id: str) -> dict:
+    """`(epoch, hash(doc))` routing: the live range `doc_id` maps to —
+    the elastic replacement for ``doc % N``."""
+    return range_containing(topo, doc_hash(doc_id))
 
 
 def partition_suffix(name: str, partition: int) -> str:
@@ -704,13 +1046,17 @@ def split_by_partition(records: List[Any],
 
 
 def lease_table(directory: str,
-                now: Optional[float] = None) -> Dict[str, str]:
-    """Live leases in `directory` as {partition_name: owner} — the
-    operator's (and chaos harness's) view of who owns what right now.
-    Read-only: no claim taken, so the snapshot may be an instant
-    stale, which is all a monitoring surface needs. Liveness semantics
-    are `LeaseManager.owner_of`'s — one place owns the expiry rule."""
-    out: Dict[str, str] = {}
+                now: Optional[float] = None) -> Dict[str, dict]:
+    """Live leases in `directory` as ``{partition_name: {"owner",
+    "fence", "expires"}}`` — the operator's (and chaos harness's) view
+    of who owns what right now, WITH the fencing token: an owner
+    string alone cannot distinguish a stale pre-split/pre-takeover
+    holder from the live one, the fence can (it strictly advances on
+    every ownership change). Read-only: no claim taken, so the
+    snapshot may be an instant stale, which is all a monitoring
+    surface needs. Liveness semantics are `LeaseManager.lease_info`'s
+    — one place owns the expiry rule."""
+    out: Dict[str, dict] = {}
     if not os.path.isdir(directory):
         return out
     probe = LeaseManager(directory, owner="__observer__")
@@ -719,7 +1065,14 @@ def lease_table(directory: str,
         if not fn.endswith(".lease"):
             continue
         name = fn[:-len(".lease")]
-        owner = probe.owner_of(name, now)
-        if owner is not None:
-            out[name] = owner
+        info = probe.lease_info(name, now)
+        if info is not None:
+            out[name] = info
     return out
+
+
+def lease_owners(directory: str,
+                 now: Optional[float] = None) -> Dict[str, str]:
+    """`lease_table` collapsed to {partition_name: owner} — the
+    historical shape, still what most health surfaces render."""
+    return {k: v["owner"] for k, v in lease_table(directory, now).items()}

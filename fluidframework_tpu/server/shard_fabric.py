@@ -58,17 +58,29 @@ import time
 import zlib
 from typing import Any, Dict, List, Optional
 
-from .columnar_log import LOG_FORMATS, default_log_format, make_topic
+from .columnar_log import (
+    LOG_FORMATS,
+    default_log_format,
+    make_tail_reader,
+    make_topic,
+)
 from .queue import (
     FencedError,
     LeaseManager,
+    RangeLeaseStore,
+    doc_hash,
+    lease_owners,
     lease_table,
+    merge_ranges,
     partition_suffix,
+    range_for_doc,
     record_partition,
     split_by_partition,
+    split_ranges,
 )
 from .supervisor import (
     DELI_IMPLS,
+    EXIT_FENCED,
     ServiceSupervisor,
     _topic_path,
     partitioned_role_class,
@@ -76,12 +88,17 @@ from .supervisor import (
 )
 
 __all__ = [
+    "MergedDeltasReader",
     "ShardFabricSupervisor",
     "ShardRouter",
     "ShardWorker",
+    "control_result",
     "partition_lease_name",
+    "range_lease_name",
+    "ranged_role_class",
     "raw_topic_name",
     "deltas_topic_name",
+    "request_topology_change",
     "serve_shard_worker",
     "spread_doc_names",
 ]
@@ -100,6 +117,452 @@ def partition_lease_name(partition: int) -> str:
     partitioned deli role's name (`partitioned_role_class`), so the
     lease, heartbeat, checkpoint and fence all share one identity."""
     return partition_suffix("deli", partition)
+
+
+def range_lease_name(rid: str) -> str:
+    """The elastic twin of `partition_lease_name`: range `rid`'s lease
+    key, role name and checkpoint key are all ``deli-{rid}`` — one
+    identity per range incarnation, like the static fabric's
+    ``deli-p{k}``."""
+    return f"deli-{rid}"
+
+
+# ---------------------------------------------------------------------------
+# ranged roles (the elastic fabric's per-range deli)
+# ---------------------------------------------------------------------------
+
+
+class _RangedMixin:
+    """Hash-range identity + predecessor absorption for a deli role.
+
+    A ranged role is a partitioned role whose slice of the document
+    space is a hash range ``[lo, hi)`` instead of a modulo class, and
+    whose range may have PREDECESSORS — the range(s) a live split or
+    merge replaced. The exactly-once story rests on one invariant the
+    sequencer already has: **per-document independence**. A document's
+    outputs are a pure function of that document's input order, and a
+    document's inputs live in exactly one topic at a time (its range's
+    raw topic, moving predecessor → successor exactly once, when the
+    router observes the new epoch). So the successor may absorb each
+    predecessor's tail as a unit — restore the predecessor's final
+    fenced checkpoint sliced to this range, bind its (strictly higher,
+    fabric-scoped) fence on the predecessor's output topic so the
+    deposed owner's in-flight batch is REJECTED, scan for the durable
+    output prefix, silently replay it, and emit only the missing tail
+    — without reconstructing the wall-clock interleaving across
+    ranges, because no document's order ever spans two sources in a
+    way the parent-first replay doesn't reproduce.
+
+    Outputs produced from predecessor inputs carry ``inSrc`` (the
+    predecessor's rid) next to ``inOff``: inOff values live in a
+    per-source offset space, and the recovery scans partition by
+    source so a successor crash mid-absorption replays exactly."""
+
+    # Filled in by `ranged_role_class`.
+    rid: str = ""
+    range_lo: int = 0
+    range_hi: int = 0
+    pred_rids: tuple = ()
+    topo_epoch: int = 0
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # Fences must be comparable ACROSS lease keys (a successor
+        # binds on predecessor topics), so ranged roles allocate from
+        # the fabric-wide counter instead of the per-key default.
+        self.leases = LeaseManager(
+            self.leases.dir, self.owner, self.leases.ttl_s,
+            self.leases.claim_ttl_s, fence_scope="__fabric__",
+        )
+        self._preds: Dict[str, dict] = {}
+        self._hash_cache: Dict[str, int] = {}
+        for prid in self.pred_rids:
+            self._add_pred(prid, None)
+
+    # ----------------------------------------------------------- slicing
+
+    def _add_pred(self, prid: str, off: Optional[int]) -> None:
+        p = self._preds.get(prid)
+        if p is not None:
+            if off is not None and (p["off"] is None or off < p["off"]):
+                p["off"] = off
+            return
+        self._preds[prid] = {
+            "off": off,
+            "raw": make_topic(
+                _topic_path(self.shared_dir, f"rawdeltas-{prid}"),
+                self.log_format,
+            ),
+            "deltas": make_topic(
+                _topic_path(self.shared_dir, f"deltas-{prid}"),
+                self.log_format,
+            ),
+            "reader": None,
+        }
+
+    def _in_range(self, doc_id: str) -> bool:
+        h = self._hash_cache.get(doc_id)
+        if h is None:
+            h = self._hash_cache[doc_id] = doc_hash(doc_id)
+        return self.range_lo <= h < self.range_hi
+
+    def _mine(self, rec) -> bool:
+        return (isinstance(rec, dict) and isinstance(rec.get("doc"), str)
+                and self._in_range(rec["doc"]))
+
+    # ------------------------------------------------------- state shape
+
+    def snapshot_state(self):
+        return {
+            "__ranged__": 1,
+            "docs": super().snapshot_state(),
+            "preds": {prid: p["off"] for prid, p in self._preds.items()
+                      if p["off"] is not None},
+            "epoch": self.topo_epoch,
+        }
+
+    def restore_state(self, state):
+        if isinstance(state, dict) and state.get("__ranged__"):
+            for prid, off in (state.get("preds") or {}).items():
+                self._add_pred(prid, int(off))
+            super().restore_state(state.get("docs"))
+        else:
+            super().restore_state(state)
+
+    # --------------------------------------------------------- recovery
+
+    def _recover(self) -> None:
+        if self._preds and self.ckpt.load(self.name) is None:
+            # First acquisition of this range: seed a checkpoint-zero
+            # from the predecessors' final fenced checkpoints BEFORE
+            # normal recovery, so a crash mid-absorption restarts from
+            # the exact same state (idempotent by construction).
+            self._seed_from_preds()
+        super()._recover()
+        if self._preds:
+            self.checkpoint()
+
+    def _seed_from_preds(self) -> None:
+        docs: Dict[str, Any] = {}
+        cursors: Dict[str, int] = {}
+        for prid in self.pred_rids:
+            env = self.ckpt.load(range_lease_name(prid))
+            st = (env or {}).get("state") or {}
+            cursors[prid] = int(st.get("offset", 0))
+            inner = st.get("state")
+            if isinstance(inner, dict) and inner.get("__ranged__"):
+                # The predecessor was itself a successor: inherit its
+                # unfinished predecessor cursors (min on conflict —
+                # reprocessing below another branch's cursor is
+                # silenced by resubmission dedup) so no ancestor tail
+                # is ever orphaned, however stale a router gets.
+                for gprid, goff in (inner.get("preds") or {}).items():
+                    if gprid in self.pred_rids:
+                        continue  # a direct pred's own offset wins
+                    goff = int(goff)
+                    cur = cursors.get(gprid)
+                    cursors[gprid] = goff if cur is None else \
+                        min(cur, goff)
+                inner = inner.get("docs") or {}
+            for d, s in (inner or {}).items():
+                if self._in_range(d):
+                    docs[d] = s
+        for prid, off in cursors.items():
+            self._add_pred(prid, off)
+        self.ckpt.save(
+            self.name,
+            {"offset": 0, "state": {
+                "__ranged__": 1, "docs": docs, "preds": cursors,
+                "epoch": self.topo_epoch,
+            }},
+            fence=self.fence, owner=self.owner,
+        )
+
+    def _absorb_predecessors(self) -> None:
+        """The `_Role._recover` hook: absorb every predecessor's tail
+        (fence bind → durable-prefix scan → silent replay → missing
+        tail emitted) BEFORE the own-topic gap replay — a doc's own-
+        topic records always postdate its predecessor records, so
+        parent-first is the per-document input order (ancestors
+        before descendants for the same reason)."""
+        for prid in self._ordered_preds():
+            self._absorb_pred(prid)
+
+    def _pred_done_counts(self, prid: str, start: int) -> Dict[int, int]:
+        """Durable outputs per `prid`-space input offset: the
+        predecessor's own (untagged) outputs, plus `inSrc`-tagged
+        re-emissions in THIS role's topic and every predecessor topic
+        (a predecessor that was itself a successor may have died with
+        tagged outputs beyond its checkpointed cursor)."""
+        done: Dict[int, int] = {}
+
+        def scan(topic, tagged: bool):
+            entries, _ = topic.read_entries(0)
+            for _i, r in entries:
+                if not isinstance(r, dict) or r.get("inOff", -1) < start:
+                    continue
+                if tagged:
+                    if r.get("inSrc") != prid:
+                        continue
+                elif r.get("inSrc") is not None:
+                    continue
+                if not self._mine(r):
+                    # ALWAYS slice by range, tagged or not: a
+                    # predecessor that was itself a successor holds
+                    # tagged records for docs OUTSIDE this child's
+                    # range (its range was wider), and counting them
+                    # would inflate max_done past this range's true
+                    # durable prefix — a clipped record of ours would
+                    # then never be re-emitted.
+                    continue
+                done[r["inOff"]] = done.get(r["inOff"], 0) + 1
+
+        scan(self._preds[prid]["deltas"], tagged=False)
+        scan(self.out_topic, tagged=True)
+        for orid, op in self._preds.items():
+            if orid != prid:
+                scan(op["deltas"], tagged=True)
+        return done
+
+    def _absorb_pred(self, prid: str) -> None:
+        p = self._preds[prid]
+        if p["off"] is None:
+            p["off"] = 0  # predecessor died before its first checkpoint
+        # Bind our fence on the predecessor's output topic FIRST: the
+        # deposed pre-split owner's in-flight batch is rejected from
+        # here on (FencedError — the demonstrable half of the handoff),
+        # so the scan below sees the final durable prefix.
+        self._durable(lambda: p["deltas"].append_many(
+            [], fence=self.fence, owner=self.owner
+        ))
+        done = self._pred_done_counts(prid, p["off"])
+        gap, next_off = p["raw"].read_entries(p["off"])
+        mine = [(i, rec) for i, rec in gap if self._mine(rec)]
+        out: List[dict] = []
+        live = mine
+        if done:
+            max_done = max(done)
+            sink: List[dict] = []
+            for i, rec in mine:
+                if i <= max_done:
+                    self.process(i, rec, sink)  # silent: already durable
+            self.flush_batch(sink)
+            # Only the LAST durable input can have been clipped
+            # mid-append; re-emit exactly its missing suffix.
+            tail = [r for r in sink if r.get("inOff") == max_done]
+            out.extend(tail[done.get(max_done, 0):])
+            live = [(i, rec) for i, rec in mine if i > max_done]
+        sink2: List[dict] = []
+        for i, rec in live:
+            self.process(i, rec, sink2)
+        self.flush_batch(sink2)
+        out.extend(sink2)
+        for r in out:
+            r["inSrc"] = prid
+        if out:
+            self._durable(lambda: self.out_topic.append_many(
+                out, fence=self.fence, owner=self.owner
+            ))
+        p["off"] = next_off
+        p["reader"] = None
+
+    # ------------------------------------------------------ steady state
+
+    def step(self, idle_sleep: float = 0.01) -> int:
+        """One quantum with the happens-before the range chain needs:
+        per document, predecessor-topic records strictly precede
+        own-topic records (the router moves a doc exactly once per
+        epoch), so the OWN batch is read first but processed LAST —
+        buffered while every predecessor tail is drained to
+        quiescence. Any pred record of a doc in the buffered batch was
+        appended before the doc's own record, hence before the drain
+        started, hence is consumed by it; processing pred-then-buffer
+        therefore reproduces every doc's true input order no matter
+        how the wall clock interleaved the topics."""
+        if self.fence is None or not self._preds:
+            return super().step(idle_sleep)
+        self._renew_or_die()
+        if self._reader is None or self._reader.next_line != self.offset:
+            self._reader = make_tail_reader(self.in_topic, self.offset)
+        # 1. READ (don't process) one own-topic batch.
+        if self.ingest_batches and hasattr(self._reader, "poll_batches"):
+            units = self._reader.poll_batches(self.batch)
+        else:
+            units = [("rec", i, rec)
+                     for i, rec in self._reader.poll(self.batch)]
+        # 2. Drain every predecessor past the read point.
+        pred_moved = self._pump_preds()
+        # 3. Process the buffered own batch.
+        out: List[dict] = []
+        moved = 0
+        for unit in units:
+            if unit[0] == "batch":
+                moved += unit[2].n
+                self.process_batch(unit[1], unit[2], out)
+            else:
+                moved += 1
+                self.process(unit[1], unit[2], out)
+        next_off = self._reader.next_line
+        if not moved:
+            if next_off != self.offset:
+                self.offset = next_off
+                self._ckpt_dirty = True
+            try:
+                self.maybe_checkpoint()
+            except FencedError as exc:
+                self._fenced_exit(exc)
+            self.heartbeat()
+            if not pred_moved:
+                time.sleep(idle_sleep)
+            return pred_moved
+        self.flush_batch(out)
+        try:
+            self._ckpt_pending_bytes += self._durable(
+                lambda: self.out_topic.append_many(
+                    out, fence=self.fence, owner=self.owner
+                )
+            )
+            self.offset = next_off
+            self._ckpt_dirty = True
+            self.maybe_checkpoint()
+        except FencedError as exc:
+            self._fenced_exit(exc)
+        self._m_pump.observe(moved)
+        self._m_records.inc(moved)
+        self.heartbeat()
+        return moved + pred_moved
+
+    def _fenced_exit(self, exc: FencedError) -> None:
+        self._m_fenced.inc()
+        self.heartbeat(force=True)
+        print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
+        raise SystemExit(EXIT_FENCED)
+
+    def _ordered_preds(self) -> List[str]:
+        """Predecessors oldest-first (birth epoch off the rid tag):
+        in a chain — grandparent inherited from a split-of-a-split —
+        the older range's records precede the newer's per doc, so
+        drains run ancestors before descendants."""
+        def birth(rid: str) -> int:
+            head, sep, tail = rid.rpartition("-e")
+            return int(tail) if sep and tail.isdigit() else 1
+
+        return sorted(self._preds, key=birth)
+
+    def _pump_preds(self) -> int:
+        """Drain every predecessor tail to QUIESCENCE: full passes
+        over the preds (oldest epoch first) until one pass delivers
+        nothing — every pred record appended before that pass began is
+        then consumed, which is what the buffered-own-batch ordering
+        rests on. Lease renewal stays live inside the loop (a huge
+        absorb must not let the lease lapse)."""
+        total = 0
+        while True:
+            pass_moved = 0
+            for prid in self._ordered_preds():
+                pass_moved += self._pump_one_pred(prid)
+            total += pass_moved
+            if pass_moved == 0:
+                return total
+
+    def _pump_one_pred(self, prid: str) -> int:
+        p = self._preds[prid]
+        if p["off"] is None:
+            return 0  # absorbed at recovery before any pump
+        taken = 0
+        while True:
+            reader = p["reader"]
+            if reader is None or reader.next_line != p["off"]:
+                reader = p["reader"] = make_tail_reader(
+                    p["raw"], p["off"]
+                )
+            entries = reader.poll(self.batch)
+            if not entries:
+                if reader.next_line != p["off"]:
+                    p["off"] = reader.next_line
+                    self._ckpt_dirty = True
+                return taken
+            out: List[dict] = []
+            for i, rec in entries:
+                if self._mine(rec):
+                    self.process(i, rec, out)
+            self.flush_batch(out)
+            for r in out:
+                r["inSrc"] = prid
+            try:
+                if out:
+                    self._ckpt_pending_bytes += self._durable(
+                        lambda: self.out_topic.append_many(
+                            out, fence=self.fence, owner=self.owner
+                        )
+                    )
+                p["off"] = reader.next_line
+                self._ckpt_dirty = True
+                self.maybe_checkpoint()
+            except FencedError as exc:
+                self._fenced_exit(exc)
+            taken += len(entries)
+            self._renew_or_die()
+            self.heartbeat()
+
+
+def ranged_role_class(base: type, entry: dict, epoch: int) -> type:
+    """The elastic form of `partitioned_role_class`: same role code,
+    hash-range identity. Lease key, heartbeat file, checkpoint key and
+    topic pair all come from the range id (``deli-{rid}`` over
+    ``rawdeltas-{rid}`` → ``deltas-{rid}``), the role only sequences
+    documents hashing into ``[lo, hi)``, and the entry's `preds` name
+    the range(s) it absorbs (split parent / merge parents)."""
+    rid = entry["rid"]
+    return type(
+        f"{base.__name__}Range", (_RangedMixin, base), {
+            "name": range_lease_name(rid),
+            "in_topic_name": entry["raw"],
+            "out_topic_name": entry["deltas"],
+            "partition": rid,  # metric label: {role: base, partition: rid}
+            "role_base": base.name,
+            "rid": rid,
+            "range_lo": int(entry["lo"]),
+            "range_hi": int(entry["hi"]),
+            "pred_rids": tuple(entry.get("preds") or ()),
+            "topo_epoch": int(epoch),
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# topology-change control channel
+# ---------------------------------------------------------------------------
+
+
+def _control_dir(shared_dir: str) -> str:
+    return os.path.join(shared_dir, "control")
+
+
+def request_topology_change(shared_dir: str, cmd: dict) -> str:
+    """Stage a split/merge command for the worker that owns the target
+    range (the fabric's admin channel — the supervisor's
+    `request_split`/`request_merge` and the chaos harness both write
+    here). Returns the command id; `control_result` reports completion
+    (the executing worker writes a ``.done`` marker with the new
+    epoch)."""
+    d = _control_dir(shared_dir)
+    os.makedirs(d, exist_ok=True)
+    cid = f"cmd-{time.time_ns():020d}-{os.getpid()}"
+    tmp = os.path.join(d, f".{cid}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(cmd, f)
+    os.replace(tmp, os.path.join(d, f"{cid}.json"))
+    return cid
+
+
+def control_result(shared_dir: str, cmd_id: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(_control_dir(shared_dir),
+                               f"{cmd_id}.done.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def spread_doc_names(n_docs: int, n_partitions: int,
@@ -139,20 +602,66 @@ class ShardRouter:
     frame/lock per partition, not per record), and arrival order is
     preserved WITHIN each partition — the only order the per-document
     sequencing contract needs, since a doc lives in exactly one
-    partition."""
+    partition.
+
+    `elastic=True` routes by ``(epoch, hash(doc))`` instead of
+    ``doc % N``: the live hash-range topology (`queue.RangeLeaseStore`)
+    is re-read whenever its record changes on disk, so a split/merge
+    redirects NEW records to the child ranges within one append — and
+    any record a momentarily-stale router still lands on a retired
+    range's topic is absorbed by the successor's predecessor tail
+    (`_RangedMixin`), so staleness costs latency, never order."""
 
     def __init__(self, shared_dir: str, n_partitions: int,
-                 log_format: Optional[str] = None):
+                 log_format: Optional[str] = None,
+                 elastic: bool = False):
         if n_partitions < 1:
             raise ValueError(f"n_partitions must be >= 1: {n_partitions}")
         self.shared_dir = shared_dir
         self.n_partitions = n_partitions
         self.log_format = default_log_format(log_format)
-        self.topics = [
-            make_topic(_topic_path(shared_dir, raw_topic_name(p)),
-                       self.log_format)
-            for p in range(n_partitions)
-        ]
+        self.elastic = bool(elastic)
+        if self.elastic:
+            self.store = RangeLeaseStore(shared_dir, "__router__")
+            self.topology = self.store.ensure_topology(n_partitions)
+            self._topo_sig: Optional[tuple] = None
+            self._topic_cache: Dict[str, Any] = {}
+            self.topics: List[Any] = []  # static-mode surface only
+        else:
+            self.topics = [
+                make_topic(_topic_path(shared_dir, raw_topic_name(p)),
+                           self.log_format)
+                for p in range(n_partitions)
+            ]
+
+    # ------------------------------------------------- topology refresh
+
+    def _refresh(self) -> None:
+        """Adopt a newer topology epoch if the record changed on disk
+        (one stat per call — the epoch flip is visible within one
+        append, no polling thread)."""
+        if not self.elastic:
+            return
+        try:
+            st = os.stat(self.store.topology_path)
+        except OSError:
+            return
+        sig = (st.st_mtime_ns, st.st_size)
+        if sig != self._topo_sig:
+            topo = self.store.read_topology()
+            if topo is not None:
+                self.topology = topo
+            self._topo_sig = sig
+
+    def _topic(self, name: str):
+        t = self._topic_cache.get(name)
+        if t is None:
+            t = self._topic_cache[name] = make_topic(
+                _topic_path(self.shared_dir, name), self.log_format
+            )
+        return t
+
+    # ----------------------------------------------------------- routing
 
     def partition(self, rec: Any) -> int:
         return record_partition(rec, self.n_partitions)
@@ -162,23 +671,104 @@ class ShardRouter:
         each group (pure routing — no I/O)."""
         return split_by_partition(records, self.n_partitions)
 
-    def append(self, records: List[Any]) -> Dict[int, int]:
+    def split_elastic(self, records: List[Any]) -> Dict[str, List[Any]]:
+        """Records grouped by live range id under the CURRENT epoch
+        (doc-less junk pins to the first range — any single consistent
+        home keeps offsets deterministic)."""
+        out: Dict[str, List[Any]] = {}
+        for rec in records:
+            doc = rec.get("doc") if isinstance(rec, dict) else None
+            if isinstance(doc, str):
+                entry = range_for_doc(self.topology, doc)
+            else:
+                entry = self.topology["ranges"][0]
+            out.setdefault(entry["rid"], []).append(rec)
+        return out
+
+    def append(self, records: List[Any]) -> Dict[Any, int]:
         """Route + append one ingress batch; returns records appended
-        per partition."""
-        counts: Dict[int, int] = {}
+        per partition (keyed by index, or by range id when elastic)."""
+        counts: Dict[Any, int] = {}
+        if self.elastic:
+            self._refresh()
+            by_rid = self.split_elastic(records)
+            rid_to_raw = {e["rid"]: e["raw"]
+                          for e in self.topology["ranges"]}
+            for rid, recs in by_rid.items():
+                self._topic(rid_to_raw[rid]).append_many(recs)
+                counts[rid] = len(recs)
+            return counts
         for p, recs in self.split(records).items():
             self.topics[p].append_many(recs)
             counts[p] = len(recs)
         return counts
 
+    # ------------------------------------------------------ read surface
+
+    def deltas_topic_names(self) -> List[str]:
+        """Every sequenced-output topic name this fabric has EVER
+        written — live ranges plus retired ones (topology history), so
+        records written under epoch E stay readable after E+1."""
+        if self.elastic:
+            self._refresh()
+            return [f"deltas-{rid}"
+                    for rid in self.topology.get("history", [])]
+        return [deltas_topic_name(p) for p in range(self.n_partitions)]
+
     def deltas_topics(self) -> List[Any]:
         """Every partition's sequenced-output topic (the merged read
         surface convergence checks and catch-up readers use)."""
+        if self.elastic:
+            return [self._topic(n) for n in self.deltas_topic_names()]
         return [
             make_topic(_topic_path(self.shared_dir, deltas_topic_name(p)),
                        self.log_format)
             for p in range(self.n_partitions)
         ]
+
+    def live_raw_topics(self) -> List[Any]:
+        """The LIVE ranges' ingress topics (fault-injection surface)."""
+        if self.elastic:
+            self._refresh()
+            return [self._topic(e["raw"])
+                    for e in self.topology["ranges"]]
+        return list(self.topics)
+
+    def merged_reader(self) -> "MergedDeltasReader":
+        return MergedDeltasReader(self)
+
+
+class MergedDeltasReader:
+    """The merged catch-up read: one cursor PER RANGE TOPIC across the
+    whole topology history, polled incrementally. A split or merge
+    adds cursors (new ranges) without invalidating old ones, so a
+    consumer riding this surface sees every record exactly once no
+    matter how often N changes mid-stream — re-reading every file from
+    zero per poll would be O(file²) at bench scale. Retired ranges'
+    topics quiesce once their successor binds, so each costs one
+    empty incremental poll per pass; history grows only by
+    operator-initiated epochs, which bounds the per-poll fan-out."""
+
+    def __init__(self, router: ShardRouter):
+        self.router = router
+        self._readers: Dict[str, Any] = {}
+
+    def poll(self, max_count_per_range: Optional[int] = None
+             ) -> List[Any]:
+        out: List[Any] = []
+        for name in self.router.deltas_topic_names():
+            reader = self._readers.get(name)
+            if reader is None:
+                reader = self._readers[name] = make_tail_reader(
+                    self.router._topic(name) if self.router.elastic
+                    else make_topic(
+                        _topic_path(self.router.shared_dir, name),
+                        self.router.log_format,
+                    ),
+                    0,
+                )
+            out.extend(v for _i, v in reader.poll(max_count_per_range))
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -210,11 +800,20 @@ class ShardWorker:
                  ckpt_interval_s: float = 0.25,
                  ckpt_bytes: int = 256 * 1024, ckpt_duty: float = 0.2,
                  worker_ttl_s: Optional[float] = None,
-                 deli_devices: Optional[int] = None):
+                 deli_devices: Optional[int] = None,
+                 elastic: bool = False):
+        """`elastic=True` swaps fixed modulo-N partitions for the
+        hash-range topology (`queue.RangeLeaseStore`): the worker
+        sweeps RANGE leases toward its fair share of the LIVE range
+        set (which changes epoch to epoch), executes staged
+        split/merge commands for ranges it owns, and releases any
+        role whose range a committed topology change retired.
+        `n_partitions` then only seeds the bootstrap topology."""
         self.shared_dir = shared_dir
         self.slot = slot
         self.owner = owner or slot
         self.n_partitions = int(n_partitions)
+        self.elastic = bool(elastic)
         self.deli_impl = deli_impl or os.environ.get("FLUID_DELI", "scalar")
         if self.deli_impl not in DELI_IMPLS:
             raise ValueError(
@@ -249,7 +848,18 @@ class ShardWorker:
         os.makedirs(self.workers_dir, exist_ok=True)
         # Read-only ownership probe (owner_of takes no claim).
         self._probe = LeaseManager(self.leases_dir, self.owner, ttl_s)
-        self.roles: Dict[int, Any] = {}
+        if self.elastic:
+            self.store: Optional[RangeLeaseStore] = RangeLeaseStore(
+                shared_dir, self.owner, ttl_s
+            )
+            self.topology: Optional[dict] = self.store.ensure_topology(
+                self.n_partitions
+            )
+        else:
+            self.store = None
+            self.topology = None
+        # Role keys: partition ints (static) or range ids (elastic).
+        self.roles: Dict[Any, Any] = {}
         self.events: List[str] = []
         self._hb_t = 0.0
         self._sweep_t = 0.0
@@ -278,15 +888,24 @@ class ShardWorker:
         """Worker-level liveness + the fabric's metrics channel: ONE
         snapshot of this process's registry (per-partition labels keep
         every owned partition's series distinct), so the supervisor
-        scrape merges one file per worker with no double counting."""
+        scrape merges one file per worker with no double counting.
+        `degraded` lists partitions currently inside a storage-fault
+        retry budget (ENOSPC/stall backoff) — limping, not dead — for
+        `ShardFabricSupervisor.health()` to surface."""
         tmp = self._hb_path() + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({
                 "t": time.time(), "slot": self.slot, "owner": self.owner,
                 "pid": os.getpid(),
                 "partitions": sorted(
-                    p for p, r in self.roles.items() if r.fence is not None
+                    p for p, r in self.roles.items()
+                    if r.fence is not None
                 ),
+                "degraded": sorted(
+                    p for p, r in self.roles.items()
+                    if getattr(r, "degraded", False)
+                ),
+                "epoch": (self.topology or {}).get("epoch"),
                 "metrics": self.metrics.snapshot(),
             }, f)
         os.replace(tmp, self._hb_path())
@@ -315,19 +934,40 @@ class ShardWorker:
                     saw_self = True
         return alive if saw_self else alive + 1
 
+    def _keys(self) -> List[Any]:
+        """The current partition key space: fixed indices, or the live
+        range ids of the topology epoch this worker last read."""
+        if not self.elastic:
+            return list(range(self.n_partitions))
+        return [e["rid"] for e in self.topology["ranges"]]
+
+    def _lease_name(self, key: Any) -> str:
+        return (range_lease_name(key) if self.elastic
+                else partition_lease_name(key))
+
+    def _entry(self, rid: str) -> dict:
+        return next(e for e in self.topology["ranges"]
+                    if e["rid"] == rid)
+
     def target_partitions(self) -> int:
-        """This worker's fair share of the partition space."""
-        t = math.ceil(self.n_partitions / max(1, self.alive_workers()))
+        """This worker's fair share of the partition space (the LIVE
+        range count when elastic — a split raises everyone's target,
+        a merge lowers it: capacity follows the topology)."""
+        t = math.ceil(len(self._keys()) / max(1, self.alive_workers()))
         if self.max_partitions is not None:
             t = min(t, self.max_partitions)
         return t
 
     # ------------------------------------------------------- role plumbing
 
-    def _make_role(self, partition: int):
-        cls = partitioned_role_class(
-            resolve_role_class("deli", self.deli_impl), partition
-        )
+    def _make_role(self, key: Any):
+        base = resolve_role_class("deli", self.deli_impl)
+        if self.elastic:
+            cls = ranged_role_class(
+                base, self._entry(key), self.topology["epoch"]
+            )
+        else:
+            cls = partitioned_role_class(base, key)
         kw = {}
         if self.deli_devices is not None and self.deli_devices > 1:
             kw["deli_devices"] = self.deli_devices
@@ -344,12 +984,12 @@ class ShardWorker:
         role.hb_interval_s = self.ttl_s / 3
         return role
 
-    def _release(self, partition: int, why: str) -> None:
+    def _release(self, key: Any, why: str) -> None:
         """Graceful fenced handoff: final checkpoint under our (still
         valid) fence, then release with expires=0 — the successor's
         next sweep takes over immediately, restores this checkpoint,
         and its recovery scan replays any durable gap silently."""
-        role = self.roles.pop(partition, None)
+        role = self.roles.pop(key, None)
         if role is None:
             return
         if role.fence is not None:
@@ -361,40 +1001,208 @@ class ShardWorker:
             # Count only REAL handoffs: dropping a role instance that
             # never acquired its lease released nothing.
             self._m_handoffs.inc()
-        self._event(f"released p{partition} ({why})")
+        self._event(f"released {self._kname(key)} ({why})")
+
+    @staticmethod
+    def _kname(key: Any) -> str:
+        return f"p{key}" if isinstance(key, int) else str(key)
 
     def sweep(self) -> None:
-        """One balance pass: shed surplus, prune lost races, acquire
-        toward target."""
+        """One balance pass: (elastic) adopt the newest topology epoch,
+        execute staged split/merge commands, retire dead ranges; then
+        shed surplus, prune lost races, acquire toward target."""
+        if self.elastic:
+            topo = self.store.read_topology()
+            if topo is not None and (
+                    self.topology is None
+                    or topo["epoch"] != self.topology["epoch"]):
+                self._event(f"topology epoch {topo['epoch']}")
+                self.topology = topo
+            self._process_controls()
+            # A committed split/merge retires its source range(s):
+            # release NOW (final fenced checkpoint) instead of pumping
+            # until the successor's fence rejects us.
+            live = set(self._keys())
+            for k in [k for k in self.roles if k not in live]:
+                self._release(k, "topology-retired")
+        keys = self._keys()
         target = self.target_partitions()
-        # Shed surplus (highest partition first: deterministic, so two
+        # Shed surplus (highest key first: deterministic, so two
         # overfull workers don't thrash the same partition).
         while len(self.roles) > target:
-            self._release(max(self.roles), "rebalance")
+            self._release(sorted(self.roles)[-1], "rebalance")
         # Prune instances that never acquired while a live foreign
         # owner holds the lease (we lost the race).
         for p, role in list(self.roles.items()):
             if role.fence is None:
-                owner = self._probe.owner_of(partition_lease_name(p))
+                owner = self._probe.owner_of(self._lease_name(p))
                 if owner is not None and owner != self.owner:
                     self.roles.pop(p)
         # Acquire free/expired partitions up to target, scanning from a
         # slot-dependent start so peers spread instead of colliding.
-        if len(self.roles) < target:
+        if len(self.roles) < target and keys:
             # crc32, not hash(): per-process salt would make the scan
             # start differ between a worker and its restarted self.
-            start = zlib.crc32(self.slot.encode()) % self.n_partitions
-            for i in range(self.n_partitions):
+            start = zlib.crc32(self.slot.encode()) % len(keys)
+            for i in range(len(keys)):
                 if len(self.roles) >= target:
                     break
-                p = (start + i) % self.n_partitions
+                p = keys[(start + i) % len(keys)]
                 if p in self.roles:
                     continue
-                owner = self._probe.owner_of(partition_lease_name(p))
+                owner = self._probe.owner_of(self._lease_name(p))
                 if owner is None or owner == self.owner:
                     self.roles[p] = self._make_role(p)
         self._m_owned.set(len(self.roles))
         self._sweep_t = time.time()
+
+    # --------------------------------------------- split/merge execution
+
+    def _process_controls(self) -> None:
+        """Execute staged topology commands for ranges this worker
+        owns (`request_topology_change` writes them; whoever owns the
+        target executes and writes the ``.done`` marker). A lost
+        commit CAS leaves the command pending for the next sweep."""
+        cdir = _control_dir(self.shared_dir)
+        try:
+            names = sorted(os.listdir(cdir))
+        except OSError:
+            return
+        for fn in names:
+            if not fn.endswith(".json") or fn.endswith(".done.json"):
+                continue
+            path = os.path.join(cdir, fn)
+            done_path = path[:-len(".json")] + ".done.json"
+            if os.path.exists(done_path):
+                continue
+            try:
+                with open(path) as f:
+                    cmd = json.load(f)
+            except (OSError, ValueError):
+                continue
+            op = cmd.get("op") if isinstance(cmd, dict) else None
+            if op == "split":
+                self._control_split(cmd, done_path)
+            elif op == "merge":
+                self._control_merge(cmd, done_path)
+            else:
+                self._done_marker(done_path, error=f"unknown op {op!r}")
+
+    def _done_marker(self, done_path: str, **result) -> None:
+        tmp = done_path + f".tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({
+                "by": self.owner,
+                "epoch": (self.topology or {}).get("epoch"),
+                **result,
+            }, f)
+        os.replace(tmp, done_path)
+
+    def _control_split(self, cmd: dict, done_path: str) -> None:
+        live = {e["rid"] for e in self.topology["ranges"]}
+        rid = cmd.get("rid")
+        if rid is None:
+            # No target named: capacity follows load — split the widest
+            # range this worker owns.
+            owned = [k for k, r in self.roles.items()
+                     if r.fence is not None]
+            if not owned:
+                return
+            rid = max(owned, key=lambda k: (
+                self._entry(k)["hi"] - self._entry(k)["lo"]
+            ))
+        if rid not in live:
+            self._done_marker(done_path, error=f"range {rid} not live")
+            return
+        role = self.roles.get(rid)
+        if role is None or role.fence is None:
+            return  # not ours (yet): the owner executes
+        try:
+            # The parent's FINAL fenced checkpoint — what both children
+            # seed from. Written BEFORE the topology commit, so a crash
+            # between the two leaves the old epoch fully intact.
+            role.checkpoint()
+        except (FencedError, OSError):
+            self.roles.pop(rid, None)
+            self._m_drops.inc()
+            return
+        topo2 = split_ranges(self.topology, rid, cmd.get("at"))
+        if self.store.commit_topology(topo2, self.topology["epoch"]):
+            role.leases.release(role.name)
+            self.roles.pop(rid, None)
+            self._m_handoffs.inc()
+            self.topology = self.store.read_topology()
+            self._event(
+                f"split {rid} -> epoch {self.topology['epoch']}"
+            )
+            self._done_marker(done_path, op="split", rid=rid)
+        else:
+            self.topology = self.store.read_topology() or self.topology
+
+    def _control_merge(self, cmd: dict, done_path: str) -> None:
+        rids = cmd.get("rids") or []
+        if len(rids) != 2:
+            self._done_marker(done_path, error=f"merge needs 2 rids: "
+                                               f"{rids}")
+            return
+        live = {e["rid"]: e for e in self.topology["ranges"]}
+        if rids[0] not in live or rids[1] not in live:
+            self._done_marker(done_path,
+                              error=f"ranges {rids} not all live")
+            return
+        a, b = sorted(rids, key=lambda r: live[r]["lo"])
+        if live[a]["hi"] != live[b]["lo"]:
+            self._done_marker(done_path,
+                              error=f"ranges {rids} not adjacent")
+            return
+        role_a = self.roles.get(a)
+        if role_a is None or role_a.fence is None:
+            # Executor rule: the LEFT range's owner executes; the right
+            # owner hands its range off the moment it sees the command
+            # so the executor's acquisition never waits out a TTL.
+            if b in self.roles:
+                self._release(b, "merge-handoff")
+            return
+        lm = self.store.leases
+        role_b = self.roles.get(b)
+        if role_b is not None and role_b.fence is not None:
+            # We own both: final-checkpoint b and KEEP its lease bound
+            # through the commit — releasing first would open a window
+            # where a peer's sweep acquires the about-to-retire range
+            # (try_acquire's already-ours short-circuit would hand the
+            # released lease back without re-arming it).
+            try:
+                role_b.checkpoint()
+            except (FencedError, OSError):
+                pass  # a successor's fence already won: its state stands
+            self.roles.pop(b, None)
+            self._m_handoffs.inc()
+            self._event(f"released {self._kname(b)} (merge-handoff)")
+        else:
+            if role_b is not None:
+                self.roles.pop(b, None)  # never acquired: nothing held
+            if lm.try_acquire(range_lease_name(b)) is None:
+                return  # right owner hasn't handed off yet: next sweep
+        try:
+            role_a.checkpoint()  # the left parent's final checkpoint
+        except (FencedError, OSError):
+            self.roles.pop(a, None)
+            self._m_drops.inc()
+            lm.release(range_lease_name(b))
+            return
+        topo2 = merge_ranges(self.topology, a, b)
+        if self.store.commit_topology(topo2, self.topology["epoch"]):
+            role_a.leases.release(role_a.name)
+            self.roles.pop(a, None)
+            self._m_handoffs.inc()
+            self.topology = self.store.read_topology()
+            self._event(
+                f"merge {a}+{b} -> epoch {self.topology['epoch']}"
+            )
+            self._done_marker(done_path, op="merge", rids=[a, b])
+        else:
+            self.topology = self.store.read_topology() or self.topology
+        lm.release(range_lease_name(b))
 
     # -------------------------------------------------------------- pump
 
@@ -412,11 +1220,11 @@ class ShardWorker:
             except SystemExit as exc:
                 self.roles.pop(p, None)
                 self._m_drops.inc()
-                self._event(f"dropped p{p} (exit={exc.code})")
+                self._event(f"dropped {self._kname(p)} (exit={exc.code})")
             except FencedError as exc:
                 self.roles.pop(p, None)
                 self._m_drops.inc()
-                self._event(f"dropped p{p} (fenced: {exc})")
+                self._event(f"dropped {self._kname(p)} (fenced: {exc})")
         now = time.time()
         if now - self._sweep_t > self.ttl_s / 2:
             self.sweep()
@@ -474,15 +1282,26 @@ class ShardFabricSupervisor(ServiceSupervisor):
     def __init__(self, shared_dir: str, n_workers: int = 2,
                  n_partitions: int = 4,
                  max_partitions: Optional[int] = None,
-                 worker_ttl_s: Optional[float] = None, **kw):
+                 worker_ttl_s: Optional[float] = None,
+                 elastic: bool = False, **kw):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1: {n_workers}")
         self.n_partitions = int(n_partitions)
         self.max_partitions = max_partitions
         self.worker_ttl_s = worker_ttl_s
+        self.elastic = bool(elastic)
         roles = tuple(f"shard-w{i}" for i in range(n_workers))
         super().__init__(shared_dir, roles=roles, **kw)
         os.makedirs(os.path.join(shared_dir, "workers"), exist_ok=True)
+        if self.elastic:
+            # Bootstrap the topology before any child spawns, so the
+            # router/workers/harness all adopt one epoch-1 record.
+            self.store: Optional[RangeLeaseStore] = RangeLeaseStore(
+                shared_dir, "__supervisor__"
+            )
+            self.store.ensure_topology(self.n_partitions)
+        else:
+            self.store = None
 
     def _child_cmd(self, role: str, owner: str) -> List[str]:
         cmd = [self.python, "-c",
@@ -503,33 +1322,133 @@ class ShardFabricSupervisor(ServiceSupervisor):
             cmd += ["--worker-ttl", str(self.worker_ttl_s)]
         if self.deli_devices is not None:
             cmd += ["--deli-devices", str(self.deli_devices)]
+        if self.elastic:
+            cmd += ["--elastic"]
         return cmd
 
     def _hb_file(self, role: str) -> str:
         return os.path.join(self.shared_dir, "workers", f"{role}.json")
 
     def partition_owners(self) -> Dict[str, str]:
-        """Live {``deli-p{k}``: owner} — the operator's ownership view
-        (`queue.lease_table` over the fabric's lease directory)."""
+        """Live {``deli-p{k}`` | ``deli-{rid}``: owner} — the
+        operator's ownership view (`queue.lease_owners` over the
+        fabric's lease directory)."""
+        return lease_owners(os.path.join(self.shared_dir, "leases"))
+
+    def partition_leases(self) -> Dict[str, dict]:
+        """The full lease view — owner AND fence/expiry per partition
+        (`queue.lease_table`): the fence is how a reader tells a stale
+        pre-split owner from the live one."""
         return lease_table(os.path.join(self.shared_dir, "leases"))
+
+    def topology(self) -> Optional[dict]:
+        """The live hash-range topology record (None when static)."""
+        return self.store.read_topology() if self.elastic else None
+
+    def request_split(self, rid: Optional[str] = None,
+                      at: Optional[int] = None) -> str:
+        """Stage a live split of range `rid` (default: the owner's
+        widest range) at hash `at` (default: midpoint). Returns the
+        command id; the owning worker executes it on its next sweep
+        and `control_result(shared_dir, cmd_id)` reports the new
+        epoch."""
+        if not self.elastic:
+            raise ValueError("request_split needs elastic=True")
+        cmd: Dict[str, Any] = {"op": "split"}
+        if rid is not None:
+            cmd["rid"] = rid
+        if at is not None:
+            cmd["at"] = int(at)
+        return request_topology_change(self.shared_dir, cmd)
+
+    def request_merge(self, rid_a: str, rid_b: str) -> str:
+        """Stage a live merge of adjacent ranges `rid_a`/`rid_b`."""
+        if not self.elastic:
+            raise ValueError("request_merge needs elastic=True")
+        return request_topology_change(
+            self.shared_dir, {"op": "merge", "rids": [rid_a, rid_b]}
+        )
+
+    def control_result(self, cmd_id: str) -> Optional[dict]:
+        return control_result(self.shared_dir, cmd_id)
+
+    def degraded_partitions(self) -> List[str]:
+        """Partitions currently limping through a storage-fault retry
+        budget: the `degraded` lists the worker heartbeats export,
+        UNION the fresh per-role heartbeats — a role stuck inside its
+        backoff cannot return to the worker loop, so its own forced
+        heartbeat (`_Role._durable`) is the prompt signal; worker
+        heartbeats catch up between steps. Role files older than the
+        heartbeat timeout are ignored (a crashed role must not pin
+        the fabric degraded forever)."""
+        out = set()
+        for role in self.roles:
+            try:
+                with open(self._hb_file(role)) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                continue
+            out.update(str(p) for p in hb.get("degraded") or [])
+        hb_dir = os.path.join(self.shared_dir, "hb")
+        now = time.time()
+        try:
+            names = os.listdir(hb_dir)
+        except OSError:
+            names = []
+        for fn in names:
+            if not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(hb_dir, fn)) as f:
+                    hb = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if (hb.get("degraded")
+                    and now - float(hb.get("t", 0))
+                    <= self.heartbeat_timeout_s):
+                out.add(fn[:-len(".json")])
+        return sorted(out)
 
     def health(self) -> Dict[str, Any]:
         h = super().health()
         owners = self.partition_owners()
-        h["n_partitions"] = self.n_partitions
+        topo = self.topology()
+        expected = (len(topo["ranges"]) if topo is not None
+                    else self.n_partitions)
+        h["n_partitions"] = expected
         h["partition_owners"] = owners
+        h["partition_leases"] = self.partition_leases()
+        if topo is not None:
+            h["epoch"] = topo["epoch"]
+            h["ranges"] = [e["rid"] for e in topo["ranges"]]
+        limping = self.degraded_partitions()
+        h["degraded_partitions"] = limping
         # Degraded until every partition has a live owner (boot,
-        # takeover windows): unowned partitions buffer, not lose, but
-        # an operator should see the gap.
-        if len(owners) < self.n_partitions:
+        # takeover, split/merge windows — unowned partitions buffer,
+        # not lose) and none is inside a storage-fault retry budget:
+        # an operator should see either gap.
+        if len(owners) < expected or limping:
             h["status"] = "degraded"
         return h
 
     def collect_metrics(self):
         reg = super().collect_metrics()
-        owners = self.partition_owners()
-        reg.gauge("shard_partitions_total").set(self.n_partitions)
-        reg.gauge("shard_partitions_owned_live").set(len(owners))
+        leases = self.partition_leases()
+        topo = self.topology()
+        reg.gauge("shard_partitions_total").set(
+            len(topo["ranges"]) if topo is not None
+            else self.n_partitions
+        )
+        reg.gauge("shard_partitions_owned_live").set(len(leases))
+        if topo is not None:
+            reg.gauge("shard_topology_epoch").set(topo["epoch"])
+        for name, info in leases.items():
+            # The lease FENCE next to the owner (satellite of the
+            # lease_table fix): a scrape can tell a stale pre-split
+            # owner's series from the live one's.
+            reg.gauge("shard_partition_fence", partition=name).set(
+                info["fence"]
+            )
         return reg
 
 
@@ -549,6 +1468,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             return val
         return default
 
+    elastic = "--elastic" in args
+    if elastic:
+        args.remove("--elastic")
     shared_dir = _take("--dir")
     slot = _take("--slot")
     owner = _take("--owner")
@@ -572,8 +1494,8 @@ def main(argv: Optional[List[str]] = None) -> None:
             "--dir D --slot S [--owner O] [--partitions N] [--ttl S] "
             "[--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--max-partitions K] "
-            "[--worker-ttl S] [--deli-devices N] [--ckpt-interval S] "
-            "[--ckpt-bytes N] [--ckpt-duty F]",
+            "[--worker-ttl S] [--deli-devices N] [--elastic] "
+            "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
         raise SystemExit(2)
@@ -585,6 +1507,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         ckpt_duty=ckpt_duty,
         worker_ttl_s=float(worker_ttl) if worker_ttl else None,
         deli_devices=int(devices_s) if devices_s else None,
+        elastic=elastic,
     )
 
 

@@ -64,6 +64,7 @@ from .queue import (
     SharedFileTopic,
     TailReader,
     partition_suffix,
+    retry_durable,
 )
 from .sequencer import DocumentSequencer
 
@@ -80,6 +81,7 @@ __all__ = [
     "partitioned_role_class",
     "resolve_role_class",
     "serve_role",
+    "unwrap_ranged_state",
 ]
 
 ROLES = ("deli", "scriptorium", "scribe", "broadcaster")
@@ -90,6 +92,21 @@ EXIT_FENCED = 3  # write-path fence rejection: we are a zombie
 
 def _topic_path(shared_dir: str, name: str) -> str:
     return os.path.join(shared_dir, "topics", f"{name}.jsonl")
+
+
+def unwrap_ranged_state(state: Any) -> Any:
+    """Deli checkpoint states come in two shapes: the classic per-doc
+    `DocumentSequencer` map, and the elastic fabric's ranged envelope
+    (``{"__ranged__": 1, "docs": {...}, "preds": {...}}`` — per-doc
+    map plus predecessor catch-up cursors, `server.shard_fabric`).
+    Every deli restore path unwraps through here, so a checkpoint
+    written by a ranged role stays restorable by ANY frontend (scalar,
+    kernel, in-proc) — the cursors only mean something to a ranged
+    successor, the doc states mean the same thing everywhere."""
+    if (isinstance(state, dict) and state.get("__ranged__")
+            and "docs" in state):
+        return state.get("docs") or {}
+    return state
 
 
 def canonical_record(rec: dict) -> dict:
@@ -190,6 +207,12 @@ class _Role:
         )
         self.fence: Optional[int] = None
         self.offset = 0
+        # Storage degradation flag: True while a durable write (topic
+        # append, checkpoint) is inside its bounded-retry backoff
+        # budget (ENOSPC, stalled volume). Rides the heartbeat so the
+        # supervisor's health surface can show a limping-but-live
+        # role; cleared by the next durable write that lands.
+        self.degraded = False
         self._reader: Optional[TailReader] = None
         self._last_renew = 0.0
         self._hb_path = os.path.join(shared_dir, "hb", f"{self.name}.json")
@@ -221,6 +244,8 @@ class _Role:
         )
         self._m_ckpt_ms = m.histogram("checkpoint_ms", **labels)
         self._m_fenced = m.counter("fence_rejections_total", **labels)
+        self._m_disk_retries = m.counter("disk_retries_total", **labels)
+        self._m_degraded = m.gauge("role_degraded", **labels)
 
     # ------------------------------------------------------------ state
 
@@ -237,6 +262,12 @@ class _Role:
     def flush_batch(self, out: List[dict]) -> None:
         """End-of-batch hook: batching roles (the kernel deli) buffer
         in `process` and emit here; scalar roles emit per record."""
+
+    def _absorb_predecessors(self) -> None:
+        """Recovery hook between the output fence bind and the
+        own-topic durable scan: the elastic fabric's ranged roles
+        (`shard_fabric._RangedMixin`) absorb their predecessor ranges'
+        tails here. Classic roles have no predecessors."""
 
     # -------------------------------------------------------- lifecycle
 
@@ -260,6 +291,7 @@ class _Role:
             json.dump({
                 "pid": os.getpid(), "owner": self.owner, "t": time.time(),
                 "fence": self.fence, "offset": self.offset,
+                "degraded": self.degraded,
                 # Metrics report UP through the existing heartbeat
                 # channel: the supervisor merges these snapshots into
                 # its /metrics registry (per-process registries, one
@@ -267,6 +299,42 @@ class _Role:
                 "metrics": self.metrics.snapshot(),
             }, f)
         os.replace(tmp, self._hb_path)
+
+    def _durable(self, fn):
+        """Run one durable write under the storage-fault budget:
+        bounded-retry jittered backoff on OSError (ENOSPC, EIO, a
+        stalled volume), flagging the role `degraded` — and force-
+        heartbeating, so liveness AND the flag stay visible while it
+        waits — for as long as the retry budget lasts. A write that
+        lands clears the flag; a spent budget re-raises (hard-fail:
+        the record was never acknowledged, so the supervisor restart
+        loses nothing). `FencedError` passes straight through — a
+        deposed writer must die, not loop."""
+        def note(attempt, exc, delay):
+            self.degraded = True
+            self._m_degraded.set(1.0)
+            self._m_disk_retries.inc()
+            self.heartbeat(force=True)  # export the flag while limping
+
+        out = retry_durable(fn, on_retry=note)
+        if self.degraded:
+            self.degraded = False
+            self._m_degraded.set(0.0)
+            self.heartbeat(force=True)  # recovery is news too
+        return out
+
+    def _renew_or_die(self, now: Optional[float] = None) -> None:
+        """Lease upkeep (every ttl/3): a failed renewal means a
+        successor owns the role — stand down loudly. ONE helper for
+        every pump path (base step, ranged step, predecessor drains)
+        so deposed handling can never fork."""
+        now = time.time() if now is None else now
+        if now - self._last_renew <= self.leases.ttl_s / 3:
+            return
+        if not self.leases.renew(self.name):
+            print(f"DEPOSED {self.name} {self.owner}", flush=True)
+            raise SystemExit(EXIT_DEPOSED)
+        self._last_renew = now
 
     def _recover(self) -> None:
         """Resume from the durable checkpoint, then close the
@@ -287,16 +355,27 @@ class _Role:
         # rejected (FencedError), so the scan below sees the final
         # durable prefix and no zombie write can land after it — the
         # write-path half of the takeover contract.
-        self.out_topic.append_many([], fence=self.fence, owner=self.owner)
+        self._durable(lambda: self.out_topic.append_many(
+            [], fence=self.fence, owner=self.owner
+        ))
+        # Ranged successors absorb their predecessors' tails HERE —
+        # after our fence is bound, before the own-topic scan: a doc's
+        # own-topic records always postdate its predecessor records,
+        # so this is the per-document input order (no-op otherwise).
+        self._absorb_predecessors()
         entries, _ = self.out_topic.read_entries(0)
         # Durable outputs per input offset: one input may emit SEVERAL
         # outputs (a wire boxcar), and a crash mid-append can leave a
         # durable PREFIX of them — outputs land in input order, so only
         # the LAST durable input (max_done) can be partial; everything
-        # below it is complete.
+        # below it is complete. Records tagged `inSrc` live in a
+        # PREDECESSOR's offset space (a ranged successor's absorbed
+        # catch-up, server.shard_fabric) — their inOff would collide
+        # with ours, so the predecessor scan owns them, not this one.
         done_counts: Dict[int, int] = {}
         for _, r in entries:
-            if isinstance(r, dict) and r.get("inOff", -1) >= self.offset:
+            if (isinstance(r, dict) and r.get("inSrc") is None
+                    and r.get("inOff", -1) >= self.offset):
                 off = r["inOff"]
                 done_counts[off] = done_counts.get(off, 0) + 1
         if not done_counts:
@@ -319,8 +398,9 @@ class _Role:
         tail = [r for r in sink if r.get("inOff") == max_done]
         tail = tail[done_counts[max_done]:]
         if tail:
-            self.out_topic.append_many(tail, fence=self.fence,
-                                       owner=self.owner)
+            self._durable(lambda: self.out_topic.append_many(
+                tail, fence=self.fence, owner=self.owner
+            ))
         self.offset = next_off
         self._reader = None  # re-anchor the tail at the new offset
         # The replayed records MUST match what is already on disk —
@@ -330,11 +410,11 @@ class _Role:
 
     def checkpoint(self) -> None:
         t0 = time.perf_counter()
-        n_bytes = self.ckpt.save(
+        n_bytes = self._durable(lambda: self.ckpt.save(
             self.name,
             {"offset": self.offset, "state": self.snapshot_state()},
             fence=self.fence, owner=self.owner,
-        )
+        ))
         self._m_ckpt_writes.inc()
         self._m_ckpt_bytes.inc(n_bytes)
         self._ckpt_last_s = time.perf_counter() - t0
@@ -380,11 +460,8 @@ class _Role:
             self.fence = fence
             self._last_renew = now
             self._recover()
-        elif now - self._last_renew > self.leases.ttl_s / 3:
-            if not self.leases.renew(self.name):
-                print(f"DEPOSED {self.name} {self.owner}", flush=True)
-                raise SystemExit(EXIT_DEPOSED)
-            self._last_renew = now
+        else:
+            self._renew_or_die(now)
         # Micro-batch cap (threaded into the read): a deep input
         # backlog yields between steps, so lease renewal + heartbeat
         # stay live no matter how far behind the role is. The tail is
@@ -433,9 +510,13 @@ class _Role:
             if self.out_topic is not None:
                 # Append THEN checkpoint; the recovery scan makes the
                 # crash window between them exactly-once, whatever the
-                # checkpoint cadence.
-                self._ckpt_pending_bytes += self.out_topic.append_many(
-                    out, fence=self.fence, owner=self.owner
+                # checkpoint cadence. Durable = retried under the
+                # storage-fault budget (degraded, not dead, through a
+                # transient ENOSPC).
+                self._ckpt_pending_bytes += self._durable(
+                    lambda: self.out_topic.append_many(
+                        out, fence=self.fence, owner=self.owner
+                    )
                 )
             self.offset = next_off
             self._ckpt_dirty = True
@@ -482,6 +563,7 @@ class DeliRole(_Role):
         return {d: s.checkpoint() for d, s in self.sequencers.items()}
 
     def restore_state(self, state: Any) -> None:
+        state = unwrap_ranged_state(state)
         self.sequencers = {
             d: DocumentSequencer.restore(s) for d, s in (state or {}).items()
         }
@@ -817,8 +899,14 @@ class ServiceSupervisor:
                  ckpt_bytes: int = 256 * 1024,
                  log_format: Optional[str] = None,
                  ckpt_duty: float = 0.2,
-                 deli_devices: Optional[int] = None):
+                 deli_devices: Optional[int] = None,
+                 child_env: Optional[Dict[str, str]] = None):
+        """`child_env` adds/overrides spawn-environment variables for
+        every child (the chaos harness's seam: it points CHILDREN at a
+        disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
+        its own appends)."""
         self.shared_dir = shared_dir
+        self.child_env = dict(child_env or {})
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -907,8 +995,11 @@ class ServiceSupervisor:
         if self.deli_devices is not None and self.deli_devices > 1:
             from ..utils.devices import forced_host_device_env
 
-            return forced_host_device_env(self.deli_devices)
-        return dict(os.environ, JAX_PLATFORMS="cpu")
+            env = forced_host_device_env(self.deli_devices)
+        else:
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.update(self.child_env)
+        return env
 
     def _spawn(self, role: str) -> Optional[subprocess.Popen]:
         """Spawn one role child; returns None (and records the event)
@@ -1122,14 +1213,27 @@ class ServiceSupervisor:
             alive = proc is not None and proc.poll() is None
             age = self._heartbeat_age(role)
             stale = age > self.heartbeat_timeout_s
+            # A child limping through storage-fault backoff reports
+            # itself `degraded` in its heartbeat — live (no restart
+            # wanted) but worth an operator's eye.
+            limping = bool(self._hb_field(role, "degraded"))
             roles[role] = {
                 "alive": alive, "heartbeat_age_s": round(age, 3),
                 "restarts": self.restarts[role],
+                "degraded": limping,
             }
-            ok = ok and alive and not stale
+            ok = ok and alive and not stale and not limping
         return {"status": "ok" if ok else "degraded", "roles": roles,
                 "deli_impl": self.deli_impl,
                 "log_format": self.log_format}
+
+    def _hb_field(self, role: str, key: str) -> Any:
+        """One field off `role`'s last heartbeat (None if absent)."""
+        try:
+            with open(self._hb_file(role)) as f:
+                return json.load(f).get(key)
+        except (OSError, ValueError):
+            return None
 
     def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
         """The farm's live ops endpoint: `/metrics` merges the

@@ -28,6 +28,25 @@ Fault classes (all seeded — a failing run reproduces from its seed):
   and re-appends whole submission batches (at-least-once ingress);
   deli's resubmission dedup must keep the total order identical.
 
+Elastic-fabric fault classes (``n_partitions > 1`` with the
+hash-range topology, `server.shard_fabric` elastic mode — a topology
+change is just another fault the fenced-handoff machinery must
+survive):
+
+- ``split``  — a live range split mid-run (mid-boxcar when
+  boxcar_rate > 0): the owner writes its final fenced checkpoint,
+  commits the next topology epoch, and the children absorb its tail
+  exactly-once; the PRE-SPLIT owner's append with its old fence must
+  be **demonstrably rejected** with `FencedError`.
+- ``merge``  — the inverse: two adjacent ranges merge live; the
+  survivor restores both parents' checkpoints and closes both gaps.
+- ``disk``   — storage failure: ENOSPC injected on the workers'
+  topic/checkpoint writes (plus an artificially stalled fsync
+  episode); roles must degrade gracefully — bounded-retry jittered
+  backoff, a ``degraded`` flag visible in worker heartbeats and
+  `ShardFabricSupervisor.health()` — and recover with no lost
+  acknowledged record once the fault clears.
+
 The GOLDEN digest is produced by running the SAME production role code
 (`DeliRole.process` / `ScribeRole.process`) in-process with no faults —
 not a parallel reimplementation — so golden and chaotic runs can only
@@ -43,7 +62,7 @@ import random
 import signal
 import tempfile
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..server.columnar_log import make_topic
@@ -61,6 +80,9 @@ from ..server.supervisor import (
 )
 
 FAULT_CLASSES = ("kill", "torn", "lease", "net", "client")
+# Fault classes of the ELASTIC fabric only (hash-range topology):
+ELASTIC_FAULTS = ("split", "merge", "disk")
+ALL_FAULT_CLASSES = FAULT_CLASSES + ELASTIC_FAULTS
 
 
 @dataclass
@@ -108,6 +130,11 @@ class ChaosConfig:
     # converging run proves the SHARDED kernel bit-identical to the
     # single-device stream under the same faults.
     deli_devices: Optional[int] = None
+    # Elastic hash-range topology (server.shard_fabric elastic mode):
+    # partitions are range leases that can split/merge LIVE. Implied
+    # by the split/merge/disk fault classes; may be set explicitly to
+    # run the classic fault set against the elastic fabric.
+    elastic: bool = False
 
 
 @dataclass
@@ -130,6 +157,12 @@ class ChaosResult:
     # (per-stage pump sizes, checkpoint bytes/durations, fence
     # rejections...) — `utils.metrics.format_report([metrics])` prints.
     metrics: Dict[str, Any] = field(default_factory=dict)
+    # Disk-fault evidence: the degraded flag (worker heartbeat →
+    # health()) was observed while the ENOSPC episode ran.
+    degraded_seen: bool = False
+    # Topology evidence: epochs observed committed during the run
+    # (split/merge faults must actually move it).
+    epochs: List[int] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -328,6 +361,20 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
             f"deli_devices={cfg.deli_devices} needs deli_impl='kernel'"
             f"; got {cfg.deli_impl!r}"
         )
+    unknown = set(cfg.faults) - set(ALL_FAULT_CLASSES)
+    if unknown:
+        raise ValueError(f"unknown fault classes {sorted(unknown)}")
+    elastic_wanted = [f for f in cfg.faults if f in ELASTIC_FAULTS]
+    if elastic_wanted and cfg.n_partitions <= 1:
+        # split/merge/disk target the sharded fabric's workers and
+        # topology; accepting them single-partition would print a
+        # convergence verdict for a fault that never ran.
+        raise ValueError(
+            f"fault classes {elastic_wanted} need n_partitions > 1 "
+            f"(the elastic sharded fabric)"
+        )
+    if elastic_wanted:
+        cfg = replace(cfg, elastic=True)
     shared = cfg.shared_dir or tempfile.mkdtemp(prefix="chaos-")
     runner = _run_chaos_sharded if cfg.n_partitions > 1 else _run_chaos_in
     res = runner(cfg, shared)
@@ -539,6 +586,7 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
     merged sequenced stream across every ``deltas-p{k}`` must be
     bit-identical to the golden with zero duplicate/skipped seqs —
     a rebalance mid-boxcar must be invisible in the order."""
+    from ..server.queue import DISK_FAULT_ENV
     from ..server.shard_fabric import ShardFabricSupervisor, ShardRouter
 
     rng = random.Random(cfg.seed ^ 0x5EED)
@@ -551,21 +599,56 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         cfg, rng, workload,
         tuple(f"shard-w{w}" for w in range(cfg.n_workers)),
     )
+    # Elastic fault schedule (seeded like everything else): the split
+    # lands in the FIRST half of the stream — mid-run, with boxcars in
+    # flight when boxcar_rate > 0 — the merge in the second half (so
+    # it can merge the split's children), the ENOSPC episode between.
+    # Bounds are clamped lo <= hi so a degenerate tiny workload (one
+    # or two chunks) still schedules the fault instead of crashing
+    # randint with an empty range.
+    def pick(lo: int, hi: int) -> int:
+        lo = max(0, lo)
+        # min() with the final chunk: the fault must actually FIRE
+        # (fed_idx never exceeds len(chunks) - 1).
+        return min(len(chunks) - 1, rng.randint(lo, max(lo, hi)))
 
+    split_at = (pick(max(1, len(chunks) // 4), len(chunks) // 2)
+                if "split" in cfg.faults else None)
+    merge_at = (pick(2 * len(chunks) // 3, len(chunks) - 2)
+                if "merge" in cfg.faults else None)
+    disk_at = (pick(len(chunks) // 3, 2 * len(chunks) // 3)
+               if "disk" in cfg.faults else None)
+    stall_at = (min(len(chunks) - 1, disk_at + max(2, len(chunks) // 8))
+                if disk_at is not None else None)
+
+    # Children get the disk-fault spec path via their spawn env; the
+    # harness's own appends (the router feed) stay clean.
+    fault_spec = os.path.join(shared, "disk-fault.json")
+    child_env = ({DISK_FAULT_ENV: fault_spec}
+                 if "disk" in cfg.faults else None)
     sup = ShardFabricSupervisor(
         shared, n_workers=cfg.n_workers, n_partitions=cfg.n_partitions,
         ttl_s=cfg.ttl_s, heartbeat_timeout_s=cfg.heartbeat_timeout_s,
         batch=cfg.batch, deli_impl=cfg.deli_impl,
         log_format=cfg.log_format, deli_devices=cfg.deli_devices,
+        elastic=cfg.elastic, child_env=child_env,
     ).start()
-    router = ShardRouter(shared, cfg.n_partitions, cfg.log_format)
+    router = ShardRouter(shared, cfg.n_partitions, cfg.log_format,
+                         elastic=cfg.elastic)
     fence_rejections = 0
+    degraded_seen = False
+    epochs: List[int] = []
     events: List[str] = []
     timeline: List[Tuple[float, str]] = []
 
     def note(ev: str) -> None:
         events.append(ev)
         timeline.append((time.time(), ev))
+
+    def note_epoch() -> None:
+        topo = sup.topology()
+        if topo is not None and topo["epoch"] not in epochs:
+            epochs.append(topo["epoch"])
 
     def merged_ops() -> List[dict]:
         out: List[dict] = []
@@ -577,6 +660,7 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         return out
 
     try:
+        note_epoch()
         fed_idx = 0
         pending_dups: Dict[int, List[dict]] = {}
         deadline = time.time() + cfg.timeout_s
@@ -597,13 +681,27 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                         note(f"chaos: SIGKILL {slot}")
                 if torn_at and torn_at[0] == fed_idx:
                     torn_at.pop(0)
-                    inject_torn_append(router.topics[0].path)
+                    inject_torn_append(router.live_raw_topics()[0].path)
                     inject_torn_append(router.deltas_topics()[0].path)
                     note("chaos: torn append (p0)")
                 if lease_at == fed_idx:
                     fence_rejections += _shard_lease_takeover(
                         shared, sup, cfg, note
                     )
+                if split_at == fed_idx:
+                    fence_rejections += _topology_split_fault(
+                        shared, sup, cfg, note
+                    )
+                    note_epoch()
+                if merge_at == fed_idx:
+                    _topology_merge_fault(shared, sup, cfg, note)
+                    note_epoch()
+                if disk_at == fed_idx:
+                    degraded_seen |= _disk_enospc_fault(
+                        fault_spec, sup, cfg, note
+                    )
+                if stall_at == fed_idx and stall_at != disk_at:
+                    _disk_stall_fault(fault_spec, cfg, note)
                 fed_idx += 1
             if fed_idx >= len(chunks) and pending_dups:
                 for idx in sorted(pending_dups):
@@ -613,19 +711,28 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                     and len(merged_ops()) >= expected):
                 break
             time.sleep(0.02)
+        note_epoch()
     finally:
         sup.stop()
+        if os.path.exists(fault_spec):
+            os.remove(fault_spec)
 
     ops = merged_ops()
     digest = stream_digest(ops)
     dups, skips = sequence_integrity(ops)
     converged = (
         digest == gdigest and dups == 0 and skips == 0
-        and ("lease" not in cfg.faults or fence_rejections > 0)
+        and (("lease" not in cfg.faults and "split" not in cfg.faults)
+             or fence_rejections > 0)
+        and ("split" not in cfg.faults or len(epochs) > 1)
+        and ("merge" not in cfg.faults or len(epochs) > 1)
+        and ("disk" not in cfg.faults or degraded_seen)
     )
     detail = (
         f"ops={len(ops)}/{expected} partitions={cfg.n_partitions} "
-        f"workers={cfg.n_workers} restarts={sup.restarts} "
+        f"workers={cfg.n_workers} elastic={cfg.elastic} "
+        f"epochs={epochs} degraded_seen={degraded_seen} "
+        f"restarts={sup.restarts} "
         f"owners={sup.partition_owners()} events={events + sup.events}"
     )
     from ..utils.metrics import dump_snapshot_line, merge_snapshots
@@ -643,7 +750,158 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         fence_rejections=fence_rejections, restarts=dict(sup.restarts),
         events=events + list(sup.events), detail=detail,
         timeline=sorted(timeline + sup.timeline), metrics=metrics,
+        degraded_seen=degraded_seen, epochs=epochs,
     )
+
+
+def _topology_split_fault(shared: str, sup, cfg: ChaosConfig,
+                          note) -> int:
+    """The live-split fault: pick an OWNED range mid-run, capture its
+    output topic's bound (fence, owner), stage the split command, wait
+    for the owning worker to commit the next epoch, then PROVE the
+    pre-split owner is deposed: its append with the old fence must
+    raise `FencedError` once a child's higher fence binds. Returns
+    demonstrated rejections."""
+    from ..server.shard_fabric import range_lease_name
+
+    topo = sup.topology()
+    if topo is None:
+        return 0
+    target = None
+    probe_deadline = time.time() + 24 * cfg.ttl_s
+    while time.time() < probe_deadline and target is None:
+        owners = sup.partition_owners()
+        for e in sorted(topo["ranges"], key=lambda r: r["lo"]):
+            if range_lease_name(e["rid"]) in owners:
+                target = e
+                break
+        if target is None:
+            sup.poll_once()
+            time.sleep(cfg.ttl_s / 5)
+    if target is None:
+        note("chaos: split fault retired (no owned range)")
+        return 0
+    deltas = make_topic(
+        os.path.join(shared, "topics", f"{target['deltas']}.jsonl"),
+        cfg.log_format,
+    )
+    old_fence, old_owner = deltas.latest_fence()
+    cmd = sup.request_split(rid=target["rid"])
+    note(f"chaos: split requested on {target['rid']} (mid-run)")
+    done_deadline = time.time() + 60 * cfg.ttl_s
+    res = None
+    while time.time() < done_deadline and res is None:
+        sup.poll_once()
+        res = sup.control_result(cmd)
+        if res is None:
+            time.sleep(cfg.ttl_s / 5)
+    if res is None or res.get("error"):
+        note(f"chaos: split did not complete ({res})")
+        return 0
+    note(f"chaos: split committed (epoch {res.get('epoch')})")
+    rejections = 0
+    if old_fence:
+        # Wait for a child successor's higher fence to bind on the
+        # parent's output topic, then replay the dead parent's write.
+        bind_deadline = time.time() + 30 * cfg.ttl_s
+        while time.time() < bind_deadline:
+            cur, _ = deltas.latest_fence()
+            if cur > old_fence:
+                break
+            sup.poll_once()
+            time.sleep(cfg.ttl_s / 5)
+        try:
+            deltas.append_many(
+                [{"kind": "op", "doc": "zombie", "seq": -1}],
+                fence=old_fence, owner=old_owner,
+            )
+        except FencedError:
+            rejections += 1
+            note("chaos: PRE-SPLIT owner topic write REJECTED")
+    return rejections
+
+
+def _topology_merge_fault(shared: str, sup, cfg: ChaosConfig,
+                          note) -> None:
+    """The live-merge fault: merge two adjacent ranges mid-run —
+    sibling children of an earlier split when present (the full
+    round-trip), else the first adjacent pair."""
+    topo = sup.topology()
+    if topo is None or len(topo["ranges"]) < 2:
+        note("chaos: merge fault retired (nothing to merge)")
+        return
+    ranges = sorted(topo["ranges"], key=lambda e: e["lo"])
+    pair = None
+    for a, b in zip(ranges, ranges[1:]):
+        if a["preds"] and a["preds"] == b["preds"]:
+            pair = (a, b)  # the split's children: the round-trip
+            break
+    if pair is None:
+        pair = (ranges[0], ranges[1])
+    cmd = sup.request_merge(pair[0]["rid"], pair[1]["rid"])
+    note(f"chaos: merge requested {pair[0]['rid']}+{pair[1]['rid']}")
+    done_deadline = time.time() + 60 * cfg.ttl_s
+    res = None
+    while time.time() < done_deadline and res is None:
+        sup.poll_once()
+        res = sup.control_result(cmd)
+        if res is None:
+            time.sleep(cfg.ttl_s / 5)
+    note(f"chaos: merge result {res}")
+
+
+def _disk_enospc_fault(fault_spec: str, sup, cfg: ChaosConfig,
+                       note) -> bool:
+    """The ENOSPC episode: children's durable writes (topic appends,
+    checkpoints) start failing; the roles must enter bounded-retry
+    backoff and flag themselves `degraded` — visible in `health()` —
+    rather than corrupt or silently drop. The fault holds until the
+    flag is OBSERVED (or a deadline passes), then clears; convergence
+    after clearance proves no acknowledged record was lost. Returns
+    whether degradation was observed."""
+    tmp = fault_spec + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"mode": "enospc",
+                   "kinds": ["topic", "checkpoint"]}, f)
+    os.replace(tmp, fault_spec)
+    note("chaos: ENOSPC injected on worker durable writes")
+    degraded = False
+    deadline = time.time() + 30 * cfg.ttl_s
+    try:
+        while time.time() < deadline:
+            sup.poll_once()
+            h = sup.health()
+            if h.get("degraded_partitions"):
+                degraded = True
+                note(f"chaos: degraded visible in health(): "
+                     f"{h['degraded_partitions']} "
+                     f"(status={h['status']})")
+                break
+            time.sleep(cfg.ttl_s / 10)
+    finally:
+        os.remove(fault_spec)
+        note("chaos: ENOSPC cleared")
+    return degraded
+
+
+def _disk_stall_fault(fault_spec: str, cfg: ChaosConfig, note) -> None:
+    """The stalled-fsync episode: every durable write crawls for a
+    beat. Liveness must hold (no restart storm — heartbeats continue
+    between writes) and the order must not notice; the window is kept
+    under the heartbeat timeout so a stall is degradation, not
+    death."""
+    stall_s = min(0.2, cfg.heartbeat_timeout_s / 8)
+    tmp = fault_spec + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"mode": "stall", "stall_s": stall_s,
+                   "kinds": ["topic", "checkpoint"]}, f)
+    os.replace(tmp, fault_spec)
+    note(f"chaos: fsync stall injected ({stall_s}s/write)")
+    try:
+        time.sleep(6 * stall_s)  # a few stalled writes land
+    finally:
+        os.remove(fault_spec)
+        note("chaos: fsync stall cleared")
 
 
 def _shard_lease_takeover(shared: str, sup, cfg: ChaosConfig,
@@ -654,11 +912,6 @@ def _shard_lease_takeover(shared: str, sup, cfg: ChaosConfig,
     owner's writes are REJECTED. The stopped worker's other partitions
     meanwhile expire and rebalance onto peers — the membership-change
     path under fault. Returns demonstrated fence rejections."""
-    from ..server.shard_fabric import (
-        deltas_topic_name,
-        partition_lease_name,
-    )
-
     # A worker may transiently own nothing (mid-rebalance, just
     # restarted): poll for a live worker that demonstrably holds a
     # partition lease before staging the takeover. Generous window —
@@ -686,11 +939,12 @@ def _shard_lease_takeover(shared: str, sup, cfg: ChaosConfig,
             time.sleep(cfg.ttl_s / 5)
     if proc is None or not victims:
         return 0
-    target = victims[0]  # partition_lease_name(k)
-    part = next(p for p in range(cfg.n_partitions)
-                if partition_lease_name(p) == target)
+    # The lease name is "deli-<suffix>" in both topologies (p{k} or a
+    # range id); the partition's output topic is "deltas-<suffix>".
+    target = victims[0]
     deltas = make_topic(
-        os.path.join(shared, "topics", f"{deltas_topic_name(part)}.jsonl"),
+        os.path.join(shared, "topics",
+                     f"deltas-{target[len('deli-'):]}.jsonl"),
         cfg.log_format,
     )
     old_fence, old_owner = deltas.latest_fence()
@@ -715,6 +969,9 @@ def _shard_lease_takeover(shared: str, sup, cfg: ChaosConfig,
         usurper = LeaseManager(
             os.path.join(shared, "leases"), "chaos-usurper",
             ttl_s=cfg.ttl_s, claim_ttl_s=max(0.25, cfg.ttl_s / 2),
+            # Elastic leases allocate from the fabric-wide counter;
+            # the usurper must too, or its fence could tie a peer's.
+            fence_scope="__fabric__" if cfg.elastic else None,
         )
 
         def acquire(deadline_s: float):

@@ -584,11 +584,14 @@ def _canonical_by_doc(paths: List[str]) -> Dict[str, List[dict]]:
     for path in paths:
         for rec in _read_canonical(path):
             if rec.get("kind") == "op":
-                # inOff is per-partition transport bookkeeping (input
-                # line offsets differ across shardings by design) —
-                # the same exclusion canonical_record applies.
+                # inOff/inSrc are per-partition transport bookkeeping
+                # (input line offsets differ across shardings, and a
+                # ranged successor tags absorbed records with their
+                # source) — the same exclusion canonical_record
+                # applies.
                 per_doc.setdefault(rec["doc"], []).append(
-                    {k: v for k, v in rec.items() if k != "inOff"}
+                    {k: v for k, v in rec.items()
+                     if k not in ("inOff", "inSrc")}
                 )
     for v in per_doc.values():
         v.sort(key=lambda r: r["seq"])
@@ -729,6 +732,127 @@ def run_shard_bench(n_docs: int = 2048, n_clients: int = 8,
             shutil.rmtree(scratch, ignore_errors=True)
 
 
+def run_rebalance_bench(n_docs: int = 10_000, n_clients: int = 64,
+                        ops_per_client: int = 1, n_ranges: int = 4,
+                        n_workers: int = 2, deli_impl: str = "kernel",
+                        log_format: str = "columnar",
+                        ttl_s: float = 0.75, feed_batch: int = 4096,
+                        timeout_s: float = 900.0,
+                        work_dir: Optional[str] = None) -> dict:
+    """Cost of a LIVE topology change: the same workload drained
+    through the ELASTIC fabric (`server.shard_fabric`, hash-range
+    leases) twice — once on a steady topology, once with a range
+    SPLIT committed mid-stream (final fenced checkpoint, epoch bump,
+    children absorb the parent's tail while the router re-routes).
+    Aggregate ops/s of the split run over the steady run is the
+    rebalance cost; the CONVERGENCE gate always runs — both variants'
+    merged canonical per-doc streams must be identical with contiguous
+    seqs (N changing mid-run must be invisible in the order)."""
+    import shutil as _shutil
+
+    from ..server.queue import RangeLeaseStore
+    from ..server.shard_fabric import (
+        ShardFabricSupervisor,
+        ShardRouter,
+        spread_doc_names,
+    )
+
+    docs = spread_doc_names(n_docs, n_ranges)
+    workload = build_pipeline_workload(
+        n_docs, n_clients, ops_per_client, doc_names=docs
+    )
+    expected = len(workload)  # every join + valid op stamps exactly once
+    scratch = work_dir or tempfile.mkdtemp(prefix="rebalance-bench-")
+    runs: Dict[str, dict] = {}
+    reference: Optional[Dict[str, List[dict]]] = None
+    try:
+        for variant in ("steady", "split"):
+            vdir = os.path.join(scratch, variant)
+            os.makedirs(vdir, exist_ok=True)
+            router = ShardRouter(vdir, n_ranges, log_format,
+                                 elastic=True)
+            sup = ShardFabricSupervisor(
+                vdir, n_workers=n_workers, n_partitions=n_ranges,
+                ttl_s=ttl_s, deli_impl=deli_impl,
+                log_format=log_format, elastic=True,
+            ).start()
+            split_cmd = None
+            ops_count = 0
+            reader = router.merged_reader()
+            t0 = time.time()
+            try:
+                fed = 0
+                deadline = t0 + timeout_s
+                while time.time() < deadline:
+                    sup.poll_once()
+                    if fed < len(workload):
+                        router.append(workload[fed:fed + feed_batch])
+                        fed += feed_batch
+                        if (variant == "split" and split_cmd is None
+                                and fed >= len(workload) // 2):
+                            split_cmd = sup.request_split()
+                    ops_count += sum(
+                        1 for r in reader.poll()
+                        if isinstance(r, dict) and r.get("kind") == "op"
+                    )
+                    if fed >= len(workload) and ops_count >= expected:
+                        break
+                    if fed >= len(workload):
+                        time.sleep(0.01)
+                elapsed = time.time() - t0
+            finally:
+                sup.stop()
+            assert ops_count >= expected, (
+                f"{variant}: drained {ops_count}/{expected} within "
+                f"{timeout_s}s"
+            )
+            epoch = RangeLeaseStore(vdir, "__bench__").read_topology()[
+                "epoch"
+            ]
+            if variant == "split":
+                assert split_cmd is not None and epoch > 1, (
+                    f"split never committed (epoch {epoch})"
+                )
+            merged = _canonical_by_doc([
+                os.path.join(vdir, "topics", f"{name}.jsonl")
+                for name in router.deltas_topic_names()
+            ])
+            for doc, recs in merged.items():
+                seqs = [r["seq"] for r in recs]
+                assert seqs == list(range(1, len(seqs) + 1)), (
+                    f"{variant}: {doc} seqs not contiguous across the "
+                    f"rebalance"
+                )
+            if reference is None:
+                reference = merged
+            else:
+                assert merged == reference, (
+                    "split-run stream diverges from the steady run"
+                )
+            runs[variant] = {
+                "variant": variant, "seconds": round(elapsed, 3),
+                "ops_per_sec": round(expected / elapsed, 1),
+                "epoch": epoch,
+            }
+        cost_pct = (1.0 - runs["split"]["ops_per_sec"]
+                    / runs["steady"]["ops_per_sec"]) * 100.0
+        return {
+            "metric": "elastic_rebalance",
+            "deli_impl": deli_impl, "log_format": log_format,
+            "docs": n_docs, "clients_per_doc": n_clients,
+            "records": expected, "ranges": n_ranges,
+            "workers": n_workers,
+            "runs": [runs["steady"], runs["split"]],
+            "split_cost_pct": round(cost_pct, 2),
+            "cores": os.cpu_count(),
+            "gate": "bit-identical steady vs mid-run split",
+            "unit": "records/s",
+        }
+    finally:
+        if work_dir is None:
+            _shutil.rmtree(scratch, ignore_errors=True)
+
+
 def main() -> None:  # CLI twin: tools/bench_deli.py
     scale = float(os.environ.get("BD_SCALE", "1.0"))
     if os.environ.get("BD_DEVICES"):
@@ -746,6 +870,20 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             ops_per_doc=int(os.environ.get("BD_OPS_PER_DOC", "64")),
             n_clients=int(os.environ.get("BD_CLIENTS", "8")),
             repeats=int(os.environ.get("BD_REPEATS", "3")),
+        )
+        print(json.dumps(res))
+        return
+    if os.environ.get("BD_REBALANCE"):
+        # Elastic-rebalance mode: mid-run split cost vs steady
+        # topology, convergence-gated (bench_configs config8 twin).
+        res = run_rebalance_bench(
+            n_docs=max(8, int(int(os.environ.get("BD_DOCS", "10000"))
+                              * scale)),
+            n_clients=int(os.environ.get("BD_CLIENTS", "64")),
+            ops_per_client=int(os.environ.get("BD_OPS", "1")),
+            n_ranges=int(os.environ.get("BD_PARTITIONS", "4")),
+            deli_impl=os.environ.get("BD_IMPL", "kernel"),
+            log_format=os.environ.get("BD_LOG_FORMAT", "columnar"),
         )
         print(json.dumps(res))
         return

@@ -110,6 +110,52 @@ def test_sharded_fabric_kill_lease_mid_boxcar_converges(tmp_path):
 
 
 @pytest.mark.chaos
+def test_elastic_fabric_kill_split_merge_converges(tmp_path):
+    """THE elastic-topology acceptance gate (ISSUE 8): a worker
+    SIGKILLed mid-stream AND a live range split AND a live merge —
+    kernel deli over columnar topics, 4 initial hash ranges, boxcars
+    in flight, N changing mid-run twice — must converge bit-identical
+    to the single-partition in-proc golden with zero duplicated or
+    skipped sequence numbers, while the PRE-SPLIT owner's stale-fence
+    write is demonstrably rejected. Capacity following load without a
+    restart is exactly this: a topology change is just another fault
+    the fenced-handoff machinery survives."""
+    res = run_chaos(ChaosConfig(
+        seed=7, faults=("kill", "split", "merge"), n_docs=4,
+        n_clients=2, ops_per_client=12, timeout_s=300,
+        shared_dir=str(tmp_path), deli_impl="kernel",
+        log_format="columnar", boxcar_rate=0.25,
+        n_partitions=4, n_workers=2,
+    ))
+    assert res.duplicate_seqs == 0, res.detail
+    assert res.skipped_seqs == 0, res.detail
+    assert res.digest == res.golden_digest, res.detail
+    assert res.converged, res.detail
+    assert res.fence_rejections >= 1  # pre-split owner rejected
+    assert len(res.epochs) >= 3, res.epochs  # split AND merge committed
+    assert sum(res.restarts.values()) >= 1  # the kill actually landed
+
+
+@pytest.mark.chaos
+def test_elastic_fabric_disk_faults_degrade_and_recover(tmp_path):
+    """The storage fault classes (ISSUE 8): ENOSPC on the workers'
+    topic/checkpoint writes plus a stalled-fsync episode. The fabric
+    must degrade gracefully — bounded-retry backoff with `degraded`
+    visible in health() while the fault holds — and converge with no
+    lost acknowledged record once it clears."""
+    res = run_chaos(ChaosConfig(
+        seed=3, faults=("disk",), n_docs=2, n_clients=2,
+        ops_per_client=10, timeout_s=240, shared_dir=str(tmp_path),
+        n_partitions=2, n_workers=2,
+    ))
+    assert res.duplicate_seqs == 0, res.detail
+    assert res.skipped_seqs == 0, res.detail
+    assert res.digest == res.golden_digest, res.detail
+    assert res.degraded_seen, res.detail
+    assert res.converged, res.detail
+
+
+@pytest.mark.chaos
 def test_chaos_kill_torn_columnar_kernel_converges(tmp_path):
     """Kill + torn faults against the KERNEL deli over COLUMNAR topics
     (boxcarred ingress): exactly-once recovery, torn-tail sealing, and

@@ -405,6 +405,100 @@ def test_supervisor_child_cmd_carries_devices(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# scoped re-place (PR-6 follow-up (b))
+# ---------------------------------------------------------------------------
+
+
+def test_scoped_scatter_skips_untouched_shards():
+    """Restoring a parked doc re-places ONLY the device slab that owns
+    its slot row: every untouched shard keeps its buffer by IDENTITY
+    (same unsafe_buffer_pointer), so a grow/park cycle at large D no
+    longer re-transfers the whole pool across the mesh."""
+    import numpy as np
+
+    from fluidframework_tpu.server.deli_kernel import SeqPool
+
+    _need_devices(4)
+    pool = SeqPool(n_docs=8, n_clients=4, mesh=mesh_for_devices(4))
+    for i in range(8):
+        pool.touch(f"d{i}")
+    pool.prepare()
+    assert pool._placed
+    fields = ("seq", "min_seq", "connected", "ref_seq", "client_seq")
+    ptrs0 = {
+        name: [s.data.unsafe_buffer_pointer()
+               for s in getattr(pool.state, name).addressable_shards]
+        for name in fields
+    }
+    # Park + touch a doc whose slot lives in shard 0 — the only slab
+    # whose buffers may change.
+    victim = pool.slot_owner[0]
+    pool.docs[victim]["clients"] = {1: [0, 3]}
+    pool.docs[victim]["cmap"] = {1: 1}
+    pool.park(victim)
+    pool.begin()
+    h = pool.touch(victim)
+    rows = pool.n_docs // 4
+    assert h["slot"] // rows == 0
+    pool.prepare()
+    for name in fields:
+        cur = [s.data.unsafe_buffer_pointer()
+               for s in getattr(pool.state, name).addressable_shards]
+        assert cur[1:] == ptrs0[name][1:], (
+            name, "untouched shards were re-transferred"
+        )
+        assert cur[0] != ptrs0[name][0], (name, "row never scattered")
+    # The scattered values actually landed where the kernel reads.
+    row = np.asarray(
+        pool.state.client_seq.addressable_shards[0].data
+    )[h["slot"]]
+    assert row[1] == 3
+    # And growth still re-places everything (new shape, new buffers) —
+    # the scoped path must not break the grow invariant.
+    pool._need_clients = 16
+    pool.prepare()
+    assert pool.state.connected.shape[1] >= 16
+    assert pool._placed
+
+
+def test_scoped_scatter_differential_verdicts_unchanged():
+    """The scoped scatter is a pure placement optimization: a sharded
+    lambda that churns docs through park/restore still produces
+    bit-identical verdicts to the scalar oracle."""
+    _need_devices(2)
+    log_a, log_b = MessageLog(), MessageLog()
+    kern = KernelDeliLambda(log_a, deli_devices=2, n_docs=2,
+                            max_resident=2)
+    oracle = DeliLambda(log_b)
+    rng = random.Random(11)
+    docs = [f"doc{i}" for i in range(6)]  # > max_resident: churn
+    seqs = {d: 0 for d in docs}
+    for d in docs:
+        for log in (log_a, log_b):
+            log.topic("rawdeltas").append(
+                {"kind": "join", "doc": d, "client": 1}
+            )
+    for i in range(40):
+        d = rng.choice(docs)
+        seqs[d] += 1
+        for log in (log_a, log_b):
+            log.topic("rawdeltas").append({
+                "kind": "op", "doc": d, "client": 1,
+                "msg": DocumentMessage(client_seq=seqs[d], ref_seq=0,
+                                       contents={"i": i}),
+            })
+        kern.pump()
+        oracle.pump()
+    a = [(e["doc"], e["msg"].sequence_number,
+          e["msg"].minimum_sequence_number)
+         for e in log_a.topic("deltas").read(0) if e["kind"] == "op"]
+    b = [(e["doc"], e["msg"].sequence_number,
+          e["msg"].minimum_sequence_number)
+         for e in log_b.topic("deltas").read(0) if e["kind"] == "op"]
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
 # the chaos acceptance gate
 # ---------------------------------------------------------------------------
 

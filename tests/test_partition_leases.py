@@ -178,6 +178,35 @@ def test_deposed_owner_checkpoint_write_rejected(tmp_path):
     assert topic.read_from(0) == [{"x": 1}]
 
 
+def test_lease_expiry_race_under_clock_skew(tmp_path):
+    """Satellite: a holder whose heartbeat/renewal stalls past the TTL
+    must be fence-rejected on its next append EVEN IF its own clock
+    says the lease is live. Logical clocks throughout (seeded, no
+    sleeps): A's clock lags — it still believes t0+0.3 — while B's
+    leads past the TTL, takes over, and binds the higher fence; A's
+    subsequent write and renewal must both lose regardless of what A
+    believes the time is."""
+    t0 = 1000.0
+    a = LeaseManager(str(tmp_path), "A", ttl_s=2.0)
+    b = LeaseManager(str(tmp_path), "B", ttl_s=2.0)
+    topic = SharedFileTopic(os.path.join(str(tmp_path), "t.jsonl"))
+    fa = a.try_acquire("p0", now=t0)
+    topic.append({"x": 1}, fence=fa, owner="A")
+    # B (clock ahead / A stalled) sees the lease expired: takeover.
+    fb = b.try_acquire("p0", now=t0 + 10.0)
+    assert fb == fa + 1
+    topic.append_many([], fence=fb, owner="B")  # successor binds
+    # A wakes with its STALE local clock — the lease looks live to it.
+    with pytest.raises(FencedError):
+        topic.append({"x": 2}, fence=fa, owner="A")
+    assert not a.renew("p0", now=t0 + 0.3)  # deposed, whatever A's clock
+    assert topic.read_from(0) == [{"x": 1}]
+    # The observer view tells the stale owner from the live one by
+    # FENCE, not owner string (the lease_table satellite).
+    info = lease_table(str(tmp_path), now=t0 + 10.5)["p0"]
+    assert info["owner"] == "B" and info["fence"] == fb
+
+
 def test_fence_monotonic_across_lease_file_loss(tmp_path):
     """The monotonic counter survives lease-file deletion: a takeover
     after the lease file vanished still advances the fence (no token
@@ -315,9 +344,11 @@ def test_two_workers_split_and_failover(tmp_path):
         owners = {}
         while time.time() < deadline:
             seqd = _read_sequenced(shared, n_parts)
-            owners = lease_table(os.path.join(shared, "leases"))
-            owners = {k: v for k, v in owners.items()
-                      if k.startswith("deli-p")}
+            owners = {
+                k: v["owner"] for k, v in
+                lease_table(os.path.join(shared, "leases")).items()
+                if k.startswith("deli-p")
+            }
             if (sum(len(v) for v in seqd.values()) >= len(docs) * 30
                     and set(owners.values()) == {"A", "B"}):
                 break
@@ -369,7 +400,8 @@ def test_two_workers_split_and_failover(tmp_path):
             )
         # Ownership of A's partitions actually changed hands.
         owners = lease_table(os.path.join(shared, "leases"))
-        moved = [p for p in a_partitions if owners.get(p) == "C"]
+        moved = [p for p in a_partitions
+                 if (owners.get(p) or {}).get("owner") == "C"]
         assert moved, f"no partition visibly changed hands: {owners}"
     finally:
         for proc in (wa, wb, wc):

@@ -178,9 +178,9 @@ def test_serve_role_partition_flag_runs_one_pinned_shard(tmp_path):
         owner = None
         deadline = time.time() + 20
         while time.time() < deadline:
-            owner = lease_table(
+            owner = (lease_table(
                 os.path.join(shared, "leases")
-            ).get("deli-p1")
+            ).get("deli-p1") or {}).get("owner")
             if owner == "W":
                 break
             time.sleep(0.05)
@@ -221,7 +221,9 @@ def test_workers_balance_on_membership_change(tmp_path):
     assert settled(), (sorted(wa.roles), sorted(wb.roles))
     assert set(wa.roles) | set(wb.roles) == {0, 1, 2, 3}
     owners = lease_table(os.path.join(shared, "leases"))
-    assert set(owners.values()) == {"wA", "wB"}
+    assert {v["owner"] for v in owners.values()} == {"wA", "wB"}
+    # The fence field distinguishes every ownership generation.
+    assert all(v["fence"] >= 1 for v in owners.values())
     wa.stop()
     wb.stop()
 
@@ -257,7 +259,17 @@ def test_dead_worker_partitions_resume_exactly_once(tmp_path):
                            "clientSeq": i + 1, "refSeq": 0,
                            "contents": {"i": i}})
     router.append(second)
-    time.sleep(1.0)  # A's partition leases expire
+    # Deflake: poll the LEASE TABLE for A's leases to expire instead
+    # of a sleep-bounded guess — the fence/expiry fields make the
+    # condition exact (a loaded box can stretch "1 second" well past
+    # the TTL or not far enough).
+    dead_leases = {f"deli-p{p}" for p in dead_parts}
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        live = lease_table(os.path.join(shared, "leases"))
+        if not dead_leases & set(live):
+            break
+        time.sleep(0.05)
     ops = _drain((wb,), router, 4 + 4 * 12, deadline_s=25)
     per = {}
     for r in ops:
@@ -287,9 +299,17 @@ def test_deposed_partition_owner_write_rejected(tmp_path):
     deltas = wa.roles[p].out_topic
     assert old_fence is not None
 
-    # A stops renewing; its lease expires; a successor takes over.
+    # A stops renewing; its lease expires — polled off the lease
+    # table (exact: the entry vanishes at expiry) instead of a
+    # sleep-bounded guess. A successor then takes over and its FENCE
+    # must strictly advance past the deposed owner's.
     os.remove(wa._hb_path())
-    time.sleep(0.9)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if partition_lease_name(p) not in lease_table(
+                os.path.join(shared, "leases")):
+            break
+        time.sleep(0.05)
     wb = ShardWorker(shared, "wB", n_partitions=2, ttl_s=5.0)
     wb.heartbeat()
     deadline = time.time() + 10
@@ -299,6 +319,10 @@ def test_deposed_partition_owner_write_rejected(tmp_path):
             break
     assert wb.roles[p].fence is not None
     assert wb.roles[p].fence > old_fence
+    # And the observer view carries the successor's fence.
+    info = lease_table(os.path.join(shared, "leases"))[
+        partition_lease_name(p)]
+    assert info["fence"] == wb.roles[p].fence > old_fence
     with pytest.raises(FencedError):
         deltas.append_many(
             [{"kind": "op", "doc": "zombie", "seq": -1}],

@@ -45,6 +45,7 @@ PACKAGES = [
     "fluidframework_tpu.server.columnar_log",
     "fluidframework_tpu.server.deli_kernel",
     "fluidframework_tpu.server.monitor",
+    "fluidframework_tpu.server.queue",
     "fluidframework_tpu.server.riddler",
     "fluidframework_tpu.server.shard_fabric",
     "fluidframework_tpu.server.supervisor",

@@ -41,6 +41,11 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   device count; the SCALING assert skips loudly where only
   forced-host virtual devices over fewer cores are available (the
   correctness gate still runs there).
+- config 8: elastic-rebalance guard — a live range split committed
+  mid-run over the elastic hash-range fabric must cost < 25% of the
+  steady aggregate ops/s, with the mid-split stream bit-identical to
+  the steady topology's (the convergence half runs on every host;
+  the perf assert skips loudly on < 4 cores).
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -488,6 +493,58 @@ def config7_multichip(min_ratio: float = 2.0,
     return result
 
 
+def config8_rebalance(max_cost_pct: float = 25.0,
+                      min_cores: int = 4) -> dict:
+    """Elastic-rebalance guard (server.shard_fabric hash-range
+    topology): a range SPLIT committed mid-run over the config-5-shape
+    workload (10k docs x 64 clients -> 1.28M records at full scale)
+    must cost the fabric less than `max_cost_pct` percent of its
+    steady aggregate ops/s. FAILS LOUDLY on regression.
+
+    The CONVERGENCE gate always runs — even on hosts too small to
+    measure the cost honestly (< `min_cores` cores: the split's extra
+    child processes time-slice the same cores and the ratio measures
+    the scheduler), a scaled-down run still proves the mid-run split
+    leaves the merged stream bit-identical to the steady topology's;
+    only the PERF assert is skipped, loudly."""
+    from fluidframework_tpu.testing.deli_bench import run_rebalance_bench
+
+    cores = os.cpu_count() or 1
+    if cores < min_cores:
+        res = run_rebalance_bench(
+            n_docs=max(8, int(256 * SCALE)), n_clients=4,
+            ops_per_client=1,
+        )
+        result = {
+            "config": "elastic_rebalance_guard",
+            "skipped": (
+                f"host has {cores} cores < {min_cores}: split cost "
+                f"cannot be measured honestly here; convergence gate "
+                f"ran ({res['gate']})"
+            ),
+            "cores": cores, "max_cost_pct": max_cost_pct,
+            "convergence_records": res["records"],
+            "split_cost_pct_unreliable": res["split_cost_pct"],
+        }
+        print(
+            f"SKIP config8_rebalance perf assert: {result['skipped']}",
+            file=sys.stderr,
+        )
+        return result
+    res = run_rebalance_bench(
+        n_docs=max(8, int(10_000 * SCALE)), n_clients=64,
+        ops_per_client=1,
+    )
+    result = {"config": "elastic_rebalance_guard",
+              "max_cost_pct": max_cost_pct, **res}
+    assert res["split_cost_pct"] < max_cost_pct, (
+        f"mid-run split cost the fabric {res['split_cost_pct']:.1f}% "
+        f"aggregate ops/s (budget {max_cost_pct}%) on a {cores}-core "
+        f"host: {result}"
+    )
+    return result
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -567,7 +624,7 @@ def main() -> None:
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
                config5_metrics_overhead, config5_log_format,
                config6_shard_scaling, config7_multichip,
-               config_streaming_ingress):
+               config8_rebalance, config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

@@ -1,10 +1,21 @@
 """Reproducible chaos-suite entry point.
 
-Run: python tools/chaos_run.py --seed N [--faults kill,torn,lease,net,client]
+Run: python tools/chaos_run.py --seed N
+        [--faults kill,torn,lease,net,client,split,merge,disk]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH]
-        [--partitions N] [--workers W] [--devices N]
+        [--partitions N] [--workers W] [--devices N] [--elastic]
+
+`--faults split,merge,disk` (with `--partitions` > 1) runs the ELASTIC
+hash-range fabric and injects topology changes as faults: a live
+range SPLIT mid-run (the pre-split owner's stale-fence write must be
+demonstrably FencedError-rejected), a live MERGE of adjacent ranges,
+and a DISK episode (ENOSPC + stalled fsync on the workers' durable
+writes — roles must degrade gracefully through bounded-retry backoff,
+`degraded` visible in health(), and recover with no lost acknowledged
+record). `--elastic` alone runs the classic fault set against the
+elastic fabric.
 
 `--devices N` (with `--deli kernel`) shards the kernel deli's doc-slot
 pool across an N-device mesh inside the deli child (forced virtual
@@ -67,6 +78,8 @@ from fluidframework_tpu.server.supervisor import (  # noqa: E402
     LOG_FORMATS,
 )
 from fluidframework_tpu.testing.chaos import (  # noqa: E402
+    ALL_FAULT_CLASSES,
+    ELASTIC_FAULTS,
     FAULT_CLASSES,
     ChaosConfig,
     run_chaos,
@@ -88,11 +101,15 @@ def main() -> int:
     metrics_out = _take("--metrics-out", None)
     faults_arg = _take("--faults", None)
     n_partitions = int(_take("--partitions", "1"))
+    elastic = "--elastic" in args
+    if elastic:
+        args.remove("--elastic")
     if faults_arg is None:
-        # Default fault set: everything the chosen runner supports.
-        # The sharded runner has no socket consumer, so "net" is only
-        # meaningful (and only accepted) single-partition; asking for
-        # it explicitly with --partitions >1 fails loudly in run_chaos.
+        # Default fault set: the classic classes the chosen runner
+        # supports. The sharded runner has no socket consumer, so
+        # "net" is only meaningful (and only accepted)
+        # single-partition; the elastic classes (split/merge/disk)
+        # are opt-in — naming them turns the elastic fabric on.
         default_faults = [f for f in FAULT_CLASSES
                           if n_partitions == 1 or f != "net"]
         faults_arg = ",".join(default_faults)
@@ -113,19 +130,23 @@ def main() -> int:
         deli_devices=(lambda v: int(v) if v else None)(
             _take("--devices", None)
         ),
+        elastic=elastic,
     )
-    unknown = set(faults) - set(FAULT_CLASSES)
+    unknown = set(faults) - set(ALL_FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
             or cfg.log_format not in LOG_FORMATS):
         print(
             f"unknown faults {sorted(unknown)} / leftover args {args}; "
-            f"faults are chosen from {','.join(FAULT_CLASSES)}; "
+            f"faults are chosen from {','.join(ALL_FAULT_CLASSES)} "
+            f"({','.join(ELASTIC_FAULTS)} need --partitions > 1); "
             f"--deli is one of {'|'.join(DELI_IMPLS)}; "
             f"--log-format is one of {'|'.join(LOG_FORMATS)}",
             file=sys.stderr,
         )
         return 2
     shard = (f" partitions={cfg.n_partitions} workers={cfg.n_workers}"
+             + (" elastic" if cfg.elastic
+                or any(f in ELASTIC_FAULTS for f in faults) else "")
              if cfg.n_partitions > 1 else "")
     dev = (f" devices={cfg.deli_devices}"
            if cfg.deli_devices and cfg.deli_devices > 1 else "")
@@ -143,6 +164,10 @@ def main() -> int:
     print(f"scribe fold   : {'match' if res.scribe_ok else 'MISMATCH'}")
     print(f"dup seqs={res.duplicate_seqs} skipped seqs={res.skipped_seqs} "
           f"fence rejections={res.fence_rejections}")
+    if res.epochs:
+        print(f"topology epochs: {res.epochs}")
+    if "disk" in faults:
+        print(f"degraded seen : {res.degraded_seen}")
     print(f"restarts: {res.restarts}")
     if res.timeline:
         t0 = res.timeline[0][0]

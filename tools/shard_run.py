@@ -5,6 +5,16 @@ Run: python tools/shard_run.py [--partitions N] [--workers W]
         [--docs D] [--clients C] [--ops K] [--deli scalar|kernel]
         [--log-format json|columnar] [--boxcar-rate R] [--ttl S]
         [--timeout S] [--keep DIR] [--kill-worker I]
+        [--elastic] [--split-mid-run] [--merge-after-split]
+
+`--elastic` runs the hash-range topology (`queue.RangeLeaseStore`):
+partitions are range leases, routed by ``(epoch, hash(doc))``, and
+the merged read rides per-range cursors across the whole topology
+history. `--split-mid-run` stages a live split of the widest owned
+range once half the workload is fed (`--merge-after-split` merges the
+children back before the drain completes) — a live demonstration
+that capacity follows load without a restart: the order must not
+notice N changing mid-stream.
 
 Builds a seeded workload over partition-balanced doc names, starts
 `server.shard_fabric.ShardFabricSupervisor` (W supervised shard
@@ -79,6 +89,20 @@ def main() -> int:
     timeout = float(_take("--timeout", "120"))
     keep = _take("--keep", None)
     kill_worker = _take("--kill-worker", None)
+    elastic = "--elastic" in args
+    if elastic:
+        args.remove("--elastic")
+    split_mid_run = "--split-mid-run" in args
+    if split_mid_run:
+        args.remove("--split-mid-run")
+        elastic = True
+    merge_after = "--merge-after-split" in args
+    if merge_after:
+        args.remove("--merge-after-split")
+    if merge_after and not split_mid_run:
+        print("--merge-after-split needs --split-mid-run",
+              file=sys.stderr)
+        return 2
     if args or deli not in DELI_IMPLS or log_format not in LOG_FORMATS:
         print(
             f"leftover args {args}; --deli is one of "
@@ -101,17 +125,22 @@ def main() -> int:
         r["doc"] for r in workload if isinstance(r, dict) and "doc" in r
     }
 
-    router = ShardRouter(shared, n_partitions, log_format)
+    router = ShardRouter(shared, n_partitions, log_format,
+                         elastic=elastic)
     sup = ShardFabricSupervisor(
         shared, n_workers=n_workers, n_partitions=n_partitions,
         ttl_s=ttl, deli_impl=deli, log_format=log_format,
+        elastic=elastic,
     ).start()
     killed = False
+    split_cmd = None
+    merge_cmd = None
     t0 = time.time()
     try:
         fed = 0
         deadline = time.time() + timeout
         ops = []
+        reader = router.merged_reader()
         while time.time() < deadline:
             sup.poll_once()
             if fed < len(workload):
@@ -125,11 +154,41 @@ def main() -> int:
                         proc.kill()
                         killed = True
                         print(f"SIGKILL {slot} mid-stream", flush=True)
-            ops = []
-            for t in router.deltas_topics():
-                ops += [r for r in t.read_from(0)
-                        if isinstance(r, dict) and r.get("kind") == "op"]
-            if fed >= len(workload) and len(ops) >= len(golden):
+                if (split_mid_run and split_cmd is None
+                        and fed >= len(workload) // 2):
+                    split_cmd = sup.request_split()
+                    print("split requested mid-stream", flush=True)
+            if (split_cmd is not None and merge_after
+                    and merge_cmd is None):
+                done = sup.control_result(split_cmd)
+                topo = sup.topology()
+                if done and not done.get("error") and topo:
+                    ranges = sorted(topo["ranges"],
+                                    key=lambda e: e["lo"])
+                    for a, b in zip(ranges, ranges[1:]):
+                        if a["preds"] and a["preds"] == b["preds"]:
+                            merge_cmd = sup.request_merge(
+                                a["rid"], b["rid"]
+                            )
+                            print("merge requested mid-stream",
+                                  flush=True)
+                            break
+            # Merged catch-up read: per-range cursors across the whole
+            # topology history — records written under epoch E stay
+            # readable after E+1, incrementally.
+            ops += [r for r in reader.poll()
+                    if isinstance(r, dict) and r.get("kind") == "op"]
+            # A requested topology change must actually COMMIT before
+            # the run ends — a small workload must not outrun the demo.
+            ctl_done = (
+                (split_cmd is None
+                 or sup.control_result(split_cmd) is not None)
+                and (not merge_after or split_cmd is None
+                     or (merge_cmd is not None
+                         and sup.control_result(merge_cmd) is not None))
+            )
+            if (fed >= len(workload) and len(ops) >= len(golden)
+                    and ctl_done):
                 break
             time.sleep(0.02)
         elapsed = time.time() - t0
@@ -139,16 +198,22 @@ def main() -> int:
     digest = stream_digest(ops)
     dups, skips = sequence_integrity(ops)
     converged = digest == gdigest and dups == 0 and skips == 0
+    topo = sup.topology()
     print(f"golden digest : {gdigest}")
     print(f"fabric digest : {digest}")
     print(f"ops           : {len(ops)}/{len(golden)} in {elapsed:.2f}s "
           f"({len(ops) / max(elapsed, 1e-9):,.0f} ops/s aggregate)")
     print(f"dup seqs={dups} skipped seqs={skips}")
     print(f"partition owners: {sup.partition_owners()}")
+    if topo is not None:
+        print(f"topology epoch {topo['epoch']}: "
+              f"{[e['rid'] for e in topo['ranges']]}")
     print(f"worker restarts : {sup.restarts}")
     print(json.dumps({
         "metric": "shard_run", "partitions": n_partitions,
         "workers": n_workers, "deli": deli, "log_format": log_format,
+        "elastic": elastic,
+        "epoch": topo["epoch"] if topo else None,
         "records": len(workload), "ops": len(ops),
         "seconds": round(elapsed, 3), "converged": converged,
         "restarts": sup.restarts,
