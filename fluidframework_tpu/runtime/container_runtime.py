@@ -522,7 +522,22 @@ class ContainerRuntime(EventEmitter):
             )
         s = tr.get("submit")
         if s is not None:
-            self._stage_hists["submit_to_apply"].observe((now - s) * 1000.0)
+            e2e = (now - s) * 1000.0
+            self._stage_hists["submit_to_apply"].observe(e2e)
+            # Slow-op flight recorder: an apply whose end-to-end
+            # latency crosses the rolling p99 (or fixed threshold)
+            # keeps its full span — the exact op behind a p99 spike.
+            # Two-phase so the steady state never builds a span dict.
+            from ..utils.metrics import get_flight_recorder
+
+            fr = get_flight_recorder()
+            if fr.note(e2e):
+                fr.add(e2e, {
+                    "client": msg.client_id,
+                    "clientSeq": msg.client_seq,
+                    "seq": msg.sequence_number,
+                    "stages": {**tr, "apply": now},
+                })
 
     def _process_one(self, msg: SequencedMessage) -> None:
         if msg.traces:

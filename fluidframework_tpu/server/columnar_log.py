@@ -184,6 +184,9 @@ class ColumnarFileTopic(SharedFileTopic):
                 os.fsync(f.fileno())
                 # Data is durable BEFORE the committed length names it.
                 self._write_committed(clean + len(frame))
+        # Event-driven consumers wake now (outside the lock, after
+        # durability — queue.TopicDoorbell semantics, both formats).
+        self._ring_doorbells()
         return len(frame)
 
     # ------------------------------------------------------------- read

@@ -13,10 +13,19 @@ the in-proc `LocalServer` and the supervised farm
   tools/metrics_report.py).
 - ``GET /healthz``       — liveness JSON from the bound health
   callback; HTTP 200 iff ``status == "ok"``, 503 otherwise.
+- ``GET /slo``           — the tail-latency summary: every histogram
+  with observations reduced to count/mean/p50/p95/p99
+  (bucket-interpolated, `utils.metrics.slo_summary`).
+- ``GET /traces``        — the slow-op flight recorder's span buffer
+  (`utils.metrics.FlightRecorder`): the exact ops whose end-to-end
+  latency crossed the threshold/rolling p99, with all their stage
+  timestamps, so a tail regression report carries its evidence.
 
 The registry may be passed as an instance or a zero-arg callable
 returning one — the supervisor rebuilds its registry per scrape by
-merging the children's heartbeat snapshots.
+merging the children's heartbeat snapshots; `traces` likewise accepts
+a zero-arg callable returning the span list (defaults to the process
+flight recorder).
 """
 
 from __future__ import annotations
@@ -24,9 +33,14 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Any, Callable, Dict, List, Optional, Union
 
-from ..utils.metrics import MetricsRegistry, get_registry
+from ..utils.metrics import (
+    MetricsRegistry,
+    get_flight_recorder,
+    get_registry,
+    slo_summary,
+)
 
 __all__ = ["MetricsServer"]
 
@@ -46,9 +60,11 @@ class MetricsServer:
         health: Optional[Callable[[], Dict[str, Any]]] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        traces: Optional[Callable[[], List[dict]]] = None,
     ):
         self._registry = registry
         self._health = health
+        self._traces = traces
         self.host = host
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -81,6 +97,11 @@ class MetricsServer:
         if "status" not in out:
             out = {"status": "ok", **out}
         return out
+
+    def _resolve_traces(self) -> List[dict]:
+        if self._traces is None:
+            return get_flight_recorder().snapshot()
+        return self._traces()
 
     # -------------------------------------------------------- lifecycle
 
@@ -115,6 +136,22 @@ class MetricsServer:
                             200,
                             json.dumps(
                                 server._resolve_registry().snapshot()
+                            ),
+                            "application/json",
+                        )
+                    elif path == "/slo":
+                        self._reply(
+                            200,
+                            json.dumps(slo_summary(
+                                server._resolve_registry().snapshot()
+                            )),
+                            "application/json",
+                        )
+                    elif path == "/traces":
+                        self._reply(
+                            200,
+                            json.dumps(
+                                {"slow_ops": server._resolve_traces()}
                             ),
                             "application/json",
                         )

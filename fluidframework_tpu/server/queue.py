@@ -225,6 +225,81 @@ class SharedFileTopic:
         if not os.path.exists(path):
             with open(path, "a"):
                 pass
+        # Doorbell producer state: cached write fds into registered
+        # consumer bells (re-listed per ring — see _ring_doorbells).
+        self._bell_wfds: Dict[str, int] = {}
+
+    def __del__(self):
+        # Short-lived topic objects (probes, one-shot appenders) must
+        # not leak the ring fds they cached.
+        for fd in (getattr(self, "_bell_wfds", None) or {}).values():
+            try:
+                os.close(fd)
+            except Exception:
+                pass
+
+    # -------------------------------------------------------- doorbells
+
+    def _ring_doorbells(self) -> None:
+        """Wake every doorbell-registered consumer of this topic (one
+        byte per bell). Costs a single failed stat when no consumer
+        ever registered; rings AFTER the append went durable, outside
+        the append lock, so waking consumers never contend with the
+        writer. Purely advisory — any failure here degrades to the
+        consumer's bounded-timeout poll."""
+        d = self.path + ".bells"
+        try:
+            names = {n for n in os.listdir(d) if not n.startswith(".")}
+        except OSError:
+            return  # no consumer ever registered: one failed syscall
+        # Re-list per ring rather than caching on the dir mtime: write
+        # fds are still cached (the per-ring cost is one listdir next
+        # to an append that already paid open+flock+fsync), but
+        # DISCOVERY never trusts directory attributes — network/
+        # passthrough filesystems (v9fs CI containers) cache those
+        # across processes, and a bell registered after the first scan
+        # would stay invisible to the ringer forever.
+        cache = self._bell_wfds
+        for name in list(cache):
+            if name not in names:
+                try:
+                    os.close(cache.pop(name))
+                except OSError:
+                    cache.pop(name, None)
+        for name in names:
+            if name in cache:
+                continue
+            try:
+                cache[name] = os.open(
+                    os.path.join(d, name),
+                    os.O_WRONLY | os.O_NONBLOCK,
+                )
+            except OSError as exc:
+                # ENXIO alone means "no live reader" — the consumer
+                # died (its O_RDWR fd vanished with it); reap the bell
+                # so a churned farm can't accumulate dead FIFOs. Any
+                # OTHER error (EMFILE, EACCES...) is a PRODUCER-side
+                # problem: unlinking would permanently sever a live
+                # consumer with no re-registration path — leave it for
+                # a later ring to open.
+                if exc.errno == errno.ENXIO:
+                    try:
+                        os.unlink(os.path.join(d, name))
+                    except OSError:
+                        pass
+        for name, fd in list(cache.items()):
+            try:
+                os.write(fd, b"!")
+            except BlockingIOError:
+                pass  # pipe full: a wake is already pending
+            except OSError:
+                # Reader went away since we opened (EPIPE): drop the
+                # fd; the next ring's listing reaps the file.
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+                cache.pop(name, None)
 
     # ------------------------------------------------------------ fence
 
@@ -291,6 +366,8 @@ class SharedFileTopic:
                 f.write(payload)
                 f.flush()
                 os.fsync(f.fileno())
+        if messages:
+            self._ring_doorbells()
         return len(payload)
 
     # ------------------------------------------------------------- read
@@ -334,6 +411,117 @@ class SharedFileTopic:
 
     def read_from(self, offset: int) -> List[Any]:
         return [v for _, v in self.read_entries(offset)[0]]
+
+
+# ---------------------------------------------------------------------------
+# topic doorbells (event-driven new-records wakeup)
+# ---------------------------------------------------------------------------
+
+# Kill switch: FLUID_DOORBELL=0 keeps every consumer on the pure poll
+# loop (the latency bench's baseline variant; also the escape hatch on
+# a platform where FIFOs misbehave).
+DOORBELL_ENV = "FLUID_DOORBELL"
+
+_bell_seq = 0
+
+
+def doorbells_enabled() -> bool:
+    """Whether event-driven topic wakeups are available AND wanted.
+    Doorbells are advisory only — with them off (or unsupported: no
+    ``os.mkfifo``), every consumer falls back to the bounded-timeout
+    poll loop it always had, so fencing/torn-read semantics never
+    depend on this answer."""
+    return (os.environ.get(DOORBELL_ENV, "1").lower()
+            not in ("0", "off", "no")
+            and hasattr(os, "mkfifo"))
+
+
+class TopicDoorbell:
+    """One consumer's wakeup line for one topic.
+
+    A FIFO under ``<topic path>.bells/``: `append_many` writes one
+    byte into every registered bell after its records are durable, and
+    the consumer waits on its bell with a BOUNDED timeout — so the
+    idle-poll interval stack that dominates low-load end-to-end
+    latency collapses to an event wake, while the timeout keeps poll
+    as the correctness fallback (a bell rung between the consumer's
+    empty poll and its wait, a lost FIFO, a disabled platform: all
+    degrade to exactly the old behavior).
+
+    The consumer holds the FIFO open O_RDWR (nonblocking): the
+    always-present reader means a producer's O_WRONLY|O_NONBLOCK open
+    succeeds while the consumer lives (ENXIO = consumer died, the
+    producer garbage-collects the bell), and the always-present writer
+    means the read end never signals EOF-readable to select — no busy
+    wake. The FIFO is created under a dot-name and renamed into place
+    only after the read end is open, so a producer can never observe a
+    bell without a live reader and wrongly reap it."""
+
+    def __init__(self, topic_path: str):
+        global _bell_seq
+        self.dir = topic_path + ".bells"
+        os.makedirs(self.dir, exist_ok=True)
+        _bell_seq += 1
+        name = f"{os.getpid()}-{_bell_seq}.bell"
+        tmp = os.path.join(self.dir, f".{name}.tmp")
+        self.path = os.path.join(self.dir, name)
+        os.mkfifo(tmp)
+        self._fd = os.open(tmp, os.O_RDWR | os.O_NONBLOCK)
+        os.rename(tmp, self.path)
+
+    def fileno(self) -> int:
+        return self._fd
+
+    def drain(self) -> bool:
+        """Consume pending ring bytes; True iff any were pending."""
+        rang = False
+        try:
+            while os.read(self._fd, 4096):
+                rang = True
+        except (BlockingIOError, OSError):
+            pass
+        return rang
+
+    def wait(self, timeout_s: float) -> bool:
+        return wait_doorbells([self], timeout_s)
+
+    def close(self) -> None:
+        if self._fd is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def wait_doorbells(bells: List["TopicDoorbell"],
+                   timeout_s: float) -> bool:
+    """Sleep until ANY of `bells` rings or `timeout_s` elapses (the
+    poll fallback); returns whether a ring woke us. Rings that arrived
+    while the consumer was busy processing are still pending in the
+    pipe, so the next wait returns immediately — a wakeup is never
+    lost, only (harmlessly) early."""
+    import select
+
+    fds = [b._fd for b in bells if b is not None and b._fd is not None]
+    if not fds:
+        time.sleep(timeout_s)
+        return False
+    try:
+        ready, _, _ = select.select(fds, [], [], timeout_s)
+    except OSError:
+        time.sleep(timeout_s)
+        return False
+    if not ready:
+        return False
+    for b in bells:
+        if b is not None and b._fd in ready:
+            b.drain()
+    return True
 
 
 class TailReader:

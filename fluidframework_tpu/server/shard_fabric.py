@@ -176,6 +176,16 @@ class _RangedMixin:
         )
         self._preds: Dict[str, dict] = {}
         self._hash_cache: Dict[str, int] = {}
+        # Cursor-retirement grace: a predecessor continuously quiescent
+        # this long is declared fully absorbed and its cursor dropped
+        # from new checkpoints (see `_retire_pred`). Two lease TTLs
+        # comfortably covers the only writer that could still land a
+        # record there — a router whose topology read raced the commit
+        # (routers stat-refresh the epoch record per append).
+        self.pred_retire_s = max(1.0, 2.0 * self.leases.ttl_s)
+        self._m_preds_retired = self.metrics.counter(
+            "shard_pred_cursors_retired_total", **self._metric_labels()
+        )
         for prid in self.pred_rids:
             self._add_pred(prid, None)
 
@@ -198,6 +208,12 @@ class _RangedMixin:
                 self.log_format,
             ),
             "reader": None,
+            # Retirement state: "done" preds are fully absorbed (their
+            # cursor is dropped from new checkpoints, replaced by a
+            # done_preds tombstone); quiet_since tracks continuous
+            # quiescence toward that declaration.
+            "done": False,
+            "quiet_since": None,
         }
 
     def _in_range(self, doc_id: str) -> bool:
@@ -213,18 +229,28 @@ class _RangedMixin:
     # ------------------------------------------------------- state shape
 
     def snapshot_state(self):
-        return {
+        st = {
             "__ranged__": 1,
             "docs": super().snapshot_state(),
             "preds": {prid: p["off"] for prid, p in self._preds.items()
-                      if p["off"] is not None},
+                      if p["off"] is not None and not p["done"]},
             "epoch": self.topo_epoch,
         }
+        done = sorted(p for p, e in self._preds.items() if e["done"])
+        if done:
+            # Tombstones, not cursors: a restart must know these were
+            # ABSORBED (skip re-absorption entirely), not merely never
+            # seen (which would rescan the predecessor from offset 0).
+            st["done_preds"] = done
+        return st
 
     def restore_state(self, state):
         if isinstance(state, dict) and state.get("__ranged__"):
             for prid, off in (state.get("preds") or {}).items():
                 self._add_pred(prid, int(off))
+            for prid in state.get("done_preds") or ():
+                self._add_pred(prid, None)
+                self._preds[prid]["done"] = True
             super().restore_state(state.get("docs"))
         else:
             super().restore_state(state)
@@ -284,7 +310,9 @@ class _RangedMixin:
         tail emitted) BEFORE the own-topic gap replay — a doc's own-
         topic records always postdate its predecessor records, so
         parent-first is the per-document input order (ancestors
-        before descendants for the same reason)."""
+        before descendants for the same reason). Retired (done) preds
+        are skipped outright — their tombstone in the checkpoint says
+        every record they ever held is already absorbed."""
         for prid in self._ordered_preds():
             self._absorb_pred(prid)
 
@@ -379,7 +407,10 @@ class _RangedMixin:
         started, hence is consumed by it; processing pred-then-buffer
         therefore reproduces every doc's true input order no matter
         how the wall clock interleaved the topics."""
-        if self.fence is None or not self._preds:
+        if self.fence is None or not self._ordered_preds():
+            # No predecessors left to watch (none ever, or all retired
+            # as fully absorbed): the classic single-topic quantum —
+            # retirement also removes the per-step pred tail polls.
             return super().step(idle_sleep)
         self._renew_or_die()
         if self._reader is None or self._reader.next_line != self.offset:
@@ -413,7 +444,7 @@ class _RangedMixin:
                 self._fenced_exit(exc)
             self.heartbeat()
             if not pred_moved:
-                time.sleep(idle_sleep)
+                self._idle_wait(idle_sleep)
             return pred_moved
         self.flush_batch(out)
         try:
@@ -439,15 +470,38 @@ class _RangedMixin:
         raise SystemExit(EXIT_FENCED)
 
     def _ordered_preds(self) -> List[str]:
-        """Predecessors oldest-first (birth epoch off the rid tag):
-        in a chain — grandparent inherited from a split-of-a-split —
-        the older range's records precede the newer's per doc, so
-        drains run ancestors before descendants."""
+        """LIVE (non-retired) predecessors oldest-first (birth epoch
+        off the rid tag): in a chain — grandparent inherited from a
+        split-of-a-split — the older range's records precede the
+        newer's per doc, so drains run ancestors before descendants."""
         def birth(rid: str) -> int:
             head, sep, tail = rid.rpartition("-e")
             return int(tail) if sep and tail.isdigit() else 1
 
-        return sorted(self._preds, key=birth)
+        return sorted(
+            (p for p, e in self._preds.items() if not e["done"]),
+            key=birth,
+        )
+
+    def _retire_pred(self, prid: str) -> None:
+        """Declare `prid` fully absorbed and drop its cursor from new
+        checkpoints (ROADMAP item-2 follow-up). Two facts make this
+        safe: (1) the topology history marks every pred DEAD by
+        construction — this role only exists because the epoch commit
+        replaced them, and a committed range never returns (a merge
+        recreating its bounds is a fresh incarnation with a fresh
+        rid); (2) the pred's raw topic has been continuously quiescent
+        for `pred_retire_s` (two lease TTLs past the last record),
+        which outlasts the only straggler writer possible — a router
+        whose per-append topology stat raced the commit. From here the
+        checkpoint carries a tombstone instead of a cursor, restarts
+        skip re-absorption, and the steady-state pump stops polling
+        the dead tail."""
+        p = self._preds[prid]
+        p["done"] = True
+        p["reader"] = None
+        self._ckpt_dirty = True
+        self._m_preds_retired.inc()
 
     def _pump_preds(self) -> int:
         """Drain every predecessor tail to QUIESCENCE: full passes
@@ -467,8 +521,8 @@ class _RangedMixin:
 
     def _pump_one_pred(self, prid: str) -> int:
         p = self._preds[prid]
-        if p["off"] is None:
-            return 0  # absorbed at recovery before any pump
+        if p["done"] or p["off"] is None:
+            return 0  # retired / absorbed at recovery before any pump
         taken = 0
         while True:
             reader = p["reader"]
@@ -481,7 +535,20 @@ class _RangedMixin:
                 if reader.next_line != p["off"]:
                     p["off"] = reader.next_line
                     self._ckpt_dirty = True
+                    p["quiet_since"] = None  # junk lines still count
+                elif taken == 0:
+                    # A fully quiet pass: start (or continue) the
+                    # retirement clock; past the grace, the cursor is
+                    # dropped from future checkpoints.
+                    now = time.time()
+                    if p["quiet_since"] is None:
+                        p["quiet_since"] = now
+                    elif now - p["quiet_since"] >= self.pred_retire_s:
+                        self._retire_pred(prid)
+                else:
+                    p["quiet_since"] = None
                 return taken
+            p["quiet_since"] = None
             out: List[dict] = []
             for i, rec in entries:
                 if self._mine(rec):
@@ -687,16 +754,34 @@ class ShardRouter:
 
     def append(self, records: List[Any]) -> Dict[Any, int]:
         """Route + append one ingress batch; returns records appended
-        per partition (keyed by index, or by range id when elastic)."""
+        per partition (keyed by index, or by range id when elastic).
+
+        Elastic appends are epoch-rechecked AFTER landing: if the
+        topology moved while this batch was in flight (a router stalled
+        between its refresh and its appends can outlive even the
+        pred-cursor retirement grace — the one hole pure tail-draining
+        can't cover), the batch is re-routed under the new epoch. The
+        duplicate delivery is safe by construction: if a successor
+        still drains the old topic, resubmission dedup silences the
+        second copy (per-client clientSeq); if the old range's cursor
+        was already retired, the first copy is simply never read and
+        the re-route is the only live one. Bounded: one re-route per
+        epoch change observed, and epochs only advance."""
         counts: Dict[Any, int] = {}
         if self.elastic:
             self._refresh()
-            by_rid = self.split_elastic(records)
-            rid_to_raw = {e["rid"]: e["raw"]
-                          for e in self.topology["ranges"]}
-            for rid, recs in by_rid.items():
-                self._topic(rid_to_raw[rid]).append_many(recs)
-                counts[rid] = len(recs)
+            for _ in range(64):  # paranoia bound; epochs move rarely
+                epoch = self.topology["epoch"]
+                by_rid = self.split_elastic(records)
+                rid_to_raw = {e["rid"]: e["raw"]
+                              for e in self.topology["ranges"]}
+                counts = {}
+                for rid, recs in by_rid.items():
+                    self._topic(rid_to_raw[rid]).append_many(recs)
+                    counts[rid] = len(recs)
+                self._refresh()
+                if self.topology["epoch"] == epoch:
+                    return counts
             return counts
         for p, recs in self.split(records).items():
             self.topics[p].append_many(recs)
@@ -992,6 +1077,7 @@ class ShardWorker:
         role = self.roles.pop(key, None)
         if role is None:
             return
+        role.close_doorbell()
         if role.fence is not None:
             try:
                 role.checkpoint()
@@ -1038,6 +1124,7 @@ class ShardWorker:
                 owner = self._probe.owner_of(self._lease_name(p))
                 if owner is not None and owner != self.owner:
                     self.roles.pop(p)
+                    role.close_doorbell()
         # Acquire free/expired partitions up to target, scanning from a
         # slot-dependent start so peers spread instead of colliding.
         if len(self.roles) < target and keys:
@@ -1219,10 +1306,12 @@ class ShardWorker:
                 moved += role.step(idle_sleep=0)
             except SystemExit as exc:
                 self.roles.pop(p, None)
+                role.close_doorbell()
                 self._m_drops.inc()
                 self._event(f"dropped {self._kname(p)} (exit={exc.code})")
             except FencedError as exc:
                 self.roles.pop(p, None)
+                role.close_doorbell()
                 self._m_drops.inc()
                 self._event(f"dropped {self._kname(p)} (fenced: {exc})")
         now = time.time()
@@ -1231,6 +1320,25 @@ class ShardWorker:
         if now - self._hb_t > self.ttl_s / 3:
             self.heartbeat()
         return moved
+
+    def idle_wait(self, timeout_s: float) -> None:
+        """The worker's idle quantum: wait on ALL owned partitions'
+        input-topic doorbells at once (any append wakes the next
+        step), bounded by `timeout_s` so the sweep/heartbeat cadence
+        and the poll fallback are unaffected."""
+        from .queue import wait_doorbells
+
+        bells = [b for b in (r.doorbell() for r in self.roles.values())
+                 if b is not None]
+        if bells:
+            # Bounded stretch (the _Role.bell_wait_s rationale), capped
+            # so the sweep/heartbeat cadence (ttl/2, ttl/3) still runs
+            # on time.
+            wait_doorbells(
+                bells, min(max(timeout_s, 0.05), self.ttl_s / 6)
+            )
+        else:
+            time.sleep(timeout_s)
 
     def stop(self) -> None:
         """Graceful exit: hand every partition off now instead of
@@ -1259,7 +1367,7 @@ def serve_shard_worker(shared_dir: str, slot: str,
     print(banner, flush=True)
     while True:
         if w.step() == 0:
-            time.sleep(idle_sleep)
+            w.idle_wait(idle_sleep)
 
 
 # ---------------------------------------------------------------------------

@@ -63,6 +63,8 @@ from .queue import (
     LeaseManager,
     SharedFileTopic,
     TailReader,
+    TopicDoorbell,
+    doorbells_enabled,
     partition_suffix,
     retry_durable,
 )
@@ -88,6 +90,22 @@ ROLES = ("deli", "scriptorium", "scribe", "broadcaster")
 
 EXIT_DEPOSED = 4  # lease renew failed: a successor owns the role
 EXIT_FENCED = 3  # write-path fence rejection: we are a zombie
+
+# Opt-in WIRE tracing for the supervised farm: with FLUID_TRACE_WIRE
+# set, the deli stamps per-stage wall-clock timestamps into a "tr" dict
+# on its output records and scriptorium/broadcaster extend it — the
+# farm twin of the in-proc `SequencedMessage.traces`. Off by default:
+# timestamps differ run to run, so any bit-identity comparison that
+# keeps all record keys must run untraced. Digest/convergence forms are
+# safe either way (`canonical_record` keeps a fixed key set that
+# excludes "tr").
+TRACE_WIRE_ENV = "FLUID_TRACE_WIRE"
+
+
+def trace_wire_enabled() -> bool:
+    return os.environ.get(TRACE_WIRE_ENV, "").lower() not in (
+        "", "0", "off", "no"
+    )
 
 
 def _topic_path(shared_dir: str, name: str) -> str:
@@ -215,6 +233,23 @@ class _Role:
         self.degraded = False
         self._reader: Optional[TailReader] = None
         self._last_renew = 0.0
+        # Event-driven idle: instead of sleeping the poll interval
+        # blind, the idle branch waits on the input topic's doorbell
+        # (queue.TopicDoorbell) with the SAME bounded timeout — an
+        # append wakes the role immediately, and a missed ring only
+        # costs the old poll latency. Created lazily on first idle so
+        # bench-driven roles (which never idle) register no FIFO.
+        self._bell: Optional[TopicDoorbell] = None
+        self._doorbell_ok = doorbells_enabled()
+        # Wire tracing (off by default — see TRACE_WIRE_ENV) and the
+        # per-stage histogram cache it feeds. `_recovering` gates the
+        # OBSERVATION side off during recovery's silent replay:
+        # replayed records would otherwise be observed a second time,
+        # with a "latency" that spans the crash — phantom multi-second
+        # slow ops in the very evidence surface this exists for.
+        self.trace_wire = trace_wire_enabled()
+        self._recovering = False
+        self._stage_hists: Dict[str, Any] = {}
         self._hb_path = os.path.join(shared_dir, "hb", f"{self.name}.json")
         os.makedirs(os.path.dirname(self._hb_path), exist_ok=True)
         # Checkpoint-cadence state + role metrics. The registry is
@@ -269,6 +304,60 @@ class _Role:
         (`shard_fabric._RangedMixin`) absorb their predecessor ranges'
         tails here. Classic roles have no predecessors."""
 
+    # -------------------------------------------------------- doorbells
+
+    def doorbell(self) -> Optional[TopicDoorbell]:
+        """This role's input-topic doorbell (created lazily; None when
+        doorbells are disabled or the FIFO cannot be made — the caller
+        then falls back to the plain poll sleep)."""
+        if not self._doorbell_ok:
+            return None
+        if self._bell is None:
+            try:
+                self._bell = TopicDoorbell(self.in_topic.path)
+            except OSError:
+                self._doorbell_ok = False
+                return None
+        return self._bell
+
+    def close_doorbell(self) -> None:
+        """Release the FIFO (a worker dropping a deposed partition
+        role must not leave its bell absorbing rings forever)."""
+        if self._bell is not None:
+            self._bell.close()
+            self._bell = None
+
+    # With a live bell the idle timeout stretches to this (still
+    # bounded — the poll fallback): rings are retained in the FIFO
+    # even while the role is mid-step, so the only append a wait can
+    # "miss" predates the bell's creation, and that one costs at most
+    # this. Meanwhile idle churn (a heartbeat write per poll tick)
+    # drops ~5x, which is itself tail latency on a contended host.
+    bell_wait_s: float = 0.05
+
+    def _idle_wait(self, timeout_s: float) -> None:
+        """The idle quantum: event wake on new input, bounded by the
+        poll fallback that keeps every correctness property
+        doorbell-independent."""
+        if timeout_s <= 0:
+            return
+        bell = self.doorbell()
+        if bell is None:
+            time.sleep(timeout_s)
+        else:
+            bell.wait(max(timeout_s, self.bell_wait_s))
+
+    def _observe_stage(self, stage: str, ms: float) -> None:
+        """Fold one wire-trace stage latency into `op_stage_ms` (the
+        same histogram family the in-proc pipeline feeds; instruments
+        cached per stage)."""
+        h = self._stage_hists.get(stage)
+        if h is None:
+            h = self._stage_hists[stage] = self.metrics.histogram(
+                "op_stage_ms", stage=stage
+            )
+        h.observe(ms)
+
     # -------------------------------------------------------- lifecycle
 
     # Minimum seconds between heartbeat file writes (0 = every call —
@@ -287,17 +376,27 @@ class _Role:
             return
         self._hb_t = now
         tmp = self._hb_path + f".tmp.{os.getpid()}"
+        hb = {
+            "pid": os.getpid(), "owner": self.owner, "t": time.time(),
+            "fence": self.fence, "offset": self.offset,
+            "degraded": self.degraded,
+            # Metrics report UP through the existing heartbeat
+            # channel: the supervisor merges these snapshots into
+            # its /metrics registry (per-process registries, one
+            # explicit merge point).
+            "metrics": self.metrics.snapshot(),
+        }
+        if self.trace_wire:
+            # Slow-op flight-recorder spans ride the same channel (the
+            # supervisor's /traces merges them); only in wire-trace
+            # mode — nothing feeds the recorder otherwise.
+            from ..utils.metrics import get_flight_recorder
+
+            spans = get_flight_recorder().snapshot()
+            if spans:
+                hb["slow_ops"] = spans
         with open(tmp, "w") as f:
-            json.dump({
-                "pid": os.getpid(), "owner": self.owner, "t": time.time(),
-                "fence": self.fence, "offset": self.offset,
-                "degraded": self.degraded,
-                # Metrics report UP through the existing heartbeat
-                # channel: the supervisor merges these snapshots into
-                # its /metrics registry (per-process registries, one
-                # explicit merge point).
-                "metrics": self.metrics.snapshot(),
-            }, f)
+            json.dump(hb, f)
         os.replace(tmp, self._hb_path)
 
     def _durable(self, fn):
@@ -340,6 +439,13 @@ class _Role:
         """Resume from the durable checkpoint, then close the
         append-vs-checkpoint crash window: deterministically reprocess
         (silently) every input whose output is already durable."""
+        self._recovering = True
+        try:
+            self._recover_inner()
+        finally:
+            self._recovering = False
+
+    def _recover_inner(self) -> None:
         env = self.ckpt.load(self.name)
         self.offset = 0
         if env is not None:
@@ -503,7 +609,7 @@ class _Role:
                 print(f"FENCED {self.name} {self.owner}: {exc}", flush=True)
                 raise SystemExit(EXIT_FENCED)
             self.heartbeat()
-            time.sleep(idle_sleep)
+            self._idle_wait(idle_sleep)
             return 0
         self.flush_batch(out)
         try:
@@ -602,7 +708,7 @@ class DeliRole(_Role):
                 if not self._ticket_wire(
                     doc, rec["doc"], client, int(op["clientSeq"]),
                     int(op.get("refSeq", 0)), op.get("contents"),
-                    line_idx, out,
+                    line_idx, out, sub_ts=rec.get("tr_sub"),
                 ):
                     break
             return
@@ -611,6 +717,7 @@ class DeliRole(_Role):
         self._ticket_wire(
             doc, rec["doc"], int(rec["client"]), int(rec["clientSeq"]),
             int(rec.get("refSeq", 0)), rec.get("contents"), line_idx, out,
+            sub_ts=rec.get("tr_sub"),
         )
 
     def process_batch(self, start_line: int, batch: Any,
@@ -673,7 +780,8 @@ class DeliRole(_Role):
     def _ticket_wire(self, doc: DocumentSequencer, doc_id: str,
                      client: int, client_seq: int, ref_seq: int,
                      contents: Any, line_idx: int,
-                     out: List[dict]) -> bool:
+                     out: List[dict], sub_ts: Optional[float] = None
+                     ) -> bool:
         """Ticket one wire op; returns False on a nack (the boxcar
         abort signal). Deduped resubmissions return True silently."""
         state = doc.clients.get(client)
@@ -696,20 +804,39 @@ class DeliRole(_Role):
                 "reason": res.reason, "inOff": line_idx,
             })
             return False
-        out.append(self._wire(doc_id, res, line_idx))
+        out.append(self._wire(doc_id, res, line_idx, sub_ts=sub_ts))
         return True
 
-    @staticmethod
-    def _wire(doc_id: str, msg, line_idx: int) -> dict:
-        # Timestamps deliberately excluded: the stream must be a pure
-        # function of the input order (the bit-identity contract).
-        return {
+    def _wire(self, doc_id: str, msg, line_idx: int,
+              sub_ts: Optional[float] = None) -> dict:
+        # Timestamps deliberately excluded from the CANONICAL keys:
+        # the stream must be a pure function of the input order (the
+        # bit-identity contract). In wire-trace mode the stamp rides
+        # the side "tr" dict, which canonical_record/digests never see
+        # — one clock read serves both the record stamp and the
+        # submit_to_stamp histogram so the two surfaces agree exactly.
+        rec = {
             "kind": "op", "doc": doc_id, "seq": msg.sequence_number,
             "msn": msg.minimum_sequence_number, "client": msg.client_id,
             "clientSeq": msg.client_seq, "refSeq": msg.ref_seq,
             "type": msg.type.value, "contents": msg.contents,
             "inOff": line_idx,
         }
+        if self.trace_wire:
+            now = time.time()
+            tr = {"stamp": now}
+            if isinstance(sub_ts, (int, float)):
+                tr["sub"] = sub_ts
+                if not self._recovering:
+                    # Recovery's silent replay regenerates records it
+                    # never emits (plus the genuinely-missing tail,
+                    # first stamped now) — observing those would
+                    # double-count with crash-spanning durations.
+                    self._observe_stage(
+                        "submit_to_stamp", (now - sub_ts) * 1000.0
+                    )
+            rec["tr"] = tr
+        return rec
 
 
 class ScriptoriumRole(_Role):
@@ -724,10 +851,21 @@ class ScriptoriumRole(_Role):
     def process(self, line_idx: int, rec: Any, out: List[dict]) -> None:
         if not isinstance(rec, dict) or rec.get("kind") != "op":
             return
-        out.append(
-            {**{k: v for k, v in rec.items() if k != "inOff"},
-             "inOff": line_idx}
-        )
+        rec2 = {**{k: v for k, v in rec.items() if k != "inOff"},
+                "inOff": line_idx}
+        tr = rec.get("tr")
+        if self.trace_wire and isinstance(tr, dict):
+            now = time.time()
+            rec2["tr"] = {**tr, "dur": now}
+            stamp = tr.get("stamp")
+            if isinstance(stamp, (int, float)) and not self._recovering:
+                # Silent replay re-processes already-durable records;
+                # observing them again would skew /slo with
+                # crash-spanning durations.
+                self._observe_stage(
+                    "stamp_to_durable", (now - stamp) * 1000.0
+                )
+        out.append(rec2)
 
 
 class BroadcasterRole(_Role):
@@ -745,10 +883,42 @@ class BroadcasterRole(_Role):
             "op", "nack"
         ):
             return
-        out.append(
-            {**{k: v for k, v in rec.items() if k != "inOff"},
-             "inOff": line_idx}
-        )
+        rec2 = {**{k: v for k, v in rec.items() if k != "inOff"},
+                "inOff": line_idx}
+        tr = rec.get("tr")
+        if self.trace_wire and isinstance(tr, dict):
+            now = time.time()
+            rec2["tr"] = {**tr, "bc": now}
+            if self._recovering:
+                # Silent replay: already-observed records must not be
+                # re-observed (crash-spanning durations) nor fed to
+                # the flight recorder as phantom slow ops.
+                out.append(rec2)
+                return
+            stamp = tr.get("stamp")
+            if isinstance(stamp, (int, float)):
+                self._observe_stage(
+                    "stamp_to_broadcast", (now - stamp) * 1000.0
+                )
+            sub = tr.get("sub")
+            if isinstance(sub, (int, float)):
+                # The farm's end-to-end stage AND the slow-op flight
+                # recorder: a tail observation beyond the rolling p99
+                # (or fixed threshold) keeps its full span — the exact
+                # slow op a regression report needs attached.
+                e2e = (now - sub) * 1000.0
+                self._observe_stage("submit_to_broadcast", e2e)
+                from ..utils.metrics import get_flight_recorder
+
+                fr = get_flight_recorder()
+                if fr.note(e2e):
+                    fr.add(e2e, {
+                        "doc": rec.get("doc"), "seq": rec.get("seq"),
+                        "client": rec.get("client"),
+                        "clientSeq": rec.get("clientSeq"),
+                        "stages": rec2["tr"],
+                    })
+        out.append(rec2)
 
 
 class ScribeRole(_Role):
@@ -836,7 +1006,8 @@ def serve_role(shared_dir: str, role: str, owner: str,
                log_format: Optional[str] = None,
                ckpt_duty: float = 0.2,
                partition: Optional[int] = None,
-               deli_devices: Optional[int] = None) -> None:
+               deli_devices: Optional[int] = None,
+               hb_interval_s: Optional[float] = None) -> None:
     """Child-process entry: run one role until killed/deposed/fenced.
     With `partition`, the role serves that partition's topic pair under
     its partition-suffixed lease (one pinned shard of the fabric —
@@ -861,6 +1032,14 @@ def serve_role(shared_dir: str, role: str, owner: str,
         ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
         log_format=log_format, ckpt_duty=ckpt_duty, **kw,
     )
+    if hb_interval_s is not None:
+        # Heartbeat throttle: the default (0 = every step) is the
+        # classic liveness contract, but a registry snapshot per
+        # record is pure tail latency at high step rates — the
+        # latency bench runs its children at ~0.1s (still 20x inside
+        # the staleness threshold; forced heartbeats — degraded
+        # flags, fence rejections — always bypass the throttle).
+        r.hb_interval_s = hb_interval_s
     print(f"READY {r.name} {owner}", flush=True)
     while True:
         try:
@@ -900,13 +1079,17 @@ class ServiceSupervisor:
                  log_format: Optional[str] = None,
                  ckpt_duty: float = 0.2,
                  deli_devices: Optional[int] = None,
-                 child_env: Optional[Dict[str, str]] = None):
+                 child_env: Optional[Dict[str, str]] = None,
+                 hb_interval_s: Optional[float] = None):
         """`child_env` adds/overrides spawn-environment variables for
         every child (the chaos harness's seam: it points CHILDREN at a
         disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
-        its own appends)."""
+        its own appends). `hb_interval_s` throttles the children's
+        heartbeat-file writes (None keeps the classic every-step
+        cadence; forced heartbeats always bypass the throttle)."""
         self.shared_dir = shared_dir
         self.child_env = dict(child_env or {})
+        self.hb_interval_s = hb_interval_s
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -979,6 +1162,8 @@ class ServiceSupervisor:
                "--ckpt-duty", str(self.ckpt_duty)]
         if self.deli_devices is not None and role == "deli":
             cmd += ["--deli-devices", str(self.deli_devices)]
+        if self.hb_interval_s is not None:
+            cmd += ["--hb-interval", str(self.hb_interval_s)]
         return cmd
 
     def _hb_file(self, role: str) -> str:
@@ -1235,16 +1420,31 @@ class ServiceSupervisor:
         except (OSError, ValueError):
             return None
 
+    def child_slow_ops(self) -> List[dict]:
+        """The farm's merged slow-op spans: every child's last
+        heartbeat-reported flight-recorder buffer (wire-trace mode
+        only — nothing feeds the recorders otherwise), slowest first.
+        The `/traces` body for a supervised farm."""
+        spans: List[dict] = []
+        for role in self.roles:
+            v = self._hb_field(role, "slow_ops")
+            if isinstance(v, list):
+                spans.extend(s for s in v if isinstance(s, dict))
+        spans.sort(key=lambda s: -float(s.get("e2e_ms", 0.0)))
+        return spans
+
     def serve_metrics(self, host: str = "127.0.0.1", port: int = 0):
-        """The farm's live ops endpoint: `/metrics` merges the
-        children's heartbeat-reported registries per scrape; `/healthz`
-        reports per-role liveness. Returns the `monitor.MetricsServer`."""
+        """The farm's live ops endpoint: `/metrics` (+ `/slo`) merges
+        the children's heartbeat-reported registries per scrape;
+        `/healthz` reports per-role liveness; `/traces` merges the
+        children's slow-op buffers. Returns the
+        `monitor.MetricsServer`."""
         if self._monitor is None:
             from .monitor import MetricsServer
 
             self._monitor = MetricsServer(
                 registry=self.collect_metrics, health=self.health,
-                host=host, port=port,
+                host=host, port=port, traces=self.child_slow_ops,
             ).start()
         return self._monitor
 
@@ -1292,6 +1492,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     ckpt_duty = float(_take("--ckpt-duty", "0.2"))
     partition_s = _take("--partition")
     devices_s = _take("--deli-devices")
+    hb_interval_s = _take("--hb-interval")
     if (role not in ROLE_CLASSES or shared_dir is None
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
@@ -1302,7 +1503,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             "--role {deli|scriptorium|scribe|broadcaster} --dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "
-            "[--deli-devices N] "
+            "[--deli-devices N] [--hb-interval S] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -1312,7 +1513,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                ckpt_bytes=ckpt_bytes, log_format=log_format,
                ckpt_duty=ckpt_duty,
                partition=int(partition_s) if partition_s else None,
-               deli_devices=int(devices_s) if devices_s else None)
+               deli_devices=int(devices_s) if devices_s else None,
+               hb_interval_s=float(hb_interval_s)
+               if hb_interval_s else None)
 
 
 if __name__ == "__main__":

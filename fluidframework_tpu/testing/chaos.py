@@ -135,6 +135,13 @@ class ChaosConfig:
     # by the split/merge/disk fault classes; may be set explicitly to
     # run the classic fault set against the elastic fabric.
     elastic: bool = False
+    # Wire tracing (supervisor.TRACE_WIRE_ENV) in the farm children:
+    # per-stage timestamps ride a side "tr" key on the wire records
+    # and the broadcaster feeds the slow-op flight recorder, so a
+    # chaos report can attach the exact slowest ops it saw. Safe for
+    # convergence — digests compare `canonical_record`, which never
+    # sees "tr".
+    trace_wire: bool = False
 
 
 @dataclass
@@ -163,6 +170,11 @@ class ChaosResult:
     # Topology evidence: epochs observed committed during the run
     # (split/merge faults must actually move it).
     epochs: List[int] = field(default_factory=list)
+    # Slow-op flight-recorder spans (trace_wire runs only): the exact
+    # ops whose submit→broadcast latency crossed the rolling p99,
+    # slowest first, with all stage timestamps — a tail-latency
+    # regression report carries its evidence.
+    slow_ops: List[dict] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -451,6 +463,7 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
         deli_impl=cfg.deli_impl, log_format=cfg.log_format,
         deli_devices=cfg.deli_devices,
+        child_env={"FLUID_TRACE_WIRE": "1"} if cfg.trace_wire else None,
     ).start()
     raw = make_topic(os.path.join(shared, "topics", "rawdeltas.jsonl"),
                      cfg.log_format)
@@ -475,7 +488,18 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         while time.time() < deadline:
             sup.poll_once()
             if fed_idx < len(chunks):
-                raw.append_many(chunks[fed_idx])
+                if cfg.trace_wire:
+                    # Stamp the submit instant at FEED time (the
+                    # workload list stays pristine for the golden):
+                    # the broadcaster then measures submit→broadcast
+                    # e2e and feeds the slow-op recorder. Digest-safe:
+                    # canonical_record never sees tr_sub.
+                    now = time.time()
+                    chunk = [{**r, "tr_sub": now}
+                             for r in chunks[fed_idx]]
+                else:
+                    chunk = chunks[fed_idx]
+                raw.append_many(chunk)
                 if fed_idx in dup_after:
                     pending_dups.setdefault(
                         dup_after[fed_idx], []
@@ -570,6 +594,7 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         fence_rejections=fence_rejections, restarts=dict(sup.restarts),
         events=events + list(sup.events), detail=detail,
         timeline=sorted(timeline + sup.timeline), metrics=metrics,
+        slow_ops=sup.child_slow_ops() if cfg.trace_wire else [],
     )
 
 
@@ -624,8 +649,11 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
     # Children get the disk-fault spec path via their spawn env; the
     # harness's own appends (the router feed) stay clean.
     fault_spec = os.path.join(shared, "disk-fault.json")
-    child_env = ({DISK_FAULT_ENV: fault_spec}
-                 if "disk" in cfg.faults else None)
+    child_env = dict({DISK_FAULT_ENV: fault_spec}
+                     if "disk" in cfg.faults else {})
+    if cfg.trace_wire:
+        child_env["FLUID_TRACE_WIRE"] = "1"
+    child_env = child_env or None
     sup = ShardFabricSupervisor(
         shared, n_workers=cfg.n_workers, n_partitions=cfg.n_partitions,
         ttl_s=cfg.ttl_s, heartbeat_timeout_s=cfg.heartbeat_timeout_s,
@@ -667,7 +695,20 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         while time.time() < deadline:
             sup.poll_once()
             if fed_idx < len(chunks):
-                router.append(chunks[fed_idx])
+                if cfg.trace_wire:
+                    # Same feed-time submit stamp as the classic
+                    # runner: the ranged delis then stamp "tr" and
+                    # observe submit_to_stamp quantiles into their
+                    # worker heartbeats. (The slow-op RECORDER rides
+                    # the classic farm's broadcaster — the fabric has
+                    # no broadcast stage, so sharded runs report
+                    # stage quantiles, not e2e spans.)
+                    now = time.time()
+                    chunk = [{**r, "tr_sub": now}
+                             for r in chunks[fed_idx]]
+                else:
+                    chunk = chunks[fed_idx]
+                router.append(chunk)
                 if fed_idx in dup_after:
                     pending_dups.setdefault(
                         dup_after[fed_idx], []
@@ -751,6 +792,10 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         events=events + list(sup.events), detail=detail,
         timeline=sorted(timeline + sup.timeline), metrics=metrics,
         degraded_seen=degraded_seen, epochs=epochs,
+        # Worker heartbeats carry no e2e spans today (no broadcast
+        # stage in the fabric) — collected anyway so a future fan-out
+        # stage lights this up without touching the harness.
+        slow_ops=sup.child_slow_ops() if cfg.trace_wire else [],
     )
 
 

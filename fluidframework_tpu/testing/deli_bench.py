@@ -853,6 +853,347 @@ def run_rebalance_bench(n_docs: int = 10_000, n_clients: int = 64,
             _shutil.rmtree(scratch, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# open-loop latency SLO bench (config9_latency's engine)
+# ---------------------------------------------------------------------------
+
+
+def _exact_quantile(sorted_vals: List[float], q: float) -> float:
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           int(q * (len(sorted_vals) - 1)))]
+
+
+def _span_quantiles(samples: List[float]) -> dict:
+    s = sorted(samples)
+    return {"count": len(s),
+            "mean": round(sum(s) / len(s), 3),
+            "p50": round(_exact_quantile(s, 0.5), 3),
+            "p95": round(_exact_quantile(s, 0.95), 3),
+            "p99": round(_exact_quantile(s, 0.99), 3)}
+
+
+def _run_latency_variant(shared: str, doorbell: bool, rate_hz: float,
+                         duration_s: float, n_docs: int, n_clients: int,
+                         ttl_s: float, timeout_s: float) -> dict:
+    """One open-loop run against the supervised farm: fixed-rate
+    submits (never waiting on completion — OPEN loop, so a backlogged
+    pipeline shows up as latency, not as a silently slower load), wire
+    traces on, spans read back off the broadcast/durable tails."""
+    from ..server.queue import SharedFileTopic, TailReader
+    from ..server.supervisor import ServiceSupervisor
+    from ..utils import metrics as _metrics
+
+    sup = ServiceSupervisor(
+        shared, roles=("deli", "scriptorium", "broadcaster"),
+        ttl_s=ttl_s,
+        child_env={"FLUID_TRACE_WIRE": "1",
+                   "FLUID_DOORBELL": "1" if doorbell else "0"},
+        # Heartbeat throttle for BOTH variants (identical treatment):
+        # a trace-mode registry snapshot per record is pure tail
+        # latency, and liveness only needs ~Hz.
+        hb_interval_s=0.1,
+    ).start()
+    try:
+        raw = SharedFileTopic(os.path.join(shared, "topics",
+                                           "rawdeltas.jsonl"))
+        bc_reader = TailReader(SharedFileTopic(
+            os.path.join(shared, "topics", "broadcast.jsonl")))
+        dur_reader = TailReader(SharedFileTopic(
+            os.path.join(shared, "topics", "durable.jsonl")))
+        docs = [f"doc{d}" for d in range(n_docs)]
+        raw.append_many([
+            {"kind": "join", "doc": doc, "client": c}
+            for doc in docs for c in range(1, n_clients + 1)
+        ])
+        # Warm: every join sequenced and broadcast (the pipeline is
+        # live end to end before the timed window opens).
+        want_joins = n_docs * n_clients
+        bcast: List[dict] = []
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            sup.poll_once()
+            bcast.extend(v for _, v in bc_reader.poll())
+            if sum(1 for r in bcast
+                   if isinstance(r, dict) and r.get("kind") == "op"
+                   ) >= want_joins:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError(
+                f"farm never came live: {len(bcast)} broadcast records "
+                f"for {want_joins} joins"
+            )
+        bcast.clear()
+        # Steady open-loop window, after a short PACED lead-in at the
+        # same rate (cold paths — first checkpoint, instrument
+        # creation, first bell registration — are start-up cost, not
+        # steady-state SLO; the lead-in ops are still span-verified
+        # below, just excluded from the quantiles).
+        lead_in = 8
+        total = max(16, int(rate_hz * duration_s)) + lead_in
+        cseq = {(d, c): 0 for d in docs for c in range(1, n_clients + 1)}
+        keys = sorted(cseq)
+        t0 = time.perf_counter()
+        last_sup = 0.0
+        lead_keys = set()
+        for i in range(total):
+            tick = t0 + i / rate_hz
+            while True:
+                now = time.perf_counter()
+                if now >= tick:
+                    break
+                # Drain tails while waiting so the bench process never
+                # bursts; the spans themselves come from wire stamps,
+                # not from observation time.
+                bcast.extend(v for _, v in bc_reader.poll())
+                if now - last_sup > 0.2:
+                    sup.poll_once()
+                    last_sup = now
+                time.sleep(min(0.002, tick - now))
+            d, c = keys[i % len(keys)]
+            cseq[(d, c)] += 1
+            if i < lead_in:
+                lead_keys.add((d, c, cseq[(d, c)]))
+            raw.append_many([{
+                "kind": "op", "doc": d, "client": c,
+                "clientSeq": cseq[(d, c)], "refSeq": 0,
+                "contents": {"i": i}, "tr_sub": time.time(),
+            }])
+        # Drain: every submitted op must reach broadcast.
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            bcast.extend(v for _, v in bc_reader.poll())
+            n_ops = sum(1 for r in bcast
+                        if isinstance(r, dict) and r.get("kind") == "op"
+                        and "sub" in (r.get("tr") or {}))
+            if n_ops >= total:
+                break
+            sup.poll_once()
+            time.sleep(0.005)
+        durable = [v for _, v in dur_reader.poll()]
+        # Children heartbeat on a throttle (hb_interval_s): give every
+        # role one post-drain heartbeat so the collected snapshots
+        # include the final ops.
+        time.sleep(0.35)
+        metrics_reg = sup.collect_metrics()
+        slow_ops = sup.child_slow_ops()
+    finally:
+        sup.stop()
+
+    # ----- trace/quantile correctness assertions (run on EVERY host)
+    ops = [r for r in bcast if isinstance(r, dict)
+           and r.get("kind") == "op" and "sub" in (r.get("tr") or {})]
+    seen = [(r["doc"], r["client"], r["clientSeq"]) for r in ops]
+    assert len(seen) == len(set(seen)), "duplicate ops in broadcast"
+    assert len(seen) == total, (
+        f"open-loop drain incomplete: {len(seen)}/{total} ops reached "
+        f"broadcast within {timeout_s}s"
+    )
+    sub_stamp, sub_bc = [], []
+    all_sub_stamp, all_sub_bc = [], []
+    for r in ops:
+        tr = r["tr"]
+        assert tr["sub"] <= tr["stamp"] <= tr["bc"], (
+            f"non-monotone span {tr}"
+        )
+        ss = (tr["stamp"] - tr["sub"]) * 1000.0
+        all_sub_stamp.append(ss)
+        all_sub_bc.append((tr["bc"] - tr["sub"]) * 1000.0)
+        if (r["doc"], r["client"], r["clientSeq"]) in lead_keys:
+            continue  # verified, but start-up cost: no quantile
+        sub_stamp.append(ss)
+        sub_bc.append((tr["bc"] - tr["sub"]) * 1000.0)
+    assert len(sub_bc) == total - len(lead_keys)
+    for r in durable:
+        tr = r.get("tr") if isinstance(r, dict) else None
+        if isinstance(tr, dict) and "dur" in tr and "stamp" in tr:
+            assert tr["stamp"] <= tr["dur"], f"non-monotone span {tr}"
+    # The child-reported histogram must be exactly the bench-side
+    # distribution: the deli stamps tr["stamp"] and observes its
+    # submit_to_stamp histogram from ONE clock read, so rebuilding the
+    # histogram from the wire spans must reproduce the child's bucket
+    # counts — the end-to-end proof that quantile estimates summarize
+    # the real per-op traces.
+    reb = _metrics.MetricsRegistry()
+    h_local = reb.histogram("op_stage_ms", stage="submit_to_stamp")
+    for v in all_sub_stamp:  # the child saw the lead-in ops too
+        h_local.observe(v)
+    h_child = metrics_reg.histogram("op_stage_ms", stage="submit_to_stamp")
+    assert h_child.counts == h_local.counts and \
+        h_child.count == h_local.count, (
+            "child-reported submit_to_stamp histogram diverges from "
+            "the wire spans"
+        )
+    # Bucket-interpolated estimate lands in the same (or adjacent,
+    # for an exact-bound landing) bucket as the exact sample quantile.
+    from bisect import bisect_left
+    snap_h = [h for h in metrics_reg.snapshot()["histograms"]
+              if h["name"] == "op_stage_ms"
+              and h["labels"].get("stage") == "submit_to_broadcast"]
+    if snap_h:
+        est = _metrics.histogram_quantile(snap_h[0], 0.99)
+        exact = _exact_quantile(sorted(all_sub_bc), 0.99)
+        bounds = snap_h[0]["buckets"]
+        if est != float("inf"):
+            assert abs(bisect_left(bounds, est)
+                       - bisect_left(bounds, exact)) <= 1, (
+                f"interpolated p99 {est} not in the exact p99 "
+                f"{exact}'s bucket"
+            )
+    return {
+        "doorbell": doorbell,
+        "records": total,
+        "lead_in": lead_in,
+        "rate_hz": rate_hz,
+        "submit_to_stamp_ms": _span_quantiles(sub_stamp),
+        "submit_to_broadcast_ms": _span_quantiles(sub_bc),
+        "slow_ops": slow_ops[:5],
+    }
+
+
+def wake_jitter_probe(n: int = 450, rate_hz: float = 150.0) -> dict:
+    """The host's EVENT-WAKE honesty probe: one minimal bell-driven
+    relay hop (producer process → FIFO doorbell → relay process) at
+    the bench rate, p50/p99 of append→relayed latency. On bare metal
+    a select() wake costs tens of microseconds; an oversubscribed VM
+    parks idle vCPUs and a wake can cost ~10ms at the tail — a host
+    property that puts a hard floor under ANY event-driven pipeline's
+    p99, poll stack or no poll stack. `config9_latency` skips its
+    ratio assert (loudly) when this probe's p99 says the floor is too
+    high to measure a 3x improvement honestly."""
+    import subprocess
+    import sys
+
+    from ..server.queue import SharedFileTopic, TailReader
+
+    scratch = tempfile.mkdtemp(
+        prefix="wake-probe-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    relay_src = (
+        "import sys, time\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "from fluidframework_tpu.server.queue import (\n"
+        "    SharedFileTopic, TopicDoorbell, TailReader)\n"
+        "src = SharedFileTopic(sys.argv[1])\n"
+        "dst = SharedFileTopic(sys.argv[2])\n"
+        "bell = TopicDoorbell(src.path)\n"
+        "r = TailReader(src)\n"
+        "print('READY', flush=True)\n"
+        "while True:\n"
+        "    vals = [v for _, v in r.poll()]\n"
+        "    if vals:\n"
+        "        now = time.time()\n"
+        "        dst.append_many([{**v, 'hop': now} for v in vals])\n"
+        "    else:\n"
+        "        bell.wait(0.05)\n"
+    )
+    a = os.path.join(scratch, "a.jsonl")
+    b = os.path.join(scratch, "b.jsonl")
+    proc = subprocess.Popen([sys.executable, "-c", relay_src, a, b],
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert (proc.stdout.readline() or "").strip() == "READY"
+        src = SharedFileTopic(a)
+        out = TailReader(SharedFileTopic(b))
+        time.sleep(0.2)
+        recs: List[dict] = []
+        t0 = time.perf_counter()
+        for i in range(n):
+            tick = t0 + i / rate_hz
+            while time.perf_counter() < tick:
+                recs.extend(v for _, v in out.poll())
+                time.sleep(0.001)
+            src.append_many([{"i": i, "ts": time.time()}])
+        deadline = time.time() + 15
+        while time.time() < deadline and len(recs) < n:
+            recs.extend(v for _, v in out.poll())
+            time.sleep(0.002)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        shutil.rmtree(scratch, ignore_errors=True)
+    lat = sorted((r["hop"] - r["ts"]) * 1000.0 for r in recs)
+    assert len(lat) >= n * 0.95, f"probe relay lost records: {len(lat)}/{n}"
+    return {
+        "samples": len(lat),
+        "p50": round(_exact_quantile(lat, 0.5), 3),
+        "p99": round(_exact_quantile(lat, 0.99), 3),
+        "max": round(lat[-1], 3),
+    }
+
+
+def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
+                      n_docs: int = 2, n_clients: int = 2,
+                      ttl_s: float = 0.75, timeout_s: float = 60.0,
+                      attempts: int = 2,
+                      work_dir: Optional[str] = None) -> dict:
+    """Submit→stamp→durable→broadcast latency SLO of the supervised
+    farm under a steady OPEN-loop load (fixed rate, never waiting on
+    completion), doorbells ON vs the polling baseline at the same
+    load. Exact per-op spans come off the wire traces
+    (FLUID_TRACE_WIRE); the trace/quantile correctness assertions run
+    inside every variant regardless of host size — the
+    p99-improvement judgment lives in `bench_configs.config9_latency`
+    (loud skip under 4 cores, where the ratio measures the scheduler).
+
+    Scratch defaults to tmpfs (/dev/shm) when present: the bench
+    measures the POLL-INTERVAL stack, and on a slow/network filesystem
+    the per-hop fsync floor would cap the measurable ratio no matter
+    how the consumers wake — the disk is not the thing under test."""
+    scratch = work_dir or tempfile.mkdtemp(
+        prefix="latency-bench-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    try:
+        runs = {}
+        for name, doorbell in (("poll", False), ("doorbell", True)):
+            # Best-of-N per variant (the config5_metrics_overhead
+            # pattern): a virtualized host's wake-from-idle jitter
+            # lands ~10ms stalls on ~1% of EVENT wakes in an unlucky
+            # run — real, but not the poll stack this bench measures.
+            # Every attempt still runs the full correctness contract.
+            best = None
+            for k in range(max(1, attempts)):
+                vdir = os.path.join(scratch, f"{name}-{k}")
+                os.makedirs(vdir, exist_ok=True)
+                res = _run_latency_variant(
+                    vdir, doorbell, rate_hz, duration_s, n_docs,
+                    n_clients, ttl_s, timeout_s,
+                )
+                if (best is None
+                        or res["submit_to_broadcast_ms"]["p99"]
+                        < best["submit_to_broadcast_ms"]["p99"]):
+                    best = res
+            runs[name] = best
+        imp = {
+            q: round(runs["poll"]["submit_to_broadcast_ms"][q]
+                     / max(1e-9,
+                           runs["doorbell"]["submit_to_broadcast_ms"][q]),
+                     2)
+            for q in ("p50", "p99")
+        }
+        return {
+            "metric": "latency_slo_open_loop",
+            "rate_hz": rate_hz,
+            "records_per_variant": runs["poll"]["records"],
+            "docs": n_docs, "clients_per_doc": n_clients,
+            "runs": [runs["poll"], runs["doorbell"]],
+            "p50_improvement": imp["p50"],
+            "p99_improvement": imp["p99"],
+            "cores": os.cpu_count(),
+            "gate": ("per-op spans exactly-once + monotone; child "
+                     "histograms == wire spans"),
+            "unit": "ms",
+        }
+    finally:
+        if work_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def main() -> None:  # CLI twin: tools/bench_deli.py
     scale = float(os.environ.get("BD_SCALE", "1.0"))
     if os.environ.get("BD_DEVICES"):
@@ -870,6 +1211,20 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             ops_per_doc=int(os.environ.get("BD_OPS_PER_DOC", "64")),
             n_clients=int(os.environ.get("BD_CLIENTS", "8")),
             repeats=int(os.environ.get("BD_REPEATS", "3")),
+        )
+        print(json.dumps(res))
+        return
+    if os.environ.get("BD_LATENCY"):
+        # Open-loop latency SLO mode (tools/bench_deli.py --latency):
+        # p50/p99 submit→broadcast under a steady fixed-rate load,
+        # doorbells vs the polling baseline (bench_configs
+        # config9_latency's engine).
+        res = run_latency_bench(
+            rate_hz=float(os.environ.get("BD_RATE_HZ", "150")),
+            duration_s=float(os.environ.get("BD_DURATION_S", "4"))
+            * scale,
+            n_docs=int(os.environ.get("BD_DOCS", "2")),
+            n_clients=int(os.environ.get("BD_CLIENTS", "2")),
         )
         print(json.dumps(res))
         return

@@ -36,17 +36,22 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "DEFAULT_LATENCY_BUCKETS_MS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "NullRegistry",
     "dump_snapshot_line",
     "format_report",
+    "get_flight_recorder",
     "get_registry",
     "histogram_quantile",
+    "histogram_stats",
     "merge_snapshots",
     "set_enabled",
+    "set_flight_recorder",
     "set_registry",
+    "slo_summary",
 ]
 
 # Fixed latency buckets (ms): sub-millisecond ticks through 10s tails.
@@ -237,22 +242,46 @@ class MetricsRegistry:
     # --------------------------------------------------------- snapshot
 
     def snapshot(self) -> dict:
-        """JSON-able, deterministic (sorted) state of every instrument."""
+        """JSON-able, deterministic (sorted) state of every instrument.
+
+        Histogram fields are copied UNDER the instruments' shared lock:
+        counts, sum and count must come from one instant, or a
+        concurrent `observe` between the field reads yields a torn
+        snapshot whose explicit sum/count disagree with its buckets —
+        and every downstream consumer (merge across process snapshots,
+        quantile estimation, the mean column) silently inherits the
+        skew."""
         counters, gauges, histograms = [], [], []
         with self._lock:
             items = sorted(self._instruments.items())
-        for (kind, name, labels), inst in items:
-            entry = {"name": name, "labels": dict(labels)}
-            if kind == "counter":
-                counters.append({**entry, "value": inst.value})
-            elif kind == "gauge":
-                gauges.append({**entry, "value": inst.value})
-            else:
-                histograms.append({
-                    **entry, "buckets": list(inst.bounds),
-                    "counts": list(inst.counts), "sum": inst.sum,
-                    "count": inst.count,
-                })
+            for (kind, name, labels), inst in items:
+                entry = {"name": name, "labels": dict(labels)}
+                if kind == "counter":
+                    counters.append({**entry, "value": inst.value})
+                elif kind == "gauge":
+                    gauges.append({**entry, "value": inst.value})
+                else:
+                    h = {
+                        **entry, "buckets": list(inst.bounds),
+                        "counts": list(inst.counts), "sum": inst.sum,
+                        "count": inst.count,
+                    }
+                    histograms.append(h)
+        for h in histograms:
+            if h["count"] > 0:
+                # Quantiles ride the snapshot (the /slo surface), but
+                # they are DERIVED — merge() folds buckets/sum/count
+                # and recomputes; None marks an estimate beyond the
+                # last finite bucket (JSON has no Infinity).
+                h["quantiles"] = {
+                    q: (None if v == float("inf") else round(v, 4))
+                    for q, v in (
+                        ("p50", histogram_quantile(h, 0.5)),
+                        ("p95", histogram_quantile(h, 0.95)),
+                        ("p99", histogram_quantile(h, 0.99)),
+                    )
+                }
+                h["mean"] = round(h["sum"] / h["count"], 4)
         return {"counters": counters, "gauges": gauges,
                 "histograms": histograms}
 
@@ -309,6 +338,7 @@ class MetricsRegistry:
                     f"{fname}{self._fmt_labels(m['labels'])} "
                     f"{self._fmt_num(m['value'])}"
                 )
+        qlines: List[str] = []
         for m in snap["histograms"]:
             fname = full(m["name"])
             if fname not in seen_type:
@@ -332,6 +362,31 @@ class MetricsRegistry:
             out.append(
                 f"{fname}_count{self._fmt_labels(m['labels'])} {m['count']}"
             )
+            # Bucket-interpolated quantile estimates as a sibling gauge
+            # family (`<name>_q{quantile=...}`) — NOT extra `<name>`
+            # series, which a strict parser would reject under TYPE
+            # histogram. Buffered and appended AFTER the histogram
+            # loop: a metric family's samples must stay one contiguous
+            # group, and a histogram name with several label sets
+            # would otherwise interleave `<name>` and `<name>_q`.
+            # Estimates beyond the last finite bucket are omitted
+            # rather than faked.
+            if m["count"] > 0:
+                qname = f"{fname}_q"
+                for q in (0.5, 0.95, 0.99):
+                    v = histogram_quantile(m, q)
+                    if v == float("inf"):
+                        continue
+                    if qname not in seen_type:
+                        qlines.append(f"# TYPE {qname} gauge")
+                        seen_type.add(qname)
+                    qlabel = 'quantile="%s"' % q
+                    qlines.append(
+                        f"{qname}"
+                        f"{self._fmt_labels(m['labels'], qlabel)}"
+                        f" {self._fmt_num(round(v, 4))}"
+                    )
+        out.extend(qlines)
         return "\n".join(out) + ("\n" if out else "")
 
 
@@ -412,6 +467,46 @@ def histogram_quantile(h: dict, q: float) -> float:
     return float("inf")
 
 
+def histogram_stats(h: dict) -> dict:
+    """The SLO-facing summary of one snapshot histogram entry:
+    count, mean (exact, from the explicit sum), and bucket-interpolated
+    p50/p95/p99. Quantiles landing beyond the last finite bucket come
+    back as ``float("inf")`` — the caller decides how to render that
+    (the JSON surfaces map it to None)."""
+    count = int(h.get("count", 0))
+    if count <= 0:
+        return {"count": 0, "mean": 0.0, "p50": 0.0, "p95": 0.0,
+                "p99": 0.0}
+    return {
+        "count": count,
+        "mean": h["sum"] / count,
+        "p50": histogram_quantile(h, 0.5),
+        "p95": histogram_quantile(h, 0.95),
+        "p99": histogram_quantile(h, 0.99),
+    }
+
+
+def slo_summary(snap: dict) -> dict:
+    """The `/slo` endpoint body: every histogram with observations,
+    reduced to its quantile summary (JSON-safe — beyond-last-bucket
+    estimates become None). Counters/gauges are omitted; they live on
+    `/metrics.json`."""
+    out = []
+    for h in snap.get("histograms", ()):
+        if not h.get("count"):
+            continue
+        stats = histogram_stats(h)
+        out.append({
+            "name": h["name"], "labels": dict(h.get("labels") or {}),
+            "count": stats["count"],
+            "mean": round(stats["mean"], 4),
+            **{q: (None if stats[q] == float("inf")
+                   else round(stats[q], 4))
+               for q in ("p50", "p95", "p99")},
+        })
+    return {"histograms": out}
+
+
 def _fmt_ms(v: float) -> str:
     if v == float("inf"):
         return ">max"
@@ -433,15 +528,16 @@ def format_report(snapshots: Iterable[dict]) -> str:
     if hists:
         lines.append(
             f"{'histogram':<26} {'labels':<34} {'count':>9} "
-            f"{'mean':>9} {'p50':>9} {'p90':>9} {'p99':>9}"
+            f"{'mean':>9} {'p50':>9} {'p95':>9} {'p99':>9}"
         )
         for h in hists:
+            stats = histogram_stats(h)
             lines.append(
                 f"{h['name']:<26} {label_str(h['labels']):<34} "
-                f"{h['count']:>9} {_fmt_ms(h['sum'] / h['count']):>9} "
-                f"{_fmt_ms(histogram_quantile(h, 0.5)):>9} "
-                f"{_fmt_ms(histogram_quantile(h, 0.9)):>9} "
-                f"{_fmt_ms(histogram_quantile(h, 0.99)):>9}"
+                f"{stats['count']:>9} {_fmt_ms(stats['mean']):>9} "
+                f"{_fmt_ms(stats['p50']):>9} "
+                f"{_fmt_ms(stats['p95']):>9} "
+                f"{_fmt_ms(stats['p99']):>9}"
             )
     rows = [("counter", c) for c in snap["counters"] if c["value"]]
     rows += [("gauge", g) for g in snap["gauges"]]
@@ -455,3 +551,116 @@ def format_report(snapshots: Iterable[dict]) -> str:
                 f"{MetricsRegistry._fmt_num(m['value']):>12}"
             )
     return "\n".join(lines) if lines else "(no metrics recorded)"
+
+
+# ---------------------------------------------------------------------------
+# slow-op flight recorder (the /traces surface)
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bounded ring buffer of SLOW-op span records.
+
+    Histograms answer "what is p99"; they cannot answer "which ops
+    were the p99" — a tail-latency regression report needs the exact
+    slow ops attached (doc/client/seq plus every stage timestamp).
+    This keeps the last `capacity` spans whose end-to-end latency
+    either exceeds a fixed `threshold_ms` or, when none is set, the
+    ROLLING p99 of the last `window` observations — so the buffer
+    always holds the current tail, never a firehose.
+
+    Two-phase API so the hot path never builds a span dict it is about
+    to drop:
+
+        if recorder.note(e2e_ms):          # updates the rolling window
+            recorder.add(e2e_ms, {...})    # admit the full span
+
+    Observational only and lock-safe; `snapshot()` returns the spans
+    oldest-first, each as ``{"e2e_ms": ..., **span}``.
+    """
+
+    RECALC_EVERY = 32  # rolling-p99 refresh cadence (observations)
+
+    def __init__(self, capacity: int = 128,
+                 threshold_ms: Optional[float] = None,
+                 window: int = 512, min_samples: int = 32):
+        from collections import deque
+
+        self.capacity = int(capacity)
+        self.threshold_ms = threshold_ms
+        self.min_samples = int(min_samples)
+        self._spans = deque(maxlen=self.capacity)
+        self._recent = deque(maxlen=int(window))
+        self._rolling_p99 = float("inf")
+        self._since_recalc = 0
+        self.seen = 0
+        self.recorded = 0
+        self._lock = threading.Lock()
+
+    def _refresh_p99(self) -> None:
+        n = len(self._recent)
+        if n < self.min_samples:
+            self._rolling_p99 = float("inf")
+            return
+        ordered = sorted(self._recent)
+        self._rolling_p99 = ordered[min(n - 1, int(0.99 * (n - 1)))]
+
+    def note(self, e2e_ms: float) -> bool:
+        """Fold one end-to-end latency into the rolling window; True
+        iff the op qualifies for the buffer (the caller then builds
+        the span and calls `add`)."""
+        with self._lock:
+            self.seen += 1
+            self._recent.append(float(e2e_ms))
+            self._since_recalc += 1
+            if self._since_recalc >= self.RECALC_EVERY:
+                self._since_recalc = 0
+                self._refresh_p99()
+            if self.threshold_ms is not None:
+                return e2e_ms >= self.threshold_ms
+            return e2e_ms >= self._rolling_p99
+
+    def add(self, e2e_ms: float, span: Dict[str, Any]) -> None:
+        with self._lock:
+            self.recorded += 1
+            self._spans.append({"e2e_ms": round(float(e2e_ms), 4),
+                                **span})
+
+    def observe(self, e2e_ms: float, span: Dict[str, Any]) -> bool:
+        """One-shot form for cold paths: note + add when admitted."""
+        if self.note(e2e_ms):
+            self.add(e2e_ms, span)
+            return True
+        return False
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._recent.clear()
+            self._rolling_p99 = float("inf")
+            self._since_recalc = 0
+            self.seen = 0
+            self.recorded = 0
+
+
+_default_recorder = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    """The process's default slow-op recorder (fed by the runtime's
+    apply-side trace fold and, in wire-trace mode, the farm's
+    broadcaster role; served by `monitor.MetricsServer` `/traces`)."""
+    return _default_recorder
+
+
+def set_flight_recorder(recorder: FlightRecorder) -> FlightRecorder:
+    """Swap the default recorder; returns the previous one (bench/test
+    isolation, like `set_registry`)."""
+    global _default_recorder
+    old = _default_recorder
+    _default_recorder = recorder
+    return old

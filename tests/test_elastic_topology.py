@@ -540,3 +540,75 @@ def test_lease_table_reports_fence_and_expiry(tmp_path):
     info = tab[range_lease_name(rid)]
     assert info["owner"] == "wA" and info["fence"] == f1
     assert info["expires"] > time.time()
+
+
+def test_absorbed_pred_cursors_retired_and_restart_exactly_once(tmp_path):
+    """ROADMAP item-2 follow-up: once a split child has drained its
+    parent to quiescence (and the parent is dead in the topology by
+    construction), the parent's `inSrc` cursor drops out of NEW
+    checkpoints — replaced by a `done_preds` tombstone — and a
+    restarted successor skips re-absorption entirely while
+    exactly-once still holds across the restart."""
+    shared = str(tmp_path)
+    router = ShardRouter(shared, 1, elastic=True)
+    w = ShardWorker(shared, "wA", n_partitions=1, ttl_s=5.0,
+                    elastic=True)
+    w.heartbeat()
+    w.sweep()
+    docs = [f"doc{i}" for i in range(6)]
+    first = _workload(docs, ops=4)
+    router.append(first)
+    _drain((w,), router, len(first))
+    parent_rid = sorted(w.roles)[0]
+    cid = request_topology_change(shared, {"op": "split",
+                                           "rid": parent_rid})
+    deadline = time.time() + 20
+    while time.time() < deadline and control_result(shared, cid) is None:
+        w.step()
+    assert control_result(shared, cid)
+    second = _workload(docs, ops=4, base=4)
+    router.append(second)
+    _drain((w,), router, len(first) + len(second))
+
+    # Children hold the parent's cursor until the retirement grace
+    # passes; shrink it and pump the (quiescent) preds.
+    children = dict(w.roles)
+    assert len(children) == 2
+    for role in children.values():
+        assert parent_rid in role._preds
+        role.pred_retire_s = 0.05
+    deadline = time.time() + 20
+    while time.time() < deadline and not all(
+        r._preds[parent_rid]["done"] for r in children.values()
+    ):
+        w.step()
+        time.sleep(0.01)
+    for role in children.values():
+        assert role._preds[parent_rid]["done"]
+        role.checkpoint()
+        st = role.ckpt.load(role.name)["state"]["state"]
+        assert st.get("preds") in ({}, None), st  # cursor DROPPED
+        assert st["done_preds"] == [parent_rid]  # tombstone instead
+        assert role.metrics.counter(
+            "shard_pred_cursors_retired_total",
+            **role._metric_labels()).value >= 1
+
+    # Graceful handoff, then a fresh worker restores the tombstoned
+    # checkpoints: no re-absorption, and the stream stays exactly-once
+    # across the restart.
+    w.stop()
+    w2 = ShardWorker(shared, "wB", n_partitions=1, ttl_s=5.0,
+                     elastic=True)
+    w2.heartbeat()
+    w2.sweep()
+    third = _workload(docs, ops=4, base=8)
+    router.append(third)
+    ops = _drain((w2,), router,
+                 len(first) + len(second) + len(third))
+    _assert_exactly_once(ops, per_doc_expected=13)
+    for role in w2.roles.values():
+        p = role._preds.get(parent_rid)
+        assert p is not None and p["done"], (
+            "restart lost the retirement tombstone"
+        )
+    w2.stop()

@@ -148,6 +148,99 @@ def test_merge_and_report():
     assert "role=deli" in report
 
 
+def test_histogram_snapshot_consistent_under_concurrent_observe():
+    """The ISSUE-9 satellite fix: a snapshot's explicit sum/count must
+    agree with its buckets even while observers race — the fields are
+    copied under the instruments' lock, so no torn (sum != counts)
+    snapshot can reach merge()/quantile estimation."""
+    reg = M.MetricsRegistry()
+    h = reg.histogram("lat_ms", buckets=(10.0,))
+    stop = threading.Event()
+
+    def work():
+        while not stop.is_set():
+            h.observe(5.0)  # every observation adds exactly 5 to sum
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            snap = reg.snapshot()["histograms"][0]
+            assert snap["sum"] == pytest.approx(5.0 * snap["count"])
+            assert sum(snap["counts"]) == snap["count"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+
+
+def test_merge_preserves_sum_count_quantiles_across_processes():
+    """Two 'process' snapshots (JSON round-tripped, as the heartbeat
+    channel carries them) merged into one registry must reproduce the
+    exact sum/count and the same quantile estimates as a registry that
+    observed every value directly."""
+    values_a = [0.3, 1.5, 4.0, 9.0, 60.0]
+    values_b = [0.7, 2.0, 30.0, 400.0]
+    a, b, direct = (M.MetricsRegistry() for _ in range(3))
+    for reg, vals in ((a, values_a), (b, values_b),
+                      (direct, values_a + values_b)):
+        h = reg.histogram("lat_ms")
+        for v in vals:
+            h.observe(v)
+    merged = M.MetricsRegistry()
+    for reg in (a, b):
+        merged.merge(json.loads(json.dumps(reg.snapshot())))
+    got = merged.snapshot()["histograms"][0]
+    want = direct.snapshot()["histograms"][0]
+    assert got["counts"] == want["counts"]
+    assert got["count"] == want["count"] == 9
+    assert got["sum"] == pytest.approx(want["sum"])
+    for q in (0.5, 0.95, 0.99):
+        assert M.histogram_quantile(got, q) == pytest.approx(
+            M.histogram_quantile(want, q)
+        )
+    assert got["quantiles"] == want["quantiles"]
+
+
+def test_histogram_stats_and_slo_summary():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("op_stage_ms", buckets=(1.0, 10.0, 100.0),
+                      stage="submit_to_broadcast")
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    reg.histogram("empty_ms", buckets=(1.0,))  # no observations
+    snap = reg.snapshot()
+    stats = M.histogram_stats(
+        next(x for x in snap["histograms"] if x["name"] == "op_stage_ms")
+    )
+    assert stats["count"] == 4
+    assert stats["mean"] == pytest.approx(555.5 / 4)
+    assert 0 < stats["p50"] <= 10.0
+    assert stats["p99"] == float("inf")  # beyond the last bucket
+    slo = M.slo_summary(snap)
+    [entry] = slo["histograms"]  # empty histograms are omitted
+    assert entry["name"] == "op_stage_ms"
+    assert entry["labels"] == {"stage": "submit_to_broadcast"}
+    assert entry["p99"] is None  # JSON-safe overflow marker
+    json.dumps(slo)  # the /slo body must be strict-JSON-able
+
+
+def test_prometheus_quantile_series():
+    reg = M.MetricsRegistry()
+    h = reg.histogram("lat_ms", buckets=(1.0, 10.0))
+    for v in (0.5, 0.6, 0.8, 5.0):
+        h.observe(v)
+    vals = parse_prometheus(reg.to_prometheus())
+    assert 0 < vals['fluid_lat_ms_q{quantile="0.5"}'] <= 1.0
+    assert 1.0 < vals['fluid_lat_ms_q{quantile="0.99"}'] <= 10.0
+    # An estimate beyond the last finite bucket is omitted, not faked.
+    h.observe(100.0)
+    h.observe(100.0)
+    vals = parse_prometheus(reg.to_prometheus())
+    assert 'fluid_lat_ms_q{quantile="0.99"}' not in vals
+
+
 def test_prometheus_exposition_parses():
     reg = M.MetricsRegistry()
     reg.counter("ops_total", role="deli").inc(7)

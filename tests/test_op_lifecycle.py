@@ -228,3 +228,127 @@ def test_approx_wire_size_is_conservative_fuzz():
     # The advisor's exact repros.
     for payload in ([""] * 20, ["\x01"] * 5):
         assert approx_wire_size(payload, 1 << 30) >= len(_dumps(payload))
+
+
+# ---------------------------------------------------------------------------
+# trace continuity (ISSUE 9): stage stamps survive restarts and
+# fenced handoffs; restarted consumers never re-stamp
+# ---------------------------------------------------------------------------
+
+
+def test_trace_stamps_survive_localserver_restart_without_restamp():
+    """The `trace_stage_once` contract, in-proc: a restarted server's
+    scriptorium replays the shared deltas log through `_apply`, whose
+    messages already carry their original "durable" stamp — the replay
+    must neither duplicate the stage nor move its timestamp."""
+    c1, c2, loader, server, doc = make_pair()
+    chan(c1).set("k", "v")
+    c1.flush()
+    before = {
+        m.sequence_number: list(m.traces)
+        for m in server.ops_from(doc, 0)
+    }
+    assert before and all(
+        [s for s, _ in tr].count("durable") == 1
+        for tr in before.values() if any(s == "durable" for s, _ in tr)
+    )
+    server2 = LocalServer(
+        log=server.log, storage=server.storage,
+        checkpoints=server.checkpoints(),
+    )
+    server2.process_all()
+    for m in server2.ops_from(doc, 0):
+        stages = [s for s, _ in m.traces]
+        assert stages.count("durable") <= 1, (
+            f"restart re-stamped seq={m.sequence_number}: {m.traces}"
+        )
+        assert m.traces == before[m.sequence_number], (
+            f"restart moved stamps for seq={m.sequence_number}"
+        )
+
+
+def test_wire_trace_stamps_survive_fenced_handoff_on_elastic_fabric(
+        tmp_path, monkeypatch):
+    """Wire-trace continuity across a kill + fenced takeover on the
+    elastic fabric: records stamped by the dead owner keep their exact
+    "tr" bytes (the successor's recovery replays them SILENTLY — no
+    re-emission, no re-stamp), the successor stamps only the missing
+    tail, and per-doc seqs stay contiguous."""
+    import time as _time
+
+    from fluidframework_tpu.server.queue import FencedError as _Fenced
+    from fluidframework_tpu.server.shard_fabric import (
+        ShardRouter,
+        ShardWorker,
+    )
+
+    monkeypatch.setenv("FLUID_TRACE_WIRE", "1")
+    shared = str(tmp_path)
+    router = ShardRouter(shared, 1, elastic=True)
+    wa = ShardWorker(shared, "wA", n_partitions=1, ttl_s=1.0,
+                     elastic=True)
+    wa.heartbeat()
+    wa.sweep()
+    docs = [f"doc{i}" for i in range(3)]
+    first = [{"kind": "join", "doc": d, "client": 1} for d in docs] + [
+        {"kind": "op", "doc": d, "client": 1, "clientSeq": i + 1,
+         "refSeq": 0, "contents": {"i": i}, "tr_sub": _time.time()}
+        for d in docs for i in range(4)
+    ]
+    router.append(first)
+    deadline = _time.time() + 30
+    def merged():
+        out = []
+        for t in router.deltas_topics():
+            out.extend(r for r in t.read_from(0)
+                       if isinstance(r, dict) and r.get("kind") == "op")
+        return out
+    while _time.time() < deadline and len(merged()) < len(first):
+        wa.step()
+    pre = merged()
+    assert len(pre) == len(first)
+    for r in pre:
+        tr = r.get("tr")
+        assert isinstance(tr, dict) and "stamp" in tr, r
+        if "sub" in tr:
+            assert tr["sub"] <= tr["stamp"]
+    before = {(r["doc"], r["seq"]): r["tr"] for r in pre}
+    victim = next(iter(wa.roles.values()))
+    old_fence, old_owner = victim.fence, victim.owner
+    out_topic = victim.out_topic
+    # "SIGKILL": wA stops stepping, never releases; its lease expires.
+    second = [
+        {"kind": "op", "doc": d, "client": 1, "clientSeq": i + 1,
+         "refSeq": 0, "contents": {"i": i}, "tr_sub": _time.time()}
+        for d in docs for i in range(4, 7)
+    ]
+    router.append(second)
+    _time.sleep(1.2)  # wA's lease TTL lapses
+    wb = ShardWorker(shared, "wB", n_partitions=1, ttl_s=5.0,
+                     elastic=True)
+    wb.heartbeat()
+    expected = len(first) + len(second)
+    deadline = _time.time() + 30
+    while _time.time() < deadline and len(merged()) < expected:
+        wb.step()
+    post = merged()
+    assert len(post) == expected
+    per = {}
+    for r in post:
+        per.setdefault(r["doc"], []).append(r["seq"])
+    for d, seqs in per.items():
+        assert sorted(seqs) == list(range(1, len(seqs) + 1)), (d, seqs)
+    # Every pre-kill record's stamps are byte-identical after the
+    # handoff (the successor re-polls the shared topic; it must never
+    # re-stamp what the dead owner produced).
+    for key, tr in before.items():
+        match = [r for r in post if (r["doc"], r["seq"]) == key]
+        assert len(match) == 1
+        assert match[0]["tr"] == tr, (key, match[0]["tr"], tr)
+    # And the handoff was FENCED: the dead owner's write is rejected.
+    with pytest.raises(_Fenced):
+        out_topic.append_many(
+            [{"kind": "op", "doc": "zombie", "seq": -1}],
+            fence=old_fence, owner=old_owner,
+        )
+    wb.stop()

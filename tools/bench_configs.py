@@ -46,6 +46,13 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   steady aggregate ops/s, with the mid-split stream bit-identical to
   the steady topology's (the convergence half runs on every host;
   the perf assert skips loudly on < 4 cores).
+- config 9: tail-latency SLO guard — with topic doorbells on (the
+  default), submit→broadcast p99 under a steady open-loop load must
+  improve >= 3x over the polling baseline at the same load
+  (testing.deli_bench.run_latency_bench); the trace/quantile
+  correctness assertions and a chaos kill-fault convergence run with
+  doorbells enabled always run; the ratio assert skips loudly on
+  < 4 cores.
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -545,6 +552,94 @@ def config8_rebalance(max_cost_pct: float = 25.0,
     return result
 
 
+def config9_latency(min_p99_improvement: float = 3.0,
+                    min_cores: int = 4) -> dict:
+    """Tail-latency SLO guard (ROADMAP item 3): with topic doorbells
+    ON (the default), submit→broadcast p99 of the supervised farm
+    under a steady OPEN-loop load must improve at least
+    `min_p99_improvement` x over the polling baseline at the same
+    load. FAILS LOUDLY on regression.
+
+    The trace/quantile CORRECTNESS assertions always run, on every
+    host, inside `run_latency_bench` itself: every submitted op
+    observed exactly once in broadcast, per-op stage stamps monotone
+    (sub ≤ stamp ≤ dur/bc), the child-heartbeat-reported
+    `op_stage_ms` histogram bucket-identical to one rebuilt from the
+    wire spans, and the bucket-interpolated p99 landing in the exact
+    sample p99's bucket. Also always run: a chaos KILL-fault
+    convergence run with doorbells enabled — event wakeups must not
+    cost a single bit of the exactly-once contract.
+
+    The RATIO assert skips LOUDLY when the host cannot measure it
+    honestly: fewer than `min_cores` cores (four waking processes
+    time-slice the same cores — the ratio measures the scheduler), or
+    a wake-jitter probe p99 above `max_wake_jitter_p99_ms` (an
+    oversubscribed VM parks idle vCPUs; when a single select() wake
+    costs ~10ms at the tail, that floor sits under the event-driven
+    pipeline's p99 no matter how the consumers wake — the honest-
+    measurement rule config7_multichip's parity_skip_reason set)."""
+    from fluidframework_tpu.testing.chaos import ChaosConfig, run_chaos
+    from fluidframework_tpu.testing.deli_bench import (
+        run_latency_bench,
+        wake_jitter_probe,
+    )
+
+    max_wake_jitter_p99_ms = 2.0
+    cores = os.cpu_count() or 1
+    small = cores < min_cores
+    probe = wake_jitter_probe()
+    res = run_latency_bench(
+        rate_hz=60.0 if small else 150.0,
+        duration_s=max(1.0, (2.0 if small else 4.0) * SCALE),
+    )
+    # Doorbells ride every farm topic by default — prove the chaos
+    # exactly-once contract still holds with them waking consumers
+    # (kill faults land mid-wake; convergence must be bit-identical
+    # with zero duplicated/skipped seqs).
+    chaos = run_chaos(ChaosConfig(
+        seed=9, faults=("kill",), n_docs=2, n_clients=3,
+        ops_per_client=30, timeout_s=240.0,
+    ))
+    assert chaos.converged, (
+        f"chaos kill run with doorbells enabled diverged: "
+        f"{chaos.detail}"
+    )
+    assert chaos.duplicate_seqs == 0 and chaos.skipped_seqs == 0
+    result = {
+        "config": "latency_slo_guard",
+        "min_p99_improvement": min_p99_improvement,
+        "chaos_kill_converged": True,
+        "chaos_restarts": chaos.restarts,
+        "wake_jitter_probe_ms": probe,
+        **res,
+    }
+    jittery = probe["p99"] > max_wake_jitter_p99_ms
+    if small or jittery:
+        why = (
+            f"host has {cores} cores < {min_cores}" if small else
+            f"host wake-jitter probe p99 {probe['p99']}ms > "
+            f"{max_wake_jitter_p99_ms}ms (a single event wake pays "
+            f"multi-ms at the tail here — that floor sits under the "
+            f"doorbell pipeline's p99 regardless of the poll stack)"
+        )
+        result["skipped"] = (
+            f"{why}: the p99 ratio cannot be measured honestly; "
+            f"correctness assertions, the chaos kill gate, and the "
+            f"measured improvements (p50 {res['p50_improvement']}x, "
+            f"p99 {res['p99_improvement']}x) are still reported"
+        )
+        print(f"SKIP config9_latency ratio assert: {result['skipped']}",
+              file=sys.stderr)
+        return result
+    assert res["p99_improvement"] >= min_p99_improvement, (
+        f"doorbells improved submit→broadcast p99 only "
+        f"{res['p99_improvement']:.2f}x over the polling baseline "
+        f"(must be >= {min_p99_improvement}x) on a {cores}-core host: "
+        f"{result}"
+    )
+    return result
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -624,12 +719,20 @@ def main() -> None:
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
                config5_metrics_overhead, config5_log_format,
                config6_shard_scaling, config7_multichip,
-               config8_rebalance, config_streaming_ingress):
+               config8_rebalance, config9_latency,
+               config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_DETAIL.json")
+    # Preserve the bench_trend ledger across this file's wholesale
+    # rewrite — history is the thing the regression gate compares to.
+    try:
+        with open(path) as f:
+            trend = json.load(f).get("trend", {})
+    except (OSError, ValueError):
+        trend = {}
     with open(path, "w") as f:
         json.dump(
             {
@@ -640,10 +743,25 @@ def main() -> None:
                 ),
                 "scale": SCALE,
                 "results": results,
+                "trend": trend,
             },
             f, indent=1,
         )
-    print(json.dumps({"configs": len(results)}))
+    # Fold this run into the trend ledger and FAIL LOUDLY on a >20%
+    # drop vs the best prior run of any config (tools/bench_trend.py).
+    try:
+        from bench_trend import append_and_gate
+    except ImportError:  # imported as a module, not run from tools/
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from bench_trend import append_and_gate
+
+    failures = append_and_gate(path, results)
+    for msg in failures:
+        print(f"REGRESSION: {msg}", file=sys.stderr)
+    print(json.dumps({"configs": len(results),
+                      "trend_regressions": len(failures)}))
+    if failures:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

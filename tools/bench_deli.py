@@ -54,7 +54,15 @@ Env knobs: BD_DOCS (10000; 2048 in shard mode; 4096 in devices mode),
 BD_CLIENTS (64; 8), BD_OPS (ops/client, 1; 2), BD_SEED_RECORDS (400),
 BD_BATCH (8192), BD_SCALE (workload shrink).
 
-Usage: python tools/bench_deli.py [--shard | --devices [LIST]]
+`--latency` switches to the open-loop LATENCY SLO mode
+(`testing.deli_bench.run_latency_bench`, bench_configs
+`config9_latency`'s engine): a steady fixed-rate submit load through
+the supervised farm, per-op submit→stamp→durable→broadcast spans off
+the wire traces, exact + bucket-interpolated p50/p95/p99, doorbells
+vs the polling baseline, slowest ops attached from the flight
+recorder. Env knobs: BD_RATE_HZ (150), BD_DURATION_S (4).
+
+Usage: python tools/bench_deli.py [--shard | --devices [LIST] | --latency]
 """
 
 from __future__ import annotations
@@ -72,6 +80,14 @@ os.environ.setdefault(
 
 if "--shard" in sys.argv:
     os.environ["BD_SHARD"] = "1"
+
+if "--latency" in sys.argv:
+    # Open-loop latency SLO mode: p50/p99 submit→broadcast through
+    # the supervised farm at a steady fixed rate, doorbells ON vs the
+    # polling baseline (bench_configs config9_latency's engine). Env
+    # knobs: BD_RATE_HZ (150), BD_DURATION_S (4), BD_DOCS (2),
+    # BD_CLIENTS (2). See testing.deli_bench.run_latency_bench.
+    os.environ["BD_LATENCY"] = "1"
 
 if "--devices" in sys.argv:
     # Multi-device scaling mode: `--devices [1,4,8]` measures the

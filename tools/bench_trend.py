@@ -1,0 +1,163 @@
+"""Bench trend ledger: append results, fail loudly on regression.
+
+Feeds on the one-line JSON bodies the bench CLIs print
+(`tools/bench_deli.py` in any mode, `tools/bench_configs.py` entries,
+`bench.py`) — one result object per file, or JSONL with several — and
+folds each into a ``trend`` section of BENCH_DETAIL.json keyed by the
+result's ``metric``/``config`` name:
+
+    {"trend": {"deli_pipeline_raw_to_deltas": [
+        {"t": ..., "value": 26900.0, "unit": "records/s"}, ...]}}
+
+Every result's HEADLINE number (ops/s for throughput metrics, the
+p99-improvement ratio for the latency SLO bench — higher is better in
+all cases) is compared against the BEST prior run of the same metric:
+a drop past ``--tolerance`` (default 20%) exits nonzero with the
+offending numbers, so a perf regression fails CI the moment it lands
+instead of surfacing as a slowly sagging ledger. Results whose
+headline cannot be identified are appended but never gated (named on
+stderr, not silently dropped). Skipped gate results (a ``skipped``
+key) are recorded with ``"skipped": true`` and never gated — a CI
+host downgrade must not look like a regression or retire history.
+
+Usage: python tools/bench_trend.py RESULT.json [RESULT.json ...]
+       python tools/bench_deli.py | python tools/bench_trend.py -
+       (env: BENCH_TREND_PATH overrides the ledger location)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Any, List, Optional, Tuple
+
+DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_DETAIL.json",
+)
+
+# Headline fields in preference order — the first present (and
+# numeric) names the metric's one comparable number. All are
+# higher-is-better, so the regression rule is one inequality.
+HEADLINE_FIELDS = (
+    "p99_improvement",          # latency_slo_open_loop (ratio)
+    "ops_per_sec",
+    "aggregate_ops_per_sec",
+    "submissions_per_sec",
+    "op_rebases_per_sec",
+    "speedup",                  # scaling benches (ratio)
+    "columnar_vs_json",         # log-format guard (ratio)
+)
+
+
+def headline(result: dict) -> Optional[Tuple[str, float]]:
+    for f in HEADLINE_FIELDS:
+        v = result.get(f)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return f, float(v)
+    return None
+
+
+def load_results(path: str) -> List[dict]:
+    text = (sys.stdin.read() if path == "-" else open(path).read())
+    stripped = text.strip()
+    if not stripped:
+        return []
+    try:
+        one = json.loads(stripped)
+        return [one] if isinstance(one, dict) else [
+            r for r in one if isinstance(r, dict)
+        ]
+    except ValueError:
+        pass  # not one document: JSONL
+    return [json.loads(line) for line in stripped.splitlines()
+            if line.strip()]
+
+
+def append_and_gate(ledger_path: str, results: List[dict],
+                    tolerance: float = 0.20) -> List[str]:
+    """Fold `results` into the ledger's trend section; returns the
+    regression messages (empty = all clear). The ledger write happens
+    EITHER WAY — a regression should be recorded, not suppressed."""
+    try:
+        with open(ledger_path) as f:
+            ledger = json.load(f)
+    except (OSError, ValueError):
+        ledger = {}
+    if not isinstance(ledger, dict):
+        ledger = {}
+    trend = ledger.setdefault("trend", {})
+    failures: List[str] = []
+    for result in results:
+        key = result.get("metric") or result.get("config")
+        if not isinstance(key, str):
+            print(f"bench_trend: result without metric/config key "
+                  f"skipped: {str(result)[:120]}", file=sys.stderr)
+            continue
+        runs = trend.setdefault(key, [])
+        head = headline(result)
+        skipped = "skipped" in result
+        entry: dict = {"t": time.time()}
+        if head is not None:
+            entry["field"], entry["value"] = head
+        if skipped:
+            entry["skipped"] = True
+        if isinstance(result.get("unit"), str):
+            entry["unit"] = result["unit"]
+        if head is None:
+            print(f"bench_trend: no headline field in {key!r}; "
+                  f"appended ungated", file=sys.stderr)
+        elif not skipped:
+            prior = [r["value"] for r in runs
+                     if isinstance(r.get("value"), (int, float))
+                     and r.get("field") == head[0]
+                     and not r.get("skipped")]
+            if prior:
+                best = max(prior)
+                floor = best * (1.0 - tolerance)
+                if head[1] < floor:
+                    failures.append(
+                        f"{key}: {head[0]}={head[1]:g} regressed "
+                        f">{tolerance:.0%} below the best prior "
+                        f"{best:g} (floor {floor:g}, "
+                        f"{len(prior)} prior runs)"
+                    )
+        runs.append(entry)
+    tmp = ledger_path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(ledger, f, indent=1)
+    os.replace(tmp, ledger_path)
+    return failures
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:]]
+    tolerance = 0.20
+    if "--tolerance" in args:
+        i = args.index("--tolerance")
+        tolerance = float(args[i + 1])
+        del args[i:i + 2]
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    results: List[dict] = []
+    for path in args:
+        results.extend(load_results(path))
+    if not results:
+        print("bench_trend: no results found", file=sys.stderr)
+        return 1
+    ledger_path = os.environ.get("BENCH_TREND_PATH", DEFAULT_PATH)
+    failures = append_and_gate(ledger_path, results, tolerance)
+    for key in {r.get("metric") or r.get("config") for r in results}:
+        print(f"bench_trend: recorded {key} -> {ledger_path}")
+    if failures:
+        for msg in failures:
+            print(f"REGRESSION: {msg}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,8 +4,17 @@ Run: python tools/chaos_run.py --seed N
         [--faults kill,torn,lease,net,client,split,merge,disk]
         [--docs D] [--clients C] [--ops K] [--timeout S] [--keep DIR]
         [--deli scalar|kernel] [--log-format json|columnar]
-        [--boxcar-rate R] [--metrics-out PATH]
+        [--boxcar-rate R] [--metrics-out PATH] [--trace-wire]
         [--partitions N] [--workers W] [--devices N] [--elastic]
+
+`--trace-wire` stamps per-stage wall-clock timestamps onto the farm's
+wire records (side "tr" key — digests compare canonical records, so
+convergence is unaffected) and attaches the slow-op flight recorder's
+spans to the report and the `--metrics-out` line: a chaos run that
+regresses tail latency names the exact slowest ops it produced. On
+the SHARDED runner (`--partitions` > 1) the fabric has no broadcast
+stage, so tracing yields submit→stamp quantiles in the worker
+metrics but no e2e spans — the slow-op list is empty there.
 
 `--faults split,merge,disk` (with `--partitions` > 1) runs the ELASTIC
 hash-range fabric and injects topology changes as faults: a live
@@ -104,6 +113,9 @@ def main() -> int:
     elastic = "--elastic" in args
     if elastic:
         args.remove("--elastic")
+    trace_wire = "--trace-wire" in args
+    if trace_wire:
+        args.remove("--trace-wire")
     if faults_arg is None:
         # Default fault set: the classic classes the chosen runner
         # supports. The sharded runner has no socket consumer, so
@@ -131,6 +143,7 @@ def main() -> int:
             _take("--devices", None)
         ),
         elastic=elastic,
+        trace_wire=trace_wire,
     )
     unknown = set(faults) - set(ALL_FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
@@ -186,11 +199,19 @@ def main() -> int:
         print("farm metrics (merged from role heartbeats):")
         for line in format_report([res.metrics]).splitlines():
             print(f"  {line}")
+        if res.slow_ops:
+            from metrics_report import slow_ops_report
+
+            print(slow_ops_report([{"slow_ops": res.slow_ops}], top=5))
         if metrics_out:
             dump_snapshot_line(
                 metrics_out, res.metrics, source="chaos_run", seed=seed,
                 faults=",".join(faults), deli=cfg.deli_impl,
                 log_format=cfg.log_format,
+                # The exact slow ops ride the same artifact line, so a
+                # tail regression caught by the snapshot's quantiles
+                # comes with its evidence attached.
+                slow_ops=res.slow_ops,
             )
             print(f"metrics snapshot appended to {metrics_out}")
     print("CONVERGED" if res.converged else f"DIVERGED ({res.detail})")

@@ -11,11 +11,14 @@ All snapshots are merged (counters/histograms add, gauges last-write)
 and printed as:
 
 - the per-stage latency table — every histogram with observations:
-  count, mean, p50/p90/p99 (bucket-interpolated);
+  count, mean, p50/p95/p99 (bucket-interpolated);
 - counters and gauges, one row each;
 - a codec summary — the columnar op-log's encode/decode throughput
   (records, bytes, wall time, MB/s) from the `codec_*` metrics
-  `protocol.record_batch` reports.
+  `protocol.record_batch` reports;
+- the slow-op flight recorder — when input lines carry ``slow_ops``
+  spans (`chaos_run --trace-wire --metrics-out`), the slowest ops
+  with their full stage timestamps.
 
 Usage: python tools/metrics_report.py FILE [FILE...]
        python tools/metrics_report.py --json FILE...   (merged snapshot
@@ -92,6 +95,29 @@ def codec_report(merged: dict) -> str:
     return "columnar codec (protocol.record_batch):\n" + "\n".join(lines)
 
 
+def slow_ops_report(snaps: list, top: int = 10) -> str:
+    """The slow-op flight-recorder section: spans attached to any
+    input line (`chaos_run --trace-wire --metrics-out`), slowest
+    first (empty string when none are present)."""
+    spans = []
+    for line in snaps:
+        v = line.get("slow_ops") if isinstance(line, dict) else None
+        if isinstance(v, list):
+            spans.extend(s for s in v if isinstance(s, dict))
+    if not spans:
+        return ""
+    spans.sort(key=lambda s: -float(s.get("e2e_ms", 0.0)))
+    lines = [f"slow-op flight recorder ({len(spans)} spans, "
+             f"slowest {min(top, len(spans))} shown):"]
+    for s in spans[:top]:
+        lines.append(
+            f"  {s.get('e2e_ms'):>9}ms  doc={s.get('doc')} "
+            f"seq={s.get('seq')} client={s.get('client')} "
+            f"clientSeq={s.get('clientSeq')} stages={s.get('stages')}"
+        )
+    return "\n".join(lines)
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:]]
     as_json = "--json" in args
@@ -115,6 +141,9 @@ def main() -> int:
         codec = codec_report(merged)
         if codec:
             print(codec)
+        slow = slow_ops_report(snaps)
+        if slow:
+            print(slow)
     return 0
 
 
