@@ -33,6 +33,14 @@ class LocalDriver:
             ops = [m for m in ops if m.sequence_number <= to_seq]
         return ops
 
+    def catchup(self, doc_id: str, from_seq: int = 0) -> dict:
+        """Nearest summary + op tail in ONE call (the summary-service
+        join shape): ``{"summary": wire|None, "summarySeq": s,
+        "ops": [...tail past max(from_seq, s)]}``. `Loader.resolve`
+        prefers this over load_document + full ops_from when the
+        driver offers it."""
+        return self.server.catchup(doc_id, from_seq)
+
     # Blob surface (reference IDocumentStorageService.createBlob/
     # readBlob — backed server-side by the content-addressed store).
     def upload_blob(self, doc_id: str, data: bytes) -> str:

@@ -402,6 +402,18 @@ class SocketDriver:
                                 fromSeq=from_seq, toSeq=to_seq)
         ]
 
+    def catchup(self, doc_id: str, from_seq: int = 0) -> dict:
+        """Nearest summary + op tail in ONE round trip (the summary
+        service's join shape — `Loader.resolve` prefers it over
+        load_document + a full ops_from)."""
+        res = self._call(doc_id, cmd="catchup", docId=doc_id,
+                         fromSeq=from_seq)
+        return {
+            "summary": res["summary"],
+            "summarySeq": res["summarySeq"],
+            "ops": [message_from_json(m) for m in res["ops"]],
+        }
+
     def upload_blob(self, doc_id: str, data: bytes) -> str:
         return self._call(
             doc_id, cmd="upload_blob", docId=doc_id,

@@ -157,13 +157,35 @@ class Loader:
                 client_id: Optional[int] = None) -> Container:
         """Load from the latest summary + catch up (container.ts:310 →
         :1374 load). With `pending_state`, stashed ops re-apply before
-        connecting, then replay through resubmit on connect."""
-        wire = self.driver.load_document(doc_id)
+        connecting, then replay through resubmit on connect.
+
+        Headless resolves (``connect=False``) against a driver that
+        offers the summary service's ``catchup`` surface answer the
+        whole boot — nearest summary + op tail — in one round trip and
+        apply the tail directly, so a headless reader (the server-side
+        summarizer agent, an export job) sees the current document
+        without ever joining the quorum. Connecting resolves keep the
+        classic load_document path: the join handshake fetches its own
+        catch-up, so shipping the tail here would only be thrown
+        away."""
+        tail_ops = None
+        if (pending_state is None and not connect
+                and hasattr(self.driver, "catchup")):
+            res = self.driver.catchup(doc_id, 0)
+            wire = res["summary"]
+            tail_ops = res["ops"]
+        else:
+            wire = self.driver.load_document(doc_id)
         if wire is None:
             raise KeyError(f"unknown document {doc_id!r}")
         rt = ContainerRuntime(self.registry, flush_mode=self.flush_mode)
         rt.load(SummaryTree.from_json(wire))
         container = Container(rt, self.driver, doc_id)
+        if tail_ops is not None:
+            # Headless catch-up: the summary's tail applies directly.
+            for msg in tail_ops:
+                if msg.sequence_number > rt.current_seq:
+                    rt.process(msg)
         if pending_state is not None:
             state = json.loads(pending_state)
             assert state["docId"] == doc_id

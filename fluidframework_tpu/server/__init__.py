@@ -35,6 +35,13 @@ from .queue import (
 )
 from .supervisor import ServiceSupervisor
 from .shard_fabric import ShardFabricSupervisor, ShardRouter, ShardWorker
+from .summarizer import (
+    SummarizerRole,
+    SummaryIndex,
+    SummaryReplica,
+    read_catchup,
+    summarize_document,
+)
 
 
 def __getattr__(name):
@@ -87,4 +94,9 @@ __all__ = [
     "ShardFabricSupervisor",
     "ShardRouter",
     "ShardWorker",
+    "SummarizerRole",
+    "SummaryIndex",
+    "SummaryReplica",
+    "read_catchup",
+    "summarize_document",
 ]

@@ -987,8 +987,13 @@ class KernelDeliRole(_Role):
         self._pending.append(("cols", start_line, batch))
 
     def _plan_op(self, plan, add, line_idx, doc, slot, col, cid, cseq,
-                 ref, contents, group=NO_GROUP):
-        plan.append((line_idx, doc, "op", (cid, cseq, ref, contents),
+                 ref, contents, group=NO_GROUP, sub_ts=None):
+        # `sub_ts` threads the client submit stamp (ingress "tr_sub")
+        # through the plan tuple so wire-trace mode can stamp/observe
+        # at emit time — the kernel twin of the scalar role's span
+        # coverage (PR 9 follow-up b).
+        plan.append((line_idx, doc, "op",
+                     (cid, cseq, ref, contents, sub_ts),
                      add(slot, SUB_OP, col, cseq, ref, group)))
 
     def flush_batch(self, out: List[dict]) -> None:
@@ -1023,13 +1028,14 @@ class KernelDeliRole(_Role):
                     plan, add, line_idx, doc, slot,
                     h["cmap"].get(cid, 0), cid, rec["clientSeq"],
                     rec.get("refSeq", 0), rec.get("contents"),
+                    sub_ts=rec.get("tr_sub"),
                 )
             elif kind == "boxcar":
                 plan_boxcar(line_idx, doc, slot, h, cid, [
                     (op["clientSeq"], op.get("refSeq", 0),
                      op.get("contents"))
                     for op in rec.get("ops") or []
-                ])
+                ], sub_ts=rec.get("tr_sub"))
             elif kind == "join":
                 conn = shadow.get(doc)
                 if conn is None:
@@ -1047,14 +1053,15 @@ class KernelDeliRole(_Role):
                 plan.append((line_idx, doc, "leave", cid,
                              add(slot, SUB_LEAVE, h["cmap"].get(cid, 0))))
 
-        def plan_boxcar(line_idx, doc, slot, h, cid, ops):
+        def plan_boxcar(line_idx, doc, slot, h, cid, ops, sub_ts=None):
             # One atomic group: a nack masks the group's tail in-kernel
             # (resubmission dedup stays per-op and silent).
             col = h["cmap"].get(cid, 0)
             g = core.new_group(slot)
             for cseq, ref, contents in ops:
                 self._plan_op(plan, add, line_idx, doc, slot, col, cid,
-                              cseq, ref, contents, group=g)
+                              cseq, ref, contents, group=g,
+                              sub_ts=sub_ts)
 
         passthrough = self.out_columnar
         for ent in self._pending:
@@ -1109,12 +1116,18 @@ class KernelDeliRole(_Role):
         emit = out.append
         seqs, msns, nacks, skips = res.seq, res.msn, res.nack, res.skipped
         apply_op = pool.apply_op
+        # Wire-trace stamps: ONE clock read per flush (the kernel
+        # role's whole-pump philosophy — KernelDeliLambda stamps the
+        # same way), serving both the record stamp and the
+        # submit_to_stamp observe so the two surfaces agree exactly.
+        trace = self.trace_wire
+        now = time.time() if trace else 0.0
         for line_idx, doc, tag, payload, handle in plan:
             if tag == "op":
                 if skips[handle]:
                     continue  # deduped resubmission / aborted boxcar tail
                 seq, msn, nack = seqs[handle], msns[handle], nacks[handle]
-                cid, cseq, ref, contents = payload
+                cid, cseq, ref, contents, sub_ts = payload
                 if nack:
                     emit({"kind": "nack", "doc": doc, "client": cid,
                           "clientSeq": cseq, "code": nack,
@@ -1124,23 +1137,45 @@ class KernelDeliRole(_Role):
                           "inOff": line_idx})
                     continue
                 apply_op(doc, cid, seq, msn, cseq, ref)
-                emit({"kind": "op", "doc": doc, "seq": seq, "msn": msn,
-                      "client": cid, "clientSeq": cseq, "refSeq": ref,
-                      "type": "op", "contents": contents,
-                      "inOff": line_idx})
+                rec = {"kind": "op", "doc": doc, "seq": seq, "msn": msn,
+                       "client": cid, "clientSeq": cseq, "refSeq": ref,
+                       "type": "op", "contents": contents,
+                       "inOff": line_idx}
+                if trace:
+                    tr = {"stamp": now}
+                    if isinstance(sub_ts, (int, float)):
+                        tr["sub"] = sub_ts
+                        if not self._recovering:
+                            # Recovery's silent replay must not be
+                            # re-observed (crash-spanning durations) —
+                            # the scalar role's rule, kernel-side.
+                            self._observe_stage(
+                                "submit_to_stamp",
+                                (now - sub_ts) * 1000.0,
+                            )
+                    rec["tr"] = tr
+                emit(rec)
             elif tag == "join":
                 seq, msn = seqs[handle], msns[handle]
                 pool.apply_join(doc, payload, seq, msn)
-                emit({"kind": "op", "doc": doc, "seq": seq, "msn": msn,
-                      "client": payload, "clientSeq": 0, "refSeq": seq - 1,
-                      "type": "join", "contents": payload,
-                      "inOff": line_idx})
+                rec = {"kind": "op", "doc": doc, "seq": seq, "msn": msn,
+                       "client": payload, "clientSeq": 0,
+                       "refSeq": seq - 1,
+                       "type": "join", "contents": payload,
+                       "inOff": line_idx}
+                if trace:
+                    rec["tr"] = {"stamp": now}
+                emit(rec)
             else:  # leave
                 seq, msn = seqs[handle], msns[handle]
                 if seq == 0:
                     continue  # unknown client: nothing stamped
                 pool.apply_leave(doc, payload, seq, msn)
-                emit({"kind": "op", "doc": doc, "seq": seq, "msn": msn,
-                      "client": payload, "clientSeq": 0, "refSeq": seq - 1,
-                      "type": "leave", "contents": payload,
-                      "inOff": line_idx})
+                rec = {"kind": "op", "doc": doc, "seq": seq, "msn": msn,
+                       "client": payload, "clientSeq": 0,
+                       "refSeq": seq - 1,
+                       "type": "leave", "contents": payload,
+                       "inOff": line_idx}
+                if trace:
+                    rec["tr"] = {"stamp": now}
+                emit(rec)

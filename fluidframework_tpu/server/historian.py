@@ -28,7 +28,10 @@ class HistorianCache:
     invalidate immediately)."""
 
     def __init__(self, backing, blob_budget_bytes: int = 64 * 1024 * 1024,
-                 ref_ttl: float = 1.0):
+                 ref_ttl: float = 1.0, name: str = "default"):
+        """`name` labels this cache's metrics series (several
+        historians in one process — e.g. a summary store next to a
+        test fixture — must not fold into one gauge)."""
         self.backing = backing
         self.blob_budget = blob_budget_bytes
         self.ref_ttl = ref_ttl
@@ -38,6 +41,16 @@ class HistorianCache:
         self._lock = threading.RLock()
         self.hits = 0
         self.misses = 0
+        from ..utils.metrics import get_registry
+
+        m = get_registry()
+        self._m_bytes = m.gauge("historian_blob_bytes", cache=name)
+        self._m_blobs = m.gauge("historian_blobs", cache=name)
+        self._m_hits = m.counter("historian_hits_total", cache=name)
+        self._m_misses = m.counter("historian_misses_total", cache=name)
+        self._m_evictions = m.counter(
+            "historian_evictions_total", cache=name
+        )
 
     # ------------------------------------------------------------- blobs
 
@@ -55,8 +68,10 @@ class HistorianCache:
             if data is not None:
                 self._blobs.move_to_end(key)
                 self.hits += 1
+                self._m_hits.inc()
                 return data
             self.misses += 1
+            self._m_misses.inc()
         data = self.backing.get(key)
         with self._lock:
             self._admit(key, data)
@@ -79,6 +94,9 @@ class HistorianCache:
         while self._blob_bytes > self.blob_budget:
             _, old = self._blobs.popitem(last=False)
             self._blob_bytes -= len(old)
+            self._m_evictions.inc()
+        self._m_bytes.set(self._blob_bytes)
+        self._m_blobs.set(len(self._blobs))
 
     # -------------------------------------------------------------- refs
 
@@ -92,8 +110,10 @@ class HistorianCache:
             hit = self._refs.get(name)
             if hit is not None and time.monotonic() - hit[0] < self.ref_ttl:
                 self.hits += 1
+                self._m_hits.inc()
                 return hit[1]
             self.misses += 1
+            self._m_misses.inc()
         val = self.backing.get_ref(name)
         with self._lock:
             self._refs[name] = (time.monotonic(), val)

@@ -52,6 +52,7 @@ from .sequencer import DocumentSequencer
 
 SYSTEM_CLIENT = -1  # server-originated control messages (scribe acks)
 MAX_OP_BYTES = 768 * 1024  # alfred's op-size nack threshold
+_historian_seq = 0  # distinct metrics label per LocalServer historian
 
 
 # --------------------------------------------------------------------------
@@ -643,8 +644,14 @@ class LocalServer:
             from .historian import HistorianCache
 
             if not isinstance(self.storage, HistorianCache):
+                global _historian_seq
+                _historian_seq += 1
+                # Distinct metrics label per server instance: several
+                # LocalServers in one process (tests, benches) must
+                # not clobber one another's historian gauges.
                 self.storage = HistorianCache(
-                    self.storage, blob_budget_bytes=historian_budget
+                    self.storage, blob_budget_bytes=historian_budget,
+                    name=f"local{_historian_seq}",
                 )
         cp = checkpoints or {}
         self.metrics = get_registry()
@@ -893,8 +900,45 @@ class LocalServer:
 
     # ------------------------------------------------------- storage API
 
-    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
-        return self.scriptorium.ops_from(doc_id, from_seq)
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        ops = self.scriptorium.ops_from(doc_id, from_seq)
+        if to_seq is not None:
+            ops = [m for m in ops if m.sequence_number <= to_seq]
+        return ops
+
+    @staticmethod
+    def summary_base_seq(wire: Optional[str]) -> int:
+        """The sequence number a runtime summary wire covers (0 when
+        none / not a runtime summary) — where a catch-up tail starts.
+        Reads the same ``.metadata`` blob `ContainerRuntime.load`
+        boots from."""
+        if wire is None:
+            return 0
+        from ..runtime.summary import SummaryTree
+
+        try:
+            meta = json.loads(
+                SummaryTree.from_json(wire).get_blob(".metadata")
+            )
+            return int(meta.get("sequenceNumber", 0))
+        except (KeyError, ValueError, TypeError, AssertionError):
+            return 0
+
+    def catchup(self, doc_id: str, from_seq: int = 0) -> dict:
+        """Answer a cold join with **nearest summary + op tail** instead
+        of the full log (the summary service's read shape, SURVEY §3.4
+        joins): the newest summary wire (None when the doc has none),
+        the sequence number it covers, and only the ops past
+        ``max(from_seq, summary seq)`` — a million-op doc costs its
+        summary plus the collab-window tail, not its history."""
+        wire = self.download_summary(doc_id)
+        base = self.summary_base_seq(wire)
+        return {
+            "summary": wire,
+            "summarySeq": base,
+            "ops": self.ops_from(doc_id, max(from_seq, base)),
+        }
 
     def upload_summary(self, wire: str) -> str:
         """Client summary upload (the storage.uploadSummaryWithContext

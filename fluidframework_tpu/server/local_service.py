@@ -96,6 +96,8 @@ class LocalOrderingService:
         self._queue: deque[SequencedMessage] = deque()
         self._doc_queue: Dict[str, deque] = {}
         self._next_client_id: Dict[str, int] = {}
+        # doc -> (covered seq, summary wire): the catchup shelf.
+        self._summaries: Dict[str, tuple] = {}
 
     # ------------------------------------------------------ connections
 
@@ -180,6 +182,28 @@ class LocalOrderingService:
 
     # ----------------------------------------------------------- catchup
 
-    def ops_from(self, doc_id: str, from_seq: int) -> List[SequencedMessage]:
-        """Durable op log read (the scriptorium/deltaStorage role)."""
-        return [m for m in self.op_log.get(doc_id, []) if m.sequence_number > from_seq]
+    def ops_from(self, doc_id: str, from_seq: int,
+                 to_seq: Optional[int] = None) -> List[SequencedMessage]:
+        """Durable op log read (the scriptorium/deltaStorage role);
+        `to_seq` bounds the range (the ranged catch-up read)."""
+        return [
+            m for m in self.op_log.get(doc_id, [])
+            if m.sequence_number > from_seq
+            and (to_seq is None or m.sequence_number <= to_seq)
+        ]
+
+    def set_summary(self, doc_id: str, seq: int, wire: str) -> None:
+        """Record a summary covering ops [1..seq] (the storage-less
+        orderer's minimal summary shelf — the embedding app or a
+        summarizer agent writes it; `catchup` serves it)."""
+        self._summaries[doc_id] = (int(seq), wire)
+
+    def catchup(self, doc_id: str, from_seq: int = 0) -> dict:
+        """Nearest summary + op tail (the `LocalServer.catchup` shape,
+        so both in-proc services answer a join identically)."""
+        seq, wire = self._summaries.get(doc_id, (0, None))
+        return {
+            "summary": wire,
+            "summarySeq": seq,
+            "ops": self.ops_from(doc_id, max(from_seq, seq)),
+        }

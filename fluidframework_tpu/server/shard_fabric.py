@@ -886,14 +886,34 @@ class ShardWorker:
                  ckpt_bytes: int = 256 * 1024, ckpt_duty: float = 0.2,
                  worker_ttl_s: Optional[float] = None,
                  deli_devices: Optional[int] = None,
-                 elastic: bool = False):
+                 elastic: bool = False, summarize: bool = False,
+                 summary_ops: Optional[int] = None):
         """`elastic=True` swaps fixed modulo-N partitions for the
         hash-range topology (`queue.RangeLeaseStore`): the worker
         sweeps RANGE leases toward its fair share of the LIVE range
         set (which changes epoch to epoch), executes staged
         split/merge commands for ranges it owns, and releases any
         role whose range a committed topology change retired.
-        `n_partitions` then only seeds the bootstrap topology."""
+        `n_partitions` then only seeds the bootstrap topology.
+
+        `summarize=True` runs a per-partition summary service next to
+        each owned deli (`summarizer.SummarizerRole` under
+        `partitioned_role_class`: ``deltas-p{k}`` → ``summaries-p{k}``
+        + content-addressed blobs in the shared store), following deli
+        ownership for locality but fenced under its own
+        ``summarizer-p{k}`` lease. Static partitions only for now —
+        an elastic summarizer must absorb predecessor ranges' fold
+        state across a split/merge, which is a ROADMAP follow-up, so
+        asking for both is a loud config error rather than a silently
+        wrong summary."""
+        self.summarize = bool(summarize)
+        self.summary_ops = summary_ops
+        if self.summarize and elastic:
+            raise ValueError(
+                "summarize=True is static-partition only: an elastic "
+                "summarizer must absorb predecessor ranges' fold state "
+                "across split/merge (ROADMAP follow-up)"
+            )
         self.shared_dir = shared_dir
         self.slot = slot
         self.owner = owner or slot
@@ -945,6 +965,9 @@ class ShardWorker:
             self.topology = None
         # Role keys: partition ints (static) or range ids (elastic).
         self.roles: Dict[Any, Any] = {}
+        # Per-partition summary services (summarize=True): mirror deli
+        # ownership, own fenced lease per partition.
+        self.summ_roles: Dict[Any, Any] = {}
         self.events: List[str] = []
         self._hb_t = 0.0
         self._sweep_t = 0.0
@@ -1069,6 +1092,46 @@ class ShardWorker:
         role.hb_interval_s = self.ttl_s / 3
         return role
 
+    def _make_summ_role(self, key: Any):
+        from .summarizer import SummarizerRole
+
+        cls = partitioned_role_class(SummarizerRole, key)
+        kw = {}
+        if self.summary_ops is not None:
+            kw["summary_ops"] = self.summary_ops
+        role = cls(
+            self.shared_dir, self.owner, ttl_s=self.ttl_s,
+            batch=self.batch, ckpt_interval_s=self.ckpt_interval_s,
+            ckpt_bytes=self.ckpt_bytes, log_format=self.log_format,
+            ckpt_duty=self.ckpt_duty, **kw,
+        )
+        role.hb_interval_s = self.ttl_s / 3
+        return role
+
+    def _sweep_summarizers(self) -> None:
+        """Summarizers follow deli ownership (the partition's deltas
+        live here anyway); their own lease/fence keeps a deposed
+        worker's late manifest append rejected like any other role."""
+        for k in list(self.summ_roles):
+            if k not in self.roles:
+                self._release_summ(k, "deli released")
+        for k in self.roles:
+            if k not in self.summ_roles:
+                self.summ_roles[k] = self._make_summ_role(k)
+
+    def _release_summ(self, key: Any, why: str) -> None:
+        role = self.summ_roles.pop(key, None)
+        if role is None:
+            return
+        role.close_doorbell()
+        if role.fence is not None:
+            try:
+                role.checkpoint()
+            except (FencedError, OSError):
+                pass
+            role.leases.release(role.name)
+        self._event(f"released summarizer {self._kname(key)} ({why})")
+
     def _release(self, key: Any, why: str) -> None:
         """Graceful fenced handoff: final checkpoint under our (still
         valid) fence, then release with expires=0 — the successor's
@@ -1140,6 +1203,8 @@ class ShardWorker:
                 owner = self._probe.owner_of(self._lease_name(p))
                 if owner is None or owner == self.owner:
                     self.roles[p] = self._make_role(p)
+        if self.summarize:
+            self._sweep_summarizers()
         self._m_owned.set(len(self.roles))
         self._sweep_t = time.time()
 
@@ -1314,6 +1379,15 @@ class ShardWorker:
                 role.close_doorbell()
                 self._m_drops.inc()
                 self._event(f"dropped {self._kname(p)} (fenced: {exc})")
+        for p, role in list(self.summ_roles.items()):
+            try:
+                moved += role.step(idle_sleep=0)
+            except (SystemExit, FencedError) as exc:
+                self.summ_roles.pop(p, None)
+                role.close_doorbell()
+                self._event(
+                    f"dropped summarizer {self._kname(p)} ({exc})"
+                )
         now = time.time()
         if now - self._sweep_t > self.ttl_s / 2:
             self.sweep()
@@ -1328,8 +1402,13 @@ class ShardWorker:
         and the poll fallback are unaffected."""
         from .queue import wait_doorbells
 
-        bells = [b for b in (r.doorbell() for r in self.roles.values())
-                 if b is not None]
+        import itertools
+
+        bells = [b for b in (
+            r.doorbell() for r in itertools.chain(
+                self.roles.values(), self.summ_roles.values()
+            )
+        ) if b is not None]
         if bells:
             # Bounded stretch (the _Role.bell_wait_s rationale), capped
             # so the sweep/heartbeat cadence (ttl/2, ttl/3) still runs
@@ -1343,6 +1422,8 @@ class ShardWorker:
     def stop(self) -> None:
         """Graceful exit: hand every partition off now instead of
         making successors wait out the lease TTL."""
+        for p in sorted(self.summ_roles):
+            self._release_summ(p, "shutdown")
         for p in sorted(self.roles):
             self._release(p, "shutdown")
         try:
@@ -1391,13 +1472,20 @@ class ShardFabricSupervisor(ServiceSupervisor):
                  n_partitions: int = 4,
                  max_partitions: Optional[int] = None,
                  worker_ttl_s: Optional[float] = None,
-                 elastic: bool = False, **kw):
+                 elastic: bool = False, summarize: bool = False,
+                 **kw):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1: {n_workers}")
         self.n_partitions = int(n_partitions)
         self.max_partitions = max_partitions
         self.worker_ttl_s = worker_ttl_s
         self.elastic = bool(elastic)
+        self.summarize = bool(summarize)
+        if self.summarize and self.elastic:
+            raise ValueError(
+                "summarize=True is static-partition only "
+                "(elastic summarizer: ROADMAP follow-up)"
+            )
         roles = tuple(f"shard-w{i}" for i in range(n_workers))
         super().__init__(shared_dir, roles=roles, **kw)
         os.makedirs(os.path.join(shared_dir, "workers"), exist_ok=True)
@@ -1432,6 +1520,10 @@ class ShardFabricSupervisor(ServiceSupervisor):
             cmd += ["--deli-devices", str(self.deli_devices)]
         if self.elastic:
             cmd += ["--elastic"]
+        if self.summarize:
+            cmd += ["--summarize"]
+            if self.summary_ops is not None:
+                cmd += ["--summary-ops", str(self.summary_ops)]
         return cmd
 
     def _hb_file(self, role: str) -> str:
@@ -1579,6 +1671,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     elastic = "--elastic" in args
     if elastic:
         args.remove("--elastic")
+    summarize = "--summarize" in args
+    if summarize:
+        args.remove("--summarize")
+    summary_ops_s = _take("--summary-ops")
     shared_dir = _take("--dir")
     slot = _take("--slot")
     owner = _take("--owner")
@@ -1596,13 +1692,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     if (shared_dir is None or slot is None or args
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
-            or (devices_s is not None and not devices_s.isdigit())):
+            or (devices_s is not None and not devices_s.isdigit())
+            or (summary_ops_s is not None
+                and not summary_ops_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.shard_fabric "
             "--dir D --slot S [--owner O] [--partitions N] [--ttl S] "
             "[--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--max-partitions K] "
             "[--worker-ttl S] [--deli-devices N] [--elastic] "
+            "[--summarize] [--summary-ops N] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -1615,7 +1714,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         ckpt_duty=ckpt_duty,
         worker_ttl_s=float(worker_ttl) if worker_ttl else None,
         deli_devices=int(devices_s) if devices_s else None,
-        elastic=elastic,
+        elastic=elastic, summarize=summarize,
+        summary_ops=int(summary_ops_s) if summary_ops_s else None,
     )
 
 

@@ -25,10 +25,12 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import queue
 import socket
 import socketserver
 import threading
+import time
 from typing import Any, Optional
 
 from ..drivers.file_driver import message_to_json
@@ -196,6 +198,15 @@ class _Session(socketserver.StreamRequestHandler):
                 if to_seq is not None:  # server-side ranged read
                     ops = [m for m in ops if m.sequence_number <= to_seq]
                 return [message_to_json(m) for m in ops], conn
+            if cmd == "catchup":
+                # Nearest summary + op tail in ONE round trip (the
+                # summary-service join shape; see LocalServer.catchup).
+                res = ls.catchup(req["docId"], req.get("fromSeq", 0))
+                return {
+                    "summary": res["summary"],
+                    "summarySeq": res["summarySeq"],
+                    "ops": [message_to_json(m) for m in res["ops"]],
+                }, conn
             if cmd == "upload_blob":
                 return ls.storage.put(base64.b64decode(req["data"])), conn
             if cmd == "read_blob":
@@ -239,6 +250,276 @@ class _Session(socketserver.StreamRequestHandler):
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FarmTailPusher:
+    """Doorbell-aware tail of a supervised-farm topic: the TCP front
+    end's wakeup spine (PR 9 follow-up c — the poll loop retired).
+
+    One background thread registers a `queue.TopicDoorbell` on the
+    topic and sleeps on it with a BOUNDED timeout — the poll fallback
+    that keeps every correctness property doorbell-independent — then
+    drains the incremental tail reader and (a) fans new records out to
+    per-doc subscribers, (b) advances the per-doc head seq and wakes
+    anyone blocked in `wait_for`. Both the live push AND the catch-up
+    long-poll therefore ride the same event wakeup: an `append_many`
+    on the topic rings once, and every subscribed socket plus every
+    pending catch-up response proceeds without a poll interval in the
+    path."""
+
+    def __init__(self, topic_path: str, log_format: Optional[str] = None,
+                 poll_s: float = 0.05, batch: int = 4096):
+        from .columnar_log import make_tail_reader, make_topic
+        from .queue import TopicDoorbell, doorbells_enabled
+
+        self.topic_path = topic_path
+        self._reader = make_tail_reader(make_topic(topic_path, log_format))
+        self._bell = None
+        if doorbells_enabled():
+            try:
+                self._bell = TopicDoorbell(topic_path)
+            except OSError:
+                self._bell = None
+        self.poll_s = poll_s
+        self.batch = batch
+        self._subs: dict = {}  # doc -> [fn(records), ...]
+        self._cond = threading.Condition()
+        self.head_seq: dict = {}  # doc -> newest seq seen
+        self.delivered = 0
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> "FarmTailPusher":
+        self._thread.start()
+        return self
+
+    # ----------------------------------------------------- subscriptions
+
+    def subscribe(self, doc_id: str, fn) -> None:
+        with self._cond:
+            self._subs.setdefault(doc_id, []).append(fn)
+
+    def unsubscribe(self, doc_id: str, fn) -> None:
+        with self._cond:
+            subs = self._subs.get(doc_id, [])
+            if fn in subs:
+                subs.remove(fn)
+            if not subs:
+                self._subs.pop(doc_id, None)
+
+    def wait_for(self, doc_id: str, seq: int,
+                 timeout_s: float = 5.0) -> bool:
+        """Block until the topic holds `doc_id`'s seq >= `seq` (the
+        catch-up long-poll: woken by the same doorbell ring that wakes
+        the live push), bounded by `timeout_s`."""
+        deadline = time.time() + timeout_s
+        with self._cond:
+            while self.head_seq.get(doc_id, 0) < seq:
+                left = deadline - time.time()
+                if left <= 0:
+                    return False
+                self._cond.wait(left)
+        return True
+
+    # ------------------------------------------------------------- loop
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                entries = self._reader.poll(self.batch)
+            except OSError:
+                entries = []
+            if not entries:
+                if self._bell is not None:
+                    self._bell.wait(self.poll_s)
+                else:
+                    self._stopped.wait(self.poll_s)
+                continue
+            per_doc: dict = {}
+            with self._cond:
+                for _, rec in entries:
+                    if not isinstance(rec, dict) or "doc" not in rec:
+                        continue
+                    doc = rec["doc"]
+                    if rec.get("kind") == "op":
+                        self.head_seq[doc] = max(
+                            self.head_seq.get(doc, 0), int(rec["seq"])
+                        )
+                    per_doc.setdefault(doc, []).append(rec)
+                self._cond.notify_all()
+                # Snapshot the fan-out targets under the lock; deliver
+                # outside it (a slow subscriber must not block
+                # wait_for wakeups).
+                targets = [
+                    (fns[:], recs) for doc, recs in per_doc.items()
+                    for fns in (self._subs.get(doc, []),) if fns
+                ]
+            for fns, recs in targets:
+                for fn in fns:
+                    try:
+                        fn(recs)
+                        self.delivered += len(recs)
+                    except Exception:
+                        # Dead subscriber: evict it, keep the room.
+                        with self._cond:
+                            docs = [d for d, subs in self._subs.items()
+                                    if fn in subs]
+                        for doc in docs:
+                            self.unsubscribe(doc, fn)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._thread.join(timeout=5)
+        if self._bell is not None:
+            self._bell.close()
+
+
+class _FarmSession(socketserver.StreamRequestHandler):
+    """One farm-read TCP session: catch-up requests + live push."""
+
+    timeout = 30
+
+    def setup(self) -> None:
+        super().setup()
+        self.connection.settimeout(30)
+        self.connection.setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+        )
+        self._wlock = threading.Lock()
+        self._push_docs: list = []
+
+    def _send(self, obj) -> None:
+        with self._wlock:
+            write_frame(self.wfile, obj)
+
+    def _push(self, recs) -> None:
+        try:
+            self._send({"event": "recs", "recs": recs})
+        except Exception:
+            # Dead/stalled subscriber: tear the transport down so the
+            # handler thread (parked in recv) exits too, then let the
+            # pusher's eviction see the failure.
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise
+
+    def handle(self) -> None:
+        srv: "FarmReadServer" = self.server.owner  # type: ignore
+        try:
+            while True:
+                try:
+                    req = read_frame(self.rfile)
+                except socket.timeout:
+                    # A passive SUBSCRIBER never sends requests; the
+                    # recv timeout must not kill its live feed (pushes
+                    # flow outbound; a dead client is reaped by the
+                    # push path's send failure instead). Sessions with
+                    # no subscription keep the idle-reap behavior.
+                    if self._push_docs:
+                        continue
+                    break
+                if req is None:
+                    break
+                try:
+                    result = self._dispatch(srv, req)
+                    self._send({"id": req.get("id"), "result": result})
+                except Exception as exc:
+                    self._send({"id": req.get("id"), "error": str(exc)})
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            for doc in self._push_docs:
+                srv.pusher.unsubscribe(doc, self._push)
+
+    def _dispatch(self, srv: "FarmReadServer", req: dict):
+        cmd = req["cmd"]
+        if cmd == "catchup":
+            # waitSeq long-poll: the response waits (bounded) for the
+            # topic to hold that seq — woken by the SAME doorbell ring
+            # that wakes the live push, so catch-up never polls.
+            wait_seq = req.get("waitSeq")
+            if wait_seq is not None:
+                srv.pusher.wait_for(
+                    req["docId"], int(wait_seq),
+                    float(req.get("timeout", 5.0)),
+                )
+            return srv.catchup(req["docId"], req.get("fromSeq"))
+        if cmd == "subscribe":
+            doc = req["docId"]
+            self._push_docs.append(doc)
+            srv.pusher.subscribe(doc, self._push)
+            return {"docId": doc,
+                    "headSeq": srv.pusher.head_seq.get(doc, 0)}
+        if cmd == "head":
+            return {"docId": req["docId"],
+                    "headSeq": srv.pusher.head_seq.get(req["docId"], 0)}
+        raise ValueError(f"unknown cmd {cmd!r}")
+
+
+class FarmReadServer:
+    """The supervised farm's READ front end over TCP: summary catch-up
+    (`server.summarizer.read_catchup` — nearest summary manifest +
+    blob + op tail) and live broadcast fan-out, both driven by ONE
+    doorbell-woken tail thread (`FarmTailPusher`). The write path
+    stays the farm's raw topic; this serves the read-heavy side —
+    joins and subscriptions — that PAPER.md names as the real traffic
+    shape."""
+
+    def __init__(self, shared_dir: str, host: str = "127.0.0.1",
+                 port: int = 0, log_format: Optional[str] = None,
+                 push_topic: str = "broadcast",
+                 deltas_topic: str = "deltas"):
+        from .summarizer import SummaryIndex, open_summary_store
+
+        self.shared_dir = shared_dir
+        self.log_format = log_format
+        self.deltas_topic = deltas_topic
+        self.index = SummaryIndex(shared_dir, log_format)
+        self.store = open_summary_store(shared_dir)
+        self.pusher = FarmTailPusher(
+            os.path.join(shared_dir, "topics", f"{push_topic}.jsonl"),
+            log_format,
+        )
+        self._tcp = _FarmTCPServer((host, port), _FarmSession)
+        self._tcp.owner = self  # type: ignore
+        self.host, self.port = self._tcp.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def catchup(self, doc_id: str,
+                from_seq: Optional[int] = None) -> dict:
+        from .summarizer import read_catchup
+
+        res = read_catchup(
+            self.shared_dir, doc_id, self.log_format,
+            index=self.index, store=self.store,
+            deltas_topic=self.deltas_topic,
+        )
+        base = res["manifest"]["seq"] if res["manifest"] else 0
+        ops = res["ops"]
+        if from_seq is not None and from_seq > base:
+            ops = [r for r in ops if int(r["seq"]) > from_seq]
+        return {"manifest": res["manifest"], "blob": res["blob"],
+                "ops": ops}
+
+    def start(self) -> "FarmReadServer":
+        self.pusher.start()
+        self._thread = threading.Thread(
+            target=self._tcp.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+        self.pusher.stop()
+
+
+class _FarmTCPServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 

@@ -75,6 +75,7 @@ __all__ = [
     "DELI_IMPLS",
     "DeliRole",
     "LOG_FORMATS",
+    "PIPELINE_ROLES",
     "ROLES",
     "ScribeRole",
     "ScriptoriumRole",
@@ -86,7 +87,13 @@ __all__ = [
     "unwrap_ranged_state",
 ]
 
-ROLES = ("deli", "scriptorium", "scribe", "broadcaster")
+# The supervised farm: the classic four-lambda pipeline plus the
+# summary service (`server.summarizer.SummarizerRole` — deltas →
+# content-addressed summary blobs + a `summaries` manifest topic, the
+# catch-up read side). PIPELINE_ROLES is the pre-summary four-stage
+# core for callers that want the ordering path alone.
+PIPELINE_ROLES = ("deli", "scriptorium", "scribe", "broadcaster")
+ROLES = PIPELINE_ROLES + ("summarizer",)
 
 EXIT_DEPOSED = 4  # lease renew failed: a successor owns the role
 EXIT_FENCED = 3  # write-path fence rejection: we are a zombie
@@ -966,11 +973,17 @@ DELI_IMPLS = ("scalar", "kernel")
 def resolve_role_class(role: str, deli_impl: str = "scalar"):
     """Role name -> class; `deli_impl="kernel"` swaps the sequencer for
     the device-batched `deli_kernel.KernelDeliRole` (imported lazily so
-    scalar farms never pay the jax import)."""
+    scalar farms never pay the jax import). The summarizer resolves
+    lazily too — its merge-tree fold engine only imports jax when a
+    doc's contents actually decode as merge-tree ops."""
     if role == "deli" and deli_impl == "kernel":
         from .deli_kernel import KernelDeliRole
 
         return KernelDeliRole
+    if role == "summarizer":
+        from .summarizer import SummarizerRole
+
+        return SummarizerRole
     return ROLE_CLASSES[role]
 
 
@@ -1007,19 +1020,27 @@ def serve_role(shared_dir: str, role: str, owner: str,
                ckpt_duty: float = 0.2,
                partition: Optional[int] = None,
                deli_devices: Optional[int] = None,
-               hb_interval_s: Optional[float] = None) -> None:
+               hb_interval_s: Optional[float] = None,
+               summary_ops: Optional[int] = None) -> None:
     """Child-process entry: run one role until killed/deposed/fenced.
     With `partition`, the role serves that partition's topic pair under
     its partition-suffixed lease (one pinned shard of the fabric —
     `shard_fabric.ShardWorker` is the lease-balanced multi-partition
     form). `deli_devices=N` shards the kernel deli's doc-slot pool
     across an N-device mesh (`--deli-devices`; kernel impl only —
-    the scalar deli has no device axis, so asking is a config error)."""
+    the scalar deli has no device axis, so asking is a config error).
+    `summary_ops` sets the summarizer's emission cadence (summarizer
+    role only; env ``FLUID_SUMMARY_OPS`` is the process-wide form)."""
     if deli_devices is not None and deli_devices > 1 and (
             role != "deli" or deli_impl != "kernel"):
         raise ValueError(
             f"deli_devices={deli_devices} needs role=deli with "
             f"deli_impl='kernel' (got role={role!r}, impl={deli_impl!r})"
+        )
+    if summary_ops is not None and role != "summarizer":
+        raise ValueError(
+            f"summary_ops={summary_ops} is a summarizer knob "
+            f"(got role={role!r})"
         )
     cls = resolve_role_class(role, deli_impl)
     if partition is not None:
@@ -1027,6 +1048,8 @@ def serve_role(shared_dir: str, role: str, owner: str,
     kw = {}
     if deli_devices is not None and deli_devices > 1:
         kw["deli_devices"] = deli_devices
+    if summary_ops is not None:
+        kw["summary_ops"] = summary_ops
     r = cls(
         shared_dir, owner, ttl_s=ttl_s, batch=batch,
         ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
@@ -1080,16 +1103,23 @@ class ServiceSupervisor:
                  ckpt_duty: float = 0.2,
                  deli_devices: Optional[int] = None,
                  child_env: Optional[Dict[str, str]] = None,
-                 hb_interval_s: Optional[float] = None):
+                 hb_interval_s: Optional[float] = None,
+                 summary_ops: Optional[int] = None):
         """`child_env` adds/overrides spawn-environment variables for
         every child (the chaos harness's seam: it points CHILDREN at a
         disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
         its own appends). `hb_interval_s` throttles the children's
         heartbeat-file writes (None keeps the classic every-step
-        cadence; forced heartbeats always bypass the throttle)."""
+        cadence; forced heartbeats always bypass the throttle).
+        `summary_ops` sets the summarizer child's emission cadence
+        (records per doc between summaries; None keeps the role
+        default / ``FLUID_SUMMARY_OPS``)."""
         self.shared_dir = shared_dir
         self.child_env = dict(child_env or {})
         self.hb_interval_s = hb_interval_s
+        self.summary_ops = (
+            int(summary_ops) if summary_ops is not None else None
+        )
         self.roles = tuple(roles)
         self.ttl_s = ttl_s
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -1162,6 +1192,8 @@ class ServiceSupervisor:
                "--ckpt-duty", str(self.ckpt_duty)]
         if self.deli_devices is not None and role == "deli":
             cmd += ["--deli-devices", str(self.deli_devices)]
+        if self.summary_ops is not None and role == "summarizer":
+            cmd += ["--summary-ops", str(self.summary_ops)]
         if self.hb_interval_s is not None:
             cmd += ["--hb-interval", str(self.hb_interval_s)]
         return cmd
@@ -1493,17 +1525,21 @@ def main(argv: Optional[List[str]] = None) -> None:
     partition_s = _take("--partition")
     devices_s = _take("--deli-devices")
     hb_interval_s = _take("--hb-interval")
-    if (role not in ROLE_CLASSES or shared_dir is None
+    summary_ops_s = _take("--summary-ops")
+    if (role not in ROLES or shared_dir is None
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
             or (partition_s is not None and not partition_s.isdigit())
-            or (devices_s is not None and not devices_s.isdigit())):
+            or (devices_s is not None and not devices_s.isdigit())
+            or (summary_ops_s is not None
+                and not summary_ops_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
-            "--role {deli|scriptorium|scribe|broadcaster} --dir D "
+            "--role {deli|scriptorium|scribe|broadcaster|summarizer} "
+            "--dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "
-            "[--deli-devices N] [--hb-interval S] "
+            "[--deli-devices N] [--hb-interval S] [--summary-ops N] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -1515,7 +1551,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                partition=int(partition_s) if partition_s else None,
                deli_devices=int(devices_s) if devices_s else None,
                hb_interval_s=float(hb_interval_s)
-               if hb_interval_s else None)
+               if hb_interval_s else None,
+               summary_ops=int(summary_ops_s) if summary_ops_s else None)
 
 
 if __name__ == "__main__":

@@ -73,6 +73,7 @@ from ..server.queue import (
     SharedFileTopic,
 )
 from ..server.supervisor import (
+    PIPELINE_ROLES,
     DeliRole,
     ScribeRole,
     ServiceSupervisor,
@@ -142,6 +143,15 @@ class ChaosConfig:
     # convergence — digests compare `canonical_record`, which never
     # sees "tr".
     trace_wire: bool = False
+    # Summary service (`server.summarizer.SummarizerRole`): run the
+    # summarizer as a fifth supervised role, include it in the kill
+    # schedule, and gate the run on SUMMARY INTEGRITY too — every
+    # (doc, seq) manifest emitted exactly once with one handle
+    # (restarts never fork a summary), and the newest summary + op
+    # tail booting bit-identical to a cold full-log replay
+    # (`summarizer.state_digest`). Classic single-partition farm only.
+    summarizer: bool = False
+    summary_ops: int = 32
 
 
 @dataclass
@@ -175,6 +185,11 @@ class ChaosResult:
     # slowest first, with all stage timestamps — a tail-latency
     # regression report carries its evidence.
     slow_ops: List[dict] = field(default_factory=list)
+    # Summary-service evidence (summarizer runs only): manifests seen,
+    # and whether the integrity gate held — no (doc, seq) fork or
+    # duplicate, and summary + tail boot == cold full replay.
+    summaries_ok: bool = True
+    summary_manifests: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -376,6 +391,15 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
     unknown = set(cfg.faults) - set(ALL_FAULT_CLASSES)
     if unknown:
         raise ValueError(f"unknown fault classes {sorted(unknown)}")
+    if cfg.summarizer and cfg.n_partitions > 1:
+        # The per-partition summarizer rides ShardWorker(summarize=)
+        # on the STATIC fabric; the chaos gate for it is a follow-up —
+        # accepting the flag here would print a summary-integrity
+        # verdict the sharded runner never checked.
+        raise ValueError(
+            "summarizer=True runs on the classic single-partition "
+            "farm (sharded summary gate: ROADMAP follow-up)"
+        )
     elastic_wanted = [f for f in cfg.faults if f in ELASTIC_FAULTS]
     if elastic_wanted and cfg.n_partitions <= 1:
         # split/merge/disk target the sharded fabric's workers and
@@ -453,17 +477,26 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     gscribe = golden_scribe_digests(golden, os.path.join(shared, "golden"))
     expected = len(golden)
 
+    kill_targets = ["deli", "scriptorium", "scribe", "broadcaster"]
+    roles = PIPELINE_ROLES
+    if cfg.summarizer:
+        # Fifth role: the summary service, killed like any other —
+        # restarts must re-emit byte-identical manifests, never fork.
+        kill_targets.append("summarizer")
+        from ..server.supervisor import ROLES as _ALL_ROLES
+
+        roles = _ALL_ROLES
     chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
-        cfg, rng, workload,
-        ("deli", "scriptorium", "scribe", "broadcaster"),
+        cfg, rng, workload, tuple(kill_targets),
     )
 
     sup = ServiceSupervisor(
-        shared, ttl_s=cfg.ttl_s,
+        shared, roles=roles, ttl_s=cfg.ttl_s,
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
         deli_impl=cfg.deli_impl, log_format=cfg.log_format,
         deli_devices=cfg.deli_devices,
         child_env={"FLUID_TRACE_WIRE": "1"} if cfg.trace_wire else None,
+        summary_ops=cfg.summary_ops if cfg.summarizer else None,
     ).start()
     raw = make_topic(os.path.join(shared, "topics", "rawdeltas.jsonl"),
                      cfg.log_format)
@@ -472,6 +505,21 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
                          cfg.log_format)
     broadcast = make_topic(
         os.path.join(shared, "topics", "broadcast.jsonl"), cfg.log_format
+    )
+    summaries = make_topic(
+        os.path.join(shared, "topics", "summaries.jsonl"), cfg.log_format
+    )
+    # Deterministic manifest count: each doc's record count is fixed
+    # by the workload (dup resubmissions dedup silently), so the
+    # summarizer MUST emit exactly one manifest per cadence multiple
+    # past the engine-decision point (the doc's first op, at count
+    # n_clients + 1; earlier multiples — all-join prefixes — are
+    # deterministically skipped) — however many times it was killed.
+    per_doc = cfg.n_clients * (1 + cfg.ops_per_client)
+    expected_manifests = (
+        cfg.n_docs * (per_doc // cfg.summary_ops
+                      - cfg.n_clients // cfg.summary_ops)
+        if cfg.summarizer else 0
     )
     fence_rejections = 0
     events: List[str] = []
@@ -532,6 +580,12 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
                     if isinstance(r, dict) and r.get("kind") == "op"]
             if (fed_idx >= len(chunks) and not pending_dups
                     and len(ops) >= expected and len(bops) >= expected):
+                if cfg.summarizer and sum(
+                    1 for r in summaries.read_from(0)
+                    if isinstance(r, dict) and r.get("kind") == "summary"
+                ) < expected_manifests:
+                    time.sleep(0.02)
+                    continue  # the summary service must finish too
                 scr = FencedCheckpointStore(
                     os.path.join(shared, "checkpoints")
                 ).load("scribe")
@@ -564,14 +618,66 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         ((scr or {}).get("state", {}).get("state", {}) or {}).items()
     }
     scribe_ok = live_scribe == gscribe
+    # Summary-service integrity (summarizer runs): every (doc, seq)
+    # manifest exactly once with exactly one handle (a kill between
+    # blob put / manifest append / checkpoint must re-emit the SAME
+    # summary, never fork or duplicate it), the deterministic cadence
+    # count reached, and the newest summary + tail booting
+    # bit-identical to a cold full-log replay.
+    summaries_ok = True
+    n_manifests = 0
+    if cfg.summarizer:
+        from ..server.summarizer import (
+            SummaryReplica,
+            open_summary_store,
+            read_catchup,
+        )
+
+        mans = [r for r in summaries.read_from(0)
+                if isinstance(r, dict) and r.get("kind") == "summary"]
+        n_manifests = len(mans)
+        by_key: Dict[Tuple[str, int], List[str]] = {}
+        for m in mans:
+            by_key.setdefault((m["doc"], m["seq"]), []).append(
+                m["handle"]
+            )
+        summaries_ok = (
+            n_manifests == expected_manifests
+            and all(len(hs) == 1 for hs in by_key.values())
+        )
+        if summaries_ok and expected_manifests:
+            deltas_topic = make_topic(deltas_path, cfg.log_format)
+            deltas_ops = [
+                r for r in deltas_topic.read_from(0)
+                if isinstance(r, dict) and r.get("kind") == "op"
+            ]
+            store = open_summary_store(shared)
+            for doc in sorted({r["doc"] for r in deltas_ops}):
+                cu = read_catchup(shared, doc, cfg.log_format,
+                                  store=store)
+                boot = SummaryReplica(cu["blob"])
+                boot.apply_records(cu["ops"])
+                cold = SummaryReplica(None)
+                cold.apply_records(
+                    [r for r in deltas_ops if r["doc"] == doc]
+                )
+                if boot.state_digest() != cold.state_digest():
+                    summaries_ok = False
+                    events.append(
+                        f"summary+tail boot DIVERGED for {doc}"
+                    )
+                    break
     converged = (
         digest == gdigest and dups == 0 and skips == 0 and scribe_ok
+        and summaries_ok
         and (client_digest in (None, gdigest))
         and ("lease" not in cfg.faults or fence_rejections > 0)
     )
     detail = (
         f"ops={len(ops)}/{expected} restarts={sup.restarts} "
-        f"events={events + sup.events}"
+        + (f"manifests={n_manifests}/{expected_manifests} "
+           f"summaries_ok={summaries_ok} " if cfg.summarizer else "")
+        + f"events={events + sup.events}"
     )
     # Observability artifacts: merge every role's final
     # heartbeat-reported metrics snapshot (the same channel the
@@ -595,6 +701,7 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         events=events + list(sup.events), detail=detail,
         timeline=sorted(timeline + sup.timeline), metrics=metrics,
         slow_ops=sup.child_slow_ops() if cfg.trace_wire else [],
+        summaries_ok=summaries_ok, summary_manifests=n_manifests,
     )
 
 

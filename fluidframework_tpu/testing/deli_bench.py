@@ -854,6 +854,291 @@ def run_rebalance_bench(n_docs: int = 10_000, n_clients: int = 64,
 
 
 # ---------------------------------------------------------------------------
+# summary catch-up bench (config10_catchup's engine)
+# ---------------------------------------------------------------------------
+
+
+def build_mergetree_stream(n_ops: int, n_clients: int = 4,
+                           seed: int = 10, doc: str = "doc0",
+                           window: int = 64,
+                           target_len: int = 400) -> List[dict]:
+    """A deterministic SEQUENCED deltas stream of merge-tree wire ops:
+    joins, then `n_ops` sequential insert/remove/annotate ops whose
+    positions are valid at their refSeq (= seq-1) perspective, with
+    the msn trailing by `window` (so summaries stay window-bounded)
+    and document length hovering around `target_len` (so per-op kernel
+    cost — O(live rows) — is flat and the log-length axis isolates
+    replay cost, the thing summaries remove). A PREFIX of the stream
+    is itself a valid stream, so one build serves every swept log
+    length."""
+    import random
+    import string
+
+    rng = random.Random(seed)
+    recs: List[dict] = []
+    seq = 0
+    for c in range(1, n_clients + 1):
+        seq += 1
+        recs.append({"kind": "op", "doc": doc, "seq": seq, "msn": 0,
+                     "client": c, "clientSeq": 0, "refSeq": seq - 1,
+                     "type": "join", "contents": c})
+    length = 0
+    cseq = {c: 0 for c in range(1, n_clients + 1)}
+    for _ in range(n_ops):
+        c = rng.randint(1, n_clients)
+        seq += 1
+        cseq[c] += 1
+        msn = max(0, seq - window)
+        r = rng.random()
+        p_ins = 0.45 if length < target_len else 0.25
+        if length == 0 or r < p_ins:
+            pos = rng.randint(0, length)
+            text = "".join(
+                rng.choices(string.ascii_lowercase, k=rng.randint(1, 6))
+            )
+            contents: dict = {"type": 0, "pos1": pos, "seg": text}
+            length += len(text)
+        elif r < p_ins + 0.35:
+            a = rng.randint(0, length - 1)
+            b = min(length, a + rng.randint(1, 6))
+            contents = {"type": 1, "pos1": a, "pos2": b}
+            length -= b - a
+        else:
+            a = rng.randint(0, length - 1)
+            b = min(length, a + rng.randint(1, 8))
+            contents = {"type": 2, "pos1": a, "pos2": b,
+                        "props": {rng.choice(["bold", "color", "size"]):
+                                  rng.choice([1, 2, "x", None])}}
+        recs.append({"kind": "op", "doc": doc, "seq": seq, "msn": msn,
+                     "client": c, "clientSeq": cseq[c],
+                     "refSeq": seq - 1, "type": "op",
+                     "contents": contents})
+    return recs
+
+
+def _drive_summarizer(shared: str, log_format: str,
+                      summary_ops: int, batch: int = 4096) -> dict:
+    """Run the summarizer ROLE datapath (deltas → summaries + blobs)
+    to quiescence over an already-written deltas topic — the exact
+    fold/emit path the supervised child runs, minus lease upkeep (the
+    `run_pipeline` pattern)."""
+    from ..server.columnar_log import make_tail_reader, make_topic
+    from ..server.summarizer import SummarizerRole
+
+    deltas = make_topic(
+        os.path.join(shared, "topics", "deltas.jsonl"), log_format
+    )
+    role = SummarizerRole(shared, owner="bench-summ", ttl_s=3600.0,
+                          log_format=log_format,
+                          summary_ops=summary_ops)
+    role.fence = 1
+    reader = make_tail_reader(deltas)
+    # The counter is process-global (shared registry labels): report
+    # THIS run's delta, not the cumulative across swept lengths.
+    summ0 = int(role._m_summaries.value)
+    n = 0
+    t0 = time.perf_counter()
+    while True:
+        entries = reader.poll(batch)
+        if not entries:
+            break
+        out: List[dict] = []
+        for line_idx, rec in entries:
+            role.process(line_idx, rec, out)
+        role.flush_batch(out)
+        if out:
+            role.out_topic.append_many(out, fence=1, owner="bench-summ")
+        role.offset = reader.next_line
+        n += len(entries)
+    return {"seconds": time.perf_counter() - t0, "records": n,
+            "summaries": int(role._m_summaries.value) - summ0}
+
+
+def run_fanout_bench(n_records: int = 2000, n_subscribers: int = 200,
+                     batch: int = 256,
+                     work_dir: Optional[str] = None) -> dict:
+    """Broadcast fan-out through the doorbell-woken read front end
+    (`socket_service.FarmTailPusher`): N subscribed readers on one
+    partition's broadcast tail, aggregate deliveries/s — the
+    hundreds-of-subscribed-clients shape of the read-heavy workload."""
+    from ..server.queue import SharedFileTopic
+    from ..server.socket_service import FarmTailPusher
+
+    scratch = work_dir or tempfile.mkdtemp(
+        prefix="fanout-bench-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    try:
+        path = os.path.join(scratch, "topics", "broadcast.jsonl")
+        topic = SharedFileTopic(path)
+        pusher = FarmTailPusher(path, "json").start()
+        import threading
+
+        got = [0] * n_subscribers
+        done = threading.Event()
+
+        def sub(i):
+            def fn(recs):
+                got[i] += len(recs)
+                if got[i] >= n_records and all(
+                    g >= n_records for g in got
+                ):
+                    done.set()
+            return fn
+
+        for i in range(n_subscribers):
+            pusher.subscribe("doc0", sub(i))
+        recs = [{"kind": "op", "doc": "doc0", "seq": i + 1, "msn": 0,
+                 "client": 1, "clientSeq": i + 1, "refSeq": 0,
+                 "type": "op", "contents": {"i": i}}
+                for i in range(n_records)]
+        t0 = time.perf_counter()
+        for lo in range(0, n_records, batch):
+            topic.append_many(recs[lo:lo + batch])
+        assert done.wait(timeout=120.0), (
+            f"fan-out never completed: {min(got)}/{n_records} at the "
+            f"slowest subscriber"
+        )
+        elapsed = time.perf_counter() - t0
+        pusher.stop()
+        total = n_records * n_subscribers
+        return {
+            "records": n_records, "subscribers": n_subscribers,
+            "seconds": round(elapsed, 4),
+            "deliveries_per_sec": round(total / elapsed, 1),
+        }
+    finally:
+        if work_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+def run_catchup_bench(log_lengths: Tuple[int, ...] = (10_000, 30_000,
+                                                      100_000),
+                      summary_ops: int = 2000, n_clients: int = 4,
+                      n_subscribers: int = 200,
+                      log_format: str = "json",
+                      work_dir: Optional[str] = None) -> dict:
+    """Cold-join latency vs log length, with and without summaries —
+    the read side the summary service exists for.
+
+    For each swept log length L (prefixes of ONE deterministic
+    merge-tree stream): write the deltas topic, run the summarizer
+    role datapath over it, then measure a cold join both ways —
+    full-log replay through the merge-tree kernel
+    (`summarizer.SummaryReplica(None)`, what every joiner paid before
+    this service) vs nearest summary + op tail
+    (`summarizer.read_catchup` + blob boot). The CORRECTNESS gate
+    always runs: both joins must land on the identical document-state
+    digest at every L. Headline: `speedup` (full replay / summary
+    join at the largest L) and `join_flatness` (summary-join time at
+    max L over min L — flat means ~1). A broadcast fan-out leg
+    (`run_fanout_bench`) rides along."""
+    from ..server.columnar_log import make_topic
+    from ..server.summarizer import (
+        SummaryReplica,
+        open_summary_store,
+        read_catchup,
+    )
+
+    scratch = work_dir or tempfile.mkdtemp(prefix="catchup-bench-")
+    try:
+        lengths = tuple(sorted(set(int(x) for x in log_lengths)))
+        # A scaled-down sweep (BD_SCALE/BC_SCALE) must still produce a
+        # summary at the SMALLEST length, or the correctness gate has
+        # nothing to check and the run crashes where config10 promises
+        # a loud skip — clamp the cadence so every swept length emits
+        # several (full scale: 2000 < 10000//4, unchanged).
+        summary_ops = max(16, min(int(summary_ops), lengths[0] // 4))
+        stream = build_mergetree_stream(max(lengths),
+                                        n_clients=n_clients)
+        joins = n_clients  # the join records ride ahead of the ops
+        # Warm-up: one full untimed mini-cycle (summarize + cold
+        # replay + summary boot) so the timed region never compiles —
+        # the boot path jits its own table shapes, not just the cold
+        # replay's (the standard bench contract).
+        warm_L = min(1024, lengths[0])
+        warm_dir = os.path.join(scratch, "warm")
+        os.makedirs(os.path.join(warm_dir, "topics"), exist_ok=True)
+        warm_prefix = stream[: joins + warm_L]
+        make_topic(
+            os.path.join(warm_dir, "topics", "deltas.jsonl"), log_format
+        ).append_many(warm_prefix)
+        _drive_summarizer(warm_dir, log_format,
+                          max(64, min(summary_ops, warm_L // 2)))
+        warm = SummaryReplica(None)
+        warm.apply_records(warm_prefix)
+        wcu = read_catchup(warm_dir, "doc0", log_format,
+                           store=open_summary_store(warm_dir))
+        wboot = SummaryReplica(wcu["blob"]) if wcu["blob"] else \
+            SummaryReplica(None)
+        wboot.apply_records(wcu["ops"])
+        runs: List[dict] = []
+        for L in lengths:
+            ldir = os.path.join(scratch, f"L{L}")
+            os.makedirs(os.path.join(ldir, "topics"), exist_ok=True)
+            prefix = stream[: joins + L]
+            deltas = make_topic(
+                os.path.join(ldir, "topics", "deltas.jsonl"), log_format
+            )
+            for lo in range(0, len(prefix), 16384):
+                deltas.append_many(prefix[lo:lo + 16384])
+            summ = _drive_summarizer(ldir, log_format, summary_ops)
+            store = open_summary_store(ldir)
+
+            t0 = time.perf_counter()
+            cold = SummaryReplica(None)
+            cold.apply_records(prefix)
+            cold_s = time.perf_counter() - t0
+
+            t0 = time.perf_counter()
+            cu = read_catchup(ldir, "doc0", log_format, store=store)
+            boot = SummaryReplica(cu["blob"]) if cu["blob"] else \
+                SummaryReplica(None)
+            boot.apply_records(cu["ops"])
+            warm_s = time.perf_counter() - t0
+
+            # Correctness gate (ALWAYS): identical document state.
+            assert cu["manifest"] is not None, f"no summary at L={L}"
+            assert boot.state_digest() == cold.state_digest(), (
+                f"summary+tail boot diverges from full replay at L={L}"
+            )
+            runs.append({
+                "log_len": L,
+                "full_replay_ms": round(cold_s * 1000.0, 2),
+                "summary_join_ms": round(warm_s * 1000.0, 2),
+                "speedup": round(cold_s / warm_s, 2),
+                "summary_seq": cu["manifest"]["seq"],
+                "tail_ops": len(cu["ops"]),
+                "blob_bytes": cu["manifest"]["bytes"],
+                "summarize_s": round(summ["seconds"], 3),
+                "summaries": summ["summaries"],
+            })
+        lo, hi = runs[0], runs[-1]
+        fanout = run_fanout_bench(n_subscribers=n_subscribers)
+        return {
+            "metric": "summary_catchup",
+            "log_format": log_format,
+            "summary_ops": summary_ops,
+            "runs": runs,
+            "speedup": hi["speedup"],
+            "speedup_axis": f"full_replay_vs_summary_join_at_"
+                            f"{hi['log_len']}_ops",
+            "join_flatness": round(
+                hi["summary_join_ms"] / max(1e-9, lo["summary_join_ms"]),
+                2,
+            ),
+            "fanout": fanout,
+            "cores": os.cpu_count(),
+            "gate": "summary+tail boot bit-identical to full replay "
+                    "at every length",
+            "unit": "ratio",
+        }
+    finally:
+        if work_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
 # open-loop latency SLO bench (config9_latency's engine)
 # ---------------------------------------------------------------------------
 
@@ -1225,6 +1510,25 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             * scale,
             n_docs=int(os.environ.get("BD_DOCS", "2")),
             n_clients=int(os.environ.get("BD_CLIENTS", "2")),
+        )
+        print(json.dumps(res))
+        return
+    if os.environ.get("BD_CATCHUP"):
+        # Summary catch-up mode (tools/bench_deli.py --catchup):
+        # cold-join latency vs log length with/without summaries plus
+        # the broadcast fan-out leg (bench_configs config10_catchup's
+        # engine). BD_LOG_LENGTHS is a comma list (default
+        # "10000,30000,100000", scaled by BD_SCALE).
+        lens = tuple(
+            max(512, int(int(x) * scale)) for x in os.environ.get(
+                "BD_LOG_LENGTHS", "10000,30000,100000"
+            ).split(",") if x
+        )
+        res = run_catchup_bench(
+            log_lengths=lens,
+            summary_ops=int(os.environ.get("BD_SUMMARY_OPS", "2000")),
+            n_subscribers=int(os.environ.get("BD_SUBSCRIBERS", "200")),
+            log_format=os.environ.get("BD_LOG_FORMAT", "json"),
         )
         print(json.dumps(res))
         return

@@ -1,0 +1,315 @@
+"""Snapshot catch-up surface: LocalServer/LocalOrderingService
+`catchup`, the in-proc summarizer agent, the socket `catchup` RPC,
+the Loader fast path, and the doorbell-woken farm read front end
+(`FarmTailPusher` / `FarmReadServer`)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.dds import MapFactory, StringFactory
+from fluidframework_tpu.drivers import LocalDriver
+from fluidframework_tpu.loader import Loader
+from fluidframework_tpu.runtime import ChannelRegistry
+from fluidframework_tpu.server import LocalServer
+from fluidframework_tpu.server.summarizer import summarize_document
+
+REGISTRY = ChannelRegistry([MapFactory(), StringFactory()])
+
+
+def make_doc(server):
+    loader = Loader(LocalDriver(server), REGISTRY)
+    c = loader.create_detached()
+    ds = c.runtime.create_datastore("default")
+    ds.create_channel("s", StringFactory.type_name)
+    return loader, c
+
+
+def text(c):
+    return c.runtime.get_datastore("default").get_channel("s")
+
+
+# ---------------------------------------------------------------------------
+# LocalServer catch-up + the summarizer agent
+# ---------------------------------------------------------------------------
+
+
+def test_local_server_catchup_serves_summary_plus_tail():
+    server = LocalServer()
+    loader, c1 = make_doc(server)
+    text(c1).insert_text(0, "hello")
+    doc = c1.attach()
+    for i in range(20):
+        text(c1).insert_text(0, f"{i}:")
+    c1.flush()
+
+    # No summary beyond the attach one: the tail is ~the whole log.
+    before = server.catchup(doc)
+    assert before["summarySeq"] == 0  # attach summary covers seq 0
+    long_tail = len(before["ops"])
+
+    # The server-side summarizer agent (the reference's summarizer
+    # client): headless resolve, upload, re-point the ref.
+    handle, base = summarize_document(server, REGISTRY, doc)
+    assert base > 0 and server.storage.get_ref(doc) == handle
+
+    after = server.catchup(doc)
+    assert after["summarySeq"] == base
+    assert len(after["ops"]) < long_tail
+    assert all(m.sequence_number > base for m in after["ops"])
+
+    # A joiner boots from the summary + short tail, bit-identical.
+    c2 = loader.resolve(doc)
+    assert text(c2).get_text() == text(c1).get_text()
+
+    # Headless resolve (connect=False) applies the tail through the
+    # catchup fast path — current state without joining the quorum.
+    for i in range(5):
+        text(c1).insert_text(0, "x")
+    c1.flush()
+    c3 = loader.resolve(doc, connect=False)
+    assert text(c3).get_text() == text(c1).get_text()
+    assert not c3.connected
+
+
+def test_summarizer_agent_keeps_tail_short_over_time():
+    server = LocalServer()
+    loader, c1 = make_doc(server)
+    text(c1).insert_text(0, "seed")
+    doc = c1.attach()
+    for round_ in range(3):
+        for i in range(10):
+            text(c1).insert_text(0, f"{round_}.{i},")
+        c1.flush()
+        summarize_document(server, REGISTRY, doc)
+        cu = server.catchup(doc)
+        # The tail past each fresh summary stays near-empty.
+        assert len(cu["ops"]) <= 1
+    c2 = loader.resolve(doc)
+    assert text(c2).get_text() == text(c1).get_text()
+
+
+def test_local_ordering_service_catchup():
+    from fluidframework_tpu.server.local_service import (
+        LocalOrderingService,
+    )
+    from fluidframework_tpu.protocol.messages import DocumentMessage
+
+    svc = LocalOrderingService()
+    conn = svc.connect("d", 1)
+    for i in range(1, 6):
+        conn.submit(DocumentMessage(client_seq=i, ref_seq=0,
+                                    contents={"i": i}))
+    assert [m.sequence_number for m in svc.ops_from("d", 2, to_seq=4)] \
+        == [3, 4]
+    svc.set_summary("d", 4, "WIRE")
+    cu = svc.catchup("d")
+    assert cu["summary"] == "WIRE" and cu["summarySeq"] == 4
+    assert all(m.sequence_number > 4 for m in cu["ops"])
+
+
+# ---------------------------------------------------------------------------
+# socket RPC + driver + loader fast path over TCP
+# ---------------------------------------------------------------------------
+
+
+def test_socket_catchup_round_trip():
+    from fluidframework_tpu.drivers.socket_driver import SocketDriver
+    from fluidframework_tpu.server.socket_service import SocketDeltaServer
+
+    server = LocalServer()
+    srv = SocketDeltaServer(server, allow_anonymous=True).start()
+    try:
+        driver = SocketDriver(srv.host, srv.port)
+        loader = Loader(driver, REGISTRY)
+        _, c1 = make_doc(server)
+        text(c1).insert_text(0, "over tcp")
+        doc = c1.attach()
+        for i in range(8):
+            text(c1).insert_text(0, f"{i}")
+        c1.flush()
+        summarize_document(server, REGISTRY, doc)
+
+        cu = driver.catchup(doc)
+        assert cu["summarySeq"] > 0
+        assert all(
+            m.sequence_number > cu["summarySeq"] for m in cu["ops"]
+        )
+        # Loader over the socket driver rides the same fast path.
+        c2 = loader.resolve(doc, connect=False)
+        assert text(c2).get_text() == text(c1).get_text()
+        c3 = loader.resolve(doc)
+        assert text(c3).get_text() == text(c1).get_text()
+        c3.disconnect()
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# doorbell-woken farm read front end
+# ---------------------------------------------------------------------------
+
+
+def _farm_dir(tmp_path, n_ops=60, summary_ops=16):
+    """An offline farm state: deltas topic + summaries + broadcast."""
+    from fluidframework_tpu.server.columnar_log import make_topic
+    from tests.test_summarizer import drive_direct, generic_records
+
+    shared = str(tmp_path)
+    os.makedirs(os.path.join(shared, "topics"), exist_ok=True)
+    recs = generic_records("doc0", n_ops=n_ops)
+    drive_direct(shared, recs, summary_ops=summary_ops)
+    make_topic(os.path.join(shared, "topics", "broadcast.jsonl"),
+               "json").append_many(recs)
+    return shared, recs
+
+
+def test_farm_tail_pusher_subscribe_and_wait(tmp_path):
+    from fluidframework_tpu.server.queue import SharedFileTopic
+    from fluidframework_tpu.server.socket_service import FarmTailPusher
+
+    path = os.path.join(str(tmp_path), "topics", "broadcast.jsonl")
+    topic = SharedFileTopic(path)
+    pusher = FarmTailPusher(path, "json", poll_s=0.5).start()
+    try:
+        got = []
+        pusher.subscribe("d", got.extend)
+        # The long-poll rides the doorbell: a waiter parked BEFORE the
+        # append wakes when the ring lands, well inside the 0.5s poll
+        # fallback.
+        result = {}
+
+        def waiter():
+            t0 = time.perf_counter()
+            ok = pusher.wait_for("d", 3, timeout_s=5.0)
+            result["ok"] = ok
+            result["s"] = time.perf_counter() - t0
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.15)
+        topic.append_many([
+            {"kind": "op", "doc": "d", "seq": s, "msn": 0, "client": 1,
+             "clientSeq": s, "refSeq": 0, "type": "op", "contents": s}
+            for s in (1, 2, 3)
+        ])
+        th.join(timeout=5)
+        assert result["ok"]
+        deadline = time.time() + 5
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert [r["seq"] for r in got] == [1, 2, 3]
+        assert pusher.head_seq["d"] == 3
+    finally:
+        pusher.stop()
+
+
+def test_farm_tail_pusher_poll_fallback(tmp_path, monkeypatch):
+    """FLUID_DOORBELL=0 degrades to the bounded-timeout poll — same
+    records, just the old latency."""
+    monkeypatch.setenv("FLUID_DOORBELL", "0")
+    from fluidframework_tpu.server.queue import SharedFileTopic
+    from fluidframework_tpu.server.socket_service import FarmTailPusher
+
+    path = os.path.join(str(tmp_path), "topics", "broadcast.jsonl")
+    topic = SharedFileTopic(path)
+    pusher = FarmTailPusher(path, "json", poll_s=0.02).start()
+    try:
+        assert pusher._bell is None
+        got = []
+        pusher.subscribe("d", got.extend)
+        topic.append({"kind": "op", "doc": "d", "seq": 1, "msn": 0,
+                      "client": 1, "clientSeq": 1, "refSeq": 0,
+                      "type": "op", "contents": 0})
+        assert pusher.wait_for("d", 1, timeout_s=5.0)
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.01)
+        assert got and got[0]["seq"] == 1
+    finally:
+        pusher.stop()
+
+
+def _rpc(host, port, sock=None, **req):
+    from fluidframework_tpu.server.framing import read_frame, write_frame
+
+    s = sock or socket.create_connection((host, port))
+    f = s.makefile("rwb")
+    req.setdefault("id", 1)
+    write_frame(f, req)
+    while True:
+        resp = read_frame(f)
+        assert resp is not None
+        if "event" in resp:
+            continue  # push frame racing the response
+        break
+    if sock is None:
+        s.close()
+    if "error" in resp:
+        raise RuntimeError(resp["error"])
+    return resp["result"]
+
+
+def test_farm_read_server_catchup_and_push(tmp_path):
+    from fluidframework_tpu.server.columnar_log import make_topic
+    from fluidframework_tpu.server.framing import read_frame, write_frame
+    from fluidframework_tpu.server.socket_service import FarmReadServer
+    from fluidframework_tpu.server.summarizer import (
+        SummaryReplica,
+    )
+
+    shared, recs = _farm_dir(tmp_path)
+    srv = FarmReadServer(shared).start()
+    try:
+        # Catch-up RPC: nearest summary manifest + blob + tail.
+        res = _rpc(srv.host, srv.port, cmd="catchup", docId="doc0")
+        assert res["manifest"] is not None
+        boot = SummaryReplica(res["blob"])
+        boot.apply_records(res["ops"])
+        cold = SummaryReplica(None)
+        cold.apply_records(recs)
+        assert boot.state_digest() == cold.state_digest()
+
+        # Live subscription + a waitSeq catch-up riding the same
+        # doorbell wakeup.
+        s = socket.create_connection((srv.host, srv.port))
+        f = s.makefile("rwb")
+        write_frame(f, {"id": 1, "cmd": "subscribe", "docId": "doc0"})
+        sub = read_frame(f)
+        assert sub["result"]["headSeq"] >= recs[-1]["seq"]
+
+        next_seq = recs[-1]["seq"] + 1
+        waited = {}
+
+        def late_catchup():
+            waited["res"] = _rpc(
+                srv.host, srv.port, cmd="catchup", docId="doc0",
+                waitSeq=next_seq, timeout=10.0,
+            )
+
+        th = threading.Thread(target=late_catchup)
+        th.start()
+        time.sleep(0.1)
+        newrec = {"kind": "op", "doc": "doc0", "seq": next_seq,
+                  "msn": 0, "client": 1, "clientSeq": 999, "refSeq": 0,
+                  "type": "op", "contents": {"late": True}}
+        make_topic(os.path.join(shared, "topics", "broadcast.jsonl"),
+                   "json").append(newrec)
+        make_topic(os.path.join(shared, "topics", "deltas.jsonl"),
+                   "json").append(newrec)
+        # The subscribed socket receives the push frame.
+        pushed = read_frame(f)
+        assert pushed["event"] == "recs"
+        assert pushed["recs"][-1]["seq"] == next_seq
+        th.join(timeout=10)
+        assert any(int(r["seq"]) == next_seq
+                   for r in waited["res"]["ops"])
+        s.close()
+    finally:
+        srv.stop()

@@ -108,3 +108,112 @@ def test_local_server_over_historian():
         c2.runtime.get_datastore("default").get_channel("s").get_text()
         == "hi"
     )
+
+
+# ---------------------------------------------------------------------------
+# hardening: eviction at the budget boundary, ref races, metrics
+# ---------------------------------------------------------------------------
+
+
+def test_evict_under_budget_at_boundary_sizes():
+    """Blobs sized AT and AROUND blob_budget_bytes: the cache must
+    never exceed its budget, a budget-sized blob is admissible alone,
+    and an over-budget blob passes through uncached."""
+    budget = 100
+    backing = CountingStore()
+    h = HistorianCache(backing, blob_budget_bytes=budget)
+    k_exact = h.put(b"e" * budget)  # == budget: admissible, fills it
+    assert h.stats()["cached_bytes"] == budget
+    assert h.get(k_exact) == b"e" * budget and backing.reads == 0
+    k_one = h.put(b"a" * 1)  # admitting 1 byte must evict the filler
+    assert h.stats()["cached_bytes"] <= budget
+    assert h.get(k_one) == b"a" and backing.reads == 0
+    h.get(k_exact)  # evicted: backing read, readmission evicts k_one
+    assert backing.reads == 1
+    assert h.stats()["cached_bytes"] <= budget
+    k_over = h.put(b"z" * (budget + 1))  # > budget: never cached
+    h.get(k_over)
+    h.get(k_over)
+    assert backing.reads == 3
+    assert h.stats()["cached_bytes"] <= budget
+    # Near-boundary churn: every admission keeps the invariant.
+    for i in range(10):
+        h.put(bytes([i]) * (budget - 3))
+        assert h.stats()["cached_bytes"] <= budget
+
+
+def test_get_ref_set_ref_race():
+    """Concurrent set_ref/get_ref hammering one name: no exception,
+    no torn read (every observed value is one some writer wrote), and
+    the final read-through agrees with the backing store."""
+    import threading
+
+    backing = CountingStore()
+    h = HistorianCache(backing, blob_budget_bytes=1 << 20, ref_ttl=0.001)
+    keys = [h.put(f"blob-{i}".encode()) for i in range(8)]
+    stop = threading.Event()
+    seen = []
+    errors = []
+
+    def writer(i):
+        try:
+            j = 0
+            while not stop.is_set():
+                h.set_ref("doc", keys[(i + j) % len(keys)])
+                j += 1
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                v = h.get_ref("doc")
+                if v is not None:
+                    seen.append(v)
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(2)] + [threading.Thread(target=reader)
+                                     for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errors
+    assert seen and all(v in keys for v in seen)
+    # Write-through means the cache and backing converge once quiet.
+    h.ref_ttl = 0.0
+    assert h.get_ref("doc") == backing.inner.get_ref("doc")
+
+
+def test_historian_metrics_gauges():
+    """The Prometheus surface: historian_blob_bytes tracks the cached
+    payload, hits/misses count, evictions count — per-cache labels."""
+    from fluidframework_tpu.utils import metrics as M
+
+    reg = M.MetricsRegistry()
+    prev = M.set_registry(reg)
+    try:
+        h = HistorianCache(CountingStore(), blob_budget_bytes=100,
+                           name="t")
+    finally:
+        M.set_registry(prev)
+    k1 = h.put(b"a" * 60)
+    h.put(b"b" * 60)  # evicts k1
+    assert reg.gauge("historian_blob_bytes", cache="t").value == 60
+    assert reg.gauge("historian_blobs", cache="t").value == 1
+    assert reg.counter("historian_evictions_total", cache="t").value == 1
+    h.get(k1)  # miss (evicted)
+    hits0 = reg.counter("historian_hits_total", cache="t").value
+    h.get(k1)  # hit (readmitted)
+    assert reg.counter("historian_misses_total", cache="t").value >= 1
+    assert reg.counter("historian_hits_total", cache="t").value \
+        == hits0 + 1
+    text = reg.to_prometheus()
+    assert "historian_blob_bytes" in text
+    assert 'cache="t"' in text

@@ -48,6 +48,7 @@ PACKAGES = [
     "fluidframework_tpu.server.queue",
     "fluidframework_tpu.server.riddler",
     "fluidframework_tpu.server.shard_fabric",
+    "fluidframework_tpu.server.summarizer",
     "fluidframework_tpu.server.supervisor",
     "fluidframework_tpu.framework",
     "fluidframework_tpu.parallel",

@@ -62,7 +62,16 @@ the wire traces, exact + bucket-interpolated p50/p95/p99, doorbells
 vs the polling baseline, slowest ops attached from the flight
 recorder. Env knobs: BD_RATE_HZ (150), BD_DURATION_S (4).
 
-Usage: python tools/bench_deli.py [--shard | --devices [LIST] | --latency]
+`--catchup` switches to the SUMMARY CATCH-UP mode
+(`testing.deli_bench.run_catchup_bench`, bench_configs
+`config10_catchup`'s engine): cold-join latency vs log length with and
+without summaries — full-log merge-tree replay vs nearest summary +
+op tail (`server.summarizer`), bit-identity gated at every length —
+plus broadcast fan-out to hundreds of subscribed readers through the
+doorbell-woken read front end.
+
+Usage: python tools/bench_deli.py
+    [--shard | --devices [LIST] | --latency | --catchup]
 """
 
 from __future__ import annotations
@@ -80,6 +89,16 @@ os.environ.setdefault(
 
 if "--shard" in sys.argv:
     os.environ["BD_SHARD"] = "1"
+
+if "--catchup" in sys.argv:
+    # Summary catch-up mode: cold-join latency vs log length with and
+    # without summaries (full-log merge-tree replay vs nearest summary
+    # + op tail, bit-identity gated at every length) plus broadcast
+    # fan-out to BD_SUBSCRIBERS readers through the doorbell-woken
+    # read front end (bench_configs config10_catchup's engine). Env
+    # knobs: BD_LOG_LENGTHS ("10000,30000,100000"), BD_SUMMARY_OPS
+    # (2000), BD_SUBSCRIBERS (200), BD_LOG_FORMAT (json).
+    os.environ["BD_CATCHUP"] = "1"
 
 if "--latency" in sys.argv:
     # Open-loop latency SLO mode: p50/p99 submit→broadcast through

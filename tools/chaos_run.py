@@ -6,6 +6,16 @@ Run: python tools/chaos_run.py --seed N
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH] [--trace-wire]
         [--partitions N] [--workers W] [--devices N] [--elastic]
+        [--summarizer] [--summary-ops N]
+
+`--summarizer` runs the summary service (`server.summarizer`) as a
+fifth supervised role, includes it in the kill schedule, and extends
+the convergence verdict with SUMMARY INTEGRITY: the deterministic
+manifest count reached with no (doc, seq) fork or duplicate —
+restarts re-emit byte-identical content-addressed summaries — and the
+newest summary + op tail booting bit-identical to a cold full-log
+replay. Classic single-partition farm only (`--summary-ops` sets the
+cadence).
 
 `--trace-wire` stamps per-stage wall-clock timestamps onto the farm's
 wire records (side "tr" key — digests compare canonical records, so
@@ -116,6 +126,10 @@ def main() -> int:
     trace_wire = "--trace-wire" in args
     if trace_wire:
         args.remove("--trace-wire")
+    summarizer = "--summarizer" in args
+    if summarizer:
+        args.remove("--summarizer")
+    summary_ops = int(_take("--summary-ops", "32"))
     if faults_arg is None:
         # Default fault set: the classic classes the chosen runner
         # supports. The sharded runner has no socket consumer, so
@@ -144,6 +158,8 @@ def main() -> int:
         ),
         elastic=elastic,
         trace_wire=trace_wire,
+        summarizer=summarizer,
+        summary_ops=summary_ops,
     )
     unknown = set(faults) - set(ALL_FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
@@ -177,6 +193,10 @@ def main() -> int:
     print(f"scribe fold   : {'match' if res.scribe_ok else 'MISMATCH'}")
     print(f"dup seqs={res.duplicate_seqs} skipped seqs={res.skipped_seqs} "
           f"fence rejections={res.fence_rejections}")
+    if summarizer:
+        print(f"summaries     : {res.summary_manifests} manifests, "
+              f"integrity {'OK' if res.summaries_ok else 'VIOLATED'} "
+              f"(no fork/dup; summary+tail == cold replay)")
     if res.epochs:
         print(f"topology epochs: {res.epochs}")
     if "disk" in faults:
