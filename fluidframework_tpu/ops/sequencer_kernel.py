@@ -315,6 +315,17 @@ def pack_submissions(slot, kind, client, client_seq, ref_seq, groups,
         yield sel, sl, ic, kind2, client2, cseq2, ref2, grp2
 
 
+# The span decomposition every columnar ingest/emit path shares: a
+# homogeneous run vectorizes (one `add_columns` call, one verdict
+# slice, one blob-heap memcpy), category boundaries fall back to
+# per-record handling without losing stream order. Defined next to the
+# codec (it is pure numpy over codec columns, and jax-free consumers —
+# the fused durable+broadcast hop — use it too); re-exported here
+# beside `pack_submissions` because kernel callers treat it as part of
+# the packing toolkit.
+from ..protocol.record_batch import mask_runs  # noqa: E402,F401
+
+
 def no_aborts(n_docs: int):
     """A fresh boxcar-abort tracker ([D], no group aborted)."""
     return jnp.full((n_docs,), -2, jnp.int32)

@@ -10,7 +10,8 @@ side — so `server.deli_kernel` ingests a batch as numpy arrays with
 zero per-record JSON decode, while legacy consumers decode records
 lazily one batch at a time and see plain Python values.
 
-Frame layout (version 1, little-endian):
+Frame layout (versions 1 and 2, little-endian — the version byte is
+per FRAME, so one file mixes both freely):
 
     magic "FRB1" | u8 version | u8 flags | u32 n_records
     | u32 payload_len | u32 crc32(payload) | i64 fence
@@ -33,7 +34,14 @@ lossless over arbitrary JSON values):
     K_RAW_JOIN   {"kind":"join","doc","client"}
     K_RAW_LEAVE  {"kind":"leave","doc","client"}
     K_RAW_BOXCAR {"kind":"boxcar","doc","client","ops":[...]}
-                  blob = [[clientSeq, refSeq, contents], ...]
+                  v1 blob = JSON [[clientSeq, refSeq, contents], ...]
+                  v2 blob = NESTED binary (the codec-v2 rev):
+                    u32 n_ops | i64 clientSeq[n] | i64 refSeq[n]
+                    | u32 off[n+1] | per-op contents JSON heap
+                  so a boxcar's per-op ints read as arrays and its
+                  per-op contents slice out as raw blobs — no
+                  once-per-boxcar JSON decode on ingest, no re-encode
+                  when the sequenced ops are emitted (`boxcar()`).
     K_SEQ_OP     {"kind":"op","doc","seq","msn","client","clientSeq",
                   "refSeq","type","contents","inOff"} blob = contents
     K_NACK       {"kind":"nack","doc","client","clientSeq","code",
@@ -41,10 +49,19 @@ lossless over arbitrary JSON values):
                   blob = reason
     K_GENERIC    anything else        blob = full record
 
+The EMIT half mirrors the ingest half: `ColumnarRecords` is a batch of
+already-columnized records (flat int columns + a blob heap — what the
+kernel deli's verdict gather produces), and `encode_columns` turns one
+or more of them into a frame with zero per-record classification or
+dict building. `encode_batch` accepts ColumnarRecords segments mixed
+with plain records in one list, so a columnar producer's stray
+dict-path records keep their stream position.
+
 The codec is pure (no I/O, no fencing): `server.columnar_log` owns the
 topic semantics (torn-tail safety, fence gating, offsets). Codec
-throughput metrics (`codec_encode_*` / `codec_decode_*`) report through
-`utils.metrics`; `tools/metrics_report.py` renders them.
+throughput metrics (`codec_encode_*` / `codec_decode_*` /
+`codec_encode_columns_total`) report through `utils.metrics`;
+`tools/metrics_report.py` renders them.
 """
 
 from __future__ import annotations
@@ -60,6 +77,8 @@ import numpy as np
 from .messages import MessageType
 
 __all__ = [
+    "ColumnarRecords",
+    "DEFAULT_VERSION",
     "HEADER",
     "JsonBlob",
     "K_GENERIC",
@@ -74,13 +93,25 @@ __all__ = [
     "MAX_RESYNC_CANDIDATES",
     "RecordBatch",
     "SCHEMA_VERSION",
+    "SCHEMA_VERSIONS",
+    "count_records",
     "decode_batch",
     "encode_batch",
+    "encode_columns",
     "iter_units",
+    "mask_runs",
 ]
 
 MAGIC = b"FRB1"
 SCHEMA_VERSION = 1
+SCHEMA_VERSION_2 = 2
+SCHEMA_VERSIONS = (1, 2)
+# What new frames are written as. v2 only changes the K_RAW_BOXCAR blob
+# layout (nested binary offsets instead of a JSON list), and the
+# version byte is per frame, so v1 and v2 frames coexist in one file —
+# upgrades need no migration, downgrades only a drained topic (like the
+# json⇄columnar rule, one rung smaller).
+DEFAULT_VERSION = 2
 HEADER = struct.Struct("<4sBBIIIq")  # magic, ver, flags, n, plen, crc, fence
 MAX_BATCH_BYTES = 256 << 20  # sanity cap: junk that fakes the magic must
 #                              not trigger a multi-GB allocation
@@ -149,11 +180,23 @@ class JsonBlob:
         return repr(self.value)
 
 
+def _json_default(o: Any) -> Any:
+    if isinstance(o, JsonBlob):
+        return o.value  # a blob NESTED in a generic record: by value
+    raise TypeError(
+        f"Object of type {o.__class__.__name__} is not JSON serializable"
+    )
+
+
 def _dumps(v: Any) -> bytes:
-    """JSON-encode one blob value; a JsonBlob passes through raw."""
+    """JSON-encode one blob value; a top-level JsonBlob passes through
+    raw (zero re-encode), a nested one — a pass-through `contents`
+    inside a record that fell to K_GENERIC (extra keys, e.g. a wire
+    "tr" trace) — serializes by value."""
     if isinstance(v, JsonBlob):
         return v.raw
-    return json.dumps(v, separators=(",", ":")).encode()
+    return json.dumps(v, separators=(",", ":"),
+                      default=_json_default).encode()
 
 
 def _is_i64(v: Any) -> bool:
@@ -220,12 +263,365 @@ def _classify(rec: Any) -> int:
     return K_GENERIC
 
 
-def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
-                 owner: Optional[str] = None) -> bytes:
-    """One binary frame for `records` (arbitrary JSON values), stamped
-    with the accepted (fence, owner)."""
+# Per-kind revalidators for the homogeneous-run fast path: once a
+# record's exact key set (and kind string) matched the previous
+# record's, only the VALUE checks the _classify ladder would have run
+# remain — the branch ladder itself is hoisted out of the run. Each
+# entry mirrors its _classify branch exactly (the regression test
+# compares frames against per-record classification).
+def _rv_raw_op(r):
+    return isinstance(r["doc"], str) and _is_i64(r["client"]) \
+        and _is_i64(r["clientSeq"]) and _is_i64(r["refSeq"])
+
+
+def _rv_member(r):
+    return isinstance(r["doc"], str) and _is_i64(r["client"])
+
+
+def _rv_boxcar(r):
+    if not (isinstance(r["doc"], str) and _is_i64(r["client"])
+            and isinstance(r["ops"], list)):
+        return False
+    return all(
+        isinstance(op, dict) and op.keys() == _BOXCAR_OP_KEYS
+        and _is_i64(op["clientSeq"]) and _is_i64(op["refSeq"])
+        for op in r["ops"]
+    )
+
+
+def _rv_seq_op(r):
+    return isinstance(r["doc"], str) and _is_i64(r["client"]) \
+        and _is_i64(r["clientSeq"]) and _is_i64(r["refSeq"]) \
+        and _is_i64(r["seq"]) and _is_i64(r["msn"]) \
+        and _is_i64(r["inOff"]) and r["type"] in _TYPE_CODE
+
+
+def _rv_nack(r):
+    return isinstance(r["doc"], str) and _is_i64(r["client"]) \
+        and _is_i64(r["clientSeq"]) and _is_i64(r["code"]) \
+        and _is_i64(r["inOff"]) and isinstance(r["reason"], str)
+
+
+_REVALIDATE = {
+    K_RAW_OP: _rv_raw_op,
+    K_RAW_JOIN: _rv_member,
+    K_RAW_LEAVE: _rv_member,
+    K_RAW_BOXCAR: _rv_boxcar,
+    K_SEQ_OP: _rv_seq_op,
+    K_NACK: _rv_nack,
+}
+
+_BOX_HDR = struct.Struct("<I")
+
+
+def _encode_boxcar_v2(ops: List[dict]) -> bytes:
+    """The nested v2 K_RAW_BOXCAR blob: per-op ints as columns, per-op
+    contents as raw slices of an inner heap — a boxcar rides through
+    sequencing with its op blobs untouched."""
+    n = len(ops)
+    blobs = [_dumps(op["contents"]) for op in ops]
+    cs = np.fromiter((op["clientSeq"] for op in ops), np.int64, n)
+    rf = np.fromiter((op["refSeq"] for op in ops), np.int64, n)
+    offs = np.zeros(n + 1, np.uint32)
+    if n:
+        offs[1:] = np.cumsum([len(b) for b in blobs])
+    return b"".join([_BOX_HDR.pack(n), cs.tobytes(), rf.tobytes(),
+                     offs.tobytes(), *blobs])
+
+
+def _decode_boxcar_v2(blob) -> Tuple[np.ndarray, np.ndarray,
+                                     np.ndarray, memoryview]:
+    """(clientSeq[n], refSeq[n], off[n+1], contents heap) views over a
+    nested v2 boxcar blob."""
+    view = memoryview(blob)
+    (n,) = _BOX_HDR.unpack_from(view, 0)
+    pos = _BOX_HDR.size
+    cs = np.frombuffer(view, "<i8", n, pos)
+    pos += 8 * n
+    rf = np.frombuffer(view, "<i8", n, pos)
+    pos += 8 * n
+    offs = np.frombuffer(view, "<u4", n + 1, pos)
+    pos += 4 * (n + 1)
+    return cs, rf, offs, view[pos:]
+
+
+class ColumnarRecords:
+    """A batch of PRE-COLUMNIZED records: the emit twin of the decoded
+    `RecordBatch` (same columns, same blob-heap layout, a batch-local
+    doc dictionary), built by producers that already hold verdict
+    columns — the kernel deli's emission, the fused durable+broadcast
+    hop's frame pass-through. `encode_columns`/`encode_batch` splice it
+    into a frame with zero per-record work; `record(i)`/iteration
+    decode lazily for dict-path consumers (recovery replay, tests).
+
+    K_RAW_BOXCAR rows are rejected: their blob layout is
+    frame-VERSION-dependent, so a pass-through segment carrying one
+    could silently splice a v1 blob into a v2 frame. (Nothing emits
+    boxcars post-sequencing — the deli unpacks them — so the
+    restriction costs no real producer anything.)"""
+
+    __slots__ = ("n", "docs", "kind", "type_code", "doc_idx", "client",
+                 "client_seq", "ref_seq", "seq", "msn", "in_off",
+                 "blob_off", "heap")
+
+    def __init__(self, docs: Sequence[str], kind, type_code, doc_idx,
+                 client, client_seq, ref_seq, seq, msn, in_off,
+                 blob_off, heap: bytes):
+        self.kind = np.ascontiguousarray(kind, np.uint8)
+        self.n = int(self.kind.shape[0])
+        if np.any(self.kind == K_RAW_BOXCAR):
+            raise ValueError(
+                "K_RAW_BOXCAR cannot ride a pre-columnized segment "
+                "(version-dependent blob layout)"
+            )
+        self.docs = list(docs)
+        self.type_code = np.ascontiguousarray(type_code, np.uint8)
+        self.doc_idx = np.ascontiguousarray(doc_idx, np.int32)
+        self.client = np.ascontiguousarray(client, np.int64)
+        self.client_seq = np.ascontiguousarray(client_seq, np.int64)
+        self.ref_seq = np.ascontiguousarray(ref_seq, np.int64)
+        self.seq = np.ascontiguousarray(seq, np.int64)
+        self.msn = np.ascontiguousarray(msn, np.int64)
+        self.in_off = np.ascontiguousarray(in_off, np.int64)
+        self.blob_off = np.ascontiguousarray(blob_off, np.uint32)
+        self.heap = bytes(heap)
+
+    @classmethod
+    def from_batch(cls, rb: "RecordBatch", rows,
+                   in_off) -> "ColumnarRecords":
+        """Slice `rows` of a decoded `RecordBatch` into an emit segment
+        with fresh input offsets (`in_off`: scalar base or per-row
+        array) — the zero-decode pass-through a 1:1 consumer (the fused
+        durable+broadcast hop) re-emits frames with. Blob bytes copy
+        span-wise: consecutive rows share one memcpy."""
+        rows = np.ascontiguousarray(rows, np.int64)
+        n = rows.shape[0]
+        off = rb._blob_off
+        lens = off[rows + 1].astype(np.int64) - off[rows]
+        new_off = np.zeros(n + 1, np.uint32)
+        if n:
+            new_off[1:] = np.cumsum(lens)
+        heap = _gather_spans(rb._heap, off, rows)
+        io = np.broadcast_to(np.asarray(in_off, np.int64), (n,)) \
+            if np.ndim(in_off) == 0 else np.asarray(in_off, np.int64)
+        return cls(
+            rb.docs, rb.kind[rows], rb.type_code[rows],
+            rb.doc_idx[rows], rb.client[rows], rb.client_seq[rows],
+            rb.ref_seq[rows], rb.seq[rows], rb.msn[rows], io,
+            new_off, heap,
+        )
+
+    def __len__(self) -> int:
+        return self.n
+
+    def blob(self, i: int) -> bytes:
+        return bytes(self.heap[self.blob_off[i]:self.blob_off[i + 1]])
+
+    def record(self, i: int) -> Any:
+        """Record `i` as a plain Python value (the dict-path view)."""
+        return _decode_record(self, i, DEFAULT_VERSION)
+
+    def __iter__(self):
+        return (self.record(i) for i in range(self.n))
+
+    def records(self) -> List[Any]:
+        return [self.record(i) for i in range(self.n)]
+
+
+def _gather_spans(heap, off, rows) -> bytes:
+    """Concatenate `rows`' blob slices out of `heap`, one copy per
+    CONSECUTIVE-row span (the all-kept fast path is a single memcpy)."""
+    n = rows.shape[0]
+    if n == 0:
+        return b""
+    breaks = np.flatnonzero(np.diff(rows) != 1)
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks + 1, [n]))
+    parts = []
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        parts.append(bytes(
+            heap[off[rows[s]]:off[rows[e - 1] + 1]]
+        ))
+    return b"".join(parts)
+
+
+def count_records(messages: Sequence[Any]) -> int:
+    """Record count of a message list that may mix plain records and
+    `ColumnarRecords` segments (what topic offsets advance by)."""
+    n = 0
+    for m in messages:
+        n += m.n if isinstance(m, ColumnarRecords) else 1
+    return n
+
+
+def mask_runs(values) -> List[Tuple[Any, int, int]]:
+    """Maximal constant runs of a 1-D array as ``[(value, lo, hi)]``
+    (host-side numpy) — the span decomposition every columnar
+    ingest/emit path shares: a homogeneous run vectorizes (one bulk
+    ingest call, one verdict slice, one blob-heap memcpy), while
+    category boundaries fall back to per-record handling without
+    losing stream order. ONE definition so the run rule can never fork
+    between the deli's columnar ingest, its verdict emission, and the
+    fused durable+broadcast hop's frame pass-through."""
+    v = np.asarray(values)
+    n = v.shape[0]
+    if n == 0:
+        return []
+    bounds = np.flatnonzero(np.diff(v.astype(np.int64))) + 1
+    edges = [0, *bounds.tolist(), n]
+    return [(v[lo].item(), lo, hi) for lo, hi in zip(edges, edges[1:])]
+
+
+class _Part:
+    """One assembled frame part: plain-loop columns or a spliced
+    segment, in stream order."""
+
+    __slots__ = ("docs", "kind", "type_code", "doc_idx", "i64",
+                 "blob_off", "heap", "n", "from_columns")
+
+    def __init__(self, docs, kind, type_code, doc_idx, i64, blob_off,
+                 heap, n, from_columns):
+        self.docs = docs
+        self.kind = kind
+        self.type_code = type_code
+        self.doc_idx = doc_idx
+        self.i64 = i64  # (6, n) int64: client/cseq/ref/seq/msn/inOff
+        self.blob_off = blob_off  # (n+1,) uint32, part-local
+        self.heap = heap
+        self.n = n
+        self.from_columns = from_columns
+
+
+def _part_from_segment(seg: ColumnarRecords) -> _Part:
+    i64 = np.empty((6, seg.n), np.int64)
+    i64[0] = seg.client
+    i64[1] = seg.client_seq
+    i64[2] = seg.ref_seq
+    i64[3] = seg.seq
+    i64[4] = seg.msn
+    i64[5] = seg.in_off
+    return _Part(seg.docs, seg.kind, seg.type_code, seg.doc_idx, i64,
+                 seg.blob_off, seg.heap, seg.n, from_columns=True)
+
+
+def _assemble_frame(parts: List[_Part], fence: Optional[int],
+                    owner: Optional[str], version: int) -> bytes:
+    """Splice frame parts (doc dictionaries remapped VECTORIZED, blob
+    heaps shifted as arrays) and wrap the header+CRC."""
+    doc_ids: List[str] = []
+    doc_of: Dict[str, int] = {}
+    kind_a: List[np.ndarray] = []
+    tc_a: List[np.ndarray] = []
+    didx_a: List[np.ndarray] = []
+    i64_a: List[np.ndarray] = []
+    off_a: List[np.ndarray] = []
+    heaps: List[bytes] = []
+    n = 0
+    heap_base = 0
+    for p in parts:
+        remap = np.empty(max(1, len(p.docs)), np.int32)
+        for j, d in enumerate(p.docs):
+            di = doc_of.get(d)
+            if di is None:
+                di = doc_of[d] = len(doc_ids)
+                doc_ids.append(d)
+            remap[j] = di
+        kind_a.append(p.kind)
+        tc_a.append(p.type_code)
+        didx_a.append(remap[p.doc_idx] if len(p.docs) else p.doc_idx)
+        i64_a.append(p.i64)
+        off_a.append(p.blob_off[:-1].astype(np.uint32) + heap_base)
+        heaps.append(p.heap)
+        heap_base += int(p.blob_off[-1])
+        n += p.n
+    heap = b"".join(heaps)
+    if len(parts) == 1:
+        p = parts[0]
+        kind_b = p.kind.tobytes()
+        tc_b = tc_a[0].tobytes()
+        didx_b = didx_a[0].tobytes()
+        i64_b = p.i64.tobytes()
+        offs = np.empty(n + 1, np.uint32)
+        offs[:n] = off_a[0]
+        offs[n] = heap_base
+        offs_b = offs.tobytes()
+    else:
+        kind_b = np.concatenate(kind_a).tobytes() if parts else b""
+        tc_b = np.concatenate(tc_a).tobytes() if parts else b""
+        didx_b = np.concatenate(didx_a).tobytes() if parts else b""
+        i64_b = (np.concatenate(i64_a, axis=1).tobytes()
+                 if parts else b"")
+        offs = np.empty(n + 1, np.uint32)
+        if parts:
+            offs[:n] = np.concatenate(off_a)
+        offs[n] = heap_base
+        offs_b = offs.tobytes()
+    owner_b = (owner or "").encode()
+    doc_parts = [struct.pack("<I", len(doc_ids))]
+    for d in doc_ids:
+        db = d.encode()
+        doc_parts.append(struct.pack("<H", len(db)) + db)
+    payload = b"".join([
+        struct.pack("<H", len(owner_b)), owner_b,
+        *doc_parts, kind_b, tc_b, didx_b, i64_b, offs_b, heap,
+    ])
+    if len(payload) > MAX_BATCH_BYTES:
+        raise ValueError(f"record batch too large: {len(payload)} bytes")
+    # The CRC covers the HEADER FIELDS (with the crc slot zeroed) as
+    # well as the payload: a flipped record count or length would
+    # otherwise mis-frame a payload whose own CRC still matches.
+    fence_i = int(fence or 0)
+    hdr0 = HEADER.pack(MAGIC, version, 0, n, len(payload), 0, fence_i)
+    crc = zlib.crc32(payload, zlib.crc32(hdr0))
+    return HEADER.pack(
+        MAGIC, version, 0, n, len(payload), crc, fence_i,
+    ) + payload
+
+
+def encode_columns(segments, fence: Optional[int] = None,
+                   owner: Optional[str] = None,
+                   version: Optional[int] = None) -> bytes:
+    """One binary frame from pre-columnized records — the emit hot
+    path: no per-record classification, no dict building, blob heaps
+    spliced as whole byte runs. `segments` is one `ColumnarRecords` or
+    a sequence of them (spliced in order)."""
     t0 = time.perf_counter()
-    n = len(records)
+    ver = DEFAULT_VERSION if version is None else int(version)
+    if ver not in SCHEMA_VERSIONS:
+        raise ValueError(f"unknown record-batch version {ver}")
+    if isinstance(segments, ColumnarRecords):
+        segments = (segments,)
+    parts = [_part_from_segment(s) for s in segments]
+    frame = _assemble_frame(parts, fence, owner, ver)
+    n = sum(p.n for p in parts)
+    _metrics("encode", n, len(frame), time.perf_counter() - t0)
+    if n:
+        from ..utils.metrics import get_registry
+
+        get_registry().counter(
+            "codec_encode_columns_total", codec="columnar"
+        ).inc(n)
+    return frame
+
+
+def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
+                 owner: Optional[str] = None,
+                 version: Optional[int] = None) -> bytes:
+    """One binary frame for `records` (arbitrary JSON values, plus
+    `ColumnarRecords` segments spliced in stream order), stamped with
+    the accepted (fence, owner). `version` picks the frame rev (the
+    module default otherwise); only the K_RAW_BOXCAR blob layout
+    differs between revs."""
+    if records and all(isinstance(r, ColumnarRecords) for r in records):
+        # Segment-only batch (the columnar emit steady state: a fused
+        # pass-through pump, a nack-free kernel pump): the pure-column
+        # encoder, no per-record machinery at all.
+        return encode_columns(records, fence=fence, owner=owner,
+                              version=version)
+    t0 = time.perf_counter()
+    ver = DEFAULT_VERSION if version is None else int(version)
+    if ver not in SCHEMA_VERSIONS:
+        raise ValueError(f"unknown record-batch version {ver}")
     doc_ids: List[str] = []
     doc_of: Dict[str, int] = {}
     # Hot path: plain list appends per record, ONE numpy conversion per
@@ -241,6 +637,8 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
     inoffs: List[int] = []
     blobs: List[bytes] = []
     blob_lens: List[int] = []
+    parts: List[_Part] = []
+    col_records = 0
 
     # One fused pass: the key-set comparison routes each record AND the
     # same lookups fill the columns (classification re-reads nothing).
@@ -249,6 +647,28 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
     qa, ra, sa, ma = (cseqs.append, refs.append, seqs.append,
                       msns.append)
     ia, ba, la = inoffs.append, blobs.append, blob_lens.append
+
+    def flush_plain() -> None:
+        # Close the current plain run into an ordered frame part
+        # (segments must splice at their stream position).
+        m = len(kinds)
+        if not m:
+            return
+        i64 = np.array([clients, cseqs, refs, seqs, msns, inoffs],
+                       np.int64)
+        offs = np.zeros(m + 1, np.uint32)
+        offs[1:] = np.cumsum(blob_lens)
+        parts.append(_Part(
+            doc_ids, np.array(kinds, np.uint8),
+            np.array(type_codes, np.uint8),
+            np.array(doc_idx, np.int32), i64, offs, b"".join(blobs),
+            m, from_columns=False,
+        ))
+        for lst in (kinds, type_codes, doc_idx, clients, cseqs, refs,
+                    seqs, msns, inoffs, blobs, blob_lens):
+            lst.clear()
+        # doc_ids/doc_of persist across plain runs: the dict remap in
+        # _assemble_frame dedups identical ids anyway.
 
     def generic(rec):
         ka(K_GENERIC)
@@ -264,8 +684,35 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
         ba(blob)
         la(len(blob))
 
+    # Homogeneous-run classification hoist: consecutive records with
+    # the SAME exact key set and kind string skip the _classify branch
+    # ladder — only that kind's value checks rerun per record. Streams
+    # are overwhelmingly single-schema runs (a deltas pump is K_SEQ_OP
+    # wall-to-wall), so the ladder cost amortizes to once per run.
+    prev_keys = None
+    prev_kind_s = None
+    prev_k = K_GENERIC
+    prev_rv = None
+
     for rec in records:
-        k = _classify(rec)
+        if isinstance(rec, ColumnarRecords):
+            flush_plain()
+            parts.append(_part_from_segment(rec))
+            col_records += rec.n
+            prev_keys = None
+            continue
+        if (type(rec) is dict and rec.keys() == prev_keys
+                and rec.get("kind") == prev_kind_s):
+            k = prev_k if prev_rv(rec) else K_GENERIC
+        else:
+            k = _classify(rec)
+            if k != K_GENERIC and type(rec) is dict:
+                prev_keys = rec.keys()
+                prev_kind_s = rec.get("kind")
+                prev_k = k
+                prev_rv = _REVALIDATE[k]
+            else:
+                prev_keys = None
         if k == K_GENERIC:
             generic(rec)
             continue
@@ -308,45 +755,28 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
             ma(0)
             ia(-1)
             ta(_NO_TYPE)
-            blob = b"" if k != K_RAW_BOXCAR else _dumps([
-                [op["clientSeq"], op["refSeq"], op["contents"]]
-                for op in rec["ops"]
-            ])
+            if k != K_RAW_BOXCAR:
+                blob = b""
+            elif ver >= SCHEMA_VERSION_2:
+                blob = _encode_boxcar_v2(rec["ops"])
+            else:
+                blob = _dumps([
+                    [op["clientSeq"], op["refSeq"], op["contents"]]
+                    for op in rec["ops"]
+                ])
         ba(blob)
         la(len(blob))
 
-    heap = b"".join(blobs)
-    offs = np.zeros(n + 1, np.uint32)
-    if n:
-        offs[1:] = np.cumsum(blob_lens)
-    i64 = np.array([clients, cseqs, refs, seqs, msns, inoffs],
-                   np.int64) if n else np.zeros((6, 0), np.int64)
-    owner_b = (owner or "").encode()
-    doc_parts = [struct.pack("<I", len(doc_ids))]
-    for d in doc_ids:
-        db = d.encode()
-        doc_parts.append(struct.pack("<H", len(db)) + db)
-    payload = b"".join([
-        struct.pack("<H", len(owner_b)), owner_b,
-        *doc_parts,
-        np.array(kinds, np.uint8).tobytes(),
-        np.array(type_codes, np.uint8).tobytes(),
-        np.array(doc_idx, np.int32).tobytes(),
-        i64.tobytes(), offs.tobytes(), heap,
-    ])
-    if len(payload) > MAX_BATCH_BYTES:
-        raise ValueError(f"record batch too large: {len(payload)} bytes")
-    # The CRC covers the HEADER FIELDS (with the crc slot zeroed) as
-    # well as the payload: a flipped record count or length would
-    # otherwise mis-frame a payload whose own CRC still matches.
-    fence_i = int(fence or 0)
-    hdr0 = HEADER.pack(MAGIC, SCHEMA_VERSION, 0, n, len(payload), 0,
-                       fence_i)
-    crc = zlib.crc32(payload, zlib.crc32(hdr0))
-    frame = HEADER.pack(
-        MAGIC, SCHEMA_VERSION, 0, n, len(payload), crc, fence_i,
-    ) + payload
+    flush_plain()
+    frame = _assemble_frame(parts, fence, owner, ver)
+    n = sum(p.n for p in parts)
     _metrics("encode", n, len(frame), time.perf_counter() - t0)
+    if col_records:
+        from ..utils.metrics import get_registry
+
+        get_registry().counter(
+            "codec_encode_columns_total", codec="columnar"
+        ).inc(col_records)
     return frame
 
 
@@ -361,16 +791,20 @@ class RecordBatch:
     `kind`/`type_code`/`doc_idx`/`client`/`client_seq`/`ref_seq`/
     `seq`/`msn`/`in_off` are numpy views over the payload — the
     zero-JSON ingest surface for the kernel deli. `records()` is the
-    legacy path: full per-record decode into plain Python values."""
+    legacy path: full per-record decode into plain Python values.
+    `version` is the frame's schema rev (it decides the K_RAW_BOXCAR
+    blob layout `boxcar()` parses)."""
 
     __slots__ = ("n", "fence", "owner", "docs", "kind", "type_code",
                  "doc_idx", "client", "client_seq", "ref_seq", "seq",
                  "msn", "in_off", "_blob_off", "_heap", "_records",
-                 "_frame_bytes")
+                 "_frame_bytes", "version")
 
-    def __init__(self, n: int, fence: int, payload: memoryview):
+    def __init__(self, n: int, fence: int, payload: memoryview,
+                 version: int = SCHEMA_VERSION):
         self.n = n
         self.fence = fence
+        self.version = version
         self._frame_bytes = HEADER.size + len(payload)
         pos = 0
         (olen,) = struct.unpack_from("<H", payload, pos)
@@ -406,42 +840,26 @@ class RecordBatch:
         reason / whole generic record, per kind)."""
         return bytes(self._heap[self._blob_off[i]:self._blob_off[i + 1]])
 
+    def boxcar(self, i: int) -> List[Tuple[int, int, Any]]:
+        """Record `i`'s boxcar ops as ``[(clientSeq, refSeq,
+        contents), ...]``. On a v2 frame `contents` is a lazy
+        `JsonBlob` sliced straight off the nested heap — the
+        pass-through handle a columnar emitter hands back untouched;
+        on v1 it is the decoded plain value (one JSON parse per
+        boxcar, the pre-rev cost)."""
+        if self.version >= SCHEMA_VERSION_2:
+            view = self._heap[self._blob_off[i]:self._blob_off[i + 1]]
+            cs, rf, offs, heap = _decode_boxcar_v2(view)
+            return [
+                (int(cs[k]), int(rf[k]),
+                 JsonBlob(bytes(heap[offs[k]:offs[k + 1]])))
+                for k in range(cs.shape[0])
+            ]
+        return [(cs, rf, c) for cs, rf, c in json.loads(self.blob(i))]
+
     def record(self, i: int) -> Any:
         """Record `i` as a plain Python value (lazy, uncached)."""
-        k = int(self.kind[i])
-        if k == K_GENERIC:
-            return json.loads(self.blob(i))
-        doc = self.docs[int(self.doc_idx[i])]
-        client = int(self.client[i])
-        if k == K_RAW_OP:
-            return {"kind": "op", "doc": doc, "client": client,
-                    "clientSeq": int(self.client_seq[i]),
-                    "refSeq": int(self.ref_seq[i]),
-                    "contents": json.loads(self.blob(i))}
-        if k == K_RAW_JOIN:
-            return {"kind": "join", "doc": doc, "client": client}
-        if k == K_RAW_LEAVE:
-            return {"kind": "leave", "doc": doc, "client": client}
-        if k == K_RAW_BOXCAR:
-            return {"kind": "boxcar", "doc": doc, "client": client,
-                    "ops": [
-                        {"clientSeq": cs, "refSeq": rf, "contents": c}
-                        for cs, rf, c in json.loads(self.blob(i))
-                    ]}
-        if k == K_SEQ_OP:
-            return {"kind": "op", "doc": doc,
-                    "seq": int(self.seq[i]), "msn": int(self.msn[i]),
-                    "client": client,
-                    "clientSeq": int(self.client_seq[i]),
-                    "refSeq": int(self.ref_seq[i]),
-                    "type": _TYPES[int(self.type_code[i])],
-                    "contents": json.loads(self.blob(i)),
-                    "inOff": int(self.in_off[i])}
-        return {"kind": "nack", "doc": doc, "client": client,
-                "clientSeq": int(self.client_seq[i]),
-                "code": int(self.seq[i]),
-                "reason": json.loads(self.blob(i)),
-                "inOff": int(self.in_off[i])}
+        return _decode_record(self, i, self.version)
 
     def records(self) -> List[Any]:
         """All records, decoded once and cached (the legacy-consumer
@@ -452,6 +870,47 @@ class RecordBatch:
             _metrics("decode", self.n, self._frame_bytes,
                      time.perf_counter() - t0)
         return self._records
+
+
+def _decode_record(obj, i: int, version: int) -> Any:
+    """One record as a plain Python value, off any column holder
+    (`RecordBatch` or `ColumnarRecords` — same column protocol)."""
+    k = int(obj.kind[i])
+    if k == K_GENERIC:
+        return json.loads(obj.blob(i))
+    doc = obj.docs[int(obj.doc_idx[i])]
+    client = int(obj.client[i])
+    if k == K_RAW_OP:
+        return {"kind": "op", "doc": doc, "client": client,
+                "clientSeq": int(obj.client_seq[i]),
+                "refSeq": int(obj.ref_seq[i]),
+                "contents": json.loads(obj.blob(i))}
+    if k == K_RAW_JOIN:
+        return {"kind": "join", "doc": doc, "client": client}
+    if k == K_RAW_LEAVE:
+        return {"kind": "leave", "doc": doc, "client": client}
+    if k == K_RAW_BOXCAR:
+        return {"kind": "boxcar", "doc": doc, "client": client,
+                "ops": [
+                    {"clientSeq": cs, "refSeq": rf,
+                     "contents": c.value if isinstance(c, JsonBlob)
+                     else c}
+                    for cs, rf, c in obj.boxcar(i)
+                ]}
+    if k == K_SEQ_OP:
+        return {"kind": "op", "doc": doc,
+                "seq": int(obj.seq[i]), "msn": int(obj.msn[i]),
+                "client": client,
+                "clientSeq": int(obj.client_seq[i]),
+                "refSeq": int(obj.ref_seq[i]),
+                "type": _TYPES[int(obj.type_code[i])],
+                "contents": json.loads(obj.blob(i)),
+                "inOff": int(obj.in_off[i])}
+    return {"kind": "nack", "doc": doc, "client": client,
+            "clientSeq": int(obj.client_seq[i]),
+            "code": int(obj.seq[i]),
+            "reason": json.loads(obj.blob(i)),
+            "inOff": int(obj.in_off[i])}
 
 
 # Header-corruption resync probe budget: how many MAGIC candidates one
@@ -483,7 +942,7 @@ def decode_batch(buf, pos: int = 0,
     magic, ver, _flags, n, plen, crc, fence = HEADER.unpack_from(view, pos)
     if magic != MAGIC:
         raise ValueError("not a record-batch frame")
-    if ver != SCHEMA_VERSION or plen > MAX_BATCH_BYTES:
+    if ver not in SCHEMA_VERSIONS or plen > MAX_BATCH_BYTES:
         # Unknown version / insane length: treat as a corrupt frame of
         # unknowable extent — callers skip the rest of the file region
         # the same way a junk JSON line is skipped.
@@ -492,14 +951,14 @@ def decode_batch(buf, pos: int = 0,
     if end > len(view):
         return None, pos, -1  # torn frame: an append in progress
     payload = view[pos + HEADER.size:end]
-    hdr0 = HEADER.pack(MAGIC, SCHEMA_VERSION, 0, n, plen, 0, fence)
+    hdr0 = HEADER.pack(MAGIC, ver, 0, n, plen, 0, fence)
     if zlib.crc32(payload, zlib.crc32(hdr0)) != crc:
         # Corrupt in place: skip, keep the count. (If the corruption
         # hit the header's count/length fields themselves, the skip
         # may land mid-junk — the walker then stops at the first
         # unparseable unit, the documented header-corruption floor.)
         return None, end, n
-    return RecordBatch(n, fence, payload), end, n
+    return RecordBatch(n, fence, payload, version=ver), end, n
 
 
 def _resync_scan(data, pos: int) -> Optional[int]:

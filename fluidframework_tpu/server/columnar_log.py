@@ -56,11 +56,18 @@ import time
 from typing import Any, List, Optional, Tuple
 
 from ..protocol.record_batch import (
+    HEADER,
+    K_GENERIC,
+    K_SEQ_OP,
+    MAGIC,
+    MAX_BATCH_BYTES,
     RecordBatch,
+    count_records,
+    decode_batch,
     encode_batch,
     iter_units,
 )
-from .queue import SharedFileTopic, TailReader, check_disk_fault
+from .queue import SharedFileTopic, TailReader, check_disk_fault, fsync_file
 
 __all__ = [
     "ColumnarFileTopic",
@@ -69,6 +76,7 @@ __all__ = [
     "default_log_format",
     "make_tail_reader",
     "make_topic",
+    "tail_records_reverse",
 ]
 
 LOG_FORMATS = ("json", "columnar")
@@ -122,11 +130,16 @@ class ColumnarFileTopic(SharedFileTopic):
             return None
 
     def _write_committed(self, n: int) -> None:
+        # Deliberately NOT fsynced: the data fsync precedes this write,
+        # so after an OS crash the sidecar can only UNDERSTATE (stale
+        # value → the seal scan covers more bytes, correct) or be
+        # junk/missing (full scan, correct) — it can never name bytes
+        # that are not durable. Dropping the fsync halves the columnar
+        # append's durability cost (one fsync per batch, not two).
         tmp = self._clen_path() + f".tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"len": int(n)}, f)
             f.flush()
-            os.fsync(f.fileno())
         os.replace(tmp, self._clen_path())
 
     @staticmethod
@@ -141,14 +154,38 @@ class ColumnarFileTopic(SharedFileTopic):
 
     # ----------------------------------------------------------- append
 
+    def __init__(self, path: str):
+        super().__init__(path)
+        # Process-local seal hint: the clean length after OUR last
+        # append (complete units only, so it stays valid whatever
+        # other writers append after it). Bounds the seal scan for
+        # unsynced-append topics whose on-disk sidecar is pinned.
+        self._seal_hint = 0
+        # True while this topic holds appends that were never fsynced
+        # (fsync=False legs): the on-disk sidecar must not advance
+        # over them — after an OS crash it could otherwise name bytes
+        # the page cache lost, and the seal scan trusts it.
+        self._unsynced = False
+
     def append_many(self, messages: List[Any],
                     fence: Optional[int] = None,
                     owner: Optional[str] = None,
-                    lock_timeout_s: Optional[float] = None) -> int:
-        """Append `messages` as ONE binary record-batch frame under the
-        OS lock; returns the frame bytes written (0 for an empty batch,
-        which still gates the fence — a deposed owner must learn it is
-        deposed even with nothing to write)."""
+                    lock_timeout_s: Optional[float] = None,
+                    fsync: bool = True) -> int:
+        """Append `messages` — plain records and/or pre-columnized
+        `ColumnarRecords` segments, spliced in order — as ONE binary
+        record-batch frame under the OS lock; returns the frame bytes
+        written (0 for an empty batch, which still gates the fence — a
+        deposed owner must learn it is deposed even with nothing to
+        write).
+
+        ``fsync=False`` skips the data fsync AND pins the committed-
+        length sidecar (a sidecar naming un-fsynced bytes could
+        overstate after an OS crash): torn-tail-safe but not crash-
+        durable — the derived-feed contract (`SharedFileTopic`
+        .append_many has the full story). A later ``fsync=True``
+        append re-covers everything (fsync flushes the whole file) and
+        resumes the sidecar."""
         from .queue import flock_exclusive
 
         with open(self.path, "r+b") as f:
@@ -165,13 +202,27 @@ class ColumnarFileTopic(SharedFileTopic):
                 # can never truncate acknowledged records; only the
                 # genuinely torn suffix (partial frame, unterminated
                 # line) is sealed away — it was never acknowledged.
-                start = 0 if committed is None else min(committed, size)
+                # The process-local hint covers our own unsynced
+                # appends, whose bytes the sidecar must not name.
+                start = max(0 if committed is None
+                            else min(committed, size),
+                            min(self._seal_hint, size))
                 f.seek(start)
                 clean = start + self._scan_clean_len(f.read())
                 if size > clean:
                     f.truncate(clean)
-                if not messages:
-                    if committed != clean:
+                if not count_records(messages):
+                    self._seal_hint = clean
+                    if committed != clean and not self._unsynced:
+                        # The scan may have covered bytes ANOTHER
+                        # writer appended fsync=False (a dead fused
+                        # consumer's broadcast frames — our local
+                        # `_unsynced` flag can't see them): fsync the
+                        # data BEFORE the sidecar names it, preserving
+                        # the file-global "sidecar never overstates
+                        # durable data" invariant. Rare path — fence
+                        # binds and recovery, never the steady state.
+                        fsync_file(f, "topic")
                         self._write_committed(clean)
                     return 0
                 cur_fence, cur_owner = self.latest_fence()
@@ -181,9 +232,14 @@ class ColumnarFileTopic(SharedFileTopic):
                 f.seek(clean)
                 f.write(frame)
                 f.flush()
-                os.fsync(f.fileno())
-                # Data is durable BEFORE the committed length names it.
-                self._write_committed(clean + len(frame))
+                self._seal_hint = clean + len(frame)
+                if fsync:
+                    fsync_file(f, "topic")
+                    self._unsynced = False
+                    # Data is durable BEFORE the length names it.
+                    self._write_committed(clean + len(frame))
+                else:
+                    self._unsynced = True
         # Event-driven consumers wake now (outside the lock, after
         # durability — queue.TopicDoorbell semantics, both formats).
         self._ring_doorbells()
@@ -344,3 +400,176 @@ class ColumnarTailReader:
             else:
                 out.append((unit[1], unit[2]))
         return out
+
+
+# ---------------------------------------------------------------------------
+# backward tail scan (summary catch-up's O(tail) read, frame edition)
+# ---------------------------------------------------------------------------
+
+# How far back one frame boundary can possibly sit from a known one: a
+# frame larger than this cannot exist, so a backward chain that finds
+# no anchoring frame inside the window is provably in a non-frame
+# region (JSON-era lines) and the caller falls forward.
+HEADER_MAX_EXTENT = HEADER.size + MAX_BATCH_BYTES
+_REV_BLOCK = 1 << 16
+
+
+def _frame_ops_reverse(batch: RecordBatch, doc: str, base: int,
+                       upto: Optional[int]):
+    """One frame's contribution to a reverse tail scan: `doc`'s
+    kind=="op" records (forward order within the frame), and whether
+    an own-doc record at/below `base` proves the scan may stop.
+    Column-first: a frame whose doc dictionary lacks `doc` is skipped
+    on the dictionary alone (no record decode), K_SEQ_OP rows gather
+    by mask, and only K_GENERIC rows pay a per-record decode."""
+    import numpy as np
+
+    ops: List[dict] = []
+    stop = False
+    gen_rows = np.flatnonzero(batch.kind == K_GENERIC)
+    if doc in batch.docs:
+        di = batch.docs.index(doc)
+        rows = np.flatnonzero(
+            (batch.kind == K_SEQ_OP) & (batch.doc_idx == di)
+        )
+        for i in rows.tolist():
+            s = int(batch.seq[i])
+            if s <= base:
+                stop = True
+                continue
+            if upto is None or s <= upto:
+                ops.append(batch.record(i))
+    elif gen_rows.shape[0] == 0:
+        return ops, stop
+    for i in gen_rows.tolist():
+        rec = batch.record(i)
+        if not isinstance(rec, dict) or rec.get("doc") != doc \
+                or rec.get("kind") != "op":
+            continue
+        s = int(rec["seq"])
+        if s <= base:
+            stop = True
+        elif upto is None or s <= upto:
+            ops.append(rec)
+    if len(ops) > 1:
+        ops.sort(key=lambda r: int(r["seq"]))  # generics interleave
+    return ops, stop
+
+
+def tail_records_reverse(topic: ColumnarFileTopic, doc: str, base: int,
+                         upto: Optional[int]) -> Optional[List[dict]]:
+    """`doc`'s op records with ``base < seq [<= upto]`` read BACKWARD
+    from the topic's end — the frame-log twin of the summarizer's
+    JSONL `_tail_records_reverse`, so summary catch-up on columnar
+    topics costs O(tail + interleave) instead of the O(log-bytes)
+    forward skip.
+
+    Frames are length-prefixed forward structures, so the walk anchors
+    on the committed-length sidecar and CHAINS backward: a MAGIC
+    candidate is trusted only when its frame decodes (header+payload
+    CRC) AND ends exactly at an already-trusted boundary — later
+    boundaries validate first, so false MAGICs inside blob heaps can
+    never mis-frame the walk. Returns None when it cannot anchor (no
+    sidecar, or a non-frame region — a JSON-era prefix mid-chain);
+    the caller falls back to the forward walk, slower but always
+    correct."""
+    try:
+        size = os.path.getsize(topic.path)
+    except OSError:
+        return None
+    committed = topic._read_committed()
+    if committed is None:
+        return None  # pre-sidecar file (migrated JSONL): fall forward
+    committed = min(committed, size)
+    from ..utils.metrics import get_registry
+
+    m_bytes = get_registry().counter(
+        "catchup_tail_scan_bytes_total", mode="reverse-columnar"
+    )
+    groups: List[List[dict]] = []  # per-unit op lists, newest first
+    with open(topic.path, "rb") as f:
+        # 1. The post-sidecar suffix (at most the appends whose
+        # sidecar update a crash dropped, or one append in flight):
+        # parse FORWARD — torn-unit rules apply, complete units count.
+        f.seek(committed)
+        tail = f.read()
+        m_bytes.inc(len(tail))
+        done = False
+        fwd: List[List[dict]] = []
+        for kind, _idx, _cnt, payload, _end in iter_units(tail):
+            if kind == "batch" and payload is not None:
+                ops, stop = _frame_ops_reverse(payload, doc, base, upto)
+                fwd.append(ops)
+                done = done or stop
+            elif kind == "line":
+                line = payload.strip()
+                if line:
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("doc") == doc \
+                            and rec.get("kind") == "op":
+                        s = int(rec["seq"])
+                        if s <= base:
+                            done = True
+                        elif upto is None or s <= upto:
+                            fwd.append([rec])
+        groups.extend(reversed(fwd))
+        # 2. Chain BACKWARD from the sidecar boundary, frame by frame.
+        lo = committed
+        buf = b""
+        buf_start = committed
+        while lo > 0 and not done:
+            # Grow the window until a frame ending exactly at `lo`
+            # appears (or the region is provably not a frame). While
+            # `lo` is fixed, a rejected candidate's verdict can never
+            # change when only EARLIER bytes arrive, so after each
+            # front growth only the newly prepended block (+3 bytes of
+            # straddle) is searched — the fallback on a non-frame
+            # region stays linear, not quadratic. A new anchor moves
+            # `lo`, which CAN validate previously rejected candidates;
+            # the outer loop therefore re-searches the (truncated)
+            # remainder from scratch per anchor.
+            anchored = None
+            fresh_hi = len(buf)  # unsearched-prefix bound, this `lo`
+            while anchored is None:
+                pos = min(fresh_hi, len(buf))
+                while pos > 0:
+                    cand = buf.rfind(MAGIC, 0, pos)
+                    if cand < 0:
+                        break
+                    try:
+                        batch, end, cnt = decode_batch(buf, cand)
+                    except ValueError:
+                        pos = cand + 3
+                        continue
+                    if cnt >= 0 and buf_start + end == lo:
+                        # A CRC-failed frame (batch None) still
+                        # anchors the chain — its records are the
+                        # skip-but-count slots every reader skips.
+                        anchored = (buf_start + cand, batch)
+                        break
+                    pos = cand + 3
+                if anchored is not None:
+                    break
+                if buf_start == 0 or \
+                        lo - buf_start > HEADER_MAX_EXTENT:
+                    return None  # non-frame region: fall forward
+                step = min(_REV_BLOCK, buf_start)
+                f.seek(buf_start - step)
+                buf = f.read(step) + buf
+                m_bytes.inc(step)
+                buf_start -= step
+                fresh_hi = step + 3  # the new block + MAGIC straddle
+            b_at, batch = anchored
+            if batch is not None:
+                ops, stop = _frame_ops_reverse(batch, doc, base, upto)
+                groups.append(ops)
+                done = done or stop
+            lo = b_at
+            buf = buf[:lo - buf_start]
+    out: List[dict] = []
+    for ops in reversed(groups):
+        out.extend(ops)
+    return out

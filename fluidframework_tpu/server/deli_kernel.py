@@ -51,7 +51,6 @@ the cost.
 
 from __future__ import annotations
 
-import json
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -65,6 +64,7 @@ from ..ops.sequencer_kernel import (
     SUB_OP,
     SUB_SYSTEM,
 )
+from ..protocol import record_batch as _rb
 from ..protocol.messages import (
     MessageType,
     NackMessage,
@@ -550,9 +550,13 @@ class SeqPool:
 
 
 class _FlatResults:
-    """Kernel verdicts for one pump as flat Python lists aligned with
-    the submission index `add()` returned — emission is plain list
-    indexing, the array→list conversion happened once, vectorized."""
+    """Kernel verdicts for one pump, aligned with the submission index
+    `add()`/`add_columns()` returned. Two shapes share the class: flat
+    Python lists (the dict-emission path — one vectorized array→list
+    conversion, then plain indexing) or numpy arrays
+    (``run(as_arrays=True)`` — the columnar-emission path, where
+    verdicts flow into `record_batch.ColumnarRecords` columns as array
+    slices without ever becoming per-record Python values)."""
 
     __slots__ = ("seq", "msn", "nack", "skipped")
 
@@ -674,11 +678,14 @@ class PackedDeliCore:
         add = self.add
         return [add(slot, SUB_OP, col, cs, rf, g) for col, cs, rf in ops]
 
-    def run(self) -> _FlatResults:
+    def run(self, as_arrays: bool = False) -> _FlatResults:
         pool = self.pool
         pool.prepare()
         n = self._n_subs
         if n == 0:
+            if as_arrays:
+                z32 = np.zeros(0, np.int32)
+                return _FlatResults(z32, z32, z32, np.zeros(0, bool))
             return _FlatResults([], [], [], [])
         parts = [
             np.asarray(s, np.int64).reshape(-1, 6) for s in self._segments
@@ -721,6 +728,8 @@ class PackedDeliCore:
         self._m_fill.set(resident / pool.n_docs if pool.n_docs else 0.0)
         self._m_cols.set(pool.n_clients)
         self._m_devices.set(pool._n_shards)
+        if as_arrays:
+            return _FlatResults(seq_o, msn_o, nack_o, skip_o)
         return _FlatResults(
             seq_o.tolist(), msn_o.tolist(), nack_o.tolist(), skip_o.tolist()
         )
@@ -906,6 +915,90 @@ class KernelDeliLambda:
 # supervised-farm frontend (exactly-once recovery)
 # ---------------------------------------------------------------------------
 
+# Wire `type` codes the emit columns stamp (the K_SEQ_OP type column).
+_TC_OP = _rb._TYPE_CODE["op"]
+_TC_JOIN = _rb._TYPE_CODE["join"]
+_TC_LEAVE = _rb._TYPE_CODE["leave"]
+
+
+class _ScalarEmit:
+    """Scalar-record accumulator for the columnar emission path: the
+    records that still need per-record handling (nacks with their
+    reason text, joins/leaves, dict-ingested strays, boxcar members)
+    land as COLUMNS in stream order, so one pump's whole output is
+    `ColumnarRecords` parts end to end — never a per-record wire
+    dict. `flush()` closes the current accumulation into a part
+    appended to `out` (called before every vectorized span so parts
+    splice back in exact stream order)."""
+
+    __slots__ = ("docs", "doc_of", "kind", "tc", "didx", "client",
+                 "cseq", "ref", "seq", "msn", "inoff", "blobs")
+
+    def __init__(self):
+        self.docs: List[str] = []
+        self.doc_of: Dict[str, int] = {}
+        self.kind: List[int] = []
+        self.tc: List[int] = []
+        self.didx: List[int] = []
+        self.client: List[int] = []
+        self.cseq: List[int] = []
+        self.ref: List[int] = []
+        self.seq: List[int] = []
+        self.msn: List[int] = []
+        self.inoff: List[int] = []
+        self.blobs: List[bytes] = []
+
+    def _doc(self, doc: str) -> int:
+        di = self.doc_of.get(doc)
+        if di is None:
+            di = self.doc_of[doc] = len(self.docs)
+            self.docs.append(doc)
+        return di
+
+    def op(self, doc: str, tc: int, cid: int, cseq: int, ref: int,
+           seq: int, msn: int, inoff: int, contents: Any) -> None:
+        self.kind.append(_rb.K_SEQ_OP)
+        self.tc.append(tc)
+        self.didx.append(self._doc(doc))
+        self.client.append(cid)
+        self.cseq.append(cseq)
+        self.ref.append(ref)
+        self.seq.append(seq)
+        self.msn.append(msn)
+        self.inoff.append(inoff)
+        self.blobs.append(_rb._dumps(contents))  # JsonBlob rides raw
+
+    def member(self, doc: str, tc: int, cid: int, seq: int, msn: int,
+               inoff: int) -> None:
+        # join/leave wire shape: clientSeq 0, refSeq seq-1, contents=cid
+        self.op(doc, tc, cid, 0, seq - 1, seq, msn, inoff, cid)
+
+    def nack(self, doc: str, cid: int, cseq: int, code: int,
+             reason: str, inoff: int) -> None:
+        self.kind.append(_rb.K_NACK)
+        self.tc.append(_rb._NO_TYPE)
+        self.didx.append(self._doc(doc))
+        self.client.append(cid)
+        self.cseq.append(cseq)
+        self.ref.append(0)
+        self.seq.append(code)  # code rides the seq column
+        self.msn.append(0)
+        self.inoff.append(inoff)
+        self.blobs.append(_rb._dumps(reason))
+
+    def flush(self, out: List[Any]) -> None:
+        n = len(self.kind)
+        if not n:
+            return
+        blob_off = np.zeros(n + 1, np.uint32)
+        blob_off[1:] = np.cumsum([len(b) for b in self.blobs])
+        out.append(_rb.ColumnarRecords(
+            self.docs, self.kind, self.tc, self.didx, self.client,
+            self.cseq, self.ref, self.seq, self.msn, self.inoff,
+            blob_off, b"".join(self.blobs),
+        ))
+        self.__init__()
+
 
 class KernelDeliRole(_Role):
     """Drop-in for `supervisor.DeliRole` with device-batched ticketing.
@@ -999,8 +1092,6 @@ class KernelDeliRole(_Role):
     def flush_batch(self, out: List[dict]) -> None:
         if not self._pending:
             return
-        from ..protocol import record_batch as _rb
-
         core = self.core
         pool = core.pool
         core.begin()
@@ -1008,6 +1099,14 @@ class KernelDeliRole(_Role):
         docs_cache: Dict[str, tuple] = {}  # touch once per doc per pump
         plan: List[tuple] = []
         shadow: Dict[str, set] = {}
+        # Columnar emission (the pre-columnized emit path): legal when
+        # the out topic carries raw frames and nothing downstream needs
+        # per-record wire dicts — wire tracing adds a side "tr" key
+        # (generic schema), recovery's silent replay and the ranged
+        # fabric's predecessor drains post-process dict records
+        # (inOff filters, inSrc tags).
+        emit_cols = (self.out_columnar and not self.trace_wire
+                     and not self._recovering and not self._dict_emit)
 
         def doc_entry(doc):
             ent = docs_cache.get(doc)
@@ -1068,38 +1167,82 @@ class KernelDeliRole(_Role):
             if ent[0] == "rec":
                 plan_record(ent[1], ent[2])
                 continue
-            # Columnar fast path: ints straight off the codec columns,
-            # doc ids via the batch-local dictionary, contents as raw
-            # JSON blobs (decoded only if the out topic needs text).
-            base, rb = ent[1], ent[2]
-            kinds = rb.kind.tolist()
-            doci = rb.doc_idx.tolist()
-            clients = rb.client.tolist()
-            cseqs = rb.client_seq.tolist()
-            refs = rb.ref_seq.tolist()
-            docs = rb.docs
-            for i in range(rb.n):
-                k = kinds[i]
+            self._plan_cols(plan, ent[2], ent[1], doc_entry,
+                            plan_record, plan_boxcar, passthrough)
+        self._pending = []
+        res = core.run(as_arrays=emit_cols)
+        if emit_cols:
+            self._emit_columns(plan, res, out)
+        else:
+            self._emit_dicts(plan, res, out)
+
+    # ------------------------------------------------- columnar ingest
+
+    # Below this, a K_RAW_OP run takes the per-record tuple path: the
+    # per-run fixed cost (unique-doc touch, array builds, one emit
+    # part per span) only amortizes over real runs — a join-interleaved
+    # stream decomposes into length-1 "runs" that would otherwise pay
+    # it per record.
+    MIN_OP_RUN = 16
+
+    def _plan_cols(self, plan, rb, base, doc_entry, plan_record,
+                   plan_boxcar, passthrough) -> None:
+        """Queue one ingested `RecordBatch`: homogeneous K_RAW_OP runs
+        (at least `MIN_OP_RUN` long) go through
+        `PackedDeliCore.add_columns` as arrays (doc slots via one
+        touch per unique doc, dense client columns via one cmap probe
+        per record — no plan tuples, no per-record blob handles),
+        everything else (joins/leaves/boxcars/generic strays, short op
+        runs) through the per-record plan."""
+        n = rb.n
+        if n == 0:
+            return
+        docs = rb.docs
+        kinds_l = None
+        cseqs_l = refs_l = None
+        for run_is_op, lo, hi in _sk.mask_runs(rb.kind == _rb.K_RAW_OP):
+            if run_is_op and hi - lo >= self.MIN_OP_RUN:
+                self._plan_op_run(plan, rb, lo, hi, base, doc_entry)
+                continue
+            if kinds_l is None:
+                kinds_l = rb.kind.tolist()
+                doci = rb.doc_idx.tolist()
+                clients = rb.client.tolist()
+            for i in range(lo, hi):
+                k = kinds_l[i]
                 if k == _rb.K_RAW_OP:
+                    if cseqs_l is None:
+                        cseqs_l = rb.client_seq.tolist()
+                        refs_l = rb.ref_seq.tolist()
                     doc = docs[doci[i]]
                     slot, h = doc_entry(doc)
                     cid = clients[i]
-                    contents = _rb.JsonBlob(rb.blob(i))
+                    contents: Any = _rb.JsonBlob(rb.blob(i))
                     if not passthrough:
                         contents = contents.value
                     self._plan_op(
-                        plan, add, base + i, doc, slot,
-                        h["cmap"].get(cid, 0), cid, cseqs[i], refs[i],
-                        contents,
+                        plan, self.core.add, base + i, doc, slot,
+                        h["cmap"].get(cid, 0), cid, cseqs_l[i],
+                        refs_l[i], contents,
                     )
                 elif k == _rb.K_RAW_BOXCAR:
                     doc = docs[doci[i]]
                     slot, h = doc_entry(doc)
+                    # v2 frames: per-op ints off the nested columns,
+                    # per-op contents as raw-blob handles end to end.
+                    ops = rb.boxcar(i)
+                    if not passthrough:
+                        ops = [
+                            (cs, rf, c.value
+                             if isinstance(c, _rb.JsonBlob) else c)
+                            for cs, rf, c in ops
+                        ]
                     plan_boxcar(base + i, doc, slot, h, clients[i],
-                                json.loads(rb.blob(i)))
+                                ops)
                 elif k in (_rb.K_RAW_JOIN, _rb.K_RAW_LEAVE):
                     plan_record(base + i, {
-                        "kind": "join" if k == _rb.K_RAW_JOIN else "leave",
+                        "kind": "join" if k == _rb.K_RAW_JOIN
+                        else "leave",
                         "doc": docs[doci[i]], "client": clients[i],
                     })
                 else:
@@ -1110,9 +1253,40 @@ class KernelDeliRole(_Role):
                             rec.get("kind") in ("join", "leave", "op",
                                                 "boxcar"):
                         plan_record(base + i, rec)
-        self._pending = []
-        res = core.run()
 
+    def _plan_op_run(self, plan, rb, lo, hi, base, doc_entry) -> None:
+        """Bulk-queue one contiguous K_RAW_OP run [lo, hi) through
+        `add_columns` — the pre-columnized ingest half finally on the
+        live path."""
+        docs = rb.docs
+        doci = rb.doc_idx[lo:hi]
+        slot_of: Dict[int, int] = {}
+        h_of: Dict[int, dict] = {}
+        for d in np.unique(doci).tolist():
+            slot, h = doc_entry(docs[d])
+            slot_of[d] = slot
+            h_of[d] = h
+        m = hi - lo
+        doci_l = doci.tolist()
+        clients_l = rb.client[lo:hi].tolist()
+        slots = np.fromiter((slot_of[d] for d in doci_l), np.int64, m)
+        cols = np.fromiter(
+            (h_of[d]["cmap"].get(c, 0)
+             for d, c in zip(doci_l, clients_l)),
+            np.int64, m,
+        )
+        j0 = self.core.add_columns(
+            slots, SUB_OP, cols, rb.client_seq[lo:hi],
+            rb.ref_seq[lo:hi],
+        )
+        plan.append((base, None, "run", (j0, rb, lo, hi, h_of), None))
+
+    # ----------------------------------------------------- emission
+
+    def _emit_dicts(self, plan, res, out: List[dict]) -> None:
+        """The per-record wire-dict emission (the differential-oracle
+        shape, and the path recovery / tracing / ranged drains use)."""
+        pool = self.core.pool
         emit = out.append
         seqs, msns, nacks, skips = res.seq, res.msn, res.nack, res.skipped
         apply_op = pool.apply_op
@@ -1122,39 +1296,60 @@ class KernelDeliRole(_Role):
         # submit_to_stamp observe so the two surfaces agree exactly.
         trace = self.trace_wire
         now = time.time() if trace else 0.0
+
+        def emit_op(line_idx, doc, cid, cseq, ref, contents, sub_ts,
+                    handle):
+            if skips[handle]:
+                return  # deduped resubmission / aborted boxcar tail
+            seq, msn, nack = seqs[handle], msns[handle], nacks[handle]
+            if nack:
+                emit({"kind": "nack", "doc": doc, "client": cid,
+                      "clientSeq": cseq, "code": nack,
+                      "reason": _nack_reason(
+                          nack, ref, msn, pool.head(doc), cseq,
+                          pool.expected_cseq(doc, cid)),
+                      "inOff": line_idx})
+                return
+            apply_op(doc, cid, seq, msn, cseq, ref)
+            rec = {"kind": "op", "doc": doc, "seq": seq, "msn": msn,
+                   "client": cid, "clientSeq": cseq, "refSeq": ref,
+                   "type": "op", "contents": contents,
+                   "inOff": line_idx}
+            if trace:
+                tr = {"stamp": now}
+                if isinstance(sub_ts, (int, float)):
+                    tr["sub"] = sub_ts
+                    if not self._recovering:
+                        # Recovery's silent replay must not be
+                        # re-observed (crash-spanning durations) —
+                        # the scalar role's rule, kernel-side.
+                        self._observe_stage(
+                            "submit_to_stamp",
+                            (now - sub_ts) * 1000.0,
+                        )
+                rec["tr"] = tr
+            emit(rec)
+
         for line_idx, doc, tag, payload, handle in plan:
             if tag == "op":
-                if skips[handle]:
-                    continue  # deduped resubmission / aborted boxcar tail
-                seq, msn, nack = seqs[handle], msns[handle], nacks[handle]
                 cid, cseq, ref, contents, sub_ts = payload
-                if nack:
-                    emit({"kind": "nack", "doc": doc, "client": cid,
-                          "clientSeq": cseq, "code": nack,
-                          "reason": _nack_reason(
-                              nack, ref, msn, pool.head(doc), cseq,
-                              pool.expected_cseq(doc, cid)),
-                          "inOff": line_idx})
-                    continue
-                apply_op(doc, cid, seq, msn, cseq, ref)
-                rec = {"kind": "op", "doc": doc, "seq": seq, "msn": msn,
-                       "client": cid, "clientSeq": cseq, "refSeq": ref,
-                       "type": "op", "contents": contents,
-                       "inOff": line_idx}
-                if trace:
-                    tr = {"stamp": now}
-                    if isinstance(sub_ts, (int, float)):
-                        tr["sub"] = sub_ts
-                        if not self._recovering:
-                            # Recovery's silent replay must not be
-                            # re-observed (crash-spanning durations) —
-                            # the scalar role's rule, kernel-side.
-                            self._observe_stage(
-                                "submit_to_stamp",
-                                (now - sub_ts) * 1000.0,
-                            )
-                    rec["tr"] = tr
-                emit(rec)
+                emit_op(line_idx, doc, cid, cseq, ref, contents,
+                        sub_ts, handle)
+            elif tag == "run":
+                j0, rb, lo, hi, _h_of = payload
+                docs = rb.docs
+                doci = rb.doc_idx
+                clients = rb.client
+                cseqs = rb.client_seq
+                refs = rb.ref_seq
+                for i in range(lo, hi):
+                    contents: Any = _rb.JsonBlob(rb.blob(i))
+                    if not self.out_columnar:
+                        contents = contents.value
+                    emit_op(line_idx + i, docs[int(doci[i])],
+                            int(clients[i]), int(cseqs[i]),
+                            int(refs[i]), contents, None,
+                            j0 + i - lo)
             elif tag == "join":
                 seq, msn = seqs[handle], msns[handle]
                 pool.apply_join(doc, payload, seq, msn)
@@ -1179,3 +1374,114 @@ class KernelDeliRole(_Role):
                 if trace:
                     rec["tr"] = {"stamp": now}
                 emit(rec)
+
+    def _emit_columns(self, plan, res, out: List[Any]) -> None:
+        """The pre-columnized emission: verdict arrays flow into
+        `ColumnarRecords` parts (ingest blob bytes pass through as
+        whole heap spans), appended to `out` in exact stream order —
+        `ColumnarFileTopic.append_many` splices them into one frame
+        with zero per-record classification. The host mirror updates
+        from flat column lists (bookkeeping-from-results, no wire
+        dicts); nack reasons stay per-record (rare, text-only)."""
+        pool = self.core.pool
+        seqs, msns, nacks, skips = res.seq, res.msn, res.nack, res.skipped
+        sc = _ScalarEmit()
+        for line_idx, doc, tag, payload, handle in plan:
+            if tag == "run":
+                self._emit_run(payload, res, sc, out, line_idx)
+            elif tag == "op":
+                if skips[handle]:
+                    continue
+                seq = int(seqs[handle])
+                msn = int(msns[handle])
+                nack = int(nacks[handle])
+                cid, cseq, ref, contents, _sub = payload
+                if nack:
+                    sc.nack(doc, cid, cseq, nack, _nack_reason(
+                        nack, ref, msn, pool.head(doc), cseq,
+                        pool.expected_cseq(doc, cid)), line_idx)
+                    continue
+                pool.apply_op(doc, cid, seq, msn, cseq, ref)
+                sc.op(doc, _TC_OP, cid, cseq, ref, seq, msn, line_idx,
+                      contents)
+            elif tag == "join":
+                seq = int(seqs[handle])
+                msn = int(msns[handle])
+                pool.apply_join(doc, payload, seq, msn)
+                sc.member(doc, _TC_JOIN, payload, seq, msn, line_idx)
+            else:  # leave
+                seq = int(seqs[handle])
+                msn = int(msns[handle])
+                if seq == 0:
+                    continue  # unknown client: nothing stamped
+                pool.apply_leave(doc, payload, seq, msn)
+                sc.member(doc, _TC_LEAVE, payload, seq, msn, line_idx)
+        sc.flush(out)
+
+    def _emit_run(self, payload, res, sc: _ScalarEmit, out: List[Any],
+                  base: int) -> None:
+        """Emit one ingested K_RAW_OP run: contiguous ACCEPTED spans
+        become `ColumnarRecords` parts — verdict columns sliced
+        straight off the kernel result, contents blobs one heap memcpy
+        per span — while nacked records (rare) take the scalar path in
+        place, so the output order is exactly the scalar role's."""
+        j0, rb, lo, hi, h_of = payload
+        m = hi - lo
+        seqs = res.seq[j0:j0 + m]
+        msns = res.msn[j0:j0 + m]
+        nacks = res.nack[j0:j0 + m]
+        skips = res.skipped[j0:j0 + m]
+        # 0 = dropped (dedup), 1 = accepted, 2 = nacked.
+        cat = np.where(skips, 0,
+                       np.where(nacks == 0, 1, 2)).astype(np.int8)
+        pool = self.core.pool
+        for c, a, b in _sk.mask_runs(cat):
+            if c == 0:
+                continue  # deduped resubmissions: nothing emitted
+            rows = slice(lo + a, lo + b)
+            if c == 1:
+                off = rb._blob_off[lo + a:lo + b + 1]
+                heap = bytes(rb._heap[off[0]:off[-1]])
+                seq64 = seqs[a:b].astype(np.int64)
+                msn64 = msns[a:b].astype(np.int64)
+                w = b - a
+                part = _rb.ColumnarRecords(
+                    rb.docs,
+                    np.full(w, _rb.K_SEQ_OP, np.uint8),
+                    np.full(w, _TC_OP, np.uint8),
+                    rb.doc_idx[rows],
+                    rb.client[rows], rb.client_seq[rows],
+                    rb.ref_seq[rows],
+                    seq64, msn64,
+                    np.arange(base + lo + a, base + lo + b,
+                              dtype=np.int64),
+                    (off - off[0]).astype(np.uint32), heap,
+                )
+                sc.flush(out)  # strays before this span keep order
+                out.append(part)
+                # Mirror update from flat columns (last write wins per
+                # (doc, client) — order-equivalent within a span of
+                # plain ops, and spans run in stream order).
+                for d, cl, cs, rf, sq, mn in zip(
+                        rb.doc_idx[rows].tolist(),
+                        rb.client[rows].tolist(),
+                        rb.client_seq[rows].tolist(),
+                        rb.ref_seq[rows].tolist(),
+                        seq64.tolist(), msn64.tolist()):
+                    h = h_of[d]
+                    h["clients"][cl] = [rf, cs]
+                    h["seq"] = sq
+                    h["min_seq"] = mn
+            else:
+                docs = rb.docs
+                for i in range(lo + a, lo + b):
+                    j = i - lo
+                    doc = docs[int(rb.doc_idx[i])]
+                    cid = int(rb.client[i])
+                    cseq = int(rb.client_seq[i])
+                    ref = int(rb.ref_seq[i])
+                    nk = int(nacks[j])
+                    msn = int(msns[j])
+                    sc.nack(doc, cid, cseq, nk, _nack_reason(
+                        nk, ref, msn, pool.head(doc), cseq,
+                        pool.expected_cseq(doc, cid)), base + i)

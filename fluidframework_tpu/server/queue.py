@@ -193,6 +193,17 @@ class JournalConsumer:
         return msgs
 
 
+def fsync_file(f, kind: str = "topic") -> None:
+    """One counted fsync: every durable-write fsync on the hot path
+    routes through here so `topic_fsyncs_total{kind=}` reports the
+    per-record durability floor the fused-hop work attacks (the
+    bench's fsyncs-per-record evidence)."""
+    os.fsync(f.fileno())
+    from ..utils.metrics import get_registry
+
+    get_registry().counter("topic_fsyncs_total", kind=kind).inc()
+
+
 class SharedFileTopic:
     """A cross-process topic over one JSONL file.
 
@@ -341,9 +352,17 @@ class SharedFileTopic:
     def append_many(self, messages: List[Any],
                     fence: Optional[int] = None,
                     owner: Optional[str] = None,
-                    lock_timeout_s: Optional[float] = None) -> int:
+                    lock_timeout_s: Optional[float] = None,
+                    fsync: bool = True) -> int:
         """Append a batch under the OS lock; returns the payload bytes
-        written (the byte-based checkpoint-cadence signal)."""
+        written (the byte-based checkpoint-cadence signal).
+
+        ``fsync=False`` skips the data fsync: the append is ordered
+        and torn-tail-safe (readers never consume an incomplete line)
+        but not crash-durable — for DERIVED feeds whose records are
+        deterministically regenerable from an upstream durable topic
+        (the fused hop's broadcast leg), where exactly-once recovery
+        re-emits anything the page cache lost."""
         # An empty batch still gates: a deposed owner must learn it is
         # deposed even when it has nothing to write.
         payload = b"".join(
@@ -365,7 +384,8 @@ class SharedFileTopic:
                 check_disk_fault("topic")
                 f.write(payload)
                 f.flush()
-                os.fsync(f.fileno())
+                if fsync:
+                    fsync_file(f, "topic")
         if messages:
             self._ring_doorbells()
         return len(payload)
@@ -962,7 +982,7 @@ class FencedCheckpointStore:
                 with open(tmp, "w") as f:
                     f.write(payload)
                     f.flush()
-                    os.fsync(f.fileno())
+                    fsync_file(f, "checkpoint")
                 os.replace(tmp, self._path(key))
             finally:
                 fcntl.flock(lk.fileno(), fcntl.LOCK_UN)

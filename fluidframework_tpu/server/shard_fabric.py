@@ -550,10 +550,17 @@ class _RangedMixin:
                 return taken
             p["quiet_since"] = None
             out: List[dict] = []
-            for i, rec in entries:
-                if self._mine(rec):
-                    self.process(i, rec, out)
-            self.flush_batch(out)
+            # Pred-drain outputs are tagged per record below, so the
+            # flush must emit wire DICTS even on a columnar-emitting
+            # role (the kernel deli's pre-columnized emission).
+            self._dict_emit = True
+            try:
+                for i, rec in entries:
+                    if self._mine(rec):
+                        self.process(i, rec, out)
+                self.flush_batch(out)
+            finally:
+                self._dict_emit = False
             for r in out:
                 r["inSrc"] = prid
             try:

@@ -826,12 +826,14 @@ def read_catchup(shared_dir: str, doc: str,
     ``{"manifest", "blob", "ops"}`` (manifest/blob None when no
     summary exists yet — the tail is then the whole log).
 
-    With a summary present on a JSONL topic the tail is read BACKWARD
-    from the topic's end (O(tail), so the join cost is flat in log
-    length — the config10 gate); columnar topics pay one forward
-    line-offset skip from the manifest's `off` (ROADMAP follow-up:
-    byte offsets in the manifest)."""
-    from .columnar_log import ColumnarFileTopic
+    With a summary present the tail is read BACKWARD from the topic's
+    end (O(tail), so the join cost is flat in log length — the
+    config10 gate) on BOTH log formats: JSONL via the line scan below,
+    columnar via the frame-chaining scan
+    (`columnar_log.tail_records_reverse`), which falls back to the
+    forward skip from the manifest's `off` only when it cannot anchor
+    (a pre-sidecar or JSON-era-prefix file)."""
+    from .columnar_log import ColumnarFileTopic, tail_records_reverse
 
     idx = index or SummaryIndex(shared_dir, log_format)
     idx.poll()
@@ -845,9 +847,13 @@ def read_catchup(shared_dir: str, doc: str,
         log_format,
     )
     base = int(man["seq"]) if man is not None else 0
-    if man is not None and not isinstance(topic, ColumnarFileTopic):
-        ops = _tail_records_reverse(topic.path, doc, base, seq)
-    else:
+    ops = None
+    if man is not None:
+        if isinstance(topic, ColumnarFileTopic):
+            ops = tail_records_reverse(topic, doc, base, seq)
+        else:
+            ops = _tail_records_reverse(topic.path, doc, base, seq)
+    if ops is None:
         # The manifest's `off` (its trigger's input line) bounds the
         # forward scan: records at/below it are covered.
         reader = make_tail_reader(

@@ -74,13 +74,16 @@ __all__ = [
     "BroadcasterRole",
     "DELI_IMPLS",
     "DeliRole",
+    "FUSED_PIPELINE_ROLES",
     "LOG_FORMATS",
     "PIPELINE_ROLES",
     "ROLES",
     "ScribeRole",
+    "ScriptoriumBroadcasterRole",
     "ScriptoriumRole",
     "ServiceSupervisor",
     "canonical_record",
+    "fused_roles",
     "partitioned_role_class",
     "resolve_role_class",
     "serve_role",
@@ -165,6 +168,13 @@ class _Role:
     # labeled with. None = the classic single-partition farm.
     partition: Optional[int] = None
     role_base: Optional[str] = None
+    # Set True around a flush whose output records will be
+    # POST-PROCESSED as wire dicts (the ranged fabric's predecessor
+    # drains tag `inSrc` onto each record): columnar-emitting roles
+    # (the kernel deli) then fall back to per-record dict emission for
+    # that flush. Recovery and wire tracing force the dict path on
+    # their own flags.
+    _dict_emit: bool = False
 
     def _metric_labels(self) -> Dict[str, str]:
         """Metric label set: single-partition roles keep the historic
@@ -304,6 +314,15 @@ class _Role:
     def flush_batch(self, out: List[dict]) -> None:
         """End-of-batch hook: batching roles (the kernel deli) buffer
         in `process` and emit here; scalar roles emit per record."""
+
+    def _append_outputs(self, out: List[dict]) -> int:
+        """The fenced durable output append for one step's batch
+        (fused roles extend it to several topics — each leg wraps its
+        OWN retry budget, so a retried leg can never re-append a leg
+        that already landed). Returns bytes written."""
+        return self._durable(lambda: self.out_topic.append_many(
+            out, fence=self.fence, owner=self.owner
+        ))
 
     def _absorb_predecessors(self) -> None:
         """Recovery hook between the output fence bind and the
@@ -476,21 +495,7 @@ class _Role:
         # own-topic records always postdate its predecessor records,
         # so this is the per-document input order (no-op otherwise).
         self._absorb_predecessors()
-        entries, _ = self.out_topic.read_entries(0)
-        # Durable outputs per input offset: one input may emit SEVERAL
-        # outputs (a wire boxcar), and a crash mid-append can leave a
-        # durable PREFIX of them — outputs land in input order, so only
-        # the LAST durable input (max_done) can be partial; everything
-        # below it is complete. Records tagged `inSrc` live in a
-        # PREDECESSOR's offset space (a ranged successor's absorbed
-        # catch-up, server.shard_fabric) — their inOff would collide
-        # with ours, so the predecessor scan owns them, not this one.
-        done_counts: Dict[int, int] = {}
-        for _, r in entries:
-            if (isinstance(r, dict) and r.get("inSrc") is None
-                    and r.get("inOff", -1) >= self.offset):
-                off = r["inOff"]
-                done_counts[off] = done_counts.get(off, 0) + 1
+        done_counts = self._durable_done_counts(self.out_topic)
         if not done_counts:
             return
         max_done = max(done_counts)
@@ -520,6 +525,25 @@ class _Role:
         # that is the determinism claim this service rests on.
         # (Checked cheaply: counts; the chaos harness checks digests.)
         self.checkpoint()
+
+    def _durable_done_counts(self, topic) -> Dict[int, int]:
+        """Durable outputs per input offset on `topic`: one input may
+        emit SEVERAL outputs (a wire boxcar), and a crash mid-append
+        can leave a durable PREFIX of them — outputs land in input
+        order, so only the LAST durable input (max over the keys) can
+        be partial; everything below it is complete. Records tagged
+        `inSrc` live in a PREDECESSOR's offset space (a ranged
+        successor's absorbed catch-up, server.shard_fabric) — their
+        inOff would collide with ours, so the predecessor scan owns
+        them, not this one."""
+        entries, _ = topic.read_entries(0)
+        done: Dict[int, int] = {}
+        for _, r in entries:
+            if (isinstance(r, dict) and r.get("inSrc") is None
+                    and r.get("inOff", -1) >= self.offset):
+                off = r["inOff"]
+                done[off] = done.get(off, 0) + 1
+        return done
 
     def checkpoint(self) -> None:
         t0 = time.perf_counter()
@@ -626,11 +650,7 @@ class _Role:
                 # checkpoint cadence. Durable = retried under the
                 # storage-fault budget (degraded, not dead, through a
                 # transient ENOSPC).
-                self._ckpt_pending_bytes += self._durable(
-                    lambda: self.out_topic.append_many(
-                        out, fence=self.fence, owner=self.owner
-                    )
-                )
+                self._ckpt_pending_bytes += self._append_outputs(out)
             self.offset = next_off
             self._ckpt_dirty = True
             self.maybe_checkpoint()
@@ -773,7 +793,12 @@ class DeliRole(_Role):
             elif k == _rb.K_RAW_BOXCAR:
                 doc_id = docs[doci[i]]
                 doc = self._doc(doc_id)
-                for cseq, ref, contents in _json.loads(rb.blob(i)):
+                # v2 frames hand per-op contents as raw-blob handles
+                # (no once-per-boxcar JSON decode); v1 as plain values.
+                for cseq, ref, contents in rb.boxcar(i):
+                    if not passthrough and isinstance(
+                            contents, _rb.JsonBlob):
+                        contents = contents.value
                     if not self._ticket_wire(
                         doc, doc_id, clients[i], cseq, ref, contents,
                         start_line + i, out,
@@ -928,6 +953,205 @@ class BroadcasterRole(_Role):
         out.append(rec2)
 
 
+class ScriptoriumBroadcasterRole(_Role):
+    """The FUSED durable+broadcast hop: ONE supervised consumer plays
+    both `ScriptoriumRole` and `BroadcasterRole`, so a record crosses
+    deltas → durable → broadcast for one topic read, one process wake
+    and ~one fsync per batch instead of one of each PER STAGE (the
+    per-hop floor PR 9's open-loop bench exposed). The wire contract
+    is unchanged — `durable` and `broadcast` carry exactly the records
+    the split roles wrote — only the consumer topology fuses.
+
+    - The durable leg keeps its fsync; the broadcast leg appends
+      UNFSYNCED (`append_many(fsync=False)`): broadcast is a DERIVED
+      feed, deterministically regenerable from the durable deltas
+      stream, and recovery's per-topic durable-prefix scan re-emits
+      anything the page cache lost — exactly-once holds leg by leg.
+    - On columnar topics the transform is a frame PASS-THROUGH:
+      K_SEQ_OP / K_NACK rows re-emit as `ColumnarRecords` slices with
+      only the inOff column rewritten — no decode, no re-encode, blob
+      bytes ride untouched (`record_batch.ColumnarRecords.from_batch`).
+    - Recovery generalizes the single-topic contract: the fence binds
+      on BOTH topics, each topic's durable prefix scans independently,
+      the gap replays silently once, and each topic gets exactly its
+      missing suffix re-emitted (the durable leg appends first in
+      steady state, so the broadcast leg is the one that usually
+      trails a crash).
+
+    In wire-trace mode one clock read stamps both `dur` and `bc` and
+    feeds the same stage histograms + slow-op flight recorder the
+    split roles fed."""
+
+    name = "scriptorium_broadcaster"
+    in_topic_name = "deltas"
+    out_topic_name = "durable"
+    ingest_batches = True  # columnar pass-through wants whole frames
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.bc_topic = make_topic(
+            _topic_path(self.shared_dir, "broadcast"), self.log_format
+        )
+        self._bc_out: List[Any] = []
+        from .columnar_log import ColumnarFileTopic
+
+        self.out_columnar = isinstance(self.out_topic, ColumnarFileTopic)
+
+    # ------------------------------------------------------------- pump
+
+    def process(self, line_idx: int, rec: Any, out: List[dict]) -> None:
+        if not isinstance(rec, dict) or rec.get("kind") not in (
+            "op", "nack"
+        ):
+            return
+        rec2 = {**{k: v for k, v in rec.items() if k != "inOff"},
+                "inOff": line_idx}
+        tr = rec.get("tr")
+        if self.trace_wire and isinstance(tr, dict):
+            now = time.time()
+            # One clock read serves both stage stamps (the fused hop
+            # IS one instant) and every observation below.
+            rec2["tr"] = {**tr, "dur": now, "bc": now}
+            if not self._recovering:
+                stamp = tr.get("stamp")
+                if isinstance(stamp, (int, float)):
+                    ms = (now - stamp) * 1000.0
+                    self._observe_stage("stamp_to_durable", ms)
+                    self._observe_stage("stamp_to_broadcast", ms)
+                sub = tr.get("sub")
+                if isinstance(sub, (int, float)):
+                    e2e = (now - sub) * 1000.0
+                    self._observe_stage("submit_to_broadcast", e2e)
+                    from ..utils.metrics import get_flight_recorder
+
+                    fr = get_flight_recorder()
+                    if fr.note(e2e):
+                        fr.add(e2e, {
+                            "doc": rec.get("doc"), "seq": rec.get("seq"),
+                            "client": rec.get("client"),
+                            "clientSeq": rec.get("clientSeq"),
+                            "stages": rec2["tr"],
+                        })
+        if rec.get("kind") == "op":
+            out.append(rec2)
+        # Broadcast carries ops AND nacks; the very same dict object
+        # rides both legs (no per-leg rebuild).
+        self._bc_out.append(rec2)
+
+    def process_batch(self, start_line: int, batch: Any,
+                      out: List[dict]) -> None:
+        """Columnar ingest: pass K_SEQ_OP/K_NACK spans through as
+        column slices (durable takes the seq-ops, broadcast takes
+        both), decode only generic strays — in stream order, so the
+        spliced output frames carry records exactly where the split
+        roles would have."""
+        if (not self.out_columnar or self.trace_wire
+                or self._recovering or self._dict_emit):
+            for i in range(batch.n):
+                self.process(start_line + i, batch.record(i), out)
+            return
+        import numpy as np
+
+        from ..protocol import record_batch as _rb
+
+        n = batch.n
+        if n == 0:
+            return
+        kind = batch.kind
+        is_pass = (kind == _rb.K_SEQ_OP) | (kind == _rb.K_NACK)
+        for run_pass, lo, hi in _rb.mask_runs(is_pass):
+            if not run_pass:
+                for i in range(lo, hi):
+                    self.process(start_line + i, batch.record(i), out)
+                continue
+            rows = np.arange(lo, hi)
+            offs = np.arange(start_line + lo, start_line + hi,
+                             dtype=np.int64)
+            self._bc_out.append(
+                _rb.ColumnarRecords.from_batch(batch, rows, offs)
+            )
+            ops = kind[lo:hi] == _rb.K_SEQ_OP
+            if ops.all():
+                out.append(self._bc_out[-1])  # same object, both legs
+            elif ops.any():
+                out.append(_rb.ColumnarRecords.from_batch(
+                    batch, rows[ops], offs[ops]
+                ))
+
+    def _append_outputs(self, out: List[Any]) -> int:
+        # Durable first (fsync, the base append), broadcast second
+        # (unfsynced): a crash between the legs leaves broadcast
+        # trailing, which recovery's per-topic scan closes. Each leg
+        # owns its retry budget — a retry must never re-append the leg
+        # that already landed.
+        n = super()._append_outputs(out)
+        bc, self._bc_out = self._bc_out, []
+        n += self._durable(lambda: self.bc_topic.append_many(
+            bc, fence=self.fence, owner=self.owner, fsync=False
+        ))
+        return n
+
+    # --------------------------------------------------------- recovery
+
+    def _recover_inner(self) -> None:
+        env = self.ckpt.load(self.name)
+        self.offset = 0
+        if env is not None:
+            st = env["state"]
+            self.offset = int(st.get("offset", 0))
+            self.restore_state(st.get("state"))
+        else:
+            self.restore_state(None)
+        self._bc_out = []
+        # Bind our fence on BOTH output topics before scanning either:
+        # a deposed predecessor's in-flight append to either leg is
+        # rejected from here on.
+        self._durable(lambda: self.out_topic.append_many(
+            [], fence=self.fence, owner=self.owner
+        ))
+        self._durable(lambda: self.bc_topic.append_many(
+            [], fence=self.fence, owner=self.owner
+        ))
+        done_d = self._durable_done_counts(self.out_topic)
+        done_b = self._durable_done_counts(self.bc_topic)
+        if not done_d and not done_b:
+            return
+        max_done = max(list(done_d) + list(done_b))
+        gap, next_off = self.in_topic.read_entries(self.offset)
+        sink: List[dict] = []
+        for line_idx, rec in gap:
+            if line_idx > max_done:
+                next_off = line_idx
+                break
+            self.process(line_idx, rec, sink)  # silent: already durable
+        else:
+            next_off = max(self.offset, max_done + 1, next_off)
+        self.flush_batch(sink)
+        bc_sink, self._bc_out = self._bc_out, []
+        # Per-leg tail: everything past that leg's own durable prefix
+        # (its max_done's clipped suffix, plus whole inputs the other
+        # leg reached first). Records sit in `snk` in input order, so
+        # the concatenation preserves stream order.
+        for topic, snk, done, fs in (
+            (self.out_topic, sink, done_d, True),
+            (self.bc_topic, bc_sink, done_b, False),
+        ):
+            if done:
+                md = max(done)
+                tail = [r for r in snk if r.get("inOff") == md]
+                tail = tail[done.get(md, 0):]
+                tail += [r for r in snk if r.get("inOff", -1) > md]
+            else:
+                tail = list(snk)
+            if tail:
+                self._durable(lambda t=topic, x=tail, f=fs:
+                              t.append_many(x, fence=self.fence,
+                                            owner=self.owner, fsync=f))
+        self.offset = next_off
+        self._reader = None  # re-anchor the tail at the new offset
+        self.checkpoint()
+
+
 class ScribeRole(_Role):
     """Protocol-state folder: deltas → per-doc rolling digest + head
     seq (the scribe/summary role). Its output IS its checkpoint, and
@@ -964,10 +1188,28 @@ class ScribeRole(_Role):
 
 ROLE_CLASSES = {
     cls.name: cls
-    for cls in (DeliRole, ScriptoriumRole, ScribeRole, BroadcasterRole)
+    for cls in (DeliRole, ScriptoriumRole, ScribeRole, BroadcasterRole,
+                ScriptoriumBroadcasterRole)
 }
 
 DELI_IMPLS = ("scalar", "kernel")
+
+
+def fused_roles(roles: Tuple[str, ...]) -> Tuple[str, ...]:
+    """`roles` with the scriptorium+broadcaster pair collapsed into
+    the fused durable+broadcast consumer (order preserved, the fused
+    role at the first of the pair's positions)."""
+    out: List[str] = []
+    for r in roles:
+        if r in ("scriptorium", "broadcaster"):
+            if ScriptoriumBroadcasterRole.name not in out:
+                out.append(ScriptoriumBroadcasterRole.name)
+        else:
+            out.append(r)
+    return tuple(out)
+
+
+FUSED_PIPELINE_ROLES = fused_roles(PIPELINE_ROLES)
 
 
 def resolve_role_class(role: str, deli_impl: str = "scalar"):
@@ -1104,7 +1346,8 @@ class ServiceSupervisor:
                  deli_devices: Optional[int] = None,
                  child_env: Optional[Dict[str, str]] = None,
                  hb_interval_s: Optional[float] = None,
-                 summary_ops: Optional[int] = None):
+                 summary_ops: Optional[int] = None,
+                 fused_hop: bool = False):
         """`child_env` adds/overrides spawn-environment variables for
         every child (the chaos harness's seam: it points CHILDREN at a
         disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
@@ -1113,7 +1356,14 @@ class ServiceSupervisor:
         cadence; forced heartbeats always bypass the throttle).
         `summary_ops` sets the summarizer child's emission cadence
         (records per doc between summaries; None keeps the role
-        default / ``FLUID_SUMMARY_OPS``)."""
+        default / ``FLUID_SUMMARY_OPS``). `fused_hop` collapses the
+        scriptorium+broadcaster pair in `roles` into the fused
+        durable+broadcast consumer (`ScriptoriumBroadcasterRole`) —
+        same topics, same records, one fewer process wake and fsync
+        per batch on the downstream hop pair."""
+        if fused_hop:
+            roles = fused_roles(tuple(roles))
+        self.fused_hop = bool(fused_hop)
         self.shared_dir = shared_dir
         self.child_env = dict(child_env or {})
         self.hb_interval_s = hb_interval_s
@@ -1442,7 +1692,8 @@ class ServiceSupervisor:
             ok = ok and alive and not stale and not limping
         return {"status": "ok" if ok else "degraded", "roles": roles,
                 "deli_impl": self.deli_impl,
-                "log_format": self.log_format}
+                "log_format": self.log_format,
+                "fused_hop": self.fused_hop}
 
     def _hb_field(self, role: str, key: str) -> Any:
         """One field off `role`'s last heartbeat (None if absent)."""
@@ -1526,7 +1777,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     devices_s = _take("--deli-devices")
     hb_interval_s = _take("--hb-interval")
     summary_ops_s = _take("--summary-ops")
-    if (role not in ROLES or shared_dir is None
+    if (role not in ROLES + (ScriptoriumBroadcasterRole.name,)
+            or shared_dir is None
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
             or (partition_s is not None and not partition_s.isdigit())
@@ -1535,7 +1787,8 @@ def main(argv: Optional[List[str]] = None) -> None:
                 and not summary_ops_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
-            "--role {deli|scriptorium|scribe|broadcaster|summarizer} "
+            "--role {deli|scriptorium|scribe|broadcaster|summarizer"
+            "|scriptorium_broadcaster} "
             "--dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "

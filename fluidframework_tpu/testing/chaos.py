@@ -152,6 +152,15 @@ class ChaosConfig:
     # (`summarizer.state_digest`). Classic single-partition farm only.
     summarizer: bool = False
     summary_ops: int = 32
+    # Fused durable+broadcast hop (`supervisor.
+    # ScriptoriumBroadcasterRole`): the scriptorium+broadcaster pair
+    # collapses into ONE supervised consumer (durable leg fsynced,
+    # broadcast leg unfsynced-but-recoverable). Kill faults then
+    # target the fused role; convergence still reads the same durable
+    # + broadcast topics, so a converging run proves the fused hop
+    # bit-identical to the split pair under the same faults. Classic
+    # single-partition farm only (the fabric has no downstream pair).
+    fused_hop: bool = False
 
 
 @dataclass
@@ -391,6 +400,14 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
     unknown = set(cfg.faults) - set(ALL_FAULT_CLASSES)
     if unknown:
         raise ValueError(f"unknown fault classes {sorted(unknown)}")
+    if cfg.fused_hop and cfg.n_partitions > 1:
+        # The fabric's workers run deli pipelines only — there is no
+        # scriptorium/broadcaster pair to fuse, and accepting the flag
+        # would print a fused-hop verdict nothing exercised.
+        raise ValueError(
+            "fused_hop=True runs on the classic single-partition farm "
+            "(the sharded fabric has no downstream stage pair)"
+        )
     if cfg.summarizer and cfg.n_partitions > 1:
         # The per-partition summarizer rides ShardWorker(summarize=)
         # on the STATIC fabric; the chaos gate for it is a follow-up —
@@ -477,15 +494,17 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     gscribe = golden_scribe_digests(golden, os.path.join(shared, "golden"))
     expected = len(golden)
 
-    kill_targets = ["deli", "scriptorium", "scribe", "broadcaster"]
     roles = PIPELINE_ROLES
+    if cfg.fused_hop:
+        from ..server.supervisor import FUSED_PIPELINE_ROLES
+
+        roles = FUSED_PIPELINE_ROLES
+    kill_targets = list(roles)
     if cfg.summarizer:
         # Fifth role: the summary service, killed like any other —
         # restarts must re-emit byte-identical manifests, never fork.
         kill_targets.append("summarizer")
-        from ..server.supervisor import ROLES as _ALL_ROLES
-
-        roles = _ALL_ROLES
+        roles = tuple(roles) + ("summarizer",)
     chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
         cfg, rng, workload, tuple(kill_targets),
     )
@@ -497,6 +516,7 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         deli_devices=cfg.deli_devices,
         child_env={"FLUID_TRACE_WIRE": "1"} if cfg.trace_wire else None,
         summary_ops=cfg.summary_ops if cfg.summarizer else None,
+        fused_hop=cfg.fused_hop,
     ).start()
     raw = make_topic(os.path.join(shared, "topics", "rawdeltas.jsonl"),
                      cfg.log_format)

@@ -119,76 +119,82 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
             if os.path.exists(out_path + side):
                 os.remove(out_path + side)
     deltas = make_topic(out_path, log_format)
-    # Isolated registry: this run's checkpoint/pump counters are not
-    # polluted by (and do not pollute) other runs in the process.
+    # Isolated registry: this run's checkpoint/pump/codec/fsync
+    # counters are not polluted by (and do not pollute) other runs in
+    # the process. The registry stays swapped in for the whole timed
+    # loop so the emit-side evidence (encode-columns records, topic
+    # fsyncs) lands here too.
+    from fluidframework_tpu.protocol.record_batch import count_records
+
     reg = _metrics.MetricsRegistry()
     prev_reg = _metrics.set_registry(reg)
     try:
         role = _make_role(impl, os.path.join(out_dir, f"scratch-{impl}"),
                           log_format, deli_devices)
+        # The bench drives the role datapath directly (no lease loop);
+        # bind a fence so fenced checkpoint writes work.
+        role.fence = 1
+        reader = make_tail_reader(raw)
+        # The kernel role's columnar fast path: whole RecordBatch
+        # frames (max_records runs keep the exact per-record cap).
+        use_batches = (role.ingest_batches and max_records is None
+                       and hasattr(reader, "poll_batches"))
+        n_records = 0
+        n_out = 0
+        t_poll = t_proc = t_append = t_ckpt = 0.0
+        t0 = time.perf_counter()
+        while True:
+            cap = batch
+            if max_records is not None:
+                cap = min(cap, max_records - n_records)
+                if cap <= 0:
+                    break
+            t1 = time.perf_counter()
+            if use_batches:
+                units = reader.poll_batches(cap)
+                entries = None
+                moved = sum(u[2].n if u[0] == "batch" else 1
+                            for u in units)
+            else:
+                entries = reader.poll(cap)
+                moved = len(entries)
+            t2 = time.perf_counter()
+            t_poll += t2 - t1
+            if not moved:
+                break
+            out: List[dict] = []
+            if use_batches:
+                for u in units:
+                    if u[0] == "batch":
+                        role.process_batch(u[1], u[2], out)
+                    else:
+                        role.process(u[1], u[2], out)
+            else:
+                for line_idx, rec in entries:
+                    role.process(line_idx, rec, out)
+            role.flush_batch(out)
+            t3 = time.perf_counter()
+            t_proc += t3 - t2
+            if per_record_append:
+                for r in out:  # the seed pipeline: one lock+fsync each
+                    role._ckpt_pending_bytes += deltas.append(r)
+            else:
+                role._ckpt_pending_bytes += deltas.append_many(out)
+            t4 = time.perf_counter()
+            t_append += t4 - t3
+            role.offset = reader.next_line
+            if checkpoint_mode is not None:
+                role._ckpt_dirty = True
+                if checkpoint_mode == "pump":
+                    role.checkpoint()
+                else:
+                    role.maybe_checkpoint()
+                t_ckpt += time.perf_counter() - t4
+            n_records += moved
+            n_out += count_records(out)
+        seconds = time.perf_counter() - t0
     finally:
         _metrics.set_registry(prev_reg)
-    # The bench drives the role datapath directly (no lease loop);
-    # bind a fence so fenced checkpoint writes work.
-    role.fence = 1
-    reader = make_tail_reader(raw)
-    # The kernel role's columnar fast path: whole RecordBatch frames
-    # (max_records runs keep the exact per-record cap instead).
-    use_batches = (role.ingest_batches and max_records is None
-                   and hasattr(reader, "poll_batches"))
-    n_records = 0
-    n_out = 0
-    t_poll = t_proc = t_append = t_ckpt = 0.0
-    t0 = time.perf_counter()
-    while True:
-        cap = batch
-        if max_records is not None:
-            cap = min(cap, max_records - n_records)
-            if cap <= 0:
-                break
-        t1 = time.perf_counter()
-        if use_batches:
-            units = reader.poll_batches(cap)
-            entries = None
-            moved = sum(u[2].n if u[0] == "batch" else 1 for u in units)
-        else:
-            entries = reader.poll(cap)
-            moved = len(entries)
-        t2 = time.perf_counter()
-        t_poll += t2 - t1
-        if not moved:
-            break
-        out: List[dict] = []
-        if use_batches:
-            for u in units:
-                if u[0] == "batch":
-                    role.process_batch(u[1], u[2], out)
-                else:
-                    role.process(u[1], u[2], out)
-        else:
-            for line_idx, rec in entries:
-                role.process(line_idx, rec, out)
-        role.flush_batch(out)
-        t3 = time.perf_counter()
-        t_proc += t3 - t2
-        if per_record_append:
-            for r in out:  # the seed pipeline: one lock+fsync each
-                role._ckpt_pending_bytes += deltas.append(r)
-        else:
-            role._ckpt_pending_bytes += deltas.append_many(out)
-        t4 = time.perf_counter()
-        t_append += t4 - t3
-        role.offset = reader.next_line
-        if checkpoint_mode is not None:
-            role._ckpt_dirty = True
-            if checkpoint_mode == "pump":
-                role.checkpoint()
-            else:
-                role.maybe_checkpoint()
-            t_ckpt += time.perf_counter() - t4
-        n_records += moved
-        n_out += len(out)
-    seconds = time.perf_counter() - t0
     ckpt = {
         "writes": int(reg.counter(
             "checkpoint_writes_total", role="deli").value),
@@ -196,6 +202,16 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
             "checkpoint_bytes_total", role="deli").value),
         "seconds": round(t_ckpt, 4),
         "mode": checkpoint_mode,
+    }
+    # Emit-side codec evidence (the pre-columnized emission tentpole):
+    # how many output records rode `encode_columns` (zero per-record
+    # classification) and the run's topic-fsync floor per record.
+    fsyncs = int(reg.counter("topic_fsyncs_total", kind="topic").value)
+    emit = {
+        "codec_encode_columns_records": int(reg.counter(
+            "codec_encode_columns_total", codec="columnar").value),
+        "topic_fsyncs": fsyncs,
+        "fsyncs_per_record": round(fsyncs / max(1, n_records), 6),
     }
     return {"seconds": seconds, "records": n_records, "outputs": n_out,
             "out_path": out_path,
@@ -205,7 +221,7 @@ def run_pipeline(impl: str, raw_path: str, out_dir: str,
                 "append_s": round(t_append, 4),
                 "checkpoint_s": round(t_ckpt, 4),
             },
-            "metrics": {"checkpoint": ckpt}}
+            "metrics": {"checkpoint": ckpt, "emit": emit}}
 
 
 def _read_canonical(path: str) -> List[dict]:
@@ -322,6 +338,11 @@ def run_pipeline_bench(n_docs: int = 10_000, n_clients: int = 64,
                 col_ops / scalar_ops, 2
             ),
             "columnar_stage_breakdown": kern_col["stages"],
+            # Emit-side evidence: records through `encode_columns`
+            # (the pre-columnized emission — per-record Python
+            # eliminated on the columnar kernel path) and the
+            # fsyncs-per-record floor of the columnar run.
+            "columnar_emit_codec": kern_col["metrics"]["emit"],
             # Per-stage wall-time breakdown of the timed kernel run
             # (where a sequenced record's time goes inside the pump).
             "stage_breakdown": kern["stages"],
@@ -856,6 +877,130 @@ def run_rebalance_bench(n_docs: int = 10_000, n_clients: int = 64,
 # ---------------------------------------------------------------------------
 # summary catch-up bench (config10_catchup's engine)
 # ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# fused durable+broadcast hop bench (the per-hop fsync floor)
+# ---------------------------------------------------------------------------
+
+
+def _snap_counter(snap: dict, name: str, **labels) -> float:
+    """Sum one counter family across a heartbeat metrics snapshot."""
+    total = 0.0
+    for c in snap.get("counters", ()):
+        if c.get("name") != name:
+            continue
+        lbl = c.get("labels") or {}
+        if all(lbl.get(k) == v for k, v in labels.items()):
+            total += float(c.get("value", 0))
+    return total
+
+
+def run_hop_bench(n_docs: int = 64, n_clients: int = 8,
+                  ops_per_client: int = 4,
+                  log_format: str = "columnar",
+                  deli_impl: str = "kernel",
+                  timeout_s: float = 180.0) -> dict:
+    """Classic vs FUSED downstream topology over ONE pre-staged
+    workload: records cross deli → durable → broadcast either through
+    the split {scriptorium, broadcaster} pair (two consumers — two
+    process wakes and two fsyncs per batch on the hop pair) or through
+    the fused `ScriptoriumBroadcasterRole` (one consumer — one wake,
+    ~one fsync: the broadcast leg appends unfsynced and recovery
+    regenerates it). Reports each topology's drain throughput and the
+    hop pair's fsyncs-per-record (read from the children's heartbeat
+    metrics — the `topic_fsyncs_total` evidence), and GATES
+    bit-identity: both topologies must produce identical durable and
+    broadcast streams."""
+    from ..server.columnar_log import make_topic
+    from ..server.supervisor import ServiceSupervisor
+
+    workload = build_pipeline_workload(n_docs, n_clients, ops_per_client)
+    expected = len(workload)  # every join/op in this workload stamps
+    per_mode: Dict[str, dict] = {}
+    streams: Dict[str, tuple] = {}
+    for mode in ("split", "fused"):
+        shared = tempfile.mkdtemp(prefix=f"hop-bench-{mode}-")
+        sup = ServiceSupervisor(
+            shared, roles=("deli", "scriptorium", "broadcaster"),
+            ttl_s=2.0, heartbeat_timeout_s=20.0, batch=4096,
+            deli_impl=deli_impl, log_format=log_format,
+            fused_hop=(mode == "fused"), hb_interval_s=0.2,
+        ).start()
+        try:
+            topics = {
+                name: make_topic(
+                    os.path.join(shared, "topics", f"{name}.jsonl"),
+                    log_format,
+                )
+                for name in ("rawdeltas", "durable", "broadcast")
+            }
+            t0 = time.perf_counter()
+            for lo in range(0, expected, 4096):
+                topics["rawdeltas"].append_many(workload[lo:lo + 4096])
+            deadline = time.time() + timeout_s
+            dur = bc = []
+            while time.time() < deadline:
+                sup.poll_once()
+                dur = topics["durable"].read_from(0)
+                bc = topics["broadcast"].read_from(0)
+                if len(dur) >= expected and len(bc) >= expected:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(
+                    f"hop bench ({mode}) never drained: "
+                    f"{len(dur)}/{len(bc)} of {expected}"
+                )
+            seconds = time.perf_counter() - t0
+            time.sleep(0.5)  # one post-drain throttled heartbeat each
+            snaps = sup.child_metrics()
+        finally:
+            sup.stop()
+            shutil.rmtree(shared, ignore_errors=True)
+        hop_fsyncs = sum(
+            _snap_counter(snaps[r], "topic_fsyncs_total", kind="topic")
+            for r in snaps if r != "deli"
+        )
+        per_mode[mode] = {
+            "seconds": round(seconds, 3),
+            "ops_per_sec": round(expected / seconds, 1),
+            "hop_pair_fsyncs": int(hop_fsyncs),
+            "hop_pair_fsyncs_per_record": round(
+                hop_fsyncs / expected, 4
+            ),
+            "downstream_consumers": len(snaps) - 1,
+            "emit_columns_records": int(sum(
+                _snap_counter(snaps[r], "codec_encode_columns_total")
+                for r in snaps
+            )),
+        }
+        streams[mode] = (dur, bc)
+    # Bit-identity gate: the fused hop must carry EXACTLY the split
+    # pair's records, in order, on both legs.
+    assert streams["split"][0] == streams["fused"][0], (
+        "durable streams diverge between split and fused topologies"
+    )
+    assert streams["split"][1] == streams["fused"][1], (
+        "broadcast streams diverge between split and fused topologies"
+    )
+    split_f = per_mode["split"]["hop_pair_fsyncs"]
+    fused_f = per_mode["fused"]["hop_pair_fsyncs"]
+    return {
+        "metric": "fused_hop_farm",
+        "records": expected,
+        "log_format": log_format,
+        "deli_impl": deli_impl,
+        "split": per_mode["split"],
+        "fused": per_mode["fused"],
+        "hop_fsync_reduction": round(split_f / max(1, fused_f), 2),
+        "fused_vs_split_ops": round(
+            per_mode["fused"]["ops_per_sec"]
+            / per_mode["split"]["ops_per_sec"], 2
+        ),
+        "gate": "bit-identical",
+        "unit": "fsyncs/record",
+    }
 
 
 def build_mergetree_stream(n_ops: int, n_clients: int = 4,
@@ -1529,6 +1674,20 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             summary_ops=int(os.environ.get("BD_SUMMARY_OPS", "2000")),
             n_subscribers=int(os.environ.get("BD_SUBSCRIBERS", "200")),
             log_format=os.environ.get("BD_LOG_FORMAT", "json"),
+        )
+        print(json.dumps(res))
+        return
+    if os.environ.get("BD_HOPS"):
+        # Fused-hop mode (tools/bench_deli.py --hops): classic vs
+        # fused durable+broadcast consumer topology — drain rate,
+        # hop-pair fsyncs per record, bit-identity gated.
+        res = run_hop_bench(
+            n_docs=max(8, int(int(os.environ.get("BD_DOCS", "64"))
+                              * scale)),
+            n_clients=int(os.environ.get("BD_CLIENTS", "8")),
+            ops_per_client=int(os.environ.get("BD_OPS", "4")),
+            log_format=os.environ.get("BD_LOG_FORMAT", "columnar"),
+            deli_impl=os.environ.get("BD_IMPL", "kernel"),
         )
         print(json.dumps(res))
         return

@@ -351,13 +351,21 @@ def config5_log_format(n_docs: int = 10_000, n_clients: int = 16,
     format damps I/O jitter; FAILS LOUDLY (AssertionError) if the
     binary record-batch log ever drops below `min_ratio` x the JSON
     log — the moment a codec hot-path regression lands, the bench
-    harness says so."""
+    harness says so.
+
+    Two gates run on EVERY host regardless of the timing outcome:
+    (1) bit-identity — the columnar run's deltas must decode to
+    exactly the JSON run's records; (2) the columnar run must have
+    taken the pre-columnized EMIT path (`codec_encode_columns_total`
+    covering every output record) — a silent fallback to dict-path
+    emission would invalidate the very number this guard protects."""
     import shutil
     import tempfile
 
     from fluidframework_tpu.server.columnar_log import make_topic
     from fluidframework_tpu.server.queue import SharedFileTopic
     from fluidframework_tpu.testing.deli_bench import (
+        _read_canonical,
         build_pipeline_workload,
         run_pipeline,
     )
@@ -375,15 +383,34 @@ def config5_log_format(n_docs: int = 10_000, n_clients: int = 16,
             col.append_many(workload[lo:lo + 16384])
         run_pipeline("kernel", raw_json, scratch)  # jit warm-up
 
+        last: dict = {}
+
         def best(fmt: str, path: str) -> float:
-            return min(
-                run_pipeline("kernel", path, scratch,
-                             log_format=fmt)["seconds"]
+            runs = [
+                run_pipeline("kernel", path, scratch, log_format=fmt)
                 for _ in range(attempts)
-            )
+            ]
+            last[fmt] = runs[-1]
+            return min(r["seconds"] for r in runs)
 
         t_json = best("json", raw_json)
         t_col = best("columnar", raw_col)
+        # Bit-identity gate (EVERY host): same stamps/nacks/MSNs
+        # through both wire forms.
+        a = _read_canonical(last["json"]["out_path"])
+        b = _read_canonical(last["columnar"]["out_path"])
+        assert a == b, (
+            f"columnar deltas diverge from JSON deltas "
+            f"({len(a)} vs {len(b)} records)"
+        )
+        # Emit-path gate (EVERY host): the columnar run must emit
+        # through encode_columns, covering all its output records.
+        emit = last["columnar"]["metrics"]["emit"]
+        assert emit["codec_encode_columns_records"] >= \
+            last["columnar"]["outputs"], (
+                f"columnar run fell back to dict-path emission: "
+                f"{emit} vs {last['columnar']['outputs']} outputs"
+            )
         ratio = t_json / t_col
         result = {
             "config": "deli_pipeline_log_format_guard",
@@ -391,7 +418,9 @@ def config5_log_format(n_docs: int = 10_000, n_clients: int = 16,
             "json_ops_per_sec": round(len(workload) / t_json, 1),
             "columnar_ops_per_sec": round(len(workload) / t_col, 1),
             "columnar_vs_json": round(ratio, 2),
+            "emit_codec": emit,
             "min_ratio": min_ratio,
+            "gate": "bit-identical + columns-emitted",
         }
         assert ratio >= min_ratio, (
             f"columnar op-log regressed to {ratio:.2f}x the JSON log "
@@ -611,10 +640,26 @@ def config9_latency(min_p99_improvement: float = 3.0,
         f"{chaos.detail}"
     )
     assert chaos.duplicate_seqs == 0 and chaos.skipped_seqs == 0
+    # The FUSED durable+broadcast hop must survive the same kill
+    # schedule bit-identically (its broadcast leg is unfsynced — this
+    # is the gate that proves recovery regenerates it exactly-once).
+    # Runs on EVERY host, like the bit-identity gates above.
+    chaos_fused = run_chaos(ChaosConfig(
+        seed=9, faults=("kill", "torn"), n_docs=2, n_clients=3,
+        ops_per_client=30, timeout_s=240.0, fused_hop=True,
+        log_format="columnar", deli_impl="kernel",
+    ))
+    assert chaos_fused.converged, (
+        f"chaos kill+torn run on the FUSED hop diverged: "
+        f"{chaos_fused.detail}"
+    )
+    assert chaos_fused.duplicate_seqs == 0 \
+        and chaos_fused.skipped_seqs == 0
     result = {
         "config": "latency_slo_guard",
         "min_p99_improvement": min_p99_improvement,
         "chaos_kill_converged": True,
+        "chaos_fused_hop_converged": True,
         "chaos_restarts": chaos.restarts,
         "wake_jitter_probe_ms": probe,
         **res,
@@ -721,6 +766,31 @@ def config10_catchup(min_speedup: float = 10.0,
     return result
 
 
+def config11_fused_hop(min_reduction: float = 1.5) -> dict:
+    """Fused durable+broadcast hop guard (ROADMAP item 1's per-hop
+    floor): the fused consumer must cut the hop pair's fsyncs by at
+    least `min_reduction` x vs the split scriptorium+broadcaster pair
+    over the same workload. The number is COUNT-based (fsyncs per
+    record off the children's heartbeat counters, not wall time), so
+    the guard runs honestly on every host — no core-count skip — and
+    `run_hop_bench` internally gates both topologies' durable and
+    broadcast streams bit-identical before reporting anything."""
+    from fluidframework_tpu.testing.deli_bench import run_hop_bench
+
+    res = run_hop_bench(
+        n_docs=max(8, int(64 * SCALE)), n_clients=8, ops_per_client=4,
+        log_format="columnar", deli_impl="kernel",
+    )
+    result = {"config": "fused_hop_farm",
+              "min_reduction": min_reduction, **res}
+    assert res["hop_fsync_reduction"] >= min_reduction, (
+        f"fused hop cut hop-pair fsyncs only "
+        f"{res['hop_fsync_reduction']:.2f}x (must be >= "
+        f"{min_reduction}x): {result}"
+    )
+    return result
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -801,7 +871,7 @@ def main() -> None:
                config5_metrics_overhead, config5_log_format,
                config6_shard_scaling, config7_multichip,
                config8_rebalance, config9_latency, config10_catchup,
-               config_streaming_ingress):
+               config11_fused_hop, config_streaming_ingress):
         r = fn()
         results.append(r)
         print(json.dumps(r), file=sys.stderr)

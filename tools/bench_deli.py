@@ -70,8 +70,15 @@ op tail (`server.summarizer`), bit-identity gated at every length —
 plus broadcast fan-out to hundreds of subscribed readers through the
 doorbell-woken read front end.
 
+`--hops` switches to the FUSED-HOP mode
+(`testing.deli_bench.run_hop_bench`): the classic
+{scriptorium, broadcaster} pair vs the fused durable+broadcast
+consumer over one workload — drain ops/s, the hop pair's
+fsyncs-per-record, and the `hop_fsync_reduction` headline, with both
+topologies' durable+broadcast streams gated bit-identical.
+
 Usage: python tools/bench_deli.py
-    [--shard | --devices [LIST] | --latency | --catchup]
+    [--shard | --devices [LIST] | --latency | --catchup | --hops]
 """
 
 from __future__ import annotations
@@ -89,6 +96,18 @@ os.environ.setdefault(
 
 if "--shard" in sys.argv:
     os.environ["BD_SHARD"] = "1"
+
+if "--hops" in sys.argv:
+    # Fused-hop mode: classic {scriptorium, broadcaster} pair vs the
+    # fused durable+broadcast consumer
+    # (supervisor.ScriptoriumBroadcasterRole) over one workload —
+    # drain ops/s per topology, the hop pair's fsyncs-per-record
+    # (topic_fsyncs_total off the children's heartbeat metrics), and
+    # the split/fused `hop_fsync_reduction` headline; both topologies'
+    # durable+broadcast streams gated bit-identical. Env knobs:
+    # BD_DOCS (64), BD_CLIENTS (8), BD_OPS (4), BD_LOG_FORMAT
+    # (columnar), BD_IMPL (kernel).
+    os.environ["BD_HOPS"] = "1"
 
 if "--catchup" in sys.argv:
     # Summary catch-up mode: cold-join latency vs log length with and

@@ -49,6 +49,7 @@ HEADLINE_FIELDS = (
     "op_rebases_per_sec",
     "speedup",                  # scaling benches (ratio)
     "columnar_vs_json",         # log-format guard (ratio)
+    "hop_fsync_reduction",      # fused durable+broadcast hop (ratio)
 )
 
 

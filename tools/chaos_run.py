@@ -6,7 +6,15 @@ Run: python tools/chaos_run.py --seed N
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH] [--trace-wire]
         [--partitions N] [--workers W] [--devices N] [--elastic]
-        [--summarizer] [--summary-ops N]
+        [--summarizer] [--summary-ops N] [--fused-hop]
+
+`--fused-hop` collapses the scriptorium+broadcaster pair into the ONE
+fused durable+broadcast consumer
+(`supervisor.ScriptoriumBroadcasterRole`): kill faults then target the
+fused role, and convergence (the same durable+broadcast topic reads)
+proves the fused hop — durable leg fsynced, broadcast leg unfsynced —
+bit-identical to the split pair with zero dup/skip under the same
+faults. Classic single-partition farm only.
 
 `--summarizer` runs the summary service (`server.summarizer`) as a
 fifth supervised role, includes it in the kill schedule, and extends
@@ -129,6 +137,9 @@ def main() -> int:
     summarizer = "--summarizer" in args
     if summarizer:
         args.remove("--summarizer")
+    fused_hop = "--fused-hop" in args
+    if fused_hop:
+        args.remove("--fused-hop")
     summary_ops = int(_take("--summary-ops", "32"))
     if faults_arg is None:
         # Default fault set: the classic classes the chosen runner
@@ -160,6 +171,7 @@ def main() -> int:
         trace_wire=trace_wire,
         summarizer=summarizer,
         summary_ops=summary_ops,
+        fused_hop=fused_hop,
     )
     unknown = set(faults) - set(ALL_FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
@@ -183,7 +195,7 @@ def main() -> int:
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
           f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl} "
           f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}"
-          f"{shard}{dev}",
+          f"{shard}{dev}{' fused-hop' if cfg.fused_hop else ''}",
           flush=True)
     res = run_chaos(cfg)
     print(f"golden digest : {res.golden_digest}")
