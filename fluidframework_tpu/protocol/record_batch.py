@@ -17,6 +17,9 @@ per FRAME, so one file mixes both freely):
     | u32 payload_len | u32 crc32(payload) | i64 fence
     payload:
       u16 owner_len + owner utf-8           (fence stamp's owner)
+      [u16 src_len + src utf-8]             (iff flags & FLAG_SRC: the
+                                             frame-level ``inSrc`` tag
+                                             — see below)
       u32 n_docs + (u16 len + utf-8) * n    (batch-local doc dictionary)
       u8  kind[n]        (K_* codes below)
       u8  type_code[n]   (MessageType table index; 255 = n/a)
@@ -49,6 +52,18 @@ lossless over arbitrary JSON values):
                   blob = reason
     K_GENERIC    anything else        blob = full record
 
+Raw kinds may ADDITIONALLY carry an ``inOff`` key (the supervised
+ingress front door stamps its input offset onto every admitted
+record — `server.ingress`): it rides the existing ``in_off`` column
+(-1 = absent), so an admission-stamped submit keeps the columnar fast
+path instead of falling to K_GENERIC.
+
+The ``FLAG_SRC`` frame flag carries a frame-level ``inSrc`` string
+(the elastic fabric's predecessor-drain tag, `server.shard_fabric`):
+every record decoded out of a src-tagged frame gains ``"inSrc": src``
+— one tag per frame instead of one generic-schema dict per record, so
+a ranged role's pred drains keep the `encode_columns` emit fast path.
+
 The EMIT half mirrors the ingest half: `ColumnarRecords` is a batch of
 already-columnized records (flat int columns + a blob heap — what the
 kernel deli's verdict gather produces), and `encode_columns` turns one
@@ -79,6 +94,7 @@ from .messages import MessageType
 __all__ = [
     "ColumnarRecords",
     "DEFAULT_VERSION",
+    "FLAG_SRC",
     "HEADER",
     "JsonBlob",
     "K_GENERIC",
@@ -113,6 +129,11 @@ SCHEMA_VERSIONS = (1, 2)
 # json⇄columnar rule, one rung smaller).
 DEFAULT_VERSION = 2
 HEADER = struct.Struct("<4sBBIIIq")  # magic, ver, flags, n, plen, crc, fence
+# Frame flag bits. FLAG_SRC: the payload carries a frame-level src
+# string (after the owner) applied as ``inSrc`` to every decoded
+# record. Flags ride the CRC preimage like every other header field.
+FLAG_SRC = 0x01
+_KNOWN_FLAGS = FLAG_SRC
 MAX_BATCH_BYTES = 256 << 20  # sanity cap: junk that fakes the magic must
 #                              not trigger a multi-GB allocation
 
@@ -134,10 +155,16 @@ _NO_TYPE = 255
 _I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
 
 # Exact key sets the columnar kinds require (anything else -> generic).
+# Raw kinds come in two flavors: the bare client-submit shape, and the
+# same + "inOff" (the ingress front door's admission stamp, riding the
+# existing in_off column).
 _RAW_OP_KEYS = frozenset(("kind", "doc", "client", "clientSeq", "refSeq",
                           "contents"))
+_RAW_OP_KEYS_OFF = _RAW_OP_KEYS | {"inOff"}
 _RAW_MEMBER_KEYS = frozenset(("kind", "doc", "client"))
+_RAW_MEMBER_KEYS_OFF = _RAW_MEMBER_KEYS | {"inOff"}
 _RAW_BOXCAR_KEYS = frozenset(("kind", "doc", "client", "ops"))
+_RAW_BOXCAR_KEYS_OFF = _RAW_BOXCAR_KEYS | {"inOff"}
 _SEQ_OP_KEYS = frozenset(("kind", "doc", "seq", "msn", "client",
                           "clientSeq", "refSeq", "type", "contents",
                           "inOff"))
@@ -232,7 +259,10 @@ def _classify(rec: Any) -> int:
         return K_GENERIC
     keys = rec.keys()  # dict_keys == set compares C-side, no new set
     if kind == "op":
-        if keys == _RAW_OP_KEYS and _is_i64(rec["client"]) \
+        if (keys == _RAW_OP_KEYS
+                or (keys == _RAW_OP_KEYS_OFF
+                    and _is_i64(rec["inOff"]) and rec["inOff"] >= 0)) \
+                and _is_i64(rec["client"]) \
                 and _is_i64(rec["clientSeq"]) and _is_i64(rec["refSeq"]):
             return K_RAW_OP
         if keys == _SEQ_OP_KEYS and _is_i64(rec["client"]) \
@@ -242,13 +272,20 @@ def _classify(rec: Any) -> int:
                 and rec["type"] in _TYPE_CODE:
             return K_SEQ_OP
         return K_GENERIC
-    if kind == "join" and keys == _RAW_MEMBER_KEYS \
+    if kind == "join" and (keys == _RAW_MEMBER_KEYS
+                           or (keys == _RAW_MEMBER_KEYS_OFF
+                               and _is_i64(rec["inOff"]) and rec["inOff"] >= 0)) \
             and _is_i64(rec["client"]):
         return K_RAW_JOIN
-    if kind == "leave" and keys == _RAW_MEMBER_KEYS \
+    if kind == "leave" and (keys == _RAW_MEMBER_KEYS
+                            or (keys == _RAW_MEMBER_KEYS_OFF
+                                and _is_i64(rec["inOff"])
+                                and rec["inOff"] >= 0)) \
             and _is_i64(rec["client"]):
         return K_RAW_LEAVE
-    if kind == "boxcar" and keys == _RAW_BOXCAR_KEYS \
+    if kind == "boxcar" and (keys == _RAW_BOXCAR_KEYS
+                             or (keys == _RAW_BOXCAR_KEYS_OFF
+                                 and _is_i64(rec["inOff"]) and rec["inOff"] >= 0)) \
             and _is_i64(rec["client"]) and isinstance(rec["ops"], list):
         ok = all(
             isinstance(op, dict) and op.keys() == _BOXCAR_OP_KEYS
@@ -269,18 +306,29 @@ def _classify(rec: Any) -> int:
 # remain — the branch ladder itself is hoisted out of the run. Each
 # entry mirrors its _classify branch exactly (the regression test
 # compares frames against per-record classification).
+def _rv_off(r):
+    # Raw kinds' optional admission stamp: the key set already matched
+    # the previous record's, so only the value check remains. MUST be
+    # non-negative — the column encodes absence as -1, so a negative
+    # value would silently drop the key on decode (lossless contract);
+    # such records ride K_GENERIC instead.
+    return "inOff" not in r or (_is_i64(r["inOff"]) and r["inOff"] >= 0)
+
+
 def _rv_raw_op(r):
     return isinstance(r["doc"], str) and _is_i64(r["client"]) \
-        and _is_i64(r["clientSeq"]) and _is_i64(r["refSeq"])
+        and _is_i64(r["clientSeq"]) and _is_i64(r["refSeq"]) \
+        and _rv_off(r)
 
 
 def _rv_member(r):
-    return isinstance(r["doc"], str) and _is_i64(r["client"])
+    return isinstance(r["doc"], str) and _is_i64(r["client"]) \
+        and _rv_off(r)
 
 
 def _rv_boxcar(r):
     if not (isinstance(r["doc"], str) and _is_i64(r["client"])
-            and isinstance(r["ops"], list)):
+            and isinstance(r["ops"], list) and _rv_off(r)):
         return False
     return all(
         isinstance(op, dict) and op.keys() == _BOXCAR_OP_KEYS
@@ -363,6 +411,11 @@ class ColumnarRecords:
     __slots__ = ("n", "docs", "kind", "type_code", "doc_idx", "client",
                  "client_seq", "ref_seq", "seq", "msn", "in_off",
                  "blob_off", "heap")
+
+    # Segments never carry a frame-level src themselves — the tag is
+    # applied at append time (`append_many(src=...)`); the class attr
+    # keeps the `_decode_record` column protocol uniform.
+    src: Optional[str] = None
 
     def __init__(self, docs: Sequence[str], kind, type_code, doc_idx,
                  client, client_seq, ref_seq, seq, msn, in_off,
@@ -505,7 +558,8 @@ def _part_from_segment(seg: ColumnarRecords) -> _Part:
 
 
 def _assemble_frame(parts: List[_Part], fence: Optional[int],
-                    owner: Optional[str], version: int) -> bytes:
+                    owner: Optional[str], version: int,
+                    src: Optional[str] = None) -> bytes:
     """Splice frame parts (doc dictionaries remapped VECTORIZED, blob
     heaps shifted as arrays) and wrap the header+CRC."""
     doc_ids: List[str] = []
@@ -557,34 +611,44 @@ def _assemble_frame(parts: List[_Part], fence: Optional[int],
         offs[n] = heap_base
         offs_b = offs.tobytes()
     owner_b = (owner or "").encode()
+    flags = 0
+    src_parts: List[bytes] = []
+    if src:
+        flags |= FLAG_SRC
+        src_b = src.encode()
+        src_parts = [struct.pack("<H", len(src_b)), src_b]
     doc_parts = [struct.pack("<I", len(doc_ids))]
     for d in doc_ids:
         db = d.encode()
         doc_parts.append(struct.pack("<H", len(db)) + db)
     payload = b"".join([
-        struct.pack("<H", len(owner_b)), owner_b,
+        struct.pack("<H", len(owner_b)), owner_b, *src_parts,
         *doc_parts, kind_b, tc_b, didx_b, i64_b, offs_b, heap,
     ])
     if len(payload) > MAX_BATCH_BYTES:
         raise ValueError(f"record batch too large: {len(payload)} bytes")
     # The CRC covers the HEADER FIELDS (with the crc slot zeroed) as
-    # well as the payload: a flipped record count or length would
-    # otherwise mis-frame a payload whose own CRC still matches.
+    # well as the payload: a flipped record count, length or flag byte
+    # would otherwise mis-frame a payload whose own CRC still matches.
     fence_i = int(fence or 0)
-    hdr0 = HEADER.pack(MAGIC, version, 0, n, len(payload), 0, fence_i)
+    hdr0 = HEADER.pack(MAGIC, version, flags, n, len(payload), 0, fence_i)
     crc = zlib.crc32(payload, zlib.crc32(hdr0))
     return HEADER.pack(
-        MAGIC, version, 0, n, len(payload), crc, fence_i,
+        MAGIC, version, flags, n, len(payload), crc, fence_i,
     ) + payload
 
 
 def encode_columns(segments, fence: Optional[int] = None,
                    owner: Optional[str] = None,
-                   version: Optional[int] = None) -> bytes:
+                   version: Optional[int] = None,
+                   src: Optional[str] = None) -> bytes:
     """One binary frame from pre-columnized records — the emit hot
     path: no per-record classification, no dict building, blob heaps
     spliced as whole byte runs. `segments` is one `ColumnarRecords` or
-    a sequence of them (spliced in order)."""
+    a sequence of them (spliced in order). `src` stamps the
+    frame-level ``inSrc`` tag (FLAG_SRC — every decoded record gains
+    it), the pred-drain emit path's answer to per-record dict
+    tagging."""
     t0 = time.perf_counter()
     ver = DEFAULT_VERSION if version is None else int(version)
     if ver not in SCHEMA_VERSIONS:
@@ -592,7 +656,7 @@ def encode_columns(segments, fence: Optional[int] = None,
     if isinstance(segments, ColumnarRecords):
         segments = (segments,)
     parts = [_part_from_segment(s) for s in segments]
-    frame = _assemble_frame(parts, fence, owner, ver)
+    frame = _assemble_frame(parts, fence, owner, ver, src=src)
     n = sum(p.n for p in parts)
     _metrics("encode", n, len(frame), time.perf_counter() - t0)
     if n:
@@ -606,18 +670,22 @@ def encode_columns(segments, fence: Optional[int] = None,
 
 def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
                  owner: Optional[str] = None,
-                 version: Optional[int] = None) -> bytes:
+                 version: Optional[int] = None,
+                 src: Optional[str] = None) -> bytes:
     """One binary frame for `records` (arbitrary JSON values, plus
     `ColumnarRecords` segments spliced in stream order), stamped with
     the accepted (fence, owner). `version` picks the frame rev (the
     module default otherwise); only the K_RAW_BOXCAR blob layout
-    differs between revs."""
+    differs between revs. `src` stamps the frame-level ``inSrc`` tag
+    (see `encode_columns`); records that ALREADY carry an ``inSrc``
+    key must not mix into a src frame (the frame tag would be
+    ambiguous) — callers pick one mechanism per append."""
     if records and all(isinstance(r, ColumnarRecords) for r in records):
         # Segment-only batch (the columnar emit steady state: a fused
         # pass-through pump, a nack-free kernel pump): the pure-column
         # encoder, no per-record machinery at all.
         return encode_columns(records, fence=fence, owner=owner,
-                              version=version)
+                              version=version, src=src)
     t0 = time.perf_counter()
     ver = DEFAULT_VERSION if version is None else int(version)
     if ver not in SCHEMA_VERSIONS:
@@ -729,7 +797,7 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
             ra(rec["refSeq"])
             sa(0)
             ma(0)
-            ia(-1)
+            ia(rec.get("inOff", -1))
             ta(_NO_TYPE)
             blob = _dumps(rec["contents"])
         elif k == K_SEQ_OP:
@@ -753,7 +821,7 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
             ra(0)
             sa(0)
             ma(0)
-            ia(-1)
+            ia(rec.get("inOff", -1))
             ta(_NO_TYPE)
             if k != K_RAW_BOXCAR:
                 blob = b""
@@ -768,7 +836,7 @@ def encode_batch(records: Sequence[Any], fence: Optional[int] = None,
         la(len(blob))
 
     flush_plain()
-    frame = _assemble_frame(parts, fence, owner, ver)
+    frame = _assemble_frame(parts, fence, owner, ver, src=src)
     n = sum(p.n for p in parts)
     _metrics("encode", n, len(frame), time.perf_counter() - t0)
     if col_records:
@@ -798,10 +866,10 @@ class RecordBatch:
     __slots__ = ("n", "fence", "owner", "docs", "kind", "type_code",
                  "doc_idx", "client", "client_seq", "ref_seq", "seq",
                  "msn", "in_off", "_blob_off", "_heap", "_records",
-                 "_frame_bytes", "version")
+                 "_frame_bytes", "version", "src")
 
     def __init__(self, n: int, fence: int, payload: memoryview,
-                 version: int = SCHEMA_VERSION):
+                 version: int = SCHEMA_VERSION, flags: int = 0):
         self.n = n
         self.fence = fence
         self.version = version
@@ -811,6 +879,12 @@ class RecordBatch:
         pos += 2
         self.owner = bytes(payload[pos:pos + olen]).decode() or None
         pos += olen
+        self.src: Optional[str] = None
+        if flags & FLAG_SRC:
+            (slen,) = struct.unpack_from("<H", payload, pos)
+            pos += 2
+            self.src = bytes(payload[pos:pos + slen]).decode() or None
+            pos += slen
         (ndocs,) = struct.unpack_from("<I", payload, pos)
         pos += 4
         docs: List[str] = []
@@ -874,43 +948,59 @@ class RecordBatch:
 
 def _decode_record(obj, i: int, version: int) -> Any:
     """One record as a plain Python value, off any column holder
-    (`RecordBatch` or `ColumnarRecords` — same column protocol)."""
+    (`RecordBatch` or `ColumnarRecords` — same column protocol). A
+    frame-level `src` (FLAG_SRC) tags every decoded dict with
+    ``inSrc``, reproducing the dict-path tagging exactly."""
     k = int(obj.kind[i])
     if k == K_GENERIC:
-        return json.loads(obj.blob(i))
-    doc = obj.docs[int(obj.doc_idx[i])]
-    client = int(obj.client[i])
-    if k == K_RAW_OP:
-        return {"kind": "op", "doc": doc, "client": client,
-                "clientSeq": int(obj.client_seq[i]),
-                "refSeq": int(obj.ref_seq[i]),
-                "contents": json.loads(obj.blob(i))}
-    if k == K_RAW_JOIN:
-        return {"kind": "join", "doc": doc, "client": client}
-    if k == K_RAW_LEAVE:
-        return {"kind": "leave", "doc": doc, "client": client}
-    if k == K_RAW_BOXCAR:
-        return {"kind": "boxcar", "doc": doc, "client": client,
-                "ops": [
-                    {"clientSeq": cs, "refSeq": rf,
-                     "contents": c.value if isinstance(c, JsonBlob)
-                     else c}
-                    for cs, rf, c in obj.boxcar(i)
-                ]}
-    if k == K_SEQ_OP:
-        return {"kind": "op", "doc": doc,
-                "seq": int(obj.seq[i]), "msn": int(obj.msn[i]),
-                "client": client,
-                "clientSeq": int(obj.client_seq[i]),
-                "refSeq": int(obj.ref_seq[i]),
-                "type": _TYPES[int(obj.type_code[i])],
-                "contents": json.loads(obj.blob(i)),
-                "inOff": int(obj.in_off[i])}
-    return {"kind": "nack", "doc": doc, "client": client,
-            "clientSeq": int(obj.client_seq[i]),
-            "code": int(obj.seq[i]),
-            "reason": json.loads(obj.blob(i)),
-            "inOff": int(obj.in_off[i])}
+        rec = json.loads(obj.blob(i))
+    else:
+        doc = obj.docs[int(obj.doc_idx[i])]
+        client = int(obj.client[i])
+        if k == K_RAW_OP:
+            rec = {"kind": "op", "doc": doc, "client": client,
+                   "clientSeq": int(obj.client_seq[i]),
+                   "refSeq": int(obj.ref_seq[i]),
+                   "contents": json.loads(obj.blob(i))}
+            if obj.in_off[i] >= 0:
+                rec["inOff"] = int(obj.in_off[i])
+        elif k == K_RAW_JOIN:
+            rec = {"kind": "join", "doc": doc, "client": client}
+            if obj.in_off[i] >= 0:
+                rec["inOff"] = int(obj.in_off[i])
+        elif k == K_RAW_LEAVE:
+            rec = {"kind": "leave", "doc": doc, "client": client}
+            if obj.in_off[i] >= 0:
+                rec["inOff"] = int(obj.in_off[i])
+        elif k == K_RAW_BOXCAR:
+            rec = {"kind": "boxcar", "doc": doc, "client": client,
+                   "ops": [
+                       {"clientSeq": cs, "refSeq": rf,
+                        "contents": c.value if isinstance(c, JsonBlob)
+                        else c}
+                       for cs, rf, c in obj.boxcar(i)
+                   ]}
+            if obj.in_off[i] >= 0:
+                rec["inOff"] = int(obj.in_off[i])
+        elif k == K_SEQ_OP:
+            rec = {"kind": "op", "doc": doc,
+                   "seq": int(obj.seq[i]), "msn": int(obj.msn[i]),
+                   "client": client,
+                   "clientSeq": int(obj.client_seq[i]),
+                   "refSeq": int(obj.ref_seq[i]),
+                   "type": _TYPES[int(obj.type_code[i])],
+                   "contents": json.loads(obj.blob(i)),
+                   "inOff": int(obj.in_off[i])}
+        else:
+            rec = {"kind": "nack", "doc": doc, "client": client,
+                   "clientSeq": int(obj.client_seq[i]),
+                   "code": int(obj.seq[i]),
+                   "reason": json.loads(obj.blob(i)),
+                   "inOff": int(obj.in_off[i])}
+    src = getattr(obj, "src", None)
+    if src and isinstance(rec, dict) and "inSrc" not in rec:
+        rec["inSrc"] = src
+    return rec
 
 
 # Header-corruption resync probe budget: how many MAGIC candidates one
@@ -939,26 +1029,31 @@ def decode_batch(buf, pos: int = 0,
         if view[pos:pos + 4] == MAGIC:
             return None, pos, -1  # header itself still in flight
         raise ValueError("not a record-batch frame")
-    magic, ver, _flags, n, plen, crc, fence = HEADER.unpack_from(view, pos)
+    magic, ver, flags, n, plen, crc, fence = HEADER.unpack_from(view, pos)
     if magic != MAGIC:
         raise ValueError("not a record-batch frame")
-    if ver not in SCHEMA_VERSIONS or plen > MAX_BATCH_BYTES:
-        # Unknown version / insane length: treat as a corrupt frame of
-        # unknowable extent — callers skip the rest of the file region
-        # the same way a junk JSON line is skipped.
-        raise ValueError(f"bad record-batch header (ver={ver}, len={plen})")
+    if ver not in SCHEMA_VERSIONS or plen > MAX_BATCH_BYTES \
+            or flags & ~_KNOWN_FLAGS:
+        # Unknown version / flag / insane length: treat as a corrupt
+        # frame of unknowable extent — callers skip the rest of the
+        # file region the same way a junk JSON line is skipped.
+        raise ValueError(
+            f"bad record-batch header (ver={ver}, flags={flags}, "
+            f"len={plen})"
+        )
     end = pos + HEADER.size + plen
     if end > len(view):
         return None, pos, -1  # torn frame: an append in progress
     payload = view[pos + HEADER.size:end]
-    hdr0 = HEADER.pack(MAGIC, ver, 0, n, plen, 0, fence)
+    hdr0 = HEADER.pack(MAGIC, ver, flags, n, plen, 0, fence)
     if zlib.crc32(payload, zlib.crc32(hdr0)) != crc:
         # Corrupt in place: skip, keep the count. (If the corruption
         # hit the header's count/length fields themselves, the skip
         # may land mid-junk — the walker then stops at the first
         # unparseable unit, the documented header-corruption floor.)
         return None, end, n
-    return RecordBatch(n, fence, payload, version=ver), end, n
+    return RecordBatch(n, fence, payload, version=ver,
+                       flags=flags), end, n
 
 
 def _resync_scan(data, pos: int) -> Optional[int]:

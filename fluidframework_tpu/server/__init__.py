@@ -34,7 +34,13 @@ from .queue import (
     partition_of,
 )
 from .supervisor import ServiceSupervisor
-from .shard_fabric import ShardFabricSupervisor, ShardRouter, ShardWorker
+from .shard_fabric import (
+    AutoscalePolicy,
+    ShardFabricSupervisor,
+    ShardRouter,
+    ShardWorker,
+)
+from .ingress import IngressRole, verify_nack, write_tenants
 from .summarizer import (
     SummarizerRole,
     SummaryIndex,
@@ -62,6 +68,7 @@ from .lambdas import (
 )
 
 __all__ = [
+    "AutoscalePolicy",
     "ColumnarFileTopic",
     "ColumnarTailReader",
     "FencedCheckpointStore",
@@ -82,6 +89,7 @@ __all__ = [
     "KernelDeliLambda",
     "KernelDeliRole",
     "DocumentSequencer",
+    "IngressRole",
     "LocalOrderingService",
     "LocalServer",
     "LogConsumer",
@@ -99,4 +107,6 @@ __all__ = [
     "SummaryReplica",
     "read_catchup",
     "summarize_document",
+    "verify_nack",
+    "write_tenants",
 ]

@@ -171,13 +171,19 @@ class ColumnarFileTopic(SharedFileTopic):
                     fence: Optional[int] = None,
                     owner: Optional[str] = None,
                     lock_timeout_s: Optional[float] = None,
-                    fsync: bool = True) -> int:
+                    fsync: bool = True,
+                    src: Optional[str] = None) -> int:
         """Append `messages` — plain records and/or pre-columnized
         `ColumnarRecords` segments, spliced in order — as ONE binary
         record-batch frame under the OS lock; returns the frame bytes
         written (0 for an empty batch, which still gates the fence — a
         deposed owner must learn it is deposed even with nothing to
         write).
+
+        ``src`` stamps the frame-level ``inSrc`` tag
+        (`record_batch.FLAG_SRC`): every record decoded out of this
+        append carries ``"inSrc": src`` — the elastic pred-drain tag
+        without per-record dict emission.
 
         ``fsync=False`` skips the data fsync AND pins the committed-
         length sidecar (a sidecar naming un-fsynced bytes could
@@ -227,7 +233,7 @@ class ColumnarFileTopic(SharedFileTopic):
                     return 0
                 cur_fence, cur_owner = self.latest_fence()
                 frame = encode_batch(messages, fence=cur_fence,
-                                     owner=cur_owner)
+                                     owner=cur_owner, src=src)
                 check_disk_fault("topic")
                 f.seek(clean)
                 f.write(frame)

@@ -1,0 +1,791 @@
+"""The supervised admission front door: alfred as a farm role.
+
+In the reference topology EVERY op crosses alfred before it can reach
+the sequencer (SURVEY §S0, ``lambdas/src/alfred``): token validation
+(the riddler gate, alfred/index.ts:595), size caps, rate throttles and
+nacks all happen at the front door, so an unauthorized or oversized or
+flooding client costs the ordering pipeline nothing. Until this PR the
+farm's ingress edge was a bare `ShardRouter` library object — any
+process could append anything to ``rawdeltas`` at full rate and the
+kernel deli would dutifully sequence it.
+
+`IngressRole` is that front door as a SUPERVISED role (full `_Role`
+machinery: fenced lease, heartbeat, checkpoint cadence, exactly-once
+``inOff`` recovery):
+
+    ingress (client submits) ──> IngressRole ──┬─> rawdeltas[-p{k}|-{rid}]
+                                               └─> nacks
+
+- **Admission owns the write path.** Clients submit to the ``ingress``
+  topic; ONLY admitted records reach the raw partition topics, each
+  stamped with its ingress offset (``inOff`` — riding the codec's
+  existing in_off column, so admission costs the columnar fast path
+  nothing). Every rejection is a NACK RECORD on the ``nacks`` topic
+  (never sequenced), carrying the reason taxonomy:
+
+    - ``auth`` (code 401): riddler token validation failed
+      (`server.riddler.TenantManager` over ``<dir>/tenants.json``;
+      enforced whenever the tenants file exists). Nacks are SIGNED
+      with the tenant's key when the tenant resolves (`sign_nack` /
+      `verify_nack`), so a client can authenticate its rejection.
+    - ``size`` (code 413): per-record contents bytes over
+      `max_record_bytes`, or a wire boxcar with more than
+      `max_boxcar_ops` ops / oversized total payload.
+    - ``rate`` (code 429, ``retryAfter``): the per-tenant token
+      bucket (`rate_limit` ops/s, burst `rate_burst`) ran dry.
+    - ``backpressure`` (code 429, ``retryAfter``): the doc's
+      partition has more than `backlog_max` admitted-but-unsequenced
+      records (ingress routed count minus the deli's checkpointed
+      offset, refreshed every `backlog_poll_s`). Overload degrades
+      VISIBLY — throttle-nacks with retry-after and a ``degraded``
+      heartbeat flag the supervisor's /healthz surfaces — instead of
+      growing the raw log without bound.
+
+- **Exactly-once over N+1 output legs.** Recovery binds the fence on
+  the nacks topic AND every raw leg the fabric has ever written (the
+  topology history when elastic), scans them all for the durable
+  ``inOff`` prefix, silently re-decides the input gap, and re-emits
+  only decisions whose input left no durable output anywhere — so an
+  ingress crash never duplicates a nack and never drops an admitted
+  submit. Decisions for inputs that died with NO durable output are
+  re-decided at recovery time: auth and size are pure functions of
+  the record (same verdict), rate/backpressure are functions of load
+  (admission control is inherently time-based; the record was never
+  acknowledged either way). A duplicated ADMIT (the elastic router's
+  epoch re-route, a retried multi-leg append) is silenced downstream
+  by the deli's resubmission dedup — the same idempotence the
+  at-least-once client contract already relies on.
+
+- **Every decision is a labeled metric**: ``ingress_admitted_total``,
+  ``ingress_nacks_total{reason=...}``,
+  ``ingress_backlog_gauge{partition=...}``, ``ingress_overloaded``.
+
+The socket layer tails the ``nacks`` topic
+(`socket_service.FarmReadServer(nacks=True)` pushes them to
+subscribed sessions), closing the submit→nack feedback loop the
+reference's WS door gives clients.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .columnar_log import make_topic
+from .queue import partition_of, range_for_doc
+from .riddler import AuthError, TenantManager
+from .supervisor import _Role, _topic_path
+
+__all__ = [
+    "INGRESS_TOPIC",
+    "IngressRole",
+    "NACKS_TOPIC",
+    "NACK_AUTH",
+    "NACK_RATE",
+    "NACK_SIZE",
+    "load_tenants",
+    "sign_nack",
+    "verify_nack",
+    "write_tenants",
+]
+
+INGRESS_TOPIC = "ingress"
+NACKS_TOPIC = "nacks"
+
+# Nack codes (HTTP-shaped, like the reference's nack contracts).
+NACK_AUTH = 401
+NACK_SIZE = 413
+NACK_RATE = 429  # rate AND backpressure; `reason` tells them apart
+
+# Admitted-record key sets per wire kind: admission STRIPS everything
+# else (credentials, stray junk), so the raw topics carry exactly the
+# schemas the codec columnizes (+ the inOff admission stamp).
+_KIND_KEYS = {
+    "op": ("client", "clientSeq", "refSeq", "contents"),
+    "join": ("client",),
+    "leave": ("client",),
+    "boxcar": ("client", "ops"),
+}
+# The exact BARE key sets (no credentials, no strays): records shaped
+# like this take the zero-rebuild canonical fast path — one inOff
+# assignment, no new dict.
+_KIND_KEYSETS = {
+    kind: frozenset(("kind", "doc") + keys)
+    for kind, keys in _KIND_KEYS.items()
+}
+_BOXCAR_OP_KEYS = frozenset(("clientSeq", "refSeq", "contents"))
+
+TENANTS_FILE = "tenants.json"
+
+
+def write_tenants(shared_dir: str, keys: Dict[str, str]) -> str:
+    """Persist the fabric's tenant signing keys (the riddler registry
+    the front door enforces). Returns the file path. Writing this file
+    TURNS AUTH ON for every ingress role reading the directory."""
+    os.makedirs(shared_dir, exist_ok=True)
+    path = os.path.join(shared_dir, TENANTS_FILE)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(dict(keys), f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_tenants(shared_dir: str) -> Optional[Dict[str, str]]:
+    """The tenant key registry, or None when the fabric runs open
+    (no tenants file — the tinylicious-style dev mode)."""
+    try:
+        with open(os.path.join(shared_dir, TENANTS_FILE)) as f:
+            keys = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(keys, dict):
+        return None
+    return {str(k): str(v) for k, v in keys.items()}
+
+
+def _nack_core(nack: dict) -> bytes:
+    """The byte string a nack signature covers (every client-meaningful
+    field, canonical JSON)."""
+    return json.dumps(
+        {k: nack.get(k) for k in ("doc", "client", "clientSeq", "code",
+                                  "reason", "inOff")},
+        sort_keys=True, separators=(",", ":"),
+    ).encode()
+
+
+def sign_nack(key: str, nack: dict) -> str:
+    """HMAC-SHA256 signature over the nack's core fields with the
+    tenant's signing key — the client-verifiable rejection (a forged
+    nack cannot carry a valid signature)."""
+    return hmac.new(key.encode(), _nack_core(nack),
+                    hashlib.sha256).hexdigest()
+
+
+def verify_nack(key: str, nack: dict) -> bool:
+    sig = nack.get("sig")
+    if not isinstance(sig, str):
+        return False
+    return hmac.compare_digest(sign_nack(key, nack), sig)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class IngressRole(_Role):
+    """The supervised admission gate in front of the `ShardRouter`.
+
+    One instance fronts ONE fabric: classic single-partition farm
+    (``n_partitions=1`` — admitted records land on the plain
+    ``rawdeltas`` topic), static modulo-N fabric, or the elastic
+    hash-range topology (``elastic=True`` — routing follows the live
+    epoch record, exactly like the library router). Admission knobs
+    come from constructor args, falling back to ``FLUID_INGRESS_*``
+    env (the supervised-child configuration channel):
+
+    - `max_record_bytes` (env ``FLUID_INGRESS_MAX_BYTES``, default
+      256 KiB): contents-byte cap per record AND per boxcar total.
+    - `max_boxcar_ops` (``FLUID_INGRESS_MAX_BOXCAR_OPS``, 64).
+    - `rate_limit` (``FLUID_INGRESS_RATE``, 0 = off): per-tenant
+      token-bucket ops/s; `rate_burst` (``FLUID_INGRESS_BURST``,
+      2x rate) is the bucket depth.
+    - `backlog_max` (``FLUID_INGRESS_BACKLOG``, 0 = off): per-
+      partition admitted-minus-sequenced record budget; beyond it,
+      submits for docs hashing there get throttle-nacks until the
+      deli catches up. `backlog_poll_s` (0.25) paces the deli-
+      checkpoint reads the estimate needs.
+    - `retry_after_s` (``FLUID_INGRESS_RETRY_AFTER_S``, 0.25): the
+      floor of the ``retryAfter`` hint on throttle nacks.
+    """
+
+    name = "ingress"
+    in_topic_name = INGRESS_TOPIC
+    # The nacks topic doubles as the PRIMARY fenced output leg (the
+    # base step's append/fence machinery runs against it); the raw
+    # partition legs are routed in `_append_outputs`.
+    out_topic_name = NACKS_TOPIC
+
+    def __init__(self, shared_dir: str, owner: str, *,
+                 n_partitions: int = 1, elastic: bool = False,
+                 max_record_bytes: Optional[int] = None,
+                 max_boxcar_ops: Optional[int] = None,
+                 rate_limit: Optional[float] = None,
+                 rate_burst: Optional[float] = None,
+                 backlog_max: Optional[int] = None,
+                 backlog_poll_s: float = 0.25,
+                 retry_after_s: Optional[float] = None,
+                 **kw):
+        super().__init__(shared_dir, owner, **kw)
+        self.n_partitions = int(n_partitions)
+        self.elastic = bool(elastic)
+        if self.n_partitions < 1:
+            raise ValueError(
+                f"n_partitions must be >= 1: {n_partitions}"
+            )
+        self.max_record_bytes = int(
+            max_record_bytes if max_record_bytes is not None
+            else _env_float("FLUID_INGRESS_MAX_BYTES", 256 * 1024)
+        )
+        self.max_boxcar_ops = int(
+            max_boxcar_ops if max_boxcar_ops is not None
+            else _env_float("FLUID_INGRESS_MAX_BOXCAR_OPS", 64)
+        )
+        self.rate_limit = float(
+            rate_limit if rate_limit is not None
+            else _env_float("FLUID_INGRESS_RATE", 0.0)
+        )
+        self.rate_burst = float(
+            rate_burst if rate_burst is not None
+            else _env_float("FLUID_INGRESS_BURST",
+                            max(1.0, 2.0 * self.rate_limit))
+        )
+        self.backlog_max = int(
+            backlog_max if backlog_max is not None
+            else _env_float("FLUID_INGRESS_BACKLOG", 0.0)
+        )
+        self.backlog_poll_s = float(backlog_poll_s)
+        self.retry_after_s = float(
+            retry_after_s if retry_after_s is not None
+            else _env_float("FLUID_INGRESS_RETRY_AFTER_S", 0.25)
+        )
+        # Routing surface. Classic farm: ONE raw topic, no suffix —
+        # the supervised deli consumes plain "rawdeltas". Fabric:
+        # the library ShardRouter, now appending under OUR fence.
+        if self.n_partitions > 1 or self.elastic:
+            from .shard_fabric import ShardRouter
+
+            self.router: Optional[ShardRouter] = ShardRouter(
+                shared_dir, self.n_partitions, self.log_format,
+                elastic=self.elastic,
+            )
+            self.raw_topic = None
+        else:
+            self.router = None
+            self.raw_topic = make_topic(
+                _topic_path(shared_dir, "rawdeltas"), self.log_format
+            )
+        # Riddler gate: enforced iff the tenants file exists.
+        self.tenant_keys = load_tenants(shared_dir)
+        self.tenants: Optional[TenantManager] = None
+        if self.tenant_keys is not None:
+            self.tenants = TenantManager()
+            for tid, key in self.tenant_keys.items():
+                self.tenants.create_tenant(tid, key)
+        # Validated-token cache: (tenant, token) -> (exp, documentId).
+        # The reference validates per CONNECTION, not per op — a
+        # client's stream re-presents one token thousands of times, so
+        # the HMAC+base64 work runs once per distinct token and every
+        # later record pays a dict probe plus the expiry/doc-binding
+        # compares. Bounded; expiry still enforced per record.
+        self._token_cache: Dict[Tuple[str, str], Tuple[float, str]] = {}
+        # SESSIONS (the alfred connection-auth shape, checkpointed):
+        # an {"kind": "auth", doc, client, tenant, token} ingress
+        # record validates once and opens a session; subsequent BARE
+        # records from that (doc, client) inherit it until expiry —
+        # op records then carry no credentials at all, which keeps
+        # them on the codec's columnar schema AND off the per-record
+        # validation cost. Per-record tokens remain accepted. Value:
+        # (expiry, tenant) — the tenant identity feeds rate limiting.
+        self._sessions: Dict[Tuple[str, int], Tuple[float, str]] = {}
+        # Admission state (checkpointed): per-tenant token buckets and
+        # per-raw-leg routed-record counts (the backlog numerator).
+        self._buckets: Dict[str, List[float]] = {}
+        self._routed: Dict[str, int] = {}
+        # Backlog estimate cache (NOT state: recomputed from the deli
+        # checkpoints on a poll cadence).
+        self._backlogs: Dict[str, int] = {}
+        self._backlog_t = 0.0
+        self._overloaded: Tuple[str, ...] = ()
+        # doc -> raw-leg cache (one consistent-hash per doc, not per
+        # record); keyed to the topology epoch when elastic so a
+        # split/merge invalidates it wholesale.
+        self._leg_cache: Dict[str, str] = {}
+        self._leg_cache_epoch: Optional[int] = None
+        self._leg_refresh_t = 0.0
+        self._leg_topics: Dict[str, Any] = {}
+        m = self.metrics
+        labels = self._metric_labels()
+        self._m_admitted = m.counter("ingress_admitted_total", **labels)
+        self._m_dropped = m.counter("ingress_dropped_total", **labels)
+        self._m_nacks = {
+            reason: m.counter("ingress_nacks_total", reason=reason,
+                              **labels)
+            for reason in ("auth", "size", "rate", "backpressure")
+        }
+        self._m_overloaded = m.gauge("ingress_overloaded", **labels)
+
+    # ------------------------------------------------------------ state
+
+    def snapshot_state(self) -> Any:
+        return {
+            "routed": dict(self._routed),
+            "buckets": {t: list(b) for t, b in self._buckets.items()},
+            "sessions": [[d, c, exp, ten] for (d, c), (exp, ten)
+                         in self._sessions.items()],
+        }
+
+    def restore_state(self, state: Any) -> None:
+        state = state or {}
+        self._routed = {
+            str(k): int(v)
+            for k, v in (state.get("routed") or {}).items()
+        }
+        self._buckets = {
+            str(t): [float(b[0]), float(b[1])]
+            for t, b in (state.get("buckets") or {}).items()
+            if isinstance(b, (list, tuple)) and len(b) == 2
+        }
+        self._sessions = {
+            (str(d), int(c)): (float(exp), str(ten))
+            for d, c, exp, ten in (state.get("sessions") or ())
+        }
+
+    # ---------------------------------------------------------- routing
+
+    def _leg_name(self, doc: str) -> str:
+        """The raw-topic name `doc`'s partition maps to under the
+        CURRENT topology (also the key routed counts/backlogs use).
+        Cached per doc — one consistent-hash per DOCUMENT, not per
+        record; an elastic epoch change flushes the cache."""
+        if self.router is None:
+            return "rawdeltas"
+        if self.elastic:
+            # Throttled topology refresh (one stat per ~20ms, not one
+            # per record): staleness here only mis-keys the backlog
+            # estimate for a beat — the actual elastic APPEND goes
+            # through the library router, whose post-append epoch
+            # recheck re-routes anything a flip stranded.
+            now = time.time()
+            if now - self._leg_refresh_t > 0.02:
+                self._leg_refresh_t = now
+                self.router._refresh()
+            epoch = self.router.topology["epoch"]
+            if epoch != self._leg_cache_epoch:
+                self._leg_cache.clear()
+                self._leg_cache_epoch = epoch
+        leg = self._leg_cache.get(doc)
+        if leg is None:
+            if len(self._leg_cache) > (1 << 20):
+                self._leg_cache.clear()
+            if self.elastic:
+                leg = range_for_doc(self.router.topology, doc)["raw"]
+            else:
+                leg = f"rawdeltas-p{partition_of(doc, self.n_partitions)}"
+            self._leg_cache[doc] = leg
+        return leg
+
+    def _leg_topic(self, leg: str):
+        t = self._leg_topics.get(leg)
+        if t is None:
+            t = self._leg_topics[leg] = make_topic(
+                _topic_path(self.shared_dir, leg), self.log_format
+            )
+        return t
+
+    def _deli_ckpt_key(self, leg: str) -> str:
+        """The deli checkpoint key consuming raw leg `leg` (its offset
+        is the backlog denominator)."""
+        if leg == "rawdeltas":
+            return "deli"
+        return "deli-" + leg[len("rawdeltas-"):]
+
+    def _raw_scan_topics(self) -> List[Any]:
+        """EVERY raw topic this fabric has ever routed to (topology
+        history when elastic) — the recovery fence-bind + durable-scan
+        set. Retired legs stay in the scan: an admit that landed there
+        moments before a split is still this role's durable output."""
+        if self.router is None:
+            return [self.raw_topic]
+        if self.elastic:
+            return [
+                self.router._topic(n)
+                for n in self.router.stage_topic_names("rawdeltas")
+            ]
+        return list(self.router.topics)
+
+    # -------------------------------------------------------- admission
+
+    def _nack(self, out: List[Any], rec: dict, line_idx: int,
+              code: int, reason: str, kind: str,
+              retry_after: Optional[float] = None,
+              tenant: Optional[str] = None) -> None:
+        """`tenant`: the RESOLVED tenant identity (a session-authed
+        bare record carries none on the wire) — the signing key lookup
+        falls back to the record's own tenant field."""
+        ops = rec.get("ops")
+        if rec.get("kind") == "boxcar" and isinstance(ops, list) and ops:
+            first = ops[0] if isinstance(ops[0], dict) else {}
+            cseq = first.get("clientSeq", 0)
+        else:
+            cseq = rec.get("clientSeq", 0)
+        nack: Dict[str, Any] = {
+            "kind": "nack",
+            "doc": rec.get("doc"),
+            "client": rec.get("client", -1),
+            "clientSeq": cseq if isinstance(cseq, int) else 0,
+            "code": code,
+            "reason": f"{kind}: {reason}",
+            "inOff": line_idx,
+        }
+        if retry_after is not None:
+            nack["retryAfter"] = round(float(retry_after), 4)
+        if not isinstance(tenant, str):
+            t = rec.get("tenant")
+            tenant = t if isinstance(t, str) else None
+        key = (self.tenant_keys or {}).get(tenant) \
+            if isinstance(tenant, str) else None
+        if key is not None:
+            # Signed rejection: the client verifies the nack really
+            # came from a holder of its tenant key (`verify_nack`).
+            nack["sig"] = sign_nack(key, nack)
+        self._m_nacks[kind].inc()
+        out.append(("nack", None, nack))
+
+    def _canonical(self, rec: dict, line_idx: int) -> Optional[dict]:
+        """The admitted wire form: schema keys only + the admission
+        stamp. None when a required field is missing/mistyped (the
+        record is DROPPED — there is no one to nack). A BARE record
+        (exactly the schema keys — the session-auth hot path) is
+        stamped in place with no rebuild."""
+        kind = rec["kind"]
+        if rec.keys() == _KIND_KEYSETS[kind] \
+                and isinstance(rec["client"], int):
+            if kind == "op":
+                if isinstance(rec["clientSeq"], int) \
+                        and isinstance(rec["refSeq"], int):
+                    rec["inOff"] = line_idx
+                    return rec
+                return None
+            if kind == "boxcar":
+                ops = rec["ops"]
+                if isinstance(ops, list) and all(
+                    isinstance(op, dict)
+                    and op.keys() == _BOXCAR_OP_KEYS
+                    and isinstance(op["clientSeq"], int)
+                    and isinstance(op["refSeq"], int)
+                    for op in ops
+                ):
+                    rec["inOff"] = line_idx
+                    return rec
+                # fall through: normalize partial boxcar ops below
+            else:
+                rec["inOff"] = line_idx
+                return rec
+        out: Dict[str, Any] = {"kind": kind, "doc": rec["doc"]}
+        for k in _KIND_KEYS[kind]:
+            if k not in rec:
+                return None
+            out[k] = rec[k]
+        if not isinstance(out.get("client"), int):
+            return None
+        if kind == "op" and not (
+            isinstance(out["clientSeq"], int)
+            and isinstance(out["refSeq"], int)
+        ):
+            return None
+        if kind == "boxcar":
+            ops = out["ops"]
+            if not isinstance(ops, list) or not all(
+                isinstance(op, dict)
+                and isinstance(op.get("clientSeq"), int)
+                and isinstance(op.get("refSeq", 0), int)
+                for op in ops
+            ):
+                # A non-int clientSeq/refSeq past this gate would be a
+                # poison pill crash-looping the deli downstream.
+                return None
+            out["ops"] = [
+                {"clientSeq": op["clientSeq"],
+                 "refSeq": op.get("refSeq", 0),
+                 "contents": op.get("contents")}
+                for op in ops
+            ]
+        if isinstance(rec.get("tr_sub"), (int, float)):
+            # The wire-trace submit stamp rides through admission so
+            # the deli's submit_to_stamp span still starts at the
+            # client (trace runs forgo the columnar fast path anyway).
+            out["tr_sub"] = rec["tr_sub"]
+        out["inOff"] = line_idx
+        return out
+
+    def _payload_bytes(self, rec: dict) -> int:
+        if rec["kind"] == "boxcar":
+            return sum(
+                len(json.dumps(op.get("contents"), separators=(",", ":")))
+                for op in rec.get("ops") or ()
+                if isinstance(op, dict)
+            )
+        if rec["kind"] != "op":
+            return 0
+        return len(json.dumps(rec.get("contents"), separators=(",", ":")))
+
+    def _take_tokens(self, tenant: str, cost: float,
+                     now: float) -> Tuple[bool, float]:
+        """Token-bucket draw; returns (admitted, retry_after_s)."""
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = [self.rate_burst, now]
+        tokens = min(self.rate_burst,
+                     b[0] + (now - b[1]) * self.rate_limit)
+        b[1] = now
+        if tokens >= cost:
+            b[0] = tokens - cost
+            return True, 0.0
+        b[0] = tokens
+        return False, max(self.retry_after_s,
+                          (cost - tokens) / max(1e-9, self.rate_limit))
+
+    def _refresh_backlogs(self, now: float) -> None:
+        if now - self._backlog_t < self.backlog_poll_s:
+            return
+        self._backlog_t = now
+        overloaded = []
+        for leg, routed in self._routed.items():
+            env = self.ckpt.load(self._deli_ckpt_key(leg))
+            consumed = int(((env or {}).get("state") or {})
+                           .get("offset", 0))
+            backlog = max(0, routed - consumed)
+            self._backlogs[leg] = backlog
+            self.metrics.gauge(
+                "ingress_backlog_gauge", partition=leg,
+                **self._metric_labels(),
+            ).set(backlog)
+            if self.backlog_max and backlog >= self.backlog_max:
+                overloaded.append(leg)
+        self._overloaded = tuple(sorted(overloaded))
+        self._m_overloaded.set(1.0 if self._overloaded else 0.0)
+
+    # ------------------------------------------------------------- pump
+
+    def _validate_token(self, rec: dict, now: float) -> Optional[str]:
+        """Per-record token check through the validated cache; returns
+        the failure reason, or None on success."""
+        tenant = rec.get("tenant")
+        tenant_id = tenant if isinstance(tenant, str) else "_anon"
+        token = rec.get("token") or ""
+        ck = (tenant_id, token)
+        hit = self._token_cache.get(ck)
+        if hit is not None and now < hit[0] and hit[1] == rec["doc"]:
+            return None  # cached-valid, same doc binding, unexpired
+        try:
+            claims = self.tenants.validate_token(
+                token, tenant_id, rec["doc"]
+            )
+        except AuthError as exc:
+            return str(exc)
+        if len(self._token_cache) > 4096:
+            self._token_cache.clear()
+        self._token_cache[ck] = (
+            float(claims.get("exp", 0)),
+            str(claims.get("documentId")),
+        )
+        return None
+
+    def process(self, line_idx: int, rec: Any, out: List[Any]) -> None:
+        if not isinstance(rec, dict) \
+                or not isinstance(rec.get("doc"), str):
+            self._m_dropped.inc()
+            return
+        kind = rec.get("kind")
+        now = time.time()
+        if kind == "auth":
+            # Session open (the alfred connection-auth shape): one
+            # token validation covers the (doc, client)'s subsequent
+            # BARE records until the token's expiry. Pure state — no
+            # output record, so exactly-once needs nothing extra
+            # (recovery's gap replay re-opens it deterministically).
+            client = rec.get("client")
+            if self.tenants is None or not isinstance(client, int):
+                self._m_dropped.inc()  # open fabric: sessions no-op
+                return
+            why = self._validate_token(rec, now)
+            if why is not None:
+                self._nack(out, rec, line_idx, NACK_AUTH, why, "auth")
+                return
+            ten = rec.get("tenant")
+            ten = ten if isinstance(ten, str) else "_anon"
+            hit = self._token_cache.get((ten, rec.get("token") or ""))
+            self._sessions[(rec["doc"], client)] = (
+                float(hit[0]) if hit else now, ten
+            )
+            return
+        if kind not in _KIND_KEYS:
+            self._m_dropped.inc()
+            return
+        tenant = rec.get("tenant")
+        tenant_id = tenant if isinstance(tenant, str) else "_anon"
+        if self.tenants is not None:
+            if "token" in rec:
+                why = self._validate_token(rec, now)
+                if why is not None:
+                    self._nack(out, rec, line_idx, NACK_AUTH, why,
+                               "auth")
+                    return
+            else:
+                sess = self._sessions.get((rec["doc"],
+                                           rec.get("client")))
+                if sess is None or now >= sess[0]:
+                    self._nack(
+                        out, rec, line_idx, NACK_AUTH,
+                        "no live session for this doc/client "
+                        "(send an auth record or a token)", "auth",
+                    )
+                    return
+                tenant_id = sess[1]  # rate limits bill the session
+        rec2 = self._canonical(rec, line_idx)
+        if rec2 is None:
+            self._m_dropped.inc()
+            return
+        if rec2["kind"] == "boxcar" \
+                and len(rec2["ops"]) > self.max_boxcar_ops:
+            self._nack(out, rec, line_idx, NACK_SIZE,
+                       f"boxcar of {len(rec2['ops'])} ops > "
+                       f"{self.max_boxcar_ops}", "size",
+                       tenant=tenant_id)
+            return
+        nbytes = self._payload_bytes(rec2)
+        if nbytes > self.max_record_bytes:
+            self._nack(out, rec, line_idx, NACK_SIZE,
+                       f"{nbytes} contents bytes > "
+                       f"{self.max_record_bytes}", "size",
+                       tenant=tenant_id)
+            return
+        if self.rate_limit > 0:
+            cost = (len(rec2["ops"]) if rec2["kind"] == "boxcar"
+                    else 1.0)
+            ok, retry = self._take_tokens(tenant_id, cost, now)
+            if not ok:
+                self._nack(out, rec, line_idx, NACK_RATE,
+                           f"tenant {tenant_id!r} over "
+                           f"{self.rate_limit:g} ops/s", "rate",
+                           retry_after=retry, tenant=tenant_id)
+                return
+        leg = self._leg_name(rec2["doc"])
+        if self.backlog_max:
+            self._refresh_backlogs(now)
+            if leg in self._overloaded:
+                self._nack(out, rec, line_idx, NACK_RATE,
+                           f"partition {leg} backlog "
+                           f"{self._backlogs.get(leg, 0)} >= "
+                           f"{self.backlog_max}", "backpressure",
+                           retry_after=self.retry_after_s,
+                           tenant=tenant_id)
+                return
+        self._routed[leg] = self._routed.get(leg, 0) + 1
+        self._m_admitted.inc()
+        out.append(("admit", leg, rec2))
+
+    # ---------------------------------------------------------- appends
+
+    def _append_outputs(self, out: List[Any]) -> int:
+        """Route one batch's decisions: admits to their raw partition
+        legs (grouped by the leg admission already computed — one
+        fenced append per leg, no second consistent-hash pass), nacks
+        to the nacks topic. Every leg append runs under its own
+        durable-retry budget; a retried multi-leg batch may duplicate
+        an admit, which the deli's resubmission dedup silences (see
+        the module docstring's exactly-once story). ELASTIC admits go
+        through the library router instead: its post-append epoch
+        recheck covers the stalled-topology hole per-leg grouping
+        cannot."""
+        nacks = [rec for tag, _leg, rec in out if tag == "nack"]
+        n = 0
+        if self.elastic:
+            admits = [rec for tag, _leg, rec in out if tag == "admit"]
+            if admits:
+                def _route() -> int:
+                    self.router.append(admits, fence=self.fence,
+                                       owner=self.owner)
+                    # The router reports record counts, not bytes:
+                    # approximate the checkpoint-cadence byte signal.
+                    return len(admits) * 64
+
+                n += self._durable(_route)
+        else:
+            by_leg: Dict[str, List[dict]] = {}
+            for tag, leg, rec in out:
+                if tag == "admit":
+                    by_leg.setdefault(leg, []).append(rec)
+            for leg, recs in by_leg.items():
+                topic = (self.raw_topic if self.router is None
+                         else self._leg_topic(leg))
+                n += self._durable(lambda t=topic, r=recs:
+                                   t.append_many(r, fence=self.fence,
+                                                 owner=self.owner))
+        if nacks:
+            n += self._durable(lambda: self.out_topic.append_many(
+                nacks, fence=self.fence, owner=self.owner
+            ))
+        return n
+
+    # The heartbeat exports overload next to disk degradation: an
+    # operator watching /healthz sees a backpressuring front door as
+    # "degraded", which is exactly what it is.
+    def heartbeat(self, force: bool = False) -> None:
+        prev = self.degraded
+        self.degraded = bool(prev or self._overloaded)
+        try:
+            super().heartbeat(force)
+        finally:
+            self.degraded = prev
+
+    # --------------------------------------------------------- recovery
+
+    def _recover_inner(self) -> None:
+        env = self.ckpt.load(self.name)
+        self.offset = 0
+        if env is not None:
+            st = env["state"]
+            self.offset = int(st.get("offset", 0))
+            self.restore_state(st.get("state"))
+        else:
+            self.restore_state(None)
+        # Bind our fence on EVERY output leg before scanning any: the
+        # nacks topic plus every raw topic the fabric has ever routed
+        # to — a deposed front door's in-flight append to any of them
+        # is rejected from here on.
+        legs = [self.out_topic] + self._raw_scan_topics()
+        for t in legs:
+            self._durable(lambda t=t: t.append_many(
+                [], fence=self.fence, owner=self.owner
+            ))
+        # Durable decisions per input offset, across all legs.
+        # Admission is 1 input -> at most 1 output, so presence is the
+        # whole story (a duplicated admit — elastic re-route, retried
+        # append — just counts the input done twice).
+        done: Dict[int, int] = {}
+        for t in legs:
+            entries, _ = t.read_entries(0)
+            for _i, r in entries:
+                if isinstance(r, dict) and isinstance(
+                        r.get("inOff"), int) and r["inOff"] >= self.offset:
+                    done[r["inOff"]] = done.get(r["inOff"], 0) + 1
+        if not done:
+            return
+        max_done = max(done)
+        gap, next_off = self.in_topic.read_entries(self.offset)
+        sink: List[Any] = []
+        for line_idx, rec in gap:
+            if line_idx > max_done:
+                next_off = line_idx
+                break
+            self.process(line_idx, rec, sink)
+        else:
+            next_off = max(self.offset, max_done + 1, next_off)
+        # Re-emit ONLY decisions whose input left no durable output on
+        # any leg (the crash window's lost suffix); everything else
+        # was a silent replay that rebuilt the admission state.
+        missing = [ent for ent in sink if ent[2]["inOff"] not in done]
+        if missing:
+            self._append_outputs(missing)
+        self.offset = next_off
+        self._reader = None
+        self.checkpoint()

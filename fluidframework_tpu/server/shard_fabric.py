@@ -56,10 +56,11 @@ import os
 import sys
 import time
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .columnar_log import (
     LOG_FORMATS,
+    ColumnarFileTopic,
     default_log_format,
     make_tail_reader,
     make_topic,
@@ -81,13 +82,31 @@ from .queue import (
 from .supervisor import (
     DELI_IMPLS,
     EXIT_FENCED,
+    BroadcasterRole,
+    ScribeRole,
+    ScriptoriumBroadcasterRole,
+    ScriptoriumRole,
     ServiceSupervisor,
     _topic_path,
     partitioned_role_class,
     resolve_role_class,
 )
 
+# Downstream-stage topologies a ShardWorker can run next to each owned
+# deli partition ("the farm's other lambdas, partitioned like deli"):
+# "fused" collapses scriptorium+broadcaster into the fused
+# durable+broadcast consumer (one deltas read per partition), "split"
+# runs them separately (the only shape the ELASTIC fabric supports —
+# two-leg predecessor absorption is fused-only machinery that doesn't
+# exist; `ranged_role_class` rejects the fused base loudly).
+DOWNSTREAM_MODES = {
+    "fused": (ScriptoriumBroadcasterRole, ScribeRole),
+    "split": (ScriptoriumRole, BroadcasterRole, ScribeRole),
+}
+
 __all__ = [
+    "AutoscalePolicy",
+    "DOWNSTREAM_MODES",
     "MergedDeltasReader",
     "ShardFabricSupervisor",
     "ShardRouter",
@@ -133,7 +152,10 @@ def range_lease_name(rid: str) -> str:
 
 
 class _RangedMixin:
-    """Hash-range identity + predecessor absorption for a deli role.
+    """Hash-range identity + predecessor absorption for a supervised
+    role (the deli, and since the front-door PR any single-out-topic
+    downstream stage — scriptorium, broadcaster, scribe — consuming a
+    per-range topic).
 
     A ranged role is a partitioned role whose slice of the document
     space is a hash range ``[lo, hi)`` instead of a modulo class, and
@@ -164,6 +186,13 @@ class _RangedMixin:
     range_hi: int = 0
     pred_rids: tuple = ()
     topo_epoch: int = 0
+    # The UNSUFFIXED topic names a predecessor's pair derives from
+    # (``{pred_in_base}-{prid}`` → ``{pred_out_base}-{prid}``): the
+    # deli reads rawdeltas→deltas, a ranged scriptorium deltas→durable,
+    # a ranged scribe deltas→(nothing — pred_out_base None skips every
+    # output-side step of the absorption).
+    pred_in_base: str = "rawdeltas"
+    pred_out_base: Optional[str] = "deltas"
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
@@ -200,12 +229,16 @@ class _RangedMixin:
         self._preds[prid] = {
             "off": off,
             "raw": make_topic(
-                _topic_path(self.shared_dir, f"rawdeltas-{prid}"),
+                _topic_path(self.shared_dir,
+                            f"{self.pred_in_base}-{prid}"),
                 self.log_format,
             ),
-            "deltas": make_topic(
-                _topic_path(self.shared_dir, f"deltas-{prid}"),
-                self.log_format,
+            "deltas": (
+                make_topic(
+                    _topic_path(self.shared_dir,
+                                f"{self.pred_out_base}-{prid}"),
+                    self.log_format,
+                ) if self.pred_out_base else None
             ),
             "reader": None,
             # Retirement state: "done" preds are fully absorbed (their
@@ -268,11 +301,16 @@ class _RangedMixin:
         if self._preds:
             self.checkpoint()
 
+    def _pred_ckpt_key(self, prid: str) -> str:
+        """Predecessor `prid`'s checkpoint key for THIS role family
+        (``{role_base}-{prid}`` — the deli's is `range_lease_name`)."""
+        return f"{self.role_base}-{prid}"
+
     def _seed_from_preds(self) -> None:
         docs: Dict[str, Any] = {}
         cursors: Dict[str, int] = {}
         for prid in self.pred_rids:
-            env = self.ckpt.load(range_lease_name(prid))
+            env = self.ckpt.load(self._pred_ckpt_key(prid))
             st = (env or {}).get("state") or {}
             cursors[prid] = int(st.get("offset", 0))
             inner = st.get("state")
@@ -312,8 +350,30 @@ class _RangedMixin:
         parent-first is the per-document input order (ancestors
         before descendants for the same reason). Retired (done) preds
         are skipped outright — their tombstone in the checkpoint says
-        every record they ever held is already absorbed."""
-        for prid in self._ordered_preds():
+        every record they ever held is already absorbed.
+
+        Our fence binds on EVERY live predecessor's output topic
+        FIRST, before any scan or emission for ANY of them: in a
+        merge→split chain a predecessor may itself be a still-LIVE
+        successor (the merged range's role mid-drain of an older
+        range) — scanning the old range's re-emissions and only later
+        deposing the live consumer would let it land more claimable
+        records between the scan and the bind, and this role would
+        re-emit them too (a durable-leg duplicate). With every pred
+        topic bound up front, every producer that could still emit a
+        record this role will claim is demonstrably FencedError-
+        deposed before the first scan. (Two sibling successors race
+        their binds; the lower fence is rejected, exits, and retries
+        under a fresh — higher — lease fence, the usual takeover
+        dance.)"""
+        preds = self._ordered_preds()
+        for prid in preds:
+            p = self._preds[prid]
+            if p["deltas"] is not None:
+                self._durable(lambda t=p["deltas"]: t.append_many(
+                    [], fence=self.fence, owner=self.owner
+                ))
+        for prid in preds:
             self._absorb_pred(prid)
 
     def _pred_done_counts(self, prid: str, start: int) -> Dict[int, int]:
@@ -348,7 +408,7 @@ class _RangedMixin:
         scan(self._preds[prid]["deltas"], tagged=False)
         scan(self.out_topic, tagged=True)
         for orid, op in self._preds.items():
-            if orid != prid:
+            if orid != prid and op["deltas"] is not None:
                 scan(op["deltas"], tagged=True)
         return done
 
@@ -356,13 +416,23 @@ class _RangedMixin:
         p = self._preds[prid]
         if p["off"] is None:
             p["off"] = 0  # predecessor died before its first checkpoint
-        # Bind our fence on the predecessor's output topic FIRST: the
-        # deposed pre-split owner's in-flight batch is rejected from
-        # here on (FencedError — the demonstrable half of the handoff),
-        # so the scan below sees the final durable prefix.
-        self._durable(lambda: p["deltas"].append_many(
-            [], fence=self.fence, owner=self.owner
-        ))
+        if p["deltas"] is None:
+            # Output-less role (scribe): state+offset commit atomically
+            # in the checkpoint, so absorption is just a silent fold of
+            # the pred tail — nothing to fence-bind or re-emit.
+            gap, next_off = p["raw"].read_entries(p["off"])
+            sink: List[dict] = []
+            for i, rec in gap:
+                if self._mine(rec):
+                    self.process(i, rec, sink)
+            self.flush_batch(sink)
+            p["off"] = next_off
+            p["reader"] = None
+            return
+        # Our fence is already bound on this (and every) pred topic by
+        # `_absorb_predecessors`' pre-pass, so the deposed owner's
+        # in-flight batch is rejected and the scan below sees the
+        # final durable prefix.
         done = self._pred_done_counts(prid, p["off"])
         gap, next_off = p["raw"].read_entries(p["off"])
         mine = [(i, rec) for i, rec in gap if self._mine(rec)]
@@ -448,11 +518,12 @@ class _RangedMixin:
             return pred_moved
         self.flush_batch(out)
         try:
-            self._ckpt_pending_bytes += self._durable(
-                lambda: self.out_topic.append_many(
-                    out, fence=self.fence, owner=self.owner
+            if self.out_topic is not None:
+                self._ckpt_pending_bytes += self._durable(
+                    lambda: self.out_topic.append_many(
+                        out, fence=self.fence, owner=self.owner
+                    )
                 )
-            )
             self.offset = next_off
             self._ckpt_dirty = True
             self.maybe_checkpoint()
@@ -549,27 +620,49 @@ class _RangedMixin:
                     p["quiet_since"] = None
                 return taken
             p["quiet_since"] = None
-            out: List[dict] = []
-            # Pred-drain outputs are tagged per record below, so the
-            # flush must emit wire DICTS even on a columnar-emitting
-            # role (the kernel deli's pre-columnized emission).
-            self._dict_emit = True
-            try:
+            out: List[Any] = []
+            src_emit = isinstance(self.out_topic, ColumnarFileTopic)
+            if src_emit:
+                # Columnar out topic: the frame-level FLAG_SRC stamp
+                # (`append_many(src=prid)`) carries the inSrc tag, so
+                # a columnar-emitting role (the kernel deli) keeps its
+                # `encode_columns` fast path through a pred drain —
+                # elastic splits no longer force the `_dict_emit`
+                # fallback (ROADMAP item-1 follow-up b). Dict-path
+                # strays in the same flush pick the tag up at decode
+                # identically.
                 for i, rec in entries:
                     if self._mine(rec):
                         self.process(i, rec, out)
                 self.flush_batch(out)
-            finally:
-                self._dict_emit = False
-            for r in out:
-                r["inSrc"] = prid
+            else:
+                # JSON out topic: per-record dict tagging (there is no
+                # frame to carry the tag).
+                self._dict_emit = True
+                try:
+                    for i, rec in entries:
+                        if self._mine(rec):
+                            self.process(i, rec, out)
+                    self.flush_batch(out)
+                finally:
+                    self._dict_emit = False
+                for r in out:
+                    r["inSrc"] = prid
             try:
-                if out:
-                    self._ckpt_pending_bytes += self._durable(
-                        lambda: self.out_topic.append_many(
-                            out, fence=self.fence, owner=self.owner
+                if out and self.out_topic is not None:
+                    if src_emit:
+                        self._ckpt_pending_bytes += self._durable(
+                            lambda: self.out_topic.append_many(
+                                out, fence=self.fence,
+                                owner=self.owner, src=prid,
+                            )
                         )
-                    )
+                    else:
+                        self._ckpt_pending_bytes += self._durable(
+                            lambda: self.out_topic.append_many(
+                                out, fence=self.fence, owner=self.owner
+                            )
+                        )
                 p["off"] = reader.next_line
                 self._ckpt_dirty = True
                 self.maybe_checkpoint()
@@ -583,16 +676,26 @@ class _RangedMixin:
 def ranged_role_class(base: type, entry: dict, epoch: int) -> type:
     """The elastic form of `partitioned_role_class`: same role code,
     hash-range identity. Lease key, heartbeat file, checkpoint key and
-    topic pair all come from the range id (``deli-{rid}`` over
-    ``rawdeltas-{rid}`` → ``deltas-{rid}``), the role only sequences
+    topic pair all derive from the base role's names + the range id
+    (the deli's ``deli-{rid}`` over ``rawdeltas-{rid}`` →
+    ``deltas-{rid}``; a ranged scriptorium's ``scriptorium-{rid}``
+    over ``deltas-{rid}`` → ``durable-{rid}``), the role only touches
     documents hashing into ``[lo, hi)``, and the entry's `preds` name
     the range(s) it absorbs (split parent / merge parents)."""
+    if getattr(base, "bc_topic_name", None):
+        raise ValueError(
+            f"{base.__name__} has a second output leg "
+            f"({base.bc_topic_name!r}): two-leg predecessor absorption "
+            f"is not implemented — run the split "
+            f"scriptorium+broadcaster pair on the elastic fabric"
+        )
     rid = entry["rid"]
     return type(
         f"{base.__name__}Range", (_RangedMixin, base), {
-            "name": range_lease_name(rid),
-            "in_topic_name": entry["raw"],
-            "out_topic_name": entry["deltas"],
+            "name": f"{base.name}-{rid}",
+            "in_topic_name": f"{base.in_topic_name}-{rid}",
+            "out_topic_name": (f"{base.out_topic_name}-{rid}"
+                               if base.out_topic_name else None),
             "partition": rid,  # metric label: {role: base, partition: rid}
             "role_base": base.name,
             "rid": rid,
@@ -600,6 +703,8 @@ def ranged_role_class(base: type, entry: dict, epoch: int) -> type:
             "range_hi": int(entry["hi"]),
             "pred_rids": tuple(entry.get("preds") or ()),
             "topo_epoch": int(epoch),
+            "pred_in_base": base.in_topic_name,
+            "pred_out_base": base.out_topic_name,
         },
     )
 
@@ -759,9 +864,14 @@ class ShardRouter:
             out.setdefault(entry["rid"], []).append(rec)
         return out
 
-    def append(self, records: List[Any]) -> Dict[Any, int]:
+    def append(self, records: List[Any],
+               fence: Optional[int] = None,
+               owner: Optional[str] = None) -> Dict[Any, int]:
         """Route + append one ingress batch; returns records appended
         per partition (keyed by index, or by range id when elastic).
+        `fence`/`owner` gate every leg's append (the supervised
+        ingress role routes under its own fence, so a deposed front
+        door's in-flight batch is rejected on the topic).
 
         Elastic appends are epoch-rechecked AFTER landing: if the
         topology moved while this batch was in flight (a router stalled
@@ -784,28 +894,37 @@ class ShardRouter:
                               for e in self.topology["ranges"]}
                 counts = {}
                 for rid, recs in by_rid.items():
-                    self._topic(rid_to_raw[rid]).append_many(recs)
+                    self._topic(rid_to_raw[rid]).append_many(
+                        recs, fence=fence, owner=owner
+                    )
                     counts[rid] = len(recs)
                 self._refresh()
                 if self.topology["epoch"] == epoch:
                     return counts
             return counts
         for p, recs in self.split(records).items():
-            self.topics[p].append_many(recs)
+            self.topics[p].append_many(recs, fence=fence, owner=owner)
             counts[p] = len(recs)
         return counts
 
     # ------------------------------------------------------ read surface
 
-    def deltas_topic_names(self) -> List[str]:
-        """Every sequenced-output topic name this fabric has EVER
-        written — live ranges plus retired ones (topology history), so
-        records written under epoch E stay readable after E+1."""
+    def stage_topic_names(self, base: str = "deltas") -> List[str]:
+        """Every topic name stage `base` has EVER written across this
+        fabric — live ranges plus retired ones (topology history), so
+        records written under epoch E stay readable after E+1. The
+        per-partition downstream stages share the naming rule
+        (``durable-p{k}`` / ``broadcast-{rid}`` ...), so one helper
+        serves every stage's merged read surface."""
         if self.elastic:
             self._refresh()
-            return [f"deltas-{rid}"
+            return [f"{base}-{rid}"
                     for rid in self.topology.get("history", [])]
-        return [deltas_topic_name(p) for p in range(self.n_partitions)]
+        return [partition_suffix(base, p)
+                for p in range(self.n_partitions)]
+
+    def deltas_topic_names(self) -> List[str]:
+        return self.stage_topic_names("deltas")
 
     def deltas_topics(self) -> List[Any]:
         """Every partition's sequenced-output topic (the merged read
@@ -826,8 +945,8 @@ class ShardRouter:
                     for e in self.topology["ranges"]]
         return list(self.topics)
 
-    def merged_reader(self) -> "MergedDeltasReader":
-        return MergedDeltasReader(self)
+    def merged_reader(self, base: str = "deltas") -> "MergedDeltasReader":
+        return MergedDeltasReader(self, base=base)
 
 
 class MergedDeltasReader:
@@ -839,16 +958,22 @@ class MergedDeltasReader:
     zero per poll would be O(file²) at bench scale. Retired ranges'
     topics quiesce once their successor binds, so each costs one
     empty incremental poll per pass; history grows only by
-    operator-initiated epochs, which bounds the per-poll fan-out."""
+    operator-initiated epochs, which bounds the per-poll fan-out.
 
-    def __init__(self, router: ShardRouter):
+    `base` picks the stage surface: "deltas" (default) reads the
+    sequenced stream; "durable"/"broadcast" read the per-partition
+    downstream legs the same elastic way (the catch-up surface a
+    split hands a range's downstream legs over on)."""
+
+    def __init__(self, router: ShardRouter, base: str = "deltas"):
         self.router = router
+        self.base = base
         self._readers: Dict[str, Any] = {}
 
     def poll(self, max_count_per_range: Optional[int] = None
              ) -> List[Any]:
         out: List[Any] = []
-        for name in self.router.deltas_topic_names():
+        for name in self.router.stage_topic_names(self.base):
             reader = self._readers.get(name)
             if reader is None:
                 reader = self._readers[name] = make_tail_reader(
@@ -894,7 +1019,8 @@ class ShardWorker:
                  worker_ttl_s: Optional[float] = None,
                  deli_devices: Optional[int] = None,
                  elastic: bool = False, summarize: bool = False,
-                 summary_ops: Optional[int] = None):
+                 summary_ops: Optional[int] = None,
+                 downstream: Optional[str] = None):
         """`elastic=True` swaps fixed modulo-N partitions for the
         hash-range topology (`queue.RangeLeaseStore`): the worker
         sweeps RANGE leases toward its fair share of the LIVE range
@@ -912,9 +1038,35 @@ class ShardWorker:
         an elastic summarizer must absorb predecessor ranges' fold
         state across a split/merge, which is a ROADMAP follow-up, so
         asking for both is a loud config error rather than a silently
-        wrong summary."""
+        wrong summary.
+
+        `downstream` ("fused" | "split") promotes the farm's OTHER
+        lambdas to per-partition supervised consumers riding deli
+        ownership: each owned partition gets its own
+        ``deltas-p{k}``-consuming scriptorium+broadcaster (fused or
+        split) and scribe under their own fenced leases — the
+        routerlicious every-stage-partitioned topology. On the
+        ELASTIC fabric the stages are ranged like the deli
+        (`ranged_role_class` over the same topology entry): a split
+        hands each range's durable/broadcast legs to the successors
+        through the same predecessor-absorption machinery,
+        exactly-once. Elastic + "fused" is a loud config error (the
+        fused role's two output legs have no two-leg absorption)."""
         self.summarize = bool(summarize)
         self.summary_ops = summary_ops
+        if downstream is not None and downstream not in DOWNSTREAM_MODES:
+            raise ValueError(
+                f"downstream {downstream!r} not in "
+                f"{sorted(DOWNSTREAM_MODES)}"
+            )
+        if downstream == "fused" and elastic:
+            raise ValueError(
+                "downstream='fused' is static-partition only: the "
+                "fused consumer's two output legs have no two-leg "
+                "predecessor absorption — use downstream='split' on "
+                "the elastic fabric"
+            )
+        self.downstream = downstream
         if self.summarize and elastic:
             raise ValueError(
                 "summarize=True is static-partition only: an elastic "
@@ -975,6 +1127,9 @@ class ShardWorker:
         # Per-partition summary services (summarize=True): mirror deli
         # ownership, own fenced lease per partition.
         self.summ_roles: Dict[Any, Any] = {}
+        # Per-partition downstream stages (downstream=): key -> list of
+        # role instances, mirroring deli ownership like summarizers.
+        self.down_roles: Dict[Any, List[Any]] = {}
         self.events: List[str] = []
         self._hb_t = 0.0
         self._sweep_t = 0.0
@@ -1139,6 +1294,52 @@ class ShardWorker:
             role.leases.release(role.name)
         self._event(f"released summarizer {self._kname(key)} ({why})")
 
+    def _make_down_roles(self, key: Any) -> List[Any]:
+        roles = []
+        for base in DOWNSTREAM_MODES[self.downstream]:
+            if self.elastic:
+                cls = ranged_role_class(
+                    base, self._entry(key), self.topology["epoch"]
+                )
+            else:
+                cls = partitioned_role_class(base, key)
+            role = cls(
+                self.shared_dir, self.owner, ttl_s=self.ttl_s,
+                batch=self.batch, ckpt_interval_s=self.ckpt_interval_s,
+                ckpt_bytes=self.ckpt_bytes, log_format=self.log_format,
+                ckpt_duty=self.ckpt_duty,
+            )
+            role.hb_interval_s = self.ttl_s / 3
+            roles.append(role)
+        return roles
+
+    def _sweep_downstream(self) -> None:
+        """Downstream stages follow deli ownership (the partition's
+        deltas are written here anyway); each stage holds its OWN
+        fenced lease (``scriptorium-p{k}`` / ``broadcaster-{rid}`` ...)
+        so a deposed worker's late downstream append is rejected like
+        any other role's."""
+        for k in list(self.down_roles):
+            if k not in self.roles:
+                self._release_down(k, "deli released")
+        for k in self.roles:
+            if k not in self.down_roles:
+                self.down_roles[k] = self._make_down_roles(k)
+
+    def _release_down(self, key: Any, why: str) -> None:
+        roles = self.down_roles.pop(key, None)
+        if not roles:
+            return
+        for role in roles:
+            role.close_doorbell()
+            if role.fence is not None:
+                try:
+                    role.checkpoint()
+                except (FencedError, OSError):
+                    pass
+                role.leases.release(role.name)
+        self._event(f"released downstream {self._kname(key)} ({why})")
+
     def _release(self, key: Any, why: str) -> None:
         """Graceful fenced handoff: final checkpoint under our (still
         valid) fence, then release with expires=0 — the successor's
@@ -1212,6 +1413,8 @@ class ShardWorker:
                     self.roles[p] = self._make_role(p)
         if self.summarize:
             self._sweep_summarizers()
+        if self.downstream:
+            self._sweep_downstream()
         self._m_owned.set(len(self.roles))
         self._sweep_t = time.time()
 
@@ -1395,6 +1598,24 @@ class ShardWorker:
                 self._event(
                     f"dropped summarizer {self._kname(p)} ({exc})"
                 )
+        for p, roles in list(self.down_roles.items()):
+            for role in list(roles):
+                try:
+                    moved += role.step(idle_sleep=0)
+                except (SystemExit, FencedError) as exc:
+                    # Drop the whole partition's downstream set (the
+                    # deposed stage's siblings released gracefully):
+                    # the key leaves down_roles, so the next sweep
+                    # recreates fresh instances while we still own the
+                    # deli — a single deposed stage must not leave the
+                    # partition's durable leg unowned forever.
+                    roles.remove(role)
+                    role.close_doorbell()
+                    self._event(
+                        f"dropped downstream {role.name} ({exc})"
+                    )
+                    self._release_down(p, f"{role.name} deposed")
+                    break  # the key's remaining roles just released
         now = time.time()
         if now - self._sweep_t > self.ttl_s / 2:
             self.sweep()
@@ -1413,7 +1634,8 @@ class ShardWorker:
 
         bells = [b for b in (
             r.doorbell() for r in itertools.chain(
-                self.roles.values(), self.summ_roles.values()
+                self.roles.values(), self.summ_roles.values(),
+                *self.down_roles.values(),
             )
         ) if b is not None]
         if bells:
@@ -1431,6 +1653,8 @@ class ShardWorker:
         making successors wait out the lease TTL."""
         for p in sorted(self.summ_roles):
             self._release_summ(p, "shutdown")
+        for p in sorted(self.down_roles):
+            self._release_down(p, "shutdown")
         for p in sorted(self.roles):
             self._release(p, "shutdown")
         try:
@@ -1459,6 +1683,156 @@ def serve_shard_worker(shared_dir: str, slot: str,
 
 
 # ---------------------------------------------------------------------------
+# load-driven autoscaling
+# ---------------------------------------------------------------------------
+
+
+class AutoscalePolicy:
+    """The closed autoscaling loop: a supervisor-side policy watching
+    per-partition deli throughput (``role_records_total{role="deli",
+    partition=rid}`` rates off the merged worker-heartbeat registry)
+    and the farm's ``/slo`` p99 (``op_stage_ms`` quantiles, wire-trace
+    runs), issuing `request_split` on sustained HOT ranges and
+    `request_merge` on sustained COLD adjacent pairs over the EXISTING
+    control channel — capacity follows load, no human in the loop.
+
+    Anti-flap machinery, all three layers deliberate:
+
+    - **hysteresis** — the split threshold (`split_rate`) sits well
+      above the merge threshold (`merge_rate`), so a range oscillating
+      near either line never qualifies for both;
+    - **sustain** — a range must hold its hot/cold verdict for
+      `sustain_s` continuous seconds before the policy acts (one
+      bursty pump is not load);
+    - **min-interval** — at most one topology change per
+      `min_interval_s`, and never while a previously issued command is
+      still pending, so the fabric always finishes absorbing one epoch
+      before the policy can stage the next.
+
+    Pure decision logic: `observe()` takes the sampled state and
+    returns at most one staged command dict — the supervisor owns the
+    sampling cadence and the control-channel write, and the chaos
+    harness gates a policy-driven split mid-boxcar bit-identical like
+    any operator-driven one."""
+
+    def __init__(self, split_rate: float = 2000.0,
+                 merge_rate: float = 50.0, sustain_s: float = 3.0,
+                 min_interval_s: float = 10.0, max_ranges: int = 16,
+                 min_ranges: int = 1,
+                 p99_hot_ms: Optional[float] = None,
+                 p99_stage: str = "submit_to_stamp"):
+        """`split_rate`/`merge_rate`: records/s per range above/below
+        which a range counts hot/cold. `p99_hot_ms` (optional): when
+        the farm-wide `op_stage_ms{stage=p99_stage}` p99 exceeds it,
+        the HIGHEST-rate range counts hot too — the latency-driven
+        trigger for load the rate threshold alone misses (one huge doc
+        in an otherwise quiet range). Needs wire tracing to populate;
+        None disables the latency trigger."""
+        if merge_rate >= split_rate:
+            raise ValueError(
+                f"hysteresis requires merge_rate < split_rate "
+                f"(got {merge_rate} >= {split_rate})"
+            )
+        self.split_rate = float(split_rate)
+        self.merge_rate = float(merge_rate)
+        self.sustain_s = float(sustain_s)
+        self.min_interval_s = float(min_interval_s)
+        self.max_ranges = int(max_ranges)
+        self.min_ranges = int(min_ranges)
+        self.p99_hot_ms = p99_hot_ms
+        self.p99_stage = p99_stage
+        self._last_sample: Optional[Tuple[float, Dict[str, float]]] = None
+        self.hot_since: Dict[str, float] = {}
+        self.cold_since: Dict[str, float] = {}
+        # None until the FIRST action: min-interval paces actions
+        # apart, it must not delay the first one.
+        self.last_action_t: Optional[float] = None
+        self.actions: List[dict] = []  # staged commands, for operators
+
+    # ------------------------------------------------------------ sample
+
+    def rates(self, now: float,
+              counts: Dict[str, float]) -> Optional[Dict[str, float]]:
+        """Per-range records/s from successive counter samples (None
+        until two samples exist). Clamped at zero: a restarted worker
+        resets its counters and a raw diff would go negative."""
+        prev = self._last_sample
+        self._last_sample = (now, dict(counts))
+        if prev is None:
+            return None
+        dt = now - prev[0]
+        if dt <= 0:
+            return None
+        return {
+            rid: max(0.0, (counts.get(rid, 0.0) - prev[1].get(rid, 0.0))
+                     ) / dt
+            for rid in counts
+        }
+
+    # ------------------------------------------------------------ decide
+
+    def observe(self, now: float, rates: Dict[str, float],
+                topo: dict,
+                p99_ms: Optional[float] = None) -> Optional[dict]:
+        """Fold one sample; returns a command dict ({"op": "split",
+        "rid": ...} / {"op": "merge", "rids": [...]}) when the policy
+        fires, else None. The caller stages it and must not call
+        `observe` with a pending unexecuted command."""
+        ranges = sorted(topo["ranges"], key=lambda e: e["lo"])
+        live = {e["rid"] for e in ranges}
+        for d in (self.hot_since, self.cold_since):
+            for rid in [r for r in d if r not in live]:
+                d.pop(rid)
+        hottest = max(rates, key=lambda r: rates[r]) if rates else None
+        latency_hot = (
+            self.p99_hot_ms is not None and p99_ms is not None
+            and p99_ms > self.p99_hot_ms
+        )
+        for rid in live:
+            rate = rates.get(rid, 0.0)
+            if rate > self.split_rate or (latency_hot and rid == hottest):
+                self.hot_since.setdefault(rid, now)
+            else:
+                self.hot_since.pop(rid, None)
+            if rate < self.merge_rate:
+                self.cold_since.setdefault(rid, now)
+            else:
+                self.cold_since.pop(rid, None)
+        if self.last_action_t is not None \
+                and now - self.last_action_t < self.min_interval_s:
+            return None
+        # Split the longest-sustained hot range first.
+        hot = [(now - t0, rid) for rid, t0 in self.hot_since.items()
+               if now - t0 >= self.sustain_s]
+        if hot and len(ranges) < self.max_ranges:
+            _, rid = max(hot)
+            self.last_action_t = now
+            self.hot_since.pop(rid, None)
+            cmd = {"op": "split", "rid": rid, "why": "autoscale-hot"}
+            self.actions.append({"t": now, **cmd})
+            return cmd
+        # Merge the first adjacent pair that is cold on BOTH sides.
+        if len(ranges) > max(1, self.min_ranges):
+            for a, b in zip(ranges, ranges[1:]):
+                if a["hi"] != b["lo"]:
+                    continue
+                ta = self.cold_since.get(a["rid"])
+                tb = self.cold_since.get(b["rid"])
+                if ta is None or tb is None:
+                    continue
+                if min(now - ta, now - tb) >= self.sustain_s:
+                    self.last_action_t = now
+                    self.cold_since.pop(a["rid"], None)
+                    self.cold_since.pop(b["rid"], None)
+                    cmd = {"op": "merge",
+                           "rids": [a["rid"], b["rid"]],
+                           "why": "autoscale-cold"}
+                    self.actions.append({"t": now, **cmd})
+                    return cmd
+        return None
+
+
+# ---------------------------------------------------------------------------
 # the fabric supervisor
 # ---------------------------------------------------------------------------
 
@@ -1480,7 +1854,20 @@ class ShardFabricSupervisor(ServiceSupervisor):
                  max_partitions: Optional[int] = None,
                  worker_ttl_s: Optional[float] = None,
                  elastic: bool = False, summarize: bool = False,
+                 downstream: Optional[str] = None,
+                 ingress: bool = False,
+                 autoscale: Any = None,
                  **kw):
+        """`downstream` ("fused"|"split") runs per-partition
+        scriptorium/broadcaster/scribe consumers inside each worker
+        (see `ShardWorker`). `ingress=True` adds the supervised
+        admission front door (`server.ingress.IngressRole`) as an
+        extra child routing the ``ingress`` topic into the fabric's
+        raw partitions. `autoscale` (an `AutoscalePolicy`, or True
+        for defaults; elastic only) closes the scaling loop: the
+        supervisor samples per-partition throughput each monitor pass
+        and stages policy-driven splits/merges on the control
+        channel."""
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1: {n_workers}")
         self.n_partitions = int(n_partitions)
@@ -1488,12 +1875,37 @@ class ShardFabricSupervisor(ServiceSupervisor):
         self.worker_ttl_s = worker_ttl_s
         self.elastic = bool(elastic)
         self.summarize = bool(summarize)
+        if downstream is not None and downstream not in DOWNSTREAM_MODES:
+            raise ValueError(
+                f"downstream {downstream!r} not in "
+                f"{sorted(DOWNSTREAM_MODES)}"
+            )
+        if downstream == "fused" and self.elastic:
+            raise ValueError(
+                "downstream='fused' is static-partition only "
+                "(use 'split' on the elastic fabric)"
+            )
+        self.downstream = downstream
         if self.summarize and self.elastic:
             raise ValueError(
                 "summarize=True is static-partition only "
                 "(elastic summarizer: ROADMAP follow-up)"
             )
+        if autoscale and not self.elastic:
+            raise ValueError(
+                "autoscale needs elastic=True (the policy issues "
+                "live range splits/merges)"
+            )
+        self.autoscale: Optional[AutoscalePolicy] = (
+            autoscale if isinstance(autoscale, AutoscalePolicy)
+            else (AutoscalePolicy() if autoscale else None)
+        )
+        self._autoscale_t = 0.0
+        self._autoscale_pending: Optional[str] = None
         roles = tuple(f"shard-w{i}" for i in range(n_workers))
+        if ingress:
+            roles = ("ingress",) + roles
+        self.ingress_enabled = bool(ingress)
         super().__init__(shared_dir, roles=roles, **kw)
         os.makedirs(os.path.join(shared_dir, "workers"), exist_ok=True)
         if self.elastic:
@@ -1507,6 +1919,27 @@ class ShardFabricSupervisor(ServiceSupervisor):
             self.store = None
 
     def _child_cmd(self, role: str, owner: str) -> List[str]:
+        if role == "ingress":
+            # The front door is a classic supervised role child
+            # (server.supervisor main), pointed at the fabric's
+            # partition topology so its router writes the same raw
+            # topics the workers consume.
+            cmd = [self.python, "-c",
+                   "from fluidframework_tpu.server.supervisor import "
+                   "main; main()",
+                   "--role", "ingress", "--dir", self.shared_dir,
+                   "--owner", owner, "--ttl", str(self.ttl_s),
+                   "--batch", str(self.batch),
+                   "--log-format", self.log_format,
+                   "--ckpt-interval", str(self.ckpt_interval_s),
+                   "--ckpt-bytes", str(self.ckpt_bytes),
+                   "--ckpt-duty", str(self.ckpt_duty),
+                   "--ingress-partitions", str(self.n_partitions)]
+            if self.elastic:
+                cmd += ["--ingress-elastic"]
+            if self.hb_interval_s is not None:
+                cmd += ["--hb-interval", str(self.hb_interval_s)]
+            return cmd
         cmd = [self.python, "-c",
                "from fluidframework_tpu.server.shard_fabric import main; "
                "main()",
@@ -1531,9 +1964,15 @@ class ShardFabricSupervisor(ServiceSupervisor):
             cmd += ["--summarize"]
             if self.summary_ops is not None:
                 cmd += ["--summary-ops", str(self.summary_ops)]
+        if self.downstream:
+            cmd += ["--downstream", self.downstream]
         return cmd
 
     def _hb_file(self, role: str) -> str:
+        if role == "ingress":
+            # The front door heartbeats like a classic role child, not
+            # a worker slot.
+            return os.path.join(self.shared_dir, "hb", "ingress.json")
         return os.path.join(self.shared_dir, "workers", f"{role}.json")
 
     def partition_owners(self) -> Dict[str, str]:
@@ -1578,6 +2017,74 @@ class ShardFabricSupervisor(ServiceSupervisor):
 
     def control_result(self, cmd_id: str) -> Optional[dict]:
         return control_result(self.shared_dir, cmd_id)
+
+    # ------------------------------------------------------- autoscaling
+
+    def poll_once(self) -> List[str]:
+        acted = super().poll_once()
+        if self.autoscale is not None:
+            self.autoscale_tick()
+        return acted
+
+    def autoscale_tick(self, force: bool = False) -> Optional[str]:
+        """One autoscale sample/decide pass, throttled to ~half the
+        lease TTL (`force` bypasses the throttle, for tests): sample
+        per-partition deli record counters off the worker heartbeats,
+        wait out any previously staged command (one epoch change in
+        flight at a time — the fabric must finish absorbing it), and
+        stage at most one policy-driven split/merge on the control
+        channel. Returns the staged command id, if any."""
+        pol = self.autoscale
+        if pol is None:
+            return None
+        now = time.time()
+        if not force and now - self._autoscale_t < max(
+                0.25, self.ttl_s / 2):
+            return None
+        self._autoscale_t = now
+        if self._autoscale_pending is not None:
+            if self.control_result(self._autoscale_pending) is None:
+                return None  # previous command still executing
+            self._autoscale_pending = None
+        topo = self.topology()
+        if topo is None:
+            return None
+        counts: Dict[str, float] = {}
+        for snap in self.child_metrics().values():
+            for c in snap.get("counters", ()):
+                if (c.get("name") == "role_records_total"
+                        and c.get("labels", {}).get("role") == "deli"):
+                    rid = c["labels"].get("partition")
+                    if rid is not None:
+                        counts[rid] = (counts.get(rid, 0.0)
+                                       + float(c["value"]))
+        rates = pol.rates(now, counts)
+        if rates is None:
+            return None  # need two samples for a rate
+        p99 = None
+        if pol.p99_hot_ms is not None:
+            from ..utils.metrics import histogram_quantile
+
+            snap = self.collect_metrics().snapshot()
+            for h in snap.get("histograms", ()):
+                if (h["name"] == "op_stage_ms"
+                        and h["labels"].get("stage") == pol.p99_stage
+                        and h.get("count")):
+                    v = histogram_quantile(h, 0.99)
+                    if v != float("inf"):
+                        p99 = v
+                    break
+        cmd = pol.observe(now, rates, topo, p99_ms=p99)
+        if cmd is None:
+            return None
+        why = cmd.pop("why", "autoscale")
+        cid = request_topology_change(self.shared_dir, cmd)
+        self._autoscale_pending = cid
+        self._event(
+            f"autoscale: staged {cmd.get('op')} "
+            f"{cmd.get('rid') or cmd.get('rids')} ({why})"
+        )
+        return cid
 
     def degraded_partitions(self) -> List[str]:
         """Partitions currently limping through a storage-fault retry
@@ -1630,6 +2137,10 @@ class ShardFabricSupervisor(ServiceSupervisor):
             h["ranges"] = [e["rid"] for e in topo["ranges"]]
         limping = self.degraded_partitions()
         h["degraded_partitions"] = limping
+        h["downstream"] = self.downstream
+        h["ingress"] = self.ingress_enabled
+        if self.autoscale is not None:
+            h["autoscale_actions"] = len(self.autoscale.actions)
         # Degraded until every partition has a live owner (boot,
         # takeover, split/merge windows — unowned partitions buffer,
         # not lose) and none is inside a storage-fault retry budget:
@@ -1649,6 +2160,10 @@ class ShardFabricSupervisor(ServiceSupervisor):
         reg.gauge("shard_partitions_owned_live").set(len(leases))
         if topo is not None:
             reg.gauge("shard_topology_epoch").set(topo["epoch"])
+        if self.autoscale is not None:
+            reg.gauge("shard_autoscale_actions").set(
+                len(self.autoscale.actions)
+            )
         for name, info in leases.items():
             # The lease FENCE next to the owner (satellite of the
             # lease_table fix): a scrape can tell a stale pre-split
@@ -1682,6 +2197,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     if summarize:
         args.remove("--summarize")
     summary_ops_s = _take("--summary-ops")
+    downstream = _take("--downstream")
     shared_dir = _take("--dir")
     slot = _take("--slot")
     owner = _take("--owner")
@@ -1700,6 +2216,8 @@ def main(argv: Optional[List[str]] = None) -> None:
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
             or (devices_s is not None and not devices_s.isdigit())
+            or (downstream is not None
+                and downstream not in DOWNSTREAM_MODES)
             or (summary_ops_s is not None
                 and not summary_ops_s.isdigit())):
         print(
@@ -1708,7 +2226,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             "[--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--max-partitions K] "
             "[--worker-ttl S] [--deli-devices N] [--elastic] "
-            "[--summarize] [--summary-ops N] "
+            "[--summarize] [--summary-ops N] [--downstream fused|split] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -1723,6 +2241,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         deli_devices=int(devices_s) if devices_s else None,
         elastic=elastic, summarize=summarize,
         summary_ops=int(summary_ops_s) if summary_ops_s else None,
+        downstream=downstream,
     )
 
 

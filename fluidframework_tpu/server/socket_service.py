@@ -389,6 +389,7 @@ class _FarmSession(socketserver.StreamRequestHandler):
         )
         self._wlock = threading.Lock()
         self._push_docs: list = []
+        self._nack_docs: list = []
 
     def _send(self, obj) -> None:
         with self._wlock:
@@ -407,6 +408,19 @@ class _FarmSession(socketserver.StreamRequestHandler):
                 pass
             raise
 
+    def _push_nacks(self, recs) -> None:
+        # The front door's rejections (`server.ingress` nack records)
+        # ride their own event so clients route them to the nack
+        # handler, not the op stream.
+        try:
+            self._send({"event": "nacks", "recs": recs})
+        except Exception:
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            raise
+
     def handle(self) -> None:
         srv: "FarmReadServer" = self.server.owner  # type: ignore
         try:
@@ -419,7 +433,7 @@ class _FarmSession(socketserver.StreamRequestHandler):
                     # flow outbound; a dead client is reaped by the
                     # push path's send failure instead). Sessions with
                     # no subscription keep the idle-reap behavior.
-                    if self._push_docs:
+                    if self._push_docs or self._nack_docs:
                         continue
                     break
                 if req is None:
@@ -434,6 +448,9 @@ class _FarmSession(socketserver.StreamRequestHandler):
         finally:
             for doc in self._push_docs:
                 srv.pusher.unsubscribe(doc, self._push)
+            if srv.nack_pusher is not None:
+                for doc in self._nack_docs:
+                    srv.nack_pusher.unsubscribe(doc, self._push_nacks)
 
     def _dispatch(self, srv: "FarmReadServer", req: dict):
         cmd = req["cmd"]
@@ -452,6 +469,12 @@ class _FarmSession(socketserver.StreamRequestHandler):
             doc = req["docId"]
             self._push_docs.append(doc)
             srv.pusher.subscribe(doc, self._push)
+            if srv.nack_pusher is not None:
+                # The same subscription tails the front door's nacks
+                # topic: a rejected submit reaches its doc's sessions
+                # as an {"event": "nacks"} push (the alfred nack edge).
+                self._nack_docs.append(doc)
+                srv.nack_pusher.subscribe(doc, self._push_nacks)
             return {"docId": doc,
                     "headSeq": srv.pusher.head_seq.get(doc, 0)}
         if cmd == "head":
@@ -472,7 +495,13 @@ class FarmReadServer:
     def __init__(self, shared_dir: str, host: str = "127.0.0.1",
                  port: int = 0, log_format: Optional[str] = None,
                  push_topic: str = "broadcast",
-                 deltas_topic: str = "deltas"):
+                 deltas_topic: str = "deltas",
+                 nacks: bool = False):
+        """`nacks=True` tails the front door's ``nacks`` topic with a
+        second doorbell-woken pusher: every subscribed session also
+        receives its doc's admission rejections (`server.ingress`
+        auth/size/rate/backpressure nack records) as ``nacks``
+        pushes — the alfred submit→nack feedback edge over TCP."""
         from .summarizer import SummaryIndex, open_summary_store
 
         self.shared_dir = shared_dir
@@ -483,6 +512,12 @@ class FarmReadServer:
         self.pusher = FarmTailPusher(
             os.path.join(shared_dir, "topics", f"{push_topic}.jsonl"),
             log_format,
+        )
+        self.nack_pusher: Optional[FarmTailPusher] = (
+            FarmTailPusher(
+                os.path.join(shared_dir, "topics", "nacks.jsonl"),
+                log_format,
+            ) if nacks else None
         )
         self._tcp = _FarmTCPServer((host, port), _FarmSession)
         self._tcp.owner = self  # type: ignore
@@ -507,6 +542,8 @@ class FarmReadServer:
 
     def start(self) -> "FarmReadServer":
         self.pusher.start()
+        if self.nack_pusher is not None:
+            self.nack_pusher.start()
         self._thread = threading.Thread(
             target=self._tcp.serve_forever, daemon=True
         )
@@ -517,6 +554,8 @@ class FarmReadServer:
         self._tcp.shutdown()
         self._tcp.server_close()
         self.pusher.stop()
+        if self.nack_pusher is not None:
+            self.nack_pusher.stop()
 
 
 class _FarmTCPServer(socketserver.ThreadingTCPServer):

@@ -883,7 +883,13 @@ class ScriptoriumRole(_Role):
     def process(self, line_idx: int, rec: Any, out: List[dict]) -> None:
         if not isinstance(rec, dict) or rec.get("kind") != "op":
             return
-        rec2 = {**{k: v for k, v in rec.items() if k != "inOff"},
+        # `inOff`/`inSrc` are the UPSTREAM stage's transport
+        # bookkeeping (the deli's input offsets, the elastic fabric's
+        # pred-drain tags): stripped here and re-keyed to THIS stage's
+        # input offset, so the downstream exactly-once scan reads its
+        # own offset space.
+        rec2 = {**{k: v for k, v in rec.items()
+                   if k not in ("inOff", "inSrc")},
                 "inOff": line_idx}
         tr = rec.get("tr")
         if self.trace_wire and isinstance(tr, dict):
@@ -915,7 +921,8 @@ class BroadcasterRole(_Role):
             "op", "nack"
         ):
             return
-        rec2 = {**{k: v for k, v in rec.items() if k != "inOff"},
+        rec2 = {**{k: v for k, v in rec.items()
+                   if k not in ("inOff", "inSrc")},
                 "inOff": line_idx}
         tr = rec.get("tr")
         if self.trace_wire and isinstance(tr, dict):
@@ -985,12 +992,17 @@ class ScriptoriumBroadcasterRole(_Role):
     name = "scriptorium_broadcaster"
     in_topic_name = "deltas"
     out_topic_name = "durable"
+    # The second output leg (partitioned_role_class suffixes it along
+    # with the in/out pair, so a per-partition fused consumer reads
+    # deltas-p{k} and writes durable-p{k} + broadcast-p{k}).
+    bc_topic_name = "broadcast"
     ingest_batches = True  # columnar pass-through wants whole frames
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
         self.bc_topic = make_topic(
-            _topic_path(self.shared_dir, "broadcast"), self.log_format
+            _topic_path(self.shared_dir, self.bc_topic_name),
+            self.log_format,
         )
         self._bc_out: List[Any] = []
         from .columnar_log import ColumnarFileTopic
@@ -1004,7 +1016,8 @@ class ScriptoriumBroadcasterRole(_Role):
             "op", "nack"
         ):
             return
-        rec2 = {**{k: v for k, v in rec.items() if k != "inOff"},
+        rec2 = {**{k: v for k, v in rec.items()
+                   if k not in ("inOff", "inSrc")},
                 "inOff": line_idx}
         tr = rec.get("tr")
         if self.trace_wire and isinstance(tr, dict):
@@ -1226,6 +1239,10 @@ def resolve_role_class(role: str, deli_impl: str = "scalar"):
         from .summarizer import SummarizerRole
 
         return SummarizerRole
+    if role == "ingress":
+        from .ingress import IngressRole
+
+        return IngressRole
     return ROLE_CLASSES[role]
 
 
@@ -1239,18 +1256,21 @@ def partitioned_role_class(base: type, partition: int) -> type:
     p = int(partition)
     if p < 0:
         raise ValueError(f"partition must be >= 0, got {partition}")
-    return type(
-        f"{base.__name__}P{p}", (base,), {
-            "name": partition_suffix(base.name, p),
-            "in_topic_name": partition_suffix(base.in_topic_name, p),
-            "out_topic_name": (
-                partition_suffix(base.out_topic_name, p)
-                if base.out_topic_name else None
-            ),
-            "partition": p,
-            "role_base": base.name,
-        },
-    )
+    attrs = {
+        "name": partition_suffix(base.name, p),
+        "in_topic_name": partition_suffix(base.in_topic_name, p),
+        "out_topic_name": (
+            partition_suffix(base.out_topic_name, p)
+            if base.out_topic_name else None
+        ),
+        "partition": p,
+        "role_base": base.name,
+    }
+    # A second output leg (the fused durable+broadcast consumer)
+    # partitions along with the primary pair.
+    if getattr(base, "bc_topic_name", None):
+        attrs["bc_topic_name"] = partition_suffix(base.bc_topic_name, p)
+    return type(f"{base.__name__}P{p}", (base,), attrs)
 
 
 def serve_role(shared_dir: str, role: str, owner: str,
@@ -1263,7 +1283,9 @@ def serve_role(shared_dir: str, role: str, owner: str,
                partition: Optional[int] = None,
                deli_devices: Optional[int] = None,
                hb_interval_s: Optional[float] = None,
-               summary_ops: Optional[int] = None) -> None:
+               summary_ops: Optional[int] = None,
+               ingress_partitions: Optional[int] = None,
+               ingress_elastic: bool = False) -> None:
     """Child-process entry: run one role until killed/deposed/fenced.
     With `partition`, the role serves that partition's topic pair under
     its partition-suffixed lease (one pinned shard of the fabric —
@@ -1284,6 +1306,12 @@ def serve_role(shared_dir: str, role: str, owner: str,
             f"summary_ops={summary_ops} is a summarizer knob "
             f"(got role={role!r})"
         )
+    if (ingress_partitions is not None or ingress_elastic) \
+            and role != "ingress":
+        raise ValueError(
+            f"ingress_partitions/ingress_elastic are ingress knobs "
+            f"(got role={role!r})"
+        )
     cls = resolve_role_class(role, deli_impl)
     if partition is not None:
         cls = partitioned_role_class(cls, partition)
@@ -1292,6 +1320,11 @@ def serve_role(shared_dir: str, role: str, owner: str,
         kw["deli_devices"] = deli_devices
     if summary_ops is not None:
         kw["summary_ops"] = summary_ops
+    if role == "ingress":
+        # The front door routes by partition topology; admission knobs
+        # themselves ride FLUID_INGRESS_* env (server.ingress).
+        kw["n_partitions"] = ingress_partitions or 1
+        kw["elastic"] = ingress_elastic
     r = cls(
         shared_dir, owner, ttl_s=ttl_s, batch=batch,
         ckpt_interval_s=ckpt_interval_s, ckpt_bytes=ckpt_bytes,
@@ -1347,7 +1380,8 @@ class ServiceSupervisor:
                  child_env: Optional[Dict[str, str]] = None,
                  hb_interval_s: Optional[float] = None,
                  summary_ops: Optional[int] = None,
-                 fused_hop: bool = False):
+                 fused_hop: bool = False,
+                 ingress: bool = False):
         """`child_env` adds/overrides spawn-environment variables for
         every child (the chaos harness's seam: it points CHILDREN at a
         disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
@@ -1360,9 +1394,17 @@ class ServiceSupervisor:
         scriptorium+broadcaster pair in `roles` into the fused
         durable+broadcast consumer (`ScriptoriumBroadcasterRole`) —
         same topics, same records, one fewer process wake and fsync
-        per batch on the downstream hop pair."""
+        per batch on the downstream hop pair. `ingress` puts the
+        supervised admission front door (`server.ingress.IngressRole`)
+        in front of the farm: clients submit to the ``ingress`` topic,
+        and only admitted records reach ``rawdeltas`` — auth / size /
+        rate / backpressure nacks land on the ``nacks`` topic
+        instead."""
         if fused_hop:
             roles = fused_roles(tuple(roles))
+        if ingress and "ingress" not in roles:
+            roles = ("ingress",) + tuple(roles)
+        self.ingress = bool(ingress) or "ingress" in roles
         self.fused_hop = bool(fused_hop)
         self.shared_dir = shared_dir
         self.child_env = dict(child_env or {})
@@ -1777,22 +1819,29 @@ def main(argv: Optional[List[str]] = None) -> None:
     devices_s = _take("--deli-devices")
     hb_interval_s = _take("--hb-interval")
     summary_ops_s = _take("--summary-ops")
-    if (role not in ROLES + (ScriptoriumBroadcasterRole.name,)
+    ingress_parts_s = _take("--ingress-partitions")
+    ingress_elastic = "--ingress-elastic" in args
+    if ingress_elastic:
+        args.remove("--ingress-elastic")
+    if (role not in ROLES + (ScriptoriumBroadcasterRole.name, "ingress")
             or shared_dir is None
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
             or (partition_s is not None and not partition_s.isdigit())
             or (devices_s is not None and not devices_s.isdigit())
+            or (ingress_parts_s is not None
+                and not ingress_parts_s.isdigit())
             or (summary_ops_s is not None
                 and not summary_ops_s.isdigit())):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster|summarizer"
-            "|scriptorium_broadcaster} "
+            "|scriptorium_broadcaster|ingress} "
             "--dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "
             "[--deli-devices N] [--hb-interval S] [--summary-ops N] "
+            "[--ingress-partitions N] [--ingress-elastic] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
         )
@@ -1805,7 +1854,10 @@ def main(argv: Optional[List[str]] = None) -> None:
                deli_devices=int(devices_s) if devices_s else None,
                hb_interval_s=float(hb_interval_s)
                if hb_interval_s else None,
-               summary_ops=int(summary_ops_s) if summary_ops_s else None)
+               summary_ops=int(summary_ops_s) if summary_ops_s else None,
+               ingress_partitions=int(ingress_parts_s)
+               if ingress_parts_s else None,
+               ingress_elastic=ingress_elastic)
 
 
 if __name__ == "__main__":

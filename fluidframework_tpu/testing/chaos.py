@@ -65,7 +65,7 @@ import time
 from dataclasses import dataclass, field, replace
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..server.columnar_log import make_topic
+from ..server.columnar_log import make_tail_reader, make_topic
 from ..server.queue import (
     FencedCheckpointStore,
     FencedError,
@@ -161,6 +161,34 @@ class ChaosConfig:
     # bit-identical to the split pair under the same faults. Classic
     # single-partition farm only (the fabric has no downstream pair).
     fused_hop: bool = False
+    # Supervised admission front door (`server.ingress.IngressRole`,
+    # sharded runner only): the workload feeds the `ingress` topic
+    # with signed tenant tokens instead of the router, the front door
+    # joins the kill schedule, `bad_submits` seeded invalid records
+    # (tampered token / oversized contents / unknown tenant) ride the
+    # stream and must each be NACKED exactly once and NEVER sequenced,
+    # and throttle-nacked valid submits are retried by the feeder
+    # until admitted (the retry-and-converge client contract).
+    ingress: bool = False
+    bad_submits: int = 6
+    # Overload episode knobs (ingress runs): per-tenant rate limit
+    # (ops/s; 0 = off) and per-partition backlog budget (records;
+    # 0 = off) exported to the ingress child via FLUID_INGRESS_*.
+    ingress_rate: float = 0.0
+    ingress_backlog: int = 0
+    # Load-driven autoscaling (`shard_fabric.AutoscalePolicy`, implies
+    # elastic): the fabric supervisor watches per-partition throughput
+    # and stages policy-driven splits/merges itself; convergence then
+    # ALSO requires the topology epoch to have actually moved — a
+    # LOAD-driven split fired mid-stream and the stream stayed
+    # bit-identical.
+    autoscale: bool = False
+    # Per-partition downstream stages (`ShardWorker(downstream=)`):
+    # "fused" | "split". Convergence then ALSO requires the merged
+    # durable legs to carry exactly the sequenced ops (bit-identical
+    # digest, zero dup/skip) — a split mid-stream hands each range's
+    # downstream legs over exactly-once.
+    downstream: Optional[str] = None
 
 
 @dataclass
@@ -199,6 +227,17 @@ class ChaosResult:
     # duplicate, and summary + tail boot == cold full replay.
     summaries_ok: bool = True
     summary_manifests: int = 0
+    # Front-door evidence (ingress runs): nacks by reason, whether
+    # every seeded bad submit was nacked-never-sequenced, and how many
+    # throttle-nacked submits the feeder had to retry.
+    ingress_nacks: Dict[str, int] = field(default_factory=dict)
+    never_sequenced_ok: bool = True
+    throttle_retries: int = 0
+    # Autoscale evidence: policy-staged commands during the run.
+    autoscale_actions: int = 0
+    # Downstream evidence (downstream runs): the merged durable legs
+    # matched the sequenced stream bit-identically.
+    downstream_ok: bool = True
 
 
 # ---------------------------------------------------------------------------
@@ -272,6 +311,32 @@ def golden_scribe_digests(stream: List[dict],
     for i, rec in enumerate(stream):
         role.process(i, rec, [])
     return {d: st["digest"] for d, st in role.docs.items()}
+
+
+def client_stream_digest(records: List[dict]) -> str:
+    """SHA-256 over every (doc, client)'s seq-ordered op sequence —
+    clientSeq, type and contents, but NOT the seq/msn assignment.
+    The convergence form for OVERLOAD runs: throttle-nacked clients
+    retry, which legitimately reorders the cross-client admission
+    interleaving (and therefore the seq numbering) relative to the
+    no-throttle golden — but every client's own stream must still
+    land exactly once, in order, bit-identical in content. Used with
+    `sequence_integrity` (zero dup/skip), this pins everything the
+    front door is allowed to leave undetermined."""
+    per: Dict[Tuple[str, Any], List[Tuple[int, list]]] = {}
+    for r in records:
+        rec = canonical_record(r)
+        per.setdefault((rec["doc"], rec.get("client")), []).append(
+            (int(rec.get("seq", 0)),
+             [rec.get("clientSeq"), rec.get("type"),
+              rec.get("contents")])
+        )
+    form = {
+        f"{doc}\x00{client}": [v for _s, v in sorted(entries)]
+        for (doc, client), entries in per.items()
+    }
+    payload = json.dumps(form, sort_keys=True, ensure_ascii=True)
+    return hashlib.sha256(payload.encode()).hexdigest()
 
 
 def stream_digest(records: List[dict]) -> str:
@@ -416,6 +481,23 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
         raise ValueError(
             "summarizer=True runs on the classic single-partition "
             "farm (sharded summary gate: ROADMAP follow-up)"
+        )
+    if cfg.n_partitions <= 1 and (cfg.ingress or cfg.autoscale
+                                  or cfg.downstream):
+        # The front-door / autoscale / downstream axes all live on the
+        # sharded fabric runner; accepting them single-partition would
+        # print verdicts for machinery that never ran.
+        raise ValueError(
+            "ingress/autoscale/downstream need n_partitions > 1 "
+            "(the sharded fabric runner)"
+        )
+    if cfg.autoscale and not (cfg.elastic or any(
+            f in ELASTIC_FAULTS for f in cfg.faults)):
+        cfg = replace(cfg, elastic=True)  # the policy splits ranges
+    if cfg.downstream == "fused" and (cfg.elastic or cfg.autoscale):
+        raise ValueError(
+            "downstream='fused' is static-partition only "
+            "(use 'split' with the elastic fabric)"
         )
     elastic_wanted = [f for f in cfg.faults if f in ELASTIC_FAULTS]
     if elastic_wanted and cfg.n_partitions <= 1:
@@ -739,7 +821,11 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
     bit-identical to the golden with zero duplicate/skipped seqs —
     a rebalance mid-boxcar must be invisible in the order."""
     from ..server.queue import DISK_FAULT_ENV
-    from ..server.shard_fabric import ShardFabricSupervisor, ShardRouter
+    from ..server.shard_fabric import (
+        AutoscalePolicy,
+        ShardFabricSupervisor,
+        ShardRouter,
+    )
 
     rng = random.Random(cfg.seed ^ 0x5EED)
     workload = build_workload(cfg)
@@ -747,10 +833,79 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
     gdigest = stream_digest(golden)
     expected = len(golden)
 
+    kill_targets = [f"shard-w{w}" for w in range(cfg.n_workers)]
+    if cfg.ingress:
+        # The front door is supervised like everything else: kill it
+        # mid-stream and its exactly-once recovery must neither drop
+        # an admitted submit nor duplicate a nack.
+        kill_targets.append("ingress")
     chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
-        cfg, rng, workload,
-        tuple(f"shard-w{w}" for w in range(cfg.n_workers)),
+        cfg, rng, workload, tuple(kill_targets),
     )
+
+    # Front-door fixtures: one tenant key (auth turns ON the moment
+    # the tenants file exists), SESSION auth records per (doc, client)
+    # fed up front — the alfred connection-auth shape: the workload's
+    # op records then ride BARE (credential-free, columnar-schema) and
+    # inherit their session — and `bad_submits` seeded invalid records
+    # that must be nacked-never-sequenced. Bad clients live at >= 9000
+    # so "never sequenced" is one scan of the merged stream.
+    BAD_CLIENT_BASE = 9000
+    tokens: Dict[str, str] = {}
+    bad_records: List[dict] = []
+    auth_records: List[dict] = []
+    if cfg.ingress:
+        from ..server.ingress import write_tenants
+        from ..server.riddler import sign_token
+
+        tenant_key = f"chaos-key-{cfg.seed}"
+        write_tenants(shared, {"t0": tenant_key})
+
+        def token_for(doc: str) -> str:
+            tok = tokens.get(doc)
+            if tok is None:
+                tok = tokens[doc] = sign_token(
+                    tenant_key, "t0", doc, ["doc:write"],
+                    lifetime_s=24 * 3600.0,
+                )
+            return tok
+
+        seen_sessions = set()
+        for r in workload:
+            key = (r["doc"], r["client"])
+            if key not in seen_sessions:
+                seen_sessions.add(key)
+                auth_records.append({
+                    "kind": "auth", "doc": r["doc"],
+                    "client": r["client"], "tenant": "t0",
+                    "token": token_for(r["doc"]),
+                })
+        docs = sorted({r["doc"] for r in workload})
+        for i in range(cfg.bad_submits):
+            doc = docs[i % len(docs)]
+            flavor = i % 3
+            rec = {"kind": "op", "doc": doc,
+                   "client": BAD_CLIENT_BASE + i, "clientSeq": 1,
+                   "refSeq": 0, "contents": {"bad": i},
+                   "tenant": "t0", "token": token_for(doc)}
+            if flavor == 0:  # tampered signature
+                rec["token"] = rec["token"][:-6] + "aaaaaa"
+            elif flavor == 1:
+                # Oversized contents behind a VALID session (the cap
+                # set below must be what rejects it, not auth).
+                auth_records.append({
+                    "kind": "auth", "doc": doc,
+                    "client": BAD_CLIENT_BASE + i, "tenant": "t0",
+                    "token": token_for(doc),
+                })
+                rec = {"kind": "op", "doc": doc,
+                       "client": BAD_CLIENT_BASE + i, "clientSeq": 1,
+                       "refSeq": 0,
+                       "contents": {"bad": i, "pad": "x" * 8192}}
+            else:  # unknown tenant
+                rec["tenant"] = "nobody"
+            bad_records.append(rec)
+
     # Elastic fault schedule (seeded like everything else): the split
     # lands in the FIRST half of the stream — mid-run, with boxcars in
     # flight when boxcar_rate > 0 — the merge in the second half (so
@@ -780,16 +935,52 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                      if "disk" in cfg.faults else {})
     if cfg.trace_wire:
         child_env["FLUID_TRACE_WIRE"] = "1"
+    if cfg.ingress:
+        # Admission knobs for the front-door child: a contents cap the
+        # seeded oversized submit violates, plus the overload episode's
+        # rate/backlog budgets when the run asks for one.
+        child_env["FLUID_INGRESS_MAX_BYTES"] = "4096"
+        if cfg.ingress_rate:
+            child_env["FLUID_INGRESS_RATE"] = str(cfg.ingress_rate)
+        if cfg.ingress_backlog:
+            child_env["FLUID_INGRESS_BACKLOG"] = str(cfg.ingress_backlog)
     child_env = child_env or None
+    # Load-driven autoscaling: thresholds scaled for the harness's
+    # small seeded workloads — the feed rate across a handful of
+    # ranges must read as "hot" within a couple of lease TTLs, so a
+    # POLICY-driven split demonstrably fires mid-stream.
+    policy = AutoscalePolicy(
+        split_rate=5.0, merge_rate=0.01,
+        sustain_s=max(0.5, cfg.ttl_s),
+        min_interval_s=max(2.0, 4 * cfg.ttl_s),
+        max_ranges=cfg.n_partitions + 2,
+    ) if cfg.autoscale else None
     sup = ShardFabricSupervisor(
         shared, n_workers=cfg.n_workers, n_partitions=cfg.n_partitions,
         ttl_s=cfg.ttl_s, heartbeat_timeout_s=cfg.heartbeat_timeout_s,
         batch=cfg.batch, deli_impl=cfg.deli_impl,
         log_format=cfg.log_format, deli_devices=cfg.deli_devices,
         elastic=cfg.elastic, child_env=child_env,
+        ingress=cfg.ingress, downstream=cfg.downstream,
+        autoscale=policy,
     ).start()
     router = ShardRouter(shared, cfg.n_partitions, cfg.log_format,
                          elastic=cfg.elastic)
+    ing_topic = make_topic(
+        os.path.join(shared, "topics", "ingress.jsonl"), cfg.log_format
+    ) if cfg.ingress else None
+    nacks_topic = make_topic(
+        os.path.join(shared, "topics", "nacks.jsonl"), cfg.log_format
+    ) if cfg.ingress else None
+
+    def feed(records: List[dict]) -> None:
+        """One ingress batch: through the front door when it is on
+        (bare records — sessions carry the auth), straight through
+        the router otherwise."""
+        if ing_topic is not None:
+            ing_topic.append_many(records)
+        else:
+            router.append(records)
     fence_rejections = 0
     degraded_seen = False
     epochs: List[int] = []
@@ -814,14 +1005,129 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
             )
         return out
 
+    def merged_stage_ops(base: str) -> List[dict]:
+        out: List[dict] = []
+        for name in router.stage_topic_names(base):
+            t = make_topic(
+                os.path.join(shared, "topics", f"{name}.jsonl"),
+                cfg.log_format,
+            )
+            out.extend(
+                r for r in t.read_from(0)
+                if isinstance(r, dict) and r.get("kind") == "op"
+            )
+        return out
+
+    # Bad submits land at seeded chunk indices. Throttle-nacked VALID
+    # submits follow the real client contract: a nack makes the client
+    # resubmit its WHOLE remaining tail in order (per-client ascending
+    # clientSeq — admission gates admit prefixes, so order survives
+    # the retry; the deli's dedup silences every duplicate). Triggers
+    # come from the ingress nacks topic (rate/backpressure) AND from
+    # deli nacks in the sequenced stream (an out-of-order arrival a
+    # gate flip let through), coalesced per client per pass.
+    bad_at: Dict[int, List[dict]] = {}
+    for rec in bad_records:
+        bad_at.setdefault(rng.randint(0, max(0, len(chunks) - 2)),
+                          []).append(rec)
+    client_units: Dict[Tuple[str, int], List[Tuple[int, dict]]] = {}
+    for rec in workload:
+        ckey = (rec["doc"], rec["client"])
+        if rec["kind"] == "op":
+            cseq = rec["clientSeq"]
+        elif rec["kind"] == "boxcar":
+            cseq = rec["ops"][0]["clientSeq"]
+        else:
+            cseq = 0  # the join leads the client's unit stream
+        client_units.setdefault(ckey, []).append((cseq, rec))
+    for units in client_units.values():
+        units.sort(key=lambda u: u[0])
+    throttle_retries = 0
+    nacks_cursor = 0
+    deli_nack_readers: Dict[str, Any] = {}
+
+    def resubmit_tails(tails: Dict[Tuple[str, int], int]) -> None:
+        nonlocal throttle_retries
+        batch: List[dict] = []
+        for ckey, from_cseq in tails.items():
+            batch.extend(rec for cseq, rec in client_units.get(ckey, ())
+                         if cseq >= from_cseq)
+        if batch:
+            throttle_retries += len(batch)
+            feed(batch)
+
+    def retry_throttled() -> None:
+        """One retry pass: gather NEW nack triggers, resubmit each
+        affected client's tail once (from its lowest nacked seq)."""
+        nonlocal nacks_cursor
+        if nacks_topic is None:
+            return
+        tails: Dict[Tuple[str, int], int] = {}
+        entries, _ = nacks_topic.read_entries(nacks_cursor)
+        for i, r in entries:
+            nacks_cursor = max(nacks_cursor, i + 1)
+            if not (isinstance(r, dict) and r.get("kind") == "nack"):
+                continue
+            reason = (r.get("reason") or "")
+            if not (reason.startswith("rate:")
+                    or reason.startswith("backpressure:")):
+                continue
+            ckey = (r.get("doc"), r.get("client"))
+            if ckey in client_units:
+                cseq = int(r.get("clientSeq") or 0)
+                tails[ckey] = min(tails.get(ckey, cseq), cseq)
+        # Deli nacks (sequenced-stream rejections of out-of-order
+        # arrivals): same tail resubmission, read INCREMENTALLY (a
+        # from-zero re-read per 0.02s tick would be quadratic in
+        # stream length). Only possible when an admission gate is
+        # configured — a gate flip is the one thing that can reorder
+        # a client's stream.
+        if not (cfg.ingress_rate or cfg.ingress_backlog):
+            resubmit_tails(tails)
+            return
+        for name in router.deltas_topic_names():
+            reader = deli_nack_readers.get(name)
+            if reader is None:
+                reader = deli_nack_readers[name] = make_tail_reader(
+                    make_topic(
+                        os.path.join(shared, "topics",
+                                     f"{name}.jsonl"),
+                        cfg.log_format,
+                    ), 0,
+                )
+            for _i, r in reader.poll():
+                if isinstance(r, dict) and r.get("kind") == "nack":
+                    ckey = (r.get("doc"), r.get("client"))
+                    if ckey in client_units:
+                        cseq = int(r.get("clientSeq") or 0)
+                        tails[ckey] = min(tails.get(ckey, cseq), cseq)
+        resubmit_tails(tails)
+
     try:
         note_epoch()
+        if auth_records and ing_topic is not None:
+            # Sessions open FIRST (clients connect before they
+            # submit); an ingress kill replays them from the gap.
+            ing_topic.append_many(auth_records)
         fed_idx = 0
         pending_dups: Dict[int, List[dict]] = {}
         deadline = time.time() + cfg.timeout_s
+        # Autoscale runs pace the feed to ~2 chunks per lease TTL: the
+        # policy needs two rate samples plus its sustain window to
+        # fire, and the point is a LOAD-driven split landing MID-
+        # stream — a burst-fed workload would drain before the loop
+        # closes.
+        feed_gap = cfg.ttl_s / 2 if cfg.autoscale else 0.0
+        last_feed = 0.0
         while time.time() < deadline:
             sup.poll_once()
-            if fed_idx < len(chunks):
+            retry_throttled()
+            if cfg.autoscale:
+                note_epoch()  # see the policy's epoch as it commits
+            if fed_idx < len(chunks) and (
+                    not feed_gap
+                    or time.time() - last_feed >= feed_gap):
+                last_feed = time.time()
                 if cfg.trace_wire:
                     # Same feed-time submit stamp as the classic
                     # runner: the ranged delis then stamp "tr" and
@@ -835,13 +1141,15 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                              for r in chunks[fed_idx]]
                 else:
                     chunk = chunks[fed_idx]
-                router.append(chunk)
+                feed(chunk)
+                for rec in bad_at.pop(fed_idx, []):
+                    ing_topic.append_many([rec])  # pre-wrapped bad
                 if fed_idx in dup_after:
                     pending_dups.setdefault(
                         dup_after[fed_idx], []
                     ).extend(chunks[fed_idx])
                 for rec in pending_dups.pop(fed_idx, []):
-                    router.append([rec])  # the lost-ack resubmission
+                    feed([rec])  # the lost-ack resubmission
                 for slot in kill_at.pop(fed_idx, []):
                     proc = sup.procs.get(slot)
                     if proc is not None and proc.poll() is None:
@@ -851,6 +1159,8 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                     torn_at.pop(0)
                     inject_torn_append(router.live_raw_topics()[0].path)
                     inject_torn_append(router.deltas_topics()[0].path)
+                    if ing_topic is not None:
+                        inject_torn_append(ing_topic.path)
                     note("chaos: torn append (p0)")
                 if lease_at == fed_idx:
                     fence_rejections += _shard_lease_takeover(
@@ -874,9 +1184,15 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
             if fed_idx >= len(chunks) and pending_dups:
                 for idx in sorted(pending_dups):
                     for rec in pending_dups.pop(idx, []):
-                        router.append([rec])
+                        feed([rec])
             if (fed_idx >= len(chunks) and not pending_dups
-                    and len(merged_ops()) >= expected):
+                    and len(merged_ops()) >= expected
+                    and (not cfg.autoscale or len(epochs) > 1)
+                    and (not cfg.downstream
+                         or (len(merged_stage_ops("durable"))
+                             >= expected
+                             and len(merged_stage_ops("broadcast"))
+                             >= expected))):
                 break
             time.sleep(0.02)
         note_epoch()
@@ -888,19 +1204,79 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
     ops = merged_ops()
     digest = stream_digest(ops)
     dups, skips = sequence_integrity(ops)
+    # Front-door verdict: every seeded bad submit nacked EXACTLY once
+    # (ingress exactly-once across its kill schedule), none of them
+    # ever sequenced, and the nack taxonomy on the wire.
+    ingress_nacks: Dict[str, int] = {}
+    never_sequenced_ok = True
+    ingress_ok = True
+    if cfg.ingress:
+        nk = [r for r in nacks_topic.read_from(0)
+              if isinstance(r, dict) and r.get("kind") == "nack"]
+        for r in nk:
+            reason = (r.get("reason") or "?").split(":", 1)[0]
+            ingress_nacks[reason] = ingress_nacks.get(reason, 0) + 1
+        bad_nacks = [r for r in nk
+                     if isinstance(r.get("client"), int)
+                     and r["client"] >= BAD_CLIENT_BASE]
+        never_sequenced_ok = not any(
+            isinstance(op.get("client"), int)
+            and op["client"] >= BAD_CLIENT_BASE for op in ops
+        )
+        ingress_ok = (len(bad_nacks) == len(bad_records)
+                      and never_sequenced_ok)
+    # Downstream verdict: the merged durable AND broadcast legs must
+    # mirror the SEQUENCED stream exactly (bit-identical to the
+    # merged deltas, zero dup/skip) — a mid-stream split handed each
+    # range's legs over exactly-once or this digest forks.
+    downstream_ok = True
+    if cfg.downstream:
+        for base in ("durable", "broadcast"):
+            sops = merged_stage_ops(base)
+            sdups, sskips = sequence_integrity(sops)
+            if (stream_digest(sops) != digest or sdups or sskips):
+                downstream_ok = False
+                events.append(
+                    f"downstream {base} leg DIVERGED "
+                    f"({len(sops)}/{expected} dups={sdups} "
+                    f"skips={sskips})"
+                )
+    autoscale_actions = (len(sup.autoscale.actions)
+                         if sup.autoscale is not None else 0)
+    # OVERLOAD runs converge in the order-free client-stream form:
+    # throttle retries legitimately reorder the cross-client admission
+    # interleaving (the seq assignment), so bit-identity holds per
+    # client stream + zero dup/skip instead of per global interleave.
+    overload_mode = bool(cfg.ingress_rate or cfg.ingress_backlog)
+    order_ok = (
+        client_stream_digest(ops) == client_stream_digest(golden)
+        if overload_mode else digest == gdigest
+    )
     converged = (
-        digest == gdigest and dups == 0 and skips == 0
+        order_ok and dups == 0 and skips == 0
+        and len(ops) == expected
         and (("lease" not in cfg.faults and "split" not in cfg.faults)
              or fence_rejections > 0)
         and ("split" not in cfg.faults or len(epochs) > 1)
         and ("merge" not in cfg.faults or len(epochs) > 1)
         and ("disk" not in cfg.faults or degraded_seen)
+        and ingress_ok and downstream_ok
+        # A LOAD-driven topology change must actually have fired.
+        and (not cfg.autoscale
+             or (len(epochs) > 1 and autoscale_actions > 0))
     )
     detail = (
         f"ops={len(ops)}/{expected} partitions={cfg.n_partitions} "
         f"workers={cfg.n_workers} elastic={cfg.elastic} "
         f"epochs={epochs} degraded_seen={degraded_seen} "
-        f"restarts={sup.restarts} "
+        + (f"ingress_nacks={ingress_nacks} bad={len(bad_records)} "
+           f"never_sequenced_ok={never_sequenced_ok} "
+           f"throttle_retries={throttle_retries} "
+           if cfg.ingress else "")
+        + (f"autoscale_actions={autoscale_actions} "
+           if cfg.autoscale else "")
+        + (f"downstream_ok={downstream_ok} " if cfg.downstream else "")
+        + f"restarts={sup.restarts} "
         f"owners={sup.partition_owners()} events={events + sup.events}"
     )
     from ..utils.metrics import dump_snapshot_line, merge_snapshots
@@ -923,6 +1299,11 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         # stage in the fabric) — collected anyway so a future fan-out
         # stage lights this up without touching the harness.
         slow_ops=sup.child_slow_ops() if cfg.trace_wire else [],
+        ingress_nacks=ingress_nacks,
+        never_sequenced_ok=never_sequenced_ok,
+        throttle_retries=throttle_retries,
+        autoscale_actions=autoscale_actions,
+        downstream_ok=downstream_ok,
     )
 
 

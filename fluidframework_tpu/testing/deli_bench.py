@@ -1003,6 +1003,220 @@ def run_hop_bench(n_docs: int = 64, n_clients: int = 8,
     }
 
 
+def run_ingress_bench(n_docs: int = 2000, n_clients: int = 16,
+                      ops_per_client: int = 2, n_partitions: int = 2,
+                      log_format: str = "json",
+                      overload_backlog: int = 64,
+                      overload_records: int = 1200) -> dict:
+    """The front-door guard's engine (bench_configs
+    ``config12_front_door``): admission cost + the overload episode.
+
+    Phase 1 — ADMISSION: the config-5-shape workload (auth ON, per-doc
+    signed tokens) driven through an in-proc `IngressRole` vs the bare
+    `ShardRouter` append the pre-front-door edge used, vs the batched
+    scalar deli sequencing the same stream. `admission_overhead_pct`
+    is the end-to-end cost in the farm's PIPELINED topology (stages in
+    separate processes): zero while admission outruns sequencing, the
+    bottleneck slowdown once it doesn't — the number config12 holds
+    under 5%. The serial view (extra hop + checks as a fraction of
+    sequencing work) is reported as `serial_overhead_pct`.
+
+    Phase 2 — OVERLOAD: a single-partition storm fed faster than a
+    deliberately slow deli drains, with a small backlog budget: the
+    rawdeltas backlog must stay BOUNDED (budget + one in-flight batch
+    per refresh lag) while throttle nacks flow, and once the feeder
+    stops, retried submits drain and sequence EXACTLY once — overload
+    degrades visibly, it never grows the log unboundedly or loses an
+    acknowledged record. Both phases gate correctness before any
+    number is reported."""
+    from ..server.columnar_log import make_topic
+    from ..server.ingress import IngressRole, write_tenants
+    from ..server.riddler import sign_token
+    from ..server.shard_fabric import ShardRouter, spread_doc_names
+    from ..server.supervisor import DeliRole, _topic_path
+
+    scratch = tempfile.mkdtemp(prefix="ingress-bench-")
+    try:
+        docs = spread_doc_names(n_docs, n_partitions)
+        workload = build_pipeline_workload(
+            n_docs, n_clients, ops_per_client, doc_names=docs
+        )
+        n = len(workload)
+        # --- phase 1: admission throughput -------------------------
+        # Session auth (the alfred connection shape): one auth record
+        # per (doc, client) opens the session, the op stream rides
+        # BARE — per-record admission is a session probe, not an HMAC.
+        key = "bench-key"
+        tokens = {d: sign_token(key, "t0", d, ["doc:write"],
+                                lifetime_s=24 * 3600.0) for d in docs}
+        auth_recs = [
+            {"kind": "auth", "doc": d, "client": c, "tenant": "t0",
+             "token": tokens[d]}
+            for d in docs for c in range(1, n_clients + 1)
+        ]
+        def timed_admission(root: str) -> float:
+            d = os.path.join(scratch, root)
+            write_tenants(d, {"t0": key})
+            t = make_topic(os.path.join(d, "topics", "ingress.jsonl"),
+                           log_format)
+            t.append_many(auth_recs)
+            ing = IngressRole(d, "bench-ingress", ttl_s=3600.0,
+                              batch=8192, log_format=log_format,
+                              n_partitions=n_partitions)
+            while ing.step() > 0:
+                pass  # session setup: connect-time cost, untimed
+            for i in range(0, n, 8192):
+                t.append_many(workload[i:i + 8192])
+            t0 = time.perf_counter()
+            while ing.step() > 0:
+                pass
+            dt = time.perf_counter() - t0
+            # Everything valid must be admitted — the correctness
+            # gate before any number.
+            admitted = sum(ing._routed.values())
+            assert admitted == n, f"admitted {admitted}/{n}"
+            return dt
+
+        def timed_sequencing(root: str) -> float:
+            d = os.path.join(scratch, root)
+            raw = make_topic(_topic_path(d, "rawdeltas"), log_format)
+            for i in range(0, n, 8192):
+                raw.append_many(workload[i:i + 8192])
+            deli = DeliRole(d, "bench-deli", ttl_s=3600.0,
+                            batch=8192, log_format=log_format)
+            t0 = time.perf_counter()
+            while deli.step() > 0:
+                pass
+            return time.perf_counter() - t0
+
+        # Best of two per loop: the two rates sit close by design
+        # (both are one read+transform+append pass), so scheduler
+        # noise would otherwise dominate the overhead ratio.
+        t_ing = min(timed_admission("adm1"), timed_admission("adm2"))
+        t_seq = min(timed_sequencing("seq1"), timed_sequencing("seq2"))
+        # Bare routing baseline (the old ingress edge).
+        route_dir = os.path.join(scratch, "route")
+        router = ShardRouter(route_dir, n_partitions, log_format)
+        t0 = time.perf_counter()
+        for i in range(0, n, 8192):
+            router.append(workload[i:i + 8192])
+        t_route = time.perf_counter() - t0
+        # Overhead in the farm's PIPELINED topology: stages run as
+        # separate processes, so the front door costs end-to-end
+        # throughput only where admission becomes the new bottleneck —
+        # overhead = how much slower min(admission, sequencing) runs
+        # than sequencing alone. The SERIAL view (the extra hop +
+        # checks as a fraction of sequencing work) rides alongside as
+        # `serial_overhead_pct`.
+        adm_rate = n / max(1e-9, t_ing)
+        seq_rate = n / max(1e-9, t_seq)
+        overhead_pct = max(
+            0.0, seq_rate / min(adm_rate, seq_rate) - 1.0
+        ) * 100
+        serial_overhead_pct = \
+            max(0.0, t_ing - t_route) / max(1e-9, t_seq) * 100
+        # --- phase 2: overload ------------------------------------
+        ov_dir = os.path.join(scratch, "ov")
+        ov_ing = IngressRole(
+            ov_dir, "ov-ingress", ttl_s=3600.0, batch=64,
+            log_format=log_format, backlog_max=overload_backlog,
+            backlog_poll_s=0.0,  # exact backlog per record: the bound
+            #                      is then budget + one admit batch
+            retry_after_s=0.01,
+        )
+        ov_deli = DeliRole(ov_dir, "ov-deli", ttl_s=3600.0, batch=16,
+                           log_format=log_format)
+        ov_topic = make_topic(
+            os.path.join(ov_dir, "topics", "ingress.jsonl"), log_format
+        )
+        raw_topic = make_topic(
+            _topic_path(ov_dir, "rawdeltas"), log_format
+        )
+        storm = [{"kind": "op", "doc": "hotdoc", "client": 1,
+                  "clientSeq": i + 1, "refSeq": 0, "contents": {"i": i}}
+                 for i in range(overload_records)]
+        storm.insert(0, {"kind": "join", "doc": "hotdoc", "client": 1})
+        max_backlog = 0
+        fed = 0
+        while fed < len(storm):
+            chunk = storm[fed:fed + 64]
+            fed += len(chunk)
+            ov_topic.append_many(chunk)
+            ov_ing.step()   # admits up to the gate, throttle-nacks past
+            ov_deli.step()  # drains slower than the feed by design
+            entries, total = raw_topic.read_entries(0)
+            max_backlog = max(max_backlog, total - ov_deli.offset)
+        budget = overload_backlog + 64  # + one admit batch of slack
+        assert max_backlog <= budget, (
+            f"overload backlog {max_backlog} burst past the bound "
+            f"{budget} (backlog_max={overload_backlog})"
+        )
+        nacks_topic = make_topic(
+            os.path.join(ov_dir, "topics", "nacks.jsonl"), log_format
+        )
+        throttled = [r for r in nacks_topic.read_from(0)
+                     if isinstance(r, dict) and str(
+                         r.get("reason", "")).startswith("backpressure")]
+        assert throttled, "overload produced no throttle nacks"
+        # Retry-and-converge (the real client contract): resubmit the
+        # remaining tail in ascending clientSeq windows until the
+        # whole storm is sequenced. Admission gates admit PREFIXES of
+        # an ascending batch (the backlog estimate is monotone within
+        # one pump), so per-client order survives the retries and the
+        # deli's dedup silences every duplicate copy.
+        retries = 0
+        deadline = time.time() + 120.0
+        deltas_topic = make_topic(
+            _topic_path(ov_dir, "deltas"), log_format
+        )
+        ops: List[dict] = []
+        while time.time() < deadline:
+            ops = [r for r in deltas_topic.read_from(0)
+                   if isinstance(r, dict) and r.get("kind") == "op"
+                   and r.get("type") == "op"]
+            if len(ops) >= overload_records:
+                break
+            frontier = max((r["clientSeq"] for r in ops), default=0)
+            window = [r for r in storm if r["kind"] == "op"
+                      and frontier < r["clientSeq"] <= frontier + 64]
+            retries += len(window)
+            ov_topic.append_many(window)
+            ov_ing.step()
+            ov_deli.step()
+        keys = [(r["doc"], r["client"], r["clientSeq"]) for r in ops]
+        assert len(ops) == overload_records and \
+            len(set(keys)) == overload_records, (
+                f"overload storm did not converge exactly-once: "
+                f"{len(ops)} ops, {len(set(keys))} unique"
+            )
+        return {
+            "metric": "ingress_front_door",
+            "records": n,
+            "partitions": n_partitions,
+            "log_format": log_format,
+            "ops_per_sec": round(n / t_ing, 1),  # admission (headline)
+            "route_ops_per_sec": round(n / t_route, 1),
+            "sequencing_ops_per_sec": round(n / t_seq, 1),
+            "admission_overhead_pct": round(overhead_pct, 2),
+            "serial_overhead_pct": round(serial_overhead_pct, 2),
+            "overload": {
+                "records": overload_records,
+                "backlog_max": overload_backlog,
+                "max_backlog_seen": int(max_backlog),
+                "backlog_bound": budget,
+                "throttle_nacks": len(throttled),
+                "retries": retries,
+                "sequenced_exactly_once": True,
+            },
+            "gate": ("all valid records admitted; overload backlog "
+                     "bounded with visible throttle nacks; storm "
+                     "retried to exactly-once convergence"),
+            "unit": "records/s",
+        }
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+
+
 def build_mergetree_stream(n_ops: int, n_clients: int = 4,
                            seed: int = 10, doc: str = "doc0",
                            window: int = 64,
@@ -1304,18 +1518,23 @@ def _span_quantiles(samples: List[float]) -> dict:
 
 def _run_latency_variant(shared: str, doorbell: bool, rate_hz: float,
                          duration_s: float, n_docs: int, n_clients: int,
-                         ttl_s: float, timeout_s: float) -> dict:
+                         ttl_s: float, timeout_s: float,
+                         fused_hop: bool = False) -> dict:
     """One open-loop run against the supervised farm: fixed-rate
     submits (never waiting on completion — OPEN loop, so a backlogged
     pipeline shows up as latency, not as a silently slower load), wire
-    traces on, spans read back off the broadcast/durable tails."""
+    traces on, spans read back off the broadcast/durable tails.
+    `fused_hop` collapses scriptorium+broadcaster into the fused
+    consumer — same topics, one fewer wake in the path — so the
+    open-loop p99 delta of the fused hop is measurable at the same
+    load (ROADMAP item-1 follow-up c)."""
     from ..server.queue import SharedFileTopic, TailReader
     from ..server.supervisor import ServiceSupervisor
     from ..utils import metrics as _metrics
 
     sup = ServiceSupervisor(
         shared, roles=("deli", "scriptorium", "broadcaster"),
-        ttl_s=ttl_s,
+        ttl_s=ttl_s, fused_hop=fused_hop,
         child_env={"FLUID_TRACE_WIRE": "1",
                    "FLUID_DOORBELL": "1" if doorbell else "0"},
         # Heartbeat throttle for BOTH variants (identical treatment):
@@ -1472,6 +1691,7 @@ def _run_latency_variant(shared: str, doorbell: bool, rate_hz: float,
             )
     return {
         "doorbell": doorbell,
+        "fused_hop": fused_hop,
         "records": total,
         "lead_in": lead_in,
         "rate_hz": rate_hz,
@@ -1560,7 +1780,8 @@ def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
                       n_docs: int = 2, n_clients: int = 2,
                       ttl_s: float = 0.75, timeout_s: float = 60.0,
                       attempts: int = 2,
-                      work_dir: Optional[str] = None) -> dict:
+                      work_dir: Optional[str] = None,
+                      fused_hop: bool = False) -> dict:
     """Submit→stamp→durable→broadcast latency SLO of the supervised
     farm under a steady OPEN-loop load (fixed rate, never waiting on
     completion), doorbells ON vs the polling baseline at the same
@@ -1569,6 +1790,13 @@ def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
     inside every variant regardless of host size — the
     p99-improvement judgment lives in `bench_configs.config9_latency`
     (loud skip under 4 cores, where the ratio measures the scheduler).
+
+    With `fused_hop`, a THIRD variant runs the fused
+    durable+broadcast consumer (doorbells on, same load): the
+    open-loop p99 delta of one fewer wake+fsync in the path, reported
+    as `fused_vs_split_p99` / `fused_p99_ms` (ROADMAP item-1
+    follow-up c — config9 records it in its MEASURED section and the
+    bench_trend ledger).
 
     Scratch defaults to tmpfs (/dev/shm) when present: the bench
     measures the POLL-INTERVAL stack, and on a slow/network filesystem
@@ -1579,8 +1807,11 @@ def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
         dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
     )
     try:
+        variants = [("poll", False, False), ("doorbell", True, False)]
+        if fused_hop:
+            variants.append(("fused", True, True))
         runs = {}
-        for name, doorbell in (("poll", False), ("doorbell", True)):
+        for name, doorbell, fused in variants:
             # Best-of-N per variant (the config5_metrics_overhead
             # pattern): a virtualized host's wake-from-idle jitter
             # lands ~10ms stalls on ~1% of EVENT wakes in an unlucky
@@ -1592,7 +1823,7 @@ def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
                 os.makedirs(vdir, exist_ok=True)
                 res = _run_latency_variant(
                     vdir, doorbell, rate_hz, duration_s, n_docs,
-                    n_clients, ttl_s, timeout_s,
+                    n_clients, ttl_s, timeout_s, fused_hop=fused,
                 )
                 if (best is None
                         or res["submit_to_broadcast_ms"]["p99"]
@@ -1606,12 +1837,12 @@ def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
                      2)
             for q in ("p50", "p99")
         }
-        return {
+        out = {
             "metric": "latency_slo_open_loop",
             "rate_hz": rate_hz,
             "records_per_variant": runs["poll"]["records"],
             "docs": n_docs, "clients_per_doc": n_clients,
-            "runs": [runs["poll"], runs["doorbell"]],
+            "runs": [runs[name] for name, _d, _f in variants],
             "p50_improvement": imp["p50"],
             "p99_improvement": imp["p99"],
             "cores": os.cpu_count(),
@@ -1619,6 +1850,16 @@ def run_latency_bench(rate_hz: float = 150.0, duration_s: float = 4.0,
                      "histograms == wire spans"),
             "unit": "ms",
         }
+        if fused_hop:
+            split_p99 = runs["doorbell"]["submit_to_broadcast_ms"]["p99"]
+            fused_p99 = runs["fused"]["submit_to_broadcast_ms"]["p99"]
+            out["fused_p99_ms"] = fused_p99
+            out["fused_p50_ms"] = \
+                runs["fused"]["submit_to_broadcast_ms"]["p50"]
+            out["fused_vs_split_p99"] = round(
+                split_p99 / max(1e-9, fused_p99), 2
+            )
+        return out
     finally:
         if work_dir is None:
             shutil.rmtree(scratch, ignore_errors=True)
@@ -1655,6 +1896,7 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             * scale,
             n_docs=int(os.environ.get("BD_DOCS", "2")),
             n_clients=int(os.environ.get("BD_CLIENTS", "2")),
+            fused_hop=bool(os.environ.get("BD_FUSED_HOP")),
         )
         print(json.dumps(res))
         return
@@ -1673,6 +1915,20 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             log_lengths=lens,
             summary_ops=int(os.environ.get("BD_SUMMARY_OPS", "2000")),
             n_subscribers=int(os.environ.get("BD_SUBSCRIBERS", "200")),
+            log_format=os.environ.get("BD_LOG_FORMAT", "json"),
+        )
+        print(json.dumps(res))
+        return
+    if os.environ.get("BD_INGRESS"):
+        # Front-door mode (tools/bench_deli.py --ingress): admission
+        # throughput + the bounded-backlog overload episode
+        # (bench_configs config12_front_door's engine).
+        res = run_ingress_bench(
+            n_docs=max(8, int(int(os.environ.get("BD_DOCS", "2000"))
+                              * scale)),
+            n_clients=int(os.environ.get("BD_CLIENTS", "16")),
+            ops_per_client=int(os.environ.get("BD_OPS", "2")),
+            n_partitions=int(os.environ.get("BD_PARTITIONS", "2")),
             log_format=os.environ.get("BD_LOG_FORMAT", "json"),
         )
         print(json.dumps(res))

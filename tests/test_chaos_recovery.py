@@ -408,3 +408,58 @@ def test_supervised_farm_processes_after_restart(tmp_path):
         assert sup.restarts["deli"] >= 1
     finally:
         sup.stop()
+
+
+# ---------------------------------------------------------------------------
+# front door + autoscale (ISSUE 12 acceptance gates)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_front_door_autoscale_storm_split_converges(tmp_path):
+    """THE front-door acceptance gate: kernel x columnar ELASTIC
+    fabric with the supervised admission ingress, per-partition
+    downstream stages and the load-driven autoscale policy all on,
+    kill faults landing on workers AND the front door, boxcars in
+    flight — a POLICY-driven split must fire mid-stream, every
+    unauthorized/oversized submit must be nacked-never-sequenced
+    (exactly once, across the ingress kill), and the merged stream
+    plus both downstream legs must converge bit-identical with zero
+    dup/skip."""
+    res = run_chaos(ChaosConfig(
+        seed=12, faults=("kill",), n_docs=2, n_clients=3,
+        ops_per_client=24, boxcar_rate=0.35, timeout_s=300.0,
+        deli_impl="kernel", log_format="columnar",
+        n_partitions=2, n_workers=2, elastic=True,
+        ingress=True, autoscale=True, downstream="split",
+        shared_dir=str(tmp_path),
+    ))
+    assert res.converged, res.detail
+    assert res.duplicate_seqs == 0 and res.skipped_seqs == 0
+    # A LOAD-driven topology change actually fired mid-stream.
+    assert res.autoscale_actions > 0 and len(res.epochs) > 1, res.detail
+    # The nack taxonomy on the wire: tampered/oversized/unknown-tenant
+    # submits all rejected, never sequenced, exactly once each.
+    assert res.never_sequenced_ok
+    assert res.ingress_nacks.get("auth", 0) >= 2
+    assert res.ingress_nacks.get("size", 0) >= 1
+    # Downstream legs bit-identical through the policy split + kills.
+    assert res.downstream_ok
+
+
+@pytest.mark.chaos
+def test_front_door_overload_throttle_retry_converges(tmp_path):
+    """The overload episode: a small per-partition backlog budget
+    forces throttle nacks mid-storm; the feeder retries per the
+    client contract and the stream still converges bit-identical —
+    overload degrades visibly, never unboundedly and never lossily."""
+    res = run_chaos(ChaosConfig(
+        seed=5, faults=(), n_docs=2, n_clients=3, ops_per_client=20,
+        n_partitions=2, n_workers=2, timeout_s=240.0,
+        ingress=True, ingress_backlog=6,
+        shared_dir=str(tmp_path),
+    ))
+    assert res.converged, res.detail
+    assert res.ingress_nacks.get("backpressure", 0) > 0, res.detail
+    assert res.throttle_retries > 0
+    assert res.never_sequenced_ok

@@ -572,3 +572,101 @@ def test_shard_bench_gates_bit_identity(tmp_path):
     assert res["runs"][1]["partitions"] == 2
     assert sum(res["runs"][1]["per_partition_records"]) == res["records"]
     assert res["speedup"] > 0
+
+# ---------------------------------------------------------------------------
+# per-partition downstream stages (static fabric, front-door PR)
+# ---------------------------------------------------------------------------
+
+
+def test_worker_runs_fused_downstream_per_partition(tmp_path):
+    """ShardWorker(downstream="fused"): every owned partition gets its
+    own fused durable+broadcast consumer (deltas-p{k} -> durable-p{k}
+    + broadcast-p{k}) and scribe, riding deli ownership under their
+    own fenced leases."""
+    import json as _json
+
+    from fluidframework_tpu.server.supervisor import canonical_record
+
+    shared = str(tmp_path)
+    n_p = 2
+    router = ShardRouter(shared, n_p)
+    w = ShardWorker(shared, "wA", n_partitions=n_p, ttl_s=5.0,
+                    downstream="fused")
+    w.heartbeat()
+    w.sweep()
+    assert set(w.down_roles) == set(w.roles)
+    fused = w.down_roles[0][0]
+    assert fused.bc_topic_name == "broadcast-p0"
+    assert fused.name == "scriptorium_broadcaster-p0"
+    docs = spread_doc_names(6, n_p)
+    workload = _fabric_workload(docs, ops=4)
+    router.append(workload)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        moved = w.step()
+        durable = []
+        for p in range(n_p):
+            t = make_topic(_topic_path(shared, f"durable-p{p}"))
+            durable.extend(r for r in t.read_from(0)
+                           if isinstance(r, dict)
+                           and r.get("kind") == "op")
+        if len(durable) >= len(workload) and moved == 0:
+            break
+    deltas_ops = _merged_ops(router)
+    assert len(deltas_ops) == len(workload)
+    want = sorted(_json.dumps(canonical_record(r), sort_keys=True)
+                  for r in deltas_ops)
+    for base in ("durable", "broadcast"):
+        got = []
+        for p in range(n_p):
+            t = make_topic(_topic_path(shared, f"{base}-p{p}"))
+            got.extend(r for r in t.read_from(0)
+                       if isinstance(r, dict) and r.get("kind") == "op")
+        assert sorted(
+            _json.dumps(canonical_record(r), sort_keys=True)
+            for r in got
+        ) == want, f"{base} legs diverged"
+    # Scribe folded every partition's stream under its own lease.
+    total = 0
+    for roles in w.down_roles.values():
+        scribe = next(r for r in roles if r.role_base == "scribe")
+        total += sum(int(st["count"]) for st in scribe.docs.values())
+    assert total == len(deltas_ops)
+    # Downstream leases are real: per-partition names, fenced.
+    owners = lease_table(os.path.join(shared, "leases"))
+    assert "scriptorium_broadcaster-p0" in owners
+    assert "scribe-p1" in owners
+    w.stop()
+
+
+def test_downstream_validation():
+    with pytest.raises(ValueError):
+        ShardWorker("/tmp/x-nonexistent-vald", "w", downstream="bogus")
+    with pytest.raises(ValueError):
+        ShardWorker("/tmp/x-nonexistent-vald", "w", elastic=True,
+                    downstream="fused")
+    from fluidframework_tpu.server.shard_fabric import ranged_role_class
+    from fluidframework_tpu.server.supervisor import (
+        ScriptoriumBroadcasterRole,
+    )
+
+    with pytest.raises(ValueError):
+        ranged_role_class(
+            ScriptoriumBroadcasterRole,
+            {"rid": "r0", "lo": 0, "hi": 10, "preds": []}, 1,
+        )
+
+
+def test_merged_reader_reads_downstream_stage(tmp_path):
+    """MergedDeltasReader(base=...) is the elastic read surface for
+    ANY stage's legs, not just deltas."""
+    shared = str(tmp_path)
+    router = ShardRouter(shared, 2)
+    for p in range(2):
+        t = make_topic(_topic_path(shared, f"durable-p{p}"))
+        t.append_many([{"kind": "op", "doc": f"d{p}", "seq": 1,
+                        "inOff": 0}])
+    reader = router.merged_reader("durable")
+    recs = reader.poll()
+    assert {r["doc"] for r in recs} == {"d0", "d1"}
+    assert reader.poll() == []  # incremental: nothing new

@@ -44,6 +44,7 @@ PACKAGES = [
     "fluidframework_tpu.server",
     "fluidframework_tpu.server.columnar_log",
     "fluidframework_tpu.server.deli_kernel",
+    "fluidframework_tpu.server.ingress",
     "fluidframework_tpu.server.monitor",
     "fluidframework_tpu.server.queue",
     "fluidframework_tpu.server.riddler",

@@ -59,6 +59,15 @@ replay). This tool measures the rest and writes BENCH_DETAIL.json:
   digest gate and a chaos summarizer-kill convergence run always
   run; the perf asserts skip loudly on < 4 cores or a sub-100k
   scaled run.
+- config 12: front-door guard — the supervised admission ingress
+  (server.ingress: riddler tokens, size caps, rate/backpressure
+  nacks) must cost the config-5 pipeline < 5% end-to-end (pipelined
+  definition; serial view reported), the overload episode must keep
+  the raw backlog bounded with visible throttle nacks and converge
+  exactly-once after retries, and a kernel x columnar ELASTIC chaos
+  run with ingress + load-driven autoscale + per-partition
+  downstream stages must converge bit-identical through kill faults
+  and a POLICY-driven split (every host).
 
 The TypeScript baselines for these configs cannot be measured in this
 environment: the reference's harnesses need node + a pnpm/lerna
@@ -626,6 +635,12 @@ def config9_latency(min_p99_improvement: float = 3.0,
     res = run_latency_bench(
         rate_hz=60.0 if small else 150.0,
         duration_s=max(1.0, (2.0 if small else 4.0) * SCALE),
+        # Third variant: the fused durable+broadcast consumer at the
+        # same load — the open-loop p99 delta of one fewer wake+fsync
+        # (ROADMAP item-1 follow-up c), recorded in this config's
+        # MEASURED section and (ungated — the ratio is wake-jitter-
+        # bound on small hosts) in the bench_trend ledger.
+        fused_hop=True,
     )
     # Doorbells ride every farm topic by default — prove the chaos
     # exactly-once contract still holds with them waking consumers
@@ -663,6 +678,16 @@ def config9_latency(min_p99_improvement: float = 3.0,
         "chaos_restarts": chaos.restarts,
         "wake_jitter_probe_ms": probe,
         **res,
+        # The fused-hop p99 delta rides the ledger as its OWN metric
+        # line, recorded-but-never-gated (a ~1x ratio on a jittery CI
+        # host must not flap the regression gate).
+        "_extra_trend": [{
+            "metric": "latency_fused_hop",
+            "fused_vs_split_p99": res.get("fused_vs_split_p99"),
+            "fused_p99_ms": res.get("fused_p99_ms"),
+            "skipped": ("recorded-not-gated: open-loop p99 ratio is "
+                        "wake-jitter-bound on small hosts"),
+        }],
     }
     jittery = probe["p99"] > max_wake_jitter_p99_ms
     if small or jittery:
@@ -791,6 +816,70 @@ def config11_fused_hop(min_reduction: float = 1.5) -> dict:
     return result
 
 
+def config12_front_door(max_overhead_pct: float = 5.0) -> dict:
+    """Front-door guard (ROADMAP item 2, the alfred admission edge):
+
+    - ADMISSION OVERHEAD: the supervised ingress (riddler token
+      validation, size caps, routing — auth ON with per-doc signed
+      tokens) must cost the config-5 pipeline less than
+      `max_overhead_pct` percent end-to-end. Stages run as separate
+      farm processes, so the pipelined definition applies: overhead is
+      the bottleneck slowdown, zero while admission outruns the
+      sequencing stage (the serial extra-hop view rides the MEASURED
+      section as `serial_overhead_pct`). Count/ratio-based on in-proc
+      roles — no core-count skip.
+    - OVERLOAD: `run_ingress_bench` asserts internally (the gate runs
+      before any number is reported) that a storm against a small
+      backlog budget keeps the rawdeltas backlog BOUNDED while
+      throttle nacks flow, and that the retried storm converges
+      exactly-once once pressure lifts.
+    - CHAOS (every host): a kernel × columnar ELASTIC run with the
+      front door and the load-driven autoscale policy on, kill faults
+      landing on workers AND the ingress, boxcars in flight — a
+      POLICY-driven split must fire mid-stream, every bad submit must
+      be nacked-never-sequenced, and the merged stream (plus the
+      per-partition downstream durable/broadcast legs) must converge
+      bit-identical with zero dup/skip."""
+    from fluidframework_tpu.testing.chaos import ChaosConfig, run_chaos
+    from fluidframework_tpu.testing.deli_bench import run_ingress_bench
+
+    res = run_ingress_bench(
+        n_docs=max(8, int(2000 * SCALE)), n_clients=16,
+        ops_per_client=2,
+        overload_records=max(256, int(1200 * SCALE)),
+    )
+    chaos = run_chaos(ChaosConfig(
+        seed=12, faults=("kill",), n_docs=2, n_clients=3,
+        ops_per_client=24, boxcar_rate=0.35, timeout_s=300.0,
+        deli_impl="kernel", log_format="columnar",
+        n_partitions=2, n_workers=2, elastic=True,
+        ingress=True, autoscale=True, downstream="split",
+    ))
+    assert chaos.converged, (
+        f"front-door chaos run diverged: {chaos.detail}"
+    )
+    assert chaos.never_sequenced_ok and chaos.downstream_ok
+    assert chaos.autoscale_actions > 0 and len(chaos.epochs) > 1, (
+        f"no policy-driven split fired: epochs={chaos.epochs} "
+        f"actions={chaos.autoscale_actions}"
+    )
+    result = {
+        "config": "front_door_guard",
+        "max_overhead_pct": max_overhead_pct,
+        "chaos_front_door_converged": True,
+        "chaos_epochs": chaos.epochs,
+        "chaos_autoscale_actions": chaos.autoscale_actions,
+        "chaos_ingress_nacks": chaos.ingress_nacks,
+        **res,
+    }
+    assert res["admission_overhead_pct"] < max_overhead_pct, (
+        f"front-door admission cost the pipeline "
+        f"{res['admission_overhead_pct']:.1f}% end-to-end "
+        f"(budget {max_overhead_pct}%): {result}"
+    )
+    return result
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -866,13 +955,19 @@ def config_streaming_ingress(n_ops: int = 100_000,
 
 def main() -> None:
     results = []
+    extra_trend = []
     for fn in (config1_sharedstring_2client, config3_matrix,
                config4_tree_rebase, config5_deli, config5_deli_pipeline,
                config5_metrics_overhead, config5_log_format,
                config6_shard_scaling, config7_multichip,
                config8_rebalance, config9_latency, config10_catchup,
-               config11_fused_hop, config_streaming_ingress):
+               config11_fused_hop, config12_front_door,
+               config_streaming_ingress):
         r = fn()
+        # Side metrics a config wants in the trend ledger as their own
+        # lines (e.g. config9's fused-hop latency delta) ride out via
+        # "_extra_trend" — recorded, popped from the config's row.
+        extra_trend.extend(r.pop("_extra_trend", []))
         results.append(r)
         print(json.dumps(r), file=sys.stderr)
     path = os.path.join(os.path.dirname(os.path.dirname(
@@ -906,7 +1001,7 @@ def main() -> None:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from bench_trend import append_and_gate
 
-    failures = append_and_gate(path, results)
+    failures = append_and_gate(path, results + extra_trend)
     for msg in failures:
         print(f"REGRESSION: {msg}", file=sys.stderr)
     print(json.dumps({"configs": len(results),

@@ -77,8 +77,22 @@ consumer over one workload — drain ops/s, the hop pair's
 fsyncs-per-record, and the `hop_fsync_reduction` headline, with both
 topologies' durable+broadcast streams gated bit-identical.
 
+`--latency --fused-hop` adds a THIRD open-loop variant running the
+fused durable+broadcast consumer at the same load: the p99 delta of
+one fewer wake+fsync in the path (`fused_vs_split_p99`,
+`fused_p99_ms` — ROADMAP item-1 follow-up c, config9's MEASURED
+section).
+
+`--ingress` switches to the FRONT-DOOR mode
+(`testing.deli_bench.run_ingress_bench`, bench_configs
+`config12_front_door`'s engine): admission throughput (riddler
+tokens + size caps through `server.ingress.IngressRole`) vs bare
+routing vs sequencing, plus the overload episode — bounded backlog,
+visible throttle nacks, retry-and-converge exactly-once.
+
 Usage: python tools/bench_deli.py
-    [--shard | --devices [LIST] | --latency | --catchup | --hops]
+    [--shard | --devices [LIST] | --latency [--fused-hop]
+     | --catchup | --hops | --ingress]
 """
 
 from __future__ import annotations
@@ -126,6 +140,15 @@ if "--latency" in sys.argv:
     # knobs: BD_RATE_HZ (150), BD_DURATION_S (4), BD_DOCS (2),
     # BD_CLIENTS (2). See testing.deli_bench.run_latency_bench.
     os.environ["BD_LATENCY"] = "1"
+    if "--fused-hop" in sys.argv:
+        os.environ["BD_FUSED_HOP"] = "1"
+
+if "--ingress" in sys.argv:
+    # Front-door mode: admission throughput + the overload episode
+    # (bench_configs config12_front_door's engine). Env knobs:
+    # BD_DOCS (2000), BD_CLIENTS (16), BD_OPS (2), BD_LOG_FORMAT
+    # (json), BD_PARTITIONS (2).
+    os.environ["BD_INGRESS"] = "1"
 
 if "--devices" in sys.argv:
     # Multi-device scaling mode: `--devices [1,4,8]` measures the

@@ -50,6 +50,9 @@ HEADLINE_FIELDS = (
     "speedup",                  # scaling benches (ratio)
     "columnar_vs_json",         # log-format guard (ratio)
     "hop_fsync_reduction",      # fused durable+broadcast hop (ratio)
+    "fused_vs_split_p99",       # fused-hop open-loop latency (ratio;
+    #                             recorded with a skipped flag — the
+    #                             jitter-bound ratio is never gated)
 )
 
 

@@ -7,6 +7,30 @@ Run: python tools/chaos_run.py --seed N
         [--boxcar-rate R] [--metrics-out PATH] [--trace-wire]
         [--partitions N] [--workers W] [--devices N] [--elastic]
         [--summarizer] [--summary-ops N] [--fused-hop]
+        [--ingress [--bad-submits N] [--ingress-rate R]
+         [--ingress-backlog B]] [--autoscale]
+        [--downstream fused|split]
+
+`--ingress` (with `--partitions` > 1) puts the supervised admission
+front door (`server.ingress.IngressRole`) in front of the fabric: the
+workload feeds the `ingress` topic with signed tenant tokens, the
+front door joins the kill schedule, `--bad-submits` seeded invalid
+records (tampered token / oversized / unknown tenant) must each be
+nacked exactly once and NEVER sequenced, and throttle-nacked valid
+submits are retried to convergence. `--ingress-rate` /
+`--ingress-backlog` stage an overload episode (per-tenant token
+bucket / per-partition backlog budget) whose throttle nacks and
+bounded backlog ride the verdict.
+
+`--autoscale` (elastic) closes the scaling loop: the fabric
+supervisor's `AutoscalePolicy` watches per-partition throughput and
+stages splits/merges itself — convergence then also requires a
+POLICY-driven epoch change to have fired mid-stream.
+
+`--downstream fused|split` promotes scriptorium/broadcaster/scribe to
+per-partition supervised consumers inside the workers; convergence
+then also requires the merged durable AND broadcast legs bit-identical
+to the golden with zero dup/skip.
 
 `--fused-hop` collapses the scriptorium+broadcaster pair into the ONE
 fused durable+broadcast consumer
@@ -140,6 +164,16 @@ def main() -> int:
     fused_hop = "--fused-hop" in args
     if fused_hop:
         args.remove("--fused-hop")
+    ingress = "--ingress" in args
+    if ingress:
+        args.remove("--ingress")
+    autoscale = "--autoscale" in args
+    if autoscale:
+        args.remove("--autoscale")
+    downstream = _take("--downstream", None)
+    bad_submits = int(_take("--bad-submits", "6"))
+    ingress_rate = float(_take("--ingress-rate", "0"))
+    ingress_backlog = int(_take("--ingress-backlog", "0"))
     summary_ops = int(_take("--summary-ops", "32"))
     if faults_arg is None:
         # Default fault set: the classic classes the chosen runner
@@ -172,10 +206,18 @@ def main() -> int:
         summarizer=summarizer,
         summary_ops=summary_ops,
         fused_hop=fused_hop,
+        ingress=ingress,
+        bad_submits=bad_submits,
+        ingress_rate=ingress_rate,
+        ingress_backlog=ingress_backlog,
+        autoscale=autoscale,
+        downstream=downstream,
     )
     unknown = set(faults) - set(ALL_FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
-            or cfg.log_format not in LOG_FORMATS):
+            or cfg.log_format not in LOG_FORMATS
+            or (downstream is not None
+                and downstream not in ("fused", "split"))):
         print(
             f"unknown faults {sorted(unknown)} / leftover args {args}; "
             f"faults are chosen from {','.join(ALL_FAULT_CLASSES)} "
@@ -209,6 +251,17 @@ def main() -> int:
         print(f"summaries     : {res.summary_manifests} manifests, "
               f"integrity {'OK' if res.summaries_ok else 'VIOLATED'} "
               f"(no fork/dup; summary+tail == cold replay)")
+    if ingress:
+        print(f"front door    : nacks={res.ingress_nacks} "
+              f"bad-never-sequenced="
+              f"{'OK' if res.never_sequenced_ok else 'VIOLATED'} "
+              f"throttle_retries={res.throttle_retries}")
+    if autoscale:
+        print(f"autoscale     : {res.autoscale_actions} policy "
+              f"action(s) staged")
+    if downstream:
+        print(f"downstream    : durable+broadcast legs "
+              f"{'match' if res.downstream_ok else 'MISMATCH'}")
     if res.epochs:
         print(f"topology epochs: {res.epochs}")
     if "disk" in faults:

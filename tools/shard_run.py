@@ -6,6 +6,7 @@ Run: python tools/shard_run.py [--partitions N] [--workers W]
         [--log-format json|columnar] [--boxcar-rate R] [--ttl S]
         [--timeout S] [--keep DIR] [--kill-worker I]
         [--elastic] [--split-mid-run] [--merge-after-split]
+        [--autoscale] [--downstream fused|split]
 
 `--elastic` runs the hash-range topology (`queue.RangeLeaseStore`):
 partitions are range leases, routed by ``(epoch, hash(doc))``, and
@@ -15,6 +16,14 @@ range once half the workload is fed (`--merge-after-split` merges the
 children back before the drain completes) — a live demonstration
 that capacity follows load without a restart: the order must not
 notice N changing mid-stream.
+
+`--autoscale` (implies elastic) hands the split decision to the
+supervisor's `AutoscalePolicy` instead: the feed is paced, the policy
+watches per-partition throughput off the worker heartbeats, and a
+LOAD-driven split must commit before the run ends — the closed
+autoscaling loop, live. `--downstream fused|split` runs per-partition
+scriptorium/broadcaster/scribe consumers inside the workers and
+verifies the merged durable leg against the golden too.
 
 Builds a seeded workload over partition-balanced doc names, starts
 `server.shard_fabric.ShardFabricSupervisor` (W supervised shard
@@ -44,6 +53,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from fluidframework_tpu.server.shard_fabric import (  # noqa: E402
+    AutoscalePolicy,
     ShardFabricSupervisor,
     ShardRouter,
     spread_doc_names,
@@ -99,11 +109,23 @@ def main() -> int:
     merge_after = "--merge-after-split" in args
     if merge_after:
         args.remove("--merge-after-split")
+    autoscale = "--autoscale" in args
+    if autoscale:
+        args.remove("--autoscale")
+        elastic = True
+    downstream = _take("--downstream", None)
     if merge_after and not split_mid_run:
         print("--merge-after-split needs --split-mid-run",
               file=sys.stderr)
         return 2
-    if args or deli not in DELI_IMPLS or log_format not in LOG_FORMATS:
+    if autoscale and split_mid_run:
+        print("--autoscale replaces --split-mid-run (the policy "
+              "stages the split)", file=sys.stderr)
+        return 2
+    if (args or deli not in DELI_IMPLS
+            or log_format not in LOG_FORMATS
+            or (downstream is not None
+                and downstream not in ("fused", "split"))):
         print(
             f"leftover args {args}; --deli is one of "
             f"{'|'.join(DELI_IMPLS)}; --log-format is one of "
@@ -127,10 +149,15 @@ def main() -> int:
 
     router = ShardRouter(shared, n_partitions, log_format,
                          elastic=elastic)
+    policy = AutoscalePolicy(
+        split_rate=5.0, merge_rate=0.01, sustain_s=max(0.5, ttl),
+        min_interval_s=max(2.0, 4 * ttl),
+        max_ranges=n_partitions + 2,
+    ) if autoscale else None
     sup = ShardFabricSupervisor(
         shared, n_workers=n_workers, n_partitions=n_partitions,
         ttl_s=ttl, deli_impl=deli, log_format=log_format,
-        elastic=elastic,
+        elastic=elastic, downstream=downstream, autoscale=policy,
     ).start()
     killed = False
     split_cmd = None
@@ -141,9 +168,20 @@ def main() -> int:
         deadline = time.time() + timeout
         ops = []
         reader = router.merged_reader()
+        dur_reader = (router.merged_reader("durable")
+                      if downstream else None)
+        dur_ops = []
+        # The autoscale demo paces the feed (~2 batches per TTL): the
+        # policy needs rate samples + its sustain window, and the
+        # point is the split landing MID-stream.
+        feed_gap = ttl / 2 if autoscale else 0.0
+        last_feed = 0.0
         while time.time() < deadline:
             sup.poll_once()
-            if fed < len(workload):
+            if fed < len(workload) and (
+                    not feed_gap
+                    or time.time() - last_feed >= feed_gap):
+                last_feed = time.time()
                 router.append(workload[fed:fed + 64])
                 fed += 64
                 if (kill_worker is not None and not killed
@@ -178,6 +216,11 @@ def main() -> int:
             # readable after E+1, incrementally.
             ops += [r for r in reader.poll()
                     if isinstance(r, dict) and r.get("kind") == "op"]
+            if dur_reader is not None:
+                dur_ops += [
+                    r for r in dur_reader.poll()
+                    if isinstance(r, dict) and r.get("kind") == "op"
+                ]
             # A requested topology change must actually COMMIT before
             # the run ends — a small workload must not outrun the demo.
             ctl_done = (
@@ -187,8 +230,14 @@ def main() -> int:
                      or (merge_cmd is not None
                          and sup.control_result(merge_cmd) is not None))
             )
+            topo_now = sup.topology() if autoscale else None
             if (fed >= len(workload) and len(ops) >= len(golden)
-                    and ctl_done):
+                    and ctl_done
+                    # The LOAD-driven split must have committed.
+                    and (not autoscale or (topo_now or {}).get(
+                        "epoch", 1) > 1)
+                    and (dur_reader is None
+                         or len(dur_ops) >= len(golden))):
                 break
             time.sleep(0.02)
         elapsed = time.time() - t0
@@ -198,6 +247,17 @@ def main() -> int:
     digest = stream_digest(ops)
     dups, skips = sequence_integrity(ops)
     converged = digest == gdigest and dups == 0 and skips == 0
+    if downstream:
+        ddigest = stream_digest(dur_ops)
+        ddups, dskips = sequence_integrity(dur_ops)
+        converged = converged and ddigest == gdigest \
+            and ddups == 0 and dskips == 0
+        print(f"durable digest: {ddigest} "
+              f"({len(dur_ops)} ops, dups={ddups} skips={dskips})")
+    if autoscale:
+        converged = converged and len(sup.autoscale.actions) > 0
+        print(f"autoscale     : {len(sup.autoscale.actions)} policy "
+              f"action(s): {sup.autoscale.actions}")
     topo = sup.topology()
     print(f"golden digest : {gdigest}")
     print(f"fabric digest : {digest}")
@@ -217,6 +277,9 @@ def main() -> int:
         "records": len(workload), "ops": len(ops),
         "seconds": round(elapsed, 3), "converged": converged,
         "restarts": sup.restarts,
+        "autoscale_actions": (len(sup.autoscale.actions)
+                              if autoscale else 0),
+        "downstream": downstream,
     }))
     print("CONVERGED" if converged else "DIVERGED")
     if keep is None and converged:
