@@ -1080,13 +1080,15 @@ class KernelDeliRole(_Role):
         self._pending.append(("cols", start_line, batch))
 
     def _plan_op(self, plan, add, line_idx, doc, slot, col, cid, cseq,
-                 ref, contents, group=NO_GROUP, sub_ts=None):
-        # `sub_ts` threads the client submit stamp (ingress "tr_sub")
+                 ref, contents, group=NO_GROUP, sub_ts=None,
+                 adm_ts=None):
+        # `sub_ts`/`adm_ts` thread the client submit stamp (ingress
+        # "tr_sub") and the front door's admission stamp ("tr_adm")
         # through the plan tuple so wire-trace mode can stamp/observe
         # at emit time — the kernel twin of the scalar role's span
-        # coverage (PR 9 follow-up b).
+        # coverage (PR 9 follow-up b; admit_to_stamp from ISSUE 13).
         plan.append((line_idx, doc, "op",
-                     (cid, cseq, ref, contents, sub_ts),
+                     (cid, cseq, ref, contents, sub_ts, adm_ts),
                      add(slot, SUB_OP, col, cseq, ref, group)))
 
     def flush_batch(self, out: List[dict]) -> None:
@@ -1128,13 +1130,15 @@ class KernelDeliRole(_Role):
                     h["cmap"].get(cid, 0), cid, rec["clientSeq"],
                     rec.get("refSeq", 0), rec.get("contents"),
                     sub_ts=rec.get("tr_sub"),
+                    adm_ts=rec.get("tr_adm"),
                 )
             elif kind == "boxcar":
                 plan_boxcar(line_idx, doc, slot, h, cid, [
                     (op["clientSeq"], op.get("refSeq", 0),
                      op.get("contents"))
                     for op in rec.get("ops") or []
-                ], sub_ts=rec.get("tr_sub"))
+                ], sub_ts=rec.get("tr_sub"),
+                    adm_ts=rec.get("tr_adm"))
             elif kind == "join":
                 conn = shadow.get(doc)
                 if conn is None:
@@ -1152,7 +1156,8 @@ class KernelDeliRole(_Role):
                 plan.append((line_idx, doc, "leave", cid,
                              add(slot, SUB_LEAVE, h["cmap"].get(cid, 0))))
 
-        def plan_boxcar(line_idx, doc, slot, h, cid, ops, sub_ts=None):
+        def plan_boxcar(line_idx, doc, slot, h, cid, ops, sub_ts=None,
+                        adm_ts=None):
             # One atomic group: a nack masks the group's tail in-kernel
             # (resubmission dedup stays per-op and silent).
             col = h["cmap"].get(cid, 0)
@@ -1160,7 +1165,7 @@ class KernelDeliRole(_Role):
             for cseq, ref, contents in ops:
                 self._plan_op(plan, add, line_idx, doc, slot, col, cid,
                               cseq, ref, contents, group=g,
-                              sub_ts=sub_ts)
+                              sub_ts=sub_ts, adm_ts=adm_ts)
 
         passthrough = self.out_columnar
         for ent in self._pending:
@@ -1298,7 +1303,7 @@ class KernelDeliRole(_Role):
         now = time.time() if trace else 0.0
 
         def emit_op(line_idx, doc, cid, cseq, ref, contents, sub_ts,
-                    handle):
+                    adm_ts, handle):
             if skips[handle]:
                 return  # deduped resubmission / aborted boxcar tail
             seq, msn, nack = seqs[handle], msns[handle], nacks[handle]
@@ -1327,14 +1332,24 @@ class KernelDeliRole(_Role):
                             "submit_to_stamp",
                             (now - sub_ts) * 1000.0,
                         )
+                if isinstance(adm_ts, (int, float)):
+                    # The front door's admission stamp: same flush
+                    # clock read, same recovery-silent rule — the
+                    # scalar role's admit_to_stamp, kernel-side.
+                    tr["adm"] = adm_ts
+                    if not self._recovering:
+                        self._observe_stage(
+                            "admit_to_stamp",
+                            (now - adm_ts) * 1000.0,
+                        )
                 rec["tr"] = tr
             emit(rec)
 
         for line_idx, doc, tag, payload, handle in plan:
             if tag == "op":
-                cid, cseq, ref, contents, sub_ts = payload
+                cid, cseq, ref, contents, sub_ts, adm_ts = payload
                 emit_op(line_idx, doc, cid, cseq, ref, contents,
-                        sub_ts, handle)
+                        sub_ts, adm_ts, handle)
             elif tag == "run":
                 j0, rb, lo, hi, _h_of = payload
                 docs = rb.docs
@@ -1348,7 +1363,7 @@ class KernelDeliRole(_Role):
                         contents = contents.value
                     emit_op(line_idx + i, docs[int(doci[i])],
                             int(clients[i]), int(cseqs[i]),
-                            int(refs[i]), contents, None,
+                            int(refs[i]), contents, None, None,
                             j0 + i - lo)
             elif tag == "join":
                 seq, msn = seqs[handle], msns[handle]
@@ -1395,7 +1410,7 @@ class KernelDeliRole(_Role):
                 seq = int(seqs[handle])
                 msn = int(msns[handle])
                 nack = int(nacks[handle])
-                cid, cseq, ref, contents, _sub = payload
+                cid, cseq, ref, contents, _sub, _adm = payload
                 if nack:
                     sc.nack(doc, cid, cseq, nack, _nack_reason(
                         nack, ref, msn, pool.head(doc), cseq,

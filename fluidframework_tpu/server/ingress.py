@@ -59,6 +59,17 @@ machinery: fenced lease, heartbeat, checkpoint cadence, exactly-once
 - **Every decision is a labeled metric**: ``ingress_admitted_total``,
   ``ingress_nacks_total{reason=...}``,
   ``ingress_backlog_gauge{partition=...}``, ``ingress_overloaded``.
+  The ``ingress_*`` counters also ride the ``/slo`` body
+  (`utils.metrics.slo_summary`) so refused load shows up next to the
+  latency quantiles of the load that was admitted.
+
+- **Admission is a traced stage.** In wire-trace mode
+  (``FLUID_TRACE_WIRE=1``) every admitted record is stamped with
+  ``tr_adm`` (one clock read — the same ``now`` the admission checks
+  use); the deli folds it into the wire ``tr`` dict as ``adm`` and
+  observes ``op_stage_ms{stage=admit_to_stamp}`` from the SAME clock
+  read that stamps the record — recovery-silent like every other
+  stage, so a restart's replay never double-observes.
 
 The socket layer tails the ``nacks`` topic
 (`socket_service.FarmReadServer(nacks=True)` pushes them to
@@ -679,6 +690,18 @@ class IngressRole(_Role):
                            retry_after=self.retry_after_s,
                            tenant=tenant_id)
                 return
+        if self.trace_wire:
+            # The admission stamp (`tr_adm`): rides the admitted wire
+            # record to the deli, which folds it into the "tr" dict as
+            # "adm" and observes op_stage_ms{stage=admit_to_stamp} —
+            # the front door's queue+hop cost becomes a first-class
+            # /slo stage. ONE clock read: `now` above already serves
+            # the rate/session checks; no extra time() on the admit
+            # path. Recovery re-decides stamp at replay time, which is
+            # still earlier than any downstream stamp of the re-emitted
+            # record, so monotonicity (adm <= stamp) holds across a
+            # crash.
+            rec2["tr_adm"] = now
         self._routed[leg] = self._routed.get(leg, 0) + 1
         self._m_admitted.inc()
         out.append(("admit", leg, rec2))

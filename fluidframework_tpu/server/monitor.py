@@ -15,7 +15,9 @@ the in-proc `LocalServer` and the supervised farm
   callback; HTTP 200 iff ``status == "ok"``, 503 otherwise.
 - ``GET /slo``           — the tail-latency summary: every histogram
   with observations reduced to count/mean/p50/p95/p99
-  (bucket-interpolated, `utils.metrics.slo_summary`).
+  (bucket-interpolated, `utils.metrics.slo_summary`), plus the
+  admission feedback counters (``ingress_*`` nack/throttle/admit
+  totals) so refused load is visible next to admitted latency.
 - ``GET /traces``        — the slow-op flight recorder's span buffer
   (`utils.metrics.FlightRecorder`): the exact ops whose end-to-end
   latency crossed the threshold/rolling p99, with all their stage

@@ -90,6 +90,7 @@ from .supervisor import (
     _topic_path,
     partitioned_role_class,
     resolve_role_class,
+    trace_wire_enabled,
 )
 
 # Downstream-stage topologies a ShardWorker can run next to each owned
@@ -120,6 +121,7 @@ __all__ = [
     "request_topology_change",
     "serve_shard_worker",
     "spread_doc_names",
+    "stage_p99s",
 ]
 
 
@@ -1161,23 +1163,35 @@ class ShardWorker:
         scrape merges one file per worker with no double counting.
         `degraded` lists partitions currently inside a storage-fault
         retry budget (ENOSPC/stall backoff) — limping, not dead — for
-        `ShardFabricSupervisor.health()` to surface."""
+        `ShardFabricSupervisor.health()` to surface. In wire-trace
+        mode the worker's slow-op flight-recorder buffer rides along
+        too (the per-partition broadcaster stages — fused or split —
+        run in THIS process and feed the process recorder, each span
+        tagged with its partition), so `/traces` is populated on the
+        elastic fabric exactly like the classic farm."""
         tmp = self._hb_path() + f".tmp.{os.getpid()}"
+        hb = {
+            "t": time.time(), "slot": self.slot, "owner": self.owner,
+            "pid": os.getpid(),
+            "partitions": sorted(
+                p for p, r in self.roles.items()
+                if r.fence is not None
+            ),
+            "degraded": sorted(
+                p for p, r in self.roles.items()
+                if getattr(r, "degraded", False)
+            ),
+            "epoch": (self.topology or {}).get("epoch"),
+            "metrics": self.metrics.snapshot(),
+        }
+        if trace_wire_enabled():
+            from ..utils.metrics import get_flight_recorder
+
+            spans = get_flight_recorder().snapshot()
+            if spans:
+                hb["slow_ops"] = spans
         with open(tmp, "w") as f:
-            json.dump({
-                "t": time.time(), "slot": self.slot, "owner": self.owner,
-                "pid": os.getpid(),
-                "partitions": sorted(
-                    p for p, r in self.roles.items()
-                    if r.fence is not None
-                ),
-                "degraded": sorted(
-                    p for p, r in self.roles.items()
-                    if getattr(r, "degraded", False)
-                ),
-                "epoch": (self.topology or {}).get("epoch"),
-                "metrics": self.metrics.snapshot(),
-            }, f)
+            json.dump(hb, f)
         os.replace(tmp, self._hb_path())
         self._hb_t = time.time()
 
@@ -1687,6 +1701,46 @@ def serve_shard_worker(shared_dir: str, slot: str,
 # ---------------------------------------------------------------------------
 
 
+def stage_p99s(snap: dict, stage: str
+               ) -> Tuple[Optional[float], Dict[str, float]]:
+    """(farm_p99, {partition: p99}) for one wire-trace stage off a
+    metrics snapshot. Per-partition series come straight from the
+    ``op_stage_ms{stage=...,partition=k}`` histograms the ranged roles
+    observe; the FARM-WIDE quantile is estimated over the bucket-wise
+    SUM of every matching histogram (label-less classic series
+    included), so it stays one quantile of one distribution rather
+    than a quantile of quantiles. Beyond-last-bucket estimates are
+    dropped, not faked."""
+    from ..utils.metrics import histogram_quantile
+
+    merged: Optional[dict] = None
+    per: Dict[str, float] = {}
+    for h in snap.get("histograms", ()):
+        if (h.get("name") != "op_stage_ms"
+                or (h.get("labels") or {}).get("stage") != stage
+                or not h.get("count")):
+            continue
+        part = h["labels"].get("partition")
+        if part is not None:
+            v = histogram_quantile(h, 0.99)
+            if v != float("inf"):
+                per[part] = v
+        if merged is None:
+            merged = {"buckets": list(h["buckets"]),
+                      "counts": list(h["counts"]),
+                      "count": int(h["count"])}
+        elif merged["buckets"] == list(h["buckets"]):
+            merged["counts"] = [a + b for a, b in
+                                zip(merged["counts"], h["counts"])]
+            merged["count"] += int(h["count"])
+    farm = None
+    if merged is not None and merged["count"]:
+        v = histogram_quantile(merged, 0.99)
+        if v != float("inf"):
+            farm = v
+    return farm, per
+
+
 class AutoscalePolicy:
     """The closed autoscaling loop: a supervisor-side policy watching
     per-partition deli throughput (``role_records_total{role="deli",
@@ -1720,14 +1774,24 @@ class AutoscalePolicy:
                  min_interval_s: float = 10.0, max_ranges: int = 16,
                  min_ranges: int = 1,
                  p99_hot_ms: Optional[float] = None,
-                 p99_stage: str = "submit_to_stamp"):
+                 p99_stage: str = "submit_to_stamp",
+                 p99_per_partition: bool = False):
         """`split_rate`/`merge_rate`: records/s per range above/below
         which a range counts hot/cold. `p99_hot_ms` (optional): when
         the farm-wide `op_stage_ms{stage=p99_stage}` p99 exceeds it,
         the HIGHEST-rate range counts hot too — the latency-driven
         trigger for load the rate threshold alone misses (one huge doc
         in an otherwise quiet range). Needs wire tracing to populate;
-        None disables the latency trigger."""
+        None disables the latency trigger.
+
+        `p99_per_partition=True` sharpens the latency trigger to the
+        PER-RANGE quantiles (the ``op_stage_ms{stage=...,partition=k}``
+        series the ranged roles observe into their worker heartbeats):
+        a range whose OWN p99 exceeds `p99_hot_ms` counts hot,
+        regardless of the farm-wide quantile or where the record rate
+        is highest — one hot range in a quiet farm triggers its own
+        split instead of hiding inside a healthy farm-wide p99 (or
+        splitting the wrong, merely-busiest, range)."""
         if merge_rate >= split_rate:
             raise ValueError(
                 f"hysteresis requires merge_rate < split_rate "
@@ -1741,6 +1805,7 @@ class AutoscalePolicy:
         self.min_ranges = int(min_ranges)
         self.p99_hot_ms = p99_hot_ms
         self.p99_stage = p99_stage
+        self.p99_per_partition = bool(p99_per_partition)
         self._last_sample: Optional[Tuple[float, Dict[str, float]]] = None
         self.hot_since: Dict[str, float] = {}
         self.cold_since: Dict[str, float] = {}
@@ -1773,11 +1838,15 @@ class AutoscalePolicy:
 
     def observe(self, now: float, rates: Dict[str, float],
                 topo: dict,
-                p99_ms: Optional[float] = None) -> Optional[dict]:
+                p99_ms: Optional[float] = None,
+                p99_by_partition: Optional[Dict[str, float]] = None,
+                ) -> Optional[dict]:
         """Fold one sample; returns a command dict ({"op": "split",
         "rid": ...} / {"op": "merge", "rids": [...]}) when the policy
         fires, else None. The caller stages it and must not call
-        `observe` with a pending unexecuted command."""
+        `observe` with a pending unexecuted command. `p99_by_partition`
+        (range id -> that range's own stage p99, ms) feeds the
+        `p99_per_partition` trigger; ignored otherwise."""
         ranges = sorted(topo["ranges"], key=lambda e: e["lo"])
         live = {e["rid"] for e in ranges}
         for d in (self.hot_since, self.cold_since):
@@ -1785,12 +1854,20 @@ class AutoscalePolicy:
                 d.pop(rid)
         hottest = max(rates, key=lambda r: rates[r]) if rates else None
         latency_hot = (
-            self.p99_hot_ms is not None and p99_ms is not None
+            not self.p99_per_partition
+            and self.p99_hot_ms is not None and p99_ms is not None
             and p99_ms > self.p99_hot_ms
         )
+        per_p99 = p99_by_partition or {}
         for rid in live:
             rate = rates.get(rid, 0.0)
-            if rate > self.split_rate or (latency_hot and rid == hottest):
+            own_p99 = per_p99.get(rid)
+            own_hot = (
+                self.p99_per_partition and self.p99_hot_ms is not None
+                and own_p99 is not None and own_p99 > self.p99_hot_ms
+            )
+            if rate > self.split_rate or own_hot \
+                    or (latency_hot and rid == hottest):
                 self.hot_since.setdefault(rid, now)
             else:
                 self.hot_since.pop(rid, None)
@@ -2062,19 +2139,12 @@ class ShardFabricSupervisor(ServiceSupervisor):
         if rates is None:
             return None  # need two samples for a rate
         p99 = None
+        p99_by_part: Optional[Dict[str, float]] = None
         if pol.p99_hot_ms is not None:
-            from ..utils.metrics import histogram_quantile
-
             snap = self.collect_metrics().snapshot()
-            for h in snap.get("histograms", ()):
-                if (h["name"] == "op_stage_ms"
-                        and h["labels"].get("stage") == pol.p99_stage
-                        and h.get("count")):
-                    v = histogram_quantile(h, 0.99)
-                    if v != float("inf"):
-                        p99 = v
-                    break
-        cmd = pol.observe(now, rates, topo, p99_ms=p99)
+            p99, p99_by_part = stage_p99s(snap, pol.p99_stage)
+        cmd = pol.observe(now, rates, topo, p99_ms=p99,
+                          p99_by_partition=p99_by_part)
         if cmd is None:
             return None
         why = cmd.pop("why", "autoscale")

@@ -376,11 +376,20 @@ class _Role:
     def _observe_stage(self, stage: str, ms: float) -> None:
         """Fold one wire-trace stage latency into `op_stage_ms` (the
         same histogram family the in-proc pipeline feeds; instruments
-        cached per stage)."""
+        cached per stage). Partitioned/ranged roles label the series
+        with their partition too — the worker heartbeat then carries
+        per-partition stage histograms, the supervisor scrape merges
+        them, and the `_q` quantile gauges come out labeled
+        ``{partition=k}`` (the per-range p99 the autoscale policy's
+        `p99_per_partition` trigger reads). Classic single-partition
+        roles keep the historic label set."""
         h = self._stage_hists.get(stage)
         if h is None:
+            labels = {"stage": stage}
+            if self.partition is not None:
+                labels["partition"] = str(self.partition)
             h = self._stage_hists[stage] = self.metrics.histogram(
-                "op_stage_ms", stage=stage
+                "op_stage_ms", **labels
             )
         h.observe(ms)
 
@@ -736,6 +745,7 @@ class DeliRole(_Role):
                     doc, rec["doc"], client, int(op["clientSeq"]),
                     int(op.get("refSeq", 0)), op.get("contents"),
                     line_idx, out, sub_ts=rec.get("tr_sub"),
+                    adm_ts=rec.get("tr_adm"),
                 ):
                     break
             return
@@ -744,7 +754,7 @@ class DeliRole(_Role):
         self._ticket_wire(
             doc, rec["doc"], int(rec["client"]), int(rec["clientSeq"]),
             int(rec.get("refSeq", 0)), rec.get("contents"), line_idx, out,
-            sub_ts=rec.get("tr_sub"),
+            sub_ts=rec.get("tr_sub"), adm_ts=rec.get("tr_adm"),
         )
 
     def process_batch(self, start_line: int, batch: Any,
@@ -812,8 +822,8 @@ class DeliRole(_Role):
     def _ticket_wire(self, doc: DocumentSequencer, doc_id: str,
                      client: int, client_seq: int, ref_seq: int,
                      contents: Any, line_idx: int,
-                     out: List[dict], sub_ts: Optional[float] = None
-                     ) -> bool:
+                     out: List[dict], sub_ts: Optional[float] = None,
+                     adm_ts: Optional[float] = None) -> bool:
         """Ticket one wire op; returns False on a nack (the boxcar
         abort signal). Deduped resubmissions return True silently."""
         state = doc.clients.get(client)
@@ -836,11 +846,13 @@ class DeliRole(_Role):
                 "reason": res.reason, "inOff": line_idx,
             })
             return False
-        out.append(self._wire(doc_id, res, line_idx, sub_ts=sub_ts))
+        out.append(self._wire(doc_id, res, line_idx, sub_ts=sub_ts,
+                              adm_ts=adm_ts))
         return True
 
     def _wire(self, doc_id: str, msg, line_idx: int,
-              sub_ts: Optional[float] = None) -> dict:
+              sub_ts: Optional[float] = None,
+              adm_ts: Optional[float] = None) -> dict:
         # Timestamps deliberately excluded from the CANONICAL keys:
         # the stream must be a pure function of the input order (the
         # bit-identity contract). In wire-trace mode the stamp rides
@@ -866,6 +878,18 @@ class DeliRole(_Role):
                     # double-count with crash-spanning durations.
                     self._observe_stage(
                         "submit_to_stamp", (now - sub_ts) * 1000.0
+                    )
+            if isinstance(adm_ts, (int, float)):
+                # The front door's admission stamp (`tr_adm`, one
+                # clock read inside `IngressRole.process`): the SAME
+                # `now` that stamps this record measures
+                # admit_to_stamp, and the same recovery gate keeps
+                # replayed records from being observed twice (the
+                # trace_stage_once contract every stage follows).
+                tr["adm"] = adm_ts
+                if not self._recovering:
+                    self._observe_stage(
+                        "admit_to_stamp", (now - adm_ts) * 1000.0
                     )
             rec["tr"] = tr
         return rec
@@ -951,12 +975,18 @@ class BroadcasterRole(_Role):
 
                 fr = get_flight_recorder()
                 if fr.note(e2e):
-                    fr.add(e2e, {
+                    span = {
                         "doc": rec.get("doc"), "seq": rec.get("seq"),
                         "client": rec.get("client"),
                         "clientSeq": rec.get("clientSeq"),
                         "stages": rec2["tr"],
-                    })
+                    }
+                    if self.partition is not None:
+                        # Fabric runs: the span names its partition so
+                        # the supervisor's merged /traces can pin a
+                        # tail regression to the hot range.
+                        span["partition"] = str(self.partition)
+                    fr.add(e2e, span)
         out.append(rec2)
 
 
@@ -1039,12 +1069,15 @@ class ScriptoriumBroadcasterRole(_Role):
 
                     fr = get_flight_recorder()
                     if fr.note(e2e):
-                        fr.add(e2e, {
+                        span = {
                             "doc": rec.get("doc"), "seq": rec.get("seq"),
                             "client": rec.get("client"),
                             "clientSeq": rec.get("clientSeq"),
                             "stages": rec2["tr"],
-                        })
+                        }
+                        if self.partition is not None:
+                            span["partition"] = str(self.partition)
+                        fr.add(e2e, span)
         if rec.get("kind") == "op":
             out.append(rec2)
         # Broadcast carries ops AND nacks; the very same dict object

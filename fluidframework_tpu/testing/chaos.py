@@ -84,6 +84,15 @@ FAULT_CLASSES = ("kill", "torn", "lease", "net", "client")
 # Fault classes of the ELASTIC fabric only (hash-range topology):
 ELASTIC_FAULTS = ("split", "merge", "disk")
 ALL_FAULT_CLASSES = FAULT_CLASSES + ELASTIC_FAULTS
+# Traffic-profile scenarios (`ChaosConfig.scenario`): the workload is
+# reshaped so a SKEWED burst is in flight while the faults land —
+# "hotdoc" weaves a contiguous storm block (one viral doc, a swarm of
+# extra writers) into the middle of the stream and the seeded
+# kill/split points are clamped INTO that window, so convergence
+# proves the fenced-handoff machinery under the one load shape even
+# benches never offer it (testing.scenarios has the open-loop,
+# latency-measured twins; this is the fault-injection twin).
+SCENARIO_PROFILES = ("hotdoc",)
 
 
 @dataclass
@@ -189,6 +198,13 @@ class ChaosConfig:
     # digest, zero dup/skip) — a split mid-stream hands each range's
     # downstream legs over exactly-once.
     downstream: Optional[str] = None
+    # Traffic-profile scenario (`SCENARIO_PROFILES`): "hotdoc" weaves
+    # a contiguous viral-doc storm block (a swarm of extra writers on
+    # docs[0]) into the middle of the workload and clamps the seeded
+    # kill/split points INTO the storm window — the faults land while
+    # the storm is in flight, and convergence must still be
+    # bit-identical with zero dup/skip.
+    scenario: Optional[str] = None
 
 
 @dataclass
@@ -272,11 +288,36 @@ def build_workload(cfg: ChaosConfig) -> List[dict]:
                 }
                 for i in range(cfg.ops_per_client)
             ]
+    recs.extend(_interleave(rng, queues, cfg.boxcar_rate))
+    if cfg.scenario == "hotdoc":
+        # The storm block: a swarm of EXTRA writers (clients
+        # n_clients+1 .. n_clients+S, well below the bad-submit id
+        # space at 9000) piling onto docs[0], spliced in as one
+        # contiguous run in the middle of the stream — a viral doc
+        # going viral mid-run, while the background mix continues
+        # around it. The runners detect the storm chunks by client id
+        # and land their kill/split faults inside the window.
+        block = _storm_block(cfg, rng, docs[0])
+        mid = len(recs) // 3
+        recs = recs[:mid] + block + recs[mid:]
+    return recs
+
+
+def _interleave(rng: random.Random,
+                queues: Dict[Tuple[str, int], List[dict]],
+                boxcar_rate: float = 0.0) -> List[dict]:
+    """The seeded cross-client interleave both the base workload and
+    the scenario storm block drain through: pick a live (doc, client)
+    queue at random and pop its head — or wrap 2-4 of its ops into a
+    wire boxcar at `boxcar_rate` — preserving per-client order. ONE
+    helper, so the storm block can never silently diverge from the
+    base workload's interleave shape."""
+    recs: List[dict] = []
     keys = list(queues)
     while keys:
         k = rng.choice(keys)
         q = queues[k]
-        if cfg.boxcar_rate and len(q) >= 2 and rng.random() < cfg.boxcar_rate:
+        if boxcar_rate and len(q) >= 2 and rng.random() < boxcar_rate:
             n = min(len(q), rng.randint(2, 4))
             ops = [q.pop(0) for _ in range(n)]
             recs.append({
@@ -291,6 +332,30 @@ def build_workload(cfg: ChaosConfig) -> List[dict]:
             recs.append(q.pop(0))
         if not q:
             keys.remove(k)
+    return recs
+
+
+def _storm_block(cfg: ChaosConfig, rng: random.Random,
+                 hot_doc: str) -> List[dict]:
+    """The hotdoc scenario's contiguous burst: `4 * n_clients` (min 6)
+    storm writers join `hot_doc` and interleave their op queues — the
+    same seeded-interleave shape as the base workload, concentrated on
+    one document."""
+    n_storm = max(6, 4 * cfg.n_clients)
+    ops_each = max(2, cfg.ops_per_client // 2)
+    clients = [cfg.n_clients + 1 + i for i in range(n_storm)]
+    recs: List[dict] = [
+        {"kind": "join", "doc": hot_doc, "client": c} for c in clients
+    ]
+    recs.extend(_interleave(rng, {
+        (hot_doc, c): [
+            {"kind": "op", "doc": hot_doc, "client": c,
+             "clientSeq": i + 1, "refSeq": 0,
+             "contents": {"storm": c, "i": i}}
+            for i in range(ops_each)
+        ]
+        for c in clients
+    }))
     return recs
 
 
@@ -465,6 +530,20 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
     unknown = set(cfg.faults) - set(ALL_FAULT_CLASSES)
     if unknown:
         raise ValueError(f"unknown fault classes {sorted(unknown)}")
+    if cfg.scenario is not None and cfg.scenario not in SCENARIO_PROFILES:
+        raise ValueError(
+            f"unknown scenario {cfg.scenario!r}; profiles: "
+            f"{SCENARIO_PROFILES}"
+        )
+    if cfg.scenario and cfg.summarizer:
+        # The summarizer gate's deterministic manifest-count formula
+        # assumes the uniform per-doc record count; a storm block
+        # breaks it. Reject loudly rather than print a summary verdict
+        # computed against the wrong expectation.
+        raise ValueError(
+            "scenario workloads do not run with summarizer=True "
+            "(the manifest-count gate assumes the uniform workload)"
+        )
     if cfg.fused_hop and cfg.n_partitions > 1:
         # The fabric's workers run deli pipelines only — there is no
         # scriptorium/broadcaster pair to fuse, and accepting the flag
@@ -568,6 +647,57 @@ def _feed_plan(cfg: ChaosConfig, rng: random.Random,
     return chunks, dup_after, kill_at, torn_at, lease_at
 
 
+def _trace_env() -> Dict[str, str]:
+    """Child env for trace-wire chaos runs: wire stamps on, and the
+    flight recorder pinned to a FIXED threshold (default 0 — keep
+    every span, ring-bounded) so a short seeded run's /traces
+    evidence does not depend on the rolling-p99 gate having armed.
+    An operator's explicit FLUID_TRACE_SLOW_MS wins."""
+    return {
+        "FLUID_TRACE_WIRE": "1",
+        "FLUID_TRACE_SLOW_MS": os.environ.get(
+            "FLUID_TRACE_SLOW_MS", "0"
+        ),
+    }
+
+
+def _storm_chunk_indices(cfg: ChaosConfig,
+                         chunks: List[List[dict]]) -> List[int]:
+    """Chunk indices carrying scenario-storm records (storm writers
+    live in the client-id band between the base workload's clients and
+    the bad-submit base at 9000)."""
+    if not cfg.scenario:
+        return []
+    return [
+        i for i, ch in enumerate(chunks)
+        if any(isinstance(r, dict) and isinstance(r.get("client"), int)
+               and cfg.n_clients < r["client"] < 9000 for r in ch)
+    ]
+
+
+def _clamp_faults_into_storm(cfg: ChaosConfig, rng: random.Random,
+                             storm_idx: List[int],
+                             kill_at: Dict[int, List[str]],
+                             split_at: Optional[int],
+                             ) -> Tuple[Dict[int, List[str]],
+                                        Optional[int]]:
+    """Scenario runs land their kill/split faults INSIDE the storm
+    window (seeded picks over the storm chunks): 'a storm fires
+    during a split/kill' is the whole point — faults scheduled after
+    the burst drained would prove nothing about it."""
+    if not storm_idx:
+        return kill_at, split_at
+    if kill_at:
+        remapped: Dict[int, List[str]] = {}
+        for targets in kill_at.values():
+            for t in targets:
+                remapped.setdefault(rng.choice(storm_idx), []).append(t)
+        kill_at = remapped
+    if split_at is not None:
+        split_at = storm_idx[len(storm_idx) // 3]
+    return kill_at, split_at
+
+
 def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     rng = random.Random(cfg.seed ^ 0x5EED)
     workload = build_workload(cfg)
@@ -590,13 +720,16 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
         cfg, rng, workload, tuple(kill_targets),
     )
+    storm_idx = _storm_chunk_indices(cfg, chunks)
+    kill_at, _ = _clamp_faults_into_storm(cfg, rng, storm_idx,
+                                          kill_at, None)
 
     sup = ServiceSupervisor(
         shared, roles=roles, ttl_s=cfg.ttl_s,
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
         deli_impl=cfg.deli_impl, log_format=cfg.log_format,
         deli_devices=cfg.deli_devices,
-        child_env={"FLUID_TRACE_WIRE": "1"} if cfg.trace_wire else None,
+        child_env=_trace_env() if cfg.trace_wire else None,
         summary_ops=cfg.summary_ops if cfg.summarizer else None,
         fused_hop=cfg.fused_hop,
     ).start()
@@ -632,6 +765,10 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         timeline.append((time.time(), ev))
 
     try:
+        if storm_idx:
+            note(f"chaos: scenario {cfg.scenario!r} storm spans "
+                 f"chunks {storm_idx[0]}..{storm_idx[-1]} "
+                 f"(faults clamped inside)")
         fed_idx = 0
         pending_dups: Dict[int, List[dict]] = {}
         deadline = time.time() + cfg.timeout_s
@@ -927,6 +1064,10 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                if "disk" in cfg.faults else None)
     stall_at = (min(len(chunks) - 1, disk_at + max(2, len(chunks) // 8))
                 if disk_at is not None else None)
+    storm_idx = _storm_chunk_indices(cfg, chunks)
+    kill_at, split_at = _clamp_faults_into_storm(
+        cfg, rng, storm_idx, kill_at, split_at,
+    )
 
     # Children get the disk-fault spec path via their spawn env; the
     # harness's own appends (the router feed) stay clean.
@@ -934,7 +1075,7 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
     child_env = dict({DISK_FAULT_ENV: fault_spec}
                      if "disk" in cfg.faults else {})
     if cfg.trace_wire:
-        child_env["FLUID_TRACE_WIRE"] = "1"
+        child_env.update(_trace_env())
     if cfg.ingress:
         # Admission knobs for the front-door child: a contents cap the
         # seeded oversized submit violates, plus the overload episode's
@@ -1105,6 +1246,10 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
 
     try:
         note_epoch()
+        if storm_idx:
+            note(f"chaos: scenario {cfg.scenario!r} storm spans "
+                 f"chunks {storm_idx[0]}..{storm_idx[-1]} "
+                 f"(kill/split clamped inside)")
         if auth_records and ing_topic is not None:
             # Sessions open FIRST (clients connect before they
             # submit); an ingress kill replays them from the gap.
@@ -1130,12 +1275,14 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
                 last_feed = time.time()
                 if cfg.trace_wire:
                     # Same feed-time submit stamp as the classic
-                    # runner: the ranged delis then stamp "tr" and
-                    # observe submit_to_stamp quantiles into their
-                    # worker heartbeats. (The slow-op RECORDER rides
-                    # the classic farm's broadcaster — the fabric has
-                    # no broadcast stage, so sharded runs report
-                    # stage quantiles, not e2e spans.)
+                    # runner: the ranged delis stamp "tr" and observe
+                    # per-partition submit_to_stamp quantiles into
+                    # their worker heartbeats; with `downstream` the
+                    # per-partition broadcaster stages feed the
+                    # worker's flight recorder too, so sharded runs
+                    # carry partition-tagged e2e spans (without a
+                    # downstream stage there is no broadcast hop and
+                    # the slow-op list stays empty).
                     now = time.time()
                     chunk = [{**r, "tr_sub": now}
                              for r in chunks[fed_idx]]
@@ -1295,9 +1442,10 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         events=events + list(sup.events), detail=detail,
         timeline=sorted(timeline + sup.timeline), metrics=metrics,
         degraded_seen=degraded_seen, epochs=epochs,
-        # Worker heartbeats carry no e2e spans today (no broadcast
-        # stage in the fabric) — collected anyway so a future fan-out
-        # stage lights this up without touching the harness.
+        # With `downstream` stages the worker heartbeats carry
+        # partition-tagged e2e spans (the per-partition broadcaster
+        # feeds each worker's flight recorder); without them there is
+        # no broadcast hop and the list is legitimately empty.
         slow_ops=sup.child_slow_ops() if cfg.trace_wire else [],
         ingress_nacks=ingress_nacks,
         never_sequenced_ok=never_sequenced_ok,

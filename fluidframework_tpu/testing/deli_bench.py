@@ -1885,6 +1885,24 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
         )
         print(json.dumps(res))
         return
+    if os.environ.get("BD_SCENARIOS"):
+        # Traffic-profile scenario mode (tools/bench_deli.py
+        # --scenarios): the four open-loop scenario primitives —
+        # hot-doc storm, reconnect stampede, read swarm, tenant mix —
+        # each with /slo quantiles, slow-op spans, and a convergence
+        # digest (bench_configs config13_scenarios' engine lives in
+        # testing.scenarios; this is the standalone CLI twin).
+        from .scenarios import run_scenario_suite
+
+        res = run_scenario_suite(
+            scale=scale,
+            deli_impl=os.environ.get("BD_IMPL", "scalar"),
+            log_format=os.environ.get("BD_LOG_FORMAT", "json"),
+            swarm_sessions=int(os.environ.get("BD_SESSIONS",
+                                              "100000")),
+        )
+        print(json.dumps(res))
+        return
     if os.environ.get("BD_LATENCY"):
         # Open-loop latency SLO mode (tools/bench_deli.py --latency):
         # p50/p99 submit→broadcast under a steady fixed-rate load,

@@ -28,6 +28,7 @@ Design constraints (the observability contract of ISSUE 3):
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from bisect import bisect_left
@@ -489,8 +490,13 @@ def histogram_stats(h: dict) -> dict:
 def slo_summary(snap: dict) -> dict:
     """The `/slo` endpoint body: every histogram with observations,
     reduced to its quantile summary (JSON-safe — beyond-last-bucket
-    estimates become None). Counters/gauges are omitted; they live on
-    `/metrics.json`."""
+    estimates become None). Counters/gauges are generally omitted
+    (they live on `/metrics.json`) with one exception: admission
+    FEEDBACK counters (``ingress_*`` — nacks by reason, throttles,
+    admits) ride along under ``"counters"``, because an operator
+    reading tail quantiles needs to see load the front door REFUSED
+    next to the latency of the load it admitted — a clean p99 over a
+    throttled stream is not a clean p99."""
     out = []
     for h in snap.get("histograms", ()):
         if not h.get("count"):
@@ -504,7 +510,17 @@ def slo_summary(snap: dict) -> dict:
                    else round(stats[q], 4))
                for q in ("p50", "p95", "p99")},
         })
-    return {"histograms": out}
+    body: Dict[str, Any] = {"histograms": out}
+    ingress = [
+        {"name": c["name"], "labels": dict(c.get("labels") or {}),
+         "value": c["value"]}
+        for c in snap.get("counters", ())
+        if str(c.get("name", "")).startswith("ingress_")
+        and c.get("value")
+    ]
+    if ingress:
+        body["counters"] = ingress
+    return body
 
 
 def _fmt_ms(v: float) -> str:
@@ -647,7 +663,23 @@ class FlightRecorder:
             self.recorded = 0
 
 
-_default_recorder = FlightRecorder()
+def _env_slow_threshold() -> Optional[float]:
+    """`FLUID_TRACE_SLOW_MS`: a FIXED slow-op threshold (ms) for the
+    process's default flight recorder — spans at/above it are kept
+    instead of the rolling-p99 gate. The scenario/chaos harnesses set
+    it ("0" = keep every span, ring-bounded) so a short run's /traces
+    evidence does not depend on the rolling window having armed;
+    unset (the default) keeps the adaptive production behavior."""
+    v = os.environ.get("FLUID_TRACE_SLOW_MS", "")
+    if not v:
+        return None
+    try:
+        return float(v)
+    except ValueError:
+        return None
+
+
+_default_recorder = FlightRecorder(threshold_ms=_env_slow_threshold())
 
 
 def get_flight_recorder() -> FlightRecorder:

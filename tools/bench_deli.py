@@ -90,9 +90,16 @@ tokens + size caps through `server.ingress.IngressRole`) vs bare
 routing vs sequencing, plus the overload episode — bounded backlog,
 visible throttle nacks, retry-and-converge exactly-once.
 
+`--scenarios` switches to the TRAFFIC-PROFILE SCENARIO mode
+(`testing.scenarios.run_scenario_suite`, bench_configs
+`config13_scenarios`' engine): the four open-loop scenario primitives
+— hot-doc storm, reconnect stampede, 100k-session read swarm,
+tenant-skewed mix — each with /slo quantiles, slow-op spans, and a
+convergence digest.
+
 Usage: python tools/bench_deli.py
     [--shard | --devices [LIST] | --latency [--fused-hop]
-     | --catchup | --hops | --ingress]
+     | --catchup | --hops | --ingress | --scenarios]
 """
 
 from __future__ import annotations
@@ -132,6 +139,16 @@ if "--catchup" in sys.argv:
     # knobs: BD_LOG_LENGTHS ("10000,30000,100000"), BD_SUMMARY_OPS
     # (2000), BD_SUBSCRIBERS (200), BD_LOG_FORMAT (json).
     os.environ["BD_CATCHUP"] = "1"
+
+if "--scenarios" in sys.argv:
+    # Traffic-profile scenario mode: the four open-loop scenario
+    # primitives (testing.scenarios.run_scenario_suite — hot-doc
+    # storm, reconnect stampede, 100k-session read swarm, tenant-
+    # skewed mix), each with /slo quantiles, slow-op spans and a
+    # convergence digest (bench_configs config13_scenarios' engine).
+    # Env knobs: BD_SCALE (suite scale), BD_IMPL (scalar), BD_SESSIONS
+    # (100000 swarm sessions), BD_LOG_FORMAT (json).
+    os.environ["BD_SCENARIOS"] = "1"
 
 if "--latency" in sys.argv:
     # Open-loop latency SLO mode: p50/p99 submit→broadcast through

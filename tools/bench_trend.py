@@ -10,11 +10,13 @@ result's ``metric``/``config`` name:
         {"t": ..., "value": 26900.0, "unit": "records/s"}, ...]}}
 
 Every result's HEADLINE number (ops/s for throughput metrics, the
-p99-improvement ratio for the latency SLO bench — higher is better in
-all cases) is compared against the BEST prior run of the same metric:
-a drop past ``--tolerance`` (default 20%) exits nonzero with the
-offending numbers, so a perf regression fails CI the moment it lands
-instead of surfacing as a slowly sagging ledger. Results whose
+p99-improvement ratio for the latency SLO bench — higher is better —
+or a LOWER-is-better latency like the scenario benches'
+``scenario_p99_ms``) is compared against the BEST prior run of the
+same metric: moving past ``--tolerance`` (default 20%) in the wrong
+direction exits nonzero with the offending numbers, so a perf
+regression fails CI the moment it lands instead of surfacing as a
+slowly sagging ledger. Results whose
 headline cannot be identified are appended but never gated (named on
 stderr, not silently dropped). Skipped gate results (a ``skipped``
 key) are recorded with ``"skipped": true`` and never gated — a CI
@@ -39,7 +41,7 @@ DEFAULT_PATH = os.path.join(
 )
 
 # Headline fields in preference order — the first present (and
-# numeric) names the metric's one comparable number. All are
+# numeric) names the metric's one comparable number. These are
 # higher-is-better, so the regression rule is one inequality.
 HEADLINE_FIELDS = (
     "p99_improvement",          # latency_slo_open_loop (ratio)
@@ -55,9 +57,16 @@ HEADLINE_FIELDS = (
     #                             jitter-bound ratio is never gated)
 )
 
+# LOWER-is-better headlines (latency milliseconds): regression means
+# rising ABOVE the best (lowest) prior run by more than the tolerance.
+# Scenario benches report their tail as `scenario_p99_ms`
+# (testing/scenarios.py), so a >20% p99 regression fails as loudly as
+# a throughput drop does.
+LOW_HEADLINE_FIELDS = ("scenario_p99_ms",)
+
 
 def headline(result: dict) -> Optional[Tuple[str, float]]:
-    for f in HEADLINE_FIELDS:
+    for f in HEADLINE_FIELDS + LOW_HEADLINE_FIELDS:
         v = result.get(f)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             return f, float(v)
@@ -118,7 +127,17 @@ def append_and_gate(ledger_path: str, results: List[dict],
                      if isinstance(r.get("value"), (int, float))
                      and r.get("field") == head[0]
                      and not r.get("skipped")]
-            if prior:
+            if prior and head[0] in LOW_HEADLINE_FIELDS:
+                best = min(prior)
+                ceiling = best * (1.0 + tolerance)
+                if head[1] > ceiling:
+                    failures.append(
+                        f"{key}: {head[0]}={head[1]:g} regressed "
+                        f">{tolerance:.0%} above the best prior "
+                        f"{best:g} (ceiling {ceiling:g}, "
+                        f"{len(prior)} prior runs)"
+                    )
+            elif prior:
                 best = max(prior)
                 floor = best * (1.0 - tolerance)
                 if head[1] < floor:

@@ -9,7 +9,16 @@ Run: python tools/chaos_run.py --seed N
         [--summarizer] [--summary-ops N] [--fused-hop]
         [--ingress [--bad-submits N] [--ingress-rate R]
          [--ingress-backlog B]] [--autoscale]
-        [--downstream fused|split]
+        [--downstream fused|split] [--scenario hotdoc]
+
+`--scenario hotdoc` reshapes the workload with a traffic-profile
+scenario (`testing.chaos.SCENARIO_PROFILES`): a contiguous viral-doc
+storm block — a swarm of extra writers piling onto one document — is
+woven into the middle of the stream, and the seeded kill/split points
+are clamped INSIDE the storm window, so the faults land while the
+storm is in flight. Convergence must still be bit-identical with zero
+dup/skip. (`testing/scenarios.py` holds the open-loop, latency-
+measured scenario benches; this flag is their fault-injection twin.)
 
 `--ingress` (with `--partitions` > 1) puts the supervised admission
 front door (`server.ingress.IngressRole`) in front of the fabric: the
@@ -54,9 +63,12 @@ wire records (side "tr" key — digests compare canonical records, so
 convergence is unaffected) and attaches the slow-op flight recorder's
 spans to the report and the `--metrics-out` line: a chaos run that
 regresses tail latency names the exact slowest ops it produced. On
-the SHARDED runner (`--partitions` > 1) the fabric has no broadcast
-stage, so tracing yields submit→stamp quantiles in the worker
-metrics but no e2e spans — the slow-op list is empty there.
+the SHARDED runner (`--partitions` > 1) combine it with
+`--downstream fused|split`: the per-partition broadcaster stages feed
+each worker's flight recorder and the spans come back PARTITION-
+TAGGED through the worker heartbeats (the fabric-wide /traces
+surface). Without a downstream stage the fabric has no broadcast hop,
+so tracing yields submit→stamp quantiles but no e2e spans.
 
 `--faults split,merge,disk` (with `--partitions` > 1) runs the ELASTIC
 hash-range fabric and injects topology changes as faults: a live
@@ -132,6 +144,7 @@ from fluidframework_tpu.testing.chaos import (  # noqa: E402
     ALL_FAULT_CLASSES,
     ELASTIC_FAULTS,
     FAULT_CLASSES,
+    SCENARIO_PROFILES,
     ChaosConfig,
     run_chaos,
 )
@@ -171,6 +184,7 @@ def main() -> int:
     if autoscale:
         args.remove("--autoscale")
     downstream = _take("--downstream", None)
+    scenario = _take("--scenario", None)
     bad_submits = int(_take("--bad-submits", "6"))
     ingress_rate = float(_take("--ingress-rate", "0"))
     ingress_backlog = int(_take("--ingress-backlog", "0"))
@@ -212,18 +226,22 @@ def main() -> int:
         ingress_backlog=ingress_backlog,
         autoscale=autoscale,
         downstream=downstream,
+        scenario=scenario,
     )
     unknown = set(faults) - set(ALL_FAULT_CLASSES)
     if (unknown or args or cfg.deli_impl not in DELI_IMPLS
             or cfg.log_format not in LOG_FORMATS
             or (downstream is not None
-                and downstream not in ("fused", "split"))):
+                and downstream not in ("fused", "split"))
+            or (scenario is not None
+                and scenario not in SCENARIO_PROFILES)):
         print(
             f"unknown faults {sorted(unknown)} / leftover args {args}; "
             f"faults are chosen from {','.join(ALL_FAULT_CLASSES)} "
             f"({','.join(ELASTIC_FAULTS)} need --partitions > 1); "
             f"--deli is one of {'|'.join(DELI_IMPLS)}; "
-            f"--log-format is one of {'|'.join(LOG_FORMATS)}",
+            f"--log-format is one of {'|'.join(LOG_FORMATS)}; "
+            f"--scenario is one of {'|'.join(SCENARIO_PROFILES)}",
             file=sys.stderr,
         )
         return 2
@@ -237,7 +255,8 @@ def main() -> int:
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
           f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl} "
           f"log={cfg.log_format} boxcar_rate={cfg.boxcar_rate}"
-          f"{shard}{dev}{' fused-hop' if cfg.fused_hop else ''}",
+          f"{shard}{dev}{' fused-hop' if cfg.fused_hop else ''}"
+          f"{f' scenario={cfg.scenario}' if cfg.scenario else ''}",
           flush=True)
     res = run_chaos(cfg)
     print(f"golden digest : {res.golden_digest}")
