@@ -41,6 +41,7 @@ from .shard_fabric import (
     ShardWorker,
 )
 from .ingress import IngressRole, verify_nack, write_tenants
+from .retention import RetentionRole, disk_usage
 from .summarizer import (
     SummarizerRole,
     SummaryIndex,
@@ -96,6 +97,7 @@ __all__ = [
     "LogTopic",
     "MessageLog",
     "NACK_STALE_REFSEQ",
+    "RetentionRole",
     "ScribeLambda",
     "ScriptoriumLambda",
     "ServiceSupervisor",
@@ -105,6 +107,7 @@ __all__ = [
     "SummarizerRole",
     "SummaryIndex",
     "SummaryReplica",
+    "disk_usage",
     "read_catchup",
     "summarize_document",
     "verify_nack",

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import Dict, List, Optional
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
 
 
 class _PyStore:
@@ -126,7 +127,26 @@ class ContentAddressedStore:
             self._impl = _PyStore(directory)
 
     def put(self, content) -> str:
-        return self._impl.put(content)
+        """Store `content`, returning its hash key. In durable mode the
+        blob file's mtime is refreshed even when the content-addressed
+        write was skipped (file already on disk): the retention GC's
+        epoch-pin floor compares blob mtimes, so a deduplicated re-put
+        must look as fresh as a first put or a recovery re-put of a
+        not-yet-referenced blob could be swept before its manifest
+        lands. If a concurrent sweep unlinks the file between the
+        backend's existence check and the stamp, the put is retried."""
+        key = self._impl.put(content)
+        if self.directory:
+            path = os.path.join(self.directory, "objects", key[:2], key)
+            for attempt in range(5):
+                try:
+                    os.utime(path)
+                    break
+                except OSError:
+                    if attempt == 4:
+                        raise
+                    self._impl.put(content)
+        return key
 
     def get(self, key: str) -> bytes:
         return self._impl.get(key)
@@ -142,3 +162,117 @@ class ContentAddressedStore:
 
     def list_refs(self) -> List[str]:
         return self._impl.list_refs()
+
+    # ------------------------------------------------------- GC surface
+    # Both backends share the on-disk object layout
+    # (objects/<h[0:2]>/<hash>), so the sweep side of the retention
+    # plane's mark-and-sweep GC (`server.retention`) works off the
+    # directory itself — backend-agnostic by construction. In-memory
+    # stores (no directory) expose nothing to sweep: their lifetime IS
+    # the process.
+
+    def list_blobs(self) -> Iterator[Tuple[str, str, int, float]]:
+        """Every durable blob as ``(key, path, size_bytes, mtime)``.
+        Durable mode only (empty for in-memory stores)."""
+        if not self.directory:
+            return
+        root = os.path.join(self.directory, "objects")
+        try:
+            shards = sorted(os.listdir(root))
+        except OSError:
+            return
+        for shard in shards:
+            sdir = os.path.join(root, shard)
+            try:
+                names = sorted(os.listdir(sdir))
+            except OSError:
+                continue
+            for name in names:
+                if name.startswith(".") or ".tmp." in name:
+                    continue  # a writer's in-flight temp: never swept
+                path = os.path.join(sdir, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue  # swept by a concurrent GC pass
+                yield name, path, int(st.st_size), float(st.st_mtime)
+
+    def sweep_tmp(self, max_age_s: float = 60.0) -> int:
+        """Unlink orphaned writer temp files (``*.tmp.*`` under
+        objects/) left by a crash between a tmp write and its atomic
+        rename — `put`'s staging file and `delete_blob`'s quarantine
+        both park there, nothing else ever removes them, and
+        `disk_usage` counts them against the retention plane's disk
+        bound. Age-gated: an in-flight writer's tmp lives for
+        milliseconds, so anything older than `max_age_s` is a dead
+        writer's. Returns the number removed."""
+        if not self.directory:
+            return 0
+        removed = 0
+        now = time.time()
+        root = os.path.join(self.directory, "objects")
+        try:
+            shards = os.listdir(root)
+        except OSError:
+            return 0
+        for shard in shards:
+            sdir = os.path.join(root, shard)
+            try:
+                names = os.listdir(sdir)
+            except OSError:
+                continue
+            for name in names:
+                if ".tmp." not in name:
+                    continue
+                p = os.path.join(sdir, name)
+                try:
+                    if now - os.stat(p).st_mtime > max_age_s:
+                        os.unlink(p)
+                        removed += 1
+                except OSError:
+                    continue
+        return removed
+
+    def delete_blob(self, key: str,
+                    older_than: Optional[float] = None) -> bool:
+        """Unlink one durable blob (the GC sweep's only write). Safe
+        against re-reference by construction of the caller's contract:
+        a later `put` of identical content recreates the file (put
+        checks the disk, not a cache). Returns whether a file was
+        removed. Any process-local memory cache of the key is dropped
+        too, so this store never serves a blob the disk no longer
+        holds.
+
+        `older_than` closes the sweep's stat→unlink race against a
+        concurrent re-put: the blob is first RENAMED to a quarantine
+        name (atomic — a racing `put` now sees no file and rewrites
+        it), then its mtime re-checked; a blob refreshed since the
+        sweep's stat is renamed back instead of deleted (identical
+        content, so restoring over a racing rewrite is harmless)."""
+        if not self.directory:
+            return False
+        path = os.path.join(self.directory, "objects", key[:2], key)
+        getattr(self._impl, "_blobs", {}).pop(key, None)
+        if older_than is None:
+            try:
+                os.unlink(path)
+                return True
+            except OSError:
+                return False
+        trash = f"{path}.tmp.gc{os.getpid()}"  # ".tmp." infix:
+        try:                                   # list_blobs skips it
+            os.replace(path, trash)
+            if os.stat(trash).st_mtime >= older_than:
+                os.replace(trash, path)  # re-put mid-sweep: keep it
+                try:
+                    # replace() carried the OLD mtime back; stamp the
+                    # survivor fresh so a racing put's pin still
+                    # covers it on the next pass.
+                    os.utime(path)
+                except OSError:
+                    pass
+                return False
+            os.unlink(trash)
+            return True
+        except OSError:
+            return False

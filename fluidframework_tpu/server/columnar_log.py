@@ -52,7 +52,9 @@ from __future__ import annotations
 
 import json
 import os
+import struct
 import time
+import zlib
 from typing import Any, List, Optional, Tuple
 
 from ..protocol.record_batch import (
@@ -73,6 +75,7 @@ __all__ = [
     "ColumnarFileTopic",
     "ColumnarTailReader",
     "LOG_FORMATS",
+    "TRUNC_HEADER_LEN",
     "default_log_format",
     "make_tail_reader",
     "make_topic",
@@ -80,6 +83,30 @@ __all__ = [
 ]
 
 LOG_FORMATS = ("json", "columnar")
+
+# -- prefix truncation (the retention plane's fenced op-log TRUNCATE) --
+#
+# A truncated topic file begins with this fixed header naming the
+# LOGICAL stream position its first physical data byte maps to:
+#
+#     magic "\x00FTR" | u64 base_records | u64 base_bytes | u32 crc
+#
+# Record offsets and byte positions are LOGICAL — stable across
+# truncation — so checkpointed offsets, `inOff` bookkeeping and
+# manifest byte offsets never move when the prefix behind a durable
+# summary is reclaimed (`ColumnarFileTopic.truncate_prefix`,
+# `server.retention`). The leading NUL byte can never open a JSON line
+# and never matches the frame MAGIC, so a header-unaware scan fails
+# loudly instead of misparsing. JSONL topics do not truncate: the
+# retention role requires the columnar log format.
+TRUNC_MAGIC = b"\x00FTR"
+_TRUNC = struct.Struct("<4sQQI")  # magic, base_records, base_bytes, crc
+TRUNC_HEADER_LEN = _TRUNC.size
+
+
+def _pack_trunc(base_records: int, base_bytes: int) -> bytes:
+    crc = zlib.crc32(struct.pack("<QQ", base_records, base_bytes))
+    return _TRUNC.pack(TRUNC_MAGIC, base_records, base_bytes, crc)
 
 def default_log_format(explicit: Optional[str] = None) -> str:
     """Resolve a log format: explicit arg > ``FLUID_LOG_FORMAT`` env >
@@ -152,20 +179,60 @@ class ColumnarFileTopic(SharedFileTopic):
             pos = end
         return pos
 
+    # -------------------------------------------------- truncation base
+
+    @staticmethod
+    def _parse_base(head: bytes) -> Tuple[int, int, int]:
+        """(base_records, base_bytes, header_len) off a file's first
+        `TRUNC_HEADER_LEN` bytes — (0, 0, 0) for a never-truncated
+        file (or a garbled header, which reads as ordinary data and
+        fails loudly downstream rather than silently re-basing)."""
+        if len(head) >= TRUNC_HEADER_LEN and \
+                head[:4] == TRUNC_MAGIC:
+            _m, r, b, crc = _TRUNC.unpack(head[:TRUNC_HEADER_LEN])
+            if crc == zlib.crc32(head[4:20]):
+                return int(r), int(b), TRUNC_HEADER_LEN
+        return 0, 0, 0
+
+    def base_offsets(self) -> Tuple[int, int]:
+        """(base_records, base_bytes): the logical stream position of
+        this topic's first physically-present unit. (0, 0) until a
+        `truncate_prefix` reclaims something. Records/bytes below the
+        base are GONE — readers that need them must boot from a
+        summary (the retention contract)."""
+        try:
+            with open(self.path, "rb") as f:
+                r, b, _h = self._parse_base(f.read(TRUNC_HEADER_LEN))
+        except OSError:
+            return 0, 0
+        return r, b
+
     # ----------------------------------------------------------- append
 
     def __init__(self, path: str):
         super().__init__(path)
-        # Process-local seal hint: the clean length after OUR last
-        # append (complete units only, so it stays valid whatever
-        # other writers append after it). Bounds the seal scan for
-        # unsynced-append topics whose on-disk sidecar is pinned.
+        # Process-local seal hint: the LOGICAL clean length after OUR
+        # last append (complete units only, so it stays valid whatever
+        # other writers append after it — and logical, so a concurrent
+        # prefix truncation cannot strand it mid-frame). Bounds the
+        # seal scan for unsynced-append topics whose on-disk sidecar
+        # is pinned.
         self._seal_hint = 0
         # True while this topic holds appends that were never fsynced
         # (fsync=False legs): the on-disk sidecar must not advance
         # over them — after an OS crash it could otherwise name bytes
         # the page cache lost, and the seal scan trusts it.
         self._unsynced = False
+
+    def _inode_stable(self, f) -> bool:
+        """Whether the locked fd still names `self.path`: a concurrent
+        `truncate_prefix` REPLACES the file (atomic rename), so an
+        appender that opened the old inode and then won its flock
+        would otherwise write acknowledged bytes into an orphan."""
+        try:
+            return os.stat(self.path).st_ino == os.fstat(f.fileno()).st_ino
+        except OSError:
+            return False
 
     def append_many(self, messages: List[Any],
                     fence: Optional[int] = None,
@@ -194,92 +261,244 @@ class ColumnarFileTopic(SharedFileTopic):
         resumes the sidecar."""
         from .queue import flock_exclusive
 
-        with open(self.path, "r+b") as f:
-            with flock_exclusive(f, lock_timeout_s, self.path):
-                self._gate_fence(fence, owner)
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                committed = self._read_committed()
-                # The sidecar is a HINT that bounds the seal scan, not
-                # an authority over the data: EXTEND it over any
-                # complete units past it (JSON-era lines appended while
-                # the farm ran the other format, frames whose sidecar
-                # update was lost to a crash) so a format round-trip
-                # can never truncate acknowledged records; only the
-                # genuinely torn suffix (partial frame, unterminated
-                # line) is sealed away — it was never acknowledged.
-                # The process-local hint covers our own unsynced
-                # appends, whose bytes the sidecar must not name.
-                start = max(0 if committed is None
-                            else min(committed, size),
-                            min(self._seal_hint, size))
-                f.seek(start)
-                clean = start + self._scan_clean_len(f.read())
-                if size > clean:
-                    f.truncate(clean)
-                if not count_records(messages):
-                    self._seal_hint = clean
-                    if committed != clean and not self._unsynced:
-                        # The scan may have covered bytes ANOTHER
-                        # writer appended fsync=False (a dead fused
-                        # consumer's broadcast frames — our local
-                        # `_unsynced` flag can't see them): fsync the
-                        # data BEFORE the sidecar names it, preserving
-                        # the file-global "sidecar never overstates
-                        # durable data" invariant. Rare path — fence
-                        # binds and recovery, never the steady state.
-                        fsync_file(f, "topic")
-                        self._write_committed(clean)
-                    return 0
-                cur_fence, cur_owner = self.latest_fence()
-                frame = encode_batch(messages, fence=cur_fence,
-                                     owner=cur_owner, src=src)
-                check_disk_fault("topic")
-                f.seek(clean)
-                f.write(frame)
-                f.flush()
-                self._seal_hint = clean + len(frame)
-                if fsync:
-                    fsync_file(f, "topic")
-                    self._unsynced = False
-                    # Data is durable BEFORE the length names it.
-                    self._write_committed(clean + len(frame))
-                else:
-                    self._unsynced = True
-        # Event-driven consumers wake now (outside the lock, after
-        # durability — queue.TopicDoorbell semantics, both formats).
-        self._ring_doorbells()
+        while True:
+            with open(self.path, "r+b") as f:
+                with flock_exclusive(f, lock_timeout_s, self.path):
+                    if not self._inode_stable(f):
+                        continue  # truncation replaced the file: reopen
+                    wrote = self._append_locked(
+                        f, messages, fence, owner, fsync, src
+                    )
+                    break
+        if wrote:
+            # Event-driven consumers wake now (outside the lock, after
+            # durability — queue.TopicDoorbell semantics, both formats).
+            self._ring_doorbells()
+        return wrote
+
+    def _append_locked(self, f, messages, fence, owner, fsync,
+                       src) -> int:
+        self._gate_fence(fence, owner)
+        f.seek(0)
+        base_r, base_b, hlen = self._parse_base(
+            f.read(TRUNC_HEADER_LEN)
+        )
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        committed = self._read_committed()  # PHYSICAL length
+        # The sidecar is a HINT that bounds the seal scan, not
+        # an authority over the data: EXTEND it over any
+        # complete units past it (JSON-era lines appended while
+        # the farm ran the other format, frames whose sidecar
+        # update was lost to a crash) so a format round-trip
+        # can never truncate acknowledged records; only the
+        # genuinely torn suffix (partial frame, unterminated
+        # line) is sealed away — it was never acknowledged.
+        # The process-local hint covers our own unsynced
+        # appends, whose bytes the sidecar must not name. The
+        # hint is LOGICAL: a truncation between our appends
+        # re-bases the file, and mapping through the current
+        # base keeps the hint on the same unit boundary.
+        hint_phys = hlen + max(0, self._seal_hint - base_b)
+        start = max(hlen if committed is None
+                    else min(max(committed, hlen), size),
+                    min(hint_phys, size))
+        f.seek(start)
+        clean = start + self._scan_clean_len(f.read())
+        if size > clean:
+            f.truncate(clean)
+        if not count_records(messages):
+            self._seal_hint = base_b + (clean - hlen)
+            if committed != clean and not self._unsynced:
+                # The scan may have covered bytes ANOTHER
+                # writer appended fsync=False (a dead fused
+                # consumer's broadcast frames — our local
+                # `_unsynced` flag can't see them): fsync the
+                # data BEFORE the sidecar names it, preserving
+                # the file-global "sidecar never overstates
+                # durable data" invariant. Rare path — fence
+                # binds and recovery, never the steady state.
+                fsync_file(f, "topic")
+                self._write_committed(clean)
+            return 0
+        cur_fence, cur_owner = self.latest_fence()
+        frame = encode_batch(messages, fence=cur_fence,
+                             owner=cur_owner, src=src)
+        check_disk_fault("topic")
+        f.seek(clean)
+        f.write(frame)
+        f.flush()
+        self._seal_hint = base_b + (clean + len(frame) - hlen)
+        if fsync:
+            fsync_file(f, "topic")
+            self._unsynced = False
+            # Data is durable BEFORE the length names it.
+            self._write_committed(clean + len(frame))
+        else:
+            self._unsynced = True
         return len(frame)
+
+    # ------------------------------------------------------- truncation
+
+    def truncate_prefix(self, upto_records: int, min_bytes: int = 0,
+                        dry_run: bool = False,
+                        lock_timeout_s: Optional[float] = None
+                        ) -> Tuple[int, int]:
+        """Physically reclaim every complete unit whose records ALL sit
+        below logical record offset `upto_records` (the cut lands on
+        the greatest unit boundary <= it). Returns the
+        ``(base_records, base_bytes)`` the call decided on — the
+        current base when nothing qualifies (or the reclaimable run is
+        under `min_bytes`), the planned new base with ``dry_run=True``
+        (nothing touched), the installed new base otherwise.
+
+        Crash-safe by construction: the replacement file (truncation
+        header + the untouched suffix bytes, fsynced) is atomically
+        renamed over the topic, so a reader sees the old complete file
+        or the new complete file, never a mix; the committed-length
+        sidecar is DELETED before the rename and rewritten after, so a
+        crash anywhere in the window costs at worst a full seal scan.
+        Offsets are unchanged — record indices and byte positions are
+        logical, and the header preserves the mapping.
+
+        NOT fence-gated: the topic's fence belongs to its WRITER role,
+        and binding another would depose it. The caller's zombie
+        safety comes from the fenced COMMIT record that precedes every
+        reclaim (`server.retention` — a deposed retention role dies at
+        its own topic's fence before bytes go away; re-executing an
+        already-applied cut is a no-op since the base only grows)."""
+        from .queue import flock_exclusive
+
+        while True:
+            with open(self.path, "r+b") as f:
+                with flock_exclusive(f, lock_timeout_s, self.path):
+                    if not self._inode_stable(f):
+                        continue
+                    return self._truncate_locked(
+                        f, int(upto_records), min_bytes, dry_run
+                    )
+
+    def _truncate_locked(self, f, upto_records: int, min_bytes: int,
+                         dry_run: bool) -> Tuple[int, int]:
+        # Orphan sweep: a crash between the tmp write below and its
+        # rename leaves `<topic>.trunc.tmp.<pid>` behind — nothing
+        # else ever removes it, and it counts against the disk bound
+        # this plane exists to hold. The flock serializes truncators,
+        # so any such sibling here is a dead writer's.
+        tdir = os.path.dirname(self.path) or "."
+        tprefix = os.path.basename(self.path) + ".trunc.tmp."
+        try:
+            for fn in os.listdir(tdir):
+                if fn.startswith(tprefix):
+                    try:
+                        os.unlink(os.path.join(tdir, fn))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        f.seek(0)
+        base_r, base_b, hlen = self._parse_base(
+            f.read(TRUNC_HEADER_LEN)
+        )
+        if upto_records <= base_r:
+            return base_r, base_b
+        f.seek(hlen)
+        data = f.read()
+        cut_rel = 0
+        cut_records = base_r
+        for _kind, idx, cnt, _payload, end in iter_units(data, base_r):
+            if idx + cnt > upto_records:
+                break
+            cut_rel, cut_records = end, idx + cnt
+        if cut_records <= base_r or cut_rel < max(1, min_bytes):
+            return base_r, base_b
+        new_r, new_b = cut_records, base_b + cut_rel
+        if dry_run:
+            return new_r, new_b
+        suffix = data[cut_rel:]
+        check_disk_fault("topic")
+        tmp = self.path + f".trunc.tmp.{os.getpid()}"
+        with open(tmp, "wb") as tf:
+            tf.write(_pack_trunc(new_r, new_b))
+            tf.write(suffix)
+            tf.flush()
+            fsync_file(tf, "topic")
+        # Sidecar OUT before the swap: its physical length is about to
+        # change, and a stale value pointing mid-frame in the new file
+        # would poison the seal scan. A crash between these steps
+        # leaves no sidecar — full scan, correct.
+        try:
+            os.remove(self._clen_path())
+        except OSError:
+            pass
+        os.replace(tmp, self.path)
+        try:
+            dfd = os.open(os.path.dirname(self.path) or ".",
+                          os.O_RDONLY)
+            try:
+                os.fsync(dfd)  # the rename itself must survive a crash
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+        # The whole replacement file was fsynced above, so the fresh
+        # sidecar may name every complete unit in it.
+        self._write_committed(
+            TRUNC_HEADER_LEN + self._scan_clean_len(suffix)
+        )
+        self._seal_hint = max(self._seal_hint, new_b)
+        from ..utils.metrics import get_registry
+
+        get_registry().counter(
+            "topic_truncations_total",
+            topic=os.path.basename(self.path),
+        ).inc()
+        return new_r, new_b
 
     # ------------------------------------------------------------- read
 
+    def _read_based(self) -> Tuple[bytes, int, int, int]:
+        """``(data_after_header, base_records, base_bytes,
+        header_len)`` — the physical file with any truncation header
+        stripped, plus the logical base it establishes. Readers rely
+        on the torn-unit rules (an incomplete frame or unterminated
+        line is never consumed), so an in-flight append is naturally
+        invisible and a stale sidecar can never hide acknowledged
+        records. Complete units are never truncated by the seal path,
+        so what a reader consumed stays consumed (prefix truncation
+        only reclaims units behind a committed retention record)."""
+        try:
+            with open(self.path, "rb") as f:
+                head = f.read(TRUNC_HEADER_LEN)
+                base_r, base_b, hlen = self._parse_base(head)
+                rest = f.read()
+        except OSError:
+            return b"", 0, 0, 0
+        return (rest if hlen else head + rest), base_r, base_b, hlen
+
     def _read_data(self) -> bytes:
-        """The whole file; readers rely on the torn-unit rules (an
-        incomplete frame or unterminated line is never consumed), so
-        an in-flight append is naturally invisible and a stale sidecar
-        can never hide acknowledged records. Complete units are never
-        truncated by the seal path, so what a reader consumed stays
-        consumed."""
-        with open(self.path, "rb") as f:
-            return f.read()
+        """The file's unit data (truncation header stripped)."""
+        return self._read_based()[0]
 
     def read_entries(self, offset: int,
                      max_count: Optional[int] = None
                      ) -> Tuple[List[Tuple[int, Any]], int]:
         """Same contract as `SharedFileTopic.read_entries`, over mixed
         frames + JSON lines: record offsets are stable (CRC-skipped
-        batches and junk lines stay counted), torn units are never
-        consumed, `max_count` caps the parsed entries taken."""
-        data = self._read_data()
+        batches and junk lines stay counted; a truncated prefix keeps
+        its logical offsets — its records are simply absent), torn
+        units are never consumed, `max_count` caps the parsed entries
+        taken."""
+        data, base_r, _base_b, _hlen = self._read_based()
         if not data:
-            return [], offset
+            return [], max(offset, base_r)
 
         def capped():
             return max_count is not None and len(out) >= max_count
 
         out: List[Tuple[int, Any]] = []
-        idx = 0
-        for kind, idx0, cnt, payload, _end in iter_units(data):
+        idx = base_r
+        for kind, idx0, cnt, payload, _end in iter_units(data, base_r):
             if capped():
                 break
             idx = idx0 + cnt
@@ -324,26 +543,51 @@ class ColumnarTailReader:
     def __init__(self, topic: ColumnarFileTopic, line_offset: int = 0):
         self.topic = topic
         self.next_line = line_offset
-        self._pos = 0  # byte position after the last consumed unit
-        self._abs = 0  # record index of the unit at _pos
-        if line_offset > 0:
+        # LOGICAL byte position after the last consumed unit, and the
+        # record index of the unit there. Logical positions are stable
+        # under prefix truncation (physical = logical - base_bytes +
+        # header_len), so a long-lived reader survives a concurrent
+        # TRUNCATE without re-anchoring. A cold reader (offset at/below
+        # the base) needs only the header — the O(file) read happens
+        # solely when a record offset must be translated to bytes.
+        base_r, base_b = topic.base_offsets()
+        self._pos = base_b
+        self._abs = base_r
+        if line_offset > base_r:
             # One O(file) scan translates the record offset into a byte
             # position; everything after is incremental. Stops before
             # the unit CONTAINING the offset (mid-batch delivery is
-            # handled record-wise in _poll_units).
-            data = topic._read_data()
-            for _kind, idx, cnt, _payload, end in iter_units(data):
+            # handled record-wise in _poll_units). Fresh base values
+            # from the same read: a truncate between the header probe
+            # and this scan only ever advances the base.
+            data, base_r, base_b, _hlen = topic._read_based()
+            self._pos = base_b
+            self._abs = base_r
+            for _kind, idx, cnt, _payload, end in iter_units(
+                    data, base_r):
                 if idx + cnt > line_offset:
                     break
-                self._pos = end
+                self._pos = base_b + end
                 self._abs = idx + cnt
 
     def _read_new(self) -> bytes:
         """Only the bytes past `_pos` (incremental tail); the torn-unit
-        rules bound what of them is consumable."""
+        rules bound what of them is consumable. Re-reads the truncation
+        base per poll: a concurrent TRUNCATE moves the physical layout
+        while logical positions stand still."""
         try:
             with open(self.topic.path, "rb") as f:
-                f.seek(self._pos)
+                base_r, base_b, hlen = self.topic._parse_base(
+                    f.read(TRUNC_HEADER_LEN)
+                )
+                if self._pos < base_b:
+                    # Our position was reclaimed (a reader behind the
+                    # cut — the retention role only cuts behind every
+                    # tracked consumer, so this is a COLD reader):
+                    # records between are gone; resume at the base.
+                    self._pos = base_b
+                    self._abs = max(self._abs, base_r)
+                f.seek(hlen + (self._pos - base_b))
                 return f.read()
         except OSError:
             return b""
@@ -463,7 +707,9 @@ def _frame_ops_reverse(batch: RecordBatch, doc: str, base: int,
 
 
 def tail_records_reverse(topic: ColumnarFileTopic, doc: str, base: int,
-                         upto: Optional[int]) -> Optional[List[dict]]:
+                         upto: Optional[int],
+                         stop_at: Optional[int] = None
+                         ) -> Optional[List[dict]]:
     """`doc`'s op records with ``base < seq [<= upto]`` read BACKWARD
     from the topic's end — the frame-log twin of the summarizer's
     JSONL `_tail_records_reverse`, so summary catch-up on columnar
@@ -478,22 +724,52 @@ def tail_records_reverse(topic: ColumnarFileTopic, doc: str, base: int,
     never mis-frame the walk. Returns None when it cannot anchor (no
     sidecar, or a non-frame region — a JSON-era prefix mid-chain);
     the caller falls back to the forward walk, slower but always
-    correct."""
-    try:
-        size = os.path.getsize(topic.path)
-    except OSError:
-        return None
-    committed = topic._read_committed()
-    if committed is None:
-        return None  # pre-sidecar file (migrated JSONL): fall forward
-    committed = min(committed, size)
+    correct.
+
+    ``stop_at`` (LOGICAL byte position — a summary manifest's
+    ``byteOff``) bounds the chain: every own-doc record below it is
+    known to be at/below `base`, so the walk never descends past it —
+    O(tail) even when the doc's records are arbitrarily sparse in the
+    interleave. A truncated topic anchors the same way; its header
+    maps logical to physical and the chain floors at the header."""
+    # ONE consistent snapshot: sidecar, then fd, then an inode check.
+    # A concurrent truncate_prefix atomically renames a new file over
+    # the path (sidecar deleted before, rewritten after) — mixing the
+    # new base with the old contents would map `stop_at` through the
+    # wrong base and silently drop tail records. Reading the sidecar
+    # BEFORE the stability check makes every interleaving safe: a
+    # sidecar deleted mid-truncate reads None (fall forward), a
+    # rewritten one implies the rename already landed and the inode
+    # check catches it; once stable, the held fd pins one complete
+    # file version for the size, the header, and every byte the scan
+    # reads.
+    while True:
+        try:
+            fh = open(topic.path, "rb")
+        except OSError:
+            return None
+        committed = topic._read_committed()
+        if committed is None:
+            fh.close()
+            return None  # pre-sidecar file (migrated JSONL): fall fwd
+        if not topic._inode_stable(fh):
+            fh.close()
+            continue  # truncate swapped the file mid-probe: re-probe
+        break
+    size = os.fstat(fh.fileno()).st_size
+    fh.seek(0)
+    _base_r, base_b, hlen = topic._parse_base(fh.read(TRUNC_HEADER_LEN))
+    committed = max(min(committed, size), hlen)
+    floor = hlen
+    if stop_at is not None:
+        floor = max(floor, min(hlen + max(0, stop_at - base_b), size))
     from ..utils.metrics import get_registry
 
     m_bytes = get_registry().counter(
         "catchup_tail_scan_bytes_total", mode="reverse-columnar"
     )
     groups: List[List[dict]] = []  # per-unit op lists, newest first
-    with open(topic.path, "rb") as f:
+    with fh as f:
         # 1. The post-sidecar suffix (at most the appends whose
         # sidecar update a crash dropped, or one append in flight):
         # parse FORWARD — torn-unit rules apply, complete units count.
@@ -522,11 +798,14 @@ def tail_records_reverse(topic: ColumnarFileTopic, doc: str, base: int,
                         elif upto is None or s <= upto:
                             fwd.append([rec])
         groups.extend(reversed(fwd))
-        # 2. Chain BACKWARD from the sidecar boundary, frame by frame.
+        # 2. Chain BACKWARD from the sidecar boundary, frame by frame,
+        # flooring at the truncation header (records below the base
+        # are reclaimed — a caller holding a summary never needs them)
+        # and at `stop_at` (records below it are provably <= base).
         lo = committed
         buf = b""
         buf_start = committed
-        while lo > 0 and not done:
+        while lo > floor and not done:
             # Grow the window until a frame ending exactly at `lo`
             # appears (or the region is provably not a frame). While
             # `lo` is fixed, a rejected candidate's verdict can never
@@ -559,10 +838,10 @@ def tail_records_reverse(topic: ColumnarFileTopic, doc: str, base: int,
                     pos = cand + 3
                 if anchored is not None:
                     break
-                if buf_start == 0 or \
+                if buf_start <= hlen or \
                         lo - buf_start > HEADER_MAX_EXTENT:
                     return None  # non-frame region: fall forward
-                step = min(_REV_BLOCK, buf_start)
+                step = min(_REV_BLOCK, buf_start - hlen)
                 f.seek(buf_start - step)
                 buf = f.read(step) + buf
                 m_bytes.inc(step)

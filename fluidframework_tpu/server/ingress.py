@@ -191,6 +191,13 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
 class IngressRole(_Role):
     """The supervised admission gate in front of the `ShardRouter`.
 

@@ -1,0 +1,718 @@
+"""Retention plane: fenced op-log truncation + castore GC — the
+summary-then-prune contract that keeps the farm's disk bounded.
+
+The farm sequences, fans out and summarizes, but until this module its
+disk only ever grew — the one thing a production ordering service can
+never do. The reference's scribe prunes the Mongo deltas collection
+behind each accepted summary and gitrest's objects are garbage-
+collected from the live refs (SURVEY §S1); `RetentionRole` is that
+contract as a SIXTH supervised role (full `server.supervisor._Role`
+machinery: fenced lease, heartbeat, checkpoint cadence, exactly-once
+recovery):
+
+- **Coverage** — the role consumes the ``summaries`` manifest topic:
+  a doc's newest durable manifest covers every sequenced record of
+  that doc at/below its seq (`SummarizerRole`'s safety argument — any
+  later reader boots from the summary and needs only the tail).
+- **Fenced TRUNCATE** — once a topic's prefix is covered (and every
+  tracked consumer/producer checkpoint is past it, and a ``keep_tail``
+  of newest records is spared for live tails), the role appends a
+  COMMITTED RETENTION RECORD to its own fenced ``retention`` topic
+  and only then physically reclaims the prefix
+  (`columnar_log.ColumnarFileTopic.truncate_prefix`: header + suffix
+  swapped in atomically; logical offsets never move). Torn-truncate
+  safe by ordering: a crash before the commit record reclaims
+  nothing; a crash after it is ROLLED FORWARD by recovery (re-execute
+  the newest committed cut per topic — idempotent, the base only
+  grows); a deposed zombie dies at its own topic's fence before any
+  byte goes away.
+- **Mark-and-sweep GC** — unreferenced `server.castore` blobs are
+  swept from the durable store, rooted at the newest ``keep_summaries``
+  manifests per doc plus every named ref. Concurrent-safe against
+  in-flight summary writes via an EPOCH PIN: the summarizer pins the
+  store (`write_pin`) before its first blob put of an emission round
+  and clears the pin once the round's manifests are durably appended;
+  the sweep never deletes a blob newer than the oldest live pin (or
+  younger than ``gc_grace_s``). Pins expire (`PIN_TTL_S`) so a dead
+  summarizer cannot block GC forever — safe because recovery re-puts
+  its blobs (content-addressed `put` recreates a missing file) before
+  re-emitting the manifests that reference them.
+
+The truncation clamps, spelled out (every one conservative):
+
+- **summary coverage** — an op record reclaims only once its doc's
+  newest durable manifest seq is at/past it; docs that never
+  summarize (frozen, undecided) pin the log rather than lose data.
+- **consumer floor** — min checkpointed offset over the configured
+  consumer roles (missing checkpoint = offset 0 = blocks), so no
+  supervised consumer can ever find its input truncated.
+- **producer floor** — records carrying ``inOff`` at/past their
+  PRODUCER's checkpointed offset are retained: the producer's
+  exactly-once recovery scans its output topic for that durable
+  prefix, and reclaiming it would make recovery re-emit (duplicate)
+  the gap. A producer counts as present once its heartbeat or
+  checkpoint exists.
+- **keep_tail** — the newest records are always spared, so realtime
+  tails (socket pushers, flight readers) a checkpoint never tracks
+  are structurally ahead of every cut.
+
+Columnar log format only: JSONL files have no truncation header, and
+the role says so loudly instead of silently never reclaiming.
+`tools/chaos_run.py --retention` drives the kill-during-truncate /
+kill-during-GC fault points; `testing.scenarios.run_week_of_traffic`
+is the week-of-traffic churn gate (disk high-water mark bounded while
+live, reconnecting and cold-from-summary clients stay bit-identical).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .castore import ContentAddressedStore
+from .columnar_log import ColumnarFileTopic, make_tail_reader, make_topic
+from .ingress import _env_float, _env_int
+from .supervisor import _Role, _topic_path
+
+__all__ = [
+    "PIN_TTL_S",
+    "RETENTION_FAULT_ENV",
+    "RetentionRole",
+    "clear_pin",
+    "disk_usage",
+    "live_pin_floor",
+    "write_pin",
+]
+
+# Env knobs (the supervisor's child_env seam carries them to the
+# retention child; explicit ctor args win).
+INTERVAL_ENV = "FLUID_RETENTION_INTERVAL"
+MIN_BYTES_ENV = "FLUID_RETENTION_MIN_BYTES"
+TOPICS_ENV = "FLUID_RETENTION_TOPICS"
+CONSUMERS_ENV = "FLUID_RETENTION_CONSUMERS"
+GRACE_ENV = "FLUID_RETENTION_GRACE"
+KEEP_TAIL_ENV = "FLUID_RETENTION_KEEP_TAIL"
+# Seeded fault points (the chaos harness's kill-during-truncate /
+# kill-during-GC axis): a JSON spec file ``{"point": "truncate"|"gc"}``
+# — when the role reaches the named point it consumes the spec and
+# SIGKILLs itself, so recovery's roll-forward is what the test
+# exercises, at exactly the nastiest instant.
+RETENTION_FAULT_ENV = "FLUID_RETENTION_FAULT"
+
+DEFAULT_TOPICS = ("deltas", "rawdeltas")
+# Deltas consumers the conservative default tracks (a missing
+# checkpoint reads as offset 0 and blocks truncation, so listing a
+# role that does not exist in a given farm STALLS reclaim rather than
+# corrupting it — the supervisor passes the exact live set).
+DEFAULT_CONSUMERS = ("scriptorium", "broadcaster", "scribe",
+                     "summarizer")
+# Producer checkpoint keys per topic base: records stamped ``inOff``
+# at/past the producer's checkpointed offset must be retained for its
+# exactly-once recovery scan. Several candidates = whichever of the
+# split/fused shapes this farm runs (presence-detected).
+PRODUCERS = {
+    "deltas": ("deli",),
+    "rawdeltas": ("ingress",),
+    "durable": ("scriptorium", "scriptorium_broadcaster"),
+    "broadcast": ("broadcaster", "scriptorium_broadcaster"),
+    "summaries": ("summarizer",),
+}
+
+# A pin whose FILE has not been rewritten for this long is ignored:
+# the writer died, and recovery re-puts its blobs before
+# re-referencing them. Liveness is the file mtime — a live writer
+# heartbeats mid-round by rewriting the pin with its ORIGINAL floor
+# (`write_pin(..., t=)`), so a round longer than the TTL keeps its
+# early puts covered.
+PIN_TTL_S = 60.0
+
+
+# ---------------------------------------------------------------------------
+# summarizer epoch pins (the GC's in-flight-write guard)
+# ---------------------------------------------------------------------------
+
+
+def _pins_dir(shared_dir: str) -> str:
+    return os.path.join(shared_dir, "store", "pins")
+
+
+def write_pin(shared_dir: str, name: str,
+              t: Optional[float] = None) -> float:
+    """Pin the summary store: blobs put from now on must survive the
+    sweep until the pin clears (the manifest referencing them is not
+    durable yet). One pin file per writer identity. Returns the floor
+    timestamp; a writer mid-round heartbeats by calling again with
+    that SAME `t` — the rewrite advances the file mtime (liveness)
+    while keeping the floor, so blobs put earlier in a long round
+    stay covered past PIN_TTL_S."""
+    t = time.time() if t is None else t
+    d = _pins_dir(shared_dir)
+    os.makedirs(d, exist_ok=True)
+    tmp = os.path.join(d, f".{name}.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"t": t, "name": name}, f)
+    os.replace(tmp, os.path.join(d, f"{name}.json"))
+    return t
+
+
+def clear_pin(shared_dir: str, name: str) -> None:
+    try:
+        os.unlink(os.path.join(_pins_dir(shared_dir), f"{name}.json"))
+    except OSError:
+        pass
+
+
+def live_pin_floor(shared_dir: str,
+                   now: Optional[float] = None) -> Optional[float]:
+    """The oldest LIVE pin timestamp (None: no live pins). The sweep
+    must not delete any blob whose mtime is at/after this instant —
+    it may be referenced by a manifest still in flight."""
+    now = time.time() if now is None else now
+    floor: Optional[float] = None
+    try:
+        names = os.listdir(_pins_dir(shared_dir))
+    except OSError:
+        return None
+    for fn in names:
+        if not fn.endswith(".json"):
+            continue
+        path = os.path.join(_pins_dir(shared_dir), fn)
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path) as f:
+                t = float(json.load(f).get("t", 0.0))
+        except (OSError, ValueError, TypeError):
+            continue
+        if now - mtime > PIN_TTL_S:
+            continue  # stale heartbeat: the writer died; recovery re-puts
+        floor = t if floor is None else min(floor, t)
+    return floor
+
+
+def disk_usage(shared_dir: str) -> Dict[str, int]:
+    """On-disk bytes of the farm's growth surfaces: the op-log topics
+    (+ sidecars) and the content-addressed store — the number the
+    week-of-traffic churn gate watches."""
+    def tree(path: str) -> int:
+        total = 0
+        for root, _dirs, files in os.walk(path):
+            for fn in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, fn))
+                except OSError:
+                    pass
+        return total
+
+    topics = tree(os.path.join(shared_dir, "topics"))
+    castore = tree(os.path.join(shared_dir, "store", "objects"))
+    return {"log_bytes": topics, "castore_bytes": castore,
+            "total_bytes": topics + castore}
+
+
+# ---------------------------------------------------------------------------
+# the role
+# ---------------------------------------------------------------------------
+
+
+class RetentionRole(_Role):
+    """summaries → retention: the summary-then-prune supervised role.
+
+    Consumes the manifest stream to learn per-doc coverage, commits
+    every reclaim to its own fenced ``retention`` topic BEFORE bytes
+    go away, and sweeps unreferenced castore blobs on a slower
+    cadence. Composes with the PR-1 machinery unchanged; its commit
+    records carry no ``inOff`` (they are decisions about *state*, not
+    deterministic functions of one input record), so the generic
+    recovery scan ignores them and recovery instead ROLLS FORWARD the
+    newest committed cut per topic — idempotent, since a topic's base
+    only advances."""
+
+    name = "retention"
+    in_topic_name = "summaries"
+    out_topic_name = "retention"
+
+    def __init__(self, *a, topics: Optional[Tuple[str, ...]] = None,
+                 consumers: Optional[Tuple[str, ...]] = None,
+                 interval_s: Optional[float] = None,
+                 gc_interval_s: Optional[float] = None,
+                 min_reclaim_bytes: Optional[int] = None,
+                 keep_tail: Optional[int] = None,
+                 keep_summaries: int = 2,
+                 gc_grace_s: Optional[float] = None,
+                 **kw):
+        super().__init__(*a, **kw)
+        if self.log_format != "columnar":
+            raise ValueError(
+                "RetentionRole needs log_format='columnar': JSONL "
+                "files have no truncation header, so a json farm "
+                "would silently never reclaim a byte"
+            )
+        env_topics = os.environ.get(TOPICS_ENV)
+        self.topics: Tuple[str, ...] = tuple(
+            topics if topics is not None
+            else (t.strip() for t in env_topics.split(","))
+            if env_topics else DEFAULT_TOPICS
+        )
+        # The role's OWN topics may be listed too — they take the
+        # META pruning rules instead of the generic coverage scan:
+        # ``summaries`` keeps the newest `keep_summaries` manifests
+        # per doc (plus the summarizer's recovery window),
+        # ``retention`` keeps the newest commit per managed topic
+        # (all roll-forward ever reads). Off by default: evidence
+        # consumers (the chaos harness) read these from offset 0.
+        env_cons = os.environ.get(CONSUMERS_ENV)
+        self.consumers: Tuple[str, ...] = tuple(
+            consumers if consumers is not None
+            else (c.strip() for c in env_cons.split(",") if c.strip())
+            if env_cons is not None else DEFAULT_CONSUMERS
+        )
+        self.interval_s = (interval_s if interval_s is not None
+                           else _env_float(INTERVAL_ENV, 2.0))
+        self.gc_interval_s = (gc_interval_s if gc_interval_s is not None
+                              else 2.0 * self.interval_s)
+        self.min_reclaim_bytes = (
+            min_reclaim_bytes if min_reclaim_bytes is not None
+            else _env_int(MIN_BYTES_ENV, 64 * 1024)
+        )
+        self.keep_tail = (keep_tail if keep_tail is not None
+                          else _env_int(KEEP_TAIL_ENV, 256))
+        self.keep_summaries = max(1, int(keep_summaries))
+        self.gc_grace_s = (gc_grace_s if gc_grace_s is not None
+                           else _env_float(GRACE_ENV, 10.0))
+        # Coverage state (checkpointed): doc -> newest durable summary
+        # seq, and the newest keep_summaries (seq, handle) pairs per
+        # doc (the GC roots).
+        self.cover: Dict[str, int] = {}
+        self.handles: Dict[str, List[List[Any]]] = {}
+        # Per managed topic: incremental reader, pending-uncovered
+        # record window, and the monotone reclaimable-upto offset.
+        self._scan: Dict[str, dict] = {}
+        self._retain_t = 0.0
+        self._gc_t = 0.0
+        # The most recent GC pass's store view (None until a pass has
+        # run) — the introspection seam tests and tools read.
+        self._store: Optional[ContentAddressedStore] = None
+        m = self.metrics
+        labels = self._metric_labels()
+        self._m_truncs = m.counter("retention_truncations_total",
+                                   **labels)
+        self._m_trunc_records = m.counter(
+            "retention_truncated_records_total", **labels
+        )
+        self._m_reclaimed = m.counter(
+            "retention_reclaimed_bytes_total", **labels
+        )
+        self._m_gc_runs = m.counter("retention_gc_runs_total", **labels)
+        self._m_gc_deleted = m.counter("retention_gc_deleted_total",
+                                       **labels)
+        self._m_gc_bytes = m.counter("retention_gc_bytes_total",
+                                     **labels)
+        self._m_blobs = m.gauge("castore_blobs", **labels)
+        self._m_blob_bytes = m.gauge("castore_bytes", **labels)
+
+    # ------------------------------------------------------------ state
+
+    def snapshot_state(self) -> Any:
+        return {"cover": self.cover, "handles": self.handles}
+
+    def restore_state(self, state: Any) -> None:
+        state = state or {}
+        self.cover = {str(d): int(s)
+                      for d, s in (state.get("cover") or {}).items()}
+        self.handles = {str(d): [list(p) for p in hs]
+                        for d, hs in (state.get("handles") or {}).items()}
+        self._scan = {}
+
+    # ------------------------------------------------------ input fold
+
+    def process(self, line_idx: int, rec: Any, out: List[dict]) -> None:
+        if not isinstance(rec, dict) or rec.get("kind") != "summary":
+            return
+        doc = rec.get("doc")
+        if not isinstance(doc, str):
+            return
+        seq = int(rec.get("seq", 0))
+        self.cover[doc] = max(self.cover.get(doc, 0), seq)
+        hs = self.handles.setdefault(doc, [])
+        # [seq, handle, summaries-topic offset, manifest inOff] — the
+        # last two feed the meta pruning rules (keep-depth cut and the
+        # summarizer's recovery-window floor).
+        hs.append([seq, rec.get("handle"), line_idx,
+                   int(rec.get("inOff", -1))
+                   if isinstance(rec.get("inOff"), int) else -1])
+        hs.sort(key=lambda p: p[0])
+        # Eviction happens in `_prune_handles` (per retain pass), not
+        # here: bounding the list per record would need the producer
+        # floor, and evicting an entry still inside the summarizer's
+        # recovery window lets `_summaries_cut` reclaim a manifest
+        # the restart scan must find.
+
+    # ---------------------------------------------------------- plumbing
+
+    def _suffixed(self, base: str) -> str:
+        """`base` carried to this role's partition slice (classic:
+        unchanged; ``-p{k}``/ranged suffixes follow the role name)."""
+        if self.partition is None:
+            return base
+        suffix = self.name[len("retention"):]
+        return f"{base}{suffix}"
+
+    def _topic(self, base: str):
+        entry = self._scan.get(base)
+        if entry is None or entry.get("topic") is None:
+            t = make_topic(
+                _topic_path(self.shared_dir, self._suffixed(base)),
+                self.log_format,
+            )
+            entry = self._scan.setdefault(base, {
+                "topic": None, "reader": None, "pending": [],
+                "upto": None, "head": 0,
+            })
+            entry["topic"] = t
+        return entry["topic"]
+
+    def _ckpt_offset(self, key: str) -> int:
+        env = self.ckpt.load(key)
+        if env is None:
+            return 0
+        try:
+            return int((env.get("state") or {}).get("offset", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _role_present(self, key: str) -> bool:
+        """Whether role `key` exists in this farm: it has checkpointed,
+        or at least heartbeaten (a role writes its heartbeat on its
+        very first step, before any record it stamps can exist)."""
+        if self.ckpt.load(key) is not None:
+            return True
+        return os.path.exists(
+            os.path.join(self.shared_dir, "hb", f"{key}.json")
+        )
+
+    def _producer_floor(self, base: str) -> Optional[int]:
+        """Offset below which ``inOff``-stamped records are safe to
+        reclaim (the producer's recovery scan never looks below its
+        checkpoint). None = no present producer = no constraint."""
+        floors = [
+            self._ckpt_offset(self._suffixed(key))
+            for key in PRODUCERS.get(base, ())
+            if self._role_present(self._suffixed(key))
+        ]
+        return min(floors) if floors else None
+
+    def _consumer_floor(self, base: str) -> Optional[int]:
+        """Min checkpointed input offset over this topic's tracked
+        consumers (missing checkpoint = 0 = blocks). None = topic has
+        no tracked consumers (a derived feed: summary coverage + the
+        keep_tail spare are the whole contract)."""
+        if base == "rawdeltas":
+            keys = [self._suffixed(k) for k in PRODUCERS["deltas"]]
+            keys = [k for k in keys if self._role_present(k)] or \
+                [self._suffixed("deli")]
+        elif base == "deltas":
+            keys = [self._suffixed(c) for c in self.consumers]
+        else:
+            return None
+        if not keys:
+            return None
+        return min(self._ckpt_offset(k) for k in keys)
+
+    # --------------------------------------------------------- the pass
+
+    def step(self, idle_sleep: float = 0.01) -> int:
+        # Pin floor BEFORE the manifest poll: a (manifest append +
+        # unpin) landing between a post-poll floor read and the sweep
+        # would delete a blob a durable-but-unread manifest references
+        # — permanently, since the summarizer has checkpointed past
+        # the round and nothing re-puts it. Captured pre-poll, either
+        # the pin is still live (its floor covers every blob of the
+        # round) or the manifest was durable before the poll and the
+        # poll returns it (moved > 0 defers the sweep; next pass it
+        # is a root).
+        pin0 = live_pin_floor(self.shared_dir)
+        moved = super().step(idle_sleep)
+        if self.fence is not None:
+            now = time.time()
+            if now - self._retain_t >= self.interval_s:
+                self._retain_t = now
+                self._retain_pass()
+            # GC only from a CAUGHT-UP manifest view (an idle pump =
+            # the summaries backlog is drained): the grace window
+            # protects blobs whose manifests are merely in flight,
+            # not ones a lagging consumer simply has not read yet.
+            if moved == 0 and now - self._gc_t >= self.gc_interval_s:
+                self._gc_t = now
+                self._gc_pass(pin_floor=pin0)
+        return moved
+
+    def _scan_topic(self, base: str) -> Optional[dict]:
+        """Advance `base`'s incremental scan: fold new records into the
+        pending window and pop the reclaimable prefix (coverage only
+        grows and floors only advance, so popped stays popped)."""
+        topic = self._topic(base)
+        if not isinstance(topic, ColumnarFileTopic):
+            return None
+        entry = self._scan[base]
+        if entry["upto"] is None:
+            entry["upto"] = topic.base_offsets()[0]
+        reader = entry["reader"]
+        if reader is None:
+            reader = entry["reader"] = make_tail_reader(
+                topic, entry["upto"]
+            )
+        pending: List[tuple] = entry["pending"]
+        # Bounded fill: an uncoverable run (docs that never summarize)
+        # must not grow the window without limit — scanning simply
+        # stalls at the blocker, memory stays flat.
+        while len(pending) < 65536:
+            batch = reader.poll(4096)
+            if not batch:
+                break
+            for off, rec in batch:
+                if isinstance(rec, dict):
+                    doc = rec.get("doc")
+                    seq = rec.get("seq")
+                    pending.append((
+                        off,
+                        doc if isinstance(doc, str)
+                        and isinstance(seq, int) else None,
+                        int(seq) if isinstance(seq, int) else 0,
+                        int(rec.get("inOff", -1))
+                        if isinstance(rec.get("inOff"), int) else -1,
+                    ))
+                else:
+                    pending.append((off, None, 0, -1))
+        entry["head"] = reader.next_line
+        pfloor = self._producer_floor(base)
+        cfloor = self._consumer_floor(base)
+        i = 0
+        upto = entry["upto"]
+        for off, doc, seq, in_off in pending:
+            if pfloor is not None and in_off >= pfloor:
+                break
+            if cfloor is not None and off >= cfloor:
+                break
+            if doc is not None and self.cover.get(doc, -1) < seq:
+                break
+            upto = off + 1
+            i += 1
+        if i:
+            del pending[:i]
+        entry["upto"] = upto
+        return entry
+
+    def _summaries_cut(self) -> int:
+        """Manifest-topic cut: keep every doc's newest
+        `keep_summaries` manifests (the catch-up discovery set + GC
+        roots), everything the summarizer's exactly-once recovery
+        window still scans (manifests with ``inOff`` at/past its
+        checkpointed input offset), and nothing past our own consumed
+        offset. Superseded manifests below all three are dead — no
+        reader ever resolves them again."""
+        if not self.handles:
+            return 0
+        cut: Optional[int] = None
+        pfloor = self._producer_floor("summaries")
+        for hs in self.handles.values():
+            keep_from = (hs[-self.keep_summaries][2]
+                         if len(hs) >= self.keep_summaries
+                         else hs[0][2])
+            if pfloor is not None:
+                for ent in hs:
+                    if len(ent) >= 4 and ent[3] >= pfloor:
+                        keep_from = min(keep_from, ent[2])
+                        break
+            cut = keep_from if cut is None else min(cut, keep_from)
+        return min(cut or 0, self.offset)
+
+    def _retention_cut(self) -> int:
+        """Own-topic cut: recovery's roll-forward only ever reads the
+        NEWEST truncate commit per topic, so everything below the
+        oldest of those (older commits, gc evidence) is dead."""
+        entries, _ = self.out_topic.read_entries(0)
+        newest: Dict[str, int] = {}
+        for i, r in entries:
+            if isinstance(r, dict) and r.get("kind") == "truncate" \
+                    and isinstance(r.get("topic"), str):
+                newest[r["topic"]] = i
+        return min(newest.values()) if newest else 0
+
+    def _prune_handles(self) -> None:
+        """Bound the checkpointed per-doc manifest lists: keep the
+        newest `keep_summaries` + 1 (root set + a same-seq
+        re-emission spare) AND every manifest still inside the
+        summarizer's exactly-once recovery window (``inOff`` at/past
+        its checkpointed input offset) — evicting one of those would
+        let `_summaries_cut` reclaim a manifest the producer's
+        restart scan re-emits, forking the summary stream."""
+        pfloor = self._producer_floor("summaries")
+        for hs in self.handles.values():
+            cut = max(0, len(hs) - (self.keep_summaries + 1))
+            if pfloor is not None:
+                for i, ent in enumerate(hs[:cut]):
+                    if len(ent) >= 4 and ent[3] >= pfloor:
+                        cut = i
+                        break
+            del hs[:cut]
+
+    def _retain_pass(self) -> None:
+        self._prune_handles()
+        for base in self.topics:
+            topic = self._topic(base)
+            if not isinstance(topic, ColumnarFileTopic):
+                continue
+            if base == self.in_topic_name:
+                cut = self._summaries_cut()
+            elif base == self.out_topic_name:
+                cut = self._retention_cut()
+            else:
+                entry = self._scan_topic(base)
+                if entry is None:
+                    continue
+                cut = min(entry["upto"],
+                          max(0, entry["head"] - self.keep_tail))
+            cur_r, cur_b = topic.base_offsets()
+            if cut <= cur_r:
+                continue
+            plan_r, plan_b = topic.truncate_prefix(cut, dry_run=True)
+            if plan_r <= cur_r or \
+                    plan_b - cur_b < self.min_reclaim_bytes:
+                continue
+            # COMMIT before RECLAIM: the fenced retention record is
+            # durable before any byte disappears, so a crash in
+            # between is rolled forward by recovery and a deposed
+            # zombie dies right here at the fence.
+            self._durable(lambda: self.out_topic.append_many(
+                [{"kind": "truncate", "topic": base,
+                  "records": plan_r, "bytes": plan_b}],
+                fence=self.fence, owner=self.owner,
+            ))
+            self._check_fault("truncate")
+            got_r, _got_b = self._durable(
+                lambda t=topic, r=plan_r: t.truncate_prefix(r)
+            )
+            self._m_truncs.inc()
+            self._m_trunc_records.inc(got_r - cur_r)
+            self._m_reclaimed.inc(plan_b - cur_b)
+            self.metrics.gauge(
+                "retention_base_records", topic=base,
+                **self._metric_labels()
+            ).set(got_r)
+            self.heartbeat(force=True)
+
+    # --------------------------------------------------------------- GC
+
+    def _gc_pass(self, pin_floor: Optional[float] = None) -> None:
+        # A FRESH store per pass: the ref table is loaded from
+        # refs.log at construction, and named refs are mark ROOTS —
+        # a cached snapshot would let the sweep delete a blob some
+        # other process ref'd since the first pass. Construction is
+        # one small-file read; the sweep itself dwarfs it.
+        store = self._store = ContentAddressedStore(
+            prefer_native=False,
+            directory=os.path.join(self.shared_dir, "store"),
+        )
+        roots = set()
+        for hs in self.handles.values():
+            for ent in hs[-self.keep_summaries:]:
+                if len(ent) >= 2 and isinstance(ent[1], str):
+                    roots.add(ent[1])
+        for name in store.list_refs():
+            ref = store.get_ref(name)
+            if ref:
+                roots.add(ref)
+        # Reclaim dead writers' staging files first (put tmps and GC
+        # quarantines orphaned by a kill) — they count against the
+        # disk bound and nothing else sweeps them.
+        store.sweep_tmp()
+        now = time.time()
+        mtime_bar = now - self.gc_grace_s
+        # The caller's PRE-POLL floor (see `step`) — re-reading pins
+        # here would reopen the unpin-after-poll window. A second
+        # read can only be LESS protective (pins only clear), so the
+        # pre-poll capture is the conservative one.
+        pin = (pin_floor if pin_floor is not None
+               else live_pin_floor(self.shared_dir, now))
+        if pin is not None:
+            mtime_bar = min(mtime_bar, pin)
+        deleted = freed = kept = kept_bytes = 0
+        faulted = False
+        for key, _path, size, mtime in store.list_blobs():
+            if key in roots or mtime >= mtime_bar:
+                kept += 1
+                kept_bytes += size
+                continue
+            if store.delete_blob(key, older_than=mtime_bar):
+                deleted += 1
+                freed += size
+                if not faulted:
+                    faulted = True
+                    self._check_fault("gc")
+        if not faulted:
+            self._check_fault("gc")
+        self._m_gc_runs.inc()
+        self._m_gc_deleted.inc(deleted)
+        self._m_gc_bytes.inc(freed)
+        self._m_blobs.set(kept)
+        self._m_blob_bytes.set(kept_bytes)
+        if deleted:
+            # The gc record is evidence, not a commit: deleting an
+            # unreferenced blob needs no roll-forward (a re-put
+            # recreates it), so it trails the sweep.
+            self._durable(lambda: self.out_topic.append_many(
+                [{"kind": "gc", "deleted": deleted, "bytes": freed,
+                  "kept": kept}],
+                fence=self.fence, owner=self.owner,
+            ))
+
+    # --------------------------------------------------------- recovery
+
+    def _recover_inner(self) -> None:
+        super()._recover_inner()
+        # Roll committed truncations FORWARD: a crash between the
+        # commit append and the physical cut re-executes it here —
+        # idempotent, the base only advances, and our fence is already
+        # bound on the retention topic above (a zombie never reaches
+        # this line; its successor's roll-forward is a no-op or the
+        # exact same cut).
+        entries, _ = self.out_topic.read_entries(0)
+        newest: Dict[str, int] = {}
+        for _i, r in entries:
+            if isinstance(r, dict) and r.get("kind") == "truncate" \
+                    and isinstance(r.get("topic"), str):
+                newest[r["topic"]] = max(
+                    newest.get(r["topic"], 0), int(r.get("records", 0))
+                )
+        for base, upto in newest.items():
+            if base not in self.topics:
+                continue
+            topic = self._topic(base)
+            if isinstance(topic, ColumnarFileTopic):
+                self._durable(
+                    lambda t=topic, u=upto: t.truncate_prefix(u)
+                )
+
+    # ------------------------------------------------------ fault seam
+
+    def _check_fault(self, point: str) -> None:
+        spec_path = os.environ.get(RETENTION_FAULT_ENV)
+        if not spec_path:
+            return
+        try:
+            with open(spec_path) as f:
+                spec = json.load(f)
+        except (OSError, ValueError):
+            return
+        if not isinstance(spec, dict) or spec.get("point") != point:
+            return
+        try:
+            os.unlink(spec_path)  # consume: the fault fires ONCE
+        except OSError:
+            return
+        print(f"retention: seeded kill at {point!r}", flush=True)
+        self.heartbeat(force=True)
+        os.kill(os.getpid(), signal.SIGKILL)

@@ -487,7 +487,10 @@ class _RangedMixin:
         self._renew_or_die()
         if self._reader is None or self._reader.next_line != self.offset:
             self._reader = make_tail_reader(self.in_topic, self.offset)
-        # 1. READ (don't process) one own-topic batch.
+        # 1. READ (don't process) one own-topic batch. The batch-start
+        # byte anchor (`_Role._in_pos`) is captured HERE and restored
+        # after the pred drains below clobber it to None.
+        in_pos0 = getattr(self._reader, "_pos", None)
         if self.ingest_batches and hasattr(self._reader, "poll_batches"):
             units = self._reader.poll_batches(self.batch)
         else:
@@ -496,6 +499,7 @@ class _RangedMixin:
         # 2. Drain every predecessor past the read point.
         pred_moved = self._pump_preds()
         # 3. Process the buffered own batch.
+        self._in_pos = in_pos0
         out: List[dict] = []
         moved = 0
         for unit in units:
@@ -622,6 +626,10 @@ class _RangedMixin:
                     p["quiet_since"] = None
                 return taken
             p["quiet_since"] = None
+            # Pred records have no own-topic byte anchor: a manifest
+            # emitted from this drain carries byteOff None (readers
+            # fall back to the unbounded backward scan).
+            self._in_pos = None
             out: List[Any] = []
             src_emit = isinstance(self.out_topic, ColumnarFileTopic)
             if src_emit:
@@ -1036,11 +1044,15 @@ class ShardWorker:
         `partitioned_role_class`: ``deltas-p{k}`` → ``summaries-p{k}``
         + content-addressed blobs in the shared store), following deli
         ownership for locality but fenced under its own
-        ``summarizer-p{k}`` lease. Static partitions only for now —
-        an elastic summarizer must absorb predecessor ranges' fold
-        state across a split/merge, which is a ROADMAP follow-up, so
-        asking for both is a loud config error rather than a silently
-        wrong summary.
+        ``summarizer-p{k}`` lease. On the ELASTIC fabric the
+        summarizer is ranged like the deli (`ranged_role_class` over
+        the same topology entry, ``deltas-{rid}`` →
+        ``summaries-{rid}``): its per-doc fold state is a flat map, so
+        a split/merge successor ABSORBS its predecessors' fold dicts
+        sliced to its hash range through the generic predecessor
+        machinery — summaries ride every topology
+        (`SummaryIndex(topics=router.stage_topic_names("summaries"))`
+        is the merged manifest read surface).
 
         `downstream` ("fused" | "split") promotes the farm's OTHER
         lambdas to per-partition supervised consumers riding deli
@@ -1069,12 +1081,6 @@ class ShardWorker:
                 "the elastic fabric"
             )
         self.downstream = downstream
-        if self.summarize and elastic:
-            raise ValueError(
-                "summarize=True is static-partition only: an elastic "
-                "summarizer must absorb predecessor ranges' fold state "
-                "across split/merge (ROADMAP follow-up)"
-            )
         self.shared_dir = shared_dir
         self.slot = slot
         self.owner = owner or slot
@@ -1271,7 +1277,17 @@ class ShardWorker:
     def _make_summ_role(self, key: Any):
         from .summarizer import SummarizerRole
 
-        cls = partitioned_role_class(SummarizerRole, key)
+        if self.elastic:
+            # Ranged summarizer: same topology entry as the deli, so a
+            # split/merge successor seeds from the predecessors' final
+            # fold checkpoints sliced to its range and re-emits only
+            # the clipped manifest tail (the `_RangedMixin` contract;
+            # the old "static-partition only" ValueError is gone).
+            cls = ranged_role_class(
+                SummarizerRole, self._entry(key), self.topology["epoch"]
+            )
+        else:
+            cls = partitioned_role_class(SummarizerRole, key)
         kw = {}
         if self.summary_ops is not None:
             kw["summary_ops"] = self.summary_ops
@@ -1963,11 +1979,6 @@ class ShardFabricSupervisor(ServiceSupervisor):
                 "(use 'split' on the elastic fabric)"
             )
         self.downstream = downstream
-        if self.summarize and self.elastic:
-            raise ValueError(
-                "summarize=True is static-partition only "
-                "(elastic summarizer: ROADMAP follow-up)"
-            )
         if autoscale and not self.elastic:
             raise ValueError(
                 "autoscale needs elastic=True (the policy issues "

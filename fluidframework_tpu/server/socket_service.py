@@ -526,6 +526,15 @@ class FarmReadServer:
 
     def catchup(self, doc_id: str,
                 from_seq: Optional[int] = None) -> dict:
+        """Summary-aware reconnect: a session at `from_seq` gets
+
+        - ``from_seq >= newest summary seq`` (short gap): the op gap
+          alone — no blob shipped, the tail seek is O(tail) via the
+          manifest's byte offset;
+        - ``from_seq < newest summary seq`` (long offline): the newest
+          summary blob + the tail PAST it — the client REBOOTS from
+          the summary instead of replaying the op gap, which with the
+          retention plane on may no longer physically exist."""
         from .summarizer import read_catchup
 
         res = read_catchup(
@@ -535,10 +544,12 @@ class FarmReadServer:
         )
         base = res["manifest"]["seq"] if res["manifest"] else 0
         ops = res["ops"]
-        if from_seq is not None and from_seq > base:
+        if from_seq is not None and from_seq >= base:
             ops = [r for r in ops if int(r["seq"]) > from_seq]
+            return {"manifest": res["manifest"], "blob": None,
+                    "ops": ops, "rebase": False}
         return {"manifest": res["manifest"], "blob": res["blob"],
-                "ops": ops}
+                "ops": ops, "rebase": res["manifest"] is not None}
 
     def start(self) -> "FarmReadServer":
         self.pusher.start()

@@ -363,9 +363,27 @@ class SummarizerRole(_Role):
     no-op with the same handle: **restarts cannot fork a summary**.
 
     Runs per-partition under `partitioned_role_class` (``deltas-p{k}``
-    → ``summaries-p{k}``) for the static sharded fabric; the elastic
-    hash-range topology needs predecessor absorption for the fold
-    state and is a ROADMAP follow-up."""
+    → ``summaries-p{k}``) for the static sharded fabric, and per RANGE
+    under `shard_fabric.ranged_role_class` on the ELASTIC fabric: the
+    fold state is a flat per-doc map, so a split/merge successor
+    absorbs its predecessors' fold dicts sliced to its hash range
+    through the generic `_RangedMixin` machinery (seed from the final
+    fenced checkpoints, fence-bind on the pred manifest topics, silent
+    replay of the durable prefix, missing manifests re-emitted
+    exactly-once) — summaries ride every topology.
+
+    Manifests additionally carry ``byteOff`` — the LOGICAL deltas-topic
+    byte position at the start of the trigger's input batch (None when
+    the emission came from recovery replay or a predecessor drain,
+    where no own-topic anchor exists). It is a hard lower bound for
+    the catch-up tail seek (`read_catchup` feeds it to the backward
+    scans as ``stop_at``), stable under op-log truncation.
+
+    Around each emission round the role PINS the summary store
+    (`server.retention.write_pin`) until the round's manifests are
+    durably appended: the retention plane's castore GC never sweeps a
+    blob newer than the oldest live pin, closing the put→manifest
+    race without coordinating with the sweeper."""
 
     name = "summarizer"
     in_topic_name = "deltas"
@@ -403,10 +421,19 @@ class SummarizerRole(_Role):
     # ------------------------------------------------------------ state
 
     def snapshot_state(self) -> Any:
-        return {"docs": self.docs}
+        # A FLAT {doc: fold} map — the shape `_RangedMixin` slices by
+        # hash range when an elastic successor absorbs this role's
+        # final checkpoint (every ranged role's state contract).
+        return dict(self.docs)
 
     def restore_state(self, state: Any) -> None:
-        self.docs = dict((state or {}).get("docs") or {})
+        state = dict(state or {})
+        if set(state) == {"docs"} and isinstance(state["docs"], dict) \
+                and all(isinstance(v, dict) and "count" in v
+                        for v in state["docs"].values()):
+            # Pre-retention checkpoint shape ({"docs": {...}}): unwrap.
+            state = dict(state["docs"])
+        self.docs = state
         self._reps = {}
         self._triggers = []
 
@@ -471,6 +498,11 @@ class SummarizerRole(_Role):
             self._triggers.append((
                 rec["doc"], line_idx, len(f["window"]),
                 len(f["records"]), f["seq"], f["msn"], f["count"],
+                # The input batch's start byte (logical; None in
+                # recovery replay / pred drains): every record of this
+                # doc below it is at/below this summary's seq, so the
+                # manifest's byteOff bounds the catch-up tail seek.
+                self._in_pos,
             ))
 
     # ------------------------------------------------------- emission
@@ -492,6 +524,17 @@ class SummarizerRole(_Role):
             return
         import time as _time
 
+        from .retention import write_pin
+
+        # GC epoch pin: blobs put from here on may not be referenced
+        # by a durable manifest yet — the retention sweeper spares
+        # everything newer than this instant until the pin clears
+        # (after this round's outputs are appended, or on expiry if we
+        # die — recovery's silent replay re-puts the blobs before the
+        # clipped manifests are re-emitted, so expiry is safe).
+        self._pin_t = write_pin(self.shared_dir, self.name)
+        self._pin_hb = self._pin_t
+        self._pinned = True
         t0 = _time.perf_counter()
         triggers, self._triggers = self._triggers, []
         consumed: Dict[str, int] = {}
@@ -511,10 +554,51 @@ class SummarizerRole(_Role):
             i = j
         self._m_build_ms.observe((_time.perf_counter() - t0) * 1000.0)
 
+    def _refresh_pin(self) -> None:
+        # Heartbeat the GC pin mid-round: rewriting with the ORIGINAL
+        # floor keeps blobs put earlier in the round covered while the
+        # file mtime proves this writer is alive — a round longer than
+        # retention.PIN_TTL_S must not lose its early puts to the sweep.
+        # Time-gated: liveness runs on the TTL clock, so the hot
+        # emission path only pays the file rewrite every TTL/4, not
+        # per blob put.
+        if getattr(self, "_pinned", False):
+            import time as _time
+
+            from .retention import PIN_TTL_S, write_pin
+
+            now = _time.time()
+            if now - getattr(self, "_pin_hb", 0.0) < PIN_TTL_S / 4.0:
+                return
+            self._pin_hb = now
+            write_pin(self.shared_dir, self.name, self._pin_t)
+
+    def _unpin(self) -> None:
+        if getattr(self, "_pinned", False):
+            from .retention import clear_pin
+
+            clear_pin(self.shared_dir, self.name)
+            self._pinned = False
+
+    def _append_outputs(self, out: List[dict]) -> int:
+        n = super()._append_outputs(out)
+        # The round's manifests are durable: release the GC pin.
+        self._unpin()
+        return n
+
+    def checkpoint(self) -> None:
+        super().checkpoint()
+        # Recovery and pred drains append outside `_append_outputs`;
+        # both checkpoint right after, so the pin never outlives the
+        # round however the manifests landed.
+        self._unpin()
+
     def _emit_round(self, round_jobs: List[tuple],
                     consumed: Dict[str, int], out: List[dict]) -> None:
+        self._refresh_pin()
         fold_jobs: List[tuple] = []
-        for doc, _line, upto, _rupto, _seq, msn, _count in round_jobs:
+        for doc, _line, upto, _rupto, _seq, msn, _count, _bo \
+                in round_jobs:
             f = self.docs[doc]
             if f["engine"] != "mergetree":
                 continue
@@ -532,7 +616,8 @@ class SummarizerRole(_Role):
             self._m_stacked.inc(len(fold_jobs))
         if fold_jobs:
             _fold_jobs(fold_jobs)
-        for doc, line_idx, upto, rec_upto, seq, msn, count in round_jobs:
+        for doc, line_idx, upto, rec_upto, seq, msn, count, byte_off \
+                in round_jobs:
             f = self.docs[doc]
             if f["engine"] == "frozen":
                 continue
@@ -566,6 +651,7 @@ class SummarizerRole(_Role):
             payload = json.dumps(
                 blob, sort_keys=True, separators=(",", ":")
             ).encode()
+            self._refresh_pin()
             handle = self._durable(lambda: self.store.put(payload))
             f["last"] = {"seq": seq, "handle": handle}
             self._m_summaries.inc()
@@ -574,6 +660,15 @@ class SummarizerRole(_Role):
                 "kind": "summary", "doc": doc, "seq": seq, "msn": msn,
                 "count": count, "form": blob["form"], "handle": handle,
                 "bytes": len(payload), "off": line_idx,
+                # Byte-offset hint for the O(tail) catch-up seek
+                # (None: recovery replay / pred drain — readers fall
+                # back to the unbounded backward scan). byteTopic
+                # names the byte space: on the elastic fabric a
+                # ranged summarizer's offsets are meaningless in any
+                # OTHER range's topic, so readers use the floor only
+                # when the topic they scan matches.
+                "byteOff": byte_off,
+                "byteTopic": self.in_topic_name,
                 "inOff": line_idx,
             })
 
@@ -590,15 +685,24 @@ class SummaryIndex:
     fabric's ``summaries-p{k}`` siblings to the tail set."""
 
     def __init__(self, shared_dir: str, log_format: Optional[str] = None,
-                 partitions: int = 1):
+                 partitions: int = 1,
+                 topics: Optional[List[str]] = None):
+        """`topics` names the manifest topics explicitly (the ELASTIC
+        fabric's per-range ``summaries-{rid}`` set across the topology
+        history — `ShardRouter.stage_topic_names("summaries")`);
+        `partitions` keeps the static fabric's ``summaries-p{k}``
+        shorthand."""
         import threading
 
         from .queue import partition_suffix
 
-        names = ["summaries"]
-        if partitions > 1:
-            names += [partition_suffix("summaries", k)
-                      for k in range(partitions)]
+        if topics is not None:
+            names = list(topics)
+        else:
+            names = ["summaries"]
+            if partitions > 1:
+                names += [partition_suffix("summaries", k)
+                          for k in range(partitions)]
         self._readers = [
             make_tail_reader(make_topic(
                 os.path.join(shared_dir, "topics", f"{n}.jsonl"),
@@ -750,13 +854,19 @@ def state_digest(replica: SummaryReplica) -> str:
 
 
 def _tail_records_reverse(path: str, doc: str, base: int,
-                          upto: Optional[int]) -> List[dict]:
+                          upto: Optional[int],
+                          stop_at: Optional[int] = None) -> List[dict]:
     """`doc`'s op records with ``base < seq [<= upto]`` read BACKWARD
     from the topic's end — O(tail + interleave), not O(log): per-doc
     seqs are append-monotone, so the first own-doc record at/below
     `base` bounds the scan. JSONL topics only (a frame log needs the
     forward walk); the torn-tail rule holds — a final line without
-    its newline is never consumed."""
+    its newline is never consumed.
+
+    ``stop_at`` (a manifest's ``byteOff`` — a line boundary) floors
+    the walk: every own-doc record below it is at/below `base`, so
+    the seek is O(tail) even with zero own-doc interleave."""
+    stop = max(0, int(stop_at)) if isinstance(stop_at, int) else 0
     out: List[dict] = []
     try:
         f = open(path, "rb")
@@ -765,11 +875,12 @@ def _tail_records_reverse(path: str, doc: str, base: int,
     with f:
         f.seek(0, os.SEEK_END)
         pos = f.tell()
+        stop = min(stop, pos)
         block = 1 << 16
         carry = b""
         first = True
-        while pos > 0:
-            step = min(block, pos)
+        while pos > stop:
+            step = min(block, pos - stop)
             pos -= step
             f.seek(pos)
             data = f.read(step) + carry
@@ -798,7 +909,11 @@ def _tail_records_reverse(path: str, doc: str, base: int,
                 if upto is None or s <= upto:
                     out.append(rec)
             block = min(block * 2, 1 << 22)
-        # File start reached: carry is the (complete) first line.
+        # Floor reached (file start, or the byteOff line boundary):
+        # carry is the (complete) first line of the scanned region —
+        # a non-aligned stop leaves a partial line, which simply
+        # fails to parse and is skipped (records below the floor are
+        # at/below `base` by the byteOff contract anyway).
         raw = carry.strip()
         if raw:
             try:
@@ -839,20 +954,61 @@ def read_catchup(shared_dir: str, doc: str,
     idx.poll()
     man = idx.nearest(doc, seq)
     blob = None
+    swept = False
     if man is not None:
         st = store or open_summary_store(shared_dir)
-        blob = json.loads(st.get(man["handle"]).decode())
+        try:
+            blob = json.loads(st.get(man["handle"]).decode())
+        except KeyError:
+            # Castore GC swept this manifest's blob: it fell below
+            # the doc's retention root set (only the newest
+            # ``keep_summaries`` manifests stay referenced, while a
+            # quiet doc can hold the manifest-topic cut back far
+            # enough for older ones to stay discoverable). Fall to
+            # the full-replay path — honest only while the op log
+            # still holds the doc's whole history, checked below.
+            man, swept = None, True
     topic = make_topic(
         os.path.join(shared_dir, "topics", f"{deltas_topic}.jsonl"),
         log_format,
     )
+    if man is None and (swept or seq is not None):
+        # No usable summary at/below the requested seq. A replay from
+        # logical 0 silently resumes at the truncation base, so if the
+        # doc IS summarized (its covered prefix may be physically
+        # reclaimed) and the log has a cut, partial state would come
+        # back as if complete — refuse loudly instead. Docs with no
+        # summary at all never pass the retention coverage clamp, so
+        # their history is structurally intact whatever the base.
+        base_gone = (topic.base_offsets()[0] > 0
+                     if hasattr(topic, "base_offsets") else False)
+        if base_gone and (swept or idx.nearest(doc) is not None):
+            raise LookupError(
+                f"catchup({doc!r}, seq={seq}): state below the "
+                f"retention horizon — the nearest summary blob was "
+                f"garbage-collected and/or the covered op prefix was "
+                f"truncated; only the newest summaries are retained"
+            )
     base = int(man["seq"]) if man is not None else 0
     ops = None
     if man is not None:
+        # The manifest's byteOff (when present) floors the backward
+        # walk: O(tail) however sparse the doc's records are in the
+        # interleave, truncation-stable (logical bytes) — but ONLY in
+        # the byte space it was stamped against (`byteTopic`). A
+        # pred-era manifest read through the merged elastic index
+        # against a successor range's topic would floor the walk at a
+        # foreign offset and silently drop tail ops; mismatch falls
+        # back to the unbounded (still correct) scan.
+        stop = man.get("byteOff")
+        stop = (stop if isinstance(stop, int)
+                and man.get("byteTopic") == deltas_topic else None)
         if isinstance(topic, ColumnarFileTopic):
-            ops = tail_records_reverse(topic, doc, base, seq)
+            ops = tail_records_reverse(topic, doc, base, seq,
+                                       stop_at=stop)
         else:
-            ops = _tail_records_reverse(topic.path, doc, base, seq)
+            ops = _tail_records_reverse(topic.path, doc, base, seq,
+                                        stop_at=stop)
     if ops is None:
         # The manifest's `off` (its trigger's input line) bounds the
         # forward scan: records at/below it are covered.

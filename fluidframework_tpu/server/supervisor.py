@@ -175,6 +175,13 @@ class _Role:
     # that flush. Recovery and wire tracing force the dict path on
     # their own flags.
     _dict_emit: bool = False
+    # LOGICAL input-topic byte position at the START of the batch being
+    # processed (captured off the incremental reader before each poll;
+    # None during recovery replay and predecessor drains, where no such
+    # anchor exists). The summarizer stamps it into its manifests as
+    # ``byteOff`` — a hard lower bound for the catch-up tail seek,
+    # stable under op-log truncation.
+    _in_pos: Optional[int] = None
 
     def _metric_labels(self) -> Dict[str, str]:
         """Metric label set: single-partition roles keep the historic
@@ -615,6 +622,9 @@ class _Role:
         # per step is O(topic²) over a role's lifetime.
         if self._reader is None or self._reader.next_line != self.offset:
             self._reader = make_tail_reader(self.in_topic, self.offset)
+        # Batch-start input byte anchor (see `_in_pos`): every record
+        # of the coming poll sits at/after this logical position.
+        self._in_pos = getattr(self._reader, "_pos", None)
         out: List[dict] = []
         moved = 0
         if self.ingest_batches and hasattr(self._reader, "poll_batches"):
@@ -1276,6 +1286,10 @@ def resolve_role_class(role: str, deli_impl: str = "scalar"):
         from .ingress import IngressRole
 
         return IngressRole
+    if role == "retention":
+        from .retention import RetentionRole
+
+        return RetentionRole
     return ROLE_CLASSES[role]
 
 
@@ -1414,7 +1428,9 @@ class ServiceSupervisor:
                  hb_interval_s: Optional[float] = None,
                  summary_ops: Optional[int] = None,
                  fused_hop: bool = False,
-                 ingress: bool = False):
+                 ingress: bool = False,
+                 retention: bool = False,
+                 retention_env: Optional[Dict[str, str]] = None):
         """`child_env` adds/overrides spawn-environment variables for
         every child (the chaos harness's seam: it points CHILDREN at a
         disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
@@ -1437,10 +1453,41 @@ class ServiceSupervisor:
             roles = fused_roles(tuple(roles))
         if ingress and "ingress" not in roles:
             roles = ("ingress",) + tuple(roles)
+        if retention and "retention" not in roles:
+            # Sixth role, the retention plane (`server.retention`):
+            # summary-driven fenced op-log truncation + castore GC.
+            # Opt-in — with it on, readers that need a topic's full
+            # prefix must boot from the newest summary instead.
+            roles = tuple(roles) + ("retention",)
+        self.retention = bool(retention) or "retention" in roles
         self.ingress = bool(ingress) or "ingress" in roles
         self.fused_hop = bool(fused_hop)
         self.shared_dir = shared_dir
         self.child_env = dict(child_env or {})
+        if self.retention:
+            if default_log_format(log_format) != "columnar":
+                raise ValueError(
+                    "retention=True needs log_format='columnar' "
+                    "(JSONL topics have no truncation header)"
+                )
+            if "summarizer" not in roles:
+                raise ValueError(
+                    "retention=True needs the summarizer in roles: "
+                    "truncation only reclaims SUMMARY-covered records"
+                )
+            # The retention child's consumer set is THIS farm's actual
+            # deltas consumers — a role that is not in the farm must
+            # not block reclaim as a phantom offset-0 checkpoint.
+            deltas_consumers = [
+                r for r in roles
+                if r in ("scriptorium", "broadcaster", "scribe",
+                         "summarizer", ScriptoriumBroadcasterRole.name)
+            ]
+            self.child_env.setdefault(
+                "FLUID_RETENTION_CONSUMERS", ",".join(deltas_consumers)
+            )
+            for k, v in (retention_env or {}).items():
+                self.child_env[k] = str(v)
         self.hb_interval_s = hb_interval_s
         self.summary_ops = (
             int(summary_ops) if summary_ops is not None else None
@@ -1768,7 +1815,8 @@ class ServiceSupervisor:
         return {"status": "ok" if ok else "degraded", "roles": roles,
                 "deli_impl": self.deli_impl,
                 "log_format": self.log_format,
-                "fused_hop": self.fused_hop}
+                "fused_hop": self.fused_hop,
+                "retention": self.retention}
 
     def _hb_field(self, role: str, key: str) -> Any:
         """One field off `role`'s last heartbeat (None if absent)."""
@@ -1856,7 +1904,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     ingress_elastic = "--ingress-elastic" in args
     if ingress_elastic:
         args.remove("--ingress-elastic")
-    if (role not in ROLES + (ScriptoriumBroadcasterRole.name, "ingress")
+    if (role not in ROLES + (ScriptoriumBroadcasterRole.name, "ingress",
+                             "retention")
             or shared_dir is None
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
@@ -1869,7 +1918,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster|summarizer"
-            "|scriptorium_broadcaster|ingress} "
+            "|scriptorium_broadcaster|ingress|retention} "
             "--dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "

@@ -161,6 +161,19 @@ class ChaosConfig:
     # (`summarizer.state_digest`). Classic single-partition farm only.
     summarizer: bool = False
     summary_ops: int = 32
+    # Retention plane (`server.retention.RetentionRole`): run the
+    # SIXTH supervised role — summary-driven fenced op-log truncation
+    # + castore GC — include it in the kill schedule, fire the SEEDED
+    # kill-during-truncate and kill-during-GC fault points (the role
+    # SIGKILLs itself between its fenced commit record and the
+    # physical reclaim / mid-sweep; recovery must roll the cut forward
+    # with zero dup/skip), and gate the run on RETENTION INTEGRITY:
+    # at least one committed truncation actually reclaimed the deltas
+    # prefix, both seeded kill points fired, and summary + tail still
+    # boots bit-identical to a cold replay (read off the untruncated
+    # durable topic). Requires summarizer=True and the columnar log
+    # format (JSONL has no truncation header); classic farm only.
+    retention: bool = False
     # Fused durable+broadcast hop (`supervisor.
     # ScriptoriumBroadcasterRole`): the scriptorium+broadcaster pair
     # collapses into ONE supervised consumer (durable leg fsynced,
@@ -254,6 +267,14 @@ class ChaosResult:
     # Downstream evidence (downstream runs): the merged durable legs
     # matched the sequenced stream bit-identically.
     downstream_ok: bool = True
+    # Retention evidence (retention runs): committed truncations
+    # observed, the deltas base they advanced to, blobs the GC swept,
+    # and whether the integrity gate held (commits rolled forward,
+    # seeded kill points fired, summary+tail == cold durable replay).
+    retention_ok: bool = True
+    truncations: int = 0
+    retention_base_records: int = 0
+    gc_deleted: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -552,6 +573,27 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
             "fused_hop=True runs on the classic single-partition farm "
             "(the sharded fabric has no downstream stage pair)"
         )
+    if cfg.retention:
+        # Retention truncates only SUMMARY-covered prefixes, and only
+        # the columnar log has a truncation header; on the fabric the
+        # retention role is a follow-up. Each a loud config error —
+        # a run that silently skipped the plane would still print a
+        # retention verdict.
+        if not cfg.summarizer:
+            raise ValueError(
+                "retention=True needs summarizer=True (truncation "
+                "only reclaims summary-covered records)"
+            )
+        if cfg.log_format != "columnar":
+            raise ValueError(
+                "retention=True needs log_format='columnar' (JSONL "
+                "topics have no truncation header)"
+            )
+        if cfg.n_partitions > 1:
+            raise ValueError(
+                "retention=True runs on the classic single-partition "
+                "farm (fabric retention: ROADMAP follow-up)"
+            )
     if cfg.summarizer and cfg.n_partitions > 1:
         # The per-partition summarizer rides ShardWorker(summarize=)
         # on the STATIC fabric; the chaos gate for it is a follow-up —
@@ -717,6 +759,12 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         # restarts must re-emit byte-identical manifests, never fork.
         kill_targets.append("summarizer")
         roles = tuple(roles) + ("summarizer",)
+    if cfg.retention:
+        # Sixth role: the retention plane — SIGKILLed like any other,
+        # PLUS the seeded kill-during-truncate / kill-during-GC points
+        # below (the role kills itself between its fenced commit and
+        # the physical reclaim; recovery must roll the cut forward).
+        kill_targets.append("retention")
     chunks, dup_after, kill_at, torn_at, lease_at = _feed_plan(
         cfg, rng, workload, tuple(kill_targets),
     )
@@ -724,14 +772,32 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
     kill_at, _ = _clamp_faults_into_storm(cfg, rng, storm_idx,
                                           kill_at, None)
 
+    ret_fault = os.path.join(shared, "retention-fault.json")
+    child_env: Dict[str, str] = dict(
+        _trace_env() if cfg.trace_wire else {}
+    )
+    if cfg.retention:
+        from ..server.retention import RETENTION_FAULT_ENV
+
+        child_env[RETENTION_FAULT_ENV] = ret_fault
     sup = ServiceSupervisor(
         shared, roles=roles, ttl_s=cfg.ttl_s,
         heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
         deli_impl=cfg.deli_impl, log_format=cfg.log_format,
         deli_devices=cfg.deli_devices,
-        child_env=_trace_env() if cfg.trace_wire else None,
+        child_env=child_env or None,
         summary_ops=cfg.summary_ops if cfg.summarizer else None,
         fused_hop=cfg.fused_hop,
+        retention=cfg.retention,
+        retention_env={
+            # Aggressive knobs so a short seeded run actually reclaims:
+            # every covered frame qualifies, a tiny tail is spared,
+            # and GC's grace is one beat.
+            "FLUID_RETENTION_INTERVAL": "0.2",
+            "FLUID_RETENTION_MIN_BYTES": "1",
+            "FLUID_RETENTION_KEEP_TAIL": "4",
+            "FLUID_RETENTION_GRACE": "0.5",
+        } if cfg.retention else None,
     ).start()
     raw = make_topic(os.path.join(shared, "topics", "rawdeltas.jsonl"),
                      cfg.log_format)
@@ -764,6 +830,31 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         events.append(ev)
         timeline.append((time.time(), ev))
 
+    # Seeded retention kill points: armed one at a time from 1/3 of
+    # the feed on — the NEXT time the role reaches the named point it
+    # consumes the spec and SIGKILLs itself. Sequential (gc armed only
+    # after truncate fired), so both points demonstrably fire.
+    ret_points = ["truncate", "gc"] if cfg.retention else []
+    ret_arm_at = max(1, len(chunks) // 3) if cfg.retention else None
+
+    def pump_retention_faults() -> None:
+        if not ret_points or os.path.exists(ret_fault):
+            return
+        point = ret_points.pop(0)
+        tmp = ret_fault + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"point": point}, f)
+        os.replace(tmp, ret_fault)
+        note(f"chaos: retention kill armed at {point!r}")
+
+    def retention_done() -> bool:
+        if not cfg.retention:
+            return True
+        if ret_points or os.path.exists(ret_fault):
+            return False
+        deltas_t = make_topic(deltas_path, cfg.log_format)
+        return deltas_t.base_offsets()[0] > 0
+
     try:
         if storm_idx:
             note(f"chaos: scenario {cfg.scenario!r} storm spans "
@@ -774,6 +865,8 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         deadline = time.time() + cfg.timeout_s
         while time.time() < deadline:
             sup.poll_once()
+            if ret_arm_at is not None and fed_idx >= ret_arm_at:
+                pump_retention_faults()
             if fed_idx < len(chunks):
                 if cfg.trace_wire:
                     # Stamp the submit instant at FEED time (the
@@ -825,6 +918,9 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
                 ) < expected_manifests:
                     time.sleep(0.02)
                     continue  # the summary service must finish too
+                if not retention_done():
+                    time.sleep(0.02)
+                    continue  # both kill points + a real reclaim first
                 scr = FencedCheckpointStore(
                     os.path.join(shared, "checkpoints")
                 ).load("scribe")
@@ -885,9 +981,15 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
             and all(len(hs) == 1 for hs in by_key.values())
         )
         if summaries_ok and expected_manifests:
-            deltas_topic = make_topic(deltas_path, cfg.log_format)
+            # Cold-replay source: with retention ON the deltas prefix
+            # is legitimately truncated, so the full stream comes off
+            # the (untruncated) durable leg — same records, scriptorium
+            # re-keyed, canonical fields intact.
+            src_topic = durable if cfg.retention else make_topic(
+                deltas_path, cfg.log_format
+            )
             deltas_ops = [
-                r for r in deltas_topic.read_from(0)
+                r for r in src_topic.read_from(0)
                 if isinstance(r, dict) and r.get("kind") == "op"
             ]
             store = open_summary_store(shared)
@@ -906,9 +1008,56 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
                         f"summary+tail boot DIVERGED for {doc}"
                     )
                     break
+    # Retention integrity (retention runs): >= 1 committed truncation,
+    # every committed cut rolled forward (the topic base is at/past
+    # the newest commit — the torn-truncate contract), and both seeded
+    # kill points actually fired.
+    retention_ok = True
+    truncations = 0
+    base_records = 0
+    gc_deleted = 0
+    if cfg.retention:
+        rt = make_topic(
+            os.path.join(shared, "topics", "retention.jsonl"),
+            cfg.log_format,
+        )
+        commits = [r for r in rt.read_from(0) if isinstance(r, dict)]
+        truncations = sum(1 for r in commits
+                          if r.get("kind") == "truncate")
+        gc_deleted = sum(int(r.get("deleted", 0)) for r in commits
+                         if r.get("kind") == "gc")
+        newest_cut = max(
+            (int(r.get("records", 0)) for r in commits
+             if r.get("kind") == "truncate"
+             and r.get("topic") == "deltas"), default=0,
+        )
+        deltas_t = make_topic(deltas_path, cfg.log_format)
+        base_records = deltas_t.base_offsets()[0]
+        if newest_cut > base_records:
+            # The final sup.stop() can SIGKILL retention INSIDE the
+            # commit-then-reclaim window (commit durable, bytes not
+            # yet reclaimed) — legal torn state whose contract is
+            # recovery roll-forward, but no successor runs after
+            # stop. Roll it forward here (idempotent, same as
+            # `_recover_inner`): the gate then verifies the committed
+            # cut actually applies instead of flaking on the window.
+            try:
+                deltas_t.truncate_prefix(newest_cut)
+            except Exception as exc:  # noqa: BLE001 - gate evidence
+                events.append(f"retention roll-forward failed: {exc}")
+            base_records = deltas_t.base_offsets()[0]
+        points_fired = not ret_points and not os.path.exists(ret_fault)
+        retention_ok = (truncations > 0 and newest_cut > 0
+                        and base_records >= newest_cut and points_fired)
+        if not retention_ok:
+            events.append(
+                f"retention integrity FAILED: truncations={truncations}"
+                f" newest_cut={newest_cut} base={base_records} "
+                f"points_fired={points_fired}"
+            )
     converged = (
         digest == gdigest and dups == 0 and skips == 0 and scribe_ok
-        and summaries_ok
+        and summaries_ok and retention_ok
         and (client_digest in (None, gdigest))
         and ("lease" not in cfg.faults or fence_rejections > 0)
     )
@@ -916,6 +1065,9 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         f"ops={len(ops)}/{expected} restarts={sup.restarts} "
         + (f"manifests={n_manifests}/{expected_manifests} "
            f"summaries_ok={summaries_ok} " if cfg.summarizer else "")
+        + (f"truncations={truncations} base={base_records} "
+           f"gc_deleted={gc_deleted} retention_ok={retention_ok} "
+           if cfg.retention else "")
         + f"events={events + sup.events}"
     )
     # Observability artifacts: merge every role's final
@@ -941,6 +1093,8 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         timeline=sorted(timeline + sup.timeline), metrics=metrics,
         slow_ops=sup.child_slow_ops() if cfg.trace_wire else [],
         summaries_ok=summaries_ok, summary_manifests=n_manifests,
+        retention_ok=retention_ok, truncations=truncations,
+        retention_base_records=base_records, gc_deleted=gc_deleted,
     )
 
 

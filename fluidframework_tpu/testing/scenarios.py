@@ -83,6 +83,7 @@ __all__ = [
     "run_reconnect_stampede",
     "run_scenario_suite",
     "run_tenant_mix",
+    "run_week_of_traffic",
     "scenario_p99s",
 ]
 
@@ -1008,6 +1009,481 @@ def run_tenant_mix(n_tenants: int = 8, records: int = 4000,
         else:
             os.environ["FLUID_TRACE_WIRE"] = prev_trace
         restore()
+        if work_dir is None:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# week-of-traffic churn (the retention plane's gate)
+# ---------------------------------------------------------------------------
+
+
+def run_week_of_traffic(cycles: int = 4, hot_writers: int = 12,
+                        cold_docs: int = 2, cold_clients: int = 2,
+                        ops_per_writer: int = 30,
+                        summary_ops: int = 64, rate_hz: float = 500.0,
+                        stampede_sessions: int = 16,
+                        swarm_sessions: int = 48,
+                        deli_impl: str = "scalar",
+                        retention: bool = True,
+                        keep_tail: int = 256,
+                        hwm_slack: float = 1.35,
+                        timeout_s: float = 300.0,
+                        work_dir: Optional[str] = None) -> dict:
+    """The MIXED week-of-traffic shape (ROADMAP 4 follow-up (c)):
+    storm + stampede + swarm CONCURRENTLY, compressed into `cycles`
+    generations of churning collaborators — and the retention plane's
+    churn gate (ROADMAP 3 / ISSUE 14 acceptance).
+
+    Each cycle, a FRESH band of writers joins (one viral hot doc takes
+    most of the load, a cold background mix the rest), streams
+    bounded merge-tree edits open-loop at `rate_hz`, and LEAVES — the
+    collab window closes, so summaries settle to state-sized blobs.
+    While the cycle streams, `swarm_sessions` subscribed read sessions
+    ride the broadcast push (every one must see every record of its
+    doc), and a `stampede_sessions`-strong reconnect burst hits the
+    summary catch-up path mid-run (one signature across the burst).
+
+    With `retention=True` the farm runs the SIXTH role
+    (`server.retention.RetentionRole`, columnar log, fused
+    durable+broadcast hop): deltas/rawdeltas/durable/broadcast all
+    truncate behind the summary epoch and unreferenced castore blobs
+    sweep. The gate:
+
+    - **bounded disk** — the on-disk high-water mark (op logs +
+      castore) stops growing after the first retention cycle:
+      ``max(usage[2:]) <= hwm_slack * usage[1]``;
+    - **bit-identity** — a LIVE client's accumulated stream, a COLD
+      boot from the newest summary + tail, and a LONG-OFFLINE
+      reconnector (last saw cycle 0; its op gap is partially
+      reclaimed, so it must REBOOT from the summary, not replay)
+      all converge to one `state_digest` per doc, with zero
+      duplicate/skipped seqs.
+
+    Returns the per-cycle usage table and ``retention_disk_mb`` (the
+    steady-state high-water mark, the bench_trend lower-is-better
+    ledger line)."""
+    if retention and cycles < 3:
+        raise ValueError(
+            "retention=True needs cycles >= 3: the bounded-disk gate "
+            "compares the high-water mark of cycles AFTER the first "
+            "retention cycle against cycle 1 — with fewer cycles "
+            "there is nothing to compare and the gate is vacuous"
+        )
+    from ..server.columnar_log import make_tail_reader, make_topic
+    from ..server.retention import disk_usage
+    from ..server.socket_service import FarmReadServer
+    from ..server.summarizer import (
+        SummaryIndex,
+        SummaryReplica,
+        open_summary_store,
+        read_catchup,
+    )
+    from ..server.supervisor import ServiceSupervisor, canonical_record
+
+    scratch = work_dir or tempfile.mkdtemp(
+        prefix="week-",
+        dir="/dev/shm" if os.path.isdir("/dev/shm") else None,
+    )
+    log_format = "columnar"
+    srv = None
+    sup = None
+    try:
+        sup = ServiceSupervisor(
+            scratch,
+            roles=("deli", "scriptorium", "broadcaster", "scribe",
+                   "summarizer"),
+            fused_hop=True, deli_impl=deli_impl,
+            log_format=log_format, summary_ops=summary_ops,
+            retention=retention, ttl_s=0.75, hb_interval_s=0.1,
+            # A loaded CI box stalls children past the default 2s
+            # staleness bar without any real fault; a spurious restart
+            # mid-cycle stalls retention and races the disk sample.
+            # Restart-on-crash still works — this only widens the
+            # wedged-child detector.
+            heartbeat_timeout_s=6.0,
+            retention_env={
+                "FLUID_RETENTION_INTERVAL": "0.25",
+                "FLUID_RETENTION_MIN_BYTES": "4096",
+                # Spare tail: live pushers/readers are structurally
+                # ahead of every cut (scaled with the workload — it
+                # must stay well under one cycle's record count or
+                # nothing ever qualifies).
+                "FLUID_RETENTION_KEEP_TAIL": str(int(keep_tail)),
+                "FLUID_RETENTION_GRACE": "1.0",
+                # Every growth surface, metadata included: the op
+                # logs, plus the manifest topic (superseded manifests
+                # beyond the keep depth) and the retention topic's own
+                # commit history (only the newest commit per topic is
+                # ever read again).
+                "FLUID_RETENTION_TOPICS":
+                    "deltas,rawdeltas,durable,broadcast,"
+                    "summaries,retention",
+            } if retention else None,
+        ).start()
+        raw = make_topic(
+            os.path.join(scratch, "topics", "rawdeltas.jsonl"),
+            log_format,
+        )
+        broadcast = make_topic(
+            os.path.join(scratch, "topics", "broadcast.jsonl"),
+            log_format,
+        )
+        retention_topic = make_topic(
+            os.path.join(scratch, "topics", "retention.jsonl"),
+            log_format,
+        ) if retention else None
+        docs = ["hotdoc"] + [f"cold{i}" for i in range(cold_docs)]
+        # The LIVE client: an incremental broadcast tail accumulated
+        # across the whole run (per-doc canonical records) — what any
+        # connected session would have seen.
+        bc_reader = make_tail_reader(broadcast, 0)
+        live: Dict[str, List[dict]] = {d: [] for d in docs}
+
+        def drain_live() -> None:
+            for _i, r in bc_reader.poll():
+                if isinstance(r, dict) and r.get("kind") == "op" \
+                        and r.get("doc") in live:
+                    live[r["doc"]].append(canonical_record(r))
+
+        # The SWARM: in-proc subscribed sessions on the farm's read
+        # front end (same doorbell-woken pusher real TCP rides).
+        srv = FarmReadServer(scratch, log_format=log_format)
+        srv.start()
+        swarm_counts = [0] * swarm_sessions
+        swarm_docs = [docs[i % len(docs)] for i in range(swarm_sessions)]
+
+        def swarm_session(i: int):
+            def fn(recs):
+                swarm_counts[i] += sum(
+                    1 for r in recs if r.get("kind") == "op"
+                )
+            return fn
+
+        for i in range(swarm_sessions):
+            srv.pusher.subscribe(swarm_docs[i], swarm_session(i))
+
+        # Feeder model: feed order == sequence order (one feeder, one
+        # raw topic), so refSeq can track the head exactly and the
+        # text length model is exact — bounded merge-tree docs whose
+        # canonical rows (and therefore blobs) stay O(state).
+        head = {d: 0 for d in docs}  # per-doc fed-record count == seq
+        text_len = {d: 0 for d in docs}
+
+        def reader_lag() -> int:
+            """How far the slowest UNTRACKED broadcast reader (the
+            live tail, the swarm's pusher) trails the fed head, in
+            records. Retention spares only `keep_tail` records behind
+            its scan head for these readers — no checkpoint tracks
+            them — and a reader lapped past a cut silently resumes at
+            the truncation base (records between are gone, failing
+            the convergence gates minutes later and doc-load-
+            dependent). Joins/leaves sequence as records too, so the
+            fed head is directly comparable to delivered counts."""
+            total = sum(head.values())
+            live_lag = total - sum(len(live[d]) for d in docs)
+            swarm_lag = max(
+                (head[swarm_docs[i]] - swarm_counts[i]
+                 for i in range(swarm_sessions)),
+                default=0,
+            )
+            return max(live_lag, swarm_lag)
+
+        def feed(recs: List[dict]) -> None:
+            # Backpressure (retention runs only): pace the feed so no
+            # untracked reader falls further behind than HALF the
+            # keep_tail spare — the cut can then never lap a live
+            # reader by construction, however asymmetrically a loaded
+            # host schedules the parent's reader threads against the
+            # retention child. Bounded wait: a wedged farm surfaces
+            # as the cycle-drain assertion, not a silent hang here.
+            if retention:
+                limit = time.time() + 30.0
+                while reader_lag() > keep_tail // 2 and \
+                        time.time() < limit:
+                    pump(0.002)
+            for r in recs:
+                head[r["doc"]] += 1
+            raw.append_many(recs)
+
+        def mt_op(doc: str, i: int) -> dict:
+            if text_len[doc] >= 120:
+                k = 60
+                text_len[doc] -= k
+                return {"type": 1, "pos1": 0, "pos2": k}
+            seg = f"w{i % 97:02d}"
+            text_len[doc] += len(seg)
+            return {"type": 0, "pos1": 0, "seg": seg}
+
+        def pump(dt: float = 0.0) -> None:
+            sup.poll_once()
+            drain_live()
+            if dt:
+                time.sleep(dt)
+
+        usage: List[int] = []
+        stampede_sigs: List[set] = []
+        reconnect_seen = 0  # the long-offline client's last seq (hot)
+        truncs_seen = 0
+        activity_seen = 0  # retention records-ever-appended high water
+        for cycle in range(cycles):
+            deadline = time.time() + timeout_s
+            base_id = 1000 * (cycle + 1)
+            hot = [base_id + w for w in range(hot_writers)]
+            colds = [(f"cold{d}", base_id + w)
+                     for d in range(cold_docs)
+                     for w in range(cold_clients)]
+            feed([{"kind": "join", "doc": "hotdoc", "client": c,
+                   "refSeq": head["hotdoc"]} for c in hot])
+            feed([{"kind": "join", "doc": d, "client": c,
+                   "refSeq": head[d]} for d, c in colds])
+            # Open-loop-paced edit stream: hot writers round-robin on
+            # the viral doc, cold writers on the background docs.
+            plan: List[tuple] = []
+            for i in range(ops_per_writer):
+                for w in hot:
+                    plan.append(("hotdoc", w, i + 1))
+                for d, c in colds:
+                    plan.append((d, c, i + 1))
+            t0 = time.perf_counter()
+            for j, (doc, client, cseq) in enumerate(plan):
+                tick = t0 + j / rate_hz
+                while time.perf_counter() < tick:
+                    pump(0.001)
+                feed([{"kind": "op", "doc": doc, "client": client,
+                       "clientSeq": cseq, "refSeq": head[doc],
+                       "contents": mt_op(doc, j)}])
+                if j % 16 == 0:
+                    pump()
+            # Churn: the whole generation LEAVES — the collab window
+            # closes behind it, summaries settle, blobs stay bounded.
+            feed([{"kind": "leave", "doc": "hotdoc", "client": c}
+                  for c in hot])
+            feed([{"kind": "leave", "doc": d, "client": c}
+                  for d, c in colds])
+            # Every record of the cycle must reach the live tail
+            # (joins/leaves sequence too, so the target is the head).
+            while time.time() < deadline:
+                pump(0.005)
+                if all(len(live[d]) >= head[d] for d in docs):
+                    break
+            else:
+                raise AssertionError(
+                    f"cycle {cycle} never drained: "
+                    f"{ {d: len(live[d]) for d in docs} } of "
+                    f"{ {d: head[d] for d in docs} }"
+                )
+            if cycle == 0:
+                # The long-offline reconnector saw exactly cycle 0.
+                reconnect_seen = max(
+                    int(r["seq"]) for r in live["hotdoc"]
+                )
+            # Mid-run reconnect STAMPEDE through the summary path
+            # (after cycle 1 a summary provably exists). Quiesce the
+            # summarizer first — a manifest landing MID-burst would
+            # legitimately split the signatures.
+            if cycle >= 1:
+                from ..server.queue import FencedCheckpointStore
+
+                ck = FencedCheckpointStore(
+                    os.path.join(scratch, "checkpoints")
+                )
+
+                def summ_offset() -> int:
+                    env = ck.load("summarizer")
+                    try:
+                        return int(((env or {}).get("state") or {})
+                                   .get("offset", 0))
+                    except (TypeError, ValueError):
+                        return 0
+
+                total = sum(head.values())
+                while summ_offset() < total and \
+                        time.time() < deadline:
+                    pump(0.02)
+                idx = SummaryIndex(scratch, log_format)
+                store = open_summary_store(scratch)
+                idx.poll()
+                last_man = idx.nearest("hotdoc")
+                stable_t = time.time()
+                while time.time() - stable_t < 0.8 and \
+                        time.time() < deadline:
+                    pump(0.05)
+                    idx.poll()
+                    cur = idx.nearest("hotdoc")
+                    if (cur or {}).get("handle") != \
+                            (last_man or {}).get("handle"):
+                        last_man, stable_t = cur, time.time()
+                sigs: List[Optional[str]] = [None] * stampede_sessions
+                errs: List[str] = []
+
+                def catchup_session(i: int) -> None:
+                    try:
+                        cu = read_catchup(scratch, "hotdoc", log_format,
+                                          index=idx, store=store)
+                        man = cu["manifest"]
+                        sigs[i] = json.dumps([
+                            man["seq"] if man else None,
+                            man["handle"] if man else None,
+                            len(cu["ops"]),
+                        ])
+                    except Exception as exc:  # gate failure, surfaced
+                        errs.append(repr(exc))
+
+                pool = [threading.Thread(target=catchup_session,
+                                         args=(i,), daemon=True)
+                        for i in range(stampede_sessions)]
+                for t in pool:
+                    t.start()
+                for t in pool:
+                    t.join(timeout=120)
+                assert not errs, f"stampede failed: {errs[:3]}"
+                assert all(s is not None for s in sigs)
+                stampede_sigs.append(set(sigs))
+                assert len(stampede_sigs[-1]) == 1, (
+                    f"stampede diverged in cycle {cycle}: "
+                    f"{stampede_sigs[-1]}"
+                )
+            # Let the retention plane SETTLE before sampling disk:
+            # wait for the truncate-commit stream to go quiet (~4
+            # retention intervals with nothing new — the reclaimable
+            # prefix is cut incrementally, so breaking on the first
+            # commit would race the rest), then one GC grace beat.
+            if retention:
+                # Progress target first: rawdeltas reclaims up to the
+                # deli's checkpoint (= the head), so its base reaching
+                # head - keep_tail (frame-granular slack) proves the
+                # plane worked through THIS cycle — a restarted child
+                # mid-cycle just makes the wait longer, not the sample
+                # wrong.
+                # (margin: keep_tail spare + frame granularity + the
+                # min-reclaim-bytes hysteresis, in records)
+                target = max(0, sum(head.values()) - 2 * keep_tail - 256)
+                wait_until = time.time() + 60.0
+                while time.time() < wait_until:
+                    pump(0.01)
+                    if raw.base_offsets()[0] >= target:
+                        break
+                # Then commit quiescence: the reclaimable prefix cuts
+                # incrementally, so sample only once the commit stream
+                # goes quiet. Activity is RECORDS EVER APPENDED
+                # (base + visible) — the retention topic prunes its
+                # own commit history, so a visible-commit count can
+                # DROP below a prior cycle's and freeze the fast
+                # break; records-ever-appended is monotone under
+                # self-pruning.
+                last_n = -1
+                stable_t = time.time()
+                wait_until = time.time() + 45.0
+                while time.time() < wait_until:
+                    pump(0.01)
+                    recs = retention_topic.read_from(0)
+                    n = retention_topic.base_offsets()[0] + len(recs)
+                    # Visible commits only bound the stat from below
+                    # after a self-prune; the newest commit per topic
+                    # always survives, so the gate stays nonzero.
+                    truncs_seen = max(truncs_seen, sum(
+                        1 for r in recs
+                        if isinstance(r, dict)
+                        and r.get("kind") == "truncate"
+                    ))
+                    if n != last_n:
+                        last_n, stable_t = n, time.time()
+                    elif time.time() - stable_t >= 1.0 and \
+                            n > activity_seen:
+                        break
+                    elif time.time() - stable_t >= 6.0:
+                        break  # nothing reclaimable this cycle
+                activity_seen = max(activity_seen, last_n)
+                time.sleep(1.2)  # one GC grace beat
+                pump()
+            usage.append(disk_usage(scratch)["total_bytes"])
+        # ------------------------------------------------ final gates
+        # Swarm completeness: every subscribed session saw every op of
+        # its doc (subscriptions predate the first record).
+        for i in range(swarm_sessions):
+            # Joins/leaves sequence as kind=="op" records too, so each
+            # session's complete view is its doc's HEAD count.
+            want = head[swarm_docs[i]]
+            got = swarm_counts[i]
+            lim = time.time() + 30.0
+            while got < want and time.time() < lim:
+                pump(0.01)
+                got = swarm_counts[i]
+            assert got == want, (
+                f"swarm session {i} ({swarm_docs[i]}): {got}/{want} "
+                f"records delivered"
+            )
+        # Sequence integrity + tri-view bit-identity per doc.
+        dups, skips = sequence_integrity(
+            [r for d in docs for r in live[d]]
+        )
+        assert dups == 0 and skips == 0, f"dups={dups} skips={skips}"
+        store = open_summary_store(scratch)
+        digests: Dict[str, str] = {}
+        for d in docs:
+            cu = read_catchup(scratch, d, log_format, store=store)
+            assert cu["manifest"] is not None, f"no summary for {d}"
+            boot = SummaryReplica(cu["blob"])
+            boot.apply_records(cu["ops"])
+            live_rep = SummaryReplica(None)
+            live_rep.apply_records(live[d])
+            assert boot.state_digest() == live_rep.state_digest(), (
+                f"cold-from-summary boot diverged from the live "
+                f"client on {d}"
+            )
+            digests[d] = boot.state_digest()
+        # The long-offline reconnector: its gap is (partially)
+        # reclaimed, so the farm MUST answer with a summary reboot —
+        # newest manifest past its last seen seq — not a gap replay.
+        recon = srv.catchup("hotdoc", from_seq=reconnect_seen)
+        assert recon["rebase"] and recon["blob"] is not None, (
+            "long-offline reconnect did not reboot from a summary"
+        )
+        assert recon["manifest"]["seq"] > reconnect_seen
+        rboot = SummaryReplica(recon["blob"])
+        rboot.apply_records(recon["ops"])
+        assert rboot.state_digest() == digests["hotdoc"], (
+            "reconnector diverged after summary reboot"
+        )
+        # Bounded disk: the high-water mark stops growing after the
+        # first retention cycle.
+        result: Dict[str, Any] = {
+            "scenario": "week_of_traffic",
+            "open_loop": True,
+            "cycles": cycles,
+            "records": sum(head.values()),
+            "hot_writers_per_cycle": hot_writers,
+            "swarm_sessions": swarm_sessions,
+            "stampede_sessions": stampede_sessions,
+            "retention": retention,
+            "disk_bytes_per_cycle": usage,
+            "truncations": truncs_seen,
+            "digest": hashlib.sha256(json.dumps(
+                sorted(digests.items())).encode()).hexdigest(),
+            "gate": ("disk hwm bounded after first retention cycle; "
+                     "live == cold-from-summary == reconnector "
+                     "bit-identical; swarm complete; zero dup/skip"),
+        }
+        if retention:
+            assert truncs_seen > 0, "retention never truncated"
+            hwm = max(usage[1:])
+            result["retention_disk_mb"] = round(hwm / 1e6, 3)
+            result["unit"] = "MB"
+            assert max(usage[2:]) <= \
+                hwm_slack * usage[1], (
+                    f"disk high-water mark kept growing after the "
+                    f"first retention cycle: {usage} "
+                    f"(slack {hwm_slack})"
+                )
+        else:
+            result["disk_mb_unbounded"] = round(max(usage) / 1e6, 3)
+        return result
+    finally:
+        if srv is not None:
+            srv.stop()
+        if sup is not None:
+            sup.stop()
         if work_dir is None:
             shutil.rmtree(scratch, ignore_errors=True)
 

@@ -561,16 +561,100 @@ def test_shard_worker_per_partition_summarizer(tmp_path):
         assert boot.state_digest() == cold.state_digest()
 
 
-def test_elastic_summarize_rejected():
-    from fluidframework_tpu.server.shard_fabric import (
-        ShardFabricSupervisor,
-        ShardWorker,
-    )
+def test_elastic_summarize_accepted(tmp_path):
+    """REGRESSION for the retained absorb path: `summarize=True` on
+    the ELASTIC fabric used to be a loud ValueError ("static-partition
+    only") — the elastic summarizer now absorbs predecessor ranges'
+    fold state, so the old rejection can no longer be raised and a
+    ranged summarizer role is actually constructed per owned range."""
+    from fluidframework_tpu.server.shard_fabric import ShardWorker
+    from fluidframework_tpu.server.summarizer import SummarizerRole
 
-    with pytest.raises(ValueError, match="static-partition only"):
-        ShardWorker("/tmp/x", "w0", elastic=True, summarize=True)
-    with pytest.raises(ValueError, match="static-partition only"):
-        ShardFabricSupervisor("/tmp/x", elastic=True, summarize=True)
+    w = ShardWorker(str(tmp_path), "w0", n_partitions=2, elastic=True,
+                    summarize=True, ttl_s=3600.0)
+    try:
+        w.sweep()
+        assert w.summ_roles, "elastic worker built no summarizer roles"
+        for rid, role in w.summ_roles.items():
+            assert isinstance(role, SummarizerRole)
+            assert role.rid == rid  # ranged identity, not partitioned
+            assert role.in_topic_name == f"deltas-{rid}"
+            assert role.out_topic_name == f"summaries-{rid}"
+    finally:
+        w.stop()
+
+
+def test_elastic_summarizer_absorbs_across_live_split(tmp_path):
+    """The absorb path itself: a live split mid-stream hands each
+    range's summarizer state to the successors (seed from the parent's
+    final fold checkpoint sliced by hash range, fence-bound pred
+    manifest topics, exactly-once manifest re-emission) — and every
+    doc's newest summary + tail boots bit-identical to a cold replay
+    of the merged stream."""
+    import time as _time
+
+    from fluidframework_tpu.server.shard_fabric import (
+        ShardRouter,
+        ShardWorker,
+        control_result,
+        request_topology_change,
+    )
+    from fluidframework_tpu.server.summarizer import SummaryIndex
+
+    d = str(tmp_path)
+    w = ShardWorker(d, "w0", n_partitions=1, elastic=True,
+                    summarize=True, ttl_s=5.0, summary_ops=8)
+    w.sweep()
+    router = ShardRouter(d, 1, elastic=True)
+    docs = [f"doc{i}" for i in range(4)]
+    recs = [{"kind": "join", "doc": doc, "client": 1} for doc in docs]
+    for i in range(40):
+        for doc in docs:
+            recs.append({"kind": "op", "doc": doc, "client": 1,
+                         "clientSeq": i + 1, "refSeq": 0,
+                         "contents": {"i": i}})
+    half = len(recs) // 2
+    try:
+        router.append(recs[:half])
+        for _ in range(8):
+            w.step()
+        rid = list(w.roles)[0]
+        cid = request_topology_change(d, {"op": "split", "rid": rid})
+        deadline = _time.time() + 30
+        while control_result(d, cid) is None and _time.time() < deadline:
+            w.step()
+            _time.sleep(0.02)
+        assert control_result(d, cid), "split never committed"
+        router.append(recs[half:])
+        for _ in range(40):
+            w.step()
+        idx = SummaryIndex(
+            d, topics=router.stage_topic_names("summaries")
+        )
+        idx.poll()
+        store = open_summary_store(d)
+        all_ops = [r for r in router.merged_reader("deltas").poll()
+                   if isinstance(r, dict) and r.get("kind") == "op"]
+        for doc in docs:
+            man = idx.nearest(doc)
+            assert man is not None, f"no manifest for {doc}"
+            blob = json.loads(store.get(man["handle"]).decode())
+            boot = SummaryReplica(blob)
+            boot.apply_records(sorted(
+                (r for r in all_ops
+                 if r["doc"] == doc and r["seq"] > man["seq"]),
+                key=lambda r: r["seq"],
+            ))
+            cold = SummaryReplica(None)
+            cold.apply_records(sorted(
+                (r for r in all_ops if r["doc"] == doc),
+                key=lambda r: r["seq"],
+            ))
+            assert boot.state_digest() == cold.state_digest(), (
+                f"elastic summary boot diverged for {doc}"
+            )
+    finally:
+        w.stop()
 
 
 # ---------------------------------------------------------------------------

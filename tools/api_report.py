@@ -47,6 +47,7 @@ PACKAGES = [
     "fluidframework_tpu.server.ingress",
     "fluidframework_tpu.server.monitor",
     "fluidframework_tpu.server.queue",
+    "fluidframework_tpu.server.retention",
     "fluidframework_tpu.server.riddler",
     "fluidframework_tpu.server.shard_fabric",
     "fluidframework_tpu.server.summarizer",

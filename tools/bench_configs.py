@@ -994,6 +994,79 @@ def config13_scenarios(min_cores: int = 4,
     return result
 
 
+def config14_retention(min_cycles: int = 3) -> dict:
+    """Retention-plane guard (ROADMAP item 3, ISSUE 14): the
+    week-of-traffic churn gate plus the kill-mid-truncate /
+    kill-mid-GC chaos gate — BOTH always run, on every host.
+
+    - **Churn** (`testing.scenarios.run_week_of_traffic`): cycles of
+      churning writers (storm-shaped hot doc + cold mix) stream
+      bounded merge-tree edits through the supervised columnar farm
+      (fused hop + summarizer + retention) while a swarm of
+      subscribed readers and a mid-run reconnect stampede ride along.
+      Gates: on-disk bytes (op logs + castore) hold a bounded
+      high-water mark after the first retention cycle, every swarm
+      session sees every record, and a live client, a cold boot from
+      the newest summary, and a long-offline reconnector (its op gap
+      physically reclaimed — it must REBOOT from the summary) all
+      converge bit-identical with zero dup/skip.
+    - **Chaos**: `--retention`-shaped run — the retention role in the
+      kill schedule AND the two seeded kill points firing (between
+      the fenced truncate commit and the physical reclaim, and
+      mid-GC-sweep); recovery must roll every committed cut forward,
+      converging bit-identical with zero dup/skip and summary
+      integrity intact.
+
+    The steady-state high-water mark feeds the bench_trend ledger as
+    the LOWER-is-better ``retention_disk_mb`` headline."""
+    from fluidframework_tpu.testing.chaos import ChaosConfig, run_chaos
+    from fluidframework_tpu.testing.scenarios import run_week_of_traffic
+
+    cycles = max(min_cycles, int(4 * SCALE))
+    churn = run_week_of_traffic(
+        cycles=cycles,
+        hot_writers=max(6, int(12 * SCALE)),
+        cold_docs=max(1, int(2 * SCALE)),
+        ops_per_writer=max(12, int(30 * SCALE)),
+        summary_ops=max(24, int(64 * SCALE)),
+        rate_hz=max(300.0, 500.0 * SCALE),
+        stampede_sessions=max(8, int(16 * SCALE)),
+        swarm_sessions=max(12, int(48 * SCALE)),
+        keep_tail=max(48, int(256 * SCALE)),
+        timeout_s=300.0,
+    )
+    chaos = run_chaos(ChaosConfig(
+        seed=14, faults=("kill",), n_docs=2, n_clients=3,
+        ops_per_client=40, timeout_s=300.0, deli_impl="scalar",
+        log_format="columnar", summarizer=True, summary_ops=16,
+        retention=True,
+    ))
+    assert chaos.converged, (
+        f"retention chaos run diverged: {chaos.detail}"
+    )
+    assert chaos.retention_ok and chaos.truncations > 0, (
+        f"retention integrity failed: truncations={chaos.truncations}"
+    )
+    assert chaos.summaries_ok
+    assert chaos.duplicate_seqs == 0 and chaos.skipped_seqs == 0
+    return {
+        "config": "retention_churn_guard",
+        "cycles": churn["cycles"],
+        "records": churn["records"],
+        "retention_disk_mb": churn["retention_disk_mb"],
+        "unit": "MB",
+        "disk_bytes_per_cycle": churn["disk_bytes_per_cycle"],
+        "churn_truncations": churn["truncations"],
+        "chaos_retention_converged": True,
+        "chaos_truncations": chaos.truncations,
+        "chaos_gc_deleted": chaos.gc_deleted,
+        "chaos_retention_base": chaos.retention_base_records,
+        "gate": ("disk hwm bounded + tri-view bit-identity on every "
+                 "host; kill-mid-truncate/GC rolls forward with zero "
+                 "dup/skip"),
+    }
+
+
 def config_streaming_ingress(n_ops: int = 100_000,
                              n_segments: int = 8) -> dict:
     """Ingest-in-the-loop vs pre-staged replay (SURVEY §2.6 row 4
@@ -1076,7 +1149,8 @@ def main() -> None:
                config6_shard_scaling, config7_multichip,
                config8_rebalance, config9_latency, config10_catchup,
                config11_fused_hop, config12_front_door,
-               config13_scenarios, config_streaming_ingress):
+               config13_scenarios, config14_retention,
+               config_streaming_ingress):
         r = fn()
         # Side metrics a config wants in the trend ledger as their own
         # lines (e.g. config9's fused-hop latency delta) ride out via

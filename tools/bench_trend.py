@@ -57,12 +57,13 @@ HEADLINE_FIELDS = (
     #                             jitter-bound ratio is never gated)
 )
 
-# LOWER-is-better headlines (latency milliseconds): regression means
-# rising ABOVE the best (lowest) prior run by more than the tolerance.
-# Scenario benches report their tail as `scenario_p99_ms`
-# (testing/scenarios.py), so a >20% p99 regression fails as loudly as
-# a throughput drop does.
-LOW_HEADLINE_FIELDS = ("scenario_p99_ms",)
+# LOWER-is-better headlines: regression means rising ABOVE the best
+# (lowest) prior run by more than the tolerance. Scenario benches
+# report their tail as `scenario_p99_ms` (testing/scenarios.py); the
+# retention churn gate reports its steady-state on-disk high-water
+# mark as `retention_disk_mb` (config14_retention) — a farm whose
+# disk footprint regresses >20% fails as loudly as a latency drop.
+LOW_HEADLINE_FIELDS = ("scenario_p99_ms", "retention_disk_mb")
 
 
 def headline(result: dict) -> Optional[Tuple[str, float]]:

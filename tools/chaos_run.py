@@ -6,7 +6,7 @@ Run: python tools/chaos_run.py --seed N
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH] [--trace-wire]
         [--partitions N] [--workers W] [--devices N] [--elastic]
-        [--summarizer] [--summary-ops N] [--fused-hop]
+        [--summarizer] [--summary-ops N] [--retention] [--fused-hop]
         [--ingress [--bad-submits N] [--ingress-rate R]
          [--ingress-backlog B]] [--autoscale]
         [--downstream fused|split] [--scenario hotdoc]
@@ -57,6 +57,16 @@ restarts re-emit byte-identical content-addressed summaries — and the
 newest summary + op tail booting bit-identical to a cold full-log
 replay. Classic single-partition farm only (`--summary-ops` sets the
 cadence).
+
+`--retention` (implies `--summarizer` and the columnar log format)
+runs the retention plane (`server.retention.RetentionRole`) as a
+SIXTH supervised role: summary-driven fenced TRUNCATE of the
+deltas/rawdeltas op logs plus mark-and-sweep castore GC. The role
+joins the kill schedule AND two SEEDED kill points fire mid-run —
+between the fenced truncate commit record and the physical reclaim,
+and mid-GC-sweep — so the verdict proves recovery ROLLS each
+committed cut forward with zero dup/skip while summary + tail still
+boots bit-identical to a cold replay off the untruncated durable leg.
 
 `--trace-wire` stamps per-stage wall-clock timestamps onto the farm's
 wire records (side "tr" key — digests compare canonical records, so
@@ -174,6 +184,14 @@ def main() -> int:
     summarizer = "--summarizer" in args
     if summarizer:
         args.remove("--summarizer")
+    retention = "--retention" in args
+    if retention:
+        # The retention plane rides the summary service and the
+        # columnar log by construction: --retention implies both
+        # (an explicit --log-format json still errors loudly in
+        # ChaosConfig validation).
+        args.remove("--retention")
+        summarizer = True
     fused_hop = "--fused-hop" in args
     if fused_hop:
         args.remove("--fused-hop")
@@ -208,8 +226,10 @@ def main() -> int:
         timeout_s=float(_take("--timeout", "120")),
         shared_dir=_take("--keep", None),
         deli_impl=_take("--deli", "scalar"),
-        log_format=_take("--log-format", "json"),
+        log_format=_take("--log-format",
+                         "columnar" if retention else "json"),
         boxcar_rate=float(_take("--boxcar-rate", "0")),
+        retention=retention,
         n_partitions=n_partitions,
         n_workers=int(_take("--workers", "2")),
         deli_devices=(lambda v: int(v) if v else None)(
@@ -270,6 +290,13 @@ def main() -> int:
         print(f"summaries     : {res.summary_manifests} manifests, "
               f"integrity {'OK' if res.summaries_ok else 'VIOLATED'} "
               f"(no fork/dup; summary+tail == cold replay)")
+    if retention:
+        print(f"retention     : {res.truncations} truncation(s) "
+              f"committed, deltas base {res.retention_base_records}, "
+              f"gc deleted {res.gc_deleted}, integrity "
+              f"{'OK' if res.retention_ok else 'VIOLATED'} "
+              f"(commit-then-reclaim rolled forward; kill points "
+              f"fired)")
     if ingress:
         print(f"front door    : nacks={res.ingress_nacks} "
               f"bad-never-sequenced="
