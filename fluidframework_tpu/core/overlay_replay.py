@@ -70,6 +70,8 @@ def reconstruct_settled(
     log: np.ndarray,
     counts: List[int],
     n_prop_keys: int,
+    initial_props: Optional[np.ndarray] = None,
+    initial_attr: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Replay the fold log into the final settled (text, props, attr).
 
@@ -78,11 +80,25 @@ def reconstruct_settled(
     `overlay_ref.OverlayDoc.fold` performs in-place; here it runs once
     per epoch over the logged rows instead (same codes, same
     PROP_DELETE tombstone semantics; `attr` carries each settled
-    position's insert-attribution key, record column 4)."""
+    position's insert-attribution key, record column 4).
+
+    `initial_props`/`initial_attr` seed the settled props/attr arrays
+    (defaults: all-absent / zero) — the INCREMENTAL form
+    `core.overlay_fold.OverlayFoldReplica` applies per emission round,
+    where the initial settled state carries real props from earlier
+    rounds instead of a fresh load."""
     KK = n_prop_keys
     settled_t = np.asarray(initial_text, np.int32)
-    settled_p = np.full((len(settled_t), KK), PROP_ABSENT, np.int32)
-    settled_a = np.zeros(len(settled_t), np.int32)
+    settled_p = (
+        np.asarray(initial_props, np.int32).copy()
+        if initial_props is not None
+        else np.full((len(settled_t), KK), PROP_ABSENT, np.int32)
+    )
+    settled_a = (
+        np.asarray(initial_attr, np.int32).copy()
+        if initial_attr is not None
+        else np.zeros(len(settled_t), np.int32)
+    )
     off = 0
     for cnt in counts:
         recs = log[off: off + cnt]

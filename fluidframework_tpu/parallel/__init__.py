@@ -9,6 +9,13 @@ devices; cross-document reductions (fleet MSN, error flags) ride ICI
 collectives inserted by XLA.
 """
 
+from .device_plane import (
+    DevicePlane,
+    parse_plane_spec,
+    plane_column_of,
+    resolve_plane,
+    shared_plane,
+)
 from .mesh import (
     docs_sharding,
     make_docs_mesh,
@@ -23,6 +30,11 @@ from .seqshard import run_sequence_sharded, sequence_sharded_replay
 from .seqshard_ref import SeqShardedOverlay
 
 __all__ = [
+    "DevicePlane",
+    "parse_plane_spec",
+    "plane_column_of",
+    "resolve_plane",
+    "shared_plane",
     "make_docs_mesh",
     "shared_docs_mesh",
     "docs_sharding",

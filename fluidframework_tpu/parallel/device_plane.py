@@ -1,0 +1,224 @@
+"""Device-placement plane: ONE 2-D ``docs × model`` mesh serving the
+sequencer AND the summarizer folds (ROADMAP item 5).
+
+Until this module the two device tenants scheduled blindly against
+each other: PR 6's sequencer shards its ``[D, C]`` doc-slot pool over
+a private 1-D docs mesh, while the summarizer's merge-tree folds
+(PR 10/14) dispatch onto whatever the default device is. `DevicePlane`
+owns one process-wide 2-D `jax.sharding.Mesh` over ``('docs',
+'model')`` and hands each tenant a TYPED slice of it:
+
+- **sequencer** — `seq_mesh(column)` returns a 1-D ``docs`` mesh over
+  one *model column* of the device grid; every per-doc array keeps its
+  `PartitionSpec('docs')` layout (`ops.sequencer_kernel
+  .sharded_sequence_fn` unchanged), and the fabric's placement rule is
+  one partition = one worker = one mesh slice: worker *k* orders its
+  documents on column ``k % model`` while the folds span the plane, so
+  ordering tenants tile the pool instead of contending for all of it.
+- **summarizer folds** — `fold_sharding()` lays the stacked per-doc
+  fold inputs over the WHOLE plane: the stacked doc axis tiles
+  ``('docs', 'model')`` (the overlay-pallas fold backend,
+  `core.overlay_fold` — one replica per plane cell), and the vmapped
+  merge-tree fold shards its row/segment axis on ``'model'`` with
+  `PartitionSpec('docs', 'model')` (`table_sharding`) — both tenants
+  on one chip pool, no host round-trips between ordering and
+  summarization.
+
+On CPU hosts the plane lands on XLA's forced virtual host devices
+exactly like `parallel.mesh` (the supervisor seams force
+``docs*model`` devices into children); the code is identical on a
+real TPU slice. Specs are strings — ``"2x2"`` = 2 docs × 2 model —
+so they ride argv/env (`PLANE_ENV`) into farm children.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+__all__ = [
+    "PLANE_ENV",
+    "DevicePlane",
+    "parse_plane_spec",
+    "plane_column_of",
+    "resolve_plane",
+    "shared_plane",
+]
+
+# Process-wide plane spec (the supervisor child_env seam): "DxM".
+PLANE_ENV = "FLUID_DEVICE_PLANE"
+
+
+def parse_plane_spec(spec: Union[str, Tuple[int, int]]) -> Tuple[int, int]:
+    """``"2x2"`` / ``(2, 2)`` → (docs, model). Loud on nonsense — a
+    mis-parsed plane must not silently fall back to one device."""
+    if isinstance(spec, tuple):
+        d, m = spec
+    else:
+        parts = str(spec).lower().replace("*", "x").split("x")
+        if len(parts) != 2:
+            raise ValueError(
+                f"device-plane spec {spec!r} is not 'DOCSxMODEL' "
+                f"(e.g. '2x2', '4x2')"
+            )
+        d, m = parts
+    d, m = int(d), int(m)
+    if d < 1 or m < 1:
+        raise ValueError(f"device-plane axes must be >= 1: {spec!r}")
+    return d, m
+
+
+class DevicePlane:
+    """One 2-D ``('docs', 'model')`` mesh + its typed slices.
+
+    Construction initializes jax (device discovery) — build planes
+    through `shared_plane`/`resolve_plane` so every pool, role and
+    bench in a process shares ONE plane object and therefore one jit
+    cache per compiled fn (the `parallel.mesh.shared_docs_mesh`
+    discipline, two axes now)."""
+
+    def __init__(self, docs: int, model: int, devices=None):
+        import numpy as np
+        import jax
+
+        self.docs = int(docs)
+        self.model = int(model)
+        n = self.docs * self.model
+        devs = list(jax.devices()) if devices is None else list(devices)
+        if len(devs) < n:
+            # Validating an NxM plane on a host with fewer accelerator
+            # devices: fall back to the CPU backend's forced virtual
+            # host devices, exactly like parallel.mesh.make_docs_mesh.
+            try:
+                cpu = jax.devices("cpu")
+            except RuntimeError:
+                cpu = []
+            if n <= len(cpu):
+                devs = list(cpu)
+            else:
+                raise ValueError(
+                    f"device plane {self.docs}x{self.model} needs {n} "
+                    f"devices; {len(devs)} "
+                    f"{devs[0].platform if devs else ''} and "
+                    f"{len(cpu)} cpu present"
+                )
+        from jax.sharding import Mesh
+
+        grid = np.asarray(devs[:n]).reshape(self.docs, self.model)
+        self.mesh = Mesh(grid, ("docs", "model"))
+        self._grid = grid
+        self._seq_meshes: dict = {}
+
+    # ------------------------------------------------------------- slices
+
+    @property
+    def size(self) -> int:
+        return self.docs * self.model
+
+    def seq_mesh(self, column: int = 0):
+        """The sequencer's typed slice: a 1-D ``docs`` mesh over model
+        column ``column % model`` of the plane — `deli_kernel.SeqPool`
+        consumes it unchanged (PartitionSpec('docs') on every per-doc
+        array). Cached per column so every pool on a column shares one
+        compiled `sharded_sequence_fn`."""
+        from jax.sharding import Mesh
+
+        col = int(column) % self.model
+        mesh = self._seq_meshes.get(col)
+        if mesh is None:
+            mesh = self._seq_meshes[col] = Mesh(
+                self._grid[:, col], ("docs",)
+            )
+        return mesh
+
+    def fold_spec(self):
+        """PartitionSpec for a stacked fold's leading doc axis: the
+        stack tiles the WHOLE plane (docs-major), so K stacked docs
+        spread over every device of the pool."""
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(("docs", "model"))
+
+    def fold_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.fold_spec())
+
+    def table_sharding(self, extra_dims: int = 0):
+        """NamedSharding for stacked ``[K, rows, ...]`` fold tables:
+        doc axis on ``docs``, the row/segment axis on ``model``
+        (the vmapped merge-tree fold's layout — XLA partitions the
+        row-axis gathers with model-axis collectives)."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(
+            self.mesh,
+            PartitionSpec("docs", "model", *([None] * extra_dims)),
+        )
+
+    def doc_sharding(self):
+        """NamedSharding for stacked per-doc 1-D values ([K])."""
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.fold_spec())
+
+    # ------------------------------------------------------------ surface
+
+    def spec(self) -> str:
+        return f"{self.docs}x{self.model}"
+
+    def describe(self) -> dict:
+        devs = self._grid.reshape(-1)
+        return {
+            "docs": self.docs,
+            "model": self.model,
+            "devices": int(self.size),
+            "platform": devs[0].platform if len(devs) else "none",
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"DevicePlane({self.spec()!r})"
+
+
+_PLANE_CACHE: dict = {}
+
+
+def shared_plane(docs: int, model: int) -> DevicePlane:
+    """The process-wide cached plane for (docs, model) — every caller
+    shares ONE mesh object so jit caches keyed on the mesh hit across
+    pools/roles/benches instead of re-tracing per instance."""
+    key = (int(docs), int(model))
+    plane = _PLANE_CACHE.get(key)
+    if plane is None:
+        plane = _PLANE_CACHE[key] = DevicePlane(*key)
+    return plane
+
+
+def resolve_plane(
+    plane: Union[None, str, Tuple[int, int], DevicePlane],
+    env: bool = False,
+) -> Optional[DevicePlane]:
+    """The seam resolver every ``device_plane=`` parameter funnels
+    through: DevicePlane passes through, specs resolve via the shared
+    cache, None consults `PLANE_ENV` when ``env=True`` (farm children
+    inherit the supervisor's plane without per-role argv plumbing)."""
+    if plane is None and env:
+        import os
+
+        plane = os.environ.get(PLANE_ENV) or None
+    if plane is None:
+        return None
+    if isinstance(plane, DevicePlane):
+        return plane
+    return shared_plane(*parse_plane_spec(plane))
+
+
+def plane_column_of(key, model: int) -> int:
+    """Deterministic model-column assignment for a partition/worker
+    key: ints map round-robin, strings hash (crc32, the fabric's
+    stable doc-hash discipline) — one partition = one worker = one
+    mesh slice, stable across restarts."""
+    if isinstance(key, int):
+        return key % max(1, model)
+    import zlib
+
+    return zlib.crc32(str(key).encode()) % max(1, model)

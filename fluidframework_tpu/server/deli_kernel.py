@@ -119,6 +119,26 @@ def mesh_for_devices(deli_devices: Optional[int]):
     return shared_docs_mesh(int(deli_devices))
 
 
+def mesh_for_plane(device_plane, plane_column: Optional[int] = None,
+                   partition_key=None, env: bool = False):
+    """The sequencer's TYPED SLICE of a 2-D device plane
+    (`parallel.device_plane.DevicePlane`): a 1-D docs mesh over one
+    model column — one partition = one worker = one mesh slice. The
+    column is explicit (`plane_column`), derived from the partition
+    key (stable hash), or 0; `env=True` lets farm children inherit
+    the supervisor's plane from ``FLUID_DEVICE_PLANE`` with no argv
+    plumbing. Returns None when no plane is configured."""
+    from ..parallel.device_plane import plane_column_of, resolve_plane
+
+    plane = resolve_plane(device_plane, env=env)
+    if plane is None:
+        return None
+    if plane_column is None:
+        plane_column = (plane_column_of(partition_key, plane.model)
+                        if partition_key is not None else 0)
+    return plane.seq_mesh(plane_column)
+
+
 def _nack_reason(code: int, ref: int, msn: int, head: int, cseq: int,
                  expected: Optional[int]) -> str:
     """The scalar sequencer's nack wording (shared helpers in
@@ -165,6 +185,14 @@ class SeqPool:
         self.n_clients = _pow2(max(2, n_clients), lo=2)
         self.state = _sk.make_state(self.n_docs, self.n_clients)
         self._placed = False  # host-side state edits re-place lazily
+        # Logical slot -> physical state row. Identity until a PLACED
+        # grow: doubling a sharded pool in place keeps every existing
+        # row on its shard (each device pads its own slab locally — no
+        # host round-trip, no cross-device traffic), which renumbers
+        # the row space per shard; the mirror/free-list keep stable
+        # LOGICAL slots and this map translates at the kernel
+        # boundary (pack + row scatter).
+        self._phys = np.arange(self.n_docs, dtype=np.int64)
         self.max_resident = max_resident
         # doc_id -> {"slot": int|None, "seq", "min_seq",
         #            "clients": {cid: [ref_seq, client_seq]}, "t": lru}
@@ -351,6 +379,66 @@ class SeqPool:
             lambda a: jax.device_put(a, sh), state
         )
 
+    def _grow_placed(self, old_d: int, old_c: int, new_c: int) -> bool:
+        """Grow an ALREADY-PLACED sharded pool in place (the deferred
+        GROW scatter — PR 6 follow-up closed): each device pads ITS
+        OWN slab with fresh empty rows/columns (`jnp.pad` on the
+        shard's committed buffer runs device-local), and the assembled
+        array reuses those buffers — no host round-trip, no
+        cross-device transfer, no full-pool re-place. The row space
+        renumbers per shard (shard s owns rows [s*r1, (s+1)*r1) after
+        the grow), so `_phys` remaps every logical slot to its new
+        physical row ON ITS OLD SHARD — untouched rows never move.
+        Returns False when the layout can't do it (not placed, shards
+        not addressable) and the caller falls back to the classic
+        grow_state + full re-place."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        S = self._n_shards
+        new_d = self.n_docs
+        r0, r1 = old_d // S, new_d // S
+        sh = NamedSharding(self.mesh, PartitionSpec("docs"))
+        new_fields = {}
+        for name in self.state._fields:
+            arr = getattr(self.state, name)
+            try:
+                shards = list(arr.addressable_shards)
+            except AttributeError:
+                return False  # host array: not actually placed
+            if len(shards) != S:
+                return False
+            parts: List[Any] = [None] * S
+            for s in shards:
+                row0 = (s.index[0].start or 0) if s.index else 0
+                widths = [(0, r1 - r0)]
+                if arr.ndim > 1:
+                    widths.append((0, new_c - old_c))
+                parts[row0 // r0] = jnp.pad(s.data, widths)
+            if any(p is None for p in parts):
+                return False
+            new_fields[name] = jax.make_array_from_single_device_arrays(
+                (new_d,) + arr.shape[1:] if arr.ndim == 1
+                else (new_d, new_c) + arr.shape[2:], sh, parts
+            )
+        # Remap: logical slot l at old physical row p (shard p//r0,
+        # local p%r0) keeps its shard at row (p//r0)*r1 + p%r0; the
+        # NEW logical ids [old_d, new_d) fill each shard's fresh
+        # locals [r0, r1).
+        phys = self._phys[:old_d]
+        new_phys = np.empty(new_d, np.int64)
+        new_phys[:old_d] = (phys // r0) * r1 + (phys % r0)
+        grow_per = r1 - r0
+        for s in range(S):
+            base_l = old_d + s * grow_per
+            new_phys[base_l: base_l + grow_per] = np.arange(
+                s * r1 + r0, s * r1 + r1
+            )
+        self._phys = new_phys
+        self.state = _sk.SequencerState(**new_fields)
+        return True
+
     def _scatter_rows_placed(self, idx, updates) -> bool:
         """Scoped re-place (PR-6 follow-up (b)): scatter the loaded
         rows into an ALREADY-PLACED pool per shard, rebuilding only
@@ -408,16 +496,29 @@ class SeqPool:
         need_c = _pow2(self._need_clients, self.n_clients)
         d, c = self.state.connected.shape
         if self.n_docs != d or need_c != c:
-            self.state = _sk.grow_state(self.state, self.n_docs, need_c)
+            if not (self.mesh is not None and self._placed
+                    and self._grow_placed(d, c, need_c)):
+                # Classic path (scalar, or first placement still
+                # pending): zero-pad on the host and re-place below;
+                # the appended rows are the new physical tail, so the
+                # logical map extends as identity.
+                self.state = _sk.grow_state(self.state, self.n_docs,
+                                            need_c)
+                self._placed = False
+                if len(self._phys) < self.n_docs:
+                    self._phys = np.concatenate([
+                        self._phys,
+                        np.arange(len(self._phys), self.n_docs,
+                                  dtype=np.int64),
+                    ])
             self.n_clients = need_c
-            self._placed = False
         if not self._loads:
             if self.mesh is not None and not self._placed:
                 self.state = self._place(self.state)
                 self._placed = True
             return
         n, C = len(self._loads), self.n_clients
-        idx = np.empty(n, np.int32)
+        idx = np.empty(n, np.int64)
         seqv = np.empty(n, np.int32)
         minv = np.empty(n, np.int32)
         conn = np.zeros((n, C), bool)
@@ -434,6 +535,7 @@ class SeqPool:
                 ref[i, col] = r
                 cseq[i, col] = cs
         self._loads = []
+        idx = self._phys[idx]  # logical slots -> physical state rows
         updates = {"seq": seqv, "min_seq": minv, "connected": conn,
                    "ref_seq": ref, "client_seq": cseq}
         if (self.mesh is not None and self._placed
@@ -705,7 +807,11 @@ class PackedDeliCore:
         # tracker threads across them.
         for sel, sl, ic, kind, client, cseq, ref, grp in \
                 _sk.pack_submissions(
-                    cols6[:, 0], cols6[:, 1], cols6[:, 2], cols6[:, 3],
+                    # Logical doc slots -> physical state rows (the
+                    # placed-grow renumbering seam; identity on
+                    # scalar / never-grown pools).
+                    pool._phys[cols6[:, 0]],
+                    cols6[:, 1], cols6[:, 2], cols6[:, 3],
                     cols6[:, 4], cols6[:, 5], pool.n_docs, self.max_cols,
                 ):
             res, aborted = pool.run_chunk(
@@ -752,16 +858,29 @@ class KernelDeliLambda:
                  max_pump: int = 8192, n_docs: int = 8, n_clients: int = 8,
                  max_resident: Optional[int] = None, max_cols: int = 256,
                  raw_topic: str = "rawdeltas",
-                 deli_devices: Optional[int] = None):
+                 deli_devices: Optional[int] = None,
+                 device_plane=None, plane_column: Optional[int] = None):
         """`raw_topic` names the ingress topic (the sharded
         LocalServer's per-partition ``rawdeltas-p{k}`` form).
         `deli_devices=N` shards the doc-slot pool across an N-device
-        mesh (`LocalServer(deli_devices=N)` passes it through); the
-        checkpoint shape is topology-free, so restores interop across
-        scalar ⇄ single-device ⇄ sharded freely."""
+        mesh (`LocalServer(deli_devices=N)` passes it through);
+        `device_plane` instead takes the sequencer's 1-D slice of the
+        shared 2-D plane (`parallel.device_plane`, model column
+        `plane_column`). Either way the checkpoint shape is
+        topology-free, so restores interop across scalar ⇄
+        single-device ⇄ sharded ⇄ plane-sliced freely."""
+        if device_plane is not None and deli_devices is not None \
+                and int(deli_devices) > 1:
+            raise ValueError(
+                "deli_devices and device_plane are exclusive: the "
+                "plane's seq_mesh IS the deli's device slice"
+            )
+        mesh = mesh_for_devices(deli_devices)
+        if mesh is None:
+            mesh = mesh_for_plane(device_plane, plane_column)
         self.core = PackedDeliCore(
             n_docs, n_clients, max_resident, max_cols, dedup=False,
-            mesh=mesh_for_devices(deli_devices),
+            mesh=mesh,
         )
         offset = 0
         if checkpoint:
@@ -1028,6 +1147,7 @@ class KernelDeliRole(_Role):
     ingest_batches = True  # _Role.step feeds RecordBatch frames whole
 
     def __init__(self, *a, mesh=None, deli_devices: Optional[int] = None,
+                 device_plane=None, plane_column: Optional[int] = None,
                  **kw):
         """`mesh` (a ready 1-D docs mesh) or `deli_devices=N` (resolved
         via the process-wide shared mesh) shards the pool across
@@ -1035,10 +1155,27 @@ class KernelDeliRole(_Role):
         checkpoint format are identical either way, so the fenced
         exactly-once machinery and the shard fabric compose unchanged
         — a fabric partition worker may run each partition's deli over
-        its own device slice."""
+        its own device slice. `device_plane`/`plane_column` instead
+        take the sequencer's 1-D slice of the shared 2-D plane
+        (`parallel.device_plane`; the column defaults to a stable
+        hash of the partition key — one partition = one mesh slice),
+        falling back to the ``FLUID_DEVICE_PLANE`` env so supervised
+        children inherit the farm plane."""
+        if device_plane is not None and deli_devices is not None \
+                and int(deli_devices) > 1:
+            raise ValueError(
+                "deli_devices and device_plane are exclusive: the "
+                "plane's seq_mesh IS the deli's device slice"
+            )
         super().__init__(*a, **kw)
         self.mesh = mesh if mesh is not None else \
             mesh_for_devices(deli_devices)
+        if self.mesh is None and (deli_devices is None
+                                  or int(deli_devices) <= 1):
+            self.mesh = mesh_for_plane(
+                device_plane, plane_column,
+                partition_key=self.partition, env=True,
+            )
         self.core = PackedDeliCore(dedup=True, mesh=self.mesh)
         self._pending: List[tuple] = []  # ("rec", off, dict) |
         #                                 ("cols", start_off, RecordBatch)

@@ -119,6 +119,11 @@ PRODUCERS = {
     "durable": ("scriptorium", "scriptorium_broadcaster"),
     "broadcast": ("broadcaster", "scriptorium_broadcaster"),
     "summaries": ("summarizer",),
+    # The front door's nack leg: records with ``inOff`` at/past the
+    # ingress role's checkpointed input offset stay — its exactly-once
+    # recovery scans nacks for the durable-decision prefix, and
+    # reclaiming it would re-nack (duplicate) the gap.
+    "nacks": ("ingress",),
 }
 
 # A pin whose FILE has not been rewritten for this long is ignored:
@@ -415,6 +420,13 @@ class RetentionRole(_Role):
                 [self._suffixed("deli")]
         elif base == "deltas":
             keys = [self._suffixed(c) for c in self.consumers]
+        elif base == "ingress":
+            # The admission front door is the `ingress` topic's ONE
+            # supervised consumer: records at/past its checkpointed
+            # input offset are still un-admitted. No presence
+            # fallback — a farm managing this topic without the role
+            # reads a missing checkpoint as 0 and blocks, never loses.
+            keys = [self._suffixed("ingress")]
         else:
             return None
         if not keys:

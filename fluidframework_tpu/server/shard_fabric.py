@@ -1030,7 +1030,9 @@ class ShardWorker:
                  deli_devices: Optional[int] = None,
                  elastic: bool = False, summarize: bool = False,
                  summary_ops: Optional[int] = None,
-                 downstream: Optional[str] = None):
+                 downstream: Optional[str] = None,
+                 device_plane: Optional[str] = None,
+                 plane_column: Optional[int] = None):
         """`elastic=True` swaps fixed modulo-N partitions for the
         hash-range topology (`queue.RangeLeaseStore`): the worker
         sweeps RANGE leases toward its fair share of the LIVE range
@@ -1104,6 +1106,24 @@ class ShardWorker:
                 f"deli_devices={self.deli_devices} needs "
                 f"deli_impl='kernel'; got {self.deli_impl!r}"
             )
+        # 2-D device plane: one partition = one worker = one mesh
+        # slice — this worker's delis order documents on model column
+        # `plane_column` (default: a stable hash of the worker slot)
+        # while its summarizers' folds span the whole plane.
+        self.device_plane = device_plane
+        if device_plane is not None:
+            if self.deli_impl != "kernel":
+                raise ValueError(
+                    f"device_plane={device_plane!r} needs "
+                    f"deli_impl='kernel'; got {self.deli_impl!r}"
+                )
+            if self.deli_devices is not None and self.deli_devices > 1:
+                raise ValueError(
+                    "deli_devices and device_plane are exclusive on "
+                    "a worker: the plane's docs axis IS the deli's "
+                    "device slice"
+                )
+        self.plane_column = plane_column
         self.log_format = default_log_format(log_format)
         self.ttl_s = ttl_s
         self.batch = batch
@@ -1261,6 +1281,21 @@ class ShardWorker:
         kw = {}
         if self.deli_devices is not None and self.deli_devices > 1:
             kw["deli_devices"] = self.deli_devices
+        if self.device_plane is not None:
+            # One worker = one mesh slice: every deli this worker
+            # runs orders on the SAME model column of the plane
+            # (explicit column, or a stable hash of the worker slot).
+            from ..parallel.device_plane import plane_column_of, \
+                resolve_plane
+
+            kw["device_plane"] = self.device_plane
+            kw["plane_column"] = (
+                self.plane_column if self.plane_column is not None
+                else plane_column_of(
+                    self.slot,
+                    resolve_plane(self.device_plane).model,
+                )
+            )
         role = cls(
             self.shared_dir, self.owner, ttl_s=self.ttl_s,
             batch=self.batch, ckpt_interval_s=self.ckpt_interval_s,
@@ -1291,6 +1326,10 @@ class ShardWorker:
         kw = {}
         if self.summary_ops is not None:
             kw["summary_ops"] = self.summary_ops
+        if self.device_plane is not None:
+            # Summarizer folds span the WHOLE plane (the sequencers
+            # tile it column-wise) — both tenants, one chip pool.
+            kw["device_plane"] = self.device_plane
         role = cls(
             self.shared_dir, self.owner, ttl_s=self.ttl_s,
             batch=self.batch, ckpt_interval_s=self.ckpt_interval_s,
@@ -2046,6 +2085,15 @@ class ShardFabricSupervisor(ServiceSupervisor):
             cmd += ["--worker-ttl", str(self.worker_ttl_s)]
         if self.deli_devices is not None:
             cmd += ["--deli-devices", str(self.deli_devices)]
+        if self.device_plane is not None:
+            # One worker = one mesh slice: worker i orders on model
+            # column i (mod model) of the shared plane.
+            cmd += ["--device-plane", self.device_plane]
+            try:
+                col = int(role.rsplit("w", 1)[1])
+            except (IndexError, ValueError):
+                col = 0
+            cmd += ["--plane-column", str(col)]
         if self.elastic:
             cmd += ["--elastic"]
         if self.summarize:
@@ -2293,10 +2341,13 @@ def main(argv: Optional[List[str]] = None) -> None:
     max_p = _take("--max-partitions")
     worker_ttl = _take("--worker-ttl")
     devices_s = _take("--deli-devices")
+    device_plane_s = _take("--device-plane")
+    plane_col_s = _take("--plane-column")
     if (shared_dir is None or slot is None or args
             or impl not in DELI_IMPLS
             or (log_format is not None and log_format not in LOG_FORMATS)
             or (devices_s is not None and not devices_s.isdigit())
+            or (plane_col_s is not None and not plane_col_s.isdigit())
             or (downstream is not None
                 and downstream not in DOWNSTREAM_MODES)
             or (summary_ops_s is not None
@@ -2306,7 +2357,8 @@ def main(argv: Optional[List[str]] = None) -> None:
             "--dir D --slot S [--owner O] [--partitions N] [--ttl S] "
             "[--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--max-partitions K] "
-            "[--worker-ttl S] [--deli-devices N] [--elastic] "
+            "[--worker-ttl S] [--deli-devices N] "
+            "[--device-plane DxM] [--plane-column K] [--elastic] "
             "[--summarize] [--summary-ops N] [--downstream fused|split] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
@@ -2323,6 +2375,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         elastic=elastic, summarize=summarize,
         summary_ops=int(summary_ops_s) if summary_ops_s else None,
         downstream=downstream,
+        device_plane=device_plane_s,
+        plane_column=int(plane_col_s) if plane_col_s else None,
     )
 
 

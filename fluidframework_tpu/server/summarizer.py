@@ -78,6 +78,9 @@ from .historian import HistorianCache
 from .supervisor import _Role, canonical_record
 
 __all__ = [
+    "FOLD_BACKENDS",
+    "FOLD_BACKEND_ENV",
+    "FOLD_INTERPRET_ENV",
     "SUMMARY_OPS_ENV",
     "SummarizerRole",
     "SummaryIndex",
@@ -93,6 +96,22 @@ __all__ = [
 # env — the supervisor's child_env seam carries it to farm children).
 SUMMARY_OPS_ENV = "FLUID_SUMMARY_OPS"
 DEFAULT_SUMMARY_OPS = 256
+
+# Merge-tree fold backend (`fold_backend=` / env): "kernel" is the
+# vmapped row-model kernel (`apply_op_batch_docs_jit`), "overlay" the
+# O(collab window) overlay-pallas engine (`core.overlay_fold` —
+# BENCH_r04/r05 measure it ~38x the vmapped replay). Canonical row
+# serialization is backend-invariant BY CONTRACT, so blobs and
+# content-addressed handles are bit-identical either way — gated by
+# `config15_device_plane` and tests/test_device_plane.py on every
+# host. When pallas cannot lower here (CPU host, no interpreter
+# requested) the role falls back to "kernel" LOUDLY.
+FOLD_BACKEND_ENV = "FLUID_FOLD_BACKEND"
+# "1": run the overlay backend through the pallas INTERPRETER — the
+# CPU-CI correctness mode (slow, bit-identical), used by the chaos /
+# differential gates on hosts without a TPU.
+FOLD_INTERPRET_ENV = "FLUID_FOLD_INTERPRET"
+FOLD_BACKENDS = ("kernel", "overlay")
 
 # Fold-engine shape knobs (uniform across docs so the stacked vmapped
 # dispatch can group them; a doc that outgrows the uniform capacity
@@ -113,6 +132,19 @@ def _summary_ops_default() -> int:
         return max(1, int(os.environ.get(SUMMARY_OPS_ENV, "")))
     except ValueError:
         return DEFAULT_SUMMARY_OPS
+
+
+def _fold_backend_default() -> str:
+    b = os.environ.get(FOLD_BACKEND_ENV, "").strip() or "kernel"
+    if b not in FOLD_BACKENDS:
+        raise ValueError(
+            f"{FOLD_BACKEND_ENV}={b!r} not in {FOLD_BACKENDS}"
+        )
+    return b
+
+
+def _fold_interpret_default() -> bool:
+    return os.environ.get(FOLD_INTERPRET_ENV, "") == "1"
 
 
 _store_seq = 0
@@ -239,12 +271,49 @@ def _encode_fold(rep, records: List[dict]) -> None:
         rep.min_seq = max(rep.min_seq, int(rec["msn"]))
 
 
-def _fold_jobs(jobs: List[tuple]) -> None:
+def _place_fold_stack(tables, stacked, plane):
+    """Lay a stacked kernel fold over the 2-D device plane: the doc
+    axis shards on ``docs`` and the TABLE row/segment axis on
+    ``model`` (`PartitionSpec('docs', 'model')` — XLA partitions the
+    row-axis gathers with model-axis collectives), batch columns ride
+    the doc axis replicated over model. Skipped (None) when the
+    shapes don't divide the grid — placement is an optimization, the
+    fold is bit-identical either way."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    K = int(tables.n_rows.shape[0])
+    cap = int(tables.buf_start.shape[1])
+    if K % plane.docs or cap % plane.model:
+        return None
+    mesh = plane.mesh
+
+    def put(a, spec):
+        return jax.device_put(a, NamedSharding(mesh, spec))
+
+    def put_table(a):
+        spec = (P("docs") if a.ndim == 1
+                else P("docs", "model", *([None] * (a.ndim - 2))))
+        return put(a, spec)
+
+    def put_batch(a):
+        return put(a, P("docs", *([None] * (a.ndim - 1))))
+
+    return (
+        jax.tree_util.tree_map(put_table, tables),
+        jax.tree_util.tree_map(put_batch, stacked),
+    )
+
+
+def _fold_jobs(jobs: List[tuple], plane=None) -> None:
     """Drain the pending encoded rows of several replicas through the
     merge-tree kernel, STACKING same-shape replicas into one vmapped
     `apply_op_batch_docs_jit` dispatch per round — the docs axis is
     embarrassingly parallel, so K summarizing docs cost one device
-    call, not K (the `stack_replicas` idiom on the live stream)."""
+    call, not K (the `stack_replicas` idiom on the live stream).
+    `plane` (a `parallel.device_plane.DevicePlane`) lays the stacked
+    dispatch over the 2-D pool: docs on the ``docs`` axis, table rows
+    on ``model``."""
     import jax
     import jax.numpy as jnp
 
@@ -275,6 +344,10 @@ def _fold_jobs(jobs: List[tuple]) -> None:
                     stack, *[r.table for r in grp]
                 )
                 stacked = jax.tree_util.tree_map(stack, *batches)
+                if plane is not None:
+                    placed = _place_fold_stack(tables, stacked, plane)
+                    if placed is not None:
+                        tables, stacked = placed
                 out = apply_op_batch_docs_jit(tables, stacked)
                 for i, r in enumerate(grp):
                     r.table = jax.tree_util.tree_map(
@@ -312,14 +385,14 @@ def _canonical_rows(rep, msn: int) -> List[list]:
     import jax
     import numpy as np
 
+    from ..core.overlay_fold import merge_canonical_rows
     from ..ops.mergetree_kernel import NOT_REMOVED, raise_kernel_errors
     from ..protocol.constants import NO_CLIENT, UNIVERSAL_SEQ
 
     t = jax.tree_util.tree_map(np.asarray, rep.table)
     raise_kernel_errors(int(t.error))
     text = rep.arena.snapshot()
-    out: List[list] = []
-    last_key: Optional[tuple] = None
+    raw: List[tuple] = []
     for i in range(int(t.n_rows)):
         rem = int(t.rem_seq[i])
         removed = rem != NOT_REMOVED
@@ -334,16 +407,12 @@ def _canonical_rows(rep, msn: int) -> List[list]:
         rcl = (sorted(int(c) for c in t.rem_clients[i]
                       if int(c) != NO_CLIENT) if removed else None)
         props = rep.props.decode_row(t.props[i])
-        key = (ins, icl, rem if removed else None,
-               tuple(rcl) if rcl else None,
-               json.dumps(props, sort_keys=True))
-        if key == last_key and out:
-            out[-1][0] += seg  # maximal run: merge adjacent equal rows
-        else:
-            out.append([seg, ins, icl, rem if removed else None,
-                        rcl, props])
-            last_key = key
-    return out
+        raw.append((seg, ins, icl, rem if removed else None, rcl,
+                    props))
+    # The merge rule is SHARED with the overlay fold backend
+    # (`core.overlay_fold.merge_canonical_rows`) — one definition, so
+    # the two backends cannot drift apart on the bytes.
+    return merge_canonical_rows(raw)
 
 
 # ---------------------------------------------------------------------------
@@ -391,11 +460,33 @@ class SummarizerRole(_Role):
 
     def __init__(self, *a, summary_ops: Optional[int] = None,
                  store=None, historian_budget: int = 64 * 1024 * 1024,
+                 fold_backend: Optional[str] = None,
+                 device_plane=None,
+                 fold_interpret: Optional[bool] = None,
                  **kw):
         super().__init__(*a, **kw)
         self.summary_ops = int(summary_ops or _summary_ops_default())
         if self.summary_ops < 1:
             raise ValueError(f"summary_ops must be >= 1: {summary_ops}")
+        # Fold backend + device plane (resolved LAZILY: both touch jax
+        # and the role must construct cheaply in scalar/no-mergetree
+        # farms). `device_plane` is a spec/`DevicePlane`; None falls
+        # back to the process env (`parallel.device_plane.PLANE_ENV`)
+        # so supervised children inherit the farm plane.
+        requested = fold_backend or _fold_backend_default()
+        if requested not in FOLD_BACKENDS:
+            raise ValueError(
+                f"fold_backend {requested!r} not in {FOLD_BACKENDS}"
+            )
+        self._fold_backend_requested = requested
+        self._fold_backend: Optional[str] = None
+        self.fold_interpret = (
+            bool(fold_interpret) if fold_interpret is not None
+            else _fold_interpret_default()
+        )
+        self._plane_arg = device_plane
+        self._plane_resolved = False
+        self._plane = None
         self.store = store if store is not None else open_summary_store(
             self.shared_dir, historian_budget
         )
@@ -417,6 +508,79 @@ class SummarizerRole(_Role):
         self._m_frozen = m.counter("summary_docs_frozen_total", **labels)
         self._m_docs = m.gauge("summary_docs", **labels)
         self._m_build_ms = m.histogram("summary_build_ms", **labels)
+        self._m_backend_fallbacks = m.counter(
+            "summary_fold_backend_fallbacks_total", **labels
+        )
+        self._m_plane_folds = m.counter("summary_plane_folds_total",
+                                        **labels)
+
+    # --------------------------------------------------- fold backend
+
+    def fold_backend(self) -> str:
+        """The RESOLVED fold backend: "overlay" only when the
+        overlay-pallas engine can actually run here (real TPU
+        lowering, or the interpreter when `fold_interpret` asks for
+        the CPU-CI correctness mode) — otherwise a LOUD fallback to
+        "kernel" (stdout + metric), never a silent one. Resolution is
+        process-cheap after the first call."""
+        if self._fold_backend is None:
+            backend = self._fold_backend_requested
+            if backend == "overlay":
+                from ..core.overlay_fold import overlay_available
+
+                if not overlay_available(self.fold_interpret):
+                    print(
+                        f"summarizer: fold_backend=overlay unavailable "
+                        f"(pallas cannot lower here, interpret="
+                        f"{self.fold_interpret}); FALLING BACK to "
+                        f"fold_backend=kernel", flush=True,
+                    )
+                    self._m_backend_fallbacks.inc()
+                    backend = "kernel"
+            self._fold_backend = backend
+            self.metrics.gauge(
+                "summary_fold_backend", backend=backend,
+                **self._metric_labels()
+            ).set(1)
+        return self._fold_backend
+
+    def device_plane(self):
+        """The farm's 2-D device plane (None when unconfigured):
+        explicit arg wins, else the process env — the supervisor's
+        child_env seam (`--device-plane`/`FLUID_DEVICE_PLANE`)."""
+        if not self._plane_resolved:
+            from ..parallel.device_plane import resolve_plane
+
+            self._plane = resolve_plane(self._plane_arg, env=True)
+            self._plane_resolved = True
+        return self._plane
+
+    def _boot_rep(self, rows: List[list], msn: int):
+        if self.fold_backend() == "overlay":
+            from ..core.overlay_fold import boot_overlay
+
+            return boot_overlay(rows, msn,
+                                interpret=self.fold_interpret)
+        return _boot_mergetree(rows, msn)
+
+    def _dispatch_fold(self, fold_jobs: List[tuple]) -> None:
+        plane = self.device_plane()
+        if plane is not None:
+            self._m_plane_folds.inc()
+        if self.fold_backend() == "overlay":
+            from ..core.overlay_fold import fold_jobs_overlay
+
+            fold_jobs_overlay(fold_jobs, plane=plane,
+                              interpret=self.fold_interpret)
+        else:
+            _fold_jobs(fold_jobs, plane=plane)
+
+    def _rows_of(self, rep, msn: int) -> List[list]:
+        """Canonical rows at `msn` — backend-dispatched, identical
+        bytes by contract (the content-addressed no-fork invariant)."""
+        if self.fold_backend() == "overlay":
+            return rep.canonical_rows(msn)
+        return _canonical_rows(rep, msn)
 
     # ------------------------------------------------------------ state
 
@@ -454,7 +618,7 @@ class SummarizerRole(_Role):
     def _rep(self, doc: str, f: dict):
         rep = self._reps.get(doc)
         if rep is None:
-            rep = self._reps[doc] = _boot_mergetree(
+            rep = self._reps[doc] = self._boot_rep(
                 f["rows"], f["base_msn"]
             )
         return rep
@@ -615,7 +779,7 @@ class SummarizerRole(_Role):
         if len(fold_jobs) > 1:
             self._m_stacked.inc(len(fold_jobs))
         if fold_jobs:
-            _fold_jobs(fold_jobs)
+            self._dispatch_fold(fold_jobs)
         for doc, line_idx, upto, rec_upto, seq, msn, count, byte_off \
                 in round_jobs:
             f = self.docs[doc]
@@ -627,7 +791,7 @@ class SummarizerRole(_Role):
                 if rep is None:
                     continue  # froze mid-round
                 try:
-                    rows = _canonical_rows(rep, msn)
+                    rows = self._rows_of(rep, msn)
                 except RuntimeError as exc:  # kernel error flag
                     self._freeze(doc, f, repr(exc))
                     continue
@@ -639,7 +803,7 @@ class SummarizerRole(_Role):
                 # Rebuild from the serialized form — the restart path,
                 # exercised every cadence, so a crashed-and-restored
                 # summarizer can never diverge from this one.
-                self._reps[doc] = _boot_mergetree(rows, msn)
+                self._reps[doc] = self._boot_rep(rows, msn)
                 blob = {"form": "mergetree", "doc": doc, "seq": seq,
                         "msn": msn, "count": count, "rows": rows}
             elif f["engine"] == "ops":

@@ -1332,7 +1332,9 @@ def serve_role(shared_dir: str, role: str, owner: str,
                hb_interval_s: Optional[float] = None,
                summary_ops: Optional[int] = None,
                ingress_partitions: Optional[int] = None,
-               ingress_elastic: bool = False) -> None:
+               ingress_elastic: bool = False,
+               device_plane: Optional[str] = None,
+               fold_backend: Optional[str] = None) -> None:
     """Child-process entry: run one role until killed/deposed/fenced.
     With `partition`, the role serves that partition's topic pair under
     its partition-suffixed lease (one pinned shard of the fabric —
@@ -1341,12 +1343,30 @@ def serve_role(shared_dir: str, role: str, owner: str,
     across an N-device mesh (`--deli-devices`; kernel impl only —
     the scalar deli has no device axis, so asking is a config error).
     `summary_ops` sets the summarizer's emission cadence (summarizer
-    role only; env ``FLUID_SUMMARY_OPS`` is the process-wide form)."""
+    role only; env ``FLUID_SUMMARY_OPS`` is the process-wide form).
+    `device_plane` ("DOCSxMODEL", `parallel.device_plane`) serves the
+    kernel deli on the plane's 1-D docs slice and lays the
+    summarizer's folds over the whole 2-D pool; `fold_backend`
+    ("kernel"|"overlay") picks the summarizer's merge-tree fold
+    engine (``FLUID_FOLD_BACKEND`` is the process-wide form)."""
     if deli_devices is not None and deli_devices > 1 and (
             role != "deli" or deli_impl != "kernel"):
         raise ValueError(
             f"deli_devices={deli_devices} needs role=deli with "
             f"deli_impl='kernel' (got role={role!r}, impl={deli_impl!r})"
+        )
+    if device_plane is not None and (
+            role not in ("deli", "summarizer")
+            or (role == "deli" and deli_impl != "kernel")):
+        raise ValueError(
+            f"device_plane={device_plane!r} serves the kernel deli "
+            f"and the summarizer (got role={role!r}, "
+            f"impl={deli_impl!r})"
+        )
+    if fold_backend is not None and role != "summarizer":
+        raise ValueError(
+            f"fold_backend={fold_backend!r} is a summarizer knob "
+            f"(got role={role!r})"
         )
     if summary_ops is not None and role != "summarizer":
         raise ValueError(
@@ -1365,6 +1385,10 @@ def serve_role(shared_dir: str, role: str, owner: str,
     kw = {}
     if deli_devices is not None and deli_devices > 1:
         kw["deli_devices"] = deli_devices
+    if device_plane is not None:
+        kw["device_plane"] = device_plane
+    if fold_backend is not None:
+        kw["fold_backend"] = fold_backend
     if summary_ops is not None:
         kw["summary_ops"] = summary_ops
     if role == "ingress":
@@ -1430,7 +1454,9 @@ class ServiceSupervisor:
                  fused_hop: bool = False,
                  ingress: bool = False,
                  retention: bool = False,
-                 retention_env: Optional[Dict[str, str]] = None):
+                 retention_env: Optional[Dict[str, str]] = None,
+                 device_plane: Optional[str] = None,
+                 fold_backend: Optional[str] = None):
         """`child_env` adds/overrides spawn-environment variables for
         every child (the chaos harness's seam: it points CHILDREN at a
         disk-fault spec — `queue.DISK_FAULT_ENV` — without poisoning
@@ -1486,6 +1512,17 @@ class ServiceSupervisor:
             self.child_env.setdefault(
                 "FLUID_RETENTION_CONSUMERS", ",".join(deltas_consumers)
             )
+            if self.ingress:
+                # With the front door on, the admission topics are
+                # growth surfaces too: `ingress` truncates behind the
+                # admission role's own input checkpoint, `nacks`
+                # behind its producer recovery window (PR 14
+                # follow-up — the whole pipeline's disk is bounded,
+                # not just the ordered half).
+                self.child_env.setdefault(
+                    "FLUID_RETENTION_TOPICS",
+                    "deltas,rawdeltas,ingress,nacks",
+                )
             for k, v in (retention_env or {}).items():
                 self.child_env[k] = str(v)
         self.hb_interval_s = hb_interval_s
@@ -1519,6 +1556,41 @@ class ServiceSupervisor:
                 f"deli_impl='kernel' (the scalar deli has no device "
                 f"axis); got {self.deli_impl!r}"
             )
+        # 2-D device plane (parallel.device_plane): ONE docs x model
+        # mesh serving the kernel deli (docs-axis slice) AND the
+        # summarizer folds (whole pool). The parent only PARSES the
+        # spec — children build the actual mesh under the forced
+        # virtual-device env below; the spec also rides the child env
+        # (PLANE_ENV) so ranged/partitioned roles inherit it.
+        self.device_plane: Optional[str] = None
+        self.plane_shape: Optional[Tuple[int, int]] = None
+        self.fold_backend = fold_backend
+        if fold_backend is not None and fold_backend not in (
+                "kernel", "overlay"):
+            raise ValueError(
+                f"fold_backend {fold_backend!r} not in "
+                f"('kernel', 'overlay')"
+            )
+        if device_plane is not None:
+            from ..parallel.device_plane import PLANE_ENV, \
+                parse_plane_spec
+
+            if self.deli_impl != "kernel":
+                raise ValueError(
+                    f"device_plane={device_plane!r} needs "
+                    f"deli_impl='kernel' (the scalar deli has no "
+                    f"device axis); got {self.deli_impl!r}"
+                )
+            if self.deli_devices is not None and self.deli_devices > 1:
+                raise ValueError(
+                    "deli_devices and device_plane are exclusive: "
+                    "the plane's docs axis IS the deli's device slice"
+                )
+            self.plane_shape = parse_plane_spec(device_plane)
+            self.device_plane = (
+                f"{self.plane_shape[0]}x{self.plane_shape[1]}"
+            )
+            self.child_env.setdefault(PLANE_ENV, self.device_plane)
         self.python = python or sys.executable
         self.spawn_ready_timeout_s = spawn_ready_timeout_s
         self.procs: Dict[str, subprocess.Popen] = {}
@@ -1564,6 +1636,11 @@ class ServiceSupervisor:
                "--ckpt-duty", str(self.ckpt_duty)]
         if self.deli_devices is not None and role == "deli":
             cmd += ["--deli-devices", str(self.deli_devices)]
+        if self.device_plane is not None and role in ("deli",
+                                                      "summarizer"):
+            cmd += ["--device-plane", self.device_plane]
+        if self.fold_backend is not None and role == "summarizer":
+            cmd += ["--fold-backend", self.fold_backend]
         if self.summary_ops is not None and role == "summarizer":
             cmd += ["--summary-ops", str(self.summary_ops)]
         if self.hb_interval_s is not None:
@@ -1580,8 +1657,16 @@ class ServiceSupervisor:
         with a multi-device deli, the CPU backend is split into
         `deli_devices` virtual host devices so the sharded pool has a
         mesh to land on (the XLA flag only acts before the first jax
-        import — exactly why it rides the spawn env)."""
-        if self.deli_devices is not None and self.deli_devices > 1:
+        import — exactly why it rides the spawn env); a device PLANE
+        forces docs*model of them so the whole 2-D grid exists in
+        every child."""
+        if self.plane_shape is not None:
+            from ..utils.devices import forced_host_device_env
+
+            env = forced_host_device_env(
+                self.plane_shape[0] * self.plane_shape[1]
+            )
+        elif self.deli_devices is not None and self.deli_devices > 1:
             from ..utils.devices import forced_host_device_env
 
             env = forced_host_device_env(self.deli_devices)
@@ -1816,7 +1901,8 @@ class ServiceSupervisor:
                 "deli_impl": self.deli_impl,
                 "log_format": self.log_format,
                 "fused_hop": self.fused_hop,
-                "retention": self.retention}
+                "retention": self.retention,
+                "device_plane": self.device_plane}
 
     def _hb_field(self, role: str, key: str) -> Any:
         """One field off `role`'s last heartbeat (None if absent)."""
@@ -1900,6 +1986,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     devices_s = _take("--deli-devices")
     hb_interval_s = _take("--hb-interval")
     summary_ops_s = _take("--summary-ops")
+    device_plane_s = _take("--device-plane")
+    fold_backend_s = _take("--fold-backend")
     ingress_parts_s = _take("--ingress-partitions")
     ingress_elastic = "--ingress-elastic" in args
     if ingress_elastic:
@@ -1914,7 +2002,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             or (ingress_parts_s is not None
                 and not ingress_parts_s.isdigit())
             or (summary_ops_s is not None
-                and not summary_ops_s.isdigit())):
+                and not summary_ops_s.isdigit())
+            or (fold_backend_s is not None
+                and fold_backend_s not in ("kernel", "overlay"))):
         print(
             "usage: python -m fluidframework_tpu.server.supervisor "
             "--role {deli|scriptorium|scribe|broadcaster|summarizer"
@@ -1922,7 +2012,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             "--dir D "
             "[--owner O] [--ttl S] [--batch N] [--impl scalar|kernel] "
             "[--log-format json|columnar] [--partition K] "
-            "[--deli-devices N] [--hb-interval S] [--summary-ops N] "
+            "[--deli-devices N] [--device-plane DxM] "
+            "[--fold-backend kernel|overlay] "
+            "[--hb-interval S] [--summary-ops N] "
             "[--ingress-partitions N] [--ingress-elastic] "
             "[--ckpt-interval S] [--ckpt-bytes N] [--ckpt-duty F]",
             file=sys.stderr,
@@ -1939,7 +2031,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                summary_ops=int(summary_ops_s) if summary_ops_s else None,
                ingress_partitions=int(ingress_parts_s)
                if ingress_parts_s else None,
-               ingress_elastic=ingress_elastic)
+               ingress_elastic=ingress_elastic,
+               device_plane=device_plane_s,
+               fold_backend=fold_backend_s)
 
 
 if __name__ == "__main__":

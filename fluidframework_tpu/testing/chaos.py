@@ -140,6 +140,19 @@ class ChaosConfig:
     # converging run proves the SHARDED kernel bit-identical to the
     # single-device stream under the same faults.
     deli_devices: Optional[int] = None
+    # 2-D device plane (parallel.device_plane, kernel impl only): ONE
+    # docs x model mesh serving the kernel deli (its docs-axis slice)
+    # AND the summarizer folds (the whole pool) — the children run
+    # under docs*model forced virtual host devices. Golden still folds
+    # single-device in-proc, so a converging run proves the
+    # plane-sliced pipeline bit-identical under the same faults.
+    device_plane: Optional[str] = None
+    # Summarizer merge-tree fold engine ("kernel" | "overlay"): the
+    # overlay-pallas backend runs through the INTERPRETER in the farm
+    # children (FLUID_FOLD_INTERPRET=1 — the CPU-CI correctness mode),
+    # and the summary-integrity gate then proves its blobs/handles
+    # bit-identical to the kernel fold's and to cold scalar replay.
+    fold_backend: Optional[str] = None
     # Elastic hash-range topology (server.shard_fabric elastic mode):
     # partitions are range leases that can split/merge LIVE. Implied
     # by the split/merge/disk fault classes; may be set explicitly to
@@ -305,7 +318,21 @@ def build_workload(cfg: ChaosConfig) -> List[dict]:
                 {
                     "kind": "op", "doc": doc, "client": c,
                     "clientSeq": i + 1, "refSeq": 0,
-                    "contents": {"v": rng.randint(0, 999), "i": i},
+                    # With a summarizer FOLD BACKEND under test the
+                    # contents must decode as merge-tree wire ops or
+                    # the engine under test never runs (generic docs
+                    # take the "ops"-blob path). Prepend-inserts are
+                    # valid at EVERY perspective (position 0 always
+                    # exists), so the raw records stay valid however
+                    # the deli interleaves and stamps them; the
+                    # golden/scribe machinery treats contents
+                    # opaquely either way.
+                    "contents": (
+                        {"type": 0, "pos1": 0,
+                         "seg": f"{c}.{i};"}
+                        if cfg.fold_backend is not None
+                        else {"v": rng.randint(0, 999), "i": i}
+                    ),
                 }
                 for i in range(cfg.ops_per_client)
             ]
@@ -548,6 +575,31 @@ def run_chaos(cfg: ChaosConfig) -> ChaosResult:
             f"deli_devices={cfg.deli_devices} needs deli_impl='kernel'"
             f"; got {cfg.deli_impl!r}"
         )
+    if cfg.device_plane is not None:
+        if cfg.deli_impl != "kernel":
+            raise ValueError(
+                f"device_plane={cfg.device_plane!r} needs "
+                f"deli_impl='kernel'; got {cfg.deli_impl!r}"
+            )
+        if cfg.deli_devices is not None and cfg.deli_devices > 1:
+            raise ValueError(
+                "deli_devices and device_plane are exclusive (the "
+                "plane's docs axis IS the deli's device slice)"
+            )
+        from ..parallel.device_plane import parse_plane_spec
+
+        parse_plane_spec(cfg.device_plane)  # loud on a bad spec
+    if cfg.fold_backend is not None:
+        if cfg.fold_backend not in ("kernel", "overlay"):
+            raise ValueError(
+                f"fold_backend {cfg.fold_backend!r} not in "
+                f"('kernel', 'overlay')"
+            )
+        if not cfg.summarizer:
+            raise ValueError(
+                "fold_backend is a summarizer knob: set "
+                "summarizer=True (nothing else folds merge-trees)"
+            )
     unknown = set(cfg.faults) - set(ALL_FAULT_CLASSES)
     if unknown:
         raise ValueError(f"unknown fault classes {sorted(unknown)}")
@@ -780,11 +832,30 @@ def _run_chaos_in(cfg: ChaosConfig, shared: str) -> ChaosResult:
         from ..server.retention import RETENTION_FAULT_ENV
 
         child_env[RETENTION_FAULT_ENV] = ret_fault
+    hb_timeout = cfg.heartbeat_timeout_s
+    if cfg.fold_backend == "overlay":
+        # CPU-CI correctness mode: the overlay-pallas fold runs
+        # through the interpreter in the summarizer child, so the
+        # overlay path is actually EXERCISED (not silently fallen
+        # back from) and the summary-integrity gate below proves its
+        # blobs bit-identical.
+        from ..server.summarizer import FOLD_INTERPRET_ENV
+
+        child_env.setdefault(FOLD_INTERPRET_ENV, "1")
+        # The interpreter's first fold compiles for tens of seconds
+        # INSIDE flush_batch — a silent child, not a wedged one. A
+        # 3s staleness bar would SIGKILL every summarizer mid-compile
+        # forever (the restart pays the same compile); chaos kills
+        # are still detected instantly via process exit, so widening
+        # the WEDGE bar costs the run nothing it is testing.
+        hb_timeout = max(hb_timeout, 120.0)
     sup = ServiceSupervisor(
         shared, roles=roles, ttl_s=cfg.ttl_s,
-        heartbeat_timeout_s=cfg.heartbeat_timeout_s, batch=cfg.batch,
+        heartbeat_timeout_s=hb_timeout, batch=cfg.batch,
         deli_impl=cfg.deli_impl, log_format=cfg.log_format,
         deli_devices=cfg.deli_devices,
+        device_plane=cfg.device_plane,
+        fold_backend=cfg.fold_backend,
         child_env=child_env or None,
         summary_ops=cfg.summary_ops if cfg.summarizer else None,
         fused_hop=cfg.fused_hop,
@@ -1255,6 +1326,7 @@ def _run_chaos_sharded(cfg: ChaosConfig, shared: str) -> ChaosResult:
         ttl_s=cfg.ttl_s, heartbeat_timeout_s=cfg.heartbeat_timeout_s,
         batch=cfg.batch, deli_impl=cfg.deli_impl,
         log_format=cfg.log_format, deli_devices=cfg.deli_devices,
+        device_plane=cfg.device_plane,
         elastic=cfg.elastic, child_env=child_env,
         ingress=cfg.ingress, downstream=cfg.downstream,
         autoscale=policy,

@@ -36,7 +36,7 @@ import os
 import shutil
 import tempfile
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def build_pipeline_workload(n_docs: int, n_clients: int,
@@ -408,6 +408,11 @@ def _multichip_child_main() -> None:
     n_docs, ops_per_doc, n_clients, repeats = (
         int(a) for a in sys.argv[2:6]
     )
+    # Optional 2-D device-plane spec ("DxM"): the sequencer then runs
+    # on the plane's 1-D docs-axis SLICE (`DevicePlane.seq_mesh(0)`) —
+    # the config15 form where the model axis exists in the process
+    # (forced docs*model devices) but ordering tiles one column of it.
+    plane_spec = sys.argv[6] if len(sys.argv) > 6 else ""
     import hashlib
 
     import jax
@@ -425,12 +430,19 @@ def _multichip_child_main() -> None:
     admitted = np.zeros((n_docs, _pow2(n_clients + 1, lo=2)), bool)
     admitted[:, 1:n_clients + 1] = True
 
-    if n_devices > 1:
-        from jax.sharding import NamedSharding, PartitionSpec
+    mesh = None
+    if plane_spec:
+        from ..parallel.device_plane import shared_plane, \
+            parse_plane_spec
 
+        mesh = shared_plane(*parse_plane_spec(plane_spec)).seq_mesh(0)
+    elif n_devices > 1:
         from ..parallel.mesh import shared_docs_mesh
 
         mesh = shared_docs_mesh(n_devices)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
         sh = NamedSharding(mesh, PartitionSpec("docs"))
         fn = _sk.sharded_sequence_fn(mesh)
 
@@ -479,6 +491,7 @@ def _multichip_child_main() -> None:
     ops = n_docs * ops_per_doc
     print("DONE " + json.dumps({
         "n_devices": n_devices,
+        "plane": plane_spec or None,
         "platform": jax.devices()[0].platform,
         "visible_devices": len(jax.devices()),
         "seconds": round(best, 6),
@@ -561,6 +574,277 @@ def run_multichip_bench(devices: Tuple[int, ...] = (1, 4, 8),
         "cores": os.cpu_count(),
         "gate": "bit-identical across device counts",
         "unit": "submissions/s",
+    }
+
+
+# ---------------------------------------------------------------------------
+# device plane: one 2-D mesh for sequencing AND summary folds
+# ---------------------------------------------------------------------------
+
+
+def fold_parity_skip_reason() -> Optional[str]:
+    """None when the overlay-vs-kernel fold speedup can be measured
+    honestly on this host (the overlay-pallas kernel actually lowers —
+    a real TPU); else the loud-skip reason. Interpreter-mode timing
+    measures the pallas interpreter, not the engine, so the
+    BENCH_r04/r05 ~38x replay advantage is unmeasurable on CPU CI —
+    the digest bit-identity gates still run there."""
+    from ..core.overlay_fold import overlay_available
+
+    if overlay_available(False):
+        return None
+    return (
+        "overlay-pallas cannot lower on this host (no TPU backend): "
+        "interpreter-mode timing measures the interpreter, not the "
+        "engine — the fold-backend speedup is not honestly measurable"
+    )
+
+
+def run_fold_backend_bench(n_docs: int = 4, ops_per_doc: int = 1500,
+                           summary_ops: Optional[int] = None,
+                           n_clients: int = 4, seed: int = 40,
+                           device_plane: Optional[str] = None,
+                           repeats: int = 2) -> dict:
+    """Kernel vs overlay summarizer fold over IDENTICAL streams — the
+    config15 engine. Each backend runs the summarizer's exact
+    emission loop (boot-from-rows, encode, stacked fold across docs,
+    canonical serialization, rebuild — the restart path every
+    cadence) over `n_docs` deterministic merge-tree streams; the
+    canonical rows of EVERY emission must be byte-identical across
+    backends (the content-addressed no-fork contract) before any
+    number is reported, and ``fold_backend_speedup`` =
+    kernel_time / overlay_time. On hosts where pallas cannot lower
+    the overlay runs the INTERPRETER (`parity_skip_reason` names why
+    the speedup is then unmeasurable; the digest gate still ran).
+    `device_plane` stacks both backends' fold dispatches over the 2-D
+    plane (resolvable in-process — forced host devices or a real
+    slice)."""
+    import hashlib
+
+    from ..core.overlay_fold import (
+        boot_overlay,
+        fold_jobs_overlay,
+        overlay_available,
+    )
+    from ..parallel.device_plane import resolve_plane
+    from ..server.summarizer import (
+        _boot_mergetree,
+        _canonical_rows,
+        _encode_fold,
+        _fold_jobs,
+    )
+
+    summary_ops = int(summary_ops or max(64, ops_per_doc // 8))
+    plane = resolve_plane(device_plane)
+    interpret = not overlay_available(False)
+    streams = {
+        f"doc{i}": build_mergetree_stream(
+            ops_per_doc, n_clients=n_clients, seed=seed + i,
+            doc=f"doc{i}",
+        )
+        for i in range(n_docs)
+    }
+    rec_len = max(len(r) for r in streams.values())
+
+    def one_run(backend: str):
+        def boot(rows, msn):
+            if backend == "overlay":
+                return boot_overlay(rows, msn, interpret=interpret)
+            return _boot_mergetree(rows, msn)
+
+        reps: Dict[str, Any] = {}
+        state: Dict[str, tuple] = {d: ([], 0) for d in streams}
+        msn_run: Dict[str, int] = {d: 0 for d in streams}
+        digests: List[str] = []
+        t0 = time.perf_counter()
+        for lo in range(0, rec_len, summary_ops):
+            jobs = []
+            triggers = []
+            for doc, recs in streams.items():
+                take = recs[lo: lo + summary_ops]
+                if not take:
+                    continue
+                rows, base_msn = state[doc]
+                rep = reps.get(doc)
+                if rep is None:
+                    rep = reps[doc] = boot(rows, base_msn)
+                _encode_fold(rep, take)
+                msn_run[doc] = max(
+                    msn_run[doc], max(r["msn"] for r in take)
+                )
+                jobs.append((rep, take))
+                triggers.append((doc, rep, msn_run[doc]))
+            if not jobs:
+                continue
+            if backend == "overlay":
+                fold_jobs_overlay(jobs, plane=plane,
+                                  interpret=interpret)
+            else:
+                _fold_jobs(jobs, plane=plane)
+            for doc, rep, msn in triggers:
+                rows = (rep.canonical_rows(msn) if backend == "overlay"
+                        else _canonical_rows(rep, msn))
+                digests.append(hashlib.sha256(
+                    json.dumps(rows, sort_keys=True).encode()
+                ).hexdigest())
+                state[doc] = (rows, msn)
+                reps[doc] = boot(rows, msn)
+        return time.perf_counter() - t0, digests
+
+    results = {}
+    for backend in ("kernel", "overlay"):
+        warm, dig0 = one_run(backend)  # compile + first pass, untimed
+        best = float("inf")
+        digs = dig0
+        for _ in range(max(1, repeats)):
+            t, digs = one_run(backend)
+            best = min(best, t)
+        assert digs == dig0, f"{backend} fold is not deterministic"
+        results[backend] = {"seconds": round(best, 4),
+                            "warmup_s": round(warm, 4),
+                            "digests": digs}
+    kd = results["kernel"].pop("digests")
+    od = results["overlay"].pop("digests")
+    # The gate that ALWAYS runs: blob bytes (canonical rows) identical
+    # across backends at every emission point.
+    assert kd == od, (
+        f"fold backends DIVERGED: {sum(a != b for a, b in zip(kd, od))}"
+        f"/{len(kd)} emissions differ"
+    )
+    speedup = results["kernel"]["seconds"] / max(
+        results["overlay"]["seconds"], 1e-9
+    )
+    return {
+        "metric": "summary_fold_backend",
+        "docs": n_docs, "ops_per_doc": ops_per_doc,
+        "summary_ops": summary_ops, "emissions": len(kd),
+        "kernel": results["kernel"], "overlay": results["overlay"],
+        "fold_backend_speedup": round(speedup, 2),
+        "interpret": interpret,
+        "plane": plane.spec() if plane is not None else None,
+        "parity_skip_reason": fold_parity_skip_reason(),
+        "gate": ("canonical rows bit-identical across fold backends "
+                 "at every emission"),
+        "unit": "x (kernel_s / overlay_s)",
+    }
+
+
+def _fold_backend_child_main() -> None:
+    """Subprocess entry for the fold-backend bench under a forced
+    device grid (the plane needs docs*model devices, which only exist
+    if the XLA flag preceded the first jax import)."""
+    import sys
+
+    n_docs, ops_per_doc, summary_ops, n_clients, repeats = (
+        int(a) for a in sys.argv[1:6]
+    )
+    plane = sys.argv[6] if len(sys.argv) > 6 and sys.argv[6] else None
+    res = run_fold_backend_bench(
+        n_docs=n_docs, ops_per_doc=ops_per_doc,
+        summary_ops=summary_ops or None, n_clients=n_clients,
+        device_plane=plane, repeats=repeats,
+    )
+    print("DONE " + json.dumps(res), flush=True)
+
+
+def run_device_plane_bench(plane: str = "2x2", n_docs: int = 2048,
+                           ops_per_doc: int = 64, n_clients: int = 8,
+                           repeats: int = 3, fold_docs: int = 4,
+                           fold_ops: int = 1500,
+                           fold_summary_ops: Optional[int] = None
+                           ) -> dict:
+    """The 2-D device-plane composition bench (config15's engine):
+
+    - SEQUENCER on the plane's docs-axis slice vs single-device — the
+      same [D, B] workload, verdict digests bit-identical (the
+      config7 gate extended to the 2-D layout: the model axis exists
+      in the child process, ordering tiles one column of it);
+    - SUMMARIZER fold backends stacked over the whole plane — kernel
+      vs overlay, canonical rows bit-identical at every emission,
+      ``fold_backend_speedup`` reported (honestly measurable only
+      where pallas lowers — `fold_parity_skip_reason`).
+
+    One subprocess per leg so the forced-device grid exists before
+    the first jax import; real chips are used when present."""
+    from ..parallel.device_plane import parse_plane_spec
+    from ..server.deli_kernel import _mul_of
+    from ..utils.devices import run_forced_host_subprocess, \
+        visible_devices
+
+    d, m = parse_plane_spec(plane)
+    spec = f"{d}x{m}"
+    # Every leg shares one workload; the plane leg shards docs over
+    # `d` devices, so a d*m multiple covers every divisibility need.
+    n_docs = _mul_of(n_docs, d * m)
+    platform, available = visible_devices()
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    seq_code = ("from fluidframework_tpu.testing.deli_bench import "
+                "_multichip_child_main; _multichip_child_main()")
+    runs: List[dict] = []
+    # ALL legs run under the SAME device grid (docs*model forced host
+    # devices on emulation hosts): 1 device of it, the classic 1-D
+    # docs mesh over `d` of it, and the plane's docs-axis slice.
+    # Forcing the grid only into the plane leg would bill the others
+    # the whole host's threadpool while the slice pays the
+    # per-virtual-device split — a ratio of the emulation artifact,
+    # not the sharding. On real accelerator hosts env passes through
+    # untouched. The 1-D leg is the PRESERVATION comparator: the 2-D
+    # layout must not lose what the 1-D mesh measures on this host.
+    forced = platform in ("cpu", "none") or available < d * m
+    for child_spec, n_dev in (("", 1), ("", d), (spec, d * m)):
+        res = run_forced_host_subprocess(
+            seq_code, d * m, cwd=repo,
+            argv=[str(n_dev), str(n_docs), str(ops_per_doc),
+                  str(n_clients), str(repeats), child_spec],
+            env=None if forced else dict(os.environ),
+        )
+        done = [l for l in res.stdout.splitlines()
+                if l.startswith("DONE ")]
+        assert done, res.stdout[-800:]
+        child = json.loads(done[0][5:])
+        child["forced_host"] = forced
+        runs.append(child)
+    digests = {r["digest"] for r in runs}
+    assert len(digests) == 1, (
+        f"sequencer verdicts diverge across 1-dev / 1-D mesh / plane "
+        f"slice: {[(r['n_devices'], r['digest'][:16]) for r in runs]}"
+    )
+    seq_speedup = round(
+        runs[2]["ops_per_sec"] / runs[0]["ops_per_sec"], 2
+    )
+    oned_speedup = round(
+        runs[1]["ops_per_sec"] / runs[0]["ops_per_sec"], 2
+    )
+    fold_code = ("from fluidframework_tpu.testing.deli_bench import "
+                 "_fold_backend_child_main; _fold_backend_child_main()")
+    res = run_forced_host_subprocess(
+        fold_code, d * m, cwd=repo,
+        argv=[str(fold_docs), str(fold_ops),
+              str(fold_summary_ops or 0), "4", "2", spec],
+        timeout_s=1800.0,
+        env=None if forced else dict(os.environ),
+    )
+    done = [l for l in res.stdout.splitlines() if l.startswith("DONE ")]
+    assert done, res.stdout[-800:]
+    fold = json.loads(done[0][5:])
+    return {
+        "metric": "device_plane",
+        "plane": spec,
+        "docs": n_docs, "ops_per_doc": ops_per_doc,
+        "sequencer": {"runs": runs, "speedup": seq_speedup,
+                      "oned_speedup": oned_speedup,
+                      "forced_host": forced,
+                      "speedup_axis": f"plane_{spec}_vs_1_device"},
+        "fold": fold,
+        "fold_backend_speedup": fold["fold_backend_speedup"],
+        "parity_skip_reason": fold["parity_skip_reason"],
+        "cores": os.cpu_count(),
+        "gate": ("sequencer digests bit-identical 1-dev vs plane "
+                 "slice; fold canonical rows bit-identical across "
+                 "backends"),
+        "unit": "x (kernel_s / overlay_s)",
     }
 
 
@@ -1882,6 +2166,28 @@ def main() -> None:  # CLI twin: tools/bench_deli.py
             ops_per_doc=int(os.environ.get("BD_OPS_PER_DOC", "64")),
             n_clients=int(os.environ.get("BD_CLIENTS", "8")),
             repeats=int(os.environ.get("BD_REPEATS", "3")),
+        )
+        print(json.dumps(res))
+        return
+    if os.environ.get("BD_DEVICE_PLANE"):
+        # 2-D device-plane mode (tools/bench_deli.py --device-plane):
+        # sequencer on the plane's docs slice vs single-device +
+        # kernel-vs-overlay summarizer fold stacked over the whole
+        # plane, both digest-gated (bench_configs
+        # config15_device_plane's engine). BD_DEVICE_PLANE is the
+        # "DOCSxMODEL" spec (default "2x2").
+        spec = os.environ["BD_DEVICE_PLANE"]
+        res = run_device_plane_bench(
+            plane=spec if "x" in spec else "2x2",
+            n_docs=max(8, int(int(os.environ.get("BD_DOCS", "2048"))
+                              * scale)),
+            ops_per_doc=int(os.environ.get("BD_OPS_PER_DOC", "64")),
+            n_clients=int(os.environ.get("BD_CLIENTS", "8")),
+            repeats=int(os.environ.get("BD_REPEATS", "3")),
+            fold_docs=int(os.environ.get("BD_FOLD_DOCS", "4")),
+            fold_ops=max(64, int(int(os.environ.get("BD_FOLD_OPS",
+                                                    "1500"))
+                                 * scale)),
         )
         print(json.dumps(res))
         return

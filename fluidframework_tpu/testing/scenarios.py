@@ -139,6 +139,7 @@ def run_hotdoc_storm(n_writers: int = 2000, cold_docs: int = 32,
                      deli_impl: str = "scalar", log_format: str = "json",
                      ttl_s: float = 0.75, timeout_s: float = 120.0,
                      seed: int = 13,
+                     hb_timeout_s: Optional[float] = None,
                      work_dir: Optional[str] = None) -> dict:
     """One viral document, `n_writers` writers, a cold background mix
     — open-loop through the supervised farm (fused durable+broadcast
@@ -160,7 +161,7 @@ def run_hotdoc_storm(n_writers: int = 2000, cold_docs: int = 32,
         return _hotdoc_storm_run(
             scratch, n_writers, cold_docs, cold_clients, rate_hz,
             duration_s, hot_fraction, deli_impl, log_format, ttl_s,
-            timeout_s, seed,
+            timeout_s, seed, hb_timeout_s,
         )
     finally:
         # Unconditional (failure paths too): the scratch lives on
@@ -174,8 +175,8 @@ def _hotdoc_storm_run(scratch: str, n_writers: int, cold_docs: int,
                       cold_clients: int, rate_hz: float,
                       duration_s: float, hot_fraction: float,
                       deli_impl: str, log_format: str, ttl_s: float,
-                      timeout_s: float, seed: int) -> dict:
-    from ..server.queue import SharedFileTopic, TailReader
+                      timeout_s: float, seed: int,
+                      hb_timeout_s: Optional[float] = None) -> dict:
     from ..server.supervisor import ServiceSupervisor
 
     rng = random.Random(seed)
@@ -183,6 +184,11 @@ def _hotdoc_storm_run(scratch: str, n_writers: int, cold_docs: int,
         scratch, roles=("deli", "scriptorium", "broadcaster"),
         ttl_s=ttl_s, fused_hop=True, deli_impl=deli_impl,
         log_format=log_format,
+        # The WEDGE bar (chaos kills still surface via process exit):
+        # a kernel deli compiling its first full-width [D, C, B] pump
+        # on a small host is silent for tens of seconds — killing it
+        # mid-compile restarts the same compile forever.
+        heartbeat_timeout_s=hb_timeout_s if hb_timeout_s else 2.0,
         # FLUID_TRACE_SLOW_MS=0: the children's flight recorders keep
         # every span (ring-bounded) instead of waiting for the rolling
         # p99 to arm — a short scaled run must still produce /traces
@@ -192,10 +198,20 @@ def _hotdoc_storm_run(scratch: str, n_writers: int, cold_docs: int,
         hb_interval_s=0.1,
     ).start()
     try:
-        raw = SharedFileTopic(os.path.join(scratch, "topics",
-                                           "rawdeltas.jsonl"))
-        bc_reader = TailReader(SharedFileTopic(
-            os.path.join(scratch, "topics", "broadcast.jsonl")))
+        # Topics in the FARM's wire format: a columnar run feeds
+        # binary record-batch frames and tails the broadcast leg with
+        # the frame-aware reader (SharedFileTopic would write JSONL
+        # into a columnar pipeline and parse none of its output).
+        from ..server.columnar_log import make_tail_reader, make_topic
+
+        raw = make_topic(
+            os.path.join(scratch, "topics", "rawdeltas.jsonl"),
+            log_format,
+        )
+        bc_reader = make_tail_reader(make_topic(
+            os.path.join(scratch, "topics", "broadcast.jsonl"),
+            log_format,
+        ))
         hot_doc = "hotdoc"
         colds = [(f"cold{d}", c) for d in range(cold_docs)
                  for c in range(1, cold_clients + 1)]
@@ -360,10 +376,50 @@ def _collect_slo(sup) -> dict:
 # ---------------------------------------------------------------------------
 
 
+def _drive_ranged_summarizers(shared: str, log_format: str,
+                              summary_ops: int, topo: dict) -> int:
+    """Drive one RANGED summarizer per live topology range to
+    quiescence over already-written ``deltas-{rid}`` topics — the
+    per-range elastic summary surface (`_drive_summarizer`'s fabric
+    twin; the supervised form is `ShardWorker(elastic=True,
+    summarize=True)`). Returns the manifest count."""
+    from ..server.columnar_log import make_tail_reader, make_topic
+    from ..server.shard_fabric import ranged_role_class
+    from ..server.summarizer import SummarizerRole
+
+    emitted = 0
+    for entry in topo["ranges"]:
+        cls = ranged_role_class(SummarizerRole, entry, topo["epoch"])
+        role = cls(shared, owner=f"stampede-{entry['rid']}",
+                   ttl_s=3600.0, log_format=log_format,
+                   summary_ops=summary_ops)
+        role.fence = 1
+        reader = make_tail_reader(make_topic(
+            os.path.join(shared, "topics",
+                         f"{role.in_topic_name}.jsonl"),
+            log_format,
+        ))
+        while True:
+            entries = reader.poll(4096)
+            if not entries:
+                break
+            out: List[dict] = []
+            for line_idx, rec in entries:
+                role.process(line_idx, rec, out)
+            role.flush_batch(out)
+            if out:
+                role.out_topic.append_many(out, fence=1,
+                                           owner=role.owner)
+                emitted += len(out)
+            role.offset = reader.next_line
+    return emitted
+
+
 def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
                            n_clients: int = 4, summary_ops: int = 1000,
                            boot_checks: int = 3, threads: int = 16,
                            log_format: str = "json",
+                           elastic_ranges: int = 0,
                            work_dir: Optional[str] = None) -> dict:
     """A partition heals: `n_sessions` clients that were offline for
     the whole log catch up SIMULTANEOUSLY through the summary path.
@@ -377,8 +433,18 @@ def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
     bit-identical to a cold full-log replay (the PR 10 contract under
     stampede conditions), and every session's catch-up SIGNATURE
     (manifest seq/handle, tail key range) single-valued — a stampede
-    cannot pass by handing different clients different states."""
+    cannot pass by handing different clients different states.
+
+    `elastic_ranges` >= 2 runs the PER-RANGE elastic summary variant
+    (PR 13 follow-up b over PR 14's elastic summarizer): the stream
+    splits into hash-range ``deltas-{rid}`` topics, one RANGED
+    summarizer serves each range, and every stampeding session reads
+    through the MERGED `SummaryIndex` over the per-range
+    ``summaries-{rid}`` topics — the same single-signature gate must
+    hold across the fabric-shaped surface, plus a background doc per
+    other range proving the merged index resolves them all."""
     from ..server.columnar_log import make_topic
+    from ..server.queue import RangeLeaseStore, range_for_doc
     from ..server.summarizer import (
         SummaryIndex,
         SummaryReplica,
@@ -389,18 +455,71 @@ def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
 
     scratch = work_dir or tempfile.mkdtemp(prefix="stampede-")
     reg, recorder, restore = _fresh_metrics()
+    elastic = int(elastic_ranges) >= 2
     try:
         summary_ops = max(16, min(int(summary_ops), log_len // 4))
         stream = build_mergetree_stream(log_len, n_clients=n_clients)
         os.makedirs(os.path.join(scratch, "topics"), exist_ok=True)
-        deltas = make_topic(
-            os.path.join(scratch, "topics", "deltas.jsonl"), log_format
-        )
-        for lo in range(0, len(stream), 16384):
-            deltas.append_many(stream[lo:lo + 16384])
-        _drive_summarizer(scratch, log_format, summary_ops)
-        store = open_summary_store(scratch)
-        index = SummaryIndex(scratch, log_format)
+        hot_deltas_topic = "deltas"
+        if elastic:
+            topo = RangeLeaseStore(scratch, "stampede").ensure_topology(
+                int(elastic_ranges)
+            )
+            # The hot doc lands in ITS range's topic; one background
+            # doc per OTHER range keeps every summaries-{rid} topic
+            # live, so the merged index demonstrably resolves across
+            # the whole per-range surface.
+            hot_rid = range_for_doc(topo, "doc0")["rid"]
+            hot_deltas_topic = f"deltas-{hot_rid}"
+            by_topic: Dict[str, List[dict]] = {hot_deltas_topic: stream}
+            bg_digests: Dict[str, str] = {}
+            bg_i = 0
+            for entry in topo["ranges"]:
+                if entry["rid"] == hot_rid:
+                    continue
+                # Find a doc hashing into this range (bounded probe).
+                doc = None
+                for k in range(10000):
+                    cand = f"bg{bg_i}-{k}"
+                    if range_for_doc(topo, cand)["rid"] == entry["rid"]:
+                        doc = cand
+                        break
+                if doc is None:
+                    continue
+                bg_i += 1
+                bg = build_mergetree_stream(
+                    max(64, summary_ops * 2), n_clients=2,
+                    seed=90 + bg_i, doc=doc,
+                )
+                by_topic.setdefault(
+                    f"deltas-{entry['rid']}", []
+                ).extend(bg)
+                cold_bg = SummaryReplica(None)
+                cold_bg.apply_records(bg)
+                bg_digests[doc] = cold_bg.state_digest()
+            for tname, recs in by_topic.items():
+                t = make_topic(
+                    os.path.join(scratch, "topics", f"{tname}.jsonl"),
+                    log_format,
+                )
+                for lo in range(0, len(recs), 16384):
+                    t.append_many(recs[lo:lo + 16384])
+            _drive_ranged_summarizers(scratch, log_format,
+                                      summary_ops, topo)
+            store = open_summary_store(scratch)
+            index = SummaryIndex(scratch, log_format, topics=[
+                f"summaries-{e['rid']}" for e in topo["ranges"]
+            ])
+        else:
+            deltas = make_topic(
+                os.path.join(scratch, "topics", "deltas.jsonl"),
+                log_format,
+            )
+            for lo in range(0, len(stream), 16384):
+                deltas.append_many(stream[lo:lo + 16384])
+            _drive_summarizer(scratch, log_format, summary_ops)
+            store = open_summary_store(scratch)
+            index = SummaryIndex(scratch, log_format)
 
         # Boot-equivalence gate (+ jit warm-up for the boot path).
         cold = SummaryReplica(None)
@@ -408,7 +527,8 @@ def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
         cold_digest = cold.state_digest()
         for _ in range(max(1, boot_checks)):
             cu = read_catchup(scratch, "doc0", log_format,
-                              index=index, store=store)
+                              index=index, store=store,
+                              deltas_topic=hot_deltas_topic)
             assert cu["manifest"] is not None, "no summary emitted"
             boot = SummaryReplica(cu["blob"])
             boot.apply_records(cu["ops"])
@@ -416,6 +536,22 @@ def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
                 "summary+tail boot diverged from cold replay under "
                 "stampede conditions"
             )
+        if elastic:
+            # The merged per-range surface resolves EVERY range's
+            # docs, not just the hot one.
+            for doc, want in bg_digests.items():
+                rid = range_for_doc(topo, doc)["rid"]
+                cu = read_catchup(scratch, doc, log_format,
+                                  index=index, store=store,
+                                  deltas_topic=f"deltas-{rid}")
+                assert cu["manifest"] is not None, (
+                    f"merged index missed {doc}'s range summary"
+                )
+                boot = SummaryReplica(cu["blob"])
+                boot.apply_records(cu["ops"])
+                assert boot.state_digest() == want, (
+                    f"per-range boot diverged for {doc}"
+                )
 
         # The stampede proper: all sessions released at once.
         h_catchup = reg.histogram("op_stage_ms", stage="read_catchup")
@@ -450,7 +586,8 @@ def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
                 try:
                     t0 = time.perf_counter()
                     cu = read_catchup(scratch, "doc0", log_format,
-                                      index=index, store=store)
+                                      index=index, store=store,
+                                      deltas_topic=hot_deltas_topic)
                     ms = (time.perf_counter() - t0) * 1000.0
                     lat_ms[i] = ms
                     sigs[i] = session_sig(cu)
@@ -487,6 +624,7 @@ def run_reconnect_stampede(n_sessions: int = 2000, log_len: int = 20000,
             "open_loop": True,  # all sessions offered at once
             "sessions": n_sessions,
             "log_len": log_len,
+            "elastic_ranges": int(elastic_ranges) if elastic else 0,
             "summary_seq": cu["manifest"]["seq"],
             "tail_ops": len(cu["ops"]),
             "wall_s": round(wall, 3),
@@ -1506,6 +1644,7 @@ def scenario_p99s(suite: dict) -> Dict[str, Optional[float]]:
 def run_scenario_suite(scale: float = 1.0, deli_impl: str = "scalar",
                        log_format: str = "json",
                        swarm_sessions: int = 100_000,
+                       stampede_elastic_ranges: int = 0,
                        work_dir: Optional[str] = None) -> dict:
     """All four scenario primitives at a common `scale` (1.0 = the
     full shapes: 2k-writer storm, 2k-session stampede, 100k-session
@@ -1535,6 +1674,10 @@ def run_scenario_suite(scale: float = 1.0, deli_impl: str = "scalar",
         n_sessions=max(24, int(2000 * scale)),
         log_len=max(2048, int(20000 * scale)),
         log_format=log_format,
+        # >= 2: the per-range elastic-summary variant (PR 13
+        # follow-up b) — the burst reads through the MERGED
+        # SummaryIndex over hash-range summaries-{rid} topics.
+        elastic_ranges=stampede_elastic_ranges,
         work_dir=os.path.join(work_dir, "stampede")
         if work_dir else None,
     )

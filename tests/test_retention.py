@@ -720,3 +720,138 @@ def test_week_of_traffic_churn_scaled():
     assert res["retention_disk_mb"] > 0
     usage = res["disk_bytes_per_cycle"]
     assert max(usage[2:]) <= 1.35 * usage[1]
+
+
+# ---------------------------------------------------------------------------
+# front-door retention (ISSUE 15 satellite): ingress + nacks topics
+# ---------------------------------------------------------------------------
+
+
+class TestFrontDoorRetention:
+    def _feed_front_door(self, d, n_ops=60, bad_every=10):
+        """Columnar front door: feed the ingress topic (auth off —
+        no tenants.json), drain the admission role, return it."""
+        from fluidframework_tpu.server.ingress import IngressRole
+
+        os.makedirs(os.path.join(d, "topics"), exist_ok=True)
+        ing_t = make_topic(os.path.join(d, "topics", "ingress.jsonl"),
+                           "columnar")
+        recs = []
+        for i in range(n_ops):
+            if bad_every and i % bad_every == bad_every - 1:
+                # Oversized record -> a nack on the nacks topic.
+                recs.append({"kind": "op", "doc": "d0", "client": 1,
+                             "clientSeq": i + 1, "refSeq": 0,
+                             "contents": {"x": "z" * 300000}})
+            else:
+                recs.append({"kind": "op", "doc": "d0", "client": 1,
+                             "clientSeq": i + 1, "refSeq": 0,
+                             "contents": {"i": i}})
+        # Feed + pump per chunk so admissions/nacks land across many
+        # frames (a realistic steady state — frame boundaries are what
+        # the truncate cut can land on).
+        ing = IngressRole(d, "ing-1", ttl_s=3600.0,
+                          log_format="columnar", ckpt_interval_s=0.0)
+        for lo in range(0, len(recs), 8):
+            ing_t.append_many(recs[lo:lo + 8], fence=1, owner="feeder")
+            while ing.step(idle_sleep=0) > 0:
+                pass
+        ing.checkpoint()
+        return ing, ing_t
+
+    def test_ingress_and_nacks_truncate_behind_admission(self, tmp_path):
+        """PR 14 follow-up: with the front door's topics managed, the
+        `ingress` prefix reclaims behind the ADMISSION role's own
+        input checkpoint (its consumer floor) and `nacks` behind its
+        producer recovery window — both commit-then-reclaim fenced."""
+        d = str(tmp_path)
+        ing, ing_t = self._feed_front_door(d)
+        nacks_t = make_topic(os.path.join(d, "topics", "nacks.jsonl"),
+                             "columnar")
+        assert ing_t.base_offsets()[0] == 0
+        n_nacks = sum(1 for r in nacks_t.read_from(0)
+                      if isinstance(r, dict))
+        assert n_nacks > 0
+        ret = RetentionRole(
+            d, "ret-1", ttl_s=3600.0, log_format="columnar",
+            topics=("ingress", "nacks"), consumers=(),
+            interval_s=0.0, gc_interval_s=1e9, min_reclaim_bytes=1,
+            keep_tail=4,
+        )
+        ret.step(idle_sleep=0)
+        ret._retain_pass()
+        # Ingress prefix reclaimed up to (checkpoint - keep_tail).
+        base_r, _ = ing_t.base_offsets()
+        assert base_r > 0
+        assert base_r <= ing.offset - 0  # never past the admission ckpt
+        # Nacks reclaimed too, behind the producer recovery window.
+        nbase, _ = nacks_t.base_offsets()
+        assert nbase > 0
+        commits = [r for r in ret.out_topic.read_entries(0)[0]
+                   if isinstance(r[1], dict)
+                   and r[1].get("kind") == "truncate"]
+        assert {c[1]["topic"] for c in commits} == {"ingress", "nacks"}
+
+    def test_exactly_once_across_ingress_truncate(self, tmp_path):
+        """The gate the satellite names: truncate the ingress topic
+        behind the admission checkpoint, RESTART the front door with
+        no fresh checkpoint write, and every admission/nack decision
+        lands exactly once — the recovery scan never needs the
+        reclaimed prefix, and logical offsets survive the cut."""
+        from fluidframework_tpu.server.ingress import IngressRole
+
+        d = str(tmp_path)
+        ing, ing_t = self._feed_front_door(d)
+        raw_t = make_topic(
+            os.path.join(d, "topics", "rawdeltas.jsonl"), "columnar"
+        )
+        admitted0 = [r for r in raw_t.read_from(0)
+                     if isinstance(r, dict)]
+        assert admitted0
+        ret = RetentionRole(
+            d, "ret-1", ttl_s=3600.0, log_format="columnar",
+            topics=("ingress", "nacks"), consumers=(),
+            interval_s=0.0, gc_interval_s=1e9, min_reclaim_bytes=1,
+            keep_tail=2,
+        )
+        ret.step(idle_sleep=0)
+        ret._retain_pass()
+        assert ing_t.base_offsets()[0] > 0
+        # Feed a tail past the cut, then restart the admission role
+        # WITHOUT the first instance checkpointing its latest work —
+        # the successor's exactly-once scan replays the gap silently.
+        more = [{"kind": "op", "doc": "d0", "client": 1,
+                 "clientSeq": 1000 + i, "refSeq": 0,
+                 "contents": {"tail": i}} for i in range(6)]
+        ing_t.append_many(more, fence=1, owner="feeder")
+        ing.leases.release("ingress")
+        ing2 = IngressRole(d, "ing-2", ttl_s=3600.0,
+                           log_format="columnar", ckpt_interval_s=0.0)
+        while ing2.step(idle_sleep=0) > 0:
+            pass
+        admitted = [r for r in raw_t.read_from(0)
+                    if isinstance(r, dict)]
+        in_offs = [r.get("inOff") for r in admitted]
+        assert len(set(in_offs)) == len(in_offs), "duplicate admission"
+        tail = [r for r in admitted
+                if isinstance(r.get("contents"), dict)
+                and "tail" in r["contents"]]
+        assert len(tail) == 6, "tail admissions lost across the cut"
+        # The pre-cut admissions are still exactly the original set.
+        assert admitted[:len(admitted0)] == admitted0
+
+    def test_supervisor_derives_front_door_topics(self, tmp_path):
+        from fluidframework_tpu.server.supervisor import (
+            ServiceSupervisor,
+        )
+
+        sup = ServiceSupervisor(
+            str(tmp_path), log_format="columnar", ingress=True,
+            retention=True,
+        )
+        assert sup.child_env["FLUID_RETENTION_TOPICS"] == \
+            "deltas,rawdeltas,ingress,nacks"
+        sup2 = ServiceSupervisor(
+            str(tmp_path / "b"), log_format="columnar", retention=True,
+        )
+        assert "FLUID_RETENTION_TOPICS" not in sup2.child_env

@@ -96,6 +96,27 @@ def test_reconnect_stampede_converges_and_measures(tmp_path):
     assert res["tail_ops"] >= 0 and res["summary_seq"] > 0
 
 
+def test_reconnect_stampede_elastic_ranges_single_signature(tmp_path):
+    """ISSUE 15 satellite (PR 13 follow-up b): the stampede through
+    PER-RANGE elastic summaries — the stream split into hash-range
+    ``deltas-{rid}`` topics, a RANGED summarizer per range, and every
+    session catching up through the MERGED `SummaryIndex` over the
+    ``summaries-{rid}`` topics. One catch-up signature across the
+    burst, hot-doc boots bit-identical to cold replay, and the merged
+    surface resolves every background range's doc too (asserted
+    inside the scenario)."""
+    res = run_reconnect_stampede(
+        n_sessions=32, log_len=1024, summary_ops=128, threads=8,
+        elastic_ranges=3,
+        work_dir=str(tmp_path / "stampede-elastic"),
+    )
+    assert res["elastic_ranges"] == 3
+    assert res["boots_bit_identical"] is True
+    assert res["digest"]  # one signature across the whole burst
+    assert res["catchup_ms"]["count"] == 32
+    assert res["summary_seq"] > 0
+
+
 def test_read_swarm_scaled_loud_skip_and_convergence(tmp_path):
     """A scaled swarm must SAY it is scaled: below the 100k-session
     bar the throughput evidence carries an explicit skip reason (the

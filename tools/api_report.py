@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 PACKAGES = [
     "fluidframework_tpu.core.mergetree",
     "fluidframework_tpu.core.native_engine",
+    "fluidframework_tpu.core.overlay_fold",
     "fluidframework_tpu.core.overlay_replay",
     "fluidframework_tpu.core.columnar_replay",
     "fluidframework_tpu.ops.mergetree_kernel",
@@ -54,6 +55,7 @@ PACKAGES = [
     "fluidframework_tpu.server.supervisor",
     "fluidframework_tpu.framework",
     "fluidframework_tpu.parallel",
+    "fluidframework_tpu.parallel.device_plane",
     "fluidframework_tpu.protocol",
     "fluidframework_tpu.protocol.record_batch",
     "fluidframework_tpu.testing",

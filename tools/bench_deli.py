@@ -97,8 +97,18 @@ visible throttle nacks, retry-and-converge exactly-once.
 tenant-skewed mix — each with /slo quantiles, slow-op spans, and a
 convergence digest.
 
+`--device-plane [DxM]` switches to the 2-D DEVICE-PLANE mode
+(`testing.deli_bench.run_device_plane_bench`, bench_configs
+`config15_device_plane`'s engine): ONE ``docs x model`` mesh serving
+sequencing AND summary folds — the sequencer's verdict digests gated
+bit-identical between single-device and the plane's docs-axis slice,
+and the summarizer's kernel-vs-overlay fold backends gated
+byte-identical at every emission with `fold_backend_speedup` reported
+where honestly measurable.
+
 Usage: python tools/bench_deli.py
-    [--shard | --devices [LIST] | --latency [--fused-hop]
+    [--shard | --devices [LIST] | --device-plane [DxM]
+     | --latency [--fused-hop]
      | --catchup | --hops | --ingress | --scenarios]
 """
 
@@ -166,6 +176,22 @@ if "--ingress" in sys.argv:
     # BD_DOCS (2000), BD_CLIENTS (16), BD_OPS (2), BD_LOG_FORMAT
     # (json), BD_PARTITIONS (2).
     os.environ["BD_INGRESS"] = "1"
+
+if "--device-plane" in sys.argv:
+    # 2-D device-plane mode: ONE docs x model mesh serving sequencing
+    # AND summary folds (testing.deli_bench.run_device_plane_bench,
+    # bench_configs config15_device_plane's engine) — sequencer
+    # digests gated 1-dev vs plane slice, summarizer fold backends
+    # (vmapped kernel vs overlay-pallas) gated byte-identical at
+    # every emission, fold_backend_speedup reported where honestly
+    # measurable (fold_parity_skip_reason otherwise). Env knobs:
+    # BD_DOCS (2048), BD_OPS_PER_DOC (64), BD_FOLD_DOCS (4),
+    # BD_FOLD_OPS (1500), BD_REPEATS (3).
+    i = sys.argv.index("--device-plane")
+    arg = sys.argv[i + 1] if len(sys.argv) > i + 1 else ""
+    os.environ["BD_DEVICE_PLANE"] = (
+        arg if arg and not arg.startswith("-") else "2x2"
+    )
 
 if "--devices" in sys.argv:
     # Multi-device scaling mode: `--devices [1,4,8]` measures the

@@ -52,6 +52,10 @@ HEADLINE_FIELDS = (
     "speedup",                  # scaling benches (ratio)
     "columnar_vs_json",         # log-format guard (ratio)
     "hop_fsync_reduction",      # fused durable+broadcast hop (ratio)
+    "fold_backend_speedup",     # overlay vs vmapped summarizer fold
+    #                             (ratio; carries a skipped flag on
+    #                             hosts where pallas cannot lower —
+    #                             interpreter timings never gate)
     "fused_vs_split_p99",       # fused-hop open-loop latency (ratio;
     #                             recorded with a skipped flag — the
     #                             jitter-bound ratio is never gated)

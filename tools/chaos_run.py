@@ -6,10 +6,23 @@ Run: python tools/chaos_run.py --seed N
         [--deli scalar|kernel] [--log-format json|columnar]
         [--boxcar-rate R] [--metrics-out PATH] [--trace-wire]
         [--partitions N] [--workers W] [--devices N] [--elastic]
+        [--device-plane DxM] [--fold-backend kernel|overlay]
         [--summarizer] [--summary-ops N] [--retention] [--fused-hop]
         [--ingress [--bad-submits N] [--ingress-rate R]
          [--ingress-backlog B]] [--autoscale]
         [--downstream fused|split] [--scenario hotdoc]
+
+`--device-plane DxM` (with `--deli kernel`) runs the farm on ONE 2-D
+``docs x model`` device mesh (`parallel.device_plane`): the kernel
+deli children shard their doc-slot pools on the plane's docs-axis
+slice while the summarizer's merge-tree folds lay out over the whole
+pool, all under docs*model forced virtual host devices (the CPU-CI
+emulation of a real slice). `--fold-backend overlay` (with
+`--summarizer`) additionally folds summaries through the
+overlay-pallas engine in INTERPRETER mode (`FLUID_FOLD_INTERPRET=1` —
+the CPU-CI correctness form), so the summary-integrity gate proves
+the overlay backend's content-addressed blobs bit-identical to the
+kernel fold's and to cold scalar replay, under kill faults.
 
 `--scenario hotdoc` reshapes the workload with a traffic-profile
 scenario (`testing.chaos.SCENARIO_PROFILES`): a contiguous viral-doc
@@ -235,6 +248,8 @@ def main() -> int:
         deli_devices=(lambda v: int(v) if v else None)(
             _take("--devices", None)
         ),
+        device_plane=_take("--device-plane", None),
+        fold_backend=_take("--fold-backend", None),
         elastic=elastic,
         trace_wire=trace_wire,
         summarizer=summarizer,
@@ -271,6 +286,8 @@ def main() -> int:
              if cfg.n_partitions > 1 else "")
     dev = (f" devices={cfg.deli_devices}"
            if cfg.deli_devices and cfg.deli_devices > 1 else "")
+    dev += (f" plane={cfg.device_plane}" if cfg.device_plane else "")
+    dev += (f" fold={cfg.fold_backend}" if cfg.fold_backend else "")
     print(f"chaos run: seed={seed} faults={','.join(faults)} "
           f"docs={cfg.n_docs} clients={cfg.n_clients} "
           f"ops/client={cfg.ops_per_client} deli={cfg.deli_impl} "
